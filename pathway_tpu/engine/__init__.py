@@ -1,0 +1,1 @@
+"""The TPU-native incremental dataflow engine (reference src/engine, Rust)."""

@@ -1,0 +1,187 @@
+"""Columnar delta batches — the unit of dataflow in the engine.
+
+Where the reference engine streams row-at-a-time ``(key, value, time, diff)``
+updates through differential-dataflow operators (``src/engine/dataflow.rs``),
+this engine moves **columnar batches**: a ``Delta`` is a struct-of-arrays
+(numpy host-side; dense numeric columns are handed to JAX/XLA by the
+expression compiler and reducer kernels). Diffs are ±k multiplicity weights,
+exactly like differential dataflow's ``diff`` field.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+import numpy as np
+
+from . import keys as K
+
+__all__ = ["Delta", "concat_deltas", "rows_to_columns", "column_of_values", "rows_equal"]
+
+
+def rows_equal(a: tuple | None, b: tuple | None) -> bool:
+    """Tuple equality tolerating ndarray-valued cells."""
+    if a is None or b is None:
+        return a is b
+    if len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        if isinstance(x, np.ndarray) or isinstance(y, np.ndarray):
+            if not (
+                isinstance(x, np.ndarray)
+                and isinstance(y, np.ndarray)
+                and x.shape == y.shape
+                and bool(np.all(x == y))
+            ):
+                return False
+        elif x != y and not (x is None and y is None):
+            return False
+    return True
+
+
+def column_of_values(values: list[Any]) -> np.ndarray:
+    """Build a column array from python values, picking the densest dtype."""
+    if not values:
+        return np.empty(0, dtype=object)
+    # unwrap numpy scalars so cells extracted from dense arrays (groupby/join
+    # rebuilds) re-densify instead of degrading every column to object dtype
+    if any(isinstance(v, np.generic) for v in values):
+        values = [v.item() if isinstance(v, np.generic) else v for v in values]
+    first_non_none = next((v for v in values if v is not None), None)
+    if any(v is None for v in values):
+        return _object_column(values)
+    if isinstance(first_non_none, bool):
+        if all(isinstance(v, bool) for v in values):
+            return np.array(values, dtype=np.bool_)
+        return _object_column(values)
+    if isinstance(first_non_none, int) and not isinstance(first_non_none, bool):
+        if all(type(v) is int for v in values):
+            try:
+                return np.array(values, dtype=np.int64)
+            except OverflowError:
+                return _object_column(values)
+        if all(isinstance(v, (int, float)) and not isinstance(v, bool) for v in values):
+            return np.array(values, dtype=np.float64)
+        return _object_column(values)
+    if isinstance(first_non_none, float):
+        if all(isinstance(v, (int, float)) and not isinstance(v, bool) for v in values):
+            return np.array(values, dtype=np.float64)
+        return _object_column(values)
+    return _object_column(values)
+
+
+def _object_column(values: list[Any]) -> np.ndarray:
+    out = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        out[i] = v
+    return out
+
+
+@dataclass
+class Delta:
+    """A batch of keyed row updates: (keys[i], {col: data[col][i]}, diffs[i])."""
+
+    keys: np.ndarray  # uint64[n]
+    data: dict[str, np.ndarray] = field(default_factory=dict)  # each [n]
+    diffs: np.ndarray = None  # type: ignore[assignment]  # int64[n]
+
+    def __post_init__(self) -> None:
+        self.keys = np.asarray(self.keys, dtype=np.uint64)
+        if self.diffs is None:
+            self.diffs = np.ones(len(self.keys), dtype=np.int64)
+        else:
+            self.diffs = np.asarray(self.diffs, dtype=np.int64)
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    @property
+    def columns(self) -> list[str]:
+        return list(self.data.keys())
+
+    @staticmethod
+    def empty(columns: list[str]) -> "Delta":
+        return Delta(
+            keys=np.empty(0, dtype=np.uint64),
+            data={c: np.empty(0, dtype=object) for c in columns},
+            diffs=np.empty(0, dtype=np.int64),
+        )
+
+    def take(self, idx: np.ndarray) -> "Delta":
+        return Delta(
+            keys=self.keys[idx],
+            data={c: a[idx] for c, a in self.data.items()},
+            diffs=self.diffs[idx],
+        )
+
+    def replace_data(self, data: dict[str, np.ndarray]) -> "Delta":
+        return Delta(keys=self.keys, data=data, diffs=self.diffs)
+
+    def with_keys(self, new_keys: np.ndarray) -> "Delta":
+        return Delta(keys=new_keys, data=self.data, diffs=self.diffs)
+
+    def negated(self) -> "Delta":
+        return Delta(keys=self.keys, data=self.data, diffs=-self.diffs)
+
+    def row(self, i: int) -> tuple:
+        return tuple(self.data[c][i] for c in self.data)
+
+    def iter_rows(self) -> Iterator[tuple[int, tuple, int]]:
+        """Yield (key, row_values_tuple, diff) per entry — host-side slow path."""
+        cols = list(self.data.values())
+        for i in range(len(self.keys)):
+            yield int(self.keys[i]), tuple(c[i] for c in cols), int(self.diffs[i])
+
+    def select_columns(self, names: list[str]) -> "Delta":
+        return Delta(keys=self.keys, data={n: self.data[n] for n in names}, diffs=self.diffs)
+
+    def consolidated(self) -> "Delta":
+        """Sum diffs of identical (key, row) entries; drop zero-diff entries.
+
+        The analog of differential's ``consolidate``; output ops use it so a
+        retract+insert of an unchanged row cancels out within a tick.
+        """
+        if len(self) <= 1:
+            if len(self) == 1 and self.diffs[0] == 0:
+                return self.take(np.array([], dtype=np.int64))
+            return self
+        row_sig = K.mix_columns(list(self.data.values()), len(self)) ^ self.keys
+        order = np.argsort(row_sig, kind="stable")
+        sig_sorted = row_sig[order]
+        boundaries = np.flatnonzero(np.diff(sig_sorted) != 0) + 1
+        starts = np.concatenate([[0], boundaries])
+        sums = np.add.reduceat(self.diffs[order], starts)
+        keep = sums != 0
+        reps = order[starts[keep]]
+        out = self.take(reps)
+        out.diffs = sums[keep]
+        return out
+
+
+def concat_deltas(deltas: list[Delta], columns: list[str] | None = None) -> Delta:
+    deltas = [d for d in deltas if d is not None and len(d) > 0]
+    if not deltas:
+        return Delta.empty(columns or [])
+    if len(deltas) == 1:
+        return deltas[0]
+    cols = columns if columns is not None else deltas[0].columns
+    return Delta(
+        keys=np.concatenate([d.keys for d in deltas]),
+        data={
+            c: _concat_cols([d.data[c] for d in deltas]) for c in cols
+        },
+        diffs=np.concatenate([d.diffs for d in deltas]),
+    )
+
+
+def _concat_cols(arrs: list[np.ndarray]) -> np.ndarray:
+    if len({a.dtype for a in arrs}) > 1:
+        arrs = [a.astype(object) for a in arrs]
+    return np.concatenate(arrs)
+
+
+def rows_to_columns(rows: list[tuple], names: list[str]) -> dict[str, np.ndarray]:
+    return {
+        name: column_of_values([r[i] for r in rows]) for i, name in enumerate(names)
+    }

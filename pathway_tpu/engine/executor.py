@@ -1,0 +1,157 @@
+"""Single-worker dataflow executor: logical-time ticks over an operator DAG.
+
+Re-design of the reference's per-worker event loop
+(``src/engine/dataflow.rs:5596-5650`` — ``step_or_park`` over timely
+operators): here the DAG is explicit, acyclic (iteration is a composite node
+running an inner fixpoint), and each logical timestamp is processed by one
+topological sweep that moves columnar ``Delta`` batches between operators.
+Progress tracking degenerates to "times are processed in nondecreasing
+order", which is exactly the reference's total-order ``Timestamp``
+(``src/engine/timestamp.rs:20``) semantics.
+
+Multi-worker sharding (reference: timely exchange channels) is layered above
+by partitioning deltas on ``keys.shard_of`` — see ``parallel/``.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable
+
+import numpy as np
+
+from .delta import Delta, concat_deltas
+
+__all__ = ["Node", "SourceNode", "Executor", "END_TIME"]
+
+END_TIME = 1 << 62
+
+
+class Node:
+    """An engine operator: consumes per-tick input deltas, emits one delta."""
+
+    _ids = itertools.count()
+
+    def __init__(self, inputs: list["Node"], column_names: list[str]):
+        self.node_id = next(Node._ids)
+        self.inputs = list(inputs)
+        self.column_names = list(column_names)
+
+    def process(self, time: int, in_deltas: list[Delta | None]) -> Delta | None:
+        raise NotImplementedError
+
+    def advance_to(self, time: int) -> Delta | None:
+        """Called when logical time advances to `time`, before any deltas at
+        `time` are delivered. Temporal buffers release their due rows here."""
+        return None
+
+    def on_end(self) -> Delta | None:
+        """Input frontier closed — flush anything still buffered."""
+        return None
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} #{self.node_id} cols={self.column_names}>"
+
+
+class SourceNode(Node):
+    """A source: provides a schedule of (time, delta) batches.
+
+    Batch inputs yield everything at a single time; streaming test sources
+    (stream generators, demo streams, the python ConnectorSubject machinery)
+    yield a finite timestamped schedule. Long-running realtime sources
+    implement ``poll`` instead (see io/).
+    """
+
+    def __init__(self, column_names: list[str]):
+        super().__init__([], column_names)
+
+    def schedule(self) -> list[tuple[int, Delta]]:
+        raise NotImplementedError
+
+    def process(self, time: int, in_deltas: list[Delta | None]) -> Delta | None:
+        return None
+
+
+class Executor:
+    """Runs a DAG of Nodes to completion over all scheduled logical times."""
+
+    def __init__(self, nodes: list[Node]):
+        # nodes must be in construction order == topological order
+        self.nodes = sorted(nodes, key=lambda n: n.node_id)
+        self._consumers: dict[int, list[tuple[Node, int]]] = {}
+        for node in self.nodes:
+            for port, inp in enumerate(node.inputs):
+                self._consumers.setdefault(inp.node_id, []).append((node, port))
+        self._on_time_end: list[Callable[[int], None]] = []
+
+    def run(self) -> None:
+        # Collect source schedules, merged by time (monotone processing order).
+        pending: dict[int, list[tuple[SourceNode, Delta]]] = {}
+        for node in self.nodes:
+            if isinstance(node, SourceNode):
+                for time, delta in node.schedule():
+                    pending.setdefault(int(time), []).append((node, delta))
+
+        for time in sorted(pending):
+            self._tick(time, pending[time])
+        self._finish()
+
+    def _tick(self, time: int, source_emissions: list[tuple[SourceNode, Delta]]) -> None:
+        inbox: dict[int, dict[int, list[Delta]]] = {}
+        seeded: dict[int, list[Delta]] = {}
+        for src, delta in source_emissions:
+            seeded.setdefault(src.node_id, []).append(delta)
+        for node in self.nodes:
+            out_parts: list[Delta] = []
+            released = node.advance_to(time)
+            if released is not None and len(released):
+                out_parts.append(released)
+            ports = inbox.get(node.node_id, {})
+            if node.node_id in seeded:
+                out_parts.extend(d for d in seeded[node.node_id] if len(d))
+            elif ports or not node.inputs:
+                ins: list[Delta | None] = [
+                    concat_deltas(ports.get(p, []), node.inputs[p].column_names)
+                    if p in ports
+                    else None
+                    for p in range(len(node.inputs))
+                ]
+                if any(x is not None for x in ins):
+                    out = node.process(time, ins)
+                    if out is not None and len(out):
+                        out_parts.append(out)
+            if out_parts:
+                emitted = concat_deltas(out_parts, out_parts[0].columns)
+                self._route(node, emitted, inbox)
+        for cb in self._on_time_end:
+            cb(time)
+
+    def _route(
+        self, node: Node, delta: Delta, inbox: dict[int, dict[int, list[Delta]]]
+    ) -> None:
+        for consumer, port in self._consumers.get(node.node_id, []):
+            inbox.setdefault(consumer.node_id, {}).setdefault(port, []).append(delta)
+
+    def _finish(self) -> None:
+        inbox: dict[int, dict[int, list[Delta]]] = {}
+        for node in self.nodes:
+            out_parts: list[Delta] = []
+            ports = inbox.get(node.node_id, {})
+            if ports:
+                ins = [
+                    concat_deltas(ports.get(p, []), node.inputs[p].column_names)
+                    if p in ports
+                    else None
+                    for p in range(len(node.inputs))
+                ]
+                out = node.process(END_TIME, ins)
+                if out is not None and len(out):
+                    out_parts.append(out)
+            flushed = node.on_end()
+            if flushed is not None and len(flushed):
+                out_parts.append(flushed)
+            if out_parts:
+                emitted = concat_deltas(out_parts, out_parts[0].columns)
+                self._route(node, emitted, inbox)
+        for cb in self._on_time_end:
+            cb(END_TIME)

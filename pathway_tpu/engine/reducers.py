@@ -1,0 +1,296 @@
+"""Reducer implementations with retraction correctness.
+
+Re-design of ``src/engine/reduce.rs:22-61``: semigroup reducers (count, sum)
+keep O(1) state updated by ±diff; order-sensitive reducers (min/max/argmin/
+argmax/unique/any/tuple variants) keep multisets so retractions restore the
+correct next-best value — the same split the reference draws between
+``SemigroupReducerImpl`` and full-state reducers.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+__all__ = ["ReducerImpl", "REDUCERS", "make_reducer"]
+
+
+def _hashable(v: Any) -> Any:
+    if isinstance(v, np.ndarray):
+        return (v.shape, v.tobytes())
+    return v
+
+
+class ReducerImpl:
+    name = "reducer"
+
+    def make(self) -> Any:
+        raise NotImplementedError
+
+    def update(self, acc: Any, values: tuple, diff: int, row_key: int, time: int) -> Any:
+        raise NotImplementedError
+
+    def extract(self, acc: Any) -> Any:
+        raise NotImplementedError
+
+
+class CountReducer(ReducerImpl):
+    name = "count"
+
+    def make(self):
+        return 0
+
+    def update(self, acc, values, diff, row_key, time):
+        return acc + diff
+
+    def extract(self, acc):
+        return acc
+
+
+class SumReducer(ReducerImpl):
+    """Semigroup sum. Works for ints, floats and ndarrays (ArraySum)."""
+
+    name = "sum"
+
+    def make(self):
+        return None
+
+    def update(self, acc, values, diff, row_key, time):
+        (v,) = values
+        contrib = v * diff
+        if acc is None:
+            return contrib
+        return acc + contrib
+
+    def extract(self, acc):
+        return acc
+
+
+class _MultisetReducer(ReducerImpl):
+    """Base: multiset of (value-ish entries) with counts."""
+
+    def make(self):
+        return {}
+
+    def _entry(self, values: tuple, row_key: int, time: int):
+        raise NotImplementedError
+
+    def update(self, acc, values, diff, row_key, time):
+        e = self._entry(values, row_key, time)
+        c = acc.get(e, 0) + diff
+        if c == 0:
+            acc.pop(e, None)
+        else:
+            acc[e] = c
+        return acc
+
+
+class MinReducer(_MultisetReducer):
+    name = "min"
+
+    def _entry(self, values, row_key, time):
+        return _hashable(values[0])
+
+    def extract(self, acc):
+        return min(acc.keys()) if acc else None
+
+
+class MaxReducer(MinReducer):
+    name = "max"
+
+    def extract(self, acc):
+        return max(acc.keys()) if acc else None
+
+
+class ArgMinReducer(_MultisetReducer):
+    name = "argmin"
+
+    def _entry(self, values, row_key, time):
+        return (_hashable(values[0]), row_key)
+
+    def _pick(self, acc):
+        return min(acc.keys()) if acc else None
+
+    def extract(self, acc):
+        e = self._pick(acc)
+        return np.uint64(e[1]) if e is not None else None
+
+
+class ArgMaxReducer(ArgMinReducer):
+    name = "argmax"
+
+    def _pick(self, acc):
+        return max(acc.keys()) if acc else None
+
+
+class UniqueReducer(_MultisetReducer):
+    """Exactly-one-distinct-value reducer (errors otherwise)."""
+
+    name = "unique"
+
+    def _entry(self, values, row_key, time):
+        return _hashable(values[0])
+
+    def extract(self, acc):
+        if not acc:
+            return None
+        if len(acc) > 1:
+            raise ValueError(
+                f"More than one distinct value passed to the unique reducer: {sorted(map(repr, acc))[:2]}"
+            )
+        return next(iter(acc.keys()))
+
+
+class AnyReducer(_MultisetReducer):
+    """Deterministic 'any': smallest (row_key) entry's value."""
+
+    name = "any"
+
+    def _entry(self, values, row_key, time):
+        return (row_key, _hashable(values[0]))
+
+    def extract(self, acc):
+        if not acc:
+            return None
+        return min(acc.keys())[1]
+
+
+class SortedTupleReducer(_MultisetReducer):
+    name = "sorted_tuple"
+
+    def __init__(self, skip_nones: bool = False):
+        self._skip_nones = skip_nones
+
+    def _entry(self, values, row_key, time):
+        return _hashable(values[0])
+
+    def extract(self, acc):
+        items = []
+        for v, c in acc.items():
+            if v is None and self._skip_nones:
+                continue
+            items.extend([v] * c)
+        return tuple(sorted(items, key=lambda x: (x is None, x)))
+
+
+class TupleReducer(_MultisetReducer):
+    """Values ordered deterministically by source row key (the reference
+    orders by the grouping source order; row-key order is our analog)."""
+
+    name = "tuple"
+
+    def __init__(self, skip_nones: bool = False):
+        self._skip_nones = skip_nones
+
+    def _entry(self, values, row_key, time):
+        return (row_key, _hashable(values[0]))
+
+    def extract(self, acc):
+        items = []
+        for (rk, v), c in sorted(acc.items(), key=lambda kv: kv[0][0]):
+            if v is None and self._skip_nones:
+                continue
+            items.extend([v] * c)
+        return tuple(items)
+
+
+class NdarrayReducer(TupleReducer):
+    name = "ndarray"
+
+    def extract(self, acc):
+        vals = super().extract(acc)
+        return np.array(vals)
+
+
+class EarliestReducer(_MultisetReducer):
+    name = "earliest"
+
+    def _entry(self, values, row_key, time):
+        return (time, row_key, _hashable(values[0]))
+
+    def extract(self, acc):
+        if not acc:
+            return None
+        return min(acc.keys())[2]
+
+
+class LatestReducer(EarliestReducer):
+    name = "latest"
+
+    def extract(self, acc):
+        if not acc:
+            return None
+        return max(acc.keys())[2]
+
+
+class StatefulReducer(ReducerImpl):
+    """Custom python accumulator (reference ``Reducer::Stateful`` +
+    ``custom_reducers.py``): combine-only (no retraction) semantics."""
+
+    name = "stateful"
+
+    def __init__(self, combine_fn):
+        self._combine = combine_fn
+
+    def make(self):
+        return None
+
+    def update(self, acc, values, diff, row_key, time):
+        return self._combine(acc, values, diff)
+
+    def extract(self, acc):
+        return acc
+
+
+class CustomAccumulatorReducer(ReducerImpl):
+    """BaseCustomAccumulator-driven reducer (reference
+    ``custom_reducers.py:108`` ``udf_reducer``): ``from_row`` builds a
+    partial accumulator per row; ``update``/``retract`` fold them."""
+
+    name = "custom_accumulator"
+
+    def __init__(self, acc_cls):
+        self._cls = acc_cls
+
+    def make(self):
+        return None
+
+    def update(self, acc, values, diff, row_key, time):
+        count = abs(diff)
+        for _ in range(count):
+            other = self._cls.from_row(list(values))
+            if diff > 0:
+                if acc is None:
+                    acc = other
+                else:
+                    acc.update(other)
+            else:
+                if acc is None:
+                    raise ValueError("retract before any insert in custom reducer")
+                acc.retract(other)
+        return acc
+
+    def extract(self, acc):
+        return acc.compute_result() if acc is not None else None
+
+
+REDUCERS: dict[str, type[ReducerImpl]] = {
+    "count": CountReducer,
+    "sum": SumReducer,
+    "min": MinReducer,
+    "max": MaxReducer,
+    "argmin": ArgMinReducer,
+    "argmax": ArgMaxReducer,
+    "unique": UniqueReducer,
+    "any": AnyReducer,
+    "sorted_tuple": SortedTupleReducer,
+    "tuple": TupleReducer,
+    "ndarray": NdarrayReducer,
+    "earliest": EarliestReducer,
+    "latest": LatestReducer,
+}
+
+
+def make_reducer(name: str, **kwargs) -> ReducerImpl:
+    return REDUCERS[name](**kwargs)

@@ -1,0 +1,1 @@
+"""Internals: declarative layer (reference python/pathway/internals)."""

@@ -1,0 +1,46 @@
+"""Custom reducer accumulators (reference ``internals/custom_reducers.py``)."""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any
+
+
+class BaseCustomAccumulator(ABC):
+    """Subclass with ``from_row``, ``update`` (mutating), ``compute_result``,
+    and optionally ``retract`` to support deletions
+    (reference custom_reducers.py:271)."""
+
+    @classmethod
+    @abstractmethod
+    def from_row(cls, row: list) -> "BaseCustomAccumulator": ...
+
+    @abstractmethod
+    def update(self, other: "BaseCustomAccumulator") -> None: ...
+
+    def retract(self, other: "BaseCustomAccumulator") -> None:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support retractions; "
+            "override retract() to handle deletions"
+        )
+
+    @abstractmethod
+    def compute_result(self) -> Any: ...
+
+
+def stateful_single(combine_fn, *args):
+    from .. import reducers
+
+    return reducers.stateful_single(combine_fn, *args)
+
+
+def stateful_many(combine_fn, *args):
+    from .. import reducers
+
+    return reducers.stateful_many(combine_fn, *args)
+
+
+def udf_reducer(reducer_cls):
+    from .. import reducers
+
+    return reducers.udf_reducer(reducer_cls)

@@ -1,0 +1,707 @@
+"""Compile ColumnExpression trees to whole-batch columnar kernels.
+
+This replaces two reference components at once:
+- the static type interpreter (``python/pathway/internals/type_interpreter.py``)
+- the row-at-a-time typed Rust interpreter (``src/engine/expression.rs:325``)
+
+An expression DAG compiles to ONE function over column arrays. Pure-numeric
+trees additionally compile to a fused ``jax.jit`` kernel that is used for
+large batches, so on TPU the whole expression lands on the VPU/MXU as a
+single XLA computation (cf. SURVEY §7: "jit whole expression DAGs into one
+XLA kernel per operator per batch").
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+from . import dtype as dt
+from . import expression as expr_mod
+from ..engine import keys as K
+from .expression import (
+    ApplyExpression,
+    AsyncApplyExpression,
+    CastExpression,
+    CoalesceExpression,
+    ColumnBinaryOpExpression,
+    ColumnConstExpression,
+    ColumnExpression,
+    ColumnReference,
+    ColumnUnaryOpExpression,
+    ConvertExpression,
+    DeclareTypeExpression,
+    FillErrorExpression,
+    GetExpression,
+    IdReference,
+    IfElseExpression,
+    IsNoneExpression,
+    IsNotNoneExpression,
+    MakeTupleExpression,
+    MethodCallExpression,
+    PointerExpression,
+    ReducerExpression,
+    RequireExpression,
+    UnwrapExpression,
+)
+
+JIT_THRESHOLD = int(os.environ.get("PATHWAY_TPU_JIT_THRESHOLD", "4096"))
+
+_NUMERIC = {dt.INT, dt.FLOAT, dt.BOOL}
+
+
+class ColumnEnv:
+    """Resolution of column references to engine column names + dtypes."""
+
+    def __init__(self) -> None:
+        self._map: dict[tuple[int, str], tuple[str | None, dt.DType]] = {}
+
+    def add(self, table: Any, name: str, engine_col: str | None, dtype: dt.DType) -> None:
+        self._map[(id(table), name)] = (engine_col, dtype)
+
+    def add_table(self, table: Any, prefix: str = "") -> None:
+        for name, dtype in table.schema.dtypes().items():
+            self.add(table, name, prefix + name, dtype)
+        self.add(table, "id", None if not prefix else prefix + "id", dt.POINTER)
+
+    def resolve(self, ref: ColumnReference) -> tuple[str | None, dt.DType]:
+        key = (id(ref.table), ref.name)
+        if key not in self._map:
+            raise KeyError(
+                f"column {ref.name!r} is not available in this context "
+                f"(table {ref.table!r})"
+            )
+        return self._map[key]
+
+
+@dataclass
+class Compiled:
+    fn: Callable[[dict[str, np.ndarray], np.ndarray], np.ndarray]
+    dtype: dt.DType
+
+
+def infer_dtype(expr: ColumnExpression, env: ColumnEnv) -> dt.DType:
+    """Static dtype of an expression (reference: type_interpreter.py)."""
+    if isinstance(expr, ReducerExpression):
+        return _reducer_dtype(expr, env)
+    _, dtype, _, _ = _build(expr, env)
+    return dtype
+
+
+def _reducer_dtype(expr: ReducerExpression, env: ColumnEnv) -> dt.DType:
+    name = expr._reducer
+    arg_ts = [infer_dtype(a, env) for a in expr._args]
+    if name == "count":
+        return dt.INT
+    if name in ("sum", "min", "max", "unique", "any", "earliest", "latest"):
+        return arg_ts[0] if arg_ts else dt.ANY
+    if name in ("argmin", "argmax"):
+        return dt.POINTER
+    if name == "avg":
+        return dt.FLOAT
+    if name == "sorted_tuple" or name == "tuple":
+        return dt.List(arg_ts[0] if arg_ts else dt.ANY)
+    if name == "ndarray":
+        return dt.Array(1, arg_ts[0] if arg_ts else dt.FLOAT)
+    return dt.ANY
+
+
+def compile_expr(expr: ColumnExpression, env: ColumnEnv) -> Compiled:
+    np_fn, dtype, jax_ok, refs = _build(expr, env)
+    if jax_ok and _jax_available():
+        jitted = _make_jitted(expr, env)
+        ref_cols = [c for c in refs if c is not None]
+
+        def fn(cols: dict[str, np.ndarray], keys: np.ndarray) -> np.ndarray:
+            n = len(keys)
+            if n >= JIT_THRESHOLD and all(
+                cols[c].dtype != object for c in ref_cols
+            ):
+                out = jitted(cols, keys)
+                return np.asarray(out)
+            return np_fn(cols, keys)
+
+        return Compiled(fn, dtype)
+    return Compiled(np_fn, dtype)
+
+
+_jax_checked: list[bool] = []
+
+
+def _jax_available() -> bool:
+    if not _jax_checked:
+        try:
+            from ..utils import jaxcfg  # noqa: F401
+
+            _jax_checked.append(True)
+        except Exception:
+            _jax_checked.append(False)
+    return _jax_checked[0]
+
+
+def _make_jitted(expr: ColumnExpression, env: ColumnEnv):
+    import jax
+
+    def traced(cols, keys):
+        import jax.numpy as jnp
+
+        fn, _, _, _ = _build(expr, env, xp_name="jax")
+        return fn(cols, keys)
+
+    return jax.jit(traced)
+
+
+# ---------------------------------------------------------------------------
+# dtype rules
+# ---------------------------------------------------------------------------
+
+_CMP_OPS = {"==", "!=", "<", "<=", ">", ">="}
+_ARITH_OPS = {"+", "-", "*", "/", "//", "%", "**", "@"}
+_BITS_OPS = {"&", "|", "^"}
+
+
+def binop_dtype(op: str, l: dt.DType, r: dt.DType) -> dt.DType:
+    lu, ru = dt.unoptionalize(l), dt.unoptionalize(r)
+    opt = l.is_optional or r.is_optional
+
+    def w(t: dt.DType) -> dt.DType:
+        return dt.Optional(t) if opt else t
+
+    if op in _CMP_OPS:
+        return w(dt.BOOL)
+    if op in _BITS_OPS:
+        if lu == dt.BOOL and ru == dt.BOOL:
+            return w(dt.BOOL)
+        if lu == dt.INT and ru == dt.INT:
+            return w(dt.INT)
+        return w(dt.ANY)
+    if op in _ARITH_OPS:
+        # datetime algebra
+        if lu in (dt.DATE_TIME_NAIVE, dt.DATE_TIME_UTC):
+            if op == "-" and ru == lu:
+                return w(dt.DURATION)
+            if op in ("+", "-") and ru == dt.DURATION:
+                return w(lu)
+        if lu == dt.DURATION:
+            if op == "+" and ru in (dt.DATE_TIME_NAIVE, dt.DATE_TIME_UTC):
+                return w(ru)
+            if op in ("+", "-") and ru == dt.DURATION:
+                return w(dt.DURATION)
+            if op in ("*",) and ru == dt.INT:
+                return w(dt.DURATION)
+            if op == "/" and ru == dt.DURATION:
+                return w(dt.FLOAT)
+            if op == "//" and ru == dt.DURATION:
+                return w(dt.INT)
+            if op in ("/", "//") and ru == dt.INT:
+                return w(dt.DURATION)
+        if lu == dt.STR and ru == dt.STR and op == "+":
+            return w(dt.STR)
+        if (lu == dt.STR and ru == dt.INT or lu == dt.INT and ru == dt.STR) and op == "*":
+            return w(dt.STR)
+        if isinstance(lu, dt.Array) or isinstance(ru, dt.Array):
+            return w(lu if isinstance(lu, dt.Array) else ru)
+        if op == "/":
+            if lu in (dt.INT, dt.FLOAT, dt.BOOL) and ru in (dt.INT, dt.FLOAT, dt.BOOL):
+                return w(dt.FLOAT)
+        if lu == dt.FLOAT or ru == dt.FLOAT:
+            if lu in _NUMERIC and ru in _NUMERIC:
+                return w(dt.FLOAT)
+        if lu in (dt.INT, dt.BOOL) and ru in (dt.INT, dt.BOOL):
+            return w(dt.INT)
+        if lu == dt.ANY or ru == dt.ANY:
+            return w(dt.ANY)
+    return w(dt.ANY)
+
+
+# ---------------------------------------------------------------------------
+# build: returns (fn, dtype, jax_ok, referenced engine cols)
+# ---------------------------------------------------------------------------
+
+
+def _build(
+    expr: ColumnExpression, env: ColumnEnv, xp_name: str = "numpy"
+) -> tuple[Callable, dt.DType, bool, set]:
+    if xp_name == "jax":
+        import jax.numpy as xp
+    else:
+        xp = np
+
+    if isinstance(expr, expr_mod.SelfKeysExpression):
+        return (lambda cols, keys: keys), dt.POINTER, True, set()
+
+    if isinstance(expr, expr_mod.HiddenRef):
+        name = expr._engine_name
+        dtype = expr._dtype if expr._dtype is not None else dt.ANY
+        numericable = dt.unoptionalize(dtype) in _NUMERIC
+        return (lambda cols, keys: cols[name]), dtype, numericable, {name}
+
+    if isinstance(expr, IdReference):
+        engine_col, dtype = env.resolve(expr)
+        if engine_col is None:
+            return (lambda cols, keys: keys), dt.POINTER, True, {None}
+        return (lambda cols, keys: cols[engine_col]), dtype, True, {engine_col}
+
+    if isinstance(expr, ColumnReference):
+        engine_col, dtype = env.resolve(expr)
+        if engine_col is None:
+            return (lambda cols, keys: keys), dt.POINTER, True, {None}
+        numericable = dt.unoptionalize(dtype) in _NUMERIC or dtype == dt.POINTER
+        return (
+            (lambda cols, keys: cols[engine_col]),
+            dtype,
+            numericable,
+            {engine_col},
+        )
+
+    if isinstance(expr, ColumnConstExpression):
+        v = expr._value
+        dtype = dt.dtype_of_value(v)
+        numericable = dtype in _NUMERIC
+        return (lambda cols, keys: v), dtype, numericable, set()
+
+    if isinstance(expr, ColumnBinaryOpExpression):
+        lf, ldt, lok, lrefs = _build(expr._left, env, xp_name)
+        rf, rdt, rok, rrefs = _build(expr._right, env, xp_name)
+        op = expr._op
+        out_dt = binop_dtype(op, ldt, rdt)
+        fn = _binop_fn(op, lf, rf, ldt, rdt, xp)
+        jax_ok = (
+            lok
+            and rok
+            and dt.unoptionalize(out_dt) in _NUMERIC
+            and not ldt.is_optional
+            and not rdt.is_optional
+            and dt.unoptionalize(ldt) in _NUMERIC
+            and dt.unoptionalize(rdt) in _NUMERIC
+        )
+        return fn, out_dt, jax_ok, lrefs | rrefs
+
+    if isinstance(expr, ColumnUnaryOpExpression):
+        f, d, ok, refs = _build(expr._expr, env, xp_name)
+        op = expr._op
+        if op == "-":
+            return (lambda cols, keys: -f(cols, keys)), d, ok, refs
+        if op == "~":
+            out_dt = d
+            def notfn(cols, keys, f=f):
+                v = f(cols, keys)
+                if isinstance(v, np.ndarray) and v.dtype == object:
+                    return np.array([None if x is None else not x for x in v], dtype=object)
+                return xp.logical_not(v) if dt.unoptionalize(d) == dt.BOOL else ~v
+            return notfn, out_dt, ok and dt.unoptionalize(d) in _NUMERIC, refs
+        if op == "abs":
+            return (lambda cols, keys: xp.abs(f(cols, keys))), d, ok, refs
+        raise NotImplementedError(f"unary op {op}")
+
+    if isinstance(expr, IsNoneExpression):
+        f, d, ok, refs = _build(expr._expr, env, xp_name)
+        negate = isinstance(expr, IsNotNoneExpression)
+
+        def fn(cols, keys, f=f, negate=negate):
+            v = f(cols, keys)
+            if isinstance(v, np.ndarray) and v.dtype == object:
+                out = np.fromiter((x is None for x in v), dtype=bool, count=len(v))
+            elif isinstance(v, np.ndarray):
+                out = np.zeros(len(v), dtype=bool)
+            else:
+                out = np.zeros(len(keys), dtype=bool) if v is not None else np.ones(len(keys), dtype=bool)
+            return ~out if negate else out
+
+        return fn, dt.BOOL, False, refs
+
+    if isinstance(expr, IfElseExpression):
+        cf, cd, cok, crefs = _build(expr._if, env, xp_name)
+        tf, td, tok, trefs = _build(expr._then, env, xp_name)
+        ef, ed, eok, erefs = _build(expr._else, env, xp_name)
+        out_dt = dt.types_lca(td, ed)
+
+        def fn(cols, keys):
+            cond = cf(cols, keys)
+            tv, ev = tf(cols, keys), ef(cols, keys)
+            if isinstance(cond, np.ndarray) and cond.dtype == object:
+                cond = np.array([bool(x) for x in cond], dtype=bool)
+            out = xp.where(cond, tv, ev)
+            return out
+
+        jax_ok = cok and tok and eok and dt.unoptionalize(out_dt) in _NUMERIC
+        return fn, out_dt, jax_ok, crefs | trefs | erefs
+
+    if isinstance(expr, CoalesceExpression):
+        parts = [_build(a, env, xp_name) for a in expr._args]
+        out_dt = dt.types_lca_many([p[1] for p in parts])
+        non_none = [p[1] for p in parts if p[1] != dt.NONE]
+        if non_none and any(not p[1].is_optional and p[1] != dt.NONE for p in parts):
+            out_dt = dt.unoptionalize(out_dt)
+
+        def fn(cols, keys):
+            n = len(keys)
+            result = _materialize(parts[0][0](cols, keys), n)
+            for f, _, _, _ in parts[1:]:
+                mask = np.fromiter((x is None for x in result), dtype=bool, count=n)
+                if not mask.any():
+                    break
+                nxt = _materialize(f(cols, keys), n)
+                result = np.where(mask, nxt, result)
+            return _densify(result, out_dt)
+
+        refs = set().union(*[p[3] for p in parts])
+        return fn, out_dt, False, refs
+
+    if isinstance(expr, RequireExpression):
+        f, d, ok, refs = _build(expr._expr, env, xp_name)
+        conds = [_build(a, env, xp_name) for a in expr._args]
+
+        def fn(cols, keys):
+            n = len(keys)
+            result = _materialize(f(cols, keys), n)
+            mask = np.zeros(n, dtype=bool)
+            for cfn, _, _, _ in conds:
+                v = _materialize(cfn(cols, keys), n)
+                mask |= np.fromiter((x is None for x in v), dtype=bool, count=n)
+            if mask.any():
+                result = result.astype(object)
+                result[mask] = None
+            return result
+
+        all_refs = refs.union(*[c[3] for c in conds]) if conds else refs
+        return fn, dt.Optional(d), False, all_refs
+
+    if isinstance(expr, UnwrapExpression):
+        f, d, ok, refs = _build(expr._expr, env, xp_name)
+
+        def fn(cols, keys):
+            v = _materialize(f(cols, keys), len(keys))
+            if v.dtype == object:
+                for x in v:
+                    if x is None:
+                        raise ValueError("cannot unwrap, None found in column")
+                return _densify(v, dt.unoptionalize(d))
+            return v
+
+        return fn, dt.unoptionalize(d), False, refs
+
+    if isinstance(expr, FillErrorExpression):
+        f, d, ok, refs = _build(expr._expr, env, xp_name)
+        rf, rd, rok, rrefs = _build(expr._replacement, env, xp_name)
+
+        def fn(cols, keys):
+            try:
+                return f(cols, keys)
+            except Exception:
+                return _materialize(rf(cols, keys), len(keys))
+
+        return fn, dt.types_lca(d, rd), False, refs | rrefs
+
+    if isinstance(expr, (CastExpression, ConvertExpression)):
+        f, d, ok, refs = _build(expr._expr, env, xp_name)
+        target = expr._return_type
+        tu = dt.unoptionalize(target)
+        fn = _cast_fn(f, d, target, xp)
+        jax_ok = (
+            ok
+            and dt.unoptionalize(d) in _NUMERIC
+            and tu in _NUMERIC
+            and not d.is_optional
+        )
+        return fn, target, jax_ok, refs
+
+    if isinstance(expr, DeclareTypeExpression):
+        f, d, ok, refs = _build(expr._expr, env, xp_name)
+        target = expr._return_type
+        return f, target, ok and dt.unoptionalize(target) in _NUMERIC, refs
+
+    if isinstance(expr, PointerExpression):
+        parts = [_build(a, env, xp_name) for a in expr._args]
+        if expr._instance is not None:
+            parts.append(_build(expr._instance, env, xp_name))
+
+        def fn(cols, keys):
+            n = len(keys)
+            arrs = [_materialize(p[0](cols, keys), n) for p in parts]
+            return K.mix_columns(arrs, n)
+
+        refs = set().union(*[p[3] for p in parts]) if parts else set()
+        return fn, dt.POINTER, False, refs
+
+    if isinstance(expr, MakeTupleExpression):
+        parts = [_build(a, env, xp_name) for a in expr._args]
+
+        def fn(cols, keys):
+            n = len(keys)
+            arrs = [_materialize(p[0](cols, keys), n) for p in parts]
+            out = np.empty(n, dtype=object)
+            for i in range(n):
+                out[i] = tuple(_unnp(a[i]) for a in arrs)
+            return out
+
+        out_dt = dt.Tuple(*[p[1] for p in parts])
+        refs = set().union(*[p[3] for p in parts]) if parts else set()
+        return fn, out_dt, False, refs
+
+    if isinstance(expr, GetExpression):
+        of, odt, ook, orefs = _build(expr._obj, env, xp_name)
+        ixf, _, _, ixrefs = _build(expr._index, env, xp_name)
+        df, ddt, _, drefs = _build(expr._default, env, xp_name)
+        check = expr._check_if_exists
+
+        def fn(cols, keys):
+            n = len(keys)
+            objs = _materialize(of(cols, keys), n)
+            idxs = _materialize(ixf(cols, keys), n)
+            dfts = _materialize(df(cols, keys), n)
+            out = np.empty(n, dtype=object)
+            for i in range(n):
+                try:
+                    v = objs[i]
+                    if isinstance(v, dict):
+                        out[i] = v[idxs[i]] if check else v.get(idxs[i], dfts[i])
+                    else:
+                        out[i] = v[idxs[i]]
+                except (KeyError, IndexError, TypeError):
+                    if check:
+                        raise
+                    out[i] = dfts[i]
+            return out
+
+        out_dt = dt.ANY
+        if isinstance(dt.unoptionalize(odt), dt.List):
+            out_dt = dt.unoptionalize(odt).wrapped
+        elif isinstance(dt.unoptionalize(odt), dt.Tuple):
+            args = dt.unoptionalize(odt).args
+            if args:
+                out_dt = dt.types_lca_many(list(args))
+        elif dt.unoptionalize(odt) == dt.JSON:
+            out_dt = dt.JSON
+        if not check:
+            out_dt = dt.types_lca(out_dt, ddt)
+        return fn, out_dt, False, orefs | ixrefs | drefs
+
+    if isinstance(expr, (AsyncApplyExpression, ApplyExpression)):
+        parts = [_build(a, env, xp_name) for a in expr._args]
+        kparts = {k: _build(v, env, xp_name) for k, v in expr._kwargs.items()}
+        fn_user = expr._fn
+        prop_none = expr._propagate_none
+
+        import asyncio
+        import inspect
+
+        is_coro = inspect.iscoroutinefunction(fn_user)
+
+        def fn(cols, keys):
+            n = len(keys)
+            arrs = [_materialize(p[0](cols, keys), n) for p in parts]
+            karrs = {k: _materialize(p[0](cols, keys), n) for k, p in kparts.items()}
+            if is_coro:
+                async def gather():
+                    return await asyncio.gather(*[
+                        fn_user(
+                            *[_unnp(a[i]) for a in arrs],
+                            **{k: _unnp(v[i]) for k, v in karrs.items()},
+                        )
+                        for i in range(n)
+                    ])
+                results = _run_async(gather())
+                out = np.empty(n, dtype=object)
+                for i, r in enumerate(results):
+                    out[i] = r
+                return _densify(out, expr._return_type)
+            out = np.empty(n, dtype=object)
+            for i in range(n):
+                args_i = [_unnp(a[i]) for a in arrs]
+                if prop_none and any(a is None for a in args_i):
+                    out[i] = None
+                    continue
+                out[i] = fn_user(*args_i, **{k: _unnp(v[i]) for k, v in karrs.items()})
+            return _densify(out, expr._return_type)
+
+        refs = set().union(*[p[3] for p in parts], *[p[3] for p in kparts.values()]) if (parts or kparts) else set()
+        return fn, expr._return_type, False, refs
+
+    if isinstance(expr, MethodCallExpression):
+        from .expressions_namespaces import compile_method
+
+        return compile_method(expr, env, _build, xp_name)
+
+    if isinstance(expr, ReducerExpression):
+        raise TypeError(
+            f"reducer {expr._reducer!r} used outside of a reduce() context"
+        )
+
+    raise NotImplementedError(f"cannot compile {type(expr).__name__}")
+
+
+def _run_async(coro):
+    import asyncio
+
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        return asyncio.run(coro)
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(1) as pool:
+        return pool.submit(asyncio.run, coro).result()
+
+
+def _materialize(v: Any, n: int) -> np.ndarray:
+    if isinstance(v, np.ndarray) and v.ndim == 1 and len(v) == n:
+        return v
+    out = np.empty(n, dtype=object)
+    out[:] = [v] * n if not isinstance(v, np.ndarray) else list(v)
+    return out
+
+
+def _unnp(v: Any) -> Any:
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
+
+
+def _densify(arr: np.ndarray, dtype: dt.DType) -> np.ndarray:
+    """Try to store an object array densely according to its declared dtype."""
+    if arr.dtype != object:
+        return arr
+    target = dtype.numpy_dtype
+    if target == np.dtype(object) or dtype.is_optional:
+        return arr
+    try:
+        return arr.astype(target)
+    except (ValueError, TypeError):
+        return arr
+
+
+def _binop_fn(op, lf, rf, ldt, rdt, xp):
+    lu, ru = dt.unoptionalize(ldt), dt.unoptionalize(rdt)
+
+    if op == "/" and lu in _NUMERIC and ru in _NUMERIC:
+        def fn(cols, keys):
+            return xp.true_divide(lf(cols, keys), rf(cols, keys))
+        return _objsafe(fn, op, lf, rf) if _maybe_obj(ldt, rdt) else fn
+    if op == "//":
+        def fn(cols, keys):
+            return xp.floor_divide(lf(cols, keys), rf(cols, keys))
+        return _objsafe(fn, op, lf, rf) if _maybe_obj(ldt, rdt) else fn
+    if op == "%":
+        def fn(cols, keys):
+            return xp.mod(lf(cols, keys), rf(cols, keys))
+        return _objsafe(fn, op, lf, rf) if _maybe_obj(ldt, rdt) else fn
+    if op == "&" and lu == dt.BOOL and ru == dt.BOOL:
+        def fn(cols, keys):
+            return xp.logical_and(lf(cols, keys), rf(cols, keys))
+        return _objsafe(fn, op, lf, rf) if _maybe_obj(ldt, rdt) else fn
+    if op == "|" and lu == dt.BOOL and ru == dt.BOOL:
+        def fn(cols, keys):
+            return xp.logical_or(lf(cols, keys), rf(cols, keys))
+        return _objsafe(fn, op, lf, rf) if _maybe_obj(ldt, rdt) else fn
+
+    import operator as _op
+
+    py_ops = {
+        "+": _op.add, "-": _op.sub, "*": _op.mul, "/": _op.truediv,
+        "**": _op.pow, "==": _op.eq, "!=": _op.ne, "<": _op.lt,
+        "<=": _op.le, ">": _op.gt, ">=": _op.ge, "&": _op.and_,
+        "|": _op.or_, "^": _op.xor, "@": _op.matmul,
+    }
+    f = py_ops[op]
+
+    if op in _CMP_OPS and (lu == dt.POINTER or ru == dt.POINTER):
+        def fn(cols, keys):
+            return f(np.asarray(lf(cols, keys), dtype=np.uint64), np.asarray(rf(cols, keys), dtype=np.uint64))
+        return fn
+
+    def fn(cols, keys):
+        return f(lf(cols, keys), rf(cols, keys))
+
+    if op == "@":
+        def fn_mm(cols, keys):
+            l, r = lf(cols, keys), rf(cols, keys)
+            n = len(keys)
+            la, ra = _materialize(l, n), _materialize(r, n)
+            out = np.empty(n, dtype=object)
+            for i in range(n):
+                out[i] = la[i] @ ra[i]
+            return out
+        return fn_mm
+    return fn
+
+
+def _maybe_obj(ldt, rdt) -> bool:
+    return ldt.is_optional or rdt.is_optional or ldt == dt.ANY or rdt == dt.ANY
+
+
+def _objsafe(fast_fn, op, lf, rf):
+    import operator as _op
+
+    py_ops = {
+        "+": _op.add, "-": _op.sub, "*": _op.mul, "/": _op.truediv,
+        "//": _op.floordiv, "%": _op.mod, "**": _op.pow,
+        "==": _op.eq, "!=": _op.ne, "<": _op.lt, "<=": _op.le,
+        ">": _op.gt, ">=": _op.ge,
+        "&": lambda a, b: (a and b) if isinstance(a, (bool, np.bool_)) else a & b,
+        "|": lambda a, b: (a or b) if isinstance(a, (bool, np.bool_)) else a | b,
+        "^": _op.xor,
+    }
+    f = py_ops[op]
+
+    def fn(cols, keys):
+        l, r = lf(cols, keys), rf(cols, keys)
+        lo = isinstance(l, np.ndarray) and l.dtype == object
+        ro = isinstance(r, np.ndarray) and r.dtype == object
+        if not lo and not ro:
+            return fast_fn(cols, keys)
+        n = len(keys)
+        la, ra = _materialize(l, n), _materialize(r, n)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            a, b = _unnp(la[i]), _unnp(ra[i])
+            out[i] = None if a is None or b is None else f(a, b)
+        return out
+
+    return fn
+
+
+def _cast_fn(f, src: dt.DType, target: dt.DType, xp):
+    tu = dt.unoptionalize(target)
+    su = dt.unoptionalize(src)
+
+    def convert_scalar(v):
+        if v is None:
+            return None
+        if tu == dt.INT:
+            return int(v)
+        if tu == dt.FLOAT:
+            return float(v)
+        if tu == dt.BOOL:
+            return bool(v)
+        if tu == dt.STR:
+            return str(v)
+        return v
+
+    def fn(cols, keys):
+        v = f(cols, keys)
+        n = len(keys)
+        arr = _materialize(v, n) if not isinstance(v, np.ndarray) else v
+        if arr.dtype == object:
+            out = np.empty(n, dtype=object)
+            for i in range(n):
+                out[i] = convert_scalar(arr[i])
+            return _densify(out, target)
+        if tu == dt.INT:
+            return xp.asarray(arr).astype(xp.int64 if xp is np else "int64")
+        if tu == dt.FLOAT:
+            return xp.asarray(arr).astype(xp.float64 if xp is np else "float64")
+        if tu == dt.BOOL:
+            return xp.asarray(arr).astype(bool)
+        if tu == dt.STR:
+            out = np.empty(n, dtype=object)
+            av = np.asarray(arr)
+            for i in range(n):
+                out[i] = str(_unnp(av[i]))
+            return out
+        return arr
+
+    return fn

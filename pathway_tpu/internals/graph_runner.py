@@ -1,0 +1,414 @@
+"""GraphRunner — lowers the declarative parse graph to engine operators.
+
+Re-design of ``python/pathway/internals/graph_runner/`` (GraphRunner
+``__init__.py:36``, storage_graph, expression_evaluator — ~30 evaluators).
+Here every Table kind lowers to a small engine-operator subgraph; columnar
+layouts are simply the tables' column dicts (the reference's tuple-layout
+planner ``path_evaluator.py`` is unnecessary with struct-of-arrays batches).
+Tree-shaking (reference ``__init__.py:93,101``) falls out of memoized
+recursion from the requested outputs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ..engine import keys as K
+from ..engine import operators as ops
+from ..engine.executor import Executor, Node
+from ..engine.reducers import make_reducer
+from . import dtype as dt
+from .expression import ColumnExpression, ColumnReference, HiddenRef, IdReference
+from .expression_compiler import ColumnEnv, compile_expr
+from .parse_graph import G
+from .table import Table
+from .thisclass import ThisPlaceholder
+
+
+class GraphRunner:
+    def __init__(self) -> None:
+        self._cache: dict[int, Node] = {}
+        self._nodes: list[Node] = []
+
+    # ------------------------------------------------------------------
+
+    def run_tables(self, *tables: Table, include_sinks: bool = False):
+        """Build + execute; return one Capture per requested table."""
+        captures = [self.capture(t) for t in tables]
+        if include_sinks:
+            for sink in G.sinks:
+                self.lower_sink(sink)
+        Executor(self._nodes).run()
+        return captures
+
+    def run(self) -> None:
+        for sink in G.sinks:
+            self.lower_sink(sink)
+        Executor(self._nodes).run()
+
+    def capture(self, table: Table) -> ops.Capture:
+        node = self.lower(table)
+        cap = ops.Capture(node)
+        self._nodes.append(cap)
+        return cap
+
+    def lower_sink(self, sink: Any) -> None:
+        kind = sink["kind"]
+        if kind == "subscribe":
+            node = self.lower(sink["table"])
+            sub = ops.Subscribe(
+                node,
+                on_change=sink.get("on_change"),
+                on_time_end=sink.get("on_time_end"),
+                on_end=sink.get("on_end"),
+            )
+            self._nodes.append(sub)
+        elif kind == "callable":
+            sink["build"](self)
+        else:
+            raise NotImplementedError(f"sink kind {kind}")
+
+    # ------------------------------------------------------------------
+
+    def _add(self, node: Node) -> Node:
+        self._nodes.append(node)
+        return node
+
+    def lower(self, table: Table) -> Node:
+        key = id(table)
+        if key in self._cache:
+            return self._cache[key]
+        node = self._lower(table)
+        self._cache[key] = node
+        return node
+
+    def _lower(self, table: Table) -> Node:
+        kind = table._kind
+        p = table._params
+        if kind == "static":
+            return self._add(ops.StaticSource(p["keys"], p["data"]))
+        if kind == "scheduled":
+            from ..engine.delta import Delta
+
+            batches = [
+                (t, Delta(keys=k, data=data, diffs=diffs))
+                for (t, k, data, diffs) in p["batches"]
+            ]
+            return self._add(ops.ScheduledSource(p["columns"], batches))
+        if kind == "source":
+            return self._add(p["build"]())
+        if kind == "rowwise":
+            return self._lower_rowwise(table)
+        if kind == "filter":
+            return self._lower_filter(table)
+        if kind == "reindex":
+            return self._lower_reindex(table)
+        if kind == "groupby_reduce":
+            return self._lower_groupby(table)
+        if kind == "join_select":
+            return self._lower_join(table)
+        if kind == "concat":
+            inputs = [self.lower(t) for t in table._inputs]
+            aligned = [
+                self._project(n, t, table.column_names())
+                for n, t in zip(inputs, table._inputs)
+            ]
+            return self._add(ops.Concat(aligned))
+        if kind == "concat_reindex":
+            parts = []
+            for i, t in enumerate(table._inputs):
+                n = self.lower(t)
+                salt = 0xC0 + i
+                rw = self._add(ops.Rowwise(n, {
+                    **{c: _colref(c) for c in t.column_names()},
+                    "__newkey__": (lambda cols, keys, s=salt: K.derive(keys, s)),
+                }))
+                parts.append(self._add(ops.Reindex(rw, "__newkey__",
+                                                   keep=table.column_names())))
+            return self._add(ops.Concat(parts))
+        if kind == "update_rows":
+            l = self._project(self.lower(table._inputs[0]), table._inputs[0], table.column_names())
+            r = self._project(self.lower(table._inputs[1]), table._inputs[1], table.column_names())
+            return self._add(ops.UpdateRows(l, r))
+        if kind == "update_cells":
+            l = self.lower(table._inputs[0])
+            r = self.lower(table._inputs[1])
+            return self._add(ops.UpdateCells(l, r, p["override"]))
+        if kind in ("restrict", "intersect", "with_universe_of"):
+            if kind == "with_universe_of":
+                return self.lower(table._inputs[0])
+            self_node = self.lower(table._inputs[0])
+            other_node = self.lower(table._inputs[1])
+            cols = table.column_names()
+            return self._add(ops.Join(
+                self_node, other_node, None, None,
+                left_cols=cols, right_cols=[], out_names=cols,
+                mode="inner", key_mode="left",
+            ))
+        if kind == "difference":
+            self_node = self.lower(table._inputs[0])
+            other_node = self.lower(table._inputs[1])
+            cols = table.column_names()
+            return self._add(ops.Join(
+                self_node, other_node, None, None,
+                left_cols=cols, right_cols=[], out_names=cols,
+                mode="left", key_mode="left", emit_matched=False,
+            ))
+        if kind == "having":
+            base_t, other_t = table._inputs
+            node, env = self._zip_env(base_t, {"__k": p["key_expr"]})
+            kc = compile_expr(p["key_expr"], env)
+            rw = self._add(ops.Rowwise(node, {
+                **{c: _colref(c) for c in base_t.column_names()},
+                "__ptr__": kc.fn,
+            }))
+            other_node = self.lower(other_t)
+            cols = table.column_names()
+            return self._add(ops.Join(
+                rw, other_node, "__ptr__", None,
+                left_cols=cols, right_cols=[], out_names=cols,
+                mode="inner", key_mode="left",
+            ))
+        if kind == "ix":
+            return self._lower_ix(table)
+        if kind == "flatten":
+            inp = self.lower(table._inputs[0])
+            node = ops.Flatten(inp, p["column"])
+            if "origin_id" in p:
+                src = self._add(ops.Rowwise(inp, {
+                    **{c: _colref(c) for c in table._inputs[0].column_names()},
+                    p["origin_id"]: (lambda cols, keys: keys),
+                }))
+                node = ops.Flatten(src, p["column"])
+            return self._add(node)
+        if kind == "deduplicate":
+            base_t = table._inputs[0]
+            exprs: dict[str, ColumnExpression] = {"__val__": p["value"]}
+            if p["instance"] is not None:
+                exprs["__inst__"] = p["instance"]
+            node, env = self._zip_env(base_t, exprs)
+            rw_cols = {c: _colref(c) for c in base_t.column_names()}
+            rw_cols["__val__"] = compile_expr(p["value"], env).fn
+            if p["instance"] is not None:
+                rw_cols["__inst__"] = compile_expr(p["instance"], env).fn
+            rw = self._add(ops.Rowwise(node, rw_cols))
+            dd = self._add(ops.Deduplicate(
+                rw, "__val__",
+                "__inst__" if p["instance"] is not None else None,
+                p["acceptor"],
+            ))
+            return self._add(ops.Rowwise(dd, {
+                c: _colref(c) for c in table.column_names()
+            }))
+        if kind == "iterate":
+            raise NotImplementedError("pw.iterate lowering not implemented yet")
+        raise NotImplementedError(f"lowering for kind {kind!r}")
+
+    # ------------------------------------------------------------------
+
+    def _project(self, node: Node, t: Table, names: list[str]) -> Node:
+        if node.column_names == names:
+            return node
+        return self._add(ops.Rowwise(node, {c: _colref(c) for c in names}))
+
+    def _zip_env(
+        self, primary: Table, exprs: dict[str, ColumnExpression]
+    ) -> tuple[Node, ColumnEnv]:
+        """Engine node + env for expressions over `primary` that may also
+        reference other (same/super-universe) tables — foreign columns are
+        zipped in by key (engine Join on row keys, key_mode='left')."""
+        foreign: list[Table] = []
+        need_foreign_id: set[int] = set()
+        seen = {id(primary)}
+
+        def walk(e: ColumnExpression) -> None:
+            if isinstance(e, ColumnReference) and not isinstance(e.table, ThisPlaceholder):
+                t = e.table
+                if isinstance(t, Table) and id(t) not in seen:
+                    seen.add(id(t))
+                    foreign.append(t)
+                if isinstance(e, IdReference) and t is not primary and isinstance(t, Table):
+                    need_foreign_id.add(id(t))
+            for d in getattr(e, "_deps", ()):
+                walk(d)
+
+        for e in exprs.values():
+            walk(e)
+
+        env = ColumnEnv()
+        env.add_table(primary)
+        node = self.lower(primary)
+        cur_cols = list(node.column_names)
+        for i, ft in enumerate(foreign):
+            # foreign table must cover every primary row: primary ⊆ foreign
+            if not (
+                primary._universe.is_subset_of(ft._universe)
+                or primary._universe.is_equal(ft._universe)
+            ):
+                raise ValueError(
+                    f"column of table {ft!r} used in a context with a different "
+                    "universe; consider promise_universes_are_equal"
+                )
+            fnode = self.lower(ft)
+            prefix = f"__f{i}."
+            fexprs = {prefix + c: _colref(c) for c in ft.column_names()}
+            fexprs[prefix + "id"] = lambda cols, keys: keys
+            frw = self._add(ops.Rowwise(fnode, fexprs))
+            out_names = cur_cols + list(fexprs.keys())
+            node = self._add(ops.Join(
+                node, frw, None, None,
+                left_cols=cur_cols, right_cols=list(fexprs.keys()),
+                out_names=out_names, mode="inner", key_mode="left",
+            ))
+            cur_cols = out_names
+            for c, cs in ft.schema.columns().items():
+                env.add(ft, c, prefix + c, cs.dtype)
+            env.add(ft, "id", prefix + "id", dt.POINTER)
+        return node, env
+
+    def _lower_rowwise(self, table: Table) -> Node:
+        primary = table._inputs[0]
+        node, env = self._zip_env(primary, table._params["exprs"])
+        compiled = {
+            name: compile_expr(e, env).fn
+            for name, e in table._params["exprs"].items()
+        }
+        return self._add(ops.Rowwise(node, compiled))
+
+    def _lower_filter(self, table: Table) -> Node:
+        primary = table._inputs[0]
+        pred = table._params["predicate"]
+        node, env = self._zip_env(primary, {"__pred__": pred})
+        pc = compile_expr(pred, env)
+        filtered = self._add(ops.Filter(node, pc.fn))
+        return self._project(filtered, primary, table.column_names())
+
+    def _lower_reindex(self, table: Table) -> Node:
+        primary = table._inputs[0]
+        key_expr = table._params["key_expr"]
+        node, env = self._zip_env(primary, {"__k": key_expr})
+        kc = compile_expr(key_expr, env)
+        rw = self._add(ops.Rowwise(node, {
+            **{c: _colref(c) for c in table.column_names()},
+            "__newkey__": kc.fn,
+        }))
+        return self._add(ops.Reindex(rw, "__newkey__", keep=table.column_names()))
+
+    def _lower_groupby(self, table: Table) -> Node:
+        primary = table._inputs[0]
+        p = table._params
+        grouping: list[ColumnExpression] = p["grouping"]
+        reducers = p["reducers"]
+        all_exprs: dict[str, ColumnExpression] = {}
+        for i, g in enumerate(grouping):
+            all_exprs[f"gk{i}"] = g
+        for out_name, rname, rargs, rkw in reducers:
+            for j, a in enumerate(rargs):
+                all_exprs[f"__a_{out_name}_{j}"] = a
+        node, env = self._zip_env(primary, all_exprs)
+        pre = {name: compile_expr(e, env).fn for name, e in all_exprs.items()}
+        pre_node = self._add(ops.Rowwise(node, pre))
+
+        engine_reducers = []
+        for out_name, rname, rargs, rkw in reducers:
+            if rname in ("sorted_tuple", "tuple", "ndarray"):
+                impl = make_reducer(rname, skip_nones=rkw.get("skip_nones", False))
+            elif rname == "stateful":
+                from ..engine.reducers import StatefulReducer
+
+                impl = StatefulReducer(rkw["combine_fn"])
+            elif rname == "custom_accumulator":
+                from ..engine.reducers import CustomAccumulatorReducer
+
+                impl = CustomAccumulatorReducer(rkw["accumulator"])
+            else:
+                impl = make_reducer(rname)
+            engine_reducers.append(
+                (out_name, impl, [f"__a_{out_name}_{j}" for j in range(len(rargs))])
+            )
+        group_cols = [f"gk{i}" for i in range(len(grouping))]
+        by_id = p["by_id"] and len(grouping) == 1
+        gb = self._add(ops.GroupByReduce(
+            pre_node, group_cols, engine_reducers,
+            key_from_column="gk0" if by_id else None,
+        ))
+        # post projection: grouping refs -> gk{i}, hidden refs resolve directly
+        post_env = ColumnEnv()
+        for name, i in p["group_names"].items():
+            post_env.add(primary, name, f"gk{i}", primary.schema.columns()[name].dtype)
+        post = {}
+        for name, e in p["outputs"].items():
+            post[name] = compile_expr(e, post_env).fn
+        return self._add(ops.Rowwise(gb, post))
+
+    def _lower_join(self, table: Table) -> Node:
+        lt, rt = table._inputs
+        p = table._params
+        lnode, lenv = self._zip_env(lt, {f"__c{i}": e for i, e in enumerate(p["left_on"])})
+        rnode, renv = self._zip_env(rt, {f"__c{i}": e for i, e in enumerate(p["right_on"])})
+        l_on = [compile_expr(e, lenv).fn for e in p["left_on"]]
+        r_on = [compile_expr(e, renv).fn for e in p["right_on"]]
+
+        def jk_fn(fns):
+            def fn(cols, keys):
+                vals = [np.asarray(_mat(f(cols, keys), len(keys))) for f in fns]
+                return K.mix_columns(vals, len(keys))
+            return fn
+
+        lrw = self._add(ops.Rowwise(lnode, {
+            **{f"l.{c}": _colref(c) for c in lt.column_names()},
+            "l.__id__": lambda cols, keys: keys,
+            "__jk__": jk_fn(l_on),
+        }))
+        rrw = self._add(ops.Rowwise(rnode, {
+            **{f"r.{c}": _colref(c) for c in rt.column_names()},
+            "r.__id__": lambda cols, keys: keys,
+            "__jk__": jk_fn(r_on),
+        }))
+        lcols = [f"l.{c}" for c in lt.column_names()] + ["l.__id__"]
+        rcols = [f"r.{c}" for c in rt.column_names()] + ["r.__id__"]
+        key_mode = {"left": "left", "right": "right", None: "pair"}[p["id_side"]]
+        join_node = self._add(ops.Join(
+            lrw, rrw, "__jk__", "__jk__",
+            left_cols=lcols, right_cols=rcols, out_names=lcols + rcols,
+            mode=p["mode"], key_mode=key_mode,
+        ))
+        env = ColumnEnv()
+        l_opt = p["mode"] in ("right", "outer")
+        r_opt = p["mode"] in ("left", "outer")
+        for c, cs in lt.schema.columns().items():
+            env.add(lt, c, f"l.{c}", dt.Optional(cs.dtype) if l_opt else cs.dtype)
+        env.add(lt, "id", "l.__id__", dt.Optional(dt.POINTER) if l_opt else dt.POINTER)
+        for c, cs in rt.schema.columns().items():
+            env.add(rt, c, f"r.{c}", dt.Optional(cs.dtype) if r_opt else cs.dtype)
+        env.add(rt, "id", "r.__id__", dt.Optional(dt.POINTER) if r_opt else dt.POINTER)
+        post = {name: compile_expr(e, env).fn for name, e in p["exprs"].items()}
+        return self._add(ops.Rowwise(join_node, post))
+
+    def _lower_ix(self, table: Table) -> Node:
+        context_t, src_t = table._inputs
+        p = table._params
+        node, env = self._zip_env(context_t, {"__k": p["key_expr"]})
+        kc = compile_expr(p["key_expr"], env)
+        rw = self._add(ops.Rowwise(node, {"__ptr__": kc.fn}))
+        src_node = self.lower(src_t)
+        cols = table.column_names()
+        src_proj = self._project(src_node, src_t, src_t.column_names())
+        return self._add(ops.Join(
+            rw, src_proj, "__ptr__", None,
+            left_cols=[], right_cols=src_t.column_names(), out_names=cols,
+            mode="left" if p["optional"] else "inner",
+            key_mode="left",
+        ))
+
+
+def _colref(name: str):
+    return lambda cols, keys, n=name: cols[n]
+
+
+def _mat(v, n):
+    from .expression_compiler import _materialize
+
+    return _materialize(v, n)

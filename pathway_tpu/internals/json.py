@@ -1,0 +1,83 @@
+"""``pw.Json`` — JSON value wrapper (reference ``internals/json.py``)."""
+
+from __future__ import annotations
+
+import json as _json
+from typing import Any
+
+
+class Json:
+    """Immutable wrapper for a JSON value held in a column."""
+
+    NULL: "Json"
+
+    def __init__(self, value: Any = None):
+        if isinstance(value, Json):
+            value = value.value
+        self._value = value
+
+    @property
+    def value(self) -> Any:
+        return self._value
+
+    @staticmethod
+    def parse(s: str | bytes) -> "Json":
+        return Json(_json.loads(s))
+
+    @staticmethod
+    def dumps(obj: Any) -> str:
+        if isinstance(obj, Json):
+            obj = obj.value
+        return _json.dumps(obj)
+
+    def __getitem__(self, key: Any) -> "Json":
+        return Json(self._value[key])
+
+    def get(self, key: Any, default: Any = None) -> Any:
+        if isinstance(self._value, dict):
+            v = self._value.get(key, default)
+            return Json(v) if not isinstance(v, Json) else v
+        return default
+
+    def as_int(self) -> int:
+        return int(self._value)
+
+    def as_float(self) -> float:
+        return float(self._value)
+
+    def as_str(self) -> str:
+        return str(self._value)
+
+    def as_bool(self) -> bool:
+        if not isinstance(self._value, bool):
+            raise ValueError(f"not a bool: {self._value!r}")
+        return self._value
+
+    def as_list(self) -> list:
+        return list(self._value)
+
+    def as_dict(self) -> dict:
+        return dict(self._value)
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, Json):
+            return self._value == other._value
+        return self._value == other
+
+    def __hash__(self) -> int:
+        return hash(_json.dumps(self._value, sort_keys=True, default=str))
+
+    def __repr__(self) -> str:
+        return f"pw.Json({self._value!r})"
+
+    def __str__(self) -> str:
+        return _json.dumps(self._value)
+
+    def __len__(self) -> int:
+        return len(self._value)
+
+    def __iter__(self):
+        return iter(self._value)
+
+
+Json.NULL = Json(None)

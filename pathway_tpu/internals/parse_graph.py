@@ -1,0 +1,83 @@
+"""Global parse graph: every Table operation appends a node.
+
+Re-design of ``python/pathway/internals/parse_graph.py:104-247`` +
+``operator.py:84-444``. Here the graph is held directly by ``Table`` objects
+(kind + inputs + params); the global ``G`` tracks sinks, static-table
+content cache (shared universes for identical definitions — what makes
+id-sensitive table equality asserts work, cf. reference
+``debug/__init__.py:396-403``) and universe equivalences.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["G", "ParseGraph", "Universe"]
+
+
+class Universe:
+    """A key-set identity (reference ``internals/universe.py``). Subset links
+    + promised equivalences form the solver (a light union-find version of
+    the reference's SAT-based ``universe_solver.py``)."""
+
+    _ids = 0
+
+    def __init__(self, parent: "Universe | None" = None):
+        Universe._ids += 1
+        self.uid = Universe._ids
+        self.parent = parent  # self ⊆ parent
+
+    def find(self) -> "Universe":
+        root = G.equiv.get(self, self)
+        if root is self:
+            return self
+        top = root.find()
+        G.equiv[self] = top
+        return top
+
+    def is_equal(self, other: "Universe") -> bool:
+        return self.find() is other.find()
+
+    def is_subset_of(self, other: "Universe") -> bool:
+        seen = set()
+        u: Universe | None = self
+        while u is not None and u not in seen:
+            seen.add(u)
+            if u.is_equal(other):
+                return True
+            nxt = u.find()
+            if nxt is not u and nxt not in seen:
+                u = nxt
+                continue
+            u = u.parent
+        # subset promises
+        for sub, sup in G.subset_promises:
+            if self.is_equal(sub) and sup.is_equal(other):
+                return True
+        return False
+
+
+class ParseGraph:
+    def __init__(self) -> None:
+        self.sinks: list[Any] = []  # sink Tables / subscribe nodes
+        self.static_tables_cache: dict[Any, Any] = {}
+        self.equiv: dict[Universe, Universe] = {}
+        self.subset_promises: list[tuple[Universe, Universe]] = []
+        self.error_log: list[Any] = []
+
+    def clear(self) -> None:
+        self.__init__()
+
+    def promise_equal(self, a: Universe, b: Universe) -> None:
+        ra, rb = a.find(), b.find()
+        if ra is not rb:
+            self.equiv[ra] = rb
+
+    def promise_subset(self, sub: Universe, sup: Universe) -> None:
+        self.subset_promises.append((sub, sup))
+
+    def add_sink(self, sink: Any) -> None:
+        self.sinks.append(sink)
+
+
+G = ParseGraph()

@@ -1,0 +1,33 @@
+"""``pw.run`` — execute all registered outputs (reference internals/run.py:42)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .graph_runner import GraphRunner
+
+
+class MonitoringLevel:
+    NONE = 0
+    IN_OUT = 1
+    ALL = 2
+    AUTO = 3
+    AUTO_ALL = 4
+
+
+def run(
+    *,
+    debug: bool = False,
+    monitoring_level: int = MonitoringLevel.NONE,
+    with_http_server: bool = False,
+    default_logging: bool = True,
+    persistence_config: Any = None,
+    runtime_typechecking: bool | None = None,
+    **kwargs: Any,
+) -> None:
+    """Build and run the whole dataflow (all sinks registered so far)."""
+    GraphRunner().run()
+
+
+def run_all(**kwargs: Any) -> None:
+    run(**kwargs)

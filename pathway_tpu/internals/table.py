@@ -1,0 +1,563 @@
+"""``pw.Table`` — the declarative, incrementally-maintained table.
+
+Re-design of ``python/pathway/internals/table.py`` (2,675 LoC; method parity
+cites below). Every method appends a node to the parse graph; nothing
+executes until ``pw.run``/debug computes outputs. Each node kind maps to one
+engine operator family (see ``internals/graph_runner.py``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Iterable
+
+from . import dtype as dt
+from .expression import (
+    ColumnExpression,
+    ColumnReference,
+    IdReference,
+    PointerExpression,
+    ReducerExpression,
+    smart_coerce,
+)
+from .parse_graph import G, Universe
+from .schema import Schema, SchemaMetaclass, schema_from_columns, schema_from_types
+from .schema import ColumnSchema
+from .thisclass import ThisPlaceholder, substitute, this
+
+
+class TableLike:
+    _universe: Universe
+
+
+class Table(TableLike):
+    _kind: str
+    _inputs: list["Table"]
+    _params: dict[str, Any]
+    _schema: SchemaMetaclass
+
+    _id_seq = itertools.count(1)
+
+    def __init__(self, kind: str, inputs: list["Table"], params: dict[str, Any],
+                 schema: SchemaMetaclass, universe: Universe):
+        self._kind = kind
+        self._inputs = inputs
+        self._params = params
+        self._schema = schema
+        self._universe = universe
+        self._table_seq = next(Table._id_seq)
+
+    # -- schema surface -----------------------------------------------------
+
+    @property
+    def schema(self) -> SchemaMetaclass:
+        return self._schema
+
+    def column_names(self) -> list[str]:
+        return self._schema.column_names()
+
+    def typehints(self) -> dict[str, Any]:
+        return self._schema.typehints()
+
+    @property
+    def id(self) -> IdReference:
+        return IdReference(self)
+
+    def keys(self):
+        return self._schema.columns()
+
+    def __getattr__(self, name: str) -> ColumnReference:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in self._schema.__columns__:
+            return ColumnReference(self, name)
+        raise AttributeError(
+            f"Table has no column {name!r}; columns: {self.column_names()}"
+        )
+
+    def __getitem__(self, arg):
+        if isinstance(arg, str):
+            if arg == "id":
+                return IdReference(self)
+            return getattr(self, arg)
+        if isinstance(arg, ColumnReference):
+            return getattr(self, arg.name)
+        if isinstance(arg, (list, tuple)):
+            return self.select(*[self[a] for a in arg])
+        raise TypeError(f"cannot index Table with {arg!r}")
+
+    def __iter__(self):
+        raise TypeError("Table is not iterable; use pw.debug.compute_and_print")
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{n}: {c.dtype!r}" for n, c in self._schema.columns().items())
+        return f"<pw.Table ({inner})>"
+
+    # -- desugaring helpers -------------------------------------------------
+
+    def _sub(self, expr: Any) -> ColumnExpression:
+        return substitute(smart_coerce(expr), {this: self})
+
+    def _named_exprs(self, args: tuple, kwargs: dict[str, Any]) -> dict[str, ColumnExpression]:
+        out: dict[str, ColumnExpression] = {}
+        for arg in args:
+            arg = self._sub(arg)
+            if not isinstance(arg, ColumnReference):
+                raise ValueError(
+                    "positional select arguments must be column references; "
+                    "use keyword arguments for expressions"
+                )
+            out[arg.name] = arg
+        for name, e in kwargs.items():
+            out[name] = self._sub(e)
+        return out
+
+    def pointer_from(self, *args: Any, instance: Any = None, optional: bool = False) -> PointerExpression:
+        return PointerExpression(self, *[self._sub(a) for a in args], instance=instance, optional=optional)
+
+    # -- rowwise ops (table.py:382 select, :490 filter, :1613 with_columns) --
+
+    def select(self, *args: Any, **kwargs: Any) -> "Table":
+        exprs = self._named_exprs(args, kwargs)
+        return self._rowwise(exprs)
+
+    def _rowwise(self, exprs: dict[str, ColumnExpression], universe: Universe | None = None) -> "Table":
+        from .expression_compiler import ColumnEnv
+
+        schema = _infer_schema(exprs, self)
+        return Table(
+            "rowwise",
+            [self],
+            {"exprs": exprs},
+            schema,
+            universe if universe is not None else self._universe,
+        )
+
+    def filter(self, filter_expression: Any) -> "Table":
+        expr = self._sub(filter_expression)
+        return Table(
+            "filter",
+            [self],
+            {"predicate": expr},
+            self._schema,
+            Universe(parent=self._universe),
+        )
+
+    def with_columns(self, *args: Any, **kwargs: Any) -> "Table":
+        new = self._named_exprs(args, kwargs)
+        exprs: dict[str, ColumnExpression] = {
+            name: ColumnReference(self, name) for name in self.column_names()
+        }
+        exprs.update(new)
+        return self._rowwise(exprs)
+
+    def without(self, *columns: Any) -> "Table":
+        names = {c.name if isinstance(c, ColumnReference) else c for c in columns}
+        exprs = {
+            n: ColumnReference(self, n) for n in self.column_names() if n not in names
+        }
+        return self._rowwise(exprs)
+
+    def rename_columns(self, **kwargs: Any) -> "Table":
+        mapping = {}
+        for new_name, old in kwargs.items():
+            mapping[old.name if isinstance(old, ColumnReference) else old] = new_name
+        return self._rename(mapping)
+
+    def rename_by_dict(self, names_mapping: dict) -> "Table":
+        mapping = {
+            (old.name if isinstance(old, ColumnReference) else old): new
+            for old, new in names_mapping.items()
+        }
+        return self._rename(mapping)
+
+    def rename(self, names_mapping: dict | None = None, **kwargs: Any) -> "Table":
+        if names_mapping is not None:
+            return self.rename_by_dict(names_mapping)
+        return self.rename_columns(**kwargs)
+
+    def _rename(self, mapping: dict[str, str]) -> "Table":
+        exprs = {
+            mapping.get(n, n): ColumnReference(self, n) for n in self.column_names()
+        }
+        return self._rowwise(exprs)
+
+    def copy(self) -> "Table":
+        return self._rowwise(
+            {n: ColumnReference(self, n) for n in self.column_names()}
+        )
+
+    def cast_to_types(self, **kwargs: Any) -> "Table":
+        from .expression import CastExpression
+
+        exprs: dict[str, ColumnExpression] = {}
+        for n in self.column_names():
+            if n in kwargs:
+                exprs[n] = CastExpression(kwargs[n], ColumnReference(self, n))
+            else:
+                exprs[n] = ColumnReference(self, n)
+        return self._rowwise(exprs)
+
+    # -- groupby / reduce (table.py:942, :1025) -----------------------------
+
+    def groupby(self, *args: Any, id: Any = None, instance: Any = None, **kwargs: Any):
+        from .groupbys import GroupedTable
+
+        grouping = [self._sub(a) for a in args]
+        by_id = False
+        if id is not None:
+            grouping = [self._sub(id)]
+            by_id = True
+        return GroupedTable(
+            self,
+            grouping,
+            instance=self._sub(instance) if instance is not None else None,
+            by_id=by_id,
+        )
+
+    def reduce(self, *args: Any, **kwargs: Any) -> "Table":
+        return self.groupby().reduce(*args, **kwargs)
+
+    def deduplicate(
+        self,
+        value: Any = None,
+        instance: Any = None,
+        acceptor: Any = None,
+        persistent_id: str | None = None,
+    ) -> "Table":
+        value = self._sub(value) if value is not None else IdReference(self)
+        instance = self._sub(instance) if instance is not None else None
+        return Table(
+            "deduplicate",
+            [self],
+            {"value": value, "instance": instance, "acceptor": acceptor},
+            self._schema,
+            Universe(),
+        )
+
+    # -- joins (table.py / joins.py) ----------------------------------------
+
+    def join(self, other: "Table", *on: Any, id: Any = None, how: Any = None, **kwargs):
+        from .joins import JoinMode, JoinResult
+
+        mode = how if how is not None else JoinMode.INNER
+        return JoinResult(self, other, on, mode=mode, id=id)
+
+    def join_inner(self, other: "Table", *on: Any, id: Any = None, **kwargs):
+        from .joins import JoinMode, JoinResult
+
+        return JoinResult(self, other, on, mode=JoinMode.INNER, id=id)
+
+    def join_left(self, other: "Table", *on: Any, id: Any = None, **kwargs):
+        from .joins import JoinMode, JoinResult
+
+        return JoinResult(self, other, on, mode=JoinMode.LEFT, id=id)
+
+    def join_right(self, other: "Table", *on: Any, id: Any = None, **kwargs):
+        from .joins import JoinMode, JoinResult
+
+        return JoinResult(self, other, on, mode=JoinMode.RIGHT, id=id)
+
+    def join_outer(self, other: "Table", *on: Any, id: Any = None, **kwargs):
+        from .joins import JoinMode, JoinResult
+
+        return JoinResult(self, other, on, mode=JoinMode.OUTER, id=id)
+
+    # -- set ops ------------------------------------------------------------
+
+    def concat(self, *others: "Table") -> "Table":
+        tables = [self, *others]
+        schema = _common_schema(tables)
+        return Table("concat", tables, {}, schema, Universe())
+
+    def concat_reindex(self, *others: "Table") -> "Table":
+        tables = [self, *others]
+        schema = _common_schema(tables)
+        return Table("concat_reindex", tables, {}, schema, Universe())
+
+    def update_rows(self, other: "Table") -> "Table":
+        schema = _common_schema([self, other])
+        return Table("update_rows", [self, other], {}, schema, Universe())
+
+    def update_cells(self, other: "Table") -> "Table":
+        if not other._universe.is_subset_of(self._universe):
+            raise ValueError(
+                "update_cells requires other's universe to be a subset of self's; "
+                "use promise_universe_is_subset_of if you know it holds"
+            )
+        extra = set(other.column_names()) - set(self.column_names())
+        if extra:
+            raise ValueError(f"update_cells: unknown columns {sorted(extra)}")
+        return Table(
+            "update_cells",
+            [self, other],
+            {"override": other.column_names()},
+            self._schema,
+            self._universe,
+        )
+
+    def __add__(self, other: "Table") -> "Table":
+        """Column-wise sum of two same-universe tables (zip columns)."""
+        if not isinstance(other, Table):
+            return NotImplemented
+        exprs: dict[str, ColumnExpression] = {
+            n: ColumnReference(self, n) for n in self.column_names()
+        }
+        for n in other.column_names():
+            if n in exprs:
+                raise ValueError(f"duplicate column {n!r} in Table + Table")
+            exprs[n] = ColumnReference(other, n)
+        return self._rowwise(exprs)
+
+    def restrict(self, other: TableLike) -> "Table":
+        return Table(
+            "restrict",
+            [self, other],  # type: ignore[list-item]
+            {},
+            self._schema,
+            other._universe,
+        )
+
+    def intersect(self, *tables: "Table") -> "Table":
+        out = self
+        for t in tables:
+            out = Table(
+                "intersect",
+                [out, t],
+                {},
+                self._schema,
+                Universe(parent=self._universe),
+            )
+        return out
+
+    def difference(self, other: "Table") -> "Table":
+        return Table(
+            "difference",
+            [self, other],
+            {},
+            self._schema,
+            Universe(parent=self._universe),
+        )
+
+    def having(self, *indexers: Any) -> "Table":
+        out = self
+        for ix in indexers:
+            out = Table(
+                "having",
+                [out, ix.table],
+                {"key_expr": self._sub(ix)},
+                out._schema,
+                Universe(parent=out._universe),
+            )
+        return out
+
+    # -- reindexing (table.py:1690 with_id_from) ----------------------------
+
+    def with_id_from(self, *args: Any, instance: Any = None) -> "Table":
+        key_expr = PointerExpression(
+            self, *[self._sub(a) for a in args],
+            instance=self._sub(instance) if instance is not None else None,
+        )
+        return self.with_id(key_expr)
+
+    def with_id(self, new_id: Any) -> "Table":
+        return Table(
+            "reindex",
+            [self],
+            {"key_expr": self._sub(new_id)},
+            self._schema,
+            Universe(),
+        )
+
+    # -- pointer indexing (table.py:1164 ix) --------------------------------
+
+    def ix(self, expression: Any, *, optional: bool = False, context: Any = None) -> "Table":
+        if context is None:
+            context = _expression_table(expression)
+        if context is None:
+            raise ValueError("cannot infer context table for ix; pass context=")
+        key_expr = substitute(smart_coerce(expression), {this: context})
+        schema = self._schema
+        if optional:
+            schema = schema_from_columns({
+                n: ColumnSchema(name=n, dtype=dt.Optional(c.dtype))
+                for n, c in schema.columns().items()
+            }, name="Ixed")
+        return Table(
+            "ix",
+            [context, self],
+            {"key_expr": key_expr, "optional": optional},
+            schema,
+            context._universe,
+        )
+
+    def ix_ref(self, *args: Any, optional: bool = False, context: Any = None, instance: Any = None) -> "Table":
+        if context is None:
+            raise ValueError("ix_ref requires context= (or use table.ix(table.pointer_from(...)))")
+        return self.ix(
+            PointerExpression(self, *args, instance=instance),
+            optional=optional,
+            context=context,
+        )
+
+    # -- flatten (table.py:2089) --------------------------------------------
+
+    def flatten(self, to_flatten: Any, origin_id: str | None = None) -> "Table":
+        ref = self._sub(to_flatten)
+        if not isinstance(ref, ColumnReference):
+            raise ValueError("flatten takes a column reference")
+        cols = dict(self._schema.columns())
+        inner = cols[ref.name].dtype
+        iu = dt.unoptionalize(inner)
+        if isinstance(iu, dt.List):
+            new_dt: dt.DType = iu.wrapped
+        elif isinstance(iu, dt.Tuple) and iu.args:
+            new_dt = dt.types_lca_many(list(iu.args))
+        elif iu == dt.STR:
+            new_dt = dt.STR
+        else:
+            new_dt = dt.ANY
+        cols[ref.name] = ColumnSchema(name=ref.name, dtype=new_dt)
+        params: dict[str, Any] = {"column": ref.name}
+        schema = schema_from_columns(cols, name="Flattened")
+        if origin_id is not None:
+            schema = schema_from_columns(
+                {**cols, origin_id: ColumnSchema(name=origin_id, dtype=dt.POINTER)},
+                name="Flattened",
+            )
+            params["origin_id"] = origin_id
+        return Table("flatten", [self], params, schema, Universe())
+
+    # -- universe promises --------------------------------------------------
+
+    def promise_universes_are_equal(self, other: "Table") -> "Table":
+        G.promise_equal(self._universe, other._universe)
+        return self
+
+    def promise_universe_is_equal_to(self, other: "Table") -> "Table":
+        G.promise_equal(self._universe, other._universe)
+        return self
+
+    def promise_universe_is_subset_of(self, other: "Table") -> "Table":
+        G.promise_subset(self._universe, other._universe)
+        return self
+
+    def promise_universes_are_disjoint(self, other: "Table") -> "Table":
+        return self
+
+    def with_universe_of(self, other: TableLike) -> "Table":
+        return Table(
+            "with_universe_of",
+            [self, other],  # type: ignore[list-item]
+            {},
+            self._schema,
+            other._universe,
+        )
+
+    # -- misc ---------------------------------------------------------------
+
+    def slice(self, *args, **kwargs):
+        raise NotImplementedError("TableSlice is not implemented yet")
+
+    def windowby(self, time_expr: Any, *, window: Any, instance: Any = None, behavior: Any = None, **kwargs):
+        from ..stdlib.temporal import windowby as _windowby
+
+        return _windowby(self, time_expr, window=window, instance=instance, behavior=behavior)
+
+    def sort(self, key: Any, instance: Any = None) -> "Table":
+        raise NotImplementedError("Table.sort arrives with the prev/next operator")
+
+    def diff(self, timestamp: Any, *values: Any) -> "Table":
+        from ..stdlib.ordered import diff as _diff
+
+        return _diff(self, timestamp, *values)
+
+
+def _expression_table(expr: Any):
+    """The unique concrete table an expression refers to (for ix context)."""
+    tables = []
+
+    def walk(e):
+        # PointerExpression._table is the *indexed* table, not the context —
+        # only column references inside the expression locate the context.
+        if isinstance(e, ColumnReference) and not isinstance(e.table, ThisPlaceholder):
+            tables.append(e.table)
+        for d in getattr(e, "_deps", ()):
+            walk(d)
+
+    if isinstance(expr, ColumnExpression):
+        walk(expr)
+    uniq = {id(t): t for t in tables}
+    if len(uniq) == 1:
+        return next(iter(uniq.values()))
+    return None
+
+
+def _infer_schema(exprs: dict[str, ColumnExpression], table: "Table") -> SchemaMetaclass:
+    """Static type propagation (the analog of type_interpreter.py)."""
+    from .expression_compiler import ColumnEnv, infer_dtype
+
+    env = ColumnEnv()
+    _add_reachable_tables(env, exprs, table)
+    cols = {}
+    for name, e in exprs.items():
+        cols[name] = ColumnSchema(name=name, dtype=infer_dtype(e, env))
+    return schema_from_columns(cols, name="Selected")
+
+
+def _add_reachable_tables(env, exprs, primary: "Table") -> None:
+    env.add_table(primary)
+    seen = {id(primary)}
+
+    def walk(e):
+        if isinstance(e, ColumnReference) and not isinstance(e.table, ThisPlaceholder):
+            t = e.table
+            if id(t) not in seen and isinstance(t, Table):
+                seen.add(id(t))
+                env.add_table(t)
+        for d in getattr(e, "_deps", ()):
+            walk(d)
+
+    for e in exprs.values():
+        walk(e)
+
+
+def _common_schema(tables: list["Table"]) -> SchemaMetaclass:
+    names = tables[0].column_names()
+    for t in tables[1:]:
+        if set(t.column_names()) != set(names):
+            raise ValueError(
+                f"tables have different columns: {names} vs {t.column_names()}"
+            )
+    cols = {}
+    for n in names:
+        dts = [t._schema.columns()[n].dtype for t in tables]
+        cols[n] = ColumnSchema(name=n, dtype=dt.types_lca_many(dts))
+    return schema_from_columns(cols, name="Concat")
+
+
+# free functions mirroring the reference's module-level API
+
+
+def groupby(table: Table, *args, **kwargs):
+    return table.groupby(*args, **kwargs)
+
+
+def join(left: Table, right: Table, *on, **kwargs):
+    return left.join(right, *on, **kwargs)
+
+
+def join_inner(left: Table, right: Table, *on, **kwargs):
+    return left.join_inner(right, *on, **kwargs)
+
+
+def join_left(left: Table, right: Table, *on, **kwargs):
+    return left.join_left(right, *on, **kwargs)
+
+
+def join_right(left: Table, right: Table, *on, **kwargs):
+    return left.join_right(right, *on, **kwargs)
+
+
+def join_outer(left: Table, right: Table, *on, **kwargs):
+    return left.join_outer(right, *on, **kwargs)

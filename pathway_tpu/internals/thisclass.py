@@ -1,0 +1,96 @@
+"""``pw.this`` / ``pw.left`` / ``pw.right`` placeholders.
+
+Re-design of the reference's desugaring machinery
+(``python/pathway/internals/thisclass.py`` + ``desugaring.py``): a
+placeholder is a fake table; expressions built on it are rewritten against
+concrete tables at the call site (select/filter/join/reduce) by
+``substitute``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .expression import (
+    ApplyExpression,
+    ColumnExpression,
+    ColumnReference,
+    IdReference,
+    PointerExpression,
+)
+
+
+class ThisPlaceholder:
+    def __init__(self, label: str):
+        self._label = label
+
+    def __getattr__(self, name: str) -> ColumnReference:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name == "id":
+            return IdReference(self)  # type: ignore[arg-type]
+        return ColumnReference(self, name)  # type: ignore[arg-type]
+
+    def __getitem__(self, name: str) -> ColumnReference:
+        if name == "id":
+            return IdReference(self)  # type: ignore[arg-type]
+        return ColumnReference(self, name)  # type: ignore[arg-type]
+
+    def pointer_from(self, *args: Any, instance: Any = None, optional: bool = False):
+        return PointerExpression(self, *args, instance=instance, optional=optional)  # type: ignore[arg-type]
+
+    def __repr__(self) -> str:
+        return f"<pw.{self._label}>"
+
+
+this = ThisPlaceholder("this")
+left = ThisPlaceholder("left")
+right = ThisPlaceholder("right")
+
+
+def substitute(expr: ColumnExpression, mapping: dict[Any, Any]) -> ColumnExpression:
+    """Rewrite placeholder column references to concrete tables.
+
+    mapping: placeholder-or-table -> concrete table. References to tables not
+    in the mapping pass through unchanged.
+    """
+    import copy
+
+    if isinstance(expr, IdReference):
+        if expr.table in mapping:
+            return IdReference(mapping[expr.table])
+        return expr
+    if isinstance(expr, ColumnReference):
+        if expr.table in mapping:
+            target = mapping[expr.table]
+            schema = getattr(target, "schema", None)
+            if schema is not None and expr.name not in schema.__columns__:
+                raise AttributeError(
+                    f"Table has no column {expr.name!r}; columns: "
+                    f"{schema.column_names()}"
+                )
+            return ColumnReference(target, expr.name)
+        return expr
+    if not expr._deps:
+        return expr
+    clone = copy.copy(expr)
+    _substitute_in_place(clone, mapping)
+    return clone
+
+
+def _substitute_in_place(expr: ColumnExpression, mapping: dict[Any, Any]) -> None:
+    for attr, value in list(vars(expr).items()):
+        if isinstance(value, ColumnExpression):
+            setattr(expr, attr, substitute(value, mapping))
+        elif isinstance(value, tuple) and any(isinstance(v, ColumnExpression) for v in value):
+            setattr(expr, attr, tuple(
+                substitute(v, mapping) if isinstance(v, ColumnExpression) else v
+                for v in value
+            ))
+        elif isinstance(value, dict) and any(
+            isinstance(v, ColumnExpression) for v in value.values()
+        ):
+            setattr(expr, attr, {
+                k: substitute(v, mapping) if isinstance(v, ColumnExpression) else v
+                for k, v in value.items()
+            })

@@ -1,0 +1,52 @@
+"""``pw.io`` — connector framework.
+
+Re-design of ``python/pathway/io/`` (8,122 LoC, 30+ modules) over the engine's
+SourceNode/Subscribe machinery. Implemented connectors live in submodules
+(``fs``, ``csv``, ``jsonlines``, ``plaintext``, ``python``, ``http``, ...);
+``subscribe`` is the universal callback sink (reference ``io.subscribe``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..internals.parse_graph import G
+from ..internals.table import Table
+
+from . import csv, fs, jsonlines, null, plaintext, python  # noqa: E402,F401
+
+__all__ = [
+    "csv",
+    "fs",
+    "jsonlines",
+    "plaintext",
+    "python",
+    "null",
+    "subscribe",
+    "OnChangeCallback",
+    "OnFinishCallback",
+]
+
+OnChangeCallback = Callable[..., None]
+OnFinishCallback = Callable[[], None]
+
+
+def subscribe(
+    table: Table,
+    on_change: Callable[..., None] | None = None,
+    on_end: Callable[[], None] | None = None,
+    on_time_end: Callable[[int], None] | None = None,
+    *,
+    skip_persisted_batch: bool = True,
+    name: str | None = None,
+    sort_by: Any = None,
+) -> None:
+    """Call ``on_change(key, row, time, is_addition)`` for every row update
+    (reference ``io/subscribe``)."""
+    G.add_sink({
+        "kind": "subscribe",
+        "table": table,
+        "on_change": on_change,
+        "on_time_end": on_time_end,
+        "on_end": on_end,
+    })

@@ -1,0 +1,174 @@
+"""``pw.io.fs`` — filesystem connector (csv/json/plaintext/binary).
+
+Re-design of ``python/pathway/io/fs`` + the Rust filesystem scanner/parsers
+(``src/connectors/posix_like.rs``, ``data_format.rs`` DsvParser :500,
+JsonLinesParser :1443). Static mode reads files at build time; streaming
+mode (directory watching) arrives with the realtime executor loop.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import glob
+import json
+import os
+from typing import Any
+
+from ..internals import dtype as dt
+from ..internals.parse_graph import G
+from ..internals.schema import SchemaMetaclass, schema_from_types
+from ..internals.table import Table
+from ..internals.table_io import rows_to_table
+
+
+def _paths_of(path: str | os.PathLike) -> list[str]:
+    path = os.fspath(path)
+    if os.path.isdir(path):
+        return sorted(
+            os.path.join(root, f)
+            for root, _, files in os.walk(path)
+            for f in files
+        )
+    matched = sorted(glob.glob(path))
+    return matched if matched else [path]
+
+
+def _convert(value: str, dtype: dt.DType) -> Any:
+    u = dt.unoptionalize(dtype)
+    if value == "" and dtype.is_optional:
+        return None
+    if u == dt.INT:
+        return int(value)
+    if u == dt.FLOAT:
+        return float(value)
+    if u == dt.BOOL:
+        return value.strip().lower() in ("true", "1", "yes", "on")
+    return value
+
+
+def read(
+    path: str | os.PathLike,
+    *,
+    format: str = "csv",
+    schema: SchemaMetaclass | None = None,
+    mode: str = "streaming",
+    csv_settings: Any = None,
+    json_field_paths: dict[str, str] | None = None,
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 1500,
+    name: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    rows: list[tuple] = []
+    names: list[str]
+    if format in ("csv", "dsv"):
+        delimiter = getattr(csv_settings, "delimiter", ",") if csv_settings else ","
+        names = schema.column_names() if schema is not None else []
+        for p in _paths_of(path):
+            with open(p, newline="") as f:
+                reader = _csv.DictReader(f, delimiter=delimiter)
+                if not names:
+                    names = list(reader.fieldnames or [])
+                for rec in reader:
+                    if schema is not None:
+                        rows.append(tuple(
+                            _convert(rec[n], schema.columns()[n].dtype) for n in names
+                        ))
+                    else:
+                        rows.append(tuple(_auto(rec[n]) for n in names))
+    elif format in ("json", "jsonlines"):
+        names = schema.column_names() if schema is not None else []
+        for p in _paths_of(path):
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    obj = json.loads(line)
+                    if not names:
+                        names = list(obj.keys())
+                    rows.append(tuple(obj.get(n) for n in names))
+    elif format in ("plaintext", "plaintext_by_file"):
+        names = ["data"]
+        for p in _paths_of(path):
+            if format == "plaintext_by_file":
+                with open(p) as f:
+                    rows.append((f.read(),))
+            else:
+                with open(p) as f:
+                    for line in f:
+                        rows.append((line.rstrip("\n"),))
+        if schema is None:
+            schema = schema_from_types(data=str)
+    elif format == "binary":
+        names = ["data"]
+        for p in _paths_of(path):
+            with open(p, "rb") as f:
+                rows.append((f.read(),))
+        if schema is None:
+            schema = schema_from_types(data=bytes)
+    else:
+        raise ValueError(f"unknown format {format!r}")
+
+    id_from = schema.primary_key_columns() if schema is not None else None
+    return rows_to_table(names, rows, schema=schema, id_from=id_from)
+
+
+def write(table: Table, filename: str | os.PathLike, *, format: str = "csv", name: str | None = None, **kwargs: Any) -> None:
+    """Write the table's update stream to a file (time/diff columns appended,
+    like the reference's FileWriter + DsvFormatter/JsonLinesFormatter)."""
+    from . import subscribe
+
+    filename = os.fspath(filename)
+    names = table.column_names()
+    state: dict[str, Any] = {"f": None, "writer": None}
+
+    def ensure_open():
+        if state["f"] is None:
+            state["f"] = open(filename, "w", newline="")
+            if format == "csv":
+                w = _csv.writer(state["f"])
+                w.writerow(names + ["time", "diff"])
+                state["writer"] = w
+        return state["f"]
+
+    def on_change(key, row, time, is_addition):
+        f = ensure_open()
+        diff = 1 if is_addition else -1
+        if format == "csv":
+            state["writer"].writerow([row[n] for n in names] + [time, diff])
+        else:
+            obj = {n: _jsonable(row[n]) for n in names}
+            obj["time"] = time
+            obj["diff"] = diff
+            f.write(json.dumps(obj) + "\n")
+
+    def on_end():
+        ensure_open()
+        state["f"].close()
+
+    subscribe(table, on_change=on_change, on_end=on_end)
+
+
+def _jsonable(v: Any) -> Any:
+    import numpy as np
+
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    return v
+
+
+def _auto(v: str) -> Any:
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        pass
+    return v

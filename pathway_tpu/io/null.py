@@ -1,0 +1,12 @@
+"""``pw.io.null`` — sink that discards output (reference NullWriter,
+data_storage.rs:1387); still forces the table to be computed."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def write(table, *, name: str | None = None, **kwargs: Any) -> None:
+    from . import subscribe
+
+    subscribe(table, on_change=lambda **kw: None)

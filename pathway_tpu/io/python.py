@@ -1,0 +1,91 @@
+"""``pw.io.python`` — custom python sources (ConnectorSubject).
+
+Re-design of ``python/pathway/io/python/__init__.py:349`` (ConnectorSubject)
++ the Rust ``PythonReader`` (data_storage.rs:835). The subject's ``run()``
+emits rows via ``next``/``next_json``/``next_str``; ``commit()`` closes a
+logical-time batch (the reference's commit ticks, connectors/mod.rs:205).
+Finite subjects are drained into a timestamped schedule; each commit maps to
+one engine timestamp.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from ..internals.schema import SchemaMetaclass
+from ..internals.table import Table
+from ..internals.table_io import rows_to_table
+
+
+class ConnectorSubject:
+    """Subclass and override ``run()``; call ``self.next(**fields)`` per row
+    and optionally ``self.commit()`` to close a batch."""
+
+    def __init__(self, datasource_name: str = "python"):
+        self._buffer: list[tuple[int, dict[str, Any]]] = []
+        self._time = 2
+
+    # -- emission API (reference io/python: next_json / next_str / next) --
+
+    def next(self, **kwargs: Any) -> None:
+        self._buffer.append((self._time, kwargs))
+
+    def next_json(self, message: dict | str) -> None:
+        if isinstance(message, str):
+            message = json.loads(message)
+        self.next(**message)
+
+    def next_str(self, message: str) -> None:
+        self.next(data=message)
+
+    def next_bytes(self, message: bytes) -> None:
+        self.next(data=message)
+
+    def commit(self) -> None:
+        self._time += 2
+
+    def close(self) -> None:
+        pass
+
+    def on_stop(self) -> None:
+        pass
+
+    def run(self) -> None:
+        raise NotImplementedError
+
+    def start(self) -> None:
+        self.run()
+        self.on_stop()
+
+
+def read(
+    subject: ConnectorSubject,
+    *,
+    schema: SchemaMetaclass,
+    autocommit_duration_ms: int | None = 1500,
+    name: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    subject.start()
+    names = schema.column_names()
+    defaults = {
+        n: c.default_value for n, c in schema.columns().items() if c.has_default
+    }
+    rows: list[tuple] = []
+    times: list[int] = []
+    for t, fields in subject._buffer:
+        row = []
+        for n in names:
+            if n in fields:
+                row.append(fields[n])
+            elif n in defaults:
+                row.append(defaults[n])
+            else:
+                row.append(None)
+        rows.append(tuple(row))
+        times.append(t)
+    return rows_to_table(names, rows, schema=schema, times=times)
+
+
+write = None  # python connector is read-only (reference parity)

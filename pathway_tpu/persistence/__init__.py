@@ -1,0 +1,30 @@
+"""pw.persistence — checkpoint/recovery config (reference
+python/pathway/persistence + src/persistence). Snapshotting engine state
+arrives with the streaming executor loop."""
+
+from dataclasses import dataclass
+from typing import Any
+
+
+class Backend:
+    def __init__(self, kind: str, **kwargs: Any):
+        self.kind = kind
+        self.options = kwargs
+
+    @classmethod
+    def filesystem(cls, path: str) -> "Backend":
+        return cls("filesystem", path=path)
+
+    @classmethod
+    def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
+        return cls("s3", root_path=root_path, bucket_settings=bucket_settings)
+
+
+@dataclass
+class Config:
+    backend: Backend | None = None
+    snapshot_interval_ms: int = 0
+
+    @classmethod
+    def simple_config(cls, backend: Backend, snapshot_interval_ms: int = 0) -> "Config":
+        return cls(backend=backend, snapshot_interval_ms=snapshot_interval_ms)

@@ -1,0 +1,106 @@
+"""``pw.reducers`` — reducer expression constructors.
+
+Re-design of ``python/pathway/internals/reducers.py`` (723 LoC). Each call
+builds a ReducerExpression; the engine implementations live in
+``engine/reducers.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .internals.expression import ColumnExpression, ReducerExpression
+
+__all__ = [
+    "count",
+    "sum",
+    "min",
+    "max",
+    "argmin",
+    "argmax",
+    "avg",
+    "unique",
+    "any",
+    "sorted_tuple",
+    "tuple",
+    "ndarray",
+    "earliest",
+    "latest",
+    "stateful_single",
+    "stateful_many",
+    "udf_reducer",
+]
+
+
+def count(*args: Any) -> ReducerExpression:
+    return ReducerExpression("count", args)
+
+
+def sum(arg: Any) -> ReducerExpression:
+    return ReducerExpression("sum", (arg,))
+
+
+def min(arg: Any) -> ReducerExpression:
+    return ReducerExpression("min", (arg,))
+
+
+def max(arg: Any) -> ReducerExpression:
+    return ReducerExpression("max", (arg,))
+
+
+def argmin(arg: Any) -> ReducerExpression:
+    return ReducerExpression("argmin", (arg,))
+
+
+def argmax(arg: Any) -> ReducerExpression:
+    return ReducerExpression("argmax", (arg,))
+
+
+def avg(arg: Any) -> ReducerExpression:
+    return ReducerExpression("avg", (arg,))
+
+
+def unique(arg: Any) -> ReducerExpression:
+    return ReducerExpression("unique", (arg,))
+
+
+def any(arg: Any) -> ReducerExpression:
+    return ReducerExpression("any", (arg,))
+
+
+def sorted_tuple(arg: Any, *, skip_nones: bool = False) -> ReducerExpression:
+    return ReducerExpression("sorted_tuple", (arg,), skip_nones=skip_nones)
+
+
+def tuple(arg: Any, *, skip_nones: bool = False) -> ReducerExpression:
+    return ReducerExpression("tuple", (arg,), skip_nones=skip_nones)
+
+
+def ndarray(arg: Any, *, skip_nones: bool = False) -> ReducerExpression:
+    return ReducerExpression("ndarray", (arg,), skip_nones=skip_nones)
+
+
+def earliest(arg: Any) -> ReducerExpression:
+    return ReducerExpression("earliest", (arg,))
+
+
+def latest(arg: Any) -> ReducerExpression:
+    return ReducerExpression("latest", (arg,))
+
+
+def stateful_single(combine_fn, *args: Any) -> ReducerExpression:
+    """Custom accumulator reducer: combine_fn(state, values, diff) -> state."""
+    return ReducerExpression("stateful", args, combine_fn=combine_fn)
+
+
+def stateful_many(combine_fn, *args: Any) -> ReducerExpression:
+    return ReducerExpression("stateful", args, combine_fn=combine_fn)
+
+
+def udf_reducer(reducer_cls):
+    """Decorator-style custom reducer from a BaseCustomAccumulator subclass."""
+
+    def make(*args: Any) -> ReducerExpression:
+        return ReducerExpression("custom_accumulator", args, accumulator=reducer_cls)
+
+    return make

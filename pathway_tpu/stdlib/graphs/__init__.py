@@ -1,0 +1,1 @@
+"""pw.graphs (reference python/pathway/stdlib/graphs) — needs pw.iterate."""

@@ -1,0 +1,2 @@
+"""pw.indexing — KNN / BM25 / hybrid live indexes (reference
+python/pathway/stdlib/indexing). TPU-native XLA kernels live in ops/knn.py."""

@@ -1,0 +1,1 @@
+"""pw.ml (reference python/pathway/stdlib/ml)."""

@@ -1,0 +1,5 @@
+"""pw.ordered (reference python/pathway/stdlib/ordered)."""
+
+
+def diff(table, timestamp, *values):
+    raise NotImplementedError("ordered.diff arrives with the sort/prev-next operator")

@@ -1,0 +1,16 @@
+"""pw.stateful (reference python/pathway/stdlib/stateful/deduplicate.py:9)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def deduplicate(
+    table,
+    *,
+    value,
+    instance=None,
+    acceptor: Callable[[Any, Any], bool],
+    persistent_id: str | None = None,
+):
+    return table.deduplicate(value=value, instance=instance, acceptor=acceptor)

@@ -1,0 +1,1 @@
+"""pw.statistical (reference python/pathway/stdlib/statistical)."""

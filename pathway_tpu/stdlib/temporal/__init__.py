@@ -1,0 +1,6 @@
+"""pw.temporal — windows, interval/asof joins, behaviors (reference
+python/pathway/stdlib/temporal). Implementations land incrementally."""
+
+
+def windowby(table, time_expr, *, window, instance=None, behavior=None):
+    raise NotImplementedError("temporal.windowby is not implemented yet")

@@ -1,0 +1,1 @@
+"""pw.utils (reference python/pathway/stdlib/utils)."""

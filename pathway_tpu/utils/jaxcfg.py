@@ -1,0 +1,29 @@
+"""Central JAX configuration for the framework.
+
+Importing this module configures JAX once:
+- on CPU (tests, virtual multi-device meshes) enable x64 so INT/FLOAT columns
+  keep python int64/float64 semantics;
+- on TPU leave 32-bit defaults (f64 is not native on the MXU/VPU); dense
+  column kernels run in f32 and the model/KNN paths pick bf16/f32 explicitly.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_platform = None
+
+
+def platform() -> str:
+    global _platform
+    if _platform is None:
+        env = os.environ.get("JAX_PLATFORMS", "")
+        # avoid touching the backend (may dial a TPU tunnel) when env decides
+        _platform = env.split(",")[0] if env else jax.default_backend()
+    return _platform
+
+
+if platform() == "cpu":
+    jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,22 @@
+"""Test harness config: force a virtual 8-device CPU platform BEFORE jax
+loads, so multi-chip sharding tests run without TPU hardware."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force off the axon TPU tunnel
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def clear_parse_graph():
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    yield
+    G.clear()

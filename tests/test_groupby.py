@@ -1,0 +1,324 @@
+"""groupby/reduce behavior — mirrors reference test_common.py reduce suites."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.testing import (
+    T,
+    assert_table_equality_wo_index,
+)
+
+
+def _t():
+    return T(
+        """
+        k | v
+        a | 1
+        a | 2
+        b | 3
+        b | 4
+        b | 5
+        """
+    )
+
+
+def test_count():
+    res = _t().groupby(pw.this.k).reduce(pw.this.k, c=pw.reducers.count())
+    expected = T(
+        """
+        k | c
+        a | 2
+        b | 3
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_sum_min_max():
+    res = _t().groupby(pw.this.k).reduce(
+        pw.this.k,
+        s=pw.reducers.sum(pw.this.v),
+        mn=pw.reducers.min(pw.this.v),
+        mx=pw.reducers.max(pw.this.v),
+    )
+    expected = T(
+        """
+        k | s  | mn | mx
+        a | 3  | 1  | 2
+        b | 12 | 3  | 5
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_avg():
+    res = _t().groupby(pw.this.k).reduce(pw.this.k, a=pw.reducers.avg(pw.this.v))
+    expected = T(
+        """
+        k | a
+        a | 1.5
+        b | 4.0
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_reduce_expression_over_reducers():
+    res = _t().groupby(pw.this.k).reduce(
+        pw.this.k,
+        r=pw.reducers.sum(pw.this.v) * 10 + pw.reducers.count(),
+    )
+    expected = T(
+        """
+        k | r
+        a | 32
+        b | 123
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_reducer_arg_expression():
+    res = _t().groupby(pw.this.k).reduce(
+        pw.this.k, s=pw.reducers.sum(pw.this.v * 2)
+    )
+    expected = T(
+        """
+        k | s
+        a | 6
+        b | 24
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_global_reduce():
+    res = _t().reduce(s=pw.reducers.sum(pw.this.v), c=pw.reducers.count())
+    expected = T(
+        """
+        s  | c
+        15 | 5
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_sorted_tuple_and_tuple():
+    res = _t().groupby(pw.this.k).reduce(
+        pw.this.k, st=pw.reducers.sorted_tuple(pw.this.v)
+    )
+    got = pw.debug.table_to_dicts(res)[1]
+    vals = sorted(tuple(v) for v in got["st"].values())
+    assert vals == [(1, 2), (3, 4, 5)]
+
+
+def test_unique_and_any():
+    t = T(
+        """
+        k | u
+        a | x
+        a | x
+        b | y
+        """
+    )
+    res = t.groupby(pw.this.k).reduce(pw.this.k, u=pw.reducers.unique(pw.this.u))
+    expected = T(
+        """
+        k | u
+        a | x
+        b | y
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_unique_raises_on_multiple():
+    t = T(
+        """
+        k | u
+        a | x
+        a | y
+        """
+    )
+    res = t.groupby(pw.this.k).reduce(pw.this.k, u=pw.reducers.unique(pw.this.u))
+    with pytest.raises(ValueError, match="unique"):
+        pw.debug.table_to_dicts(res)
+
+
+def test_argmin_argmax():
+    t = T(
+        """
+        id | k | v
+        1  | a | 10
+        2  | a | 5
+        3  | b | 7
+        """
+    )
+    res = t.groupby(pw.this.k).reduce(
+        pw.this.k,
+        lo=pw.reducers.argmin(pw.this.v),
+        hi=pw.reducers.argmax(pw.this.v),
+    )
+    # argmin of group a is row id 2, argmax row id 1
+    ids, cols = pw.debug.table_to_dicts(t)
+    rids, rcols = pw.debug.table_to_dicts(res)
+    by_k = {rcols["k"][k]: k for k in rids}
+    id_by_v = {cols["v"][k]: k for k in ids}
+    assert int(rcols["lo"][by_k["a"]]) == int(id_by_v[5])
+    assert int(rcols["hi"][by_k["a"]]) == int(id_by_v[10])
+    assert int(rcols["lo"][by_k["b"]]) == int(id_by_v[7])
+
+
+def test_groupby_incremental_with_retractions():
+    """Streamed input with deletions: final state reflects retraction-correct
+    min/max/sum (the reference's differential reduce semantics)."""
+    t = T(
+        """
+        k | v | __time__ | __diff__
+        a | 1 | 2        | 1
+        a | 2 | 2        | 1
+        a | 3 | 4        | 1
+        a | 3 | 6        | -1
+        a | 1 | 8        | -1
+        """
+    )
+    res = t.groupby(pw.this.k).reduce(
+        pw.this.k,
+        s=pw.reducers.sum(pw.this.v),
+        mn=pw.reducers.min(pw.this.v),
+        mx=pw.reducers.max(pw.this.v),
+        c=pw.reducers.count(),
+    )
+    expected = T(
+        """
+        k | s | mn | mx | c
+        a | 2 | 2  | 2  | 1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_group_disappears_on_full_retraction():
+    t = T(
+        """
+        k | v | __time__ | __diff__
+        a | 1 | 2        | 1
+        b | 2 | 2        | 1
+        a | 1 | 4        | -1
+        """
+    )
+    res = t.groupby(pw.this.k).reduce(pw.this.k, c=pw.reducers.count())
+    expected = T(
+        """
+        k | c
+        b | 1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_groupby_multiple_keys():
+    t = T(
+        """
+        a | b | v
+        1 | x | 1
+        1 | y | 2
+        1 | x | 3
+        2 | x | 4
+        """
+    )
+    res = t.groupby(pw.this.a, pw.this.b).reduce(
+        pw.this.a, pw.this.b, s=pw.reducers.sum(pw.this.v)
+    )
+    expected = T(
+        """
+        a | b | s
+        1 | x | 4
+        1 | y | 2
+        2 | x | 4
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_earliest_latest():
+    t = T(
+        """
+        k | v | __time__
+        a | 1 | 2
+        a | 2 | 4
+        a | 3 | 6
+        """
+    )
+    res = t.groupby(pw.this.k).reduce(
+        pw.this.k,
+        first=pw.reducers.earliest(pw.this.v),
+        last=pw.reducers.latest(pw.this.v),
+    )
+    expected = T(
+        """
+        k | first | last
+        a | 1     | 3
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_ndarray_reducer():
+    import numpy as np
+
+    res = _t().groupby(pw.this.k).reduce(
+        pw.this.k, arr=pw.reducers.ndarray(pw.this.v)
+    )
+    _, cols = pw.debug.table_to_dicts(res)
+    arrays = {sorted(a.tolist())[0]: a for a in cols["arr"].values()}
+    assert sorted(arrays[1].tolist()) == [1, 2]
+    assert sorted(arrays[3].tolist()) == [3, 4, 5]
+
+
+def test_custom_stateful_reducer():
+    def combine(state, values, diff):
+        (v,) = values
+        return (state or 0) + v * v * diff
+
+    res = _t().groupby(pw.this.k).reduce(
+        pw.this.k, ss=pw.reducers.stateful_single(combine, pw.this.v)
+    )
+    expected = T(
+        """
+        k | ss
+        a | 5
+        b | 50
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_custom_accumulator():
+    class SumAcc(pw.BaseCustomAccumulator):
+        def __init__(self, s):
+            self.s = s
+
+        @classmethod
+        def from_row(cls, row):
+            return cls(row[0])
+
+        def update(self, other):
+            self.s += other.s
+
+        def retract(self, other):
+            self.s -= other.s
+
+        def compute_result(self):
+            return self.s
+
+    sum_red = pw.reducers.udf_reducer(SumAcc)
+    res = _t().groupby(pw.this.k).reduce(pw.this.k, s=sum_red(pw.this.v))
+    expected = T(
+        """
+        k | s
+        a | 3
+        b | 12
+        """
+    )
+    assert_table_equality_wo_index(res, expected)

@@ -1,0 +1,491 @@
+"""Join behavior incl. incremental updates — mirrors reference test_joins.py."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.testing import (
+    T,
+    assert_table_equality,
+    assert_table_equality_wo_index,
+)
+
+
+def _sides():
+    left = T(
+        """
+        id | name | dept
+        1  | ann  | 1
+        2  | bob  | 2
+        3  | cid  | 9
+        """
+    )
+    right = T(
+        """
+        id | did | dname
+        1  | 1   | eng
+        2  | 2   | ops
+        3  | 3   | hr
+        """
+    )
+    return left, right
+
+
+def test_inner_join():
+    left, right = _sides()
+    res = left.join(right, left.dept == right.did).select(
+        pw.left.name, dname=pw.right.dname
+    )
+    expected = T(
+        """
+        name | dname
+        ann  | eng
+        bob  | ops
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_left_join_pads_none():
+    left, right = _sides()
+    res = left.join_left(right, left.dept == right.did).select(
+        pw.left.name, dname=pw.right.dname
+    )
+    expected = T(
+        """
+        name | dname
+        ann  | eng
+        bob  | ops
+        cid  | None
+        """
+    )
+    assert_table_equality_wo_index(res, expected, check_types=False)
+
+
+def test_right_join():
+    left, right = _sides()
+    res = left.join_right(right, left.dept == right.did).select(
+        name=pw.left.name, dname=pw.right.dname
+    )
+    expected = T(
+        """
+        name | dname
+        ann  | eng
+        bob  | ops
+        None | hr
+        """
+    )
+    assert_table_equality_wo_index(res, expected, check_types=False)
+
+
+def test_outer_join():
+    left, right = _sides()
+    res = left.join_outer(right, left.dept == right.did).select(
+        name=pw.left.name, dname=pw.right.dname
+    )
+    expected = T(
+        """
+        name | dname
+        ann  | eng
+        bob  | ops
+        cid  | None
+        None | hr
+        """
+    )
+    assert_table_equality_wo_index(res, expected, check_types=False)
+
+
+def test_join_many_to_many():
+    l = T(
+        """
+        k | a
+        x | 1
+        x | 2
+        """
+    )
+    r = T(
+        """
+        k | b
+        x | 10
+        x | 20
+        """
+    )
+    res = l.join(r, l.k == r.k).select(s=pw.left.a + pw.right.b)
+    expected = T(
+        """
+        s
+        11
+        21
+        12
+        22
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_join_streaming_updates():
+    """Left row updated over time: join output follows incrementally."""
+    l = T(
+        """
+        k | v | __time__ | __diff__
+        x | 1 | 2        | 1
+        x | 1 | 4        | -1
+        x | 5 | 4        | 1
+        """
+    )
+    r = T(
+        """
+        k | w
+        x | 10
+        """
+    )
+    res = l.join(r, l.k == r.k).select(s=pw.left.v + pw.right.w)
+    expected = T(
+        """
+        s
+        15
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_left_join_pad_transitions():
+    """Pad row appears when last match retracted, disappears when one arrives."""
+    l = T(
+        """
+        k | v
+        x | 1
+        y | 2
+        """
+    )
+    r = T(
+        """
+        k | w | __time__ | __diff__
+        x | 7 | 2        | 1
+        x | 7 | 4        | -1
+        y | 8 | 6        | 1
+        """
+    )
+    res = l.join_left(r, l.k == r.k).select(pw.left.v, w=pw.right.w)
+    expected = T(
+        """
+        v | w
+        1 | None
+        2 | 8
+        """
+    )
+    assert_table_equality_wo_index(res, expected, check_types=False)
+
+
+def test_chained_joins_with_updates():
+    """Regression: consolidation reorders retract/insert pairs; downstream
+    join arrangements must net by row value, not row key."""
+    for v0 in range(6):
+        a = T(
+            f"""
+            k | v | __time__ | __diff__
+            x | {v0} | 2      | 1
+            x | {v0} | 4      | -1
+            x | {v0 + 100} | 4 | 1
+            """
+        )
+        b = T(
+            """
+            k | w
+            x | 1
+            """
+        )
+        c = T(
+            """
+            k | u | __time__ | __diff__
+            x | 7 | 2        | 1
+            x | 9 | 6        | 1
+            """
+        )
+        j1 = a.join(b, a.k == b.k, id=pw.left.id).select(
+            pw.left.k, pw.left.v, pw.right.w
+        )
+        j2 = j1.join(c, j1.k == c.k).select(s=pw.left.v + pw.left.w + pw.right.u)
+        expected = T(
+            f"""
+            s
+            {v0 + 108}
+            {v0 + 110}
+            """
+        )
+        assert_table_equality_wo_index(j2, expected)
+
+
+def test_join_id_side():
+    left, right = _sides()
+    res = left.join(right, left.dept == right.did, id=pw.left.id).select(
+        pw.left.name
+    )
+    expected = T(
+        """
+        id | name
+        1  | ann
+        2  | bob
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_self_join():
+    t = T(
+        """
+        a | b
+        1 | 2
+        2 | 3
+        """
+    )
+    t2 = t.copy()
+    res = t.join(t2, t.b == t2.a).select(x=t.a, y=t2.b)
+    expected = T(
+        """
+        x | y
+        1 | 3
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_ix():
+    orders = T(
+        """
+        id | item | customer_id
+        1  | pen  | 11
+        2  | ink  | 12
+        """
+    )
+    customers = T(
+        """
+        cid | name
+        11  | ann
+        12  | bob
+        """,
+        id_from=["cid"],
+    )
+    res = orders.select(
+        pw.this.item,
+        cname=customers.ix(customers.pointer_from(orders.customer_id)).name,
+    )
+    expected = T(
+        """
+        id | item | cname
+        1  | pen  | ann
+        2  | ink  | bob
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_restrict_and_difference():
+    t = T(
+        """
+        id | a
+        1  | 1
+        2  | 2
+        3  | 3
+        """
+    )
+    sub = t.filter(pw.this.a >= 2)
+    diff = t.difference(sub)
+    expected = T(
+        """
+        id | a
+        1  | 1
+        """
+    )
+    assert_table_equality(diff, expected)
+    inter = t.intersect(sub)
+    expected2 = T(
+        """
+        id | a
+        2  | 2
+        3  | 3
+        """
+    )
+    assert_table_equality(inter, expected2)
+
+
+def test_concat_and_update_rows():
+    t1 = T(
+        """
+        id | a
+        1  | 1
+        2  | 2
+        """
+    )
+    t2 = T(
+        """
+        id | a
+        3  | 3
+        """
+    )
+    res = t1.concat(t2)
+    expected = T(
+        """
+        id | a
+        1  | 1
+        2  | 2
+        3  | 3
+        """
+    )
+    assert_table_equality(res, expected)
+
+    t3 = T(
+        """
+        id | a
+        2  | 20
+        4  | 40
+        """
+    )
+    upd = t1.update_rows(t3)
+    expected_upd = T(
+        """
+        id | a
+        1  | 1
+        2  | 20
+        4  | 40
+        """
+    )
+    assert_table_equality(upd, expected_upd)
+
+
+def test_update_cells():
+    t = T(
+        """
+        id | a | b
+        1  | 1 | x
+        2  | 2 | y
+        """
+    )
+    patch = t.filter(pw.this.a == 1).select(b=pw.this.b + "!")
+    res = t.update_cells(patch)
+    expected = T(
+        """
+        id | a | b
+        1  | 1 | x!
+        2  | 2 | y
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_flatten():
+    t = T(
+        """
+        w
+        abc
+        de
+        """
+    )
+    res = t.select(c=pw.apply_with_type(lambda s: tuple(s), tuple, pw.this.w)).flatten(
+        pw.this.c
+    )
+    expected = T(
+        """
+        c
+        a
+        b
+        c
+        d
+        e
+        """
+    )
+    assert_table_equality_wo_index(res, expected, check_types=False)
+
+
+def test_deduplicate():
+    t = T(
+        """
+        v | __time__
+        1 | 2
+        3 | 4
+        2 | 6
+        5 | 8
+        """
+    )
+    res = t.deduplicate(value=pw.this.v, acceptor=lambda new, old: new > old)
+    expected = T(
+        """
+        v
+        5
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_groupby_output_dense_dtype():
+    """Regression: groupby/join rebuilds must keep numeric columns dense."""
+    t = T(
+        """
+        k | v
+        a | 1
+        b | 2
+        """
+    )
+    res = t.groupby(pw.this.k).reduce(pw.this.k, s=pw.reducers.sum(pw.this.v))
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    (cap,) = GraphRunner().run_tables(res)
+    # peek at the captured delta dtypes via state rows
+    for _, row in cap.state.iter_items():
+        assert isinstance(row[1], (int, np.integer))
+
+
+def test_bool_key_consistency():
+    """Regression: bool group keys must hash identically from dense and
+    object columns (e.g. after passing through a stateful operator)."""
+    t = T(
+        """
+        b | v
+        True  | 1
+        True  | 2
+        False | 3
+        """
+    )
+    r1 = t.groupby(pw.this.b).reduce(pw.this.b, s=pw.reducers.sum(pw.this.v))
+    r2 = r1.groupby(pw.this.b).reduce(pw.this.b, s2=pw.reducers.sum(pw.this.s))
+    joined = r1.join(r2, r1.b == r2.b).select(pw.left.s, pw.right.s2)
+    expected = T(
+        """
+        s | s2
+        3 | 3
+        3 | 3
+        """
+    )
+    expected = T(
+        """
+        s | s2
+        3 | 3
+        """
+    ).concat_reindex(
+        T(
+            """
+            s | s2
+            3 | 3
+            """
+        )
+    )
+    # simpler: both groups join 1:1
+    got = pw.debug.table_to_dicts(joined)[1]
+    assert sorted(got["s"].values()) == [3, 3]
+    assert sorted(got["s2"].values()) == [3, 3]
+
+
+def test_foreign_subset_universe_rejected():
+    t = T(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    f = t.filter(pw.this.a < 3).select(b=pw.this.a)
+    with pytest.raises(ValueError, match="universe"):
+        from pathway_tpu.internals.graph_runner import GraphRunner
+
+        GraphRunner().run_tables(t.select(pw.this.a, y=f.b))

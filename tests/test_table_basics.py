@@ -1,0 +1,391 @@
+"""Core Table API: select/filter/expressions — mirrors the reference's
+``test_common.py`` style (markdown tables + equality asserts)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.testing import (
+    T,
+    assert_table_equality,
+    assert_table_equality_wo_index,
+)
+
+
+def test_static_table_roundtrip():
+    t = T(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        """
+    )
+    assert t.column_names() == ["a", "b"]
+    assert_table_equality(t, t)
+
+
+def test_select_arithmetic():
+    t = T(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        """
+    )
+    res = t.select(c=pw.this.a + pw.this.b)
+    expected = T(
+        """
+        c
+        3
+        7
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_select_keeps_ids():
+    t = T(
+        """
+        id | a
+        1  | 10
+        2  | 20
+        """
+    )
+    res = t.select(b=pw.this.a * 2)
+    expected = T(
+        """
+        id | b
+        1  | 20
+        2  | 40
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_filter():
+    t = T(
+        """
+        id | a
+        1  | 10
+        2  | 25
+        3  | 30
+        """
+    )
+    res = t.filter(pw.this.a > 15)
+    expected = T(
+        """
+        id | a
+        2  | 25
+        3  | 30
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_division_produces_float():
+    t = T(
+        """
+        a | b
+        6 | 3
+        7 | 2
+        """
+    )
+    res = t.select(q=pw.this.a / pw.this.b)
+    expected = T(
+        """
+        q
+        2.0
+        3.5
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_comparison_and_bool_ops():
+    t = T(
+        """
+        a | b
+        1 | 2
+        5 | 2
+        3 | 3
+        """
+    )
+    res = t.select(lt=pw.this.a < pw.this.b, both=(pw.this.a > 0) & (pw.this.b > 2))
+    expected = T(
+        """
+        lt    | both
+        True  | False
+        False | False
+        False | True
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_string_concat():
+    t = T(
+        """
+        a     | b
+        hello | world
+        foo   | bar
+        """
+    )
+    res = t.select(c=pw.this.a + pw.this.b)
+    expected = T(
+        """
+        c
+        helloworld
+        foobar
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_if_else():
+    t = T(
+        """
+        a
+        1
+        5
+        3
+        """
+    )
+    res = t.select(x=pw.if_else(pw.this.a > 2, pw.this.a * 10, pw.this.a))
+    expected = T(
+        """
+        x
+        1
+        50
+        30
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_with_columns():
+    t = T(
+        """
+        id | a | b
+        1  | 1 | 2
+        2  | 3 | 4
+        """
+    )
+    res = t.with_columns(c=pw.this.a + pw.this.b)
+    expected = T(
+        """
+        id | a | b | c
+        1  | 1 | 2 | 3
+        2  | 3 | 4 | 7
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_rename_and_without():
+    t = T(
+        """
+        id | a | b
+        1  | 1 | 2
+        """
+    )
+    res = t.rename_columns(c=pw.this.a).without("b")
+    expected = T(
+        """
+        id | c
+        1  | 1
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_apply_udf():
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+
+    @pw.udf
+    def double(x: int) -> int:
+        return 2 * x
+
+    res = t.select(b=double(pw.this.a))
+    expected = T(
+        """
+        b
+        2
+        4
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_apply_builtin():
+    t = T(
+        """
+        a
+        -1
+        2
+        """
+    )
+    res = t.select(b=pw.apply_with_type(abs, int, pw.this.a))
+    expected = T(
+        """
+        b
+        1
+        2
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_optional_and_coalesce():
+    t = T(
+        """
+        a
+        1
+        None
+        3
+        """
+    )
+    res = t.select(b=pw.coalesce(pw.this.a, 0))
+    expected = T(
+        """
+        b
+        1
+        0
+        3
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_is_none_filter():
+    t = T(
+        """
+        a
+        1
+        None
+        3
+        """
+    )
+    res = t.filter(pw.this.a.is_not_none()).select(b=pw.unwrap(pw.this.a) + 1)
+    expected = T(
+        """
+        b
+        2
+        4
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_str_namespace():
+    t = T(
+        """
+        s
+        Hello
+        World
+        """
+    )
+    res = t.select(
+        lower=pw.this.s.str.lower(),
+        n=pw.this.s.str.len(),
+        sw=pw.this.s.str.startswith("He"),
+    )
+    expected = T(
+        """
+        lower | n | sw
+        hello | 5 | True
+        world | 5 | False
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_schema_class():
+    class MySchema(pw.Schema):
+        a: int
+        b: str
+
+    assert MySchema.column_names() == ["a", "b"]
+    t = T(
+        """
+        a | b
+        1 | x
+        """,
+        schema=MySchema,
+    )
+    pw.assert_table_has_schema(t, MySchema)
+
+
+def test_foreign_column_same_universe():
+    t = T(
+        """
+        id | a
+        1  | 10
+        2  | 20
+        """
+    )
+    t2 = t.select(b=pw.this.a + 1)
+    res = t2.select(c=t.a + pw.this.b)
+    expected = T(
+        """
+        id | c
+        1  | 21
+        2  | 41
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_cast():
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    res = t.select(f=pw.cast(float, pw.this.a), s=pw.cast(str, pw.this.a))
+    expected = T(
+        """
+        f   | s
+        1.0 | 1
+        2.0 | 2
+        """,
+        schema=pw.schema_from_types(f=float, s=str),
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_make_tuple_and_get():
+    t = T(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    res = t.select(t=pw.make_tuple(pw.this.a, pw.this.b)).select(
+        x=pw.this.t[0], y=pw.this.t.get(5, default=-1)
+    )
+    expected = T(
+        """
+        x | y
+        1 | -1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_compute_and_print(capsys):
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    pw.debug.compute_and_print(t)
+    out = capsys.readouterr().out
+    assert "a" in out and "1" in out
