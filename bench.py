@@ -1,0 +1,73 @@
+"""Benchmark: sharded brute-force KNN retrieval latency on TPU.
+
+North-star metric (BASELINE.json): p50 KNN query latency over a 1M-doc
+index — the serving-path hot op of the Adaptive-RAG template. The reference
+runs USearch HNSW on CPU; here scoring is a bf16 matmul on the MXU + top-k.
+``vs_baseline`` = (50 ms target) / p50 — >1.0 means beating the north-star
+target. Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    platform = jax.default_backend()
+    on_tpu = platform not in ("cpu",)
+    n_docs = 1_000_000 if on_tpu else 50_000
+    dim = 384
+    n_queries = 64
+    k = 10
+    target_ms = 50.0
+
+    from pathway_tpu.ops.knn import topk_scores
+
+    rng = np.random.default_rng(0)
+    docs = rng.standard_normal((n_docs, dim), dtype=np.float32)
+    docs /= np.linalg.norm(docs, axis=1, keepdims=True)
+    queries = rng.standard_normal((n_queries, dim), dtype=np.float32)
+    queries /= np.linalg.norm(queries, axis=1, keepdims=True)
+
+    import jax.numpy as jnp
+
+    d_index = jax.device_put(jnp.asarray(docs))
+    d_queries = jax.device_put(jnp.asarray(queries))
+
+    # compile + warm up
+    s, i = topk_scores(d_queries, d_index, k)
+    jax.block_until_ready((s, i))
+
+    lat = []
+    iters = 30 if on_tpu else 10
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        s, i = topk_scores(d_queries, d_index, k)
+        jax.block_until_ready((s, i))
+        lat.append((time.perf_counter() - t0) * 1000.0)
+    p50 = float(np.percentile(lat, 50))
+    qps = n_queries / (p50 / 1000.0)
+
+    print(json.dumps({
+        "metric": f"knn_p50_latency_{n_docs // 1000}k_docs_batch{n_queries}",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(target_ms / p50, 3),
+        "extra": {
+            "platform": platform,
+            "n_docs": n_docs,
+            "dim": dim,
+            "k": k,
+            "queries_per_sec": round(qps, 1),
+            "baseline_note": "reference publishes no in-repo numbers (BASELINE.md); 50ms north-star serve target used",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+"""TPU-native text embedder — the flagship on-device model.
+
+Replaces the reference LLM xpack's CPU-bound ``SentenceTransformerEmbedder``
+(``python/pathway/xpacks/llm/embedders.py:217``) with a pure-JAX transformer
+encoder that runs on the MXU in bf16: mean-pooled, L2-normalized sentence
+embeddings. Weights can be tensor-parallel sharded over a mesh "model" axis
+(attention heads + MLP hidden split), with batch data-parallel over "data".
+
+Deterministic init (seeded) so the framework is self-contained; loading
+pretrained MiniLM-class weights is a straight param-tree mapping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbedderConfig:
+    vocab_size: int = 30528
+    dim: int = 384
+    n_layers: int = 6
+    n_heads: int = 12
+    mlp_ratio: int = 4
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+def init_params(cfg: EmbedderConfig, seed: int = 0) -> dict:
+    """Initialize a parameter pytree (dense f32 master weights)."""
+    key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, 4 + 8 * cfg.n_layers)
+    k = iter(keys)
+
+    def dense(kk, fan_in, shape):
+        return (jax.random.normal(kk, shape, jnp.float32) / np.sqrt(fan_in)).astype(
+            jnp.float32
+        )
+
+    params: dict = {
+        "tok_emb": dense(next(k), cfg.dim, (cfg.vocab_size, cfg.dim)),
+        "pos_emb": dense(next(k), cfg.dim, (cfg.max_len, cfg.dim)),
+        "ln_f_scale": jnp.ones((cfg.dim,), jnp.float32),
+        "ln_f_bias": jnp.zeros((cfg.dim,), jnp.float32),
+        "layers": [],
+    }
+    hidden = cfg.dim * cfg.mlp_ratio
+    for _ in range(cfg.n_layers):
+        layer = {
+            "qkv": dense(next(k), cfg.dim, (cfg.dim, 3 * cfg.dim)),
+            "proj": dense(next(k), cfg.dim, (cfg.dim, cfg.dim)),
+            "mlp_in": dense(next(k), cfg.dim, (cfg.dim, hidden)),
+            "mlp_out": dense(next(k), hidden, (hidden, cfg.dim)),
+            "ln1_scale": jnp.ones((cfg.dim,), jnp.float32),
+            "ln1_bias": jnp.zeros((cfg.dim,), jnp.float32),
+            "ln2_scale": jnp.ones((cfg.dim,), jnp.float32),
+            "ln2_bias": jnp.zeros((cfg.dim,), jnp.float32),
+        }
+        params["layers"].append(layer)
+        for _ in range(4):
+            next(k, None)
+    return params
+
+
+def _layernorm(x, scale, bias):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + 1e-6) * scale + bias).astype(x.dtype)
+
+
+def _block(x, layer, cfg: EmbedderConfig, mask):
+    # attention — bf16 matmuls land on the MXU; softmax in f32
+    h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"])
+    b, s, d = h.shape
+    qkv = h @ layer["qkv"].astype(cfg.dtype)
+    q, kk, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, s, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+
+    q, kk, v = heads(q), heads(kk), heads(v)
+    scores = (q @ kk.transpose(0, 1, 3, 2)).astype(jnp.float32) / np.sqrt(cfg.head_dim)
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    att = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+    out = (att @ v).transpose(0, 2, 1, 3).reshape(b, s, d)
+    x = x + out @ layer["proj"].astype(cfg.dtype)
+    # MLP
+    h = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"])
+    h = jax.nn.gelu(h @ layer["mlp_in"].astype(cfg.dtype))
+    x = x + h @ layer["mlp_out"].astype(cfg.dtype)
+    return x
+
+
+def embed_tokens(params: dict, token_ids: jax.Array, cfg: EmbedderConfig) -> jax.Array:
+    """token_ids int32 [batch, seq] (0 = pad) -> f32 [batch, dim], L2-normed."""
+    mask = token_ids > 0
+    s = token_ids.shape[1]
+    x = params["tok_emb"].astype(cfg.dtype)[token_ids] + params["pos_emb"].astype(
+        cfg.dtype
+    )[:s][None, :, :]
+    for layer in params["layers"]:
+        x = _block(x, layer, cfg, mask)
+    x = _layernorm(x, params["ln_f_scale"], params["ln_f_bias"])
+    # masked mean pool
+    m = mask[:, :, None].astype(jnp.float32)
+    pooled = (x.astype(jnp.float32) * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+    return pooled / jnp.linalg.norm(pooled, axis=-1, keepdims=True).clip(1e-9)
+
+
+class Embedder:
+    """Host-facing embedder with a cached jitted forward per shape bucket."""
+
+    def __init__(self, cfg: EmbedderConfig | None = None, seed: int = 0):
+        self.cfg = cfg or EmbedderConfig()
+        self.params = init_params(self.cfg, seed)
+        self._fwd = jax.jit(functools.partial(embed_tokens, cfg=self.cfg))
+
+    def __call__(self, token_ids: np.ndarray) -> np.ndarray:
+        return np.asarray(self._fwd(self.params, jnp.asarray(token_ids, jnp.int32)))
+
+    def embed_texts(self, texts: list[str], max_len: int = 128) -> np.ndarray:
+        toks = tokenize_batch(texts, self.cfg.vocab_size, max_len)
+        return self(toks)
+
+
+def tokenize_batch(texts: list[str], vocab_size: int, max_len: int) -> np.ndarray:
+    """Deterministic hashing tokenizer (feature-hashing — a self-contained
+    stand-in for a learned vocab; swap with a real WordPiece for pretrained
+    weights)."""
+    out = np.zeros((len(texts), max_len), dtype=np.int32)
+    for i, t in enumerate(texts):
+        words = t.lower().split()[: max_len]
+        for j, w in enumerate(words):
+            out[i, j] = (hash_word(w) % (vocab_size - 2)) + 2
+    return out
+
+
+def hash_word(w: str) -> int:
+    h = 2166136261
+    for ch in w.encode("utf-8"):
+        h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
+    return h

@@ -1,0 +1,178 @@
+"""TPU-native KNN kernels.
+
+Replaces the reference's native ANN engines — USearch HNSW
+(``src/external_integration/usearch_integration.rs``) and the brute-force
+CPU index (``brute_force_knn_integration.rs``) — with XLA kernels: scoring
+is one bf16 matmul on the MXU (batch × index), top-k via ``lax.top_k``.
+A mesh-sharded variant splits the index rows across devices and merges
+local top-k with an all-gather — the "sharded vector index over ICI" of
+BASELINE.json's north star.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["topk_scores", "knn_search", "ShardedKnnIndex", "sharded_knn_search"]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def topk_scores(
+    queries: jax.Array,
+    index: jax.Array,
+    k: int,
+    metric: str = "cos",
+    valid: jax.Array | None = None,
+):
+    """queries [q, d] (f32), index [n, d] -> (scores [q,k], ids [q,k]).
+
+    cos: both sides assumed L2-normalized → dot product == cosine.
+    l2: negative squared distance (higher is closer).
+    valid [n] bool: rows where False are masked to -inf BEFORE top-k
+    (capacity padding must never displace real documents).
+    """
+    qb = queries.astype(jnp.bfloat16)
+    ib = index.astype(jnp.bfloat16)
+    if metric == "cos":
+        scores = (qb @ ib.T).astype(jnp.float32)
+    else:
+        sq_i = (index.astype(jnp.float32) ** 2).sum(-1)
+        dots = (qb @ ib.T).astype(jnp.float32)
+        sq_q = (queries.astype(jnp.float32) ** 2).sum(-1, keepdims=True)
+        scores = -(sq_q - 2 * dots + sq_i[None, :])
+    if valid is not None:
+        scores = jnp.where(valid[None, :], scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+def knn_search(queries: np.ndarray, index: np.ndarray, k: int, metric: str = "cos"):
+    s, i = topk_scores(jnp.asarray(queries), jnp.asarray(index), k, metric)
+    return np.asarray(s), np.asarray(i)
+
+
+def sharded_knn_search(
+    mesh: Mesh,
+    axis: str,
+    queries: jax.Array,
+    index_sharded: jax.Array,
+    k: int,
+    metric: str = "cos",
+    valid_sharded: jax.Array | None = None,
+):
+    """Index rows sharded over `axis`; queries replicated. Each device scores
+    its shard and takes a local top-k; an all-gather over `axis` + global
+    top-k merges — the collective rides the ICI. k must be ≤ rows per shard.
+    """
+    n_shards = mesh.shape[axis]
+    rows_per_shard = index_sharded.shape[0] // n_shards
+    if k > rows_per_shard:
+        raise ValueError(
+            f"k={k} exceeds rows per shard ({rows_per_shard}); "
+            "raise index capacity or lower k"
+        )
+
+    from jax import shard_map
+
+    specs_in = [P(), P(axis, None)]
+    args = [queries, index_sharded]
+    if valid_sharded is not None:
+        specs_in.append(P(axis))
+        args.append(valid_sharded)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=tuple(specs_in),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def search(q, shard, *maybe_valid):
+        my = jax.lax.axis_index(axis)
+        v = maybe_valid[0] if maybe_valid else None
+        s, i = topk_scores(q, shard, k, metric, valid=v)
+        i = i + my * rows_per_shard
+        # gather all shards' candidates, merge to global top-k
+        all_s = jax.lax.all_gather(s, axis, axis=1).reshape(q.shape[0], -1)
+        all_i = jax.lax.all_gather(i, axis, axis=1).reshape(q.shape[0], -1)
+        gs, gpos = jax.lax.top_k(all_s, k)
+        gi = jnp.take_along_axis(all_i, gpos, axis=1)
+        return gs, gi
+
+    return search(*args)
+
+
+class ShardedKnnIndex:
+    """Device-resident brute-force index with insert/query (host API).
+
+    Capacity-padded: rows beyond ``size`` are masked by a -inf score via a
+    validity column, so shapes stay static for XLA. Single-device by default;
+    pass a mesh to shard rows across devices.
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        capacity: int = 1 << 20,
+        metric: str = "cos",
+        mesh: Mesh | None = None,
+        axis: str = "data",
+    ):
+        self.dim = dim
+        self.capacity = capacity
+        self.metric = metric
+        self.mesh = mesh
+        self.axis = axis
+        self.size = 0
+        if mesh is not None:
+            self._data = jax.device_put(
+                jnp.zeros((capacity, dim), jnp.float32),
+                NamedSharding(mesh, P(axis, None)),
+            )
+            self._valid_d = jax.device_put(
+                jnp.zeros((capacity,), jnp.bool_), NamedSharding(mesh, P(axis))
+            )
+        else:
+            self._data = jnp.zeros((capacity, dim), jnp.float32)
+            self._valid_d = jnp.zeros((capacity,), jnp.bool_)
+        self._keys: list[Any] = []
+
+    def add(self, vectors: np.ndarray, keys: list[Any] | None = None) -> None:
+        n = len(vectors)
+        if self.size + n > self.capacity:
+            raise ValueError("index capacity exceeded")
+        self._data = jax.lax.dynamic_update_slice(
+            self._data, jnp.asarray(vectors, jnp.float32), (self.size, 0)
+        )
+        self._valid_d = jax.lax.dynamic_update_slice(
+            self._valid_d, jnp.ones((n,), jnp.bool_), (self.size,)
+        )
+        self._keys.extend(keys if keys is not None else range(self.size, self.size + n))
+        self.size += n
+
+    def query(self, queries: np.ndarray, k: int):
+        k_eff = min(k, max(self.size, 1))
+        if self.mesh is not None:
+            # the sharded merge needs k candidates from every shard
+            k_eff = min(k_eff, self.capacity // self.mesh.shape[self.axis])
+            s, i = sharded_knn_search(
+                self.mesh, self.axis, jnp.asarray(queries, jnp.float32),
+                self._data, k_eff, self.metric, valid_sharded=self._valid_d,
+            )
+        else:
+            s, i = topk_scores(
+                jnp.asarray(queries, jnp.float32), self._data, k_eff,
+                self.metric, valid=self._valid_d,
+            )
+        return np.asarray(s), np.asarray(i)
+
+    def keys_of(self, ids: np.ndarray):
+        return [
+            [self._keys[j] if 0 <= j < len(self._keys) else None for j in row]
+            for row in ids
+        ]

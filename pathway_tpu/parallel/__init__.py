@@ -1,0 +1,12 @@
+"""Multi-device distribution layer.
+
+Re-designs the reference's worker/exchange model (timely workers + hash
+sharding, SURVEY §2.9) onto ``jax.sharding``: a Mesh replaces the worker
+pool; record exchange by key becomes a bucketed all-to-all over ICI; dense
+model/index state shards with NamedSharding annotations.
+"""
+
+from .mesh import make_mesh, data_model_mesh
+from .exchange import shard_rows, bucketed_all_to_all
+
+__all__ = ["make_mesh", "data_model_mesh", "shard_rows", "bucketed_all_to_all"]

@@ -25,5 +25,37 @@ def platform() -> str:
     return _platform
 
 
+def guard_cpu_platform(force_device_count: int | None = None) -> None:
+    """When running on CPU, keep the axon TPU plugin (auto-registered by the
+    image's sitecustomize) from wedging backend init by dialing its tunnel:
+    scrub its path entries, deregister non-cpu backend factories, and pin
+    jax_platforms. Optionally force a virtual device count (must run before
+    any backend is initialized)."""
+    import sys
+
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        return
+    sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p
+    )
+    if force_device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={force_device_count}"
+            ).strip()
+    try:
+        import jax._src.xla_bridge as _xb
+
+        for _name in list(_xb._backend_factories):
+            if _name != "cpu":
+                _xb._backend_factories.pop(_name, None)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
 if platform() == "cpu":
     jax.config.update("jax_enable_x64", True)

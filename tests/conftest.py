@@ -1,14 +1,14 @@
-"""Test harness config: force a virtual 8-device CPU platform BEFORE jax
-loads, so multi-chip sharding tests run without TPU hardware."""
+"""Test harness config: force a virtual 8-device CPU platform BEFORE any
+backend initializes, so multi-chip sharding tests run without TPU hardware
+(and without the axon TPU tunnel, which can wedge backend init)."""
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force off the axon TPU tunnel
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from pathway_tpu.utils.jaxcfg import guard_cpu_platform  # noqa: E402
+
+guard_cpu_platform(force_device_count=8)
 
 import pytest  # noqa: E402
 
