@@ -146,7 +146,11 @@ class Delta:
             if len(self) == 1 and self.diffs[0] == 0:
                 return self.take(np.array([], dtype=np.int64))
             return self
-        row_sig = K.mix_columns(list(self.data.values()), len(self)) ^ self.keys
+        # asymmetric combine — a plain xor would zero out whenever row keys
+        # are themselves content-derived (same mix as the row hash)
+        row_sig = K.derive_pair(
+            self.keys, K.mix_columns(list(self.data.values()), len(self))
+        )
         order = np.argsort(row_sig, kind="stable")
         sig_sorted = row_sig[order]
         boundaries = np.flatnonzero(np.diff(sig_sorted) != 0) + 1

@@ -248,6 +248,9 @@ class Join(Node):
         mode: str = "inner",  # inner | left | right | outer
         key_mode: str = "pair",
         emit_matched: bool = True,
+        react_to_right: bool = True,  # False = asof_now: left deltas join the
+        # right state as-of-now; later right changes never retract past output
+        # (reference asof_now_join, _asof_now_join.py:176)
     ):
         super().__init__([left, right], out_names)
         assert len(out_names) == len(left_cols) + len(right_cols)
@@ -256,6 +259,7 @@ class Join(Node):
         self._mode = mode
         self._key_mode = key_mode
         self._emit_matched = emit_matched
+        self._react_to_right = react_to_right
         self._left = MultiIndex(left_cols)
         self._right = MultiIndex(right_cols)
         # row_key -> current pad multiplicity (for outer sides)
@@ -305,7 +309,7 @@ class Join(Node):
         out: tuple[list, list, list] = ([], [], [])
 
         # L_old ⋈ dR
-        if self._emit_matched:
+        if self._emit_matched and self._react_to_right:
             for jk, rk, rrow, diff in dr:
                 for lrk, lrow, lcount in self._left.iter_group_rows(jk):
                     self._emit(out, lrk, rk, lrow, rrow, lcount * diff)
@@ -355,6 +359,120 @@ class Join(Node):
         for jk, rk, row, _ in d_this:
             if rk not in this_idx.group(jk) and pad_state.get(rk, 0) != 0:
                 pad_fn(out, rk, row, -pad_state.pop(rk))
+
+
+class GroupedRecompute(Node):
+    """Generic stateful operator: group rows of 1–2 inputs by a key column,
+    recompute affected groups with a host function on every change, emit the
+    diff against the group's previous output.
+
+    Backs the order-sensitive operators the reference implements as custom
+    timely operators (``prev_next.rs`` sort/prev-next pointers, asof joins
+    ``_asof_join.py:479``, session windows ``_window.py``): not maximally
+    incremental within a group, but retraction-correct and batched per group.
+
+    compute_fn(group_key, rows_a, rows_b, time) -> list[(out_key, row_tuple)]
+    where rows_x = {row_key: row_tuple}.
+    """
+
+    def __init__(
+        self,
+        inputs: list[Node],
+        group_cols: list[str | None],  # per input; None = whole-input group
+        out_columns: list[str],
+        compute_fn,
+    ):
+        super().__init__(inputs, out_columns)
+        self._group_cols = group_cols
+        self._fn = compute_fn
+        self._state: list[dict[int, dict[int, list[list]]]] = [
+            {} for _ in inputs
+        ]  # per input: group_key -> {row_key: [[row, count], ...]}
+        self._prev_out: dict[int, dict[int, tuple]] = {}
+
+    def _gkeys(self, port: int, d: Delta) -> np.ndarray:
+        col = self._group_cols[port]
+        if col is None:
+            return np.zeros(len(d), dtype=np.uint64)
+        return np.asarray(d.data[col], dtype=np.uint64)
+
+    def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
+        affected: dict[int, None] = {}
+        for port, d in enumerate(ins):
+            if d is None or not len(d):
+                continue
+            gkeys = self._gkeys(port, d)
+            state = self._state[port]
+            cols = list(d.data.values())
+            for i in range(len(d)):
+                gk = int(gkeys[i])
+                rk = int(d.keys[i])
+                row = tuple(c[i] for c in cols)
+                diff = int(d.diffs[i])
+                grp = state.setdefault(gk, {})
+                entries = grp.get(rk)
+                if entries is None:
+                    grp[rk] = [[row, diff]]
+                else:
+                    # net by row VALUE — a tick may carry the retract of the
+                    # old row and the insert of the new one in any order
+                    for e in entries:
+                        if _rows_equal(e[0], row):
+                            e[1] += diff
+                            if e[1] == 0:
+                                entries.remove(e)
+                            break
+                    else:
+                        entries.append([row, diff])
+                    if not entries:
+                        del grp[rk]
+                if not grp:
+                    state.pop(gk, None)
+                affected[gk] = None
+        if not affected:
+            return None
+        out_keys: list[int] = []
+        out_rows: list[tuple] = []
+        out_diffs: list[int] = []
+        for gk in affected:
+            rows_per_input = []
+            for p in range(len(self.inputs)):
+                rows = {}
+                for rk, entries in self._state[p].get(gk, {}).items():
+                    positive = [e for e in entries if e[1] > 0]
+                    if len(positive) > 1:
+                        raise ValueError(
+                            f"row key {rk} holds {len(positive)} live rows in a group"
+                        )
+                    if positive:
+                        rows[rk] = positive[0][0]
+                rows_per_input.append(rows)
+            if any(rows_per_input):
+                new_out = dict(self._fn(gk, *rows_per_input, time))
+            else:
+                new_out = {}
+            old_out = self._prev_out.get(gk, {})
+            for ok, row in old_out.items():
+                if not _rows_equal(row, new_out.get(ok)):
+                    out_keys.append(ok)
+                    out_rows.append(row)
+                    out_diffs.append(-1)
+            for ok, row in new_out.items():
+                if not _rows_equal(row, old_out.get(ok)):
+                    out_keys.append(ok)
+                    out_rows.append(row)
+                    out_diffs.append(1)
+            if new_out:
+                self._prev_out[gk] = new_out
+            else:
+                self._prev_out.pop(gk, None)
+        if not out_keys:
+            return None
+        return Delta(
+            keys=np.array(out_keys, dtype=np.uint64),
+            data=rows_to_columns(out_rows, self.column_names),
+            diffs=np.array(out_diffs, dtype=np.int64),
+        )
 
 
 class UpdateRows(Node):
@@ -487,6 +605,103 @@ class Flatten(Node):
             data=rows_to_columns(rows_out, names),
             diffs=np.array(diffs_out, dtype=np.int64),
         )
+
+
+class BufferUntil(Node):
+    """Temporal buffer (reference ``time_column.rs`` postpone_core/
+    TimeColumnBuffer :255,380): hold each row until logical time reaches its
+    threshold column value; release on advance_to / end of stream. Buffered
+    insert+retract pairs cancel before ever being emitted — the mechanism
+    behind exactly-once window outputs."""
+
+    def __init__(self, inp: Node, threshold_col: str):
+        super().__init__([inp], inp.column_names)
+        self._col = threshold_col
+        # threshold -> list[(key, row, diff)]
+        self._buffer: dict[int, list] = {}
+        self._watermark = -(1 << 62)
+
+    def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
+        d = ins[0]
+        if d is None or not len(d):
+            return None
+        thr = np.asarray(d.data[self._col], dtype=np.int64)
+        pass_now = thr <= self._watermark
+        out = d.take(np.flatnonzero(pass_now))
+        hold_ix = np.flatnonzero(~pass_now)
+        cols = list(d.data.values())
+        for i in hold_ix:
+            self._buffer.setdefault(int(thr[i]), []).append(
+                (int(d.keys[i]), tuple(c[i] for c in cols), int(d.diffs[i]))
+            )
+        return out if len(out) else None
+
+    def advance_to(self, time: int) -> Delta | None:
+        self._watermark = time
+        due = [t for t in self._buffer if t <= time]
+        if not due:
+            return None
+        entries = []
+        for t in sorted(due):
+            entries.extend(self._buffer.pop(t))
+        keys = np.array([e[0] for e in entries], dtype=np.uint64)
+        rows = [e[1] for e in entries]
+        diffs = np.array([e[2] for e in entries], dtype=np.int64)
+        return Delta(
+            keys=keys, data=rows_to_columns(rows, self.column_names), diffs=diffs
+        ).consolidated()
+
+    def on_end(self) -> Delta | None:
+        return self.advance_to(END_TIME)
+
+
+class ForgetAfter(Node):
+    """Temporal forget/cutoff (reference ``time_column.rs`` TimeColumnForget
+    :556 / ignore_late :631): drop rows arriving after their threshold has
+    passed; if ``forget_state``, also retract previously-passed rows once the
+    watermark crosses their threshold (bounding downstream state — the
+    keep_results=False behavior)."""
+
+    def __init__(self, inp: Node, threshold_col: str, forget_state: bool = False):
+        super().__init__([inp], inp.column_names)
+        self._col = threshold_col
+        self._forget = forget_state
+        self._watermark = -(1 << 62)
+        # threshold -> list[(key, row, diff)] of rows passed through
+        self._live: dict[int, list] = {}
+
+    def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
+        d = ins[0]
+        if d is None or not len(d):
+            return None
+        thr = np.asarray(d.data[self._col], dtype=np.int64)
+        keep = thr > self._watermark
+        out = d.take(np.flatnonzero(keep))
+        if self._forget and len(out):
+            cols = list(out.data.values())
+            thr_kept = np.asarray(out.data[self._col], dtype=np.int64)
+            for i in range(len(out)):
+                self._live.setdefault(int(thr_kept[i]), []).append(
+                    (int(out.keys[i]), tuple(c[i] for c in cols), int(out.diffs[i]))
+                )
+        return out if len(out) else None
+
+    def advance_to(self, time: int) -> Delta | None:
+        self._watermark = time
+        if not self._forget:
+            return None
+        due = [t for t in self._live if t <= time]
+        if not due:
+            return None
+        entries = []
+        for t in sorted(due):
+            entries.extend(self._live.pop(t))
+        keys = np.array([e[0] for e in entries], dtype=np.uint64)
+        rows = [e[1] for e in entries]
+        diffs = np.array([-e[2] for e in entries], dtype=np.int64)
+        return Delta(
+            keys=keys, data=rows_to_columns(rows, self.column_names), diffs=diffs
+        ).consolidated()
 
 
 class Deduplicate(Node):

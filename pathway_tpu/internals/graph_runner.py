@@ -202,6 +202,9 @@ class GraphRunner:
             return self._add(ops.Rowwise(dd, {
                 c: _colref(c) for c in table.column_names()
             }))
+        if kind == "custom":
+            # stdlib escape hatch: the table carries its own lowering function
+            return p["lower"](self, table)
         if kind == "iterate":
             raise NotImplementedError("pw.iterate lowering not implemented yet")
         raise NotImplementedError(f"lowering for kind {kind!r}")
@@ -374,6 +377,7 @@ class GraphRunner:
             lrw, rrw, "__jk__", "__jk__",
             left_cols=lcols, right_cols=rcols, out_names=lcols + rcols,
             mode=p["mode"], key_mode=key_mode,
+            react_to_right=not p.get("asof_now", False),
         ))
         env = ColumnEnv()
         l_opt = p["mode"] in ("right", "outer")

@@ -122,6 +122,7 @@ class JoinResult:
                 "mode": self._mode.value,
                 "exprs": exprs,
                 "id_side": id_side,
+                "asof_now": getattr(self, "_asof_now", False),
             },
             schema,
             Universe(),

@@ -67,7 +67,7 @@ class Table(TableLike):
         return self._schema.columns()
 
     def __getattr__(self, name: str) -> ColumnReference:
-        if name.startswith("_"):
+        if name.startswith("__"):
             raise AttributeError(name)
         if name in self._schema.__columns__:
             return ColumnReference(self, name)
@@ -464,13 +464,81 @@ class Table(TableLike):
 
         return _windowby(self, time_expr, window=window, instance=instance, behavior=behavior)
 
-    def sort(self, key: Any, instance: Any = None) -> "Table":
-        raise NotImplementedError("Table.sort arrives with the prev/next operator")
+    def interval_join(self, other: "Table", self_time: Any, other_time: Any, interval: Any, *on: Any, **kwargs):
+        from ..stdlib.temporal import interval_join as _ij
 
-    def diff(self, timestamp: Any, *values: Any) -> "Table":
+        return _ij(self, other, self_time, other_time, interval, *on, **kwargs)
+
+    def interval_join_inner(self, other, self_time, other_time, interval, *on, **kw):
+        from ..stdlib.temporal import interval_join_inner as _f
+
+        return _f(self, other, self_time, other_time, interval, *on, **kw)
+
+    def interval_join_left(self, other, self_time, other_time, interval, *on, **kw):
+        from ..stdlib.temporal import interval_join_left as _f
+
+        return _f(self, other, self_time, other_time, interval, *on, **kw)
+
+    def interval_join_right(self, other, self_time, other_time, interval, *on, **kw):
+        from ..stdlib.temporal import interval_join_right as _f
+
+        return _f(self, other, self_time, other_time, interval, *on, **kw)
+
+    def interval_join_outer(self, other, self_time, other_time, interval, *on, **kw):
+        from ..stdlib.temporal import interval_join_outer as _f
+
+        return _f(self, other, self_time, other_time, interval, *on, **kw)
+
+    def window_join(self, other, self_time, other_time, window, *on, **kw):
+        from ..stdlib.temporal import window_join as _f
+
+        return _f(self, other, self_time, other_time, window, *on, **kw)
+
+    def asof_join(self, other, self_time, other_time, *on, **kw):
+        from ..stdlib.temporal import asof_join as _f
+
+        return _f(self, other, self_time, other_time, *on, **kw)
+
+    def asof_join_left(self, other, self_time, other_time, *on, **kw):
+        from ..stdlib.temporal import asof_join_left as _f
+
+        return _f(self, other, self_time, other_time, *on, **kw)
+
+    def asof_now_join(self, other, *on, **kw):
+        from ..stdlib.temporal import asof_now_join as _f
+
+        return _f(self, other, *on, **kw)
+
+    def sort(self, key: Any, instance: Any = None) -> "Table":
+        """Sort rows by `key` (within `instance`); returns a same-universe
+        table with ``prev``/``next`` pointer columns (reference table.py:2157,
+        backed by prev_next.rs in the reference engine)."""
+        from ..stdlib._sorted import sorted_group_transform
+
+        key_e = self._sub(key)
+        inst_e = self._sub(instance) if instance is not None else None
+
+        def fn(entries):
+            out = []
+            for i, (rk, order, _payload) in enumerate(entries):
+                prev_k = entries[i - 1][0] if i > 0 else None
+                next_k = entries[i + 1][0] if i + 1 < len(entries) else None
+                out.append((rk, (
+                    None if prev_k is None else __import__("numpy").uint64(prev_k),
+                    None if next_k is None else __import__("numpy").uint64(next_k),
+                )))
+            return out
+
+        return sorted_group_transform(
+            self, key_e, [], inst_e,
+            {"prev": dt.Optional(dt.POINTER), "next": dt.Optional(dt.POINTER)},
+            fn,
+        )
+
+    def diff(self, timestamp: Any, *values: Any, instance: Any = None) -> "Table":
         from ..stdlib.ordered import diff as _diff
 
-        return _diff(self, timestamp, *values)
+        return _diff(self, timestamp, *values, instance=instance)
 
 
 def _expression_table(expr: Any):

@@ -25,7 +25,7 @@ class ThisPlaceholder:
         self._label = label
 
     def __getattr__(self, name: str) -> ColumnReference:
-        if name.startswith("_"):
+        if name.startswith("__") or name in ("_label", "_ipython_canary_method_should_not_exist_"):
             raise AttributeError(name)
         if name == "id":
             return IdReference(self)  # type: ignore[arg-type]

@@ -1,0 +1,110 @@
+"""sort / ordered.diff / statistical.interpolate tests."""
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.testing import T, assert_table_equality_wo_index
+
+
+def test_diff():
+    t = T(
+        """
+        timestamp | values
+        1         | 1
+        2         | 2
+        3         | 4
+        4         | 7
+        """
+    )
+    res = t + t.diff(pw.this.timestamp, pw.this.values)
+    expected = T(
+        """
+        timestamp | values | diff_values
+        1         | 1      | None
+        2         | 2      | 1
+        3         | 4      | 2
+        4         | 7      | 3
+        """
+    )
+    assert_table_equality_wo_index(res, expected, check_types=False)
+
+
+def test_diff_with_instance():
+    t = T(
+        """
+        k | timestamp | values
+        a | 1         | 1
+        a | 2         | 5
+        b | 1         | 10
+        b | 2         | 12
+        """
+    )
+    res = t + t.diff(pw.this.timestamp, pw.this.values, instance=pw.this.k)
+    expected = T(
+        """
+        k | timestamp | values | diff_values
+        a | 1         | 1      | None
+        a | 2         | 5      | 4
+        b | 1         | 10     | None
+        b | 2         | 12     | 2
+        """
+    )
+    assert_table_equality_wo_index(res, expected, check_types=False)
+
+
+def test_sort_prev_next():
+    t = T(
+        """
+        id | v
+        1  | 30
+        2  | 10
+        3  | 20
+        """
+    )
+    sorted_t = t.sort(pw.this.v)
+    combined = t + sorted_t
+    _, cols = pw.debug.table_to_dicts(combined)
+    by_v = {cols["v"][k]: k for k in cols["v"]}
+    assert cols["prev"][by_v[10]] is None
+    assert int(cols["prev"][by_v[20]]) == int(by_v[10])
+    assert int(cols["next"][by_v[20]]) == int(by_v[30])
+    assert cols["next"][by_v[30]] is None
+
+
+def test_interpolate_linear():
+    t = T(
+        """
+        timestamp | va
+        1         | 1
+        2         | None
+        3         | 3
+        4         | None
+        6         | 6
+        """
+    )
+    res = t.statistical_interpolate if False else None
+    from pathway_tpu.stdlib.statistical import interpolate
+
+    res = interpolate(t, pw.this.timestamp, pw.this.va)
+    _, cols = pw.debug.table_to_dicts(res)
+    by_t = {cols["timestamp"][k]: cols["va"][k] for k in cols["timestamp"]}
+    assert by_t[2] == 2.0
+    assert by_t[4] == 4.0
+    assert by_t[1] == 1 and by_t[6] == 6
+
+
+def test_interpolate_streaming_update():
+    t = T(
+        """
+        timestamp | va   | __time__
+        1         | 1    | 2
+        3         | None | 2
+        5         | 5    | 4
+        """
+    )
+    from pathway_tpu.stdlib.statistical import interpolate
+
+    res = interpolate(t, pw.this.timestamp, pw.this.va)
+    _, cols = pw.debug.table_to_dicts(res)
+    by_t = {cols["timestamp"][k]: cols["va"][k] for k in cols["timestamp"]}
+    assert by_t[3] == 3.0

@@ -1,0 +1,445 @@
+"""Temporal stdlib: windows, interval/window/asof joins, behaviors —
+mirrors reference temporal/test_windows.py, test_interval_joins.py style."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.testing import (
+    T,
+    assert_table_equality_wo_index,
+)
+
+
+def test_tumbling_window_reduce():
+    t = T(
+        """
+        t  | v
+        1  | 1
+        3  | 2
+        4  | 3
+        11 | 4
+        """
+    )
+    res = t.windowby(pw.this.t, window=pw.temporal.tumbling(duration=10)).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+        c=pw.reducers.count(),
+    )
+    expected = T(
+        """
+        start | s | c
+        0     | 6 | 3
+        10    | 4 | 1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_sliding_window_reduce():
+    t = T(
+        """
+        t | v
+        4 | 1
+        9 | 2
+        """
+    )
+    res = t.windowby(
+        pw.this.t, window=pw.temporal.sliding(hop=5, duration=10)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    # t=4 in windows starting 0, -5; t=9 in windows starting 0, 5
+    expected = T(
+        """
+        start | s
+        -5    | 1
+        0     | 3
+        5     | 2
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_tumbling_window_instance():
+    t = T(
+        """
+        k | t | v
+        a | 1 | 1
+        a | 2 | 2
+        b | 1 | 5
+        """
+    )
+    res = t.windowby(
+        pw.this.t, window=pw.temporal.tumbling(duration=10), instance=pw.this.k
+    ).reduce(
+        k=pw.this._pw_instance,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    expected = T(
+        """
+        k | s
+        a | 3
+        b | 5
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_session_window():
+    t = T(
+        """
+        t  | v
+        1  | 1
+        2  | 2
+        3  | 3
+        10 | 4
+        11 | 5
+        """
+    )
+    res = t.windowby(
+        pw.this.t, window=pw.temporal.session(max_gap=2)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    expected = T(
+        """
+        start | end | s
+        1     | 3   | 6
+        10    | 11  | 9
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_session_window_streaming_merge():
+    """Two sessions merge when a bridging row arrives later."""
+    t = T(
+        """
+        t  | v | __time__
+        1  | 1 | 2
+        5  | 2 | 2
+        3  | 9 | 4
+        """
+    )
+    res = t.windowby(
+        pw.this.t, window=pw.temporal.session(max_gap=2)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    expected = T(
+        """
+        start | s
+        1     | 12
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_windowby_window_tuple():
+    t = T(
+        """
+        t | v
+        1 | 1
+        """
+    )
+    res = t.windowby(pw.this.t, window=pw.temporal.tumbling(duration=4)).reduce(
+        w=pw.this._pw_window, c=pw.reducers.count()
+    )
+    _, cols = pw.debug.table_to_dicts(res)
+    assert list(cols["w"].values()) == [(0, 4)]
+
+
+def test_interval_join_inner():
+    l = T(
+        """
+        t | a
+        0 | 1
+        5 | 2
+        """
+    )
+    r = T(
+        """
+        t | b
+        1 | 10
+        4 | 20
+        9 | 30
+        """
+    )
+    res = l.interval_join(
+        r, l.t, r.t, pw.temporal.interval(-2, 2)
+    ).select(a=pw.left.a, b=pw.right.b)
+    expected = T(
+        """
+        a | b
+        1 | 10
+        2 | 20
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_interval_join_with_eq_condition():
+    l = T(
+        """
+        k | t | a
+        x | 0 | 1
+        y | 0 | 2
+        """
+    )
+    r = T(
+        """
+        k | t | b
+        x | 1 | 10
+        y | 3 | 20
+        """
+    )
+    res = l.interval_join(
+        r, l.t, r.t, pw.temporal.interval(0, 2), pw.left.k == pw.right.k
+    ).select(a=pw.left.a, b=pw.right.b)
+    expected = T(
+        """
+        a | b
+        1 | 10
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_interval_join_left():
+    l = T(
+        """
+        t | a
+        0 | 1
+        9 | 2
+        """
+    )
+    r = T(
+        """
+        t | b
+        1 | 10
+        """
+    )
+    res = l.interval_join_left(
+        r, l.t, r.t, pw.temporal.interval(-2, 2)
+    ).select(a=pw.left.a, b=pw.right.b)
+    expected = T(
+        """
+        a | b
+        1 | 10
+        2 | None
+        """
+    )
+    assert_table_equality_wo_index(res, expected, check_types=False)
+
+
+def test_window_join():
+    l = T(
+        """
+        t | a
+        1 | 1
+        6 | 2
+        """
+    )
+    r = T(
+        """
+        t | b
+        2 | 10
+        7 | 20
+        """
+    )
+    res = l.window_join(
+        r, l.t, r.t, pw.temporal.tumbling(duration=5)
+    ).select(a=pw.left.a, b=pw.right.b)
+    expected = T(
+        """
+        a | b
+        1 | 10
+        2 | 20
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_asof_join_backward():
+    trades = T(
+        """
+        t  | price
+        2  | 100
+        5  | 101
+        9  | 102
+        """
+    )
+    quotes = T(
+        """
+        t  | bid
+        1  | 99
+        4  | 100
+        8  | 101
+        """
+    )
+    res = trades.asof_join(quotes, trades.t, quotes.t).select(
+        price=pw.left.price, bid=pw.right.bid
+    )
+    expected = T(
+        """
+        price | bid
+        100   | 99
+        101   | 100
+        102   | 101
+        """
+    )
+    assert_table_equality_wo_index(res, expected, check_types=False)
+
+
+def test_asof_join_forward_and_unmatched():
+    l = T(
+        """
+        t | a
+        1 | 1
+        9 | 2
+        """
+    )
+    r = T(
+        """
+        t | b
+        5 | 50
+        """
+    )
+    res = l.asof_join(
+        r, l.t, r.t, direction=pw.temporal.Direction.FORWARD
+    ).select(a=pw.left.a, b=pw.right.b)
+    expected = T(
+        """
+        a | b
+        1 | 50
+        2 | None
+        """
+    )
+    assert_table_equality_wo_index(res, expected, check_types=False)
+
+
+def test_asof_join_incremental_update():
+    """A late right row re-matches existing left rows (retraction path)."""
+    l = T(
+        """
+        t | a
+        5 | 1
+        """
+    )
+    r = T(
+        """
+        t | b | __time__
+        1 | 10 | 2
+        4 | 40 | 6
+        """
+    )
+    res = l.asof_join(r, l.t, r.t).select(a=pw.left.a, b=pw.right.b)
+    expected = T(
+        """
+        a | b
+        1 | 40
+        """
+    )
+    assert_table_equality_wo_index(res, expected, check_types=False)
+
+
+def test_asof_now_join_does_not_retract():
+    queries = T(
+        """
+        q | __time__
+        1 | 2
+        2 | 6
+        """
+    )
+    state = T(
+        """
+        k | v | __time__
+        0 | 10 | 0
+        0 | 10 | 4
+        0 | 20 | 4
+        """,
+        split_on_whitespace=True,
+    )
+    # state: v=10 at t0; at t4 retract...? build explicitly with diffs
+    state = T(
+        """
+        k | v  | __time__ | __diff__
+        0 | 10 | 0        | 1
+        0 | 10 | 4        | -1
+        0 | 20 | 4        | 1
+        """
+    )
+    queries = queries.with_columns(k=0)
+    res = queries.asof_now_join(state, pw.left.k == pw.right.k).select(
+        q=pw.left.q, v=pw.right.v
+    )
+    # query 1 (t=2) saw v=10 and must NOT be retracted; query 2 (t=6) sees 20
+    expected = T(
+        """
+        q | v
+        1 | 10
+        2 | 20
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_exactly_once_behavior_single_emission():
+    t = T(
+        """
+        t | v | __time__
+        1 | 1 | 2
+        2 | 2 | 4
+        11 | 5 | 6
+        12 | 6 | 20
+        """
+    )
+    res = t.windowby(
+        pw.this.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.exactly_once_behavior(),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    (cap,) = GraphRunner().run_tables(res)
+    # window [0,10) closes at time>=10 → emitted once with both rows;
+    # window [10,20): row at t=11 buffered to time 20, late row t=12
+    # (arriving at 20) still within cutoff tick? it arrives exactly at
+    # release → included or dropped per cutoff; assert single emission per
+    # window (no retractions ever reach the output)
+    diffs = [d for (_, _, _, d) in cap.stream]
+    assert all(d == 1 for d in diffs), cap.stream
+    rows = {row[0]: row[1] for _, _, row, d in cap.stream}
+    assert rows[0] == 3
+
+
+def test_common_behavior_keep_results_false():
+    t = T(
+        """
+        t  | v | __time__
+        1  | 1 | 2
+        15 | 2 | 16
+        30 | 3 | 32
+        """
+    )
+    res = t.windowby(
+        pw.this.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.common_behavior(cutoff=2, keep_results=False),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    (cap,) = GraphRunner().run_tables(res)
+    final = {row[0]: row[1] for _, row in cap.state.iter_items()}
+    # windows [0,10) and [10,20) are past cutoff by the final time → dropped
+    assert final == {30: 3}, final
