@@ -78,16 +78,13 @@ class Type:
     BYTES = _dt.BYTES
 
 
-Pointer = int  # pointer typehint (engine keys are 64-bit ints)
+Pointer = _dt.Pointer  # pointer typehint (engine keys are 64-bit ints)
 DateTimeNaive = _dt.DATE_TIME_NAIVE
 DateTimeUtc = _dt.DATE_TIME_UTC
 Duration = _dt.DURATION
 
 
-def iterate(func, iteration_limit: int | None = None, **kwargs):
-    raise NotImplementedError(
-        "pw.iterate (fixpoint iteration) is not implemented yet in pathway_tpu"
-    )
+from .internals.iterate import iterate, iterate_universe  # noqa: E402
 
 
 def set_license_key(key: str | None) -> None:  # compatibility no-op
@@ -132,6 +129,7 @@ __all__ = [
     "indexing",
     "io",
     "iterate",
+    "iterate_universe",
     "join",
     "join_inner",
     "join_left",

@@ -99,6 +99,14 @@ STR = _SimpleDType("STR", object, str)
 BYTES = _SimpleDType("BYTES", object, bytes)
 # Pointers (row keys) are engine 64-bit hashes; see engine/keys.py.
 POINTER = _SimpleDType("POINTER", np.uint64, int)
+
+
+class Pointer(int):
+    """Typehint for pointer (row-key) columns — ``pw.Pointer[Any]`` in
+    schemas (reference ``internals/api.py`` Pointer)."""
+
+    def __class_getitem__(cls, item: Any) -> type:
+        return cls
 # datetimes/durations stored as int64 nanoseconds (epoch / delta).
 DATE_TIME_NAIVE = _SimpleDType("DATE_TIME_NAIVE", np.int64, datetime.datetime)
 DATE_TIME_UTC = _SimpleDType("DATE_TIME_UTC", np.int64, datetime.datetime)
@@ -251,6 +259,8 @@ def wrap(t: Any) -> DType:
     if origin in (list,):
         args = typing.get_args(t)
         return List(wrap(args[0]) if args else ANY)
+    if isinstance(t, type) and issubclass(t, Pointer):
+        return POINTER
     if t in _FROM_PY:
         return _FROM_PY[t]
     if isinstance(t, type) and issubclass(t, np.integer):

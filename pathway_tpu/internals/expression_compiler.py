@@ -76,6 +76,14 @@ class ColumnEnv:
             )
         return self._map[key]
 
+    def signature(self) -> frozenset:
+        """Identity of the binding environment — compile results are valid
+        for any env with the same bindings (used to reuse jitted kernels
+        across pw.iterate rounds instead of re-tracing every round)."""
+        return frozenset(
+            (k, v[0], str(v[1])) for k, v in self._map.items()
+        )
+
 
 @dataclass
 class Compiled:
@@ -110,6 +118,26 @@ def _reducer_dtype(expr: ReducerExpression, env: ColumnEnv) -> dt.DType:
 
 
 def compile_expr(expr: ColumnExpression, env: ColumnEnv) -> Compiled:
+    # memoize per (expression, bindings): pw.iterate re-lowers the same
+    # captured subgraph every fixpoint round — without this each round
+    # would rebuild closures and re-trace XLA kernels from scratch
+    cache: dict | None = getattr(expr, "_compiled_cache", None)
+    if cache is None:
+        cache = {}
+        try:
+            expr._compiled_cache = cache  # type: ignore[attr-defined]
+        except Exception:
+            cache = None
+    sig = env.signature() if cache is not None else None
+    if cache is not None and sig in cache:
+        return cache[sig]
+    result = _compile_expr_uncached(expr, env)
+    if cache is not None:
+        cache[sig] = result
+    return result
+
+
+def _compile_expr_uncached(expr: ColumnExpression, env: ColumnEnv) -> Compiled:
     np_fn, dtype, jax_ok, refs = _build(expr, env)
     if jax_ok and _jax_available():
         jitted = _make_jitted(expr, env)

@@ -205,8 +205,12 @@ class GraphRunner:
         if kind == "custom":
             # stdlib escape hatch: the table carries its own lowering function
             return p["lower"](self, table)
-        if kind == "iterate":
-            raise NotImplementedError("pw.iterate lowering not implemented yet")
+        if kind == "iter_pin":
+            raise RuntimeError(
+                "pw.iterate placeholder table used outside its iterate body "
+                f"(input {p.get('name')!r}) — tables created inside the "
+                "iterated function must not escape it"
+            )
         raise NotImplementedError(f"lowering for kind {kind!r}")
 
     # ------------------------------------------------------------------
@@ -340,7 +344,12 @@ class GraphRunner:
         # post projection: grouping refs -> gk{i}, hidden refs resolve directly
         post_env = ColumnEnv()
         for name, i in p["group_names"].items():
-            post_env.add(primary, name, f"gk{i}", primary.schema.columns()[name].dtype)
+            g = grouping[i]
+            src = g.table if isinstance(g, ColumnReference) and isinstance(g.table, Table) else primary
+            cs = src.schema.columns().get(name) if hasattr(src, "schema") else None
+            post_env.add(src, name, f"gk{i}", cs.dtype if cs is not None else dt.ANY)
+            if src is not primary:
+                post_env.add(primary, name, f"gk{i}", cs.dtype if cs is not None else dt.ANY)
         post = {}
         for name, e in p["outputs"].items():
             post[name] = compile_expr(e, post_env).fn

