@@ -851,7 +851,8 @@ def _as_column(arr: Any, n: int) -> np.ndarray:
             return np.asarray(arr)
     except Exception:
         pass
-    if np.isscalar(arr) or arr is None:
+    if np.isscalar(arr) or arr is None or isinstance(arr, (tuple, dict)):
+        # tuples are row *values* (constant per row), never column vectors
         return column_of_values([arr] * n)
     a = np.asarray(arr)
     if a.ndim == 1 and len(a) == n:

@@ -195,6 +195,24 @@ class TupleReducer(_MultisetReducer):
         return tuple(items)
 
 
+class TupleByReducer(_MultisetReducer):
+    """Tuple of values ordered by an explicit sort key (args: sort_key, value).
+    Backs rank-ordered collapse in the index repack path — the analog of the
+    reference's ``groupby(sort_by=...)`` + tuple reducer
+    (``stdlib/indexing/data_index.py:150-165``)."""
+
+    name = "tuple_by"
+
+    def _entry(self, values, row_key, time):
+        return ((_hashable(values[0]), row_key), _hashable(values[1]))
+
+    def extract(self, acc):
+        items = []
+        for (_sk, v), c in sorted(acc.items(), key=lambda kv: kv[0][0]):
+            items.extend([v] * c)
+        return tuple(items)
+
+
 class NdarrayReducer(TupleReducer):
     name = "ndarray"
 
@@ -286,6 +304,7 @@ REDUCERS: dict[str, type[ReducerImpl]] = {
     "any": AnyReducer,
     "sorted_tuple": SortedTupleReducer,
     "tuple": TupleReducer,
+    "tuple_by": TupleByReducer,
     "ndarray": NdarrayReducer,
     "earliest": EarliestReducer,
     "latest": LatestReducer,

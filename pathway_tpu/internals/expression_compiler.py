@@ -579,7 +579,12 @@ def _materialize(v: Any, n: int) -> np.ndarray:
     if isinstance(v, np.ndarray) and v.ndim == 1 and len(v) == n:
         return v
     out = np.empty(n, dtype=object)
-    out[:] = [v] * n if not isinstance(v, np.ndarray) else list(v)
+    if isinstance(v, np.ndarray):
+        out[:] = list(v)
+    else:
+        # fill() assigns the object per cell — slice-assigning tuple/list
+        # values would make numpy broadcast them as nested arrays
+        out.fill(v)
     return out
 
 

@@ -152,6 +152,8 @@ def _reducer_out_dtype(name: str, arg_ts: list[dt.DType]) -> dt.DType:
         return dt.POINTER
     if name in ("sorted_tuple", "tuple"):
         return dt.List(arg_ts[0] if arg_ts else dt.ANY)
+    if name == "tuple_by":
+        return dt.List(arg_ts[1] if len(arg_ts) > 1 else dt.ANY)
     if name == "ndarray":
         return dt.Array(1, arg_ts[0] if arg_ts else dt.FLOAT)
     if name == "stateful":

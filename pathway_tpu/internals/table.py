@@ -459,6 +459,13 @@ class Table(TableLike):
     def slice(self, *args, **kwargs):
         raise NotImplementedError("TableSlice is not implemented yet")
 
+    def sort(self, key: Any = None, instance: Any = None) -> "Table":
+        """``prev``/``next`` pointer columns ordering this table by ``key``
+        (reference table.py:2157, backed by prev_next.rs:770)."""
+        from ..stdlib.indexing.sorting import sort_from_index
+
+        return sort_from_index(self, key, instance)
+
     def windowby(self, time_expr: Any, *, window: Any, instance: Any = None, behavior: Any = None, **kwargs):
         from ..stdlib.temporal import windowby as _windowby
 

@@ -39,7 +39,8 @@ def topk_scores(
     """
     qb = queries.astype(jnp.bfloat16)
     ib = index.astype(jnp.bfloat16)
-    if metric == "cos":
+    if metric in ("cos", "ip"):
+        # cos assumes L2-normalized inputs; ip is the raw inner product
         scores = (qb @ ib.T).astype(jnp.float32)
     else:
         sq_i = (index.astype(jnp.float32) ** 2).sum(-1)

@@ -23,6 +23,7 @@ __all__ = [
     "any",
     "sorted_tuple",
     "tuple",
+    "tuple_by",
     "ndarray",
     "earliest",
     "latest",
@@ -78,6 +79,13 @@ def tuple(arg: Any, *, skip_nones: bool = False) -> ReducerExpression:
 
 def ndarray(arg: Any, *, skip_nones: bool = False) -> ReducerExpression:
     return ReducerExpression("ndarray", (arg,), skip_nones=skip_nones)
+
+
+def tuple_by(sort_key: Any, arg: Any) -> ReducerExpression:
+    """Tuple of ``arg`` values ordered ascending by ``sort_key`` (ties by
+    row key). Used by the indexing repack path; the reference spells this
+    ``groupby(sort_by=...)`` + ``reducers.tuple``."""
+    return ReducerExpression("tuple_by", (sort_key, arg))
 
 
 def earliest(arg: Any) -> ReducerExpression:

@@ -18,7 +18,6 @@ from typing import Any
 import numpy as np
 
 from ...internals import dtype as dt
-from ...internals.iterate import _IterateDescriptor  # engine recompute plumbing
 from ...internals.parse_graph import Universe
 from ...internals.schema import schema_from_types
 from ...internals.table import Table

@@ -1,2 +1,64 @@
-"""pw.indexing — KNN / BM25 / hybrid live indexes (reference
-python/pathway/stdlib/indexing). TPU-native XLA kernels live in ops/knn.py."""
+"""``pw.indexing`` — live KNN / BM25 / hybrid indexes and sortedness
+(reference ``python/pathway/stdlib/indexing``). The KNN scoring path runs
+as XLA kernels on the TPU MXU (``ops/knn.py``, ``ops/index_engines.py``)
+replacing the reference's native USearch/Tantivy integrations
+(``src/external_integration/``)."""
+
+from __future__ import annotations
+
+from .bm25 import BM25, TantivyBM25, TantivyBM25Factory
+from .data_index import DataIndex, InnerIndex, InnerIndexFactory
+from .full_text_document_index import default_full_text_document_index
+from .hybrid_index import HybridIndex, HybridIndexFactory
+from .nearest_neighbors import (
+    BruteForceKnn,
+    BruteForceKnnFactory,
+    BruteForceKnnMetricKind,
+    LshKnn,
+    LshKnnFactory,
+    USearchKnn,
+    USearchMetricKind,
+    UsearchKnnFactory,
+)
+from .retrievers import AbstractRetrieverFactory
+from .sorting import (
+    SortedIndex,
+    build_sorted_index,
+    retrieve_prev_next_values,
+    sort_from_index,
+)
+from .vector_document_index import (
+    default_brute_force_knn_document_index,
+    default_lsh_knn_document_index,
+    default_usearch_knn_document_index,
+    default_vector_document_index,
+)
+
+__all__ = [
+    "AbstractRetrieverFactory",
+    "DataIndex",
+    "InnerIndex",
+    "InnerIndexFactory",
+    "USearchKnn",
+    "UsearchKnnFactory",
+    "USearchMetricKind",
+    "BruteForceKnn",
+    "BruteForceKnnFactory",
+    "BruteForceKnnMetricKind",
+    "LshKnn",
+    "LshKnnFactory",
+    "TantivyBM25",
+    "TantivyBM25Factory",
+    "BM25",
+    "HybridIndex",
+    "HybridIndexFactory",
+    "SortedIndex",
+    "default_vector_document_index",
+    "default_lsh_knn_document_index",
+    "default_usearch_knn_document_index",
+    "default_brute_force_knn_document_index",
+    "default_full_text_document_index",
+    "retrieve_prev_next_values",
+    "sort_from_index",
+    "build_sorted_index",
+]

@@ -1,0 +1,221 @@
+"""Metadata filter expressions for index queries.
+
+The reference filters candidate documents with JMESPath boolean queries
+(``src/external_integration/mod.rs:373``, via the jmespath crate). That
+library isn't in this environment, so this module implements the subset the
+indexing/RAG surfaces actually use, compiled to a Python predicate over the
+metadata JSON dict:
+
+    path.to.field == 'value'      (also != < <= > >=; numbers via `123`)
+    contains(path, 'x')           starts_with / ends_with
+    globmatch('pat', path)        glob on string fields
+    expr && expr, expr || expr, !expr, parentheses
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from typing import Any, Callable
+
+__all__ = ["compile_metadata_filter", "FilterSyntaxError"]
+
+
+class FilterSyntaxError(ValueError):
+    pass
+
+
+_TOKEN = re.compile(
+    r"\s*(?:(?P<op>==|!=|<=|>=|<|>|&&|\|\||!|\(|\)|,)"
+    r"|(?P<str>'[^']*'|\"[^\"]*\")"
+    r"|(?P<tick>`[^`]*`)"
+    r"|(?P<num>-?\d+(?:\.\d+)?)"
+    r"|(?P<ident>[A-Za-z_][A-Za-z0-9_.]*))"
+)
+
+
+def _lex(src: str) -> list[tuple[str, str]]:
+    out, pos = [], 0
+    while pos < len(src):
+        m = _TOKEN.match(src, pos)
+        if m is None:
+            if src[pos:].strip() == "":
+                break
+            raise FilterSyntaxError(f"bad filter syntax at {src[pos:]!r}")
+        pos = m.end()
+        for kind in ("op", "str", "tick", "num", "ident"):
+            tok = m.group(kind)
+            if tok is not None:
+                out.append((kind, tok))
+                break
+    return out
+
+
+class _Parser:
+    """Recursive descent: or → and → unary → comparison/primary."""
+
+    def __init__(self, tokens: list[tuple[str, str]]):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else (None, None)
+
+    def take(self):
+        tok = self.peek()
+        self.i += 1
+        return tok
+
+    def expect(self, value: str):
+        kind, tok = self.take()
+        if tok != value:
+            raise FilterSyntaxError(f"expected {value!r}, got {tok!r}")
+
+    def parse(self):
+        node = self.or_expr()
+        if self.i != len(self.toks):
+            raise FilterSyntaxError(f"trailing tokens: {self.toks[self.i:]}")
+        return node
+
+    def or_expr(self):
+        node = self.and_expr()
+        while self.peek() == ("op", "||"):
+            self.take()
+            rhs = self.and_expr()
+            node = ("or", node, rhs)
+        return node
+
+    def and_expr(self):
+        node = self.unary()
+        while self.peek() == ("op", "&&"):
+            self.take()
+            rhs = self.unary()
+            node = ("and", node, rhs)
+        return node
+
+    def unary(self):
+        if self.peek() == ("op", "!"):
+            self.take()
+            return ("not", self.unary())
+        if self.peek() == ("op", "("):
+            self.take()
+            node = self.or_expr()
+            self.expect(")")
+            return self.maybe_comparison(node)
+        return self.comparison()
+
+    def value(self):
+        kind, tok = self.take()
+        if kind == "str":
+            return ("lit", tok[1:-1])
+        if kind == "num":
+            return ("lit", float(tok) if "." in tok else int(tok))
+        if kind == "tick":
+            import json
+
+            return ("lit", json.loads(tok[1:-1]))
+        if kind == "ident":
+            if tok in ("contains", "starts_with", "ends_with", "globmatch"):
+                if self.peek() == ("op", "("):
+                    self.take()
+                    a = self.value()
+                    self.expect(",")
+                    b = self.value()
+                    self.expect(")")
+                    return ("call", tok, a, b)
+            if tok == "true":
+                return ("lit", True)
+            if tok == "false":
+                return ("lit", False)
+            if tok == "null":
+                return ("lit", None)
+            return ("path", tok.split("."))
+        raise FilterSyntaxError(f"unexpected token {tok!r}")
+
+    def comparison(self):
+        return self.maybe_comparison(self.value())
+
+    def maybe_comparison(self, lhs):
+        kind, tok = self.peek()
+        if kind == "op" and tok in ("==", "!=", "<", "<=", ">", ">="):
+            self.take()
+            rhs = self.value()
+            return ("cmp", tok, lhs, rhs)
+        return lhs
+
+
+def _lookup(meta: Any, path: list[str]) -> Any:
+    cur = meta
+    for p in path:
+        if isinstance(cur, dict):
+            cur = cur.get(p)
+        else:
+            return None
+    return cur
+
+
+def _eval(node, meta: Any) -> Any:
+    tag = node[0]
+    if tag == "lit":
+        return node[1]
+    if tag == "path":
+        return _lookup(meta, node[1])
+    if tag == "and":
+        return bool(_eval(node[1], meta)) and bool(_eval(node[2], meta))
+    if tag == "or":
+        return bool(_eval(node[1], meta)) or bool(_eval(node[2], meta))
+    if tag == "not":
+        return not bool(_eval(node[1], meta))
+    if tag == "cmp":
+        op, l, r = node[1], _eval(node[2], meta), _eval(node[3], meta)
+        try:
+            if op == "==":
+                return l == r
+            if op == "!=":
+                return l != r
+            if l is None or r is None:
+                return False
+            if op == "<":
+                return l < r
+            if op == "<=":
+                return l <= r
+            if op == ">":
+                return l > r
+            if op == ">=":
+                return l >= r
+        except TypeError:
+            return False
+    if tag == "call":
+        fn = node[1]
+        a = _eval(node[2], meta)
+        b = _eval(node[3], meta)
+        if fn == "globmatch":
+            # jmespath-extension argument order: globmatch(pattern, field)
+            return isinstance(b, str) and isinstance(a, str) and fnmatch.fnmatch(b, a)
+        if not isinstance(a, str):
+            if fn == "contains" and isinstance(a, (list, tuple)):
+                return b in a
+            return False
+        b = "" if b is None else str(b)
+        if fn == "contains":
+            return b in a
+        if fn == "starts_with":
+            return a.startswith(b)
+        if fn == "ends_with":
+            return a.endswith(b)
+    raise FilterSyntaxError(f"cannot evaluate node {node!r}")
+
+
+def compile_metadata_filter(src: Any) -> Callable[[Any], bool] | None:
+    """Compile a filter string to a predicate over a metadata dict.
+    None (or None-valued cell) means "match everything"."""
+    if src is None:
+        return None
+    if callable(src):
+        return src
+    ast = _Parser(_lex(str(src))).parse()
+
+    def predicate(meta: Any) -> bool:
+        return bool(_eval(ast, meta if meta is not None else {}))
+
+    return predicate

@@ -68,13 +68,21 @@ def test_pagerank_sums_and_orders():
         u=edges_raw.pointer_from(edges_raw.un),
         v=edges_raw.pointer_from(edges_raw.vn),
     )
-    ranks = pagerank(edges, steps=10)
-    rows, _ = run_table(ranks)
-    vals = sorted(r[0] for r in rows.values())
-    assert len(vals) == 3
+    ranks = pagerank(edges, steps=30)
+    # tie ranks back to vertex names via the vertex pointer
+    uv = edges_raw.select(name=edges_raw.un, vid=edges_raw.pointer_from(edges_raw.un))
+    vv = edges_raw.select(name=edges_raw.vn, vid=edges_raw.pointer_from(edges_raw.vn))
+    verts = uv.concat_reindex(vv).groupby(pw.this.name).reduce(
+        pw.this.name, vid=pw.reducers.unique(pw.this.vid)
+    )
+    named = verts.join(ranks, verts.vid == pw.right.id).select(
+        verts.name, rank=pw.right.rank
+    )
+    rows, _ = run_table(named)
+    by_name = {r[0]: r[1] for r in rows.values()}
+    assert len(by_name) == 3
     # b receives from two vertices -> highest; c receives nothing -> lowest
-    assert vals[0] < vals[1] < vals[2] or vals[0] <= vals[1] <= vals[2]
-    assert all(isinstance(v, (int,)) or int(v) == v for v in vals)
+    assert by_name["b"] > by_name["a"] > by_name["c"]
 
 
 def test_louvain_two_cliques():
