@@ -72,8 +72,43 @@ class SourceNode(Node):
         return None
 
 
+class RealtimeSource(SourceNode):
+    """A live long-running source, polled by the streaming event loop.
+
+    The reference runs each connector on its own thread feeding a channel
+    drained by the worker loop's pollers (``src/connectors/mod.rs:427``,
+    ``dataflow.rs:5596-5650``); subclasses here do the same — a producer
+    thread fills an internal queue and ``poll()`` drains it.
+    """
+
+    def schedule(self) -> list[tuple[int, Delta]]:
+        return []
+
+    def start(self) -> None:
+        """Begin producing (spawn the reader thread)."""
+
+    def poll(self) -> list[Delta]:
+        """Drain everything produced since the last poll. Each returned
+        delta is committed at its own fresh timestamp (a commit tick)."""
+        return []
+
+    def is_finished(self) -> bool:
+        return False
+
+    def stop(self) -> None:
+        """Request shutdown (engine teardown)."""
+
+
 class Executor:
-    """Runs a DAG of Nodes to completion over all scheduled logical times."""
+    """Runs a DAG of Nodes over logical times.
+
+    Batch mode (finite source schedules) processes all scheduled times and
+    finishes; streaming mode (any RealtimeSource present) is the analog of
+    the reference per-worker event loop (``step_or_park`` + pollers +
+    flushers, dataflow.rs:5596-5650): poll sources, mint an even wall-clock
+    commit timestamp (timestamp.rs:22-28), run one topological sweep, park
+    briefly when idle.
+    """
 
     def __init__(self, nodes: list[Node]):
         # nodes must be in construction order == topological order
@@ -83,8 +118,16 @@ class Executor:
             for port, inp in enumerate(node.inputs):
                 self._consumers.setdefault(inp.node_id, []).append((node, port))
         self._on_time_end: list[Callable[[int], None]] = []
+        self._stop_requested = False
+
+    def request_stop(self) -> None:
+        self._stop_requested = True
 
     def run(self) -> None:
+        realtime = [n for n in self.nodes if isinstance(n, RealtimeSource)]
+        if realtime:
+            self._run_streaming(realtime)
+            return
         # Collect source schedules, merged by time (monotone processing order).
         pending: dict[int, list[tuple[SourceNode, Delta]]] = {}
         for node in self.nodes:
@@ -94,6 +137,49 @@ class Executor:
 
         for time in sorted(pending):
             self._tick(time, pending[time])
+        self._finish()
+
+    def _run_streaming(self, realtime: list[RealtimeSource]) -> None:
+        import time as _time
+
+        # finite schedules (static tables) land on the first ticks
+        pending: dict[int, list[tuple[SourceNode, Delta]]] = {}
+        for node in self.nodes:
+            if isinstance(node, SourceNode) and not isinstance(node, RealtimeSource):
+                for t, delta in node.schedule():
+                    pending.setdefault(int(t), []).append((node, delta))
+        clock = 0
+        for t in sorted(pending):
+            clock = max(clock + 2, int(t))
+            self._tick(clock, pending[t])
+
+        for src in realtime:
+            src.start()
+        try:
+            while not self._stop_requested:
+                # each commit batch of a source gets its own timestamp;
+                # batch j of every source shares round j's tick
+                rounds: list[list[tuple[SourceNode, Delta]]] = []
+                for src in realtime:
+                    for j, delta in enumerate(src.poll()):
+                        if delta is None or not len(delta):
+                            continue
+                        while len(rounds) <= j:
+                            rounds.append([])
+                        rounds[j].append((src, delta))
+                if rounds:
+                    for emissions in rounds:
+                        # even wall-clock ms, strictly increasing (timestamp.rs)
+                        wall = int(_time.time() * 1000) & ~1
+                        clock = max(clock + 2, wall)
+                        self._tick(clock, emissions)
+                elif all(src.is_finished() for src in realtime):
+                    break
+                else:
+                    _time.sleep(0.005)  # park (step_or_park's wait)
+        finally:
+            for src in realtime:
+                src.stop()
         self._finish()
 
     def _tick(self, time: int, source_emissions: list[tuple[SourceNode, Delta]]) -> None:

@@ -31,8 +31,13 @@ class GraphRunner:
     def __init__(self) -> None:
         self._cache: dict[int, Node] = {}
         self._nodes: list[Node] = []
+        self.executor: Executor | None = None
 
     # ------------------------------------------------------------------
+
+    def _execute(self) -> None:
+        self.executor = Executor(self._nodes)
+        self.executor.run()
 
     def run_tables(self, *tables: Table, include_sinks: bool = False):
         """Build + execute; return one Capture per requested table."""
@@ -40,13 +45,13 @@ class GraphRunner:
         if include_sinks:
             for sink in G.sinks:
                 self.lower_sink(sink)
-        Executor(self._nodes).run()
+        self._execute()
         return captures
 
     def run(self) -> None:
         for sink in G.sinks:
             self.lower_sink(sink)
-        Executor(self._nodes).run()
+        self._execute()
 
     def capture(self, table: Table) -> ops.Capture:
         node = self.lower(table)

@@ -2,9 +2,13 @@
 
 from __future__ import annotations
 
+import threading
 from typing import Any
 
 from .graph_runner import GraphRunner
+
+_current: dict[str, GraphRunner | None] = {"runner": None}
+_lock = threading.Lock()
 
 
 class MonitoringLevel:
@@ -25,8 +29,31 @@ def run(
     runtime_typechecking: bool | None = None,
     **kwargs: Any,
 ) -> None:
-    """Build and run the whole dataflow (all sinks registered so far)."""
-    GraphRunner().run()
+    """Build and run the whole dataflow (all sinks registered so far).
+    Blocks until all sources finish (streaming sources may run forever —
+    stop from another thread with ``request_stop()``)."""
+    runner = GraphRunner()
+    with _lock:
+        _current["runner"] = runner
+    try:
+        if persistence_config is not None:
+            from ..persistence import run_with_persistence
+
+            run_with_persistence(runner, persistence_config)
+        else:
+            runner.run()
+    finally:
+        with _lock:
+            _current["runner"] = None
+
+
+def request_stop() -> None:
+    """Ask the currently running streaming engine loop to wind down after
+    the in-flight tick (callable from any thread)."""
+    with _lock:
+        runner = _current["runner"]
+    if runner is not None and runner.executor is not None:
+        runner.executor.request_stop()
 
 
 def run_all(**kwargs: Any) -> None:

@@ -13,11 +13,12 @@ from typing import Any, Callable
 from ..internals.parse_graph import G
 from ..internals.table import Table
 
-from . import csv, fs, jsonlines, null, plaintext, python  # noqa: E402,F401
+from . import csv, fs, http, jsonlines, null, plaintext, python  # noqa: E402,F401
 
 __all__ = [
     "csv",
     "fs",
+    "http",
     "jsonlines",
     "plaintext",
     "python",

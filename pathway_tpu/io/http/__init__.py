@@ -1,0 +1,165 @@
+"""``pw.io.http`` — HTTP streaming client + REST server connector
+(reference ``python/pathway/io/http``)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time as _time
+from typing import Any, Callable, Sequence
+
+from ...internals.schema import SchemaMetaclass
+from ...internals.table import Table
+from ._server import PathwayWebserver, rest_connector
+
+__all__ = ["rest_connector", "PathwayWebserver", "read", "write", "RetryPolicy"]
+
+
+class RetryPolicy:
+    """Exponential backoff policy (reference io/http RetryPolicy surface)."""
+
+    def __init__(self, first_delay_ms: int = 1000, backoff_factor: float = 2.0,
+                 jitter_ms: int = 0, max_retries: int = 5):
+        self.first_delay_ms = first_delay_ms
+        self.backoff_factor = backoff_factor
+        self.jitter_ms = jitter_ms
+        self.max_retries = max_retries
+
+    @classmethod
+    def default(cls) -> "RetryPolicy":
+        return cls()
+
+
+def read(
+    url: str,
+    *,
+    schema: SchemaMetaclass | None = None,
+    method: str = "GET",
+    payload: Any = None,
+    headers: dict[str, str] | None = None,
+    response_mapper: Callable[[bytes], dict] | None = None,
+    format: str = "json",
+    delimiter: str | None = None,
+    n_retries: int = 0,
+    autocommit_duration_ms: int | None = 1000,
+    allow_redirects: bool = True,
+    retry_policy: RetryPolicy | None = None,
+    content_type: str = "application/json",
+) -> Table:
+    """Streaming HTTP read: long-poll ``url`` and emit one row per
+    JSON line / delimiter chunk (reference io/http streaming client)."""
+    import requests as _requests
+
+    from ..python import ConnectorSubject, read as python_read
+
+    if schema is None:
+        raise ValueError("schema is required")
+
+    policy = retry_policy or RetryPolicy.default()
+    attempts = max(1, n_retries + 1)
+    sep = delimiter.encode() if isinstance(delimiter, str) else delimiter
+
+    class _HttpSubject(ConnectorSubject):
+        def run(self) -> None:
+            delay = policy.first_delay_ms / 1000.0
+            for attempt in range(attempts):
+                try:
+                    resp = _requests.request(
+                        method, url, json=payload, headers=headers, stream=True,
+                        allow_redirects=allow_redirects, timeout=300,
+                    )
+                    resp.raise_for_status()
+                    for line in resp.iter_lines(delimiter=sep):
+                        if not line:
+                            continue
+                        if response_mapper is not None:
+                            row = response_mapper(line)
+                        elif format == "json":
+                            row = json.loads(line)
+                        else:
+                            row = {"data": line.decode()}
+                        if row is not None:
+                            self.next(**row)
+                    break
+                except Exception:
+                    if attempt == attempts - 1:
+                        raise
+                    _time.sleep(delay)
+                    delay *= policy.backoff_factor
+            self.close()
+
+    return python_read(
+        _HttpSubject(), schema=schema,
+        autocommit_duration_ms=autocommit_duration_ms,
+    )
+
+
+def write(
+    table: Table,
+    url: str,
+    *,
+    method: str = "POST",
+    format: str = "json",
+    request_payload_template: str | None = None,
+    n_retries: int = 0,
+    headers: dict[str, str] | None = None,
+    retry_policy: RetryPolicy | None = None,
+) -> None:
+    """POST one request per row change. Requests drain on a writer thread so
+    retries/backoff never stall the engine tick (the reference likewise runs
+    sink I/O off the worker loop)."""
+    import queue as _queue
+
+    import requests as _requests
+
+    from .. import subscribe
+    from ._server import _dumps
+
+    q: "_queue.Queue[Any]" = _queue.Queue()
+    _END = object()
+    failure: list[BaseException] = []
+
+    def drain():
+        while True:
+            body = q.get()
+            if body is _END:
+                return
+            attempts = max(1, n_retries + 1)
+            delay = (retry_policy.first_delay_ms / 1000.0) if retry_policy else 1.0
+            for i in range(attempts):
+                try:
+                    _requests.request(
+                        method, url, data=_dumps(body),
+                        headers={
+                            "Content-Type": "application/json",
+                            **(headers or {}),
+                        },
+                        timeout=30,
+                    ).raise_for_status()
+                    break
+                except Exception as e:
+                    if i == attempts - 1:
+                        failure.append(e)
+                        return
+                    _time.sleep(delay)
+                    if retry_policy:
+                        delay *= retry_policy.backoff_factor
+
+    worker = threading.Thread(target=drain, daemon=True)
+    worker.start()
+
+    def on_change(key, row, time, is_addition):
+        if failure:
+            raise RuntimeError("http.write sink failed") from failure[0]
+        body = dict(row)
+        body["diff"] = 1 if is_addition else -1
+        body["time"] = time
+        q.put(body)
+
+    def on_end():
+        q.put(_END)
+        worker.join(timeout=60)
+        if failure:
+            raise RuntimeError("http.write sink failed") from failure[0]
+
+    subscribe(table, on_change=on_change, on_end=on_end)

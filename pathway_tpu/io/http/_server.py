@@ -1,0 +1,253 @@
+"""``pw.io.http.rest_connector`` — HTTP requests as a streaming table.
+
+Re-design of the reference aiohttp server (``io/http/_server.py``:
+``PathwayWebserver`` :329, ``rest_connector`` :624): each HTTP request
+becomes a row of a query table keyed by a unique request key; the user
+pipeline computes a result row under the same key; the response writer sink
+completes the pending HTTP response when that row arrives. Request →
+dataflow → response over the streaming engine, exactly the reference's
+serve model (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import threading
+from typing import Any, Callable, Sequence
+
+from ...engine import keys as K
+from ...internals.json import Json
+from ...internals.schema import SchemaMetaclass, schema_from_types
+from ...internals.table import Table
+from ..python import ConnectorSubject, read as python_read
+
+__all__ = ["PathwayWebserver", "rest_connector", "terminate_all"]
+
+_live_webservers: list["PathwayWebserver"] = []
+
+
+def terminate_all() -> None:
+    """Stop every live webserver (test teardown helper; the reference tests
+    kill the whole process instead)."""
+    for ws in list(_live_webservers):
+        ws.terminate()
+    _live_webservers.clear()
+
+_request_counter = itertools.count(1)
+
+
+def _json_default(v: Any):
+    import numpy as np
+
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, Json):
+        return v.value
+    if isinstance(v, (set, tuple)):
+        return list(v)
+    return str(v)
+
+
+def _dumps(v: Any) -> str:
+    return json.dumps(v, default=_json_default)
+
+
+class PathwayWebserver:
+    """One aiohttp server shared by any number of rest_connector routes
+    (reference _server.py:329)."""
+
+    def __init__(self, host: str, port: int, with_cors: bool = False):
+        import aiohttp.web as web
+
+        self.host = host
+        self.port = port
+        self._web = web
+        self._app = web.Application()
+        self._routes: dict[tuple[str, str], Any] = {}
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._runner = None
+        self._started = threading.Event()
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _add_route(self, route: str, methods: Sequence[str], handler) -> None:
+        for m in methods:
+            self._app.router.add_route(m, route, handler)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    def _serve(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def main():
+            self._runner = self._web.AppRunner(self._app)
+            await self._runner.setup()
+            site = self._web.TCPSite(self._runner, self.host, self.port)
+            await site.start()
+            self._started.set()
+            while not self._stopped.is_set():
+                await asyncio.sleep(0.05)
+            await self._runner.cleanup()
+
+        try:
+            self._loop.run_until_complete(main())
+        finally:
+            self._started.set()
+            self._loop.close()
+
+    def terminate(self) -> None:
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class _RestSubject(ConnectorSubject):
+    """Bridges HTTP handlers to the engine queue; keeps pending futures by
+    request key."""
+
+    def __init__(
+        self,
+        webserver: PathwayWebserver,
+        route: str,
+        methods: Sequence[str],
+        schema: SchemaMetaclass,
+        delete_completed_queries: bool,
+        request_validator: Callable | None,
+    ):
+        super().__init__()
+        self.webserver = webserver
+        self.schema = schema
+        self.delete_completed_queries = delete_completed_queries
+        self.request_validator = request_validator
+        self._futures: dict[int, asyncio.Future] = {}
+        self._rows: dict[int, dict[str, Any]] = {}
+        self._names = schema.column_names()
+        webserver._add_route(route, methods, self._handle)
+
+    async def _handle(self, request):
+        web = self.webserver._web
+        if request.method in ("POST", "PUT", "PATCH"):
+            try:
+                payload = await request.json()
+            except Exception:
+                payload = {}
+        else:
+            payload = dict(request.query)
+        if self.request_validator is not None:
+            try:
+                issue = self.request_validator(payload)
+                if issue is not None:
+                    raise ValueError(str(issue))
+            except Exception as e:
+                return web.json_response({"error": str(e)}, status=400)
+        row = {}
+        for n, cs in self.schema.columns().items():
+            if n in payload:
+                v = payload[n]
+                if isinstance(v, (dict, list)):
+                    v = Json(v)
+                row[n] = v
+            elif cs.has_default:
+                row[n] = cs.default_value
+            else:
+                return web.json_response(
+                    {"error": f"missing field {n!r}"}, status=400
+                )
+        key = int(K.ref_scalar(next(_request_counter), salt=0x9E57))
+        fut = asyncio.get_event_loop().create_future()
+        self._futures[key] = fut
+        if self.delete_completed_queries:
+            self._rows[key] = row  # kept only for the later retraction
+        self._next_with_key(key, **row)
+        self.commit()
+        try:
+            result = await asyncio.wait_for(fut, timeout=120)
+        except asyncio.TimeoutError:
+            self._futures.pop(key, None)
+            return web.json_response({"error": "timeout"}, status=504)
+        if isinstance(result, Json):
+            result = result.value
+        return web.json_response(result, dumps=_dumps)
+
+    def _complete(self, key: int, value: Any) -> None:
+        """Called from the engine thread by the response writer sink."""
+        fut = self._futures.pop(key, None)
+        if fut is not None and not fut.done():
+            loop = self.webserver._loop
+            if loop is not None and loop.is_running():
+                loop.call_soon_threadsafe(
+                    lambda: None if fut.done() else fut.set_result(value)
+                )
+        # retract the query even when the HTTP side already timed out —
+        # otherwise timed-out queries pile up in the live table forever
+        if self.delete_completed_queries:
+            row = self._rows.pop(key, None)
+            if row is not None:
+                self._next_with_key(key, diff=-1, **row)
+                self.commit()
+
+    def run(self) -> None:
+        self.webserver.start()
+        # the reader thread just waits for server shutdown
+        self.webserver._stopped.wait()
+        self.close()
+
+
+def rest_connector(
+    host: str | None = None,
+    port: int | None = None,
+    *,
+    webserver: PathwayWebserver | None = None,
+    route: str = "/",
+    schema: SchemaMetaclass | None = None,
+    methods: Sequence[str] = ("POST",),
+    autocommit_duration_ms: int | None = 50,
+    keep_queries: bool | None = None,
+    delete_completed_queries: bool = False,
+    request_validator: Callable | None = None,
+) -> tuple[Table, Callable[[Table], None]]:
+    """HTTP endpoint as a (query_table, response_writer) pair
+    (reference io/http/_server.py:624)."""
+    if webserver is None:
+        if host is None or port is None:
+            raise ValueError("pass host+port or a PathwayWebserver")
+        webserver = PathwayWebserver(host, port)
+    if webserver not in _live_webservers:
+        _live_webservers.append(webserver)
+    if schema is None:
+        schema = schema_from_types(query=str, user=str)
+    if keep_queries is not None:
+        delete_completed_queries = not keep_queries
+
+    subject = _RestSubject(
+        webserver, route, methods, schema, delete_completed_queries,
+        request_validator,
+    )
+    table = python_read(
+        subject, schema=schema, autocommit_duration_ms=autocommit_duration_ms
+    )
+
+    def response_writer(result_table: Table) -> None:
+        from .. import subscribe
+
+        cols = result_table.column_names()
+
+        def on_change(key, row, time, is_addition):
+            if not is_addition:
+                return
+            value = row.get("result") if "result" in cols else row
+            subject._complete(int(key), value)
+
+        subscribe(result_table, on_change=on_change)
+
+    return table, response_writer

@@ -1,21 +1,39 @@
 """``pw.io.python`` — custom python sources (ConnectorSubject).
 
 Re-design of ``python/pathway/io/python/__init__.py:349`` (ConnectorSubject)
-+ the Rust ``PythonReader`` (data_storage.rs:835). The subject's ``run()``
-emits rows via ``next``/``next_json``/``next_str``; ``commit()`` closes a
-logical-time batch (the reference's commit ticks, connectors/mod.rs:205).
-Finite subjects are drained into a timestamped schedule; each commit maps to
-one engine timestamp.
++ the Rust ``PythonReader`` (``src/connectors/data_storage.rs:835``). The
+subject's ``run()`` executes on a dedicated reader thread (exactly the
+reference's connector-thread model, ``src/connectors/mod.rs:427``), emitting
+rows via ``next``/``next_json``/``next_str`` into a queue; ``commit()``
+closes a logical-time batch. The engine's streaming event loop polls the
+queue and mints one commit timestamp per batch
+(``engine/executor.RealtimeSource``).
 """
 
 from __future__ import annotations
 
 import json
+import queue
+import threading
+import time as _time
 from typing import Any
 
+import numpy as np
+
+from ..engine import keys as K
+from ..engine.delta import Delta, rows_to_columns
+from ..engine.executor import RealtimeSource
+from ..internals.parse_graph import Universe
 from ..internals.schema import SchemaMetaclass
 from ..internals.table import Table
-from ..internals.table_io import rows_to_table
+
+_COMMIT = object()
+_DONE = object()
+
+
+class _SourceError:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
 
 
 class ConnectorSubject:
@@ -23,13 +41,12 @@ class ConnectorSubject:
     and optionally ``self.commit()`` to close a batch."""
 
     def __init__(self, datasource_name: str = "python"):
-        self._buffer: list[tuple[int, dict[str, Any]]] = []
-        self._time = 2
+        self._queue: "queue.Queue[Any]" = queue.Queue()
 
     # -- emission API (reference io/python: next_json / next_str / next) --
 
     def next(self, **kwargs: Any) -> None:
-        self._buffer.append((self._time, kwargs))
+        self._queue.put(("row", 1, kwargs, None))
 
     def next_json(self, message: dict | str) -> None:
         if isinstance(message, str):
@@ -42,11 +59,19 @@ class ConnectorSubject:
     def next_bytes(self, message: bytes) -> None:
         self.next(data=message)
 
+    def _remove(self, **kwargs: Any) -> None:
+        """Retract a previously emitted row (matched by content)."""
+        self._queue.put(("row", -1, kwargs, None))
+
+    def _next_with_key(self, key: int, diff: int = 1, **kwargs: Any) -> None:
+        """Emit a row under an explicit engine key (rest_connector plumbing)."""
+        self._queue.put(("row", diff, kwargs, key))
+
     def commit(self) -> None:
-        self._time += 2
+        self._queue.put(_COMMIT)
 
     def close(self) -> None:
-        pass
+        self._queue.put(_DONE)
 
     def on_stop(self) -> None:
         pass
@@ -55,37 +80,129 @@ class ConnectorSubject:
         raise NotImplementedError
 
     def start(self) -> None:
-        self.run()
-        self.on_stop()
+        try:
+            self.run()
+        except BaseException as e:  # surfaced by the engine loop, not lost
+            self._queue.put(_SourceError(e))
+        finally:
+            self.on_stop()
+            self._queue.put(_DONE)
+
+
+class PythonSubjectSource(RealtimeSource):
+    """Engine source draining a ConnectorSubject's queue
+    (the PythonReader analog)."""
+
+    def __init__(
+        self,
+        subject: ConnectorSubject,
+        names: list[str],
+        defaults: dict[str, Any],
+        pk_indices: list[int] | None,
+        autocommit_ms: int | None,
+    ):
+        super().__init__(names)
+        self.subject = subject
+        self.names = names
+        self.defaults = defaults
+        self.pk_indices = pk_indices
+        self.autocommit_ms = autocommit_ms
+        self._partial: list[tuple[int, tuple, int | None]] = []  # (diff, row, key)
+        self._last_flush = _time.monotonic()
+        self._done = False
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.subject.start, daemon=True)
+        self._thread.start()
+
+    def _row_tuple(self, fields: dict[str, Any]) -> tuple:
+        row = []
+        for n in self.names:
+            if n in fields:
+                row.append(fields[n])
+            elif n in self.defaults:
+                row.append(self.defaults[n])
+            else:
+                row.append(None)
+        return tuple(row)
+
+    def _make_delta(self, entries: list[tuple[int, tuple, int | None]]) -> Delta:
+        rows = [r for _, r, _ in entries]
+        diffs = np.array([d for d, _, _ in entries], dtype=np.int64)
+        if self.pk_indices is not None:
+            pk_rows = [tuple(r[i] for i in self.pk_indices) for r in rows]
+            keys = K.hash_values(pk_rows)
+        else:
+            keys = K.hash_values(rows)
+        for i, (_, _, explicit) in enumerate(entries):
+            if explicit is not None:
+                keys[i] = explicit
+        return Delta(keys=keys, data=rows_to_columns(rows, self.names), diffs=diffs)
+
+    def poll(self) -> list[Delta]:
+        out: list[Delta] = []
+        while True:
+            try:
+                item = self.subject._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is _DONE:
+                self._done = True
+                break
+            if isinstance(item, _SourceError):
+                # re-raise on the engine thread (reference: connector errors
+                # poison the run, dataflow.rs:5674 panic propagation)
+                raise RuntimeError(
+                    f"connector source {type(self.subject).__name__} failed"
+                ) from item.exc
+            if item is _COMMIT:
+                if self._partial:
+                    out.append(self._make_delta(self._partial))
+                    self._partial = []
+                self._last_flush = _time.monotonic()
+                continue
+            _tag, diff, fields, key = item
+            self._partial.append((diff, self._row_tuple(fields), key))
+        now = _time.monotonic()
+        flush_due = (
+            self.autocommit_ms is not None
+            and (now - self._last_flush) * 1000.0 >= self.autocommit_ms
+        )
+        if self._partial and (self._done or flush_due):
+            out.append(self._make_delta(self._partial))
+            self._partial = []
+            self._last_flush = now
+        return out
+
+    def is_finished(self) -> bool:
+        return self._done and not self._partial and self.subject._queue.empty()
+
+    def stop(self) -> None:
+        pass
 
 
 def read(
     subject: ConnectorSubject,
     *,
     schema: SchemaMetaclass,
-    autocommit_duration_ms: int | None = 1500,
+    autocommit_duration_ms: int | None = 100,
     name: str | None = None,
     **kwargs: Any,
 ) -> Table:
-    subject.start()
     names = schema.column_names()
     defaults = {
         n: c.default_value for n, c in schema.columns().items() if c.has_default
     }
-    rows: list[tuple] = []
-    times: list[int] = []
-    for t, fields in subject._buffer:
-        row = []
-        for n in names:
-            if n in fields:
-                row.append(fields[n])
-            elif n in defaults:
-                row.append(defaults[n])
-            else:
-                row.append(None)
-        rows.append(tuple(row))
-        times.append(t)
-    return rows_to_table(names, rows, schema=schema, times=times)
+    pk = schema.primary_key_columns()
+    pk_indices = [names.index(p) for p in pk] if pk else None
+
+    def build():
+        return PythonSubjectSource(
+            subject, names, defaults, pk_indices, autocommit_duration_ms
+        )
+
+    return Table("source", [], {"build": build}, schema, Universe())
 
 
 write = None  # python connector is read-only (reference parity)
