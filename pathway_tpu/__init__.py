@@ -31,7 +31,7 @@ from .internals.expression import (
 )
 from .internals.json import Json
 from .internals.parse_graph import G, Universe
-from .internals.run import MonitoringLevel, run, run_all
+from .internals.run import MonitoringLevel, request_stop, run, run_all
 from .internals.schema import (
     Schema,
     assert_table_has_schema,
@@ -143,6 +143,7 @@ __all__ = [
     "reducers",
     "require",
     "right",
+    "request_stop",
     "run",
     "run_all",
     "schema_builder",

@@ -81,6 +81,10 @@ class RealtimeSource(SourceNode):
     thread fills an internal queue and ``poll()`` drains it.
     """
 
+    #: stable id used by persistence to snapshot/replay this source's input
+    #: (reference `persistent_id` / unique_name, src/connectors/mod.rs)
+    persistent_id: str | None = None
+
     def schedule(self) -> list[tuple[int, Delta]]:
         return []
 
@@ -98,6 +102,16 @@ class RealtimeSource(SourceNode):
     def stop(self) -> None:
         """Request shutdown (engine teardown)."""
 
+    # -- persistence protocol (reference OffsetAntichain, connectors/offset.rs)
+
+    def offset_state(self):
+        """JSON-serializable resume position covering everything emitted by
+        `poll` so far. None = non-replayable (snapshot replay only)."""
+        return None
+
+    def seek(self, state) -> None:
+        """Skip input already covered by `state` (recovery restart)."""
+
 
 class Executor:
     """Runs a DAG of Nodes over logical times.
@@ -110,7 +124,7 @@ class Executor:
     briefly when idle.
     """
 
-    def __init__(self, nodes: list[Node]):
+    def __init__(self, nodes: list[Node], persistence: Any = None):
         # nodes must be in construction order == topological order
         self.nodes = sorted(nodes, key=lambda n: n.node_id)
         self._consumers: dict[int, list[tuple[Node, int]]] = {}
@@ -119,6 +133,8 @@ class Executor:
                 self._consumers.setdefault(inp.node_id, []).append((node, port))
         self._on_time_end: list[Callable[[int], None]] = []
         self._stop_requested = False
+        self.persistence = persistence
+        self._last_clock = 0
 
     def request_stop(self) -> None:
         self._stop_requested = True
@@ -153,6 +169,9 @@ class Executor:
             clock = max(clock + 2, int(t))
             self._tick(clock, pending[t])
 
+        if self.persistence is not None:
+            clock = max(clock, self._recover(realtime))
+
         for src in realtime:
             src.start()
         try:
@@ -182,11 +201,60 @@ class Executor:
                 src.stop()
         self._finish()
 
+    def _recover(self, realtime: list[RealtimeSource]) -> int:
+        """Replay the input snapshot through the dataflow (rebuilding all
+        operator state deterministically), seek sources past persisted
+        offsets, then start recording live input. Returns the last replayed
+        time (the clock floor)."""
+        for i, src in enumerate(realtime):
+            if src.persistent_id is None:
+                src.persistent_id = f"src-{i}"
+        by_pid = {src.persistent_id: src for src in realtime}
+        clock = 0
+        # group persisted entries by time (commit order is time-ordered)
+        current_t: int | None = None
+        emissions: list[tuple[SourceNode, Delta]] = []
+        for t, pid, delta in self.persistence.replay_batches():
+            src = by_pid.get(pid)
+            if src is None:
+                raise RuntimeError(
+                    f"persisted state references source {pid!r} which is not "
+                    "present in this program — the dataflow changed since the "
+                    "snapshot was taken (give sources stable name= ids, or "
+                    "clear the persistence backend)"
+                )
+            if list(delta.columns) != list(src.column_names):
+                raise RuntimeError(
+                    f"persisted snapshot for source {pid!r} has columns "
+                    f"{list(delta.columns)} but the source now produces "
+                    f"{list(src.column_names)} — refusing to replay "
+                    "mismatched state (did unnamed sources get reordered?)"
+                )
+            if current_t is not None and t != current_t and emissions:
+                self._tick(current_t, emissions)
+                clock = max(clock, current_t)
+                emissions = []
+            current_t = t
+            emissions.append((src, delta))
+        if emissions and current_t is not None:
+            self._tick(current_t, emissions)
+            clock = max(clock, current_t)
+        for src in realtime:
+            state = self.persistence.offset_for(src.persistent_id)
+            if state is not None:
+                src.seek(state)
+        self.persistence.begin_recording(realtime)
+        return clock
+
     def _tick(self, time: int, source_emissions: list[tuple[SourceNode, Delta]]) -> None:
         inbox: dict[int, dict[int, list[Delta]]] = {}
         seeded: dict[int, list[Delta]] = {}
         for src, delta in source_emissions:
             seeded.setdefault(src.node_id, []).append(delta)
+            if self.persistence is not None and isinstance(src, RealtimeSource):
+                if src.persistent_id is not None:
+                    self.persistence.record(time, src.persistent_id, delta)
+        self._last_clock = max(self._last_clock, time) if time != END_TIME else self._last_clock
         for node in self.nodes:
             out_parts: list[Delta] = []
             released = node.advance_to(time)
@@ -211,6 +279,8 @@ class Executor:
                 self._route(node, emitted, inbox)
         for cb in self._on_time_end:
             cb(time)
+        if self.persistence is not None and time != END_TIME:
+            self.persistence.on_time_end(time)
 
     def _route(
         self, node: Node, delta: Delta, inbox: dict[int, dict[int, list[Delta]]]
@@ -241,3 +311,5 @@ class Executor:
                 self._route(node, emitted, inbox)
         for cb in self._on_time_end:
             cb(END_TIME)
+        if self.persistence is not None:
+            self.persistence.commit(self._last_clock)

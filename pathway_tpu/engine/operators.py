@@ -805,16 +805,22 @@ class Subscribe(Node):
         on_change: Callable[..., None] | None = None,
         on_time_end: Callable[[int], None] | None = None,
         on_end: Callable[[], None] | None = None,
+        skip_until: int = -1,
     ):
         super().__init__([inp], inp.column_names)
         self._on_change = on_change
         self._on_time_end = on_time_end
         self._had_data_at: int | None = None
         self._on_end_cb = on_end
+        # suppress re-emission of already-persisted times on recovery
+        # (reference io.subscribe skip_persisted_batch)
+        self._skip_until = skip_until
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d = ins[0]
         if d is None or not len(d):
+            return None
+        if time <= self._skip_until:
             return None
         d = d.consolidated()
         if self._on_change is not None:

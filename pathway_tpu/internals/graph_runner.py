@@ -32,11 +32,12 @@ class GraphRunner:
         self._cache: dict[int, Node] = {}
         self._nodes: list[Node] = []
         self.executor: Executor | None = None
+        self.persistence: Any = None  # PersistenceManager when pw.run has one
 
     # ------------------------------------------------------------------
 
     def _execute(self) -> None:
-        self.executor = Executor(self._nodes)
+        self.executor = Executor(self._nodes, persistence=self.persistence)
         self.executor.run()
 
     def run_tables(self, *tables: Table, include_sinks: bool = False):
@@ -63,11 +64,15 @@ class GraphRunner:
         kind = sink["kind"]
         if kind == "subscribe":
             node = self.lower(sink["table"])
+            skip_until = -1
+            if self.persistence is not None and sink.get("skip_persisted_batch", True):
+                skip_until = self.persistence.last_time
             sub = ops.Subscribe(
                 node,
                 on_change=sink.get("on_change"),
                 on_time_end=sink.get("on_time_end"),
                 on_end=sink.get("on_end"),
+                skip_until=skip_until,
             )
             self._nodes.append(sub)
         elif kind == "callable":

@@ -50,4 +50,5 @@ def subscribe(
         "on_change": on_change,
         "on_time_end": on_time_end,
         "on_end": on_end,
+        "skip_persisted_batch": skip_persisted_batch,
     })

@@ -14,6 +14,7 @@ import json
 import os
 from typing import Any
 
+from ..engine.executor import RealtimeSource
 from ..internals import dtype as dt
 from ..internals.parse_graph import G
 from ..internals.schema import SchemaMetaclass, schema_from_types
@@ -46,6 +47,163 @@ def _convert(value: str, dtype: dt.DType) -> Any:
     return value
 
 
+class FsStreamSource(RealtimeSource):
+    """Directory/glob watcher: polls for new files and appended lines,
+    emitting one committed batch per poll round.
+
+    Re-design of the Rust posix scanner + parser thread
+    (``src/connectors/posix_like.rs``, ``scanner/filesystem``): offsets are
+    (path → bytes consumed), which is this source's ``OffsetAntichain``
+    (``src/connectors/offset.rs``) for persistence seek/resume. Each poll
+    reads only the appended tail (stat + seek), never the whole file; a
+    shrunk file (truncate/rotate) resets its offset and is re-read.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        format: str,
+        schema: SchemaMetaclass | None,
+        names: list[str],
+        delimiter: str = ",",
+        autocommit_ms: int | None = 1500,
+    ):
+        super().__init__(list(names))
+        self.path = path
+        self.format = format
+        self.fschema = schema
+        self.names = list(names)
+        self.delimiter = delimiter
+        self.autocommit_ms = autocommit_ms
+        #: bytes actually delivered to the engine (the persisted offset);
+        #: bytes parsed into _pending but not yet emitted stay in _staged so
+        #: a checkpoint never covers input the snapshot doesn't contain
+        self._consumed: dict[str, int] = {}
+        self._staged: dict[str, int] = {}
+        self._headers: dict[str, list[str]] = {}
+        self._pending: list[tuple] = []
+        self._last_emit: float | None = None  # None = emit first batch now
+
+    # -- persistence protocol --
+
+    def offset_state(self):
+        return {"files": dict(self._consumed)}
+
+    def seek(self, state) -> None:
+        self._consumed = {str(k): int(v) for k, v in state.get("files", {}).items()}
+        self._staged = {}
+        self._pending = []
+        # headers live before the persisted offsets — recover them
+        for fpath in list(self._consumed):
+            self._load_header(fpath)
+
+    # -- polling --
+
+    def _load_header(self, fpath: str) -> bool:
+        if self.format not in ("csv", "dsv") or fpath in self._headers:
+            return True
+        try:
+            with open(fpath, "rb") as f:
+                first = f.readline()
+        except OSError:
+            return False
+        if not first.endswith(b"\n"):
+            return False  # header not fully written yet
+        self._headers[fpath] = next(
+            _csv.reader([first.decode("utf-8").rstrip("\r\n")],
+                        delimiter=self.delimiter)
+        )
+        # a fresh file starts past its header line
+        if self._consumed.get(fpath, 0) < len(first):
+            self._consumed[fpath] = len(first)
+        return True
+
+    def _parse_line(self, fpath: str, line: str):
+        if self.format in ("csv", "dsv"):
+            header = self._headers[fpath]
+            rec = dict(zip(header, next(_csv.reader([line], delimiter=self.delimiter))))
+            if self.fschema is not None:
+                return tuple(
+                    _convert(rec.get(n, ""), self.fschema.columns()[n].dtype)
+                    for n in self.names
+                )
+            return tuple(_auto(rec.get(n, "")) for n in self.names)
+        if self.format in ("json", "jsonlines"):
+            obj = json.loads(line)
+            return tuple(obj.get(n) for n in self.names)
+        return (line,)  # plaintext
+
+    def _scan(self) -> None:
+        """Read appended tails of all watched files into _pending."""
+        for fpath in _paths_of(self.path):
+            if not os.path.isfile(fpath):
+                continue
+            try:
+                size = os.stat(fpath).st_size
+            except OSError:
+                continue
+            start = self._staged.get(fpath, self._consumed.get(fpath, 0))
+            if size < start:
+                # truncated/rotated — re-read from scratch
+                self._consumed.pop(fpath, None)
+                self._staged.pop(fpath, None)
+                self._headers.pop(fpath, None)
+                start = 0
+            if not self._load_header(fpath):
+                continue
+            start = max(start, self._consumed.get(fpath, 0))
+            if size <= start:
+                continue
+            try:
+                with open(fpath, "rb") as f:
+                    f.seek(start)
+                    chunk = f.read()
+            except OSError:
+                continue
+            # only consume complete (newline-terminated) lines; a partial
+            # tail stays for the next poll
+            end = chunk.rfind(b"\n")
+            if end < 0:
+                continue
+            for line in chunk[:end].decode("utf-8").split("\n"):
+                line = line.rstrip("\r")
+                if line.strip():
+                    self._pending.append(self._parse_line(fpath, line))
+            self._staged[fpath] = start + end + 1
+
+    def poll(self):
+        import time as _time
+
+        from ..engine import keys as K
+        from ..engine.delta import Delta, rows_to_columns
+
+        self._scan()
+        if not self._pending:
+            return []
+        now = _time.monotonic()
+        window_open = (
+            self._last_emit is None
+            or self.autocommit_ms is None
+            or (now - self._last_emit) * 1000.0 >= self.autocommit_ms
+        )
+        if not window_open:
+            return []
+        rows, self._pending = self._pending, []
+        self._consumed.update(self._staged)  # rows now delivered → offset moves
+        self._staged.clear()
+        self._last_emit = now
+        if self.fschema is not None and self.fschema.primary_key_columns():
+            pk = self.fschema.primary_key_columns()
+            idx = [self.names.index(p) for p in pk]
+            keys = K.hash_values([tuple(r[i] for i in idx) for r in rows])
+        else:
+            keys = K.hash_values(rows)
+        return [Delta(keys=keys, data=rows_to_columns(rows, self.names))]
+
+    def is_finished(self) -> bool:
+        return False  # watches forever (stop via pw.request_stop)
+
+
 def read(
     path: str | os.PathLike,
     *,
@@ -59,6 +217,37 @@ def read(
     name: str | None = None,
     **kwargs: Any,
 ) -> Table:
+    if mode == "streaming" and format in ("csv", "dsv", "json", "jsonlines", "plaintext"):
+        from ..internals.parse_graph import Universe
+
+        spath = os.fspath(path)
+        delimiter = getattr(csv_settings, "delimiter", ",") if csv_settings else ","
+        if format in ("plaintext",):
+            schema = schema or schema_from_types(data=str)
+        if schema is not None:
+            names = schema.column_names()
+        else:
+            # sniff columns from whatever exists now
+            probe = read(spath, format=format, schema=None, mode="static",
+                         csv_settings=csv_settings)
+            names = probe.column_names()
+            schema = probe.schema
+            if not names:
+                raise ValueError(
+                    f"pw.io.fs.read({spath!r}, mode='streaming'): no files to "
+                    "infer columns from yet — pass schema= explicitly"
+                )
+        use_schema = schema
+
+        def build():
+            src = FsStreamSource(
+                spath, format, use_schema, names, delimiter,
+                autocommit_ms=autocommit_duration_ms,
+            )
+            src.persistent_id = name
+            return src
+
+        return Table("source", [], {"build": build}, use_schema, Universe())
     rows: list[tuple] = []
     names: list[str]
     if format in ("csv", "dsv"):

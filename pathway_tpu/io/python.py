@@ -111,6 +111,8 @@ class PythonSubjectSource(RealtimeSource):
         self._last_flush = _time.monotonic()
         self._done = False
         self._thread: threading.Thread | None = None
+        self._emitted = 0  # rows delivered to the engine (offset state)
+        self._skip = 0  # rows to drop after a recovery seek
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self.subject.start, daemon=True)
@@ -128,6 +130,10 @@ class PythonSubjectSource(RealtimeSource):
         return tuple(row)
 
     def _make_delta(self, entries: list[tuple[int, tuple, int | None]]) -> Delta:
+        # the offset covers exactly the rows delivered to the engine as
+        # deltas — never rows still sitting in _partial, which would be
+        # lost on recovery (persisted offset past unsnapshotted input)
+        self._emitted += len(entries)
         rows = [r for _, r, _ in entries]
         diffs = np.array([d for d, _, _ in entries], dtype=np.int64)
         if self.pk_indices is not None:
@@ -163,6 +169,12 @@ class PythonSubjectSource(RealtimeSource):
                 self._last_flush = _time.monotonic()
                 continue
             _tag, diff, fields, key = item
+            if self._skip > 0:
+                # already persisted before restart; the restarted subject
+                # re-emits its deterministic prefix (reference PythonReader
+                # offset = message count, data_storage.rs:835)
+                self._skip -= 1
+                continue
             self._partial.append((diff, self._row_tuple(fields), key))
         now = _time.monotonic()
         flush_due = (
@@ -181,6 +193,13 @@ class PythonSubjectSource(RealtimeSource):
     def stop(self) -> None:
         pass
 
+    def offset_state(self):
+        return {"rows": self._emitted}
+
+    def seek(self, state) -> None:
+        self._skip = int(state.get("rows", 0))
+        self._emitted = self._skip
+
 
 def read(
     subject: ConnectorSubject,
@@ -198,9 +217,11 @@ def read(
     pk_indices = [names.index(p) for p in pk] if pk else None
 
     def build():
-        return PythonSubjectSource(
+        src = PythonSubjectSource(
             subject, names, defaults, pk_indices, autocommit_duration_ms
         )
+        src.persistent_id = name
+        return src
 
     return Table("source", [], {"build": build}, schema, Universe())
 

@@ -1,0 +1,153 @@
+"""Persistence KV backends.
+
+Re-design of the reference ``src/persistence/backends/`` —
+``PersistenceBackend`` trait (``backends/mod.rs:47``) with filesystem
+(``backends/file.rs:19``), S3 (``backends/s3.rs:34``), memory and mock
+backends. The backend is a flat key → bytes store; all snapshot/metadata
+layout policy lives above it (snapshots.py), exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+__all__ = [
+    "PersistenceBackend",
+    "MemoryBackend",
+    "FilesystemBackend",
+    "S3Backend",
+    "open_backend",
+]
+
+
+class PersistenceBackend:
+    """Flat key-value store of byte blobs (backends/mod.rs:47)."""
+
+    def get_value(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def put_value(self, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def list_keys(self) -> list[str]:
+        raise NotImplementedError
+
+    def remove_key(self, key: str) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryBackend(PersistenceBackend):
+    """In-process backend. A named registry lets a 'restarted' engine in the
+    same process find prior state (the reference's mock backend role,
+    ``src/persistence/backends/mock.rs:12``)."""
+
+    _registry: dict[str, dict[str, bytes]] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, name: str | None = None):
+        if name is None:
+            self._store: dict[str, bytes] = {}
+        else:
+            with MemoryBackend._lock:
+                self._store = MemoryBackend._registry.setdefault(name, {})
+
+    @classmethod
+    def drop(cls, name: str) -> None:
+        with cls._lock:
+            cls._registry.pop(name, None)
+
+    def get_value(self, key: str) -> bytes:
+        return self._store[key]
+
+    def put_value(self, key: str, value: bytes) -> None:
+        self._store[key] = value
+
+    def list_keys(self) -> list[str]:
+        return sorted(self._store.keys())
+
+    def remove_key(self, key: str) -> None:
+        self._store.pop(key, None)
+
+
+class FilesystemBackend(PersistenceBackend):
+    """Local-filesystem backend (``backends/file.rs:19``). Writes are
+    atomic-by-rename so a crash mid-write never leaves a torn blob."""
+
+    def __init__(self, root: str | os.PathLike):
+        self.root = os.fspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        # keys may contain '/' segments — map to subdirectories
+        p = os.path.join(self.root, *key.split("/"))
+        return p
+
+    def get_value(self, key: str) -> bytes:
+        with open(self._path(key), "rb") as f:
+            return f.read()
+
+    def put_value(self, key: str, value: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(value)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def list_keys(self) -> list[str]:
+        out = []
+        for dirpath, _, files in os.walk(self.root):
+            rel = os.path.relpath(dirpath, self.root)
+            for fn in files:
+                if fn.endswith(".tmp"):
+                    continue
+                key = fn if rel == "." else "/".join(rel.split(os.sep) + [fn])
+                out.append(key)
+        return sorted(out)
+
+    def remove_key(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+
+class S3Backend(PersistenceBackend):
+    """S3/GCS object-store backend (``backends/s3.rs:34``). Requires boto3,
+    which is not part of the baked environment — gated import."""
+
+    def __init__(self, root_path: str, bucket_settings: Any = None):
+        try:
+            import boto3  # type: ignore[import-not-found]
+        except ImportError as e:  # pragma: no cover - env has no boto3
+            raise ImportError(
+                "pw.persistence.Backend.s3 requires the 'boto3' package"
+            ) from e
+        self._boto3 = boto3
+        raise NotImplementedError(
+            "S3 backend requires object-store credentials; unavailable in "
+            "this environment"
+        )
+
+
+def open_backend(backend_spec: Any) -> PersistenceBackend:
+    """Instantiate a backend from the user-facing ``pw.persistence.Backend``
+    descriptor (persistence/__init__.py)."""
+    kind = backend_spec.kind
+    if kind == "filesystem":
+        return FilesystemBackend(backend_spec.options["path"])
+    if kind == "memory":
+        return MemoryBackend(backend_spec.options.get("name"))
+    if kind == "s3":
+        return S3Backend(
+            backend_spec.options["root_path"],
+            backend_spec.options.get("bucket_settings"),
+        )
+    raise ValueError(f"unknown persistence backend kind {kind!r}")

@@ -1,0 +1,101 @@
+"""PersistenceManager — the engine side of checkpoint/recovery.
+
+Re-design of the reference's per-worker persistent storage tracker
+(``src/persistence/tracker.rs:47``) + the connector replay protocol
+(``src/connectors/mod.rs:108-152`` PersistenceMode / SnapshotAccess):
+
+1. During a run, every committed source batch is recorded to the input
+   snapshot (``record``), and on a snapshot interval the chunk is flushed
+   and metadata (last finalized time + per-source offsets) committed.
+2. On restart, ``replay_batches`` returns the persisted input stream; the
+   executor pushes it through the (deterministic) dataflow to rebuild all
+   operator state, sinks suppress re-emission for times ≤ ``last_time``
+   (``skip_persisted_batch``, reference io.subscribe), and each source is
+   ``seek``-ed past its persisted offset so only new data flows afterwards.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any
+
+from ..engine.delta import Delta
+from .backends import PersistenceBackend, open_backend
+from .snapshots import MetadataAccessor, SnapshotReader, SnapshotWriter
+
+__all__ = ["PersistenceManager"]
+
+
+class PersistenceManager:
+    def __init__(self, config: Any):
+        self.config = config
+        self.backend: PersistenceBackend = open_backend(config.backend)
+        self.snapshot_interval_s = (config.snapshot_interval_ms or 0) / 1000.0
+        self._meta = MetadataAccessor(self.backend)
+        meta = self._meta.current or {}
+        self.last_time: int = int(meta.get("last_time", -1))
+        self.offsets: dict[str, Any] = dict(meta.get("offsets", {}))
+        n_chunks = int(meta.get("n_chunks", 0))
+        self._reader = SnapshotReader(self.backend, n_chunks)
+        self._writer = SnapshotWriter(self.backend, n_chunks)
+        self._recording = False
+        self._sources: list[Any] = []  # RealtimeSources with persistent ids
+        self._last_flush = _time.monotonic()
+        self._dirty = False
+        self._last_recorded_time = self.last_time
+
+    # -- recovery side ----------------------------------------------------
+
+    def replay_batches(self) -> list[tuple[int, str, Delta]]:
+        return self._reader.batches()
+
+    def offset_for(self, pid: str) -> Any | None:
+        return self.offsets.get(pid)
+
+    # -- recording side ---------------------------------------------------
+
+    def begin_recording(self, sources: list[Any]) -> None:
+        """Replay done; start capturing live input. `sources` are the
+        realtime source nodes whose offsets go into each metadata commit."""
+        self._sources = [s for s in sources if s.persistent_id is not None]
+        self._recording = True
+
+    def record(self, time: int, pid: str, delta: Delta) -> None:
+        if not self._recording:
+            return
+        self._writer.record(time, pid, delta)
+        self._dirty = True
+        self._last_recorded_time = max(self._last_recorded_time, int(time))
+
+    def on_time_end(self, time: int) -> None:
+        if not self._recording or not self._dirty:
+            return
+        now = _time.monotonic()
+        if now - self._last_flush >= self.snapshot_interval_s:
+            self.commit(time)
+            self._last_flush = now
+
+    def commit(self, time: int) -> None:
+        """Flush pending chunk + finalize metadata (the consistency point —
+        reference `finalize`, tracker.rs)."""
+        if not self._recording:
+            return
+        self._writer.flush()
+        self.last_time = max(self.last_time, int(time))
+        self.offsets = {
+            s.persistent_id: s.offset_state() for s in self._sources
+        }
+        self._meta.commit({
+            "last_time": self.last_time,
+            "n_chunks": self._writer.n_chunks,
+            "offsets": self.offsets,
+        })
+        self._meta.prune(keep=2)  # superseded metadata versions
+        self._dirty = False
+
+    def close(self) -> None:
+        """Flush any uncommitted tail (covers abnormal executor exits —
+        a raising connector unwinds past _finish) and release the backend."""
+        if self._dirty:
+            self.commit(self._last_recorded_time)
+        self.backend.close()

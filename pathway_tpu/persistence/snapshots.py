@@ -1,0 +1,136 @@
+"""Input snapshots + versioned metadata over a PersistenceBackend.
+
+Re-design of the reference's input-snapshot and metadata machinery:
+``src/persistence/input_snapshot.rs:56-217`` (chunked event capture),
+``src/persistence/state.rs:17-35`` (``MetadataAccessor`` versioned
+metadata), ``src/connectors/offset.rs`` (``OffsetAntichain`` per-source
+resume positions).
+
+Layout (keys in the backend):
+
+- ``chunks/chunk-{seq:08d}``  — pickled list of (time, source_pid, keys,
+  data-columns, diffs) entries, appended in commit order.
+- ``meta/meta-{version:08d}`` — JSON: {"last_time", "n_chunks",
+  "offsets": {pid: offset_state}}. The newest readable metadata wins; a
+  chunk written without a following metadata commit is ignored on
+  recovery (write-chunks-then-metadata gives crash atomicity, mirroring
+  the reference's finalize protocol).
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..engine.delta import Delta
+from .backends import PersistenceBackend
+
+__all__ = ["SnapshotWriter", "SnapshotReader", "MetadataAccessor"]
+
+_CHUNK_PREFIX = "chunks/chunk-"
+_META_PREFIX = "meta/meta-"
+
+
+def _delta_parts(delta: Delta) -> tuple:
+    return (
+        delta.keys,
+        {c: np.asarray(v) for c, v in delta.data.items()},
+        delta.diffs,
+    )
+
+
+def _delta_from_parts(parts: tuple) -> Delta:
+    keys, data, diffs = parts
+    return Delta(keys=keys, data=dict(data), diffs=diffs)
+
+
+class MetadataAccessor:
+    """Versioned metadata blobs; highest parseable version is current
+    (``state.rs:35``)."""
+
+    def __init__(self, backend: PersistenceBackend):
+        self._backend = backend
+        self._version = -1
+        self.current: dict[str, Any] | None = None
+        for key in backend.list_keys():
+            if not key.startswith(_META_PREFIX):
+                continue
+            try:
+                version = int(key[len(_META_PREFIX):])
+                meta = json.loads(backend.get_value(key))
+            except (ValueError, json.JSONDecodeError):
+                continue
+            if version > self._version:
+                self._version = version
+                self.current = meta
+
+    def commit(self, meta: dict[str, Any]) -> None:
+        self._version += 1
+        self._backend.put_value(
+            f"{_META_PREFIX}{self._version:08d}",
+            json.dumps(meta).encode(),
+        )
+        self.current = meta
+
+    def prune(self, keep: int = 2) -> None:
+        """Remove superseded metadata versions (all but the newest `keep`),
+        bounding backend growth on long runs."""
+        for key in self._backend.list_keys():
+            if not key.startswith(_META_PREFIX):
+                continue
+            try:
+                version = int(key[len(_META_PREFIX):])
+            except ValueError:
+                continue
+            if version <= self._version - keep:
+                self._backend.remove_key(key)
+
+
+class SnapshotWriter:
+    """Buffers (time, pid, delta) entries; ``flush`` appends one chunk
+    (``input_snapshot.rs:217`` WriteSnapshotEvent)."""
+
+    def __init__(self, backend: PersistenceBackend, n_existing_chunks: int):
+        self._backend = backend
+        self._seq = n_existing_chunks
+        self._buffer: list[tuple[int, str, tuple]] = []
+
+    def record(self, time: int, pid: str, delta: Delta) -> None:
+        self._buffer.append((time, pid, _delta_parts(delta)))
+
+    @property
+    def n_chunks(self) -> int:
+        return self._seq
+
+    def flush(self) -> bool:
+        """Write buffered entries as one chunk. Returns True if anything
+        was written (caller then commits metadata)."""
+        if not self._buffer:
+            return False
+        blob = pickle.dumps(self._buffer, protocol=pickle.HIGHEST_PROTOCOL)
+        self._backend.put_value(f"{_CHUNK_PREFIX}{self._seq:08d}", blob)
+        self._seq += 1
+        self._buffer = []
+        return True
+
+
+class SnapshotReader:
+    """Reads finalized chunks (those covered by metadata) back as
+    time-ordered batches (``input_snapshot.rs:67`` ReadInputSnapshot)."""
+
+    def __init__(self, backend: PersistenceBackend, n_chunks: int):
+        self._backend = backend
+        self._n_chunks = n_chunks
+
+    def batches(self) -> list[tuple[int, str, Delta]]:
+        """All persisted (time, pid, delta) entries, in commit order (which
+        is nondecreasing in time by construction)."""
+        out: list[tuple[int, str, Delta]] = []
+        for seq in range(self._n_chunks):
+            blob = self._backend.get_value(f"{_CHUNK_PREFIX}{seq:08d}")
+            for time, pid, parts in pickle.loads(blob):
+                out.append((int(time), pid, _delta_from_parts(parts)))
+        return out

@@ -1,0 +1,197 @@
+"""Checkpoint/recovery: input snapshots, offsets, restart-from-snapshot.
+
+Mirrors the reference recovery strategy tested by
+``integration_tests/wordcount/test_recovery.py`` (kill mid-run, restart from
+persisted state, verify exactly-once-ish output) — here the "kill" is an
+engine stop between commits and the restart is a fresh GraphRunner over the
+same persistence backend.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.persistence import Backend, Config, MemoryBackend
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _word_pipeline(subject):
+    t = pw.io.python.read(
+        subject, schema=pw.schema_from_types(word=str), name="words"
+    )
+    return t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+
+
+class _Emitter(pw.io.python.ConnectorSubject):
+    """Deterministic stream: emits `words[:upto]`, one commit per row."""
+
+    def __init__(self, words, upto):
+        super().__init__()
+        self.words = words
+        self.upto = upto
+
+    def run(self):
+        for w in self.words[: self.upto]:
+            self.next(word=w)
+            self.commit()
+
+
+WORDS = ["foo", "bar", "foo", "baz", "foo", "bar", "qux", "foo", "bar", "baz"]
+
+
+def test_python_subject_recovery_memory_backend():
+    MemoryBackend.drop("t1")
+    cfg = Config.simple_config(Backend.memory("t1"))
+
+    seen1 = []
+    counts = _word_pipeline(_Emitter(WORDS, 6))
+    pw.io.subscribe(counts, on_change=lambda key, row, time, is_addition:
+                    seen1.append((row["word"], int(row["c"]), is_addition)))
+    pw.run(persistence_config=cfg)
+    final1 = {w: c for w, c, add in seen1 if add}
+    assert final1 == {"foo": 3, "bar": 2, "baz": 1}
+
+    # --- restart: same deterministic source, now with 4 more rows ---
+    G.clear()
+    seen2 = []
+    counts = _word_pipeline(_Emitter(WORDS, 10))
+    pw.io.subscribe(counts, on_change=lambda key, row, time, is_addition:
+                    seen2.append((row["word"], int(row["c"]), is_addition)))
+    pw.run(persistence_config=cfg)
+
+    # replayed times are suppressed: only the 4 new rows' updates emitted
+    new_words = [w for w, c, add in seen2 if add]
+    assert set(new_words) == {"qux", "foo", "bar", "baz"}
+    final2 = {w: c for w, c, add in seen2 if add}
+    # counts continue from the persisted state — no double counting
+    assert final2 == {"foo": 4, "bar": 3, "baz": 2, "qux": 1}
+    # foo's only new addition is 4 (3 replayed silently)
+    foo_updates = [c for w, c, add in seen2 if w == "foo" and add]
+    assert foo_updates == [4]
+
+
+def test_fs_streaming_recovery(tmp_path):
+    """Wordcount-style: stream a CSV directory, stop, add data, restart."""
+    data = tmp_path / "data"
+    data.mkdir()
+    pdir = tmp_path / "pstate"
+    cfg = Config.simple_config(Backend.filesystem(os.fspath(pdir)))
+
+    (data / "a.csv").write_text("word\nfoo\nbar\nfoo\n")
+
+    def run_until(n_events, extra_setup=None):
+        seen = []
+        done = threading.Event()
+        t = pw.io.fs.read(
+            os.fspath(data), format="csv",
+            schema=pw.schema_from_types(word=str), mode="streaming",
+            name="words",
+        )
+        counts = t.groupby(pw.this.word).reduce(
+            pw.this.word, c=pw.reducers.count()
+        )
+
+        def on_change(key, row, time, is_addition):
+            seen.append((row["word"], int(row["c"]), is_addition))
+            if sum(1 for _, _, add in seen if add) >= n_events:
+                done.set()
+
+        pw.io.subscribe(counts, on_change=on_change)
+
+        def stopper():
+            done.wait(timeout=15)
+            time.sleep(0.3)  # let the commit tick finish
+            pw.request_stop()
+
+        th = threading.Thread(target=stopper, daemon=True)
+        th.start()
+        pw.run(persistence_config=cfg)
+        th.join()
+        return seen
+
+    seen1 = run_until(2)
+    final1 = {w: c for w, c, add in seen1 if add}
+    assert final1 == {"foo": 2, "bar": 1}
+
+    # "crash" happened; more data arrives while the engine is down
+    (data / "a.csv").open("a").write("baz\n")
+    (data / "b.csv").write_text("word\nfoo\n")
+
+    G.clear()
+    seen2 = run_until(2)
+    final2 = {w: c for w, c, add in seen2 if add}
+    # old rows are not re-read (offsets) and old output is not re-emitted
+    assert final2.get("baz") == 1
+    assert final2.get("foo") == 3
+    assert all(w in ("baz", "foo") for w, _, _ in seen2)
+
+
+def test_backend_kv_roundtrip(tmp_path):
+    from pathway_tpu.persistence.backends import FilesystemBackend
+
+    b = FilesystemBackend(tmp_path / "kv")
+    b.put_value("meta/meta-00000001", b"hello")
+    b.put_value("chunks/chunk-00000000", b"\x00\x01")
+    assert b.get_value("meta/meta-00000001") == b"hello"
+    assert b.list_keys() == ["chunks/chunk-00000000", "meta/meta-00000001"]
+    b.remove_key("meta/meta-00000001")
+    assert b.list_keys() == ["chunks/chunk-00000000"]
+
+
+def test_python_source_offset_counts_only_delivered_rows():
+    """Offset must not cover rows still buffered (pre-commit) — a persisted
+    offset past unsnapshotted input would lose them on recovery."""
+    from pathway_tpu.io.python import ConnectorSubject, PythonSubjectSource
+
+    class S(ConnectorSubject):
+        def run(self):
+            pass
+
+    s = S()
+    src = PythonSubjectSource(s, ["word"], {}, None, autocommit_ms=10_000_000)
+    s.next(word="a")
+    s.next(word="b")
+    assert src.poll() == []  # drained into the partial buffer, not committed
+    assert src.offset_state() == {"rows": 0}
+    s.commit()
+    deltas = src.poll()
+    assert len(deltas) == 1 and len(deltas[0]) == 2
+    assert src.offset_state() == {"rows": 2}
+
+
+def test_fs_stream_truncation_and_partial_lines(tmp_path):
+    from pathway_tpu.io.fs import FsStreamSource
+
+    f = tmp_path / "log.csv"
+    f.write_text("word\nfoo\nbar\n")
+    src = FsStreamSource(
+        os.fspath(tmp_path), "csv", None, ["word"], autocommit_ms=None
+    )
+    (d,) = src.poll()
+    assert len(d) == 2
+
+    # partial (no trailing newline) line is not consumed until completed
+    with f.open("a") as h:
+        h.write("ba")
+    assert src.poll() == []
+    with f.open("a") as h:
+        h.write("z\n")
+    (d,) = src.poll()
+    assert list(d.data["word"]) == ["baz"]
+
+    # truncation/rotation: shorter rewrite is re-read from scratch
+    f.write_text("word\nqux\n")
+    (d,) = src.poll()
+    assert list(d.data["word"]) == ["qux"]
