@@ -135,6 +135,7 @@ class Executor:
         self._stop_requested = False
         self.persistence = persistence
         self._last_clock = 0
+        self._defer_commit = False
 
     def request_stop(self) -> None:
         self._stop_requested = True
@@ -187,11 +188,16 @@ class Executor:
                             rounds.append([])
                         rounds[j].append((src, delta))
                 if rounds:
-                    for emissions in rounds:
+                    for j, emissions in enumerate(rounds):
                         # even wall-clock ms, strictly increasing (timestamp.rs)
                         wall = int(_time.time() * 1000) & ~1
                         clock = max(clock + 2, wall)
+                        # a checkpoint between rounds of one poll cycle would
+                        # persist offsets covering rounds not yet recorded —
+                        # only the cycle's last tick may commit
+                        self._defer_commit = j < len(rounds) - 1
                         self._tick(clock, emissions)
+                    self._defer_commit = False
                 elif all(src.is_finished() for src in realtime):
                     break
                 else:
@@ -279,7 +285,11 @@ class Executor:
                 self._route(node, emitted, inbox)
         for cb in self._on_time_end:
             cb(time)
-        if self.persistence is not None and time != END_TIME:
+        if (
+            self.persistence is not None
+            and time != END_TIME
+            and not self._defer_commit
+        ):
             self.persistence.on_time_end(time)
 
     def _route(

@@ -16,10 +16,72 @@ import numpy as np
 __all__ = ["ReducerImpl", "REDUCERS", "make_reducer"]
 
 
-def _hashable(v: Any) -> Any:
+def _encode(v: Any) -> Any:
+    """Structural, hashable encoding of a value (multiset dict key)."""
     if isinstance(v, np.ndarray):
-        return (v.shape, v.tobytes())
+        return ("\x00nd", v.shape, str(v.dtype), v.tobytes())
+    if isinstance(v, dict):
+        return ("\x00d", tuple(sorted((k, _encode(x)) for k, x in v.items())))
+    if isinstance(v, (list, tuple)):
+        return ("\x00t", tuple(_encode(x) for x in v))
+    if isinstance(v, set):
+        return ("\x00s", tuple(sorted(map(_encode, v))))
     return v
+
+
+class _H:
+    """Unhashable value (ndarray/dict/list) boxed for multiset membership:
+    hashes/orders by structural encoding, extract() unwraps the original."""
+
+    __slots__ = ("k", "v")
+
+    def __init__(self, v: Any):
+        self.v = v
+        self.k = _encode(v)
+
+    def __hash__(self):
+        return hash(self.k)
+
+    def __eq__(self, other):
+        return isinstance(other, _H) and self.k == other.k
+
+    def _cmp(self, other) -> int:
+        a = self.k
+        b = other.k if isinstance(other, _H) else _encode(other)
+        try:
+            if a == b:
+                return 0
+            return -1 if a < b else 1
+        except TypeError:
+            # heterogeneous multiset (e.g. int vs list under min/max):
+            # total-order by type name, then repr — deterministic, arbitrary
+            ka, kb = (type(a).__name__, repr(a)), (type(b).__name__, repr(b))
+            return -1 if ka < kb else (0 if ka == kb else 1)
+
+    def __lt__(self, other):
+        return self._cmp(other) < 0
+
+    def __gt__(self, other):
+        return self._cmp(other) > 0
+
+    def __le__(self, other):
+        return self._cmp(other) <= 0
+
+    def __ge__(self, other):
+        return self._cmp(other) >= 0
+
+    def __repr__(self):
+        return f"_H({self.v!r})"
+
+
+def _hashable(v: Any) -> Any:
+    if isinstance(v, (np.ndarray, dict, list, set)):
+        return _H(v)
+    return v
+
+
+def _unwrap(v: Any) -> Any:
+    return v.v if isinstance(v, _H) else v
 
 
 class ReducerImpl:
@@ -93,14 +155,14 @@ class MinReducer(_MultisetReducer):
         return _hashable(values[0])
 
     def extract(self, acc):
-        return min(acc.keys()) if acc else None
+        return _unwrap(min(acc.keys())) if acc else None
 
 
 class MaxReducer(MinReducer):
     name = "max"
 
     def extract(self, acc):
-        return max(acc.keys()) if acc else None
+        return _unwrap(max(acc.keys())) if acc else None
 
 
 class ArgMinReducer(_MultisetReducer):
@@ -139,7 +201,7 @@ class UniqueReducer(_MultisetReducer):
             raise ValueError(
                 f"More than one distinct value passed to the unique reducer: {sorted(map(repr, acc))[:2]}"
             )
-        return next(iter(acc.keys()))
+        return _unwrap(next(iter(acc.keys())))
 
 
 class AnyReducer(_MultisetReducer):
@@ -153,7 +215,7 @@ class AnyReducer(_MultisetReducer):
     def extract(self, acc):
         if not acc:
             return None
-        return min(acc.keys())[1]
+        return _unwrap(min(acc.keys())[1])
 
 
 class SortedTupleReducer(_MultisetReducer):
@@ -171,7 +233,9 @@ class SortedTupleReducer(_MultisetReducer):
             if v is None and self._skip_nones:
                 continue
             items.extend([v] * c)
-        return tuple(sorted(items, key=lambda x: (x is None, x)))
+        return tuple(
+            _unwrap(x) for x in sorted(items, key=lambda x: (x is None, x))
+        )
 
 
 class TupleReducer(_MultisetReducer):
@@ -191,7 +255,7 @@ class TupleReducer(_MultisetReducer):
         for (rk, v), c in sorted(acc.items(), key=lambda kv: kv[0][0]):
             if v is None and self._skip_nones:
                 continue
-            items.extend([v] * c)
+            items.extend([_unwrap(v)] * c)
         return tuple(items)
 
 
@@ -209,7 +273,7 @@ class TupleByReducer(_MultisetReducer):
     def extract(self, acc):
         items = []
         for (_sk, v), c in sorted(acc.items(), key=lambda kv: kv[0][0]):
-            items.extend([v] * c)
+            items.extend([_unwrap(v)] * c)
         return tuple(items)
 
 
@@ -230,7 +294,7 @@ class EarliestReducer(_MultisetReducer):
     def extract(self, acc):
         if not acc:
             return None
-        return min(acc.keys())[2]
+        return _unwrap(min(acc.keys())[2])
 
 
 class LatestReducer(EarliestReducer):
@@ -239,7 +303,7 @@ class LatestReducer(EarliestReducer):
     def extract(self, acc):
         if not acc:
             return None
-        return max(acc.keys())[2]
+        return _unwrap(max(acc.keys())[2])
 
 
 class StatefulReducer(ReducerImpl):

@@ -144,10 +144,12 @@ class FsStreamSource(RealtimeSource):
                 continue
             start = self._staged.get(fpath, self._consumed.get(fpath, 0))
             if size < start:
-                # truncated/rotated — re-read from scratch
+                # truncated/rotated — re-read from scratch; drop unemitted
+                # rows parsed from the pre-truncation content
                 self._consumed.pop(fpath, None)
                 self._staged.pop(fpath, None)
                 self._headers.pop(fpath, None)
+                self._pending = [(p, r) for p, r in self._pending if p != fpath]
                 start = 0
             if not self._load_header(fpath):
                 continue
@@ -168,7 +170,7 @@ class FsStreamSource(RealtimeSource):
             for line in chunk[:end].decode("utf-8").split("\n"):
                 line = line.rstrip("\r")
                 if line.strip():
-                    self._pending.append(self._parse_line(fpath, line))
+                    self._pending.append((fpath, self._parse_line(fpath, line)))
             self._staged[fpath] = start + end + 1
 
     def poll(self):
@@ -188,7 +190,8 @@ class FsStreamSource(RealtimeSource):
         )
         if not window_open:
             return []
-        rows, self._pending = self._pending, []
+        rows = [r for _, r in self._pending]
+        self._pending = []
         self._consumed.update(self._staged)  # rows now delivered → offset moves
         self._staged.clear()
         self._last_emit = now

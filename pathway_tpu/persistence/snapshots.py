@@ -76,17 +76,12 @@ class MetadataAccessor:
         self.current = meta
 
     def prune(self, keep: int = 2) -> None:
-        """Remove superseded metadata versions (all but the newest `keep`),
-        bounding backend growth on long runs."""
-        for key in self._backend.list_keys():
-            if not key.startswith(_META_PREFIX):
-                continue
-            try:
-                version = int(key[len(_META_PREFIX):])
-            except ValueError:
-                continue
-            if version <= self._version - keep:
-                self._backend.remove_key(key)
+        """Remove the metadata version just superseded beyond the newest
+        `keep`. O(1) per commit — versions are sequential, so deleting
+        ``version - keep`` at every commit keeps exactly `keep` around."""
+        stale = self._version - keep
+        if stale >= 0:
+            self._backend.remove_key(f"{_META_PREFIX}{stale:08d}")
 
 
 class SnapshotWriter:

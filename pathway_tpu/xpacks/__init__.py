@@ -1,0 +1,5 @@
+"""``pw.xpacks`` — extension packs (reference python/pathway/xpacks)."""
+
+from . import llm  # noqa: F401
+
+__all__ = ["llm"]

@@ -1,0 +1,48 @@
+"""``pw.xpacks.llm`` — LLM / RAG toolkit.
+
+Re-design of ``python/pathway/xpacks/llm/`` (8,045 LoC): chats, embedders,
+splitters, parsers, rerankers, prompts, the live vector/document stores and
+the RAG question-answering servers — with the embedding path running
+natively on TPU (``pathway_tpu.models.embedder``) instead of a CPU-bound
+sentence-transformers pipeline.
+"""
+
+from . import (  # noqa: F401
+    document_store,
+    embedders,
+    llms,
+    parsers,
+    prompts,
+    question_answering,
+    rerankers,
+    servers,
+    splitters,
+    vector_store,
+)
+from .document_store import DocumentStore, SlidesDocumentStore  # noqa: F401
+from .question_answering import (  # noqa: F401
+    AdaptiveRAGQuestionAnswerer,
+    BaseRAGQuestionAnswerer,
+    RAGClient,
+)
+from .vector_store import VectorStoreClient, VectorStoreServer  # noqa: F401
+
+__all__ = [
+    "llms",
+    "embedders",
+    "splitters",
+    "parsers",
+    "rerankers",
+    "prompts",
+    "document_store",
+    "vector_store",
+    "question_answering",
+    "servers",
+    "DocumentStore",
+    "SlidesDocumentStore",
+    "VectorStoreServer",
+    "VectorStoreClient",
+    "BaseRAGQuestionAnswerer",
+    "AdaptiveRAGQuestionAnswerer",
+    "RAGClient",
+]

@@ -1,0 +1,71 @@
+"""Document parsers (reference ``python/pathway/xpacks/llm/parsers.py``,
+928 LoC — Utf8/Unstructured/OpenParse/OCR).
+
+A parser is a UDF ``bytes -> list[(text, metadata)]``. ``ParseUtf8`` is
+always available; the heavyweight parsers gate on their libraries
+(unstructured / openparse are not baked into this environment).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...udfs import UDF
+
+__all__ = ["ParseUtf8", "ParseUnstructured", "OpenParse"]
+
+
+class ParseUtf8(UDF):
+    """Decode raw bytes as one UTF-8 text document
+    (reference parsers.py ParseUtf8)."""
+
+    def __wrapped__(self, contents: Any, **kwargs: Any) -> list[tuple[str, dict]]:
+        if isinstance(contents, bytes):
+            text = contents.decode("utf-8", errors="replace")
+        else:
+            text = str(contents)
+        return [(text, {})]
+
+
+class ParseUnstructured(UDF):
+    """reference parsers.py ParseUnstructured — requires ``unstructured``
+    (not baked in)."""
+
+    def __init__(self, mode: str = "single", **kwargs: Any):
+        try:
+            import unstructured.partition.auto  # type: ignore[import-not-found]  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "ParseUnstructured requires the 'unstructured' package; "
+                "ParseUtf8 handles plain-text documents"
+            ) from e
+        super().__init__()
+        self.mode = mode
+        self.kwargs = kwargs
+
+    def __wrapped__(self, contents: bytes, **kwargs: Any) -> list[tuple[str, dict]]:
+        import io
+
+        from unstructured.partition.auto import partition  # type: ignore[import-not-found]
+
+        elements = partition(file=io.BytesIO(contents), **{**self.kwargs, **kwargs})
+        if self.mode == "single":
+            return [("\n\n".join(str(e) for e in elements), {})]
+        return [(str(e), getattr(e, "metadata", None) and e.metadata.to_dict() or {})
+                for e in elements]
+
+
+class OpenParse(UDF):
+    """reference parsers.py OpenParse (PDF layout parser) — requires
+    ``openparse`` (not baked in)."""
+
+    def __init__(self, **kwargs: Any):
+        try:
+            import openparse  # type: ignore[import-not-found]  # noqa: F401
+        except ImportError as e:
+            raise ImportError("OpenParse requires the 'openparse' package") from e
+        super().__init__()
+        self.kwargs = kwargs
+
+    def __wrapped__(self, contents: bytes, **kwargs: Any) -> list[tuple[str, dict]]:
+        raise NotImplementedError("openparse unavailable in this environment")

@@ -1,0 +1,183 @@
+"""VectorStoreServer / VectorStoreClient.
+
+Re-design of ``python/pathway/xpacks/llm/vector_store.py`` (server :38,
+client :629): live document ingestion → parse → split → embed → KNN index,
+served over the REST connector. The embedding+search path is TPU-resident:
+``TpuEmbedder`` (JAX encoder on the MXU) feeding the brute-force/LSH KNN
+kernels in ``pathway_tpu/ops``.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import threading
+import urllib.request
+from typing import Any, Callable, Iterable
+
+import pathway_tpu as pw
+from ...internals.table import Table
+from ...stdlib.indexing.nearest_neighbors import BruteForceKnnFactory
+from .document_store import DocumentStore
+
+__all__ = ["VectorStoreServer", "VectorStoreClient"]
+
+
+class VectorStoreServer:
+    """DocumentStore + an embedder-backed KNN index + REST endpoints
+    (/v1/retrieve, /v1/statistics, /v1/inputs)."""
+
+    def __init__(
+        self,
+        *docs: Table,
+        embedder: Callable | None = None,
+        parser: Callable | None = None,
+        splitter: Callable | None = None,
+        doc_post_processors: list[Callable] | None = None,
+        index_factory: Any = None,
+    ):
+        if embedder is None and index_factory is None:
+            from .embedders import TpuEmbedder
+
+            embedder = TpuEmbedder()
+        self.embedder = embedder
+        if index_factory is None:
+            dim = self._embedding_dimension(embedder)
+            index_factory = BruteForceKnnFactory(
+                dimensions=dim, embedder=self._embed_fn(embedder)
+            )
+        self.store = DocumentStore(
+            list(docs),
+            index_factory,
+            parser=parser,
+            splitter=splitter,
+            doc_post_processors=doc_post_processors,
+        )
+        self._threads: list[threading.Thread] = []
+
+    @staticmethod
+    def _embed_fn(embedder: Any) -> Callable:
+        for attr in ("func", "__wrapped__"):
+            f = getattr(embedder, attr, None)
+            if callable(f):
+                return f
+        return embedder
+
+    @classmethod
+    def _embedding_dimension(cls, embedder: Any) -> int:
+        probe = getattr(embedder, "get_embedding_dimension", None)
+        if probe is not None:
+            return int(probe())
+        return len(cls._embed_fn(embedder)("."))
+
+    # -- query surfaces (DocumentStore pass-throughs) ---------------------
+
+    RetrieveQuerySchema = DocumentStore.RetrieveQuerySchema
+    StatisticsQuerySchema = DocumentStore.StatisticsQuerySchema
+    InputsQuerySchema = DocumentStore.InputsQuerySchema
+
+    @property
+    def index(self):
+        return self.store.index
+
+    def retrieve_query(self, queries: Table) -> Table:
+        return self.store.retrieve_query(queries)
+
+    def statistics_query(self, queries: Table) -> Table:
+        return self.store.statistics_query(queries)
+
+    def inputs_query(self, queries: Table) -> Table:
+        return self.store.inputs_query(queries)
+
+    # -- serving ----------------------------------------------------------
+
+    def build_server(self, host: str, port: int, **rest_kwargs: Any) -> None:
+        from ...io.http._server import PathwayWebserver, rest_connector
+
+        webserver = PathwayWebserver(host, port)
+        routes = [
+            ("/v1/retrieve", self.RetrieveQuerySchema, self.retrieve_query),
+            ("/v1/statistics", self.StatisticsQuerySchema, self.statistics_query),
+            ("/v1/inputs", self.InputsQuerySchema, self.inputs_query),
+        ]
+        for route, schema, handler in routes:
+            queries, writer = rest_connector(
+                webserver=webserver, route=route, schema=schema,
+                delete_completed_queries=True, **rest_kwargs,
+            )
+            writer(handler(queries))
+
+    def run_server(
+        self,
+        host: str,
+        port: int,
+        *,
+        threaded: bool = False,
+        with_cache: bool = False,
+        cache_backend: Any = None,
+        **kwargs: Any,
+    ):
+        if with_cache:
+            raise NotImplementedError(
+                "with_cache caches LLM replies in the QA servers; the vector "
+                "store has no LLM surface — wrap your embedder in a "
+                "pw.udfs CacheStrategy instead"
+            )
+        self.build_server(host, port)
+        if threaded:
+            t = threading.Thread(target=lambda: pw.run(**kwargs), daemon=True)
+            t.start()
+            self._threads.append(t)
+            return t
+        pw.run(**kwargs)
+
+
+class VectorStoreClient:
+    """stdlib-urllib client for VectorStoreServer (reference :629)."""
+
+    def __init__(
+        self,
+        host: str | None = None,
+        port: int | None = None,
+        url: str | None = None,
+        timeout: float = 15.0,
+    ):
+        self.url = url or f"http://{host}:{port}"
+        self.timeout = timeout
+
+    def _post(self, route: str, payload: dict) -> Any:
+        req = urllib.request.Request(
+            self.url + route,
+            data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return _json.loads(resp.read().decode())
+
+    def query(
+        self,
+        query: str,
+        k: int = 3,
+        metadata_filter: str | None = None,
+        filepath_globpattern: str | None = None,
+    ) -> list[dict]:
+        return self._post("/v1/retrieve", {
+            "query": query, "k": k,
+            "metadata_filter": metadata_filter,
+            "filepath_globpattern": filepath_globpattern,
+        })
+
+    __call__ = query
+
+    def get_vectorstore_statistics(self) -> dict:
+        return self._post("/v1/statistics", {})
+
+    def get_input_files(
+        self,
+        metadata_filter: str | None = None,
+        filepath_globpattern: str | None = None,
+    ) -> list:
+        return self._post("/v1/inputs", {
+            "metadata_filter": metadata_filter,
+            "filepath_globpattern": filepath_globpattern,
+        })

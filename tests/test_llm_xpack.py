@@ -1,0 +1,258 @@
+"""LLM xpack: splitters, prompts, rerankers, DocumentStore, RAG answerers
+(reference test model: python/pathway/xpacks/llm tests — fake chats and
+embedders, no network)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.stdlib.indexing.nearest_neighbors import BruteForceKnnFactory
+from pathway_tpu.xpacks.llm import prompts
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.llms import BaseChat
+from pathway_tpu.xpacks.llm.question_answering import (
+    AdaptiveRAGQuestionAnswerer,
+    BaseRAGQuestionAnswerer,
+    answer_with_geometric_rag_strategy,
+)
+from pathway_tpu.xpacks.llm.rerankers import EncoderReranker, rerank_topk_filter
+from pathway_tpu.xpacks.llm.splitters import NullSplitter, TokenCountSplitter
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def fake_embed(text: str) -> np.ndarray:
+    v = np.zeros(16)
+    for ch in str(text)[:400]:
+        v[ord(ch) % 16] += 1.0
+    return v / (np.linalg.norm(v) or 1.0)
+
+
+class EchoDocsChat(BaseChat):
+    """Fake chat: answers with the count of 'Sources'/'Articles' docs seen —
+    lets tests assert what context reached the model."""
+
+    def _call_model(self, messages, **kwargs):
+        return "reply: " + messages[-1]["content"][:40]
+
+
+DOCS = [
+    ("TPUs multiply matrices on a systolic array called the MXU.", {"path": "tpu.txt", "modified_at": 3}),
+    ("Kafka is a distributed message broker for event streams.", {"path": "kafka.txt", "modified_at": 7}),
+    ("Croissants are made with laminated butter dough.", {"path": "food.txt", "modified_at": 5}),
+]
+
+
+def _store(splitter=None):
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(data=str, _metadata=dict), DOCS
+    )
+    return DocumentStore(
+        docs,
+        BruteForceKnnFactory(dimensions=16, embedder=fake_embed),
+        splitter=splitter or NullSplitter(),
+    )
+
+
+def _rows(table):
+    cap = pw.debug.table_to_dicts(table)
+    return cap
+
+
+def test_token_count_splitter_bounds():
+    s = TokenCountSplitter(min_tokens=3, max_tokens=6)
+    text = "one two three. four five six. seven eight. nine ten eleven twelve."
+    chunks = s.__wrapped__(text)
+    assert len(chunks) >= 2
+    for chunk, meta in chunks:
+        assert len(chunk.split()) <= 6
+    # nothing lost
+    rejoined = " ".join(c for c, _ in chunks)
+    assert rejoined.split() == text.split()
+
+
+def test_document_store_retrieve_and_filter():
+    store = _store()
+    queries = pw.debug.table_from_rows(
+        DocumentStore.RetrieveQuerySchema,
+        [("systolic array MXU matrices", 2, None, None)],
+    )
+    [row] = pw.debug.table_to_pandas(store.retrieve_query(queries))["result"].tolist()
+    assert row[0]["metadata"]["path"] == "tpu.txt"
+    assert len(row) == 2
+
+    G.clear()
+    store = _store()
+    queries = pw.debug.table_from_rows(
+        DocumentStore.RetrieveQuerySchema,
+        [("systolic array MXU matrices", 2, None, "kafka*")],
+    )
+    [row] = pw.debug.table_to_pandas(store.retrieve_query(queries))["result"].tolist()
+    assert [d["metadata"]["path"] for d in row] == ["kafka.txt"]
+
+
+def test_document_store_statistics_and_inputs():
+    store = _store()
+    stats_q = pw.debug.table_from_rows(DocumentStore.StatisticsQuerySchema, [()])
+    [stats] = pw.debug.table_to_pandas(store.statistics_query(stats_q))["result"].tolist()
+    assert stats == {"file_count": 3, "last_modified": 7}
+
+    G.clear()
+    store = _store()
+    inputs_q = pw.debug.table_from_rows(
+        DocumentStore.InputsQuerySchema, [(None, None)]
+    )
+    [files] = pw.debug.table_to_pandas(store.inputs_query(inputs_q))["result"].tolist()
+    assert {f["path"] for f in files} == {"tpu.txt", "kafka.txt", "food.txt"}
+
+
+def test_base_rag_answer_query():
+    store = _store()
+    rag = BaseRAGQuestionAnswerer(EchoDocsChat(), store, search_topk=2)
+    queries = pw.debug.table_from_rows(
+        rag.AnswerQuerySchema,
+        [("what is the MXU?", None, None, False)],
+    )
+    [ans] = pw.debug.table_to_pandas(rag.answer_query(queries))["result"].tolist()
+    assert ans.startswith("reply:")
+
+
+def test_base_rag_answer_returns_context_docs():
+    store = _store()
+    rag = BaseRAGQuestionAnswerer(EchoDocsChat(), store, search_topk=2)
+    queries = pw.debug.table_from_rows(
+        rag.AnswerQuerySchema,
+        [("what is the MXU?", None, None, True)],
+    )
+    [ans] = pw.debug.table_to_pandas(rag.answer_query(queries))["result"].tolist()
+    assert set(ans.keys()) == {"response", "context_docs"}
+    assert len(ans["context_docs"]) == 2
+
+
+class CountingChat(BaseChat):
+    """Refuses until it sees >= need docs in the prompt (Articles block)."""
+
+    def __init__(self, need: int, **kwargs):
+        super().__init__(**kwargs)
+        self.need = need
+        self.calls: list[int] = []
+
+    def _call_model(self, messages, **kwargs):
+        content = messages[-1]["content"]
+        articles = content.split("Articles:\n", 1)[1].rsplit("\n\nQ:", 1)[0]
+        n_docs = len([p for p in articles.split("\n\n") if p.strip()])
+        self.calls.append(n_docs)
+        if n_docs >= self.need:
+            return f"answered with {n_docs} docs"
+        return prompts.NO_INFO_ANSWER
+
+
+def test_geometric_rag_strategy_expands_until_answer():
+    chat = CountingChat(need=4)
+    docs = [f"doc {i}" for i in range(8)]
+    ans = answer_with_geometric_rag_strategy(
+        "q?", docs, chat, n_starting_documents=1, factor=2, max_iterations=4
+    )
+    assert ans == "answered with 4 docs"
+    assert chat.calls == [1, 2, 4]
+
+
+def test_geometric_rag_strategy_gives_up():
+    chat = CountingChat(need=100)
+    ans = answer_with_geometric_rag_strategy(
+        "q?", ["a", "b"], chat, n_starting_documents=1, factor=2, max_iterations=3
+    )
+    assert ans == prompts.NO_INFO_ANSWER
+
+
+def test_adaptive_rag_answer_query():
+    store = _store()
+    chat = CountingChat(need=1)
+    rag = AdaptiveRAGQuestionAnswerer(
+        chat, store, n_starting_documents=1, factor=2, max_iterations=3
+    )
+    queries = pw.debug.table_from_rows(
+        rag.AnswerQuerySchema,
+        [("what is the MXU?", None, None, False)],
+    )
+    [ans] = pw.debug.table_to_pandas(rag.answer_query(queries))["result"].tolist()
+    assert ans == "answered with 1 docs"
+
+
+def test_encoder_reranker_and_topk():
+    class FakeEmbedderUDF:
+        def __wrapped__(self, text):
+            return fake_embed(text)
+
+    rr = EncoderReranker(FakeEmbedderUDF())
+    same = rr.__wrapped__("hello world", "hello world")
+    diff = rr.__wrapped__("hello world", "zzzzzz qqqq")
+    assert same > diff
+
+    docs, scores = rerank_topk_filter(
+        ["a", "b", "c"], [0.1, 0.9, 0.5], k=2
+    )
+    assert docs == ("b", "c") and scores == (0.9, 0.5)
+
+
+def test_summarize_query():
+    store = _store()
+    rag = BaseRAGQuestionAnswerer(EchoDocsChat(), store)
+    q = pw.debug.table_from_rows(
+        rag.SummarizeQuerySchema, [((["text one", "text two"],))]
+    )
+    [ans] = pw.debug.table_to_pandas(rag.summarize_query(q))["result"].tolist()
+    assert ans.startswith("reply:")
+
+
+def test_qa_rest_server_roundtrip():
+    """Full serve path over HTTP: answer/retrieve/statistics/list_documents
+    (reference integration_tests/webserver + xpack QARestServer)."""
+    import time
+
+    from pathway_tpu.internals.run import request_stop
+    from pathway_tpu.io.http._server import terminate_all
+    from pathway_tpu.xpacks.llm.question_answering import RAGClient
+    from pathway_tpu.xpacks.llm.servers import QASummaryRestServer
+
+    class FactChat(BaseChat):
+        def _call_model(self, messages, **kw):
+            c = messages[-1]["content"]
+            if "MXU" in c and "systolic" in c:
+                return "The MXU is the systolic array."
+            return prompts.NO_INFO_ANSWER
+
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(data=str, _metadata=dict), DOCS
+    )
+    store = DocumentStore(
+        docs, BruteForceKnnFactory(dimensions=16, embedder=fake_embed)
+    )
+    rag = AdaptiveRAGQuestionAnswerer(
+        FactChat(), store, n_starting_documents=1, factor=2, max_iterations=2
+    )
+    server = QASummaryRestServer("127.0.0.1", 18737, rag)
+    try:
+        server.run(threaded=True)
+        time.sleep(1.0)
+        client = RAGClient(url="http://127.0.0.1:18737", timeout=20)
+        assert client.answer("what is the MXU?") == "The MXU is the systolic array."
+        hits = client.retrieve("systolic array MXU matrices", k=1)
+        assert [d["metadata"]["path"] for d in hits] == ["tpu.txt"]
+        assert client.statistics()["file_count"] == 3
+        assert {d["path"] for d in client.list_documents()} == {
+            "tpu.txt", "kafka.txt", "food.txt"
+        }
+    finally:
+        request_stop()
+        terminate_all()
+        if server._thread is not None:
+            server._thread.join(timeout=10)
