@@ -32,6 +32,7 @@ from .internals.expression import (
 from .internals.json import Json
 from .internals.parse_graph import G, Universe
 from .internals.run import MonitoringLevel, request_stop, run, run_all
+from .internals.sql import sql
 from .internals.schema import (
     Schema,
     assert_table_has_schema,
@@ -147,6 +148,7 @@ __all__ = [
     "run",
     "run_all",
     "schema_builder",
+    "sql",
     "schema_from_dict",
     "schema_from_types",
     "stateful",

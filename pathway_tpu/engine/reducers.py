@@ -77,6 +77,13 @@ class _H:
 def _hashable(v: Any) -> Any:
     if isinstance(v, (np.ndarray, dict, list, set)):
         return _H(v)
+    if isinstance(v, tuple):
+        # tuples are hashable only if their elements are (e.g. not a
+        # tuple of dicts, which index reply columns produce)
+        try:
+            hash(v)
+        except TypeError:
+            return _H(v)
     return v
 
 

@@ -54,6 +54,7 @@ class MetadataAccessor:
     def __init__(self, backend: PersistenceBackend):
         self._backend = backend
         self._version = -1
+        self._swept = False
         self.current: dict[str, Any] | None = None
         for key in backend.list_keys():
             if not key.startswith(_META_PREFIX):
@@ -76,11 +77,25 @@ class MetadataAccessor:
         self.current = meta
 
     def prune(self, keep: int = 2) -> None:
-        """Remove the metadata version just superseded beyond the newest
-        `keep`. O(1) per commit — versions are sequential, so deleting
-        ``version - keep`` at every commit keeps exactly `keep` around."""
+        """Remove superseded metadata versions. First call sweeps the whole
+        backlog (heals anything a crash between commit and prune left
+        behind); afterwards each commit deletes exactly one stale version —
+        O(1) per commit, one listing per process lifetime."""
         stale = self._version - keep
-        if stale >= 0:
+        if stale < 0:
+            return
+        if not self._swept:
+            for key in self._backend.list_keys():
+                if not key.startswith(_META_PREFIX):
+                    continue
+                try:
+                    version = int(key[len(_META_PREFIX):])
+                except ValueError:
+                    continue
+                if version <= stale:
+                    self._backend.remove_key(key)
+            self._swept = True
+        else:
             self._backend.remove_key(f"{_META_PREFIX}{stale:08d}")
 
 

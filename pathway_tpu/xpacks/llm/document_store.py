@@ -17,6 +17,8 @@ from ...internals import dtype as dt
 from ...internals.expression import apply_with_type
 from ...internals.table import Table
 from ...internals.thisclass import this
+from ...stdlib.indexing.data_index import _SCORE
+from ._utils import doc_dicts
 
 __all__ = ["DocumentStore", "SlidesDocumentStore"]
 
@@ -125,6 +127,11 @@ class DocumentStore:
         if metadata_filter:
             parts.append(f"({metadata_filter})")
         if globpattern:
+            if "'" in globpattern:
+                # the filter grammar's string literals have no escape form
+                raise ValueError(
+                    "filepath_globpattern must not contain single quotes"
+                )
             parts.append(f"globmatch('{globpattern}', path)")
         return " && ".join(parts) if parts else None
 
@@ -145,14 +152,8 @@ class DocumentStore:
         ).select(
             qid=pw.left.id,
             result=apply_with_type(
-                lambda texts, metas, scores: tuple(
-                    {"text": t, "metadata": m, "dist": -float(s)}
-                    for t, m, s in zip(texts or (), metas or (), scores or ())
-                ),
-                dt.ANY,
-                pw.right.text,
-                pw.right._metadata,
-                pw.right._pw_index_reply_score,
+                doc_dicts, dt.ANY,
+                pw.right.text, pw.right._metadata, pw.right[_SCORE],
             )
         )
         # key results by the incoming query rows (REST writers complete

@@ -6,9 +6,7 @@ strategy (:97), summarization, and the HTTP ``RAGClient``.
 
 from __future__ import annotations
 
-import json as _json
 import threading
-import urllib.request
 from typing import Any
 
 import pathway_tpu as pw
@@ -18,6 +16,7 @@ from ...internals.table import Table
 from ...internals.thisclass import this
 from ...stdlib.indexing.data_index import _SCORE
 from . import prompts
+from ._utils import HttpClientBase, doc_dicts
 from .prompts import NO_INFO_ANSWER
 
 __all__ = [
@@ -142,7 +141,14 @@ class BaseRAGQuestionAnswerer:
             ):
                 self._llm_fn_cached = prepare()
             else:
-                self._llm_fn_cached = self.llm.__wrapped__
+                # async retry/capacity wrappers can't be driven from this
+                # synchronous call path, but the cache wrapper can — don't
+                # silently drop with_cache for async-executor chats
+                fn = self.llm.__wrapped__
+                cache = getattr(self.llm, "_cache_strategy", None)
+                if cache is not None:
+                    fn = cache.wrap(fn)
+                self._llm_fn_cached = fn
         return self._llm_fn_cached
 
     def _enable_cache(self, cache_backend: Any) -> None:
@@ -183,14 +189,8 @@ class BaseRAGQuestionAnswerer:
             prompt=pw.left.prompt,
             return_context_docs=pw.left.return_context_docs,
             docs=apply_with_type(
-                lambda texts, metas, scores: tuple(
-                    {"text": t, "metadata": m, "dist": -float(s)}
-                    for t, m, s in zip(texts or (), metas or (), scores or ())
-                ),
-                dt.ANY,
-                pw.right.text,
-                pw.right._metadata,
-                pw.right[_SCORE],
+                doc_dicts, dt.ANY,
+                pw.right.text, pw.right._metadata, pw.right[_SCORE],
             ),
         )
         # responses must be keyed by the incoming query rows (the REST
@@ -341,23 +341,12 @@ class SummaryQuestionAnswerer(BaseRAGQuestionAnswerer):
     """Alias surface whose primary endpoint is summarization."""
 
 
-class RAGClient:
+class RAGClient(HttpClientBase):
     """HTTP client for the QA servers (reference question_answering.py
     RAGClient) — stdlib urllib, no extra deps."""
 
     def __init__(self, host: str | None = None, port: int | None = None, url: str | None = None, timeout: float = 90.0):
-        self.url = url or f"http://{host}:{port}"
-        self.timeout = timeout
-
-    def _post(self, route: str, payload: dict) -> Any:
-        req = urllib.request.Request(
-            self.url + route,
-            data=_json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return _json.loads(resp.read().decode())
+        super().__init__(host, port, url, timeout)
 
     def answer(self, prompt: str, filters: str | None = None, return_context_docs: bool = False) -> Any:
         payload: dict[str, Any] = {"prompt": prompt}

@@ -9,14 +9,13 @@ kernels in ``pathway_tpu/ops``.
 
 from __future__ import annotations
 
-import json as _json
 import threading
-import urllib.request
 from typing import Any, Callable, Iterable
 
 import pathway_tpu as pw
 from ...internals.table import Table
 from ...stdlib.indexing.nearest_neighbors import BruteForceKnnFactory
+from ._utils import HttpClientBase
 from .document_store import DocumentStore
 
 __all__ = ["VectorStoreServer", "VectorStoreClient"]
@@ -131,28 +130,8 @@ class VectorStoreServer:
         pw.run(**kwargs)
 
 
-class VectorStoreClient:
+class VectorStoreClient(HttpClientBase):
     """stdlib-urllib client for VectorStoreServer (reference :629)."""
-
-    def __init__(
-        self,
-        host: str | None = None,
-        port: int | None = None,
-        url: str | None = None,
-        timeout: float = 15.0,
-    ):
-        self.url = url or f"http://{host}:{port}"
-        self.timeout = timeout
-
-    def _post(self, route: str, payload: dict) -> Any:
-        req = urllib.request.Request(
-            self.url + route,
-            data=_json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return _json.loads(resp.read().decode())
 
     def query(
         self,

@@ -1,0 +1,218 @@
+"""pw.sql — SQL-to-Table compilation (reference test model:
+python/pathway/tests/test_sql.py over internals/sql.py)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T, assert_table_equality_wo_index
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _tab():
+    return T(
+        """
+        name  | dept | salary
+        alice | eng  | 100
+        bob   | eng  | 80
+        carol | ops  | 60
+        dave  | ops  | 40
+        erin  | mgmt | 120
+        """
+    )
+
+
+def test_select_where_arithmetic():
+    t = _tab()
+    res = pw.sql("SELECT name, salary * 2 AS double_pay FROM t WHERE salary >= 80", t=t)
+    expected = T(
+        """
+        name  | double_pay
+        alice | 200
+        bob   | 160
+        erin  | 240
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_select_star_and_boolean_ops():
+    t = _tab()
+    res = pw.sql(
+        "SELECT * FROM t WHERE dept = 'eng' OR (salary < 70 AND NOT dept = 'mgmt')",
+        t=t,
+    )
+    expected = T(
+        """
+        name  | dept | salary
+        alice | eng  | 100
+        bob   | eng  | 80
+        carol | ops  | 60
+        dave  | ops  | 40
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_group_by_aggregates_and_having():
+    t = _tab()
+    res = pw.sql(
+        "SELECT dept, COUNT(*) AS n, SUM(salary) AS total, AVG(salary) AS mean "
+        "FROM t GROUP BY dept HAVING SUM(salary) > 110",
+        t=t,
+    )
+    expected = T(
+        """
+        dept | n | total | mean
+        eng  | 2 | 180   | 90.0
+        mgmt | 1 | 120   | 120.0
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_join_on():
+    t = _tab()
+    d = T(
+        """
+        dept | location
+        eng  | berlin
+        ops  | paris
+        """
+    )
+    res = pw.sql(
+        "SELECT name, location FROM t JOIN d ON t.dept = d.dept WHERE salary > 50",
+        t=t, d=d,
+    )
+    expected = T(
+        """
+        name  | location
+        alice | berlin
+        bob   | berlin
+        carol | paris
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_union_and_distinct():
+    a = T(
+        """
+        x
+        1
+        2
+        """
+    )
+    b = T(
+        """
+        x
+        2
+        3
+        """
+    )
+    res = pw.sql("SELECT x FROM a UNION SELECT x FROM b", a=a, b=b)
+    expected = T(
+        """
+        x
+        1
+        2
+        3
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+    res_all = pw.sql("SELECT x FROM a UNION ALL SELECT x FROM b", a=a, b=b)
+    assert len(pw.debug.table_to_pandas(res_all)) == 4
+
+
+def test_case_when_in_between_like():
+    t = _tab()
+    res = pw.sql(
+        "SELECT name, CASE WHEN salary >= 100 THEN 'high' WHEN salary >= 60 "
+        "THEN 'mid' ELSE 'low' END AS band FROM t WHERE name LIKE '%a%'",
+        t=t,
+    )
+    expected = T(
+        """
+        name  | band
+        alice | high
+        carol | mid
+        dave  | low
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+    res2 = pw.sql("SELECT name FROM t WHERE salary BETWEEN 60 AND 100", t=t)
+    assert set(pw.debug.table_to_pandas(res2)["name"]) == {"alice", "bob", "carol"}
+
+    res3 = pw.sql("SELECT name FROM t WHERE dept IN ('eng', 'mgmt')", t=t)
+    assert set(pw.debug.table_to_pandas(res3)["name"]) == {"alice", "bob", "erin"}
+
+
+def test_scalar_functions():
+    t = T(
+        """
+        s     | v
+        Alice | -3
+        bob   | 4
+        """
+    )
+    res = pw.sql(
+        "SELECT upper(s) AS u, abs(v) AS a, length(s) AS l FROM t", t=t
+    )
+    expected = T(
+        """
+        u     | a | l
+        ALICE | 3 | 5
+        BOB   | 4 | 3
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_count_expr_skips_nulls():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(g=str, x=int | None),
+        [("a", 1), ("a", None), ("b", 3)],
+    )
+    res = pw.sql("SELECT g, COUNT(x) AS n, COUNT(*) AS total FROM t GROUP BY g", t=t)
+    expected = T(
+        """
+        g | n | total
+        a | 1 | 2
+        b | 1 | 1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_aggregate_inside_case():
+    t = T(
+        """
+        dept | salary
+        eng  | 100
+        eng  | 80
+        ops  | 40
+        """
+    )
+    res = pw.sql(
+        "SELECT dept, CASE WHEN SUM(salary) > 150 THEN 'big' ELSE 'small' END "
+        "AS sz FROM t GROUP BY dept",
+        t=t,
+    )
+    expected = T(
+        """
+        dept | sz
+        eng  | big
+        ops  | small
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
