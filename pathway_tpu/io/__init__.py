@@ -13,16 +13,60 @@ from typing import Any, Callable
 from ..internals.parse_graph import G
 from ..internals.table import Table
 
-from . import csv, fs, http, jsonlines, null, plaintext, python  # noqa: E402,F401
+from . import (  # noqa: E402,F401
+    airbyte,
+    bigquery,
+    csv,
+    debezium,
+    deltalake,
+    elasticsearch,
+    fs,
+    gdrive,
+    http,
+    jsonlines,
+    kafka,
+    logstash,
+    minio,
+    mongodb,
+    nats,
+    null,
+    plaintext,
+    postgres,
+    pubsub,
+    pyfilesystem,
+    python,
+    redpanda,
+    s3,
+    slack,
+    sqlite,
+)
 
 __all__ = [
+    "airbyte",
+    "bigquery",
     "csv",
+    "debezium",
+    "deltalake",
+    "elasticsearch",
     "fs",
+    "gdrive",
     "http",
     "jsonlines",
-    "plaintext",
-    "python",
+    "kafka",
+    "logstash",
+    "minio",
+    "mongodb",
+    "nats",
     "null",
+    "plaintext",
+    "postgres",
+    "pubsub",
+    "pyfilesystem",
+    "python",
+    "redpanda",
+    "s3",
+    "slack",
+    "sqlite",
     "subscribe",
     "OnChangeCallback",
     "OnFinishCallback",

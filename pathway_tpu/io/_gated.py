@@ -1,0 +1,26 @@
+"""Helper for connectors whose client libraries are not in this
+environment: full reference API surface, informative failure at call time
+(mirrors how the reference degrades when an optional extra is missing)."""
+
+from __future__ import annotations
+
+from typing import Any, NoReturn
+
+
+def require(module: str, pip_name: str, feature: str) -> Any:
+    try:
+        return __import__(module)
+    except ImportError as e:
+        raise ImportError(
+            f"{feature} requires the {pip_name!r} package, which is not "
+            "installed in this environment (no network egress). The "
+            "connector API matches the reference; install the client "
+            "library to activate it."
+        ) from e
+
+
+def unavailable(feature: str, pip_name: str) -> NoReturn:
+    raise ImportError(
+        f"{feature} requires the {pip_name!r} package, which is not "
+        "installed in this environment (no network egress)."
+    )

@@ -1,0 +1,22 @@
+"""``pw.io.airbyte`` — Airbyte-sourced streams (reference
+``python/pathway/io/airbyte`` over vendored airbyte_serverless, 300+
+sources). Gated: requires an airbyte runtime (docker or PyAirbyte)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..internals.table import Table
+from ._gated import unavailable
+
+__all__ = ["read"]
+
+
+def read(config_file_path: str, streams: list[str], *, mode: str = "streaming",
+         refresh_interval_ms: int = 60_000, name: str | None = None,
+         **kwargs: Any) -> Table:
+    try:
+        import airbyte  # type: ignore[import-not-found]  # noqa: F401
+    except ImportError:
+        unavailable("pw.io.airbyte.read", "airbyte")
+    raise NotImplementedError

@@ -1,0 +1,21 @@
+"""``pw.io.bigquery`` — BigQuery sink (reference
+``python/pathway/io/bigquery``). Gated on ``google-cloud-bigquery``."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..internals.table import Table
+from ._gated import unavailable
+
+__all__ = ["write"]
+
+
+def write(table: Table, dataset_name: str, table_name: str, *,
+          service_user_credentials_file: str | None = None,
+          name: str | None = None, **kwargs: Any) -> None:
+    try:
+        from google.cloud import bigquery  # type: ignore[attr-defined]  # noqa: F401
+    except ImportError:
+        unavailable("pw.io.bigquery.write", "google-cloud-bigquery")
+    raise NotImplementedError

@@ -1,0 +1,32 @@
+"""``pw.io.deltalake`` — Delta Lake source/sink (reference Rust
+``DeltaTableWriter``/``Reader``, ``src/connectors/data_storage.rs:1611,1902``).
+Gated on the ``deltalake`` library."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..internals.schema import SchemaMetaclass
+from ..internals.table import Table
+from ._gated import unavailable
+
+__all__ = ["read", "write"]
+
+
+def read(uri: str, *, schema: SchemaMetaclass | None = None, mode: str = "streaming",
+         autocommit_duration_ms: int | None = 1500, name: str | None = None,
+         **kwargs: Any) -> Table:
+    try:
+        import deltalake  # type: ignore[import-not-found]  # noqa: F401
+    except ImportError:
+        unavailable("pw.io.deltalake.read", "deltalake")
+    raise NotImplementedError
+
+
+def write(table: Table, uri: str, *, min_commit_frequency: int | None = 60_000,
+          name: str | None = None, **kwargs: Any) -> None:
+    try:
+        import deltalake  # type: ignore[import-not-found]  # noqa: F401
+    except ImportError:
+        unavailable("pw.io.deltalake.write", "deltalake")
+    raise NotImplementedError

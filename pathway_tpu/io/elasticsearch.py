@@ -1,0 +1,52 @@
+"""``pw.io.elasticsearch`` — Elasticsearch sink (reference Rust
+``ElasticSearchWriter``, ``src/connectors/data_storage.rs:1328``). Gated on
+the ``elasticsearch`` client library."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..internals.table import Table
+from ._gated import require
+
+__all__ = ["write", "ElasticSearchAuth", "ElasticSearchParams"]
+
+
+class ElasticSearchAuth:
+    def __init__(self, kind: str, **kwargs: Any):
+        self.kind = kind
+        self.options = kwargs
+
+    @classmethod
+    def basic(cls, username: str, password: str) -> "ElasticSearchAuth":
+        return cls("basic", username=username, password=password)
+
+    @classmethod
+    def apikey(cls, apikey_id: str, apikey: str) -> "ElasticSearchAuth":
+        return cls("apikey", apikey_id=apikey_id, apikey=apikey)
+
+    @classmethod
+    def bearer(cls, bearer: str) -> "ElasticSearchAuth":
+        return cls("bearer", bearer=bearer)
+
+
+class ElasticSearchParams:
+    def __init__(self, host: str, index_name: str, auth: ElasticSearchAuth):
+        self.host = host
+        self.index_name = index_name
+        self.auth = auth
+
+
+def write(table: Table, host: str | None = None, auth: ElasticSearchAuth | None = None,
+          index_name: str | None = None, **kwargs: Any) -> None:
+    es_mod = require("elasticsearch", "elasticsearch", "pw.io.elasticsearch")
+    client = es_mod.Elasticsearch(hosts=[host])
+    from . import subscribe
+
+    names = table.column_names()
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            client.index(index=index_name, document={n: row[n] for n in names})
+
+    subscribe(table, on_change=on_change)

@@ -1,0 +1,25 @@
+"""``pw.io.gdrive`` — Google Drive source (reference
+``python/pathway/io/gdrive``: polling scanner over the Drive API). Gated on
+``google-api-python-client``."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..internals.schema import SchemaMetaclass
+from ..internals.table import Table
+from ._gated import unavailable
+
+__all__ = ["read"]
+
+
+def read(object_id: str, *, mode: str = "streaming", format: str = "binary",
+         object_size_limit: int | None = None, refresh_interval: int = 30,
+         service_user_credentials_file: str | None = None,
+         with_metadata: bool = False, name: str | None = None,
+         schema: SchemaMetaclass | None = None, **kwargs: Any) -> Table:
+    try:
+        import googleapiclient  # type: ignore[import-not-found]  # noqa: F401
+    except ImportError:
+        unavailable("pw.io.gdrive.read", "google-api-python-client")
+    raise NotImplementedError

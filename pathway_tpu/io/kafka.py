@@ -1,0 +1,117 @@
+"""``pw.io.kafka`` — Kafka source/sink.
+
+Re-design of the Rust ``KafkaReader``/``KafkaWriter``
+(``src/connectors/data_storage.rs:692,1250``) + ``python/pathway/io/kafka``.
+The client library (confluent-kafka) is not in this environment, so the
+full reference signature is kept and activation is gated on the import:
+``read`` builds a ConnectorSubject wrapping a consumer poll loop (the
+reference's reader-thread model), ``write`` subscribes a producer.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from ..internals.schema import SchemaMetaclass
+from ..internals.table import Table
+from ._gated import require
+from .python import ConnectorSubject, read as python_read
+
+__all__ = ["read", "write", "simple_read"]
+
+
+def _require_client():
+    return require("confluent_kafka", "confluent-kafka", "pw.io.kafka")
+
+
+class _KafkaSubject(ConnectorSubject):
+    def __init__(self, consumer, topic: str, format: str):
+        super().__init__()
+        self._consumer = consumer
+        self._topic = topic
+        self._format = format
+        self._running = True
+
+    def run(self) -> None:
+        self._consumer.subscribe([self._topic])
+        while self._running:
+            msg = self._consumer.poll(0.2)
+            if msg is None:
+                continue
+            if msg.error():
+                continue
+            value = msg.value()
+            if self._format == "raw":
+                self.next(data=value)
+            else:
+                self.next(**json.loads(value))
+            self.commit()
+
+    def on_stop(self) -> None:
+        self._running = False
+        self._consumer.close()
+
+
+def read(
+    rdkafka_settings: dict,
+    topic: str | None = None,
+    *,
+    schema: SchemaMetaclass | None = None,
+    format: str = "raw",
+    autocommit_duration_ms: int | None = 1500,
+    topic_names: list[str] | None = None,
+    parallel_readers: int | None = None,
+    name: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    ck = _require_client()
+    consumer = ck.Consumer(rdkafka_settings)
+    topic = topic or (topic_names or [None])[0]
+    if topic is None:
+        raise ValueError("pass topic or topic_names")
+    if schema is None:
+        from ..internals.schema import schema_from_types
+
+        schema = schema_from_types(data=bytes)
+    return python_read(
+        _KafkaSubject(consumer, topic, format), schema=schema,
+        autocommit_duration_ms=autocommit_duration_ms, name=name,
+    )
+
+
+def simple_read(server: str, topic: str, *, format: str = "raw", **kwargs: Any) -> Table:
+    return read(
+        {"bootstrap.servers": server, "group.id": "pathway", "auto.offset.reset": "beginning"},
+        topic, format=format, **kwargs,
+    )
+
+
+def write(
+    table: Table,
+    rdkafka_settings: dict,
+    topic_name: str,
+    *,
+    format: str = "json",
+    key: Any = None,
+    headers: Any = None,
+    name: str | None = None,
+    **kwargs: Any,
+) -> None:
+    ck = _require_client()
+    producer = ck.Producer(rdkafka_settings)
+    from . import subscribe
+    from .http._server import _dumps
+
+    names = table.column_names()
+
+    def on_change(key_, row, time, is_addition):
+        payload = {**{n: row[n] for n in names}, "time": time,
+                   "diff": 1 if is_addition else -1}
+        producer.produce(topic_name, _dumps(payload).encode())
+        producer.poll(0)
+
+    def on_end():
+        producer.flush()
+
+    subscribe(table, on_change=on_change, on_end=on_end)

@@ -1,0 +1,30 @@
+"""``pw.io.mongodb`` — MongoDB sink (reference Rust ``MongoWriter``,
+``src/connectors/data_storage.rs:2187``). Gated on ``pymongo``."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..internals.table import Table
+from ._gated import require
+
+__all__ = ["write"]
+
+
+def write(table: Table, connection_string: str, database: str, collection: str,
+          *, max_batch_size: int | None = None, name: str | None = None,
+          **kwargs: Any) -> None:
+    pymongo = require("pymongo", "pymongo", "pw.io.mongodb")
+    client = pymongo.MongoClient(connection_string)
+    coll = client[database][collection]
+    from . import subscribe
+
+    names = table.column_names()
+
+    def on_change(key, row, time, is_addition):
+        doc = {n: row[n] for n in names}
+        doc["time"] = time
+        doc["diff"] = 1 if is_addition else -1
+        coll.insert_one(doc)
+
+    subscribe(table, on_change=on_change)

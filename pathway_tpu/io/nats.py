@@ -1,0 +1,32 @@
+"""``pw.io.nats`` — NATS source/sink (reference Rust ``NatsReader``/
+``NatsWriter``, ``src/connectors/data_storage.rs:2226,2300``). Gated on
+``nats-py``."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..internals.schema import SchemaMetaclass
+from ..internals.table import Table
+from ._gated import unavailable
+
+__all__ = ["read", "write"]
+
+
+def read(uri: str, topic: str, *, schema: SchemaMetaclass | None = None,
+         format: str = "json", autocommit_duration_ms: int | None = 1500,
+         name: str | None = None, **kwargs: Any) -> Table:
+    try:
+        import nats  # type: ignore[import-not-found]  # noqa: F401
+    except ImportError:
+        unavailable("pw.io.nats.read", "nats-py")
+    raise NotImplementedError
+
+
+def write(table: Table, uri: str, topic: str, *, format: str = "json",
+          name: str | None = None, **kwargs: Any) -> None:
+    try:
+        import nats  # type: ignore[import-not-found]  # noqa: F401
+    except ImportError:
+        unavailable("pw.io.nats.write", "nats-py")
+    raise NotImplementedError

@@ -1,0 +1,100 @@
+"""``pw.io.postgres`` — PostgreSQL sink.
+
+Re-design of the Rust ``PsqlWriter`` + ``PsqlUpdates``/``PsqlSnapshotFormatter``
+(``src/connectors/data_storage.rs:1072``, ``data_format.rs:1632,1691``):
+``write`` appends the full update stream (time/diff columns); ``write_snapshot``
+maintains the current table state via per-key upserts/deletes. Gated on a
+postgres client library (psycopg), matching the reference API.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..internals.table import Table
+from ._gated import require
+
+__all__ = ["write", "write_snapshot"]
+
+
+def _connect(postgres_settings: dict):
+    try:
+        psycopg = __import__("psycopg")
+    except ImportError:
+        psycopg = None
+    if psycopg is not None:
+        return psycopg.connect(**postgres_settings)
+    psycopg2 = require("psycopg2", "psycopg2", "pw.io.postgres")
+    return psycopg2.connect(**postgres_settings)
+
+
+def write(
+    table: Table,
+    postgres_settings: dict,
+    table_name: str,
+    *,
+    max_batch_size: int | None = None,
+    init_mode: str = "default",
+    name: str | None = None,
+    **kwargs: Any,
+) -> None:
+    """Append every row update with time/diff (reference PsqlUpdates)."""
+    conn = _connect(postgres_settings)
+    from . import subscribe
+
+    names = table.column_names()
+    cols = ", ".join(names + ["time", "diff"])
+    ph = ", ".join(["%s"] * (len(names) + 2))
+    sql = f"INSERT INTO {table_name} ({cols}) VALUES ({ph})"
+
+    def on_change(key, row, time, is_addition):
+        with conn.cursor() as cur:
+            cur.execute(sql, [row[n] for n in names] + [time, 1 if is_addition else -1])
+        conn.commit()
+
+    def on_end():
+        conn.close()
+
+    subscribe(table, on_change=on_change, on_end=on_end)
+
+
+def write_snapshot(
+    table: Table,
+    postgres_settings: dict,
+    table_name: str,
+    primary_key: list[str],
+    *,
+    max_batch_size: int | None = None,
+    init_mode: str = "default",
+    name: str | None = None,
+    **kwargs: Any,
+) -> None:
+    """Maintain the current state: upsert on addition, delete on retraction
+    (reference PsqlSnapshotFormatter)."""
+    conn = _connect(postgres_settings)
+    from . import subscribe
+
+    names = table.column_names()
+    cols = ", ".join(names)
+    ph = ", ".join(["%s"] * len(names))
+    conflict = ", ".join(primary_key)
+    updates = ", ".join(f"{n} = EXCLUDED.{n}" for n in names if n not in primary_key)
+    upsert = (
+        f"INSERT INTO {table_name} ({cols}) VALUES ({ph}) "
+        f"ON CONFLICT ({conflict}) DO UPDATE SET {updates}"
+    )
+    where = " AND ".join(f"{k} = %s" for k in primary_key)
+    delete = f"DELETE FROM {table_name} WHERE {where}"
+
+    def on_change(key, row, time, is_addition):
+        with conn.cursor() as cur:
+            if is_addition:
+                cur.execute(upsert, [row[n] for n in names])
+            else:
+                cur.execute(delete, [row[k] for k in primary_key])
+        conn.commit()
+
+    def on_end():
+        conn.close()
+
+    subscribe(table, on_change=on_change, on_end=on_end)

@@ -1,0 +1,20 @@
+"""``pw.io.pubsub`` — Google Pub/Sub sink (reference
+``python/pathway/io/pubsub``). Gated on ``google-cloud-pubsub``."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..internals.table import Table
+from ._gated import unavailable
+
+__all__ = ["write"]
+
+
+def write(table: Table, publisher: Any = None, project_id: str | None = None,
+          topic_id: str | None = None, **kwargs: Any) -> None:
+    try:
+        from google.cloud import pubsub_v1  # type: ignore[attr-defined]  # noqa: F401
+    except ImportError:
+        unavailable("pw.io.pubsub.write", "google-cloud-pubsub")
+    raise NotImplementedError

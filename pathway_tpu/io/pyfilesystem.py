@@ -1,0 +1,22 @@
+"""``pw.io.pyfilesystem`` — PyFilesystem source (reference
+``python/pathway/io/pyfilesystem``). Gated on the ``fs`` package."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..internals.table import Table
+from ._gated import unavailable
+
+__all__ = ["read"]
+
+
+def read(source: Any, *, path: str | None = None, format: str = "binary",
+         mode: str = "streaming", refresh_interval: int = 30,
+         with_metadata: bool = False, name: str | None = None,
+         **kwargs: Any) -> Table:
+    try:
+        import fs  # type: ignore[import-not-found]  # noqa: F401
+    except ImportError:
+        unavailable("pw.io.pyfilesystem.read", "fs")
+    raise NotImplementedError

@@ -1,0 +1,6 @@
+"""``pw.io.redpanda`` — Redpanda is Kafka-protocol compatible
+(reference ``python/pathway/io/redpanda`` re-exports kafka)."""
+
+from .kafka import read, simple_read, write  # noqa: F401
+
+__all__ = ["read", "write", "simple_read"]

@@ -1,0 +1,41 @@
+"""``pw.io.s3`` (+ ``minio``) — S3-compatible object-store source
+(reference Rust s3 scanner, ``src/connectors/scanner/s3.rs`` +
+``python/pathway/io/s3``). Gated on ``boto3``."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..internals.schema import SchemaMetaclass
+from ..internals.table import Table
+from ._gated import unavailable
+
+__all__ = ["read", "AwsS3Settings", "DigitalOceanS3Settings", "WasabiS3Settings"]
+
+
+class AwsS3Settings:
+    def __init__(self, *, bucket_name: str | None = None, access_key: str | None = None,
+                 secret_access_key: str | None = None, with_path_style: bool = False,
+                 region: str | None = None, endpoint: str | None = None, **kwargs: Any):
+        self.bucket_name = bucket_name
+        self.access_key = access_key
+        self.secret_access_key = secret_access_key
+        self.with_path_style = with_path_style
+        self.region = region
+        self.endpoint = endpoint
+
+
+DigitalOceanS3Settings = AwsS3Settings
+WasabiS3Settings = AwsS3Settings
+
+
+def read(path: str, *, aws_s3_settings: AwsS3Settings | None = None,
+         format: str = "binary", schema: SchemaMetaclass | None = None,
+         mode: str = "streaming", with_metadata: bool = False,
+         autocommit_duration_ms: int | None = 1500, name: str | None = None,
+         **kwargs: Any) -> Table:
+    try:
+        import boto3  # type: ignore[import-not-found]  # noqa: F401
+    except ImportError:
+        unavailable("pw.io.s3.read", "boto3")
+    raise NotImplementedError

@@ -1,0 +1,46 @@
+"""``pw.io.slack`` — Slack alert sink (reference ``python/pathway/io/slack``:
+posts one chat.postMessage per row of a single-text-column table)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..internals.table import Table
+
+__all__ = ["send_alerts"]
+
+_SLACK_URL = "https://slack.com/api/chat.postMessage"
+
+
+def send_alerts(
+    messages: Table,
+    slack_channel_id: str,
+    slack_token: str,
+    **kwargs: Any,
+) -> None:
+    """Each addition in the (single text column) table becomes one Slack
+    message to the channel."""
+    from . import subscribe
+
+    (col,) = messages.column_names()
+
+    def on_change(key, row, time, is_addition):
+        if not is_addition:
+            return
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            _SLACK_URL,
+            data=json.dumps(
+                {"channel": slack_channel_id, "text": str(row[col])}
+            ).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "Authorization": f"Bearer {slack_token}",
+            },
+            method="POST",
+        )
+        urllib.request.urlopen(req, timeout=30)
+
+    subscribe(messages, on_change=on_change)
