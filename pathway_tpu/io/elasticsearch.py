@@ -40,7 +40,19 @@ class ElasticSearchParams:
 def write(table: Table, host: str | None = None, auth: ElasticSearchAuth | None = None,
           index_name: str | None = None, **kwargs: Any) -> None:
     es_mod = require("elasticsearch", "elasticsearch", "pw.io.elasticsearch")
-    client = es_mod.Elasticsearch(hosts=[host])
+    client_kwargs: dict[str, Any] = {"hosts": [host]}
+    if auth is not None:
+        if auth.kind == "basic":
+            client_kwargs["basic_auth"] = (
+                auth.options["username"], auth.options["password"]
+            )
+        elif auth.kind == "apikey":
+            client_kwargs["api_key"] = (
+                auth.options["apikey_id"], auth.options["apikey"]
+            )
+        elif auth.kind == "bearer":
+            client_kwargs["bearer_auth"] = auth.options["bearer"]
+    client = es_mod.Elasticsearch(**client_kwargs)
     from . import subscribe
 
     names = table.column_names()

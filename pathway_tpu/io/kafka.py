@@ -26,15 +26,15 @@ def _require_client():
 
 
 class _KafkaSubject(ConnectorSubject):
-    def __init__(self, consumer, topic: str, format: str):
+    def __init__(self, consumer, topics: list[str], format: str):
         super().__init__()
         self._consumer = consumer
-        self._topic = topic
+        self._topics = list(topics)
         self._format = format
         self._running = True
 
     def run(self) -> None:
-        self._consumer.subscribe([self._topic])
+        self._consumer.subscribe(self._topics)
         while self._running:
             msg = self._consumer.poll(0.2)
             if msg is None:
@@ -67,15 +67,20 @@ def read(
 ) -> Table:
     ck = _require_client()
     consumer = ck.Consumer(rdkafka_settings)
-    topic = topic or (topic_names or [None])[0]
-    if topic is None:
+    topics = list(topic_names or ([] if topic is None else [topic]))
+    if not topics:
         raise ValueError("pass topic or topic_names")
     if schema is None:
+        if format != "raw":
+            raise ValueError(
+                f"format={format!r} needs schema= (the decoded fields define "
+                "the columns); only format='raw' has a default data column"
+            )
         from ..internals.schema import schema_from_types
 
         schema = schema_from_types(data=bytes)
     return python_read(
-        _KafkaSubject(consumer, topic, format), schema=schema,
+        _KafkaSubject(consumer, topics, format), schema=schema,
         autocommit_duration_ms=autocommit_duration_ms, name=name,
     )
 

@@ -17,6 +17,40 @@ from ._gated import require
 __all__ = ["write", "write_snapshot"]
 
 
+_SQL_TYPES = {
+    "INT": "BIGINT", "FLOAT": "DOUBLE PRECISION", "BOOL": "BOOLEAN",
+    "STR": "TEXT", "BYTES": "BYTEA", "POINTER": "BIGINT", "ANY": "TEXT",
+    "JSON": "JSONB",
+}
+
+
+def _sql_type(dtype) -> str:
+    from ..internals import dtype as dt
+
+    u = dt.unoptionalize(dtype)
+    return _SQL_TYPES.get(getattr(u, "name", str(u)), "TEXT")
+
+
+def _init_table(conn, table, table_name: str, init_mode: str,
+                extra_cols: list[str], primary_key: list[str] | None) -> None:
+    """init_mode: default (table must exist) | create_if_not_exists |
+    replace (reference data_storage.rs table init modes)."""
+    if init_mode == "default":
+        return
+    cols = [
+        f'{n} {_sql_type(cs.dtype)}'
+        for n, cs in table.schema.columns().items()
+    ] + extra_cols
+    if primary_key:
+        cols.append(f"PRIMARY KEY ({', '.join(primary_key)})")
+    ddl = f"CREATE TABLE IF NOT EXISTS {table_name} ({', '.join(cols)})"
+    with conn.cursor() as cur:
+        if init_mode == "replace":
+            cur.execute(f"DROP TABLE IF EXISTS {table_name}")
+        cur.execute(ddl)
+    conn.commit()
+
+
 def _connect(postgres_settings: dict):
     try:
         psycopg = __import__("psycopg")
@@ -38,24 +72,41 @@ def write(
     name: str | None = None,
     **kwargs: Any,
 ) -> None:
-    """Append every row update with time/diff (reference PsqlUpdates)."""
+    """Append every row update with time/diff (reference PsqlUpdates).
+    Rows are batched per commit tick (and by max_batch_size) instead of
+    one transaction per row."""
     conn = _connect(postgres_settings)
+    _init_table(conn, table, table_name, init_mode,
+                ["time BIGINT", "diff BIGINT"], None)
     from . import subscribe
 
     names = table.column_names()
     cols = ", ".join(names + ["time", "diff"])
     ph = ", ".join(["%s"] * (len(names) + 2))
     sql = f"INSERT INTO {table_name} ({cols}) VALUES ({ph})"
+    pending: list[list] = []
+
+    def flush():
+        if not pending:
+            return
+        with conn.cursor() as cur:
+            cur.executemany(sql, pending)
+        conn.commit()
+        pending.clear()
 
     def on_change(key, row, time, is_addition):
-        with conn.cursor() as cur:
-            cur.execute(sql, [row[n] for n in names] + [time, 1 if is_addition else -1])
-        conn.commit()
+        pending.append([row[n] for n in names] + [time, 1 if is_addition else -1])
+        if max_batch_size is not None and len(pending) >= max_batch_size:
+            flush()
+
+    def on_time_end(time):
+        flush()
 
     def on_end():
+        flush()
         conn.close()
 
-    subscribe(table, on_change=on_change, on_end=on_end)
+    subscribe(table, on_change=on_change, on_time_end=on_time_end, on_end=on_end)
 
 
 def write_snapshot(
@@ -70,8 +121,9 @@ def write_snapshot(
     **kwargs: Any,
 ) -> None:
     """Maintain the current state: upsert on addition, delete on retraction
-    (reference PsqlSnapshotFormatter)."""
+    (reference PsqlSnapshotFormatter). Statements batch per commit tick."""
     conn = _connect(postgres_settings)
+    _init_table(conn, table, table_name, init_mode, [], primary_key)
     from . import subscribe
 
     names = table.column_names()
@@ -86,15 +138,30 @@ def write_snapshot(
     where = " AND ".join(f"{k} = %s" for k in primary_key)
     delete = f"DELETE FROM {table_name} WHERE {where}"
 
-    def on_change(key, row, time, is_addition):
+    pending: list[tuple[str, list]] = []
+
+    def flush():
+        if not pending:
+            return
         with conn.cursor() as cur:
-            if is_addition:
-                cur.execute(upsert, [row[n] for n in names])
-            else:
-                cur.execute(delete, [row[k] for k in primary_key])
+            for stmt, params in pending:
+                cur.execute(stmt, params)
         conn.commit()
+        pending.clear()
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            pending.append((upsert, [row[n] for n in names]))
+        else:
+            pending.append((delete, [row[k] for k in primary_key]))
+        if max_batch_size is not None and len(pending) >= max_batch_size:
+            flush()
+
+    def on_time_end(time):
+        flush()
 
     def on_end():
+        flush()
         conn.close()
 
-    subscribe(table, on_change=on_change, on_end=on_end)
+    subscribe(table, on_change=on_change, on_time_end=on_time_end, on_end=on_end)

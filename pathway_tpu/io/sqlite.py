@@ -35,14 +35,6 @@ def _snapshot(path: str, table_name: str, names: list[str]) -> list[tuple]:
         con.close()
 
 
-def _data_version(path: str) -> int:
-    con = sqlite3.connect(f"file:{path}?mode=ro", uri=True)
-    try:
-        return int(con.execute("PRAGMA data_version").fetchone()[0])
-    finally:
-        con.close()
-
-
 class SqliteStreamSource(RealtimeSource):
     """Polls the db; on any change, diffs the full snapshot against the
     last one by primary key and emits the delta."""
@@ -62,7 +54,9 @@ class SqliteStreamSource(RealtimeSource):
         self.pk_indices = pk_indices
         self.poll_interval_s = poll_interval_s
         self._last: dict[tuple, tuple] = {}
-        self._mtime: float | None = None
+        self._con: sqlite3.Connection | None = None
+        self._data_version: int | None = None
+        self._next_poll = 0.0
         self._primed = False
 
     def _pk(self, row: tuple) -> tuple:
@@ -86,15 +80,27 @@ class SqliteStreamSource(RealtimeSource):
         return out
 
     def poll(self) -> list[Delta]:
-        import os
+        import time as _time
 
+        now = _time.monotonic()
+        if now < self._next_poll:
+            return []
+        self._next_poll = now + self.poll_interval_s
+        # PRAGMA data_version increments (per connection) whenever another
+        # connection committed — visible under WAL too, unlike file mtime
         try:
-            mtime = os.stat(self.path).st_mtime_ns
-        except OSError:
+            if self._con is None:
+                self._con = sqlite3.connect(
+                    f"file:{self.path}?mode=ro", uri=True,
+                    check_same_thread=False,
+                )
+            version = int(self._con.execute("PRAGMA data_version").fetchone()[0])
+        except sqlite3.Error:
+            self._con = None
             return []
-        if self._primed and mtime == self._mtime:
+        if self._primed and version == self._data_version:
             return []
-        self._mtime = mtime
+        self._data_version = version
         self._primed = True
         changes = self._diff()
         if not changes:
@@ -106,6 +112,11 @@ class SqliteStreamSource(RealtimeSource):
 
     def is_finished(self) -> bool:
         return False
+
+    def stop(self) -> None:
+        if self._con is not None:
+            self._con.close()
+            self._con = None
 
 
 def read(

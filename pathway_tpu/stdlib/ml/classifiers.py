@@ -1,0 +1,112 @@
+"""kNN-LSH classifiers (reference
+``python/pathway/stdlib/ml/classifiers/_knn_lsh.py``):
+``knn_lsh_classifier_train`` builds an index over training points,
+``knn_lsh_classify`` labels queries by majority vote of their k nearest
+training points. The distance kernels run on TPU via ``pw.ml.index``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...internals import dtype as dt
+from ...internals.expression import ColumnReference, apply_with_type
+from ...internals.table import Table
+from ...internals.thisclass import this
+from .index import KNNIndex
+
+__all__ = [
+    "knn_lsh_classifier_train",
+    "knn_lsh_train",
+    "knn_lsh_classify",
+    "knn_lsh_generic_classifier_train",
+    "knn_lsh_euclidean_classifier_train",
+]
+
+
+def knn_lsh_classifier_train(
+    data: Table,
+    L: int = 20,
+    type: str = "euclidean",  # noqa: A002 - reference parameter name
+    **kwargs: Any,
+) -> KNNIndex:
+    """Index training vectors (column ``data``); returns the queryable
+    model (reference _knn_lsh.py knn_lsh_classifier_train)."""
+    d = kwargs.get("d")
+    if d is None:
+        raise ValueError("pass d= (embedding dimensionality)")
+    return KNNIndex(
+        ColumnReference(data, "data"),
+        data,
+        n_dimensions=d,
+        n_or=L,
+        n_and=kwargs.get("M", 10),
+        bucket_length=kwargs.get("A", 10.0),
+        distance_type=type,
+    )
+
+
+knn_lsh_train = knn_lsh_classifier_train
+knn_lsh_generic_classifier_train = knn_lsh_classifier_train
+
+
+def knn_lsh_euclidean_classifier_train(data: Table, d: int, M: int = 10, L: int = 20, A: float = 10.0) -> KNNIndex:
+    return knn_lsh_classifier_train(data, L=L, type="euclidean", d=d, M=M, A=A)
+
+
+def knn_lsh_classify(
+    knn_model: KNNIndex, data_labels: Table, queries: Table, k: int = 3
+) -> Table:
+    """Majority label among the k nearest training points
+    (reference _knn_lsh.py knn_lsh_classify)."""
+    from ..indexing.data_index import _MATCHED_ID
+    from ...internals.thisclass import left as l_, right as r_
+
+    # collapsed matches with the training row ids (the classify path needs
+    # ids, which the user-facing get_nearest_items projection drops)
+    hits = knn_model._index.query(
+        ColumnReference(queries, "data"),
+        number_of_matches=k,
+        collapse_rows=True,
+    ).select(**{"__ids": getattr(r_, _MATCHED_ID)})
+
+    label_col = data_labels.column_names()[0]
+    id_to_label = data_labels.reduce(
+        __pairs=_tuple_of_pairs(data_labels, label_col),
+    )
+
+    tagged = hits.with_columns(__one=0)
+    lookup = id_to_label.select(__one=0, __pairs=this["__pairs"])
+    joined = tagged.join_left(
+        lookup, l_["__one"] == r_["__one"]
+    ).select(
+        __ids=l_["__ids"],
+        __pairs=r_["__pairs"],
+    )
+
+    def vote(ids, pairs):
+        from collections import Counter
+
+        mapping = dict(pairs or ())
+        votes = Counter(
+            mapping[i] for i in (ids or ()) if i in mapping
+        )
+        if not votes:
+            return None
+        return votes.most_common(1)[0][0]
+
+    return joined.select(
+        predicted_label=apply_with_type(
+            vote, dt.ANY, this["__ids"], this["__pairs"]
+        )
+    )
+
+
+def _tuple_of_pairs(table: Table, label_col: str):
+    from ... import reducers
+
+    return reducers.tuple(
+        apply_with_type(
+            lambda i, v: (int(i), v), dt.ANY, table.id, table[label_col]
+        )
+    )

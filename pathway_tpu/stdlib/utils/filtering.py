@@ -1,0 +1,29 @@
+"""Row filters (reference ``python/pathway/stdlib/utils/filtering.py``):
+``argmax_rows`` (:8) / ``argmin_rows`` (:20) — keep, per group, the row
+extremizing a column."""
+
+from __future__ import annotations
+
+from ... import reducers
+from ...internals.expression import ColumnReference
+from ...internals.table import Table
+from ...internals.thisclass import this
+
+__all__ = ["argmax_rows", "argmin_rows"]
+
+
+def _extreme_rows(table: Table, *on: ColumnReference, what: ColumnReference, reducer) -> Table:
+    winners = (
+        table.groupby(*on)
+        .reduce(__winner=reducer(what))
+        .with_id(this["__winner"])
+    )
+    return table.restrict(winners)
+
+
+def argmax_rows(table: Table, *on: ColumnReference, what: ColumnReference) -> Table:
+    return _extreme_rows(table, *on, what=what, reducer=reducers.argmax)
+
+
+def argmin_rows(table: Table, *on: ColumnReference, what: ColumnReference) -> Table:
+    return _extreme_rows(table, *on, what=what, reducer=reducers.argmin)

@@ -1,0 +1,235 @@
+"""pw.ml (KNN classifier, fuzzy join, HMM) + pw.utils (col helpers,
+AsyncTransformer, pandas_transformer) — reference test model:
+python/pathway/stdlib/ml tests + tests/test_utils*."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T, assert_table_equality_wo_index
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+# -- pw.utils ---------------------------------------------------------------
+
+
+def test_unpack_col():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(data=tuple), [((1, "a"),), ((2, "b"),)]
+    )
+    res = pw.utils.unpack_col(t.data, "num", "letter")
+    expected = T(
+        """
+        num | letter
+        1   | a
+        2   | b
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_groupby_reduce_majority():
+    t = T(
+        """
+        g | v
+        a | 1
+        a | 1
+        a | 2
+        b | 3
+        """
+    )
+    res = pw.utils.groupby_reduce_majority(t.g, t.v)
+    expected = T(
+        """
+        group | majority
+        a     | 1
+        b     | 3
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_argmax_rows():
+    t = T(
+        """
+        g | v  | name
+        a | 10 | x
+        a | 20 | y
+        b | 5  | z
+        """
+    )
+    res = pw.utils.argmax_rows(t, t.g, what=t.v)
+    expected = T(
+        """
+        g | v  | name
+        a | 20 | y
+        b | 5  | z
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_apply_all_rows():
+    t = T(
+        """
+        v
+        1
+        2
+        3
+        """
+    )
+    # global max-normalization needs all rows at once
+    res = pw.utils.apply_all_rows(
+        t.v, fun=lambda vs: [x / max(vs) for x in vs], result_col_name="frac"
+    )
+    vals = sorted(pw.debug.table_to_pandas(res)["frac"])
+    assert vals == [pytest.approx(1 / 3), pytest.approx(2 / 3), pytest.approx(1.0)]
+
+
+def test_async_transformer():
+    class Upper(pw.utils.AsyncTransformer):
+        output_schema = pw.schema_from_types(up=str)
+
+        async def invoke(self, word):
+            if word == "bad":
+                raise ValueError("nope")
+            return {"up": word.upper()}
+
+    t = T(
+        """
+        word
+        foo
+        bad
+        bar
+        """
+    )
+    tr = Upper(t)
+    ok = pw.debug.table_to_pandas(tr.successful)
+    assert sorted(ok["up"]) == ["BAR", "FOO"]
+    G.clear()
+    t = T(
+        """
+        word
+        foo
+        bad
+        """
+    )
+    assert len(pw.debug.table_to_pandas(Upper(t).failed)) == 1
+
+
+def test_pandas_transformer():
+    @pw.utils.pandas_transformer(
+        output_schema=pw.schema_from_types(doubled=int)
+    )
+    def double(df):
+        out = df[["v"]].rename(columns={"v": "doubled"})
+        out["doubled"] = out["doubled"] * 2
+        return out
+
+    t = T(
+        """
+        v
+        1
+        2
+        """
+    )
+    res = double(t)
+    assert sorted(pw.debug.table_to_pandas(res)["doubled"]) == [2, 4]
+
+
+# -- pw.ml ------------------------------------------------------------------
+
+
+def _vec_table(rows):
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(data=np.ndarray), [(np.asarray(r, float),) for r in rows]
+    )
+
+
+def test_knn_classifier():
+    data = _vec_table([[0.0, 0.0], [0.1, 0.1], [5.0, 5.0], [5.1, 5.1]])
+    labels = pw.debug.table_from_rows(
+        pw.schema_from_types(label=str), [("low",), ("low",), ("high",), ("high",)]
+    )
+    # labels table must share the data table's keys
+    labels = data.select(
+        label=pw.apply(
+            lambda v: "low" if float(v[0]) < 2 else "high", pw.this.data
+        )
+    )
+    model = pw.ml.knn_lsh_classifier_train(data, L=20, type="euclidean", d=2)
+    queries = _vec_table([[0.2, 0.0], [4.9, 5.3]])
+    predicted = pw.ml.knn_lsh_classify(model, labels, queries, k=2)
+    assert sorted(
+        pw.debug.table_to_pandas(predicted)["predicted_label"]
+    ) == ["high", "low"]
+
+
+def test_fuzzy_match():
+    left = pw.debug.table_from_rows(
+        pw.schema_from_types(txt=str),
+        [("apple inc",), ("alphabet google",), ("microsoft corp",)],
+    )
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(txt=str),
+        [("apple incorporated",), ("google llc",), ("msft corporation",)],
+    )
+    res = pw.ml.fuzzy_match(left.txt, right.txt)
+    df = pw.debug.table_to_pandas(res)
+    # resolve matched ids back to text
+    lmap = {r.key: r.txt for r in _rows_with_keys(left)}
+    rmap = {r.key: r.txt for r in _rows_with_keys(right)}
+    pairs = {(lmap[int(l)], rmap[int(r)]) for l, r in zip(df["left"], df["right"])}
+    assert ("apple inc", "apple incorporated") in pairs
+    assert ("alphabet google", "google llc") in pairs
+
+
+def _rows_with_keys(table):
+    import collections
+
+    df = pw.debug.table_to_pandas(table, include_id=True)
+    Row = collections.namedtuple("Row", ["key", "txt"])
+    return [Row(int(i), r["txt"]) for i, r in df.iterrows()]
+
+
+def test_hmm_reducer():
+    import math
+
+    import networkx as nx
+
+    g = nx.DiGraph()
+    # two states; emissions make the decoded state follow the observation
+    def log_ppb(dst):
+        def calc(obs):
+            return math.log(0.9) if obs == dst else math.log(0.1)
+        return calc
+
+    for s in ("A", "B"):
+        g.add_node(s, initial_log_ppb=math.log(0.5))
+    for u in ("A", "B"):
+        for v in ("A", "B"):
+            g.add_edge(u, v, calc_log_ppb=log_ppb(v))
+
+    reducer = pw.ml.create_hmm_reducer(g)
+    t = T(
+        """
+        grp | obs | __time__
+        x   | A   | 2
+        x   | A   | 4
+        x   | B   | 6
+        """
+    )
+    decoded = t.groupby(pw.this.grp).reduce(
+        grp=pw.this.grp, state=reducer(pw.this.obs)
+    )
+    [state] = pw.debug.table_to_pandas(decoded)["state"].tolist()
+    assert state == "B"
