@@ -33,6 +33,8 @@ from .internals.json import Json
 from .internals.parse_graph import G, Universe
 from .internals.run import MonitoringLevel, request_stop, run, run_all
 from .internals.sql import sql
+from .internals.config import PathwayConfig, get_pathway_config
+from .internals.yaml_loader import load_yaml
 from .internals.schema import (
     Schema,
     assert_table_has_schema,
@@ -56,8 +58,8 @@ from .internals.joins import Joinable, JoinMode, JoinResult
 from .internals.thisclass import left, right, this
 from .udfs import UDF, udf, udf_async
 
-from . import debug, demo, io, persistence, stdlib  # noqa: E402
-from .stdlib import graphs, indexing, ml, ordered, stateful, statistical, temporal, utils  # noqa: E402
+from . import debug, demo, io, persistence, stdlib, universes  # noqa: E402
+from .stdlib import graphs, indexing, ml, ordered, stateful, statistical, temporal, utils, viz  # noqa: E402
 
 __version__ = "0.1.0"
 
@@ -149,6 +151,11 @@ __all__ = [
     "run_all",
     "schema_builder",
     "sql",
+    "universes",
+    "viz",
+    "PathwayConfig",
+    "get_pathway_config",
+    "load_yaml",
     "schema_from_dict",
     "schema_from_types",
     "stateful",

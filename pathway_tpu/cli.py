@@ -1,0 +1,97 @@
+"""``pathway`` CLI (reference ``python/pathway/cli.py:53-280``):
+``spawn`` launches a program over N processes × T threads with the worker
+environment set; ``replay`` re-runs a program against recorded input
+(``--record`` under spawn captures it).
+
+Run as ``python -m pathway_tpu.cli`` or the ``pathway-tpu`` entry point.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import click
+
+from .internals.config import MAX_WORKERS
+
+__all__ = ["main", "spawn", "replay"]
+
+
+@click.group()
+def main() -> None:
+    """pathway_tpu command line."""
+
+
+def _spawn_processes(
+    threads: int, processes: int, first_port: int, env_extra: dict, args: tuple[str, ...]
+) -> int:
+    if threads * processes > MAX_WORKERS:
+        raise click.ClickException(
+            f"{threads}×{processes} workers exceed the {MAX_WORKERS}-worker limit"
+        )
+    program = list(args)
+    if not program:
+        raise click.ClickException("pass the program to run, e.g. python app.py")
+    base_env = {
+        **os.environ,
+        "PATHWAY_THREADS": str(threads),
+        "PATHWAY_PROCESSES": str(processes),
+        "PATHWAY_FIRST_PORT": str(first_port),
+        **env_extra,
+    }
+    if processes <= 1:
+        env = {**base_env, "PATHWAY_PROCESS_ID": "0"}
+        return subprocess.call(program, env=env)
+    procs = []
+    for pid in range(processes):
+        env = {**base_env, "PATHWAY_PROCESS_ID": str(pid)}
+        procs.append(subprocess.Popen(program, env=env))
+    code = 0
+    for p in procs:
+        code = p.wait() or code
+    return code
+
+
+@main.command(context_settings={"ignore_unknown_options": True})
+@click.option("-t", "--threads", type=int, default=1, help="worker threads per process")
+@click.option("-n", "--processes", type=int, default=1, help="number of processes")
+@click.option("--first-port", type=int, default=10000, help="cluster port base")
+@click.option("--record", is_flag=True, default=False,
+              help="record input streams for later replay")
+@click.option("--record-path", type=str, default="record",
+              help="where recorded input lands")
+@click.argument("program", nargs=-1, type=click.UNPROCESSED)
+def spawn(threads, processes, first_port, record, record_path, program):
+    """Launch PROGRAM with the worker environment set (reference cli.py:53)."""
+    env_extra: dict[str, str] = {}
+    if record:
+        env_extra["PATHWAY_REPLAY_STORAGE"] = record_path
+        env_extra["PATHWAY_SNAPSHOT_ACCESS"] = "record"
+    sys.exit(_spawn_processes(threads, processes, first_port, env_extra, program))
+
+
+@main.command(context_settings={"ignore_unknown_options": True})
+@click.option("-t", "--threads", type=int, default=1)
+@click.option("-n", "--processes", type=int, default=1)
+@click.option("--record-path", type=str, default="record")
+@click.option("--mode", type=click.Choice(["batch", "speedrun"]), default="batch",
+              help="replay all at once (batch) or with original pacing")
+@click.option("--continue", "continue_after_replay", is_flag=True, default=False,
+              help="keep consuming live data after the replay finishes")
+@click.argument("program", nargs=-1, type=click.UNPROCESSED)
+def replay(threads, processes, record_path, mode, continue_after_replay, program):
+    """Re-run PROGRAM against recorded input (reference cli.py:194)."""
+    env_extra = {
+        "PATHWAY_REPLAY_STORAGE": record_path,
+        "PATHWAY_SNAPSHOT_ACCESS": "replay",
+        "PATHWAY_PERSISTENCE_MODE": mode,
+    }
+    if continue_after_replay:
+        env_extra["PATHWAY_CONTINUE_AFTER_REPLAY"] = "1"
+    sys.exit(_spawn_processes(threads, processes, 10000, env_extra, program))
+
+
+if __name__ == "__main__":
+    main()

@@ -22,9 +22,47 @@ import numpy as np
 
 from .delta import Delta, concat_deltas
 
-__all__ = ["Node", "SourceNode", "Executor", "END_TIME"]
+__all__ = ["Node", "SourceNode", "Executor", "EngineStats", "END_TIME"]
 
 END_TIME = 1 << 62
+
+
+class EngineStats:
+    """Live counters read by the monitoring dashboard and the /metrics
+    endpoint (the reference's ProberStats role, graph.rs:521-563)."""
+
+    def __init__(self) -> None:
+        import time as _time
+
+        self.started_at = _time.time()
+        self.ticks = 0
+        self.rows_total = 0
+        self.input_rows = 0
+        self.output_rows = 0
+        self.latency_ms: float | None = None
+        self.last_time: int = 0
+        self.rows_by_node: dict[str, int] = {}
+        self.finished = False
+
+    def note_node(self, node: "Node", n_rows: int, is_source: bool, is_sink: bool) -> None:
+        self.rows_total += n_rows
+        if is_source:
+            self.input_rows += n_rows
+        if is_sink:
+            self.output_rows += n_rows
+        label = f"{type(node).__name__}#{node.node_id}"
+        self.rows_by_node[label] = self.rows_by_node.get(label, 0) + n_rows
+
+    def note_tick(self, time: int) -> None:
+        import time as _time
+
+        self.ticks += 1
+        self.last_time = time
+        now_ms = _time.time() * 1000.0
+        # only wall-clock commit timestamps are latency-comparable; small
+        # logical times (scheduled test streams) would read as ~epoch ms
+        if 1_000_000_000_000 < time <= now_ms:
+            self.latency_ms = now_ms - time
 
 
 class Node:
@@ -136,6 +174,7 @@ class Executor:
         self.persistence = persistence
         self._last_clock = 0
         self._defer_commit = False
+        self.stats = EngineStats()
 
     def request_stop(self) -> None:
         self._stop_requested = True
@@ -282,7 +321,13 @@ class Executor:
                         out_parts.append(out)
             if out_parts:
                 emitted = concat_deltas(out_parts, out_parts[0].columns)
+                self.stats.note_node(
+                    node, len(emitted),
+                    is_source=isinstance(node, SourceNode),
+                    is_sink=not self._consumers.get(node.node_id),
+                )
                 self._route(node, emitted, inbox)
+        self.stats.note_tick(time)
         for cb in self._on_time_end:
             cb(time)
         if (
@@ -323,3 +368,4 @@ class Executor:
             cb(END_TIME)
         if self.persistence is not None:
             self.persistence.commit(self._last_clock)
+        self.stats.finished = True

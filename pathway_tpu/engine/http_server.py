@@ -1,0 +1,82 @@
+"""Per-process monitoring HTTP server.
+
+Re-design of ``src/engine/http_server.rs:21-60``: serves OpenMetrics/
+Prometheus text built from the live ``EngineStats`` on port
+``20000 + process_id`` (same convention). Pure-stdlib ``http.server`` on a
+daemon thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+__all__ = ["start_http_server", "DEFAULT_PORT_BASE"]
+
+DEFAULT_PORT_BASE = 20000
+
+
+def _render_metrics(stats: Any) -> str:
+    import time as _time
+
+    lines = [
+        "# TYPE pathway_engine_ticks counter",
+        f"pathway_engine_ticks {stats.ticks}",
+        "# TYPE pathway_engine_rows_total counter",
+        f"pathway_engine_rows_total {stats.rows_total}",
+        "# TYPE pathway_input_rows counter",
+        f"pathway_input_rows {stats.input_rows}",
+        "# TYPE pathway_output_rows counter",
+        f"pathway_output_rows {stats.output_rows}",
+        "# TYPE pathway_uptime_seconds gauge",
+        f"pathway_uptime_seconds {_time.time() - stats.started_at:.3f}",
+    ]
+    if stats.latency_ms is not None:
+        lines += [
+            "# TYPE pathway_output_latency_ms gauge",
+            f"pathway_output_latency_ms {stats.latency_ms:.3f}",
+        ]
+    # snapshot: the executor thread inserts node keys concurrently
+    for label, count in sorted(list(stats.rows_by_node.items())):
+        lines.append(
+            f'pathway_operator_rows_total{{operator="{label}"}} {count}'
+        )
+    return "\n".join(lines) + "\n"
+
+
+def start_http_server(
+    stats: Any, port: int | None = None, host: str = "0.0.0.0"
+):
+    """Serve /metrics (and / as a liveness probe); returns (server, thread).
+    Call ``server.shutdown()`` to stop."""
+    if port is None:
+        import os
+
+        from ..internals.config import get_pathway_config
+
+        base = int(os.environ.get("PATHWAY_MONITORING_HTTP_PORT", DEFAULT_PORT_BASE))
+        port = base + get_pathway_config().process_id
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self) -> None:  # noqa: N802 - http.server API
+            if self.path.rstrip("/") in ("", "/metrics", "/status"):
+                body = _render_metrics(stats).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def log_message(self, *args: Any) -> None:  # silence request logs
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, thread

@@ -1,0 +1,87 @@
+"""Env-first engine configuration (reference
+``python/pathway/internals/config.py:35-121`` ``PathwayConfig`` +
+``src/engine/dataflow/config.rs:62-128`` worker config).
+
+All knobs come from ``PATHWAY_*`` environment variables so `spawn`-style
+launchers configure workers purely through the environment, exactly like
+the reference.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+__all__ = ["PathwayConfig", "get_pathway_config", "pathway_config", "MAX_WORKERS"]
+
+#: reference free-tier cap (dataflow/config.rs:7-11)
+MAX_WORKERS = 8
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    try:
+        return int(v) if v is not None else default
+    except ValueError:
+        return default
+
+
+@dataclass
+class PathwayConfig:
+    ignore_asserts: bool = field(
+        default_factory=lambda: _env_bool("PATHWAY_IGNORE_ASSERTS"))
+    runtime_typechecking: bool = field(
+        default_factory=lambda: _env_bool("PATHWAY_RUNTIME_TYPECHECKING"))
+    replay_storage: str | None = field(
+        default_factory=lambda: os.environ.get("PATHWAY_REPLAY_STORAGE"))
+    snapshot_access: str | None = field(
+        default_factory=lambda: os.environ.get("PATHWAY_SNAPSHOT_ACCESS"))
+    persistence_mode: str | None = field(
+        default_factory=lambda: os.environ.get("PATHWAY_PERSISTENCE_MODE"))
+    license_key: str | None = field(
+        default_factory=lambda: os.environ.get("PATHWAY_LICENSE_KEY"))
+    monitoring_server: str | None = field(
+        default_factory=lambda: os.environ.get("PATHWAY_MONITORING_SERVER"))
+    continue_after_replay: bool = field(
+        default_factory=lambda: _env_bool("PATHWAY_CONTINUE_AFTER_REPLAY"))
+    # worker layout (config.rs PATHWAY_THREADS/PROCESSES/PROCESS_ID/FIRST_PORT)
+    threads: int = field(default_factory=lambda: _env_int("PATHWAY_THREADS", 1))
+    processes: int = field(default_factory=lambda: _env_int("PATHWAY_PROCESSES", 1))
+    process_id: int = field(default_factory=lambda: _env_int("PATHWAY_PROCESS_ID", 0))
+    first_port: int = field(default_factory=lambda: _env_int("PATHWAY_FIRST_PORT", 10000))
+
+    def __post_init__(self) -> None:
+        if self.threads * self.processes > MAX_WORKERS:
+            raise RuntimeError(
+                f"too many workers: {self.threads}×{self.processes} > "
+                f"{MAX_WORKERS} (reference free-tier limit, "
+                "dataflow/config.rs:7-11)"
+            )
+
+    @property
+    def total_workers(self) -> int:
+        return self.threads * self.processes
+
+    @property
+    def replay_mode(self) -> str | None:
+        return self.persistence_mode
+
+
+def get_pathway_config() -> PathwayConfig:
+    """Fresh config snapshot from the current environment."""
+    return PathwayConfig()
+
+
+def __getattr__(name: str):
+    # `pathway_config` resolves lazily: importing the package must not
+    # validate (and possibly reject) worker env vars the program never uses
+    if name == "pathway_config":
+        return get_pathway_config()
+    raise AttributeError(name)

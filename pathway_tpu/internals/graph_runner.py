@@ -33,12 +33,33 @@ class GraphRunner:
         self._nodes: list[Node] = []
         self.executor: Executor | None = None
         self.persistence: Any = None  # PersistenceManager when pw.run has one
+        self.monitoring_level: int = 0
+        self.with_http_server: bool = False
 
     # ------------------------------------------------------------------
 
     def _execute(self) -> None:
         self.executor = Executor(self._nodes, persistence=self.persistence)
-        self.executor.run()
+        stop_dashboard = None
+        http_server = None
+        if self.with_http_server:
+            from ..engine.http_server import start_http_server
+
+            http_server, _ = start_http_server(self.executor.stats)
+        if self.monitoring_level:
+            from .monitoring import start_dashboard
+
+            stop_dashboard = start_dashboard(
+                self.executor.stats, self.monitoring_level
+            )
+        try:
+            self.executor.run()
+        finally:
+            if stop_dashboard is not None:
+                stop_dashboard()
+            if http_server is not None:
+                http_server.shutdown()
+                http_server.server_close()
 
     def run_tables(self, *tables: Table, include_sinks: bool = False):
         """Build + execute; return one Capture per requested table."""

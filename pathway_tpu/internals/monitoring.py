@@ -1,0 +1,92 @@
+"""Live monitoring dashboard (reference
+``python/pathway/internals/monitoring.py:56-190`` — a rich TUI table of
+connector/operator progress fed by engine ProberStats). Renders from
+``EngineStats`` on a background thread; falls back to plain-text lines
+when rich is unavailable or stdout is not a TTY.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Any
+
+__all__ = ["MonitoringLevel", "start_dashboard"]
+
+
+class MonitoringLevel:
+    NONE = 0
+    IN_OUT = 1
+    ALL = 2
+    AUTO = 3
+    AUTO_ALL = 4
+
+
+def _rows(stats: Any, level: int) -> list[tuple[str, str]]:
+    out = [
+        ("ticks (commits)", str(stats.ticks)),
+        ("rows ingested", str(stats.input_rows)),
+        ("rows emitted", str(stats.output_rows)),
+        (
+            "output latency",
+            f"{stats.latency_ms:.0f} ms" if stats.latency_ms is not None else "-",
+        ),
+    ]
+    if level >= MonitoringLevel.ALL:
+        # snapshot: the executor thread inserts node keys concurrently
+        for label, count in sorted(list(stats.rows_by_node.items())):
+            out.append((f"  {label}", str(count)))
+    return out
+
+
+def start_dashboard(stats: Any, level: int, refresh_s: float = 1.0):
+    """Returns a stop() callable."""
+    if level in (MonitoringLevel.AUTO, MonitoringLevel.AUTO_ALL):
+        if not sys.stderr.isatty():
+            # AUTO means "dashboard only when interactive" (reference
+            # resolves AUTO to NONE off-tty) — don't spam piped logs
+            return lambda: None
+        level = (
+            MonitoringLevel.ALL
+            if level == MonitoringLevel.AUTO_ALL
+            else MonitoringLevel.IN_OUT
+        )
+    stop_event = threading.Event()
+
+    def plain_loop() -> None:
+        while not stop_event.wait(refresh_s):
+            parts = ", ".join(f"{k}={v}" for k, v in _rows(stats, level))
+            print(f"[pathway monitoring] {parts}", file=sys.stderr)
+
+    def rich_loop() -> None:
+        from rich.live import Live
+        from rich.table import Table as RichTable
+
+        def render():
+            table = RichTable(title="pathway_tpu engine")
+            table.add_column("metric")
+            table.add_column("value", justify="right")
+            for k, v in _rows(stats, level):
+                table.add_row(k, v)
+            return table
+
+        with Live(render(), refresh_per_second=4, transient=True) as live:
+            while not stop_event.wait(refresh_s):
+                live.update(render())
+
+    use_rich = sys.stderr.isatty()
+    if use_rich:
+        try:
+            import rich  # noqa: F401
+        except ImportError:
+            use_rich = False
+    thread = threading.Thread(
+        target=rich_loop if use_rich else plain_loop, daemon=True
+    )
+    thread.start()
+
+    def stop() -> None:
+        stop_event.set()
+        thread.join(timeout=2)
+
+    return stop

@@ -6,17 +6,10 @@ import threading
 from typing import Any
 
 from .graph_runner import GraphRunner
+from .monitoring import MonitoringLevel
 
 _current: dict[str, GraphRunner | None] = {"runner": None}
 _lock = threading.Lock()
-
-
-class MonitoringLevel:
-    NONE = 0
-    IN_OUT = 1
-    ALL = 2
-    AUTO = 3
-    AUTO_ALL = 4
 
 
 def run(
@@ -33,6 +26,8 @@ def run(
     Blocks until all sources finish (streaming sources may run forever —
     stop from another thread with ``request_stop()``)."""
     runner = GraphRunner()
+    runner.monitoring_level = monitoring_level
+    runner.with_http_server = with_http_server
     with _lock:
         _current["runner"] = runner
     try:

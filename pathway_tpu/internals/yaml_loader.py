@@ -1,0 +1,90 @@
+"""YAML pipeline loader (reference
+``python/pathway/internals/yaml_loader.py`` — used by the app templates).
+
+``!pw.some.dotted.Name`` tags instantiate the referenced callable with the
+mapping's entries as kwargs; ``$ref: name`` entries resolve to previously
+defined top-level objects, and ``$env`` interpolates environment variables.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+from typing import Any, IO
+
+import yaml
+
+__all__ = ["load_yaml"]
+
+
+def _resolve_dotted(path: str) -> Any:
+    parts = path.split(".")
+    for split in range(len(parts), 0, -1):
+        module_name = ".".join(parts[:split])
+        try:
+            obj: Any = importlib.import_module(module_name)
+        except ImportError:
+            continue
+        for attr in parts[split:]:
+            obj = getattr(obj, attr)
+        return obj
+    raise ImportError(f"cannot resolve {path!r}")
+
+
+class _Tagged:
+    def __init__(self, path: str, value: Any):
+        self.path = path
+        self.value = value
+
+
+class _Loader(yaml.SafeLoader):
+    pass
+
+
+def _tag_constructor(loader: _Loader, tag_suffix: str, node: yaml.Node) -> _Tagged:
+    if isinstance(node, yaml.MappingNode):
+        value = loader.construct_mapping(node, deep=True)
+    elif isinstance(node, yaml.SequenceNode):
+        value = loader.construct_sequence(node, deep=True)
+    else:
+        value = loader.construct_scalar(node)
+    return _Tagged(tag_suffix, value)
+
+
+_Loader.add_multi_constructor("!", _tag_constructor)
+
+
+def _instantiate(obj: Any, defined: dict[str, Any]) -> Any:
+    if isinstance(obj, _Tagged):
+        target = _resolve_dotted(obj.path)
+        value = _instantiate(obj.value, defined)
+        if isinstance(value, dict):
+            return target(**value)
+        if value is None or (isinstance(value, str) and value == ""):
+            return target()
+        if isinstance(value, list):
+            return target(*value)
+        return target(value)
+    if isinstance(obj, dict):
+        if set(obj.keys()) == {"$ref"}:
+            return defined[obj["$ref"]]
+        if set(obj.keys()) == {"$env"}:
+            return os.environ[obj["$env"]]
+        return {k: _instantiate(v, defined) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_instantiate(v, defined) for v in obj]
+    if isinstance(obj, str) and obj.startswith("$") and obj[1:] in defined:
+        return defined[obj[1:]]
+    return obj
+
+
+def load_yaml(stream: str | IO) -> Any:
+    """Parse a YAML pipeline description, instantiating ``!dotted.path``
+    tags (top-level keys become ``$name`` references for later entries)."""
+    raw = yaml.load(stream, Loader=_Loader)
+    if not isinstance(raw, dict):
+        return _instantiate(raw, {})
+    defined: dict[str, Any] = {}
+    for key, value in raw.items():
+        defined[key] = _instantiate(value, defined)
+    return defined

@@ -1,5 +1,5 @@
 """Standard library (reference python/pathway/stdlib)."""
 
-from . import graphs, indexing, ml, ordered, stateful, statistical, temporal, utils
+from . import graphs, indexing, ml, ordered, stateful, statistical, temporal, utils, viz
 
-__all__ = ["graphs", "indexing", "ml", "ordered", "stateful", "statistical", "temporal", "utils"]
+__all__ = ["graphs", "indexing", "ml", "ordered", "stateful", "statistical", "temporal", "utils", "viz"]

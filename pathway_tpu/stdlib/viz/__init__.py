@@ -1,0 +1,38 @@
+"""``pw.viz`` — live Bokeh/Panel plots (reference
+``python/pathway/stdlib/viz/plotting.py``). Gated: bokeh/panel are not in
+this environment; ``table.plot``/``show`` raise with guidance."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+__all__ = ["plot", "show", "table_viz"]
+
+
+def _require_panel():
+    try:
+        import bokeh  # type: ignore[import-not-found]  # noqa: F401
+        import panel  # type: ignore[import-not-found]
+        return panel
+    except ImportError as e:
+        raise ImportError(
+            "pw.viz requires the 'bokeh' and 'panel' packages (not installed "
+            "in this environment); use pw.debug.compute_and_print or "
+            "pw.io.subscribe for textual inspection"
+        ) from e
+
+
+def plot(table: Any, plotting_function: Callable, sorting_col: str | None = None):
+    """Live-updating Bokeh plot of a table (reference plotting.py:plot)."""
+    _require_panel()
+    raise NotImplementedError
+
+
+def show(obj: Any) -> None:
+    _require_panel()
+    raise NotImplementedError
+
+
+def table_viz(table: Any, **kwargs: Any):
+    _require_panel()
+    raise NotImplementedError

@@ -1,0 +1,36 @@
+"""``pw.universes`` — key-set (universe) promises (reference
+``python/pathway/internals/universes.py``: ``promise_is_subset_of``,
+``promise_are_equal``, ``promise_are_pairwise_disjoint``). Promises feed
+the universe solver consulted when columns of different tables are mixed.
+"""
+
+from __future__ import annotations
+
+from .internals.parse_graph import G
+from .internals.table import Table
+
+__all__ = [
+    "promise_is_subset_of",
+    "promise_are_equal",
+    "promise_are_pairwise_disjoint",
+]
+
+
+def promise_is_subset_of(subset: Table, superset: Table) -> Table:
+    G.promise_subset(subset._universe, superset._universe)
+    return subset
+
+
+def promise_are_equal(*tables: Table) -> None:
+    for other in tables[1:]:
+        G.promise_equal(tables[0]._universe, other._universe)
+
+
+def promise_are_pairwise_disjoint(*tables: Table) -> None:
+    """Disjointness lets ``concat`` keep original keys safely. The solver
+    only tracks equal/subset relations; disjointness is accepted and relied
+    on by the caller (matching the reference's promise semantics — the
+    engine trusts, and errors at runtime on key collisions)."""
+    for table in tables:
+        table._universe  # touch: all args must be tables
+

@@ -1,0 +1,150 @@
+"""CLI, config, monitoring/metrics endpoint, yaml loader, universes
+(reference: cli.py, internals/config.py, monitoring.py, yaml_loader.py)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def test_config_from_env(monkeypatch):
+    monkeypatch.setenv("PATHWAY_THREADS", "4")
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "1")
+    monkeypatch.setenv("PATHWAY_IGNORE_ASSERTS", "true")
+    cfg = pw.get_pathway_config()
+    assert cfg.threads == 4 and cfg.processes == 2 and cfg.process_id == 1
+    assert cfg.total_workers == 8
+    assert cfg.ignore_asserts is True
+
+    monkeypatch.setenv("PATHWAY_THREADS", "5")
+    with pytest.raises(RuntimeError, match="too many workers"):
+        pw.get_pathway_config()
+
+
+def test_cli_spawn_sets_environment(tmp_path):
+    prog = tmp_path / "prog.py"
+    prog.write_text(
+        "import os\n"
+        "print(os.environ['PATHWAY_THREADS'], os.environ['PATHWAY_PROCESS_ID'])\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "pathway_tpu.cli", "spawn", "-t", "2",
+         sys.executable, str(prog)],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={**os.environ, "PYTHONPATH": "/root/repo"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "2 0"
+
+
+def test_cli_replay_sets_replay_env(tmp_path):
+    prog = tmp_path / "prog.py"
+    prog.write_text(
+        "import os\n"
+        "print(os.environ['PATHWAY_REPLAY_STORAGE'],"
+        " os.environ['PATHWAY_SNAPSHOT_ACCESS'],"
+        " os.environ['PATHWAY_PERSISTENCE_MODE'])\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "pathway_tpu.cli", "replay",
+         "--record-path", "rec", "--mode", "speedrun",
+         sys.executable, str(prog)],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={**os.environ, "PYTHONPATH": "/root/repo"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "rec replay speedrun"
+
+
+def test_metrics_endpoint(monkeypatch):
+    """pw.run(with_http_server=True) serves OpenMetrics on 20000+pid
+    (port overridable via PATHWAY_MONITORING_HTTP_PORT)."""
+    import threading
+    import time
+
+    monkeypatch.setenv("PATHWAY_MONITORING_HTTP_PORT", "28471")
+
+    import threading as _threading
+
+    scrape_done = _threading.Event()
+
+    class S(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(3):
+                self.next(x=i)
+                self.commit()
+            # keep the engine (and its metrics server) alive until scraped
+            scrape_done.wait(timeout=10)
+
+    t = pw.io.python.read(S(), schema=pw.schema_from_types(x=int))
+    total = t.reduce(s=pw.reducers.sum(pw.this.x))
+    seen = threading.Event()
+    scraped: list[str] = []
+
+    def on_change(key, row, time, is_addition):
+        if is_addition and int(row["s"]) == 3:
+            seen.set()
+
+    pw.io.subscribe(total, on_change=on_change)
+
+    def scrape_and_stop():
+        seen.wait(timeout=10)
+        time.sleep(0.2)
+        try:
+            with urllib.request.urlopen(
+                "http://127.0.0.1:28471/metrics", timeout=5
+            ) as resp:
+                scraped.append(resp.read().decode())
+        finally:
+            scrape_done.set()
+            pw.request_stop()
+
+    th = threading.Thread(target=scrape_and_stop, daemon=True)
+    th.start()
+    pw.run(with_http_server=True)
+    th.join()
+    assert scraped, "metrics endpoint unreachable"
+    body = scraped[0]
+    assert "pathway_engine_ticks" in body
+    assert "pathway_input_rows 3" in body
+
+
+def test_yaml_loader():
+    doc = """
+splitter: !pathway_tpu.xpacks.llm.splitters.TokenCountSplitter
+  min_tokens: 2
+  max_tokens: 7
+limits:
+  low: 1
+  high: $splitter
+"""
+    objs = pw.load_yaml(doc)
+    from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+
+    assert isinstance(objs["splitter"], TokenCountSplitter)
+    assert objs["splitter"].max_tokens == 7
+    assert objs["limits"]["high"] is objs["splitter"]
+
+
+def test_universes_promises():
+    a = pw.debug.table_from_rows(pw.schema_from_types(x=int), [(1,), (2,)])
+    b = a.filter(pw.this.x > 0)
+    # b ⊆ a already; promising equality allows mixing columns both ways
+    pw.universes.promise_are_equal(a, b)
+    res = a.select(y=pw.ColumnReference(b, "x"))
+    assert sorted(pw.debug.table_to_pandas(res)["y"]) == [1, 2]
