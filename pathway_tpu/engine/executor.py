@@ -44,12 +44,10 @@ class EngineStats:
         self.rows_by_node: dict[str, int] = {}
         self.finished = False
 
-    def note_node(self, node: "Node", n_rows: int, is_source: bool, is_sink: bool) -> None:
+    def note_node(self, node: "Node", n_rows: int, is_source: bool) -> None:
         self.rows_total += n_rows
         if is_source:
             self.input_rows += n_rows
-        if is_sink:
-            self.output_rows += n_rows
         label = f"{type(node).__name__}#{node.node_id}"
         self.rows_by_node[label] = self.rows_by_node.get(label, 0) + n_rows
 
@@ -61,8 +59,9 @@ class EngineStats:
         now_ms = _time.time() * 1000.0
         # only wall-clock commit timestamps are latency-comparable; small
         # logical times (scheduled test streams) would read as ~epoch ms
-        if 1_000_000_000_000 < time <= now_ms:
-            self.latency_ms = now_ms - time
+        if time > 1_000_000_000_000:
+            # a logical clock nudged past wall-clock means we're keeping up
+            self.latency_ms = max(0.0, now_ms - time)
 
 
 class Node:
@@ -316,6 +315,12 @@ class Executor:
                     for p in range(len(node.inputs))
                 ]
                 if any(x is not None for x in ins):
+                    if node.inputs and not self._consumers.get(node.node_id):
+                        # terminal node (Subscribe/Capture/output writer):
+                        # rows reaching it ARE the pipeline's output
+                        self.stats.output_rows += sum(
+                            len(d) for d in ins if d is not None
+                        )
                     out = node.process(time, ins)
                     if out is not None and len(out):
                         out_parts.append(out)
@@ -324,7 +329,6 @@ class Executor:
                 self.stats.note_node(
                     node, len(emitted),
                     is_source=isinstance(node, SourceNode),
-                    is_sink=not self._consumers.get(node.node_id),
                 )
                 self._route(node, emitted, inbox)
         self.stats.note_tick(time)

@@ -59,6 +59,7 @@ def start_dashboard(stats: Any, level: int, refresh_s: float = 1.0):
             print(f"[pathway monitoring] {parts}", file=sys.stderr)
 
     def rich_loop() -> None:
+        from rich.console import Console
         from rich.live import Live
         from rich.table import Table as RichTable
 
@@ -70,7 +71,11 @@ def start_dashboard(stats: Any, level: int, refresh_s: float = 1.0):
                 table.add_row(k, v)
             return table
 
-        with Live(render(), refresh_per_second=4, transient=True) as live:
+        # dashboard goes to stderr (the tty we gated on) so redirected
+        # stdout program output stays clean
+        console = Console(file=sys.stderr)
+        with Live(render(), refresh_per_second=4, transient=True,
+                  console=console) as live:
             while not stop_event.wait(refresh_s):
                 live.update(render())
 

@@ -18,6 +18,8 @@ __all__ = ["load_yaml"]
 
 
 def _resolve_dotted(path: str) -> Any:
+    if path == "pw" or path.startswith("pw."):
+        path = "pathway_tpu" + path[2:]
     parts = path.split(".")
     for split in range(len(parts), 0, -1):
         module_name = ".".join(parts[:split])

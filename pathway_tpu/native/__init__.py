@@ -1,0 +1,62 @@
+"""Native runtime loader.
+
+Compiles ``native.c`` (CPython C API — no pybind11 in this environment)
+with the system compiler on first import and caches the shared object next
+to the source; falls back to pure Python silently when no compiler is
+available. The C and Python hash paths are bit-identical (enforced by
+tests/test_native.py), so a cache hit/miss never changes key values.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+
+__all__ = ["get_native", "native_available"]
+
+_cached: object | None = None
+_tried = False
+
+
+def _build(src: str, out: str) -> bool:
+    include = sysconfig.get_paths()["include"]
+    cc = os.environ.get("CC", "gcc")
+    cmd = [
+        cc, "-O3", "-shared", "-fPIC", "-std=c11",
+        f"-I{include}", src, "-o", out,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    return proc.returncode == 0 and os.path.exists(out)
+
+
+def get_native():
+    """The compiled module, or None when unavailable."""
+    global _cached, _tried
+    if _tried:
+        return _cached
+    _tried = True
+    here = os.path.dirname(__file__)
+    src = os.path.join(here, "native.c")
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = os.path.join(here, f"_pathway_native{suffix}")
+    try:
+        if not os.path.exists(out) or os.path.getmtime(out) < os.path.getmtime(src):
+            if not _build(src, out):
+                return None
+        spec = importlib.util.spec_from_file_location("_pathway_native", out)
+        assert spec is not None and spec.loader is not None
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        _cached = module
+    except Exception:
+        _cached = None
+    return _cached
+
+
+def native_available() -> bool:
+    return get_native() is not None

@@ -1,0 +1,271 @@
+/* Native runtime kernels for pathway_tpu.
+ *
+ * The reference engine's keyspace is native Rust (xxh3 u128 keys,
+ * src/engine/value.rs:30-75); this module is our native equivalent for the
+ * hot row-ingestion path: batch row hashing with EXACTLY the same scalar
+ * semantics as the pure-Python implementation in engine/keys.py
+ * (splitmix64 avalanche folds over per-scalar digests; strings/bytes via
+ * BLAKE2b-64 as hashlib.blake2b(digest_size=8) produces). Python and C
+ * paths are interchangeable bit-for-bit, so persisted state stays valid
+ * whichever path built it (guarded by tests/test_native.py).
+ *
+ * Built with plain g++/gcc against the CPython C API (no pybind11 in this
+ * environment) by pathway_tpu/native/__init__.py.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+/* ----------------------------------------------------------------- */
+/* BLAKE2b (RFC 7693), fixed config: 8-byte digest, no key           */
+
+static const uint64_t blake2b_iv[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL,
+    0x3c6ef372fe94f82bULL, 0xa54ff53a5f1d36f1ULL,
+    0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL,
+};
+
+static const uint8_t blake2b_sigma[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+};
+
+static inline uint64_t rotr64(uint64_t x, int n) {
+    return (x >> n) | (x << (64 - n));
+}
+
+#define B2B_G(a, b, c, d, x, y)                 \
+    do {                                        \
+        v[a] = v[a] + v[b] + (x);               \
+        v[d] = rotr64(v[d] ^ v[a], 32);         \
+        v[c] = v[c] + v[d];                     \
+        v[b] = rotr64(v[b] ^ v[c], 24);         \
+        v[a] = v[a] + v[b] + (y);               \
+        v[d] = rotr64(v[d] ^ v[a], 16);         \
+        v[c] = v[c] + v[d];                     \
+        v[b] = rotr64(v[b] ^ v[c], 63);         \
+    } while (0)
+
+static void blake2b_compress(uint64_t h[8], const uint8_t block[128],
+                             uint64_t t, int last) {
+    uint64_t v[16], m[16];
+    int i, r;
+    for (i = 0; i < 8; i++) v[i] = h[i];
+    for (i = 0; i < 8; i++) v[i + 8] = blake2b_iv[i];
+    v[12] ^= t; /* low counter word; inputs here are < 2^64 bytes */
+    if (last) v[14] = ~v[14];
+    for (i = 0; i < 16; i++) memcpy(&m[i], block + 8 * i, 8);
+    for (r = 0; r < 12; r++) {
+        const uint8_t *s = blake2b_sigma[r];
+        B2B_G(0, 4, 8, 12, m[s[0]], m[s[1]]);
+        B2B_G(1, 5, 9, 13, m[s[2]], m[s[3]]);
+        B2B_G(2, 6, 10, 14, m[s[4]], m[s[5]]);
+        B2B_G(3, 7, 11, 15, m[s[6]], m[s[7]]);
+        B2B_G(0, 5, 10, 15, m[s[8]], m[s[9]]);
+        B2B_G(1, 6, 11, 12, m[s[10]], m[s[11]]);
+        B2B_G(2, 7, 8, 13, m[s[12]], m[s[13]]);
+        B2B_G(3, 4, 9, 14, m[s[14]], m[s[15]]);
+    }
+    for (i = 0; i < 8; i++) h[i] ^= v[i] ^ v[i + 8];
+}
+
+/* 8-byte BLAKE2b digest of data, as little-endian uint64 (the exact value
+ * of int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), 'little')) */
+static uint64_t blake2b8(const uint8_t *data, Py_ssize_t len) {
+    uint64_t h[8];
+    uint8_t block[128];
+    Py_ssize_t remaining = len, off = 0;
+    memcpy(h, blake2b_iv, sizeof(h));
+    h[0] ^= 0x01010000ULL ^ 8ULL; /* digest_size=8, no key, fanout=depth=1 */
+    while (remaining > 128) {
+        blake2b_compress(h, data + off, (uint64_t)(off + 128), 0);
+        off += 128;
+        remaining -= 128;
+    }
+    memset(block, 0, sizeof(block));
+    if (remaining > 0) memcpy(block, data + off, (size_t)remaining);
+    blake2b_compress(h, block, (uint64_t)len, 1);
+    return h[0];
+}
+
+/* ----------------------------------------------------------------- */
+/* splitmix64 finalizer — must match keys._splitmix exactly           */
+
+static inline uint64_t splitmix(uint64_t x) {
+    x += 0x9E3779B97F4A7C15ULL;
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+    return x ^ (x >> 31);
+}
+
+#define NONE_TAG 0x736E6F6E65736E6FULL
+#define TUPLE_SEED 0x9E37ULL
+#define ROW_SEED 0xA0761D6478BD642FULL
+
+/* hash one scalar with keys._hash_scalar semantics; `fallback` is the
+ * Python implementation used for types this C path doesn't know
+ * (ndarrays, datetimes, Json wrappers, ...). Returns 0 + sets err on
+ * failure. */
+static int hash_scalar(PyObject *v, PyObject *fallback, uint64_t *out) {
+    if (v == Py_None) {
+        *out = NONE_TAG;
+        return 0;
+    }
+    if (PyBool_Check(v)) {
+        *out = splitmix((v == Py_True ? 1ULL : 0ULL) + 0xB001ULL);
+        return 0;
+    }
+    if (PyLong_CheckExact(v)) {
+        uint64_t x = PyLong_AsUnsignedLongLongMask(v); /* low 64 bits */
+        if (x == (uint64_t)-1 && PyErr_Occurred()) return -1;
+        *out = splitmix(x);
+        return 0;
+    }
+    if (PyFloat_CheckExact(v)) {
+        double d = PyFloat_AS_DOUBLE(v);
+        uint64_t bits;
+        memcpy(&bits, &d, 8);
+        *out = splitmix(bits);
+        return 0;
+    }
+    if (PyUnicode_CheckExact(v)) {
+        Py_ssize_t len;
+        const char *utf8 = PyUnicode_AsUTF8AndSize(v, &len);
+        if (utf8 == NULL) return -1;
+        *out = blake2b8((const uint8_t *)utf8, len);
+        return 0;
+    }
+    if (PyBytes_CheckExact(v)) {
+        *out = blake2b8((const uint8_t *)PyBytes_AS_STRING(v),
+                        PyBytes_GET_SIZE(v));
+        return 0;
+    }
+    if (PyTuple_CheckExact(v)) {
+        uint64_t acc = TUPLE_SEED, h;
+        Py_ssize_t i, n = PyTuple_GET_SIZE(v);
+        for (i = 0; i < n; i++) {
+            if (hash_scalar(PyTuple_GET_ITEM(v, i), fallback, &h) < 0)
+                return -1;
+            acc = splitmix(acc ^ h);
+        }
+        *out = acc;
+        return 0;
+    }
+    /* numpy scalars, ndarrays, datetimes, wrappers: defer to Python */
+    {
+        PyObject *res = PyObject_CallFunctionObjArgs(fallback, v, NULL);
+        uint64_t x;
+        if (res == NULL) return -1;
+        x = PyLong_AsUnsignedLongLongMask(res);
+        Py_DECREF(res);
+        if (x == (uint64_t)-1 && PyErr_Occurred()) return -1;
+        *out = x;
+        return 0;
+    }
+}
+
+/* hash_rows(rows: sequence of tuples, salt: int, fallback, out: writable
+ * uint64 buffer of len(rows)) -> None */
+static PyObject *py_hash_rows(PyObject *self, PyObject *args) {
+    PyObject *rows, *fallback, *out_obj;
+    unsigned long long salt;
+    Py_buffer out;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OKOO", &rows, &salt, &fallback, &out_obj))
+        return NULL;
+    if (PyObject_GetBuffer(out_obj, &out, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0)
+        return NULL;
+    {
+        PyObject *seq = PySequence_Fast(rows, "rows must be a sequence");
+        Py_ssize_t n, i;
+        uint64_t *dst = (uint64_t *)out.buf;
+        if (seq == NULL) {
+            PyBuffer_Release(&out);
+            return NULL;
+        }
+        n = PySequence_Fast_GET_SIZE(seq);
+        if ((Py_ssize_t)(out.len / 8) < n) {
+            Py_DECREF(seq);
+            PyBuffer_Release(&out);
+            PyErr_SetString(PyExc_ValueError, "output buffer too small");
+            return NULL;
+        }
+        for (i = 0; i < n; i++) {
+            PyObject *row = PySequence_Fast_GET_ITEM(seq, i);
+            uint64_t acc = ROW_SEED ^ (uint64_t)salt, h;
+            Py_ssize_t j, m;
+            PyObject *rowseq = PySequence_Fast(row, "row must be a sequence");
+            if (rowseq == NULL) {
+                Py_DECREF(seq);
+                PyBuffer_Release(&out);
+                return NULL;
+            }
+            m = PySequence_Fast_GET_SIZE(rowseq);
+            for (j = 0; j < m; j++) {
+                if (hash_scalar(PySequence_Fast_GET_ITEM(rowseq, j),
+                                fallback, &h) < 0) {
+                    Py_DECREF(rowseq);
+                    Py_DECREF(seq);
+                    PyBuffer_Release(&out);
+                    return NULL;
+                }
+                acc = splitmix(acc ^ h);
+            }
+            Py_DECREF(rowseq);
+            dst[i] = acc;
+        }
+        Py_DECREF(seq);
+    }
+    PyBuffer_Release(&out);
+    Py_RETURN_NONE;
+}
+
+/* blake2b8(data: bytes-like) -> int — exposed for parity tests */
+static PyObject *py_blake2b8(PyObject *self, PyObject *arg) {
+    Py_buffer buf;
+    uint64_t h;
+    (void)self;
+    if (PyObject_GetBuffer(arg, &buf, PyBUF_C_CONTIGUOUS) < 0) return NULL;
+    h = blake2b8((const uint8_t *)buf.buf, buf.len);
+    PyBuffer_Release(&buf);
+    return PyLong_FromUnsignedLongLong(h);
+}
+
+/* splitmix64(x: int) -> int — exposed for parity tests */
+static PyObject *py_splitmix(PyObject *self, PyObject *arg) {
+    unsigned long long x = PyLong_AsUnsignedLongLongMask(arg);
+    (void)self;
+    if (x == (unsigned long long)-1 && PyErr_Occurred()) return NULL;
+    return PyLong_FromUnsignedLongLong(splitmix(x));
+}
+
+static PyMethodDef methods[] = {
+    {"hash_rows", py_hash_rows, METH_VARARGS,
+     "hash_rows(rows, salt, fallback, out_uint64_buffer)"},
+    {"blake2b8", py_blake2b8, METH_O, "8-byte BLAKE2b digest as uint64"},
+    {"splitmix64", py_splitmix, METH_O, "splitmix64 finalizer"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "_pathway_native",
+    "Native keyspace kernels for pathway_tpu", -1, methods,
+    NULL, NULL, NULL, NULL,
+};
+
+PyMODINIT_FUNC PyInit__pathway_native(void) {
+    return PyModule_Create(&module);
+}

@@ -1,0 +1,78 @@
+"""Native C keyspace kernel: bit-parity with the pure-Python path.
+
+Parity is load-bearing: persisted snapshots store keys, so the two
+implementations must agree on every value class or recovery would
+mis-route rows after an environment change.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from pathway_tpu.engine import keys as K
+from pathway_tpu.native import get_native
+
+native = get_native()
+
+pytestmark = pytest.mark.skipif(
+    native is None, reason="no C compiler available to build the native module"
+)
+
+CORPUS_ROWS = [
+    (),
+    (None,),
+    (True, False),
+    (0, 1, -1, 2**62, -(2**62), 123456789),
+    (0.0, -0.0, 1.5, float("inf"), -2.75e300),
+    ("", "hello", "héllo wörld", "x" * 1000),
+    (b"", b"raw\x00bytes", b"y" * 500),
+    (("nested", 1), ("deep", ("er", 2.5), None)),
+    (np.int64(42), np.float64(2.5), np.bool_(True)),
+    (np.array([1.0, 2.0, 3.0]),),
+    ({"a": 1},),  # falls back to repr hashing, must still agree
+]
+
+
+def test_blake2b8_matches_hashlib():
+    for data in [b"", b"a", b"hello world", b"z" * 127, b"z" * 128, b"z" * 129,
+                 b"q" * 1000]:
+        expected = int.from_bytes(
+            hashlib.blake2b(data, digest_size=8).digest(), "little"
+        )
+        assert native.blake2b8(data) == expected, f"len={len(data)}"
+
+
+def test_splitmix_matches_python():
+    for x in [0, 1, 0xDEADBEEF, 2**64 - 1, 0x9E3779B97F4A7C15]:
+        assert native.splitmix64(x) == int(K._splitmix(np.uint64(x)))
+
+
+def test_hash_rows_parity():
+    for salt in (0, 7, 0xC0):
+        py = K._hash_values_py(CORPUS_ROWS, salt)
+        out = np.empty(len(CORPUS_ROWS), dtype=np.uint64)
+        native.hash_rows(CORPUS_ROWS, salt, K._hash_scalar, out)
+        assert list(out) == list(py)
+
+
+def test_hash_values_uses_native_and_agrees():
+    rows = [("word", i, float(i) / 3) for i in range(1000)]
+    assert list(K.hash_values(rows)) == list(K._hash_values_py(rows))
+
+
+def test_native_speedup_on_string_rows():
+    import time
+
+    rows = [(f"token-{i}", f"text {i % 97}", i) for i in range(20000)]
+    t0 = time.perf_counter()
+    out = np.empty(len(rows), dtype=np.uint64)
+    native.hash_rows(rows, 0, K._hash_scalar, out)
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    K._hash_values_py(rows)
+    t_py = time.perf_counter() - t0
+    # native should be dramatically faster; 3x is a conservative floor
+    assert t_native * 3 < t_py, (t_native, t_py)

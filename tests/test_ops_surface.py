@@ -122,6 +122,11 @@ def test_metrics_endpoint(monkeypatch):
     body = scraped[0]
     assert "pathway_engine_ticks" in body
     assert "pathway_input_rows 3" in body
+    # sink deliveries count as output rows (sum updates reached subscribe)
+    import re
+
+    m = re.search(r"pathway_output_rows (\d+)", body)
+    assert m and int(m.group(1)) > 0
 
 
 def test_yaml_loader():
@@ -148,3 +153,10 @@ def test_universes_promises():
     pw.universes.promise_are_equal(a, b)
     res = a.select(y=pw.ColumnReference(b, "x"))
     assert sorted(pw.debug.table_to_pandas(res)["y"]) == [1, 2]
+
+
+def test_yaml_pw_alias():
+    objs = pw.load_yaml("s: !pw.xpacks.llm.splitters.NullSplitter\n")
+    from pathway_tpu.xpacks.llm.splitters import NullSplitter
+
+    assert isinstance(objs["s"], NullSplitter)
