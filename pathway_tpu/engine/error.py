@@ -1,0 +1,81 @@
+"""Per-row error values (reference ``Value::Error``, value.rs:226).
+
+A row-level failure inside an expression becomes an ``Error`` value that
+flows through the dataflow instead of poisoning the whole stream;
+``pw.fill_error`` recovers it, ``pw.unwrap`` refuses it, sinks render it
+as ``Error``. Each constructed Error is also counted and (rate-limited)
+logged with its operator context — the reference's error-log channel.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+__all__ = ["Error", "is_error", "ERROR_LOG"]
+
+logger = logging.getLogger("pathway_tpu.errors")
+
+
+class _ErrorLog:
+    """Process-wide error collector (reference global error log)."""
+
+    def __init__(self, max_kept: int = 1000, max_logged: int = 20):
+        self._lock = threading.Lock()
+        self._entries: list[tuple[str, str]] = []
+        self.total = 0
+        self._max_kept = max_kept
+        self._max_logged = max_logged
+
+    def record(self, message: str, context: str) -> None:
+        with self._lock:
+            self.total += 1
+            if len(self._entries) < self._max_kept:
+                self._entries.append((message, context))
+            if self.total <= self._max_logged:
+                logger.warning("row error in %s: %s", context, message)
+            elif self.total == self._max_logged + 1:
+                logger.warning("further row errors suppressed (see error log)")
+
+    def entries(self) -> list[tuple[str, str]]:
+        with self._lock:
+            return list(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.total = 0
+
+
+ERROR_LOG = _ErrorLog()
+
+
+class Error:
+    """A row-level error value. Compares equal to nothing (including other
+    errors and itself), so it never silently merges state; hashes by
+    identity so containers still work."""
+
+    __slots__ = ("message",)
+
+    def __init__(self, message: str = "Error", context: str = "<expression>"):
+        self.message = message
+        ERROR_LOG.record(message, context)
+
+    def __repr__(self) -> str:
+        return "Error"
+
+    def __bool__(self) -> bool:
+        raise TypeError("Error value used in a boolean context")
+
+    def __eq__(self, other: object) -> bool:
+        return False
+
+    def __ne__(self, other: object) -> bool:
+        return True
+
+    def __hash__(self) -> int:
+        return id(self)
+
+
+def is_error(v: object) -> bool:
+    return isinstance(v, Error)

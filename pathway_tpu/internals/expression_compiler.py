@@ -22,6 +22,7 @@ import numpy as np
 from . import dtype as dt
 from . import expression as expr_mod
 from ..engine import keys as K
+from ..engine.error import Error as EngineError
 from .expression import (
     ApplyExpression,
     AsyncApplyExpression,
@@ -407,6 +408,10 @@ def _build(
                 for x in v:
                     if x is None:
                         raise ValueError("cannot unwrap, None found in column")
+                    if isinstance(x, EngineError):
+                        raise ValueError(
+                            f"cannot unwrap, Error found in column: {x.message}"
+                        )
                 return _densify(v, dt.unoptionalize(d))
             return v
 
@@ -417,10 +422,23 @@ def _build(
         rf, rd, rok, rrefs = _build(expr._replacement, env, xp_name)
 
         def fn(cols, keys):
+            n = len(keys)
             try:
-                return f(cols, keys)
+                v = _materialize(f(cols, keys), n)
             except Exception:
-                return _materialize(rf(cols, keys), len(keys))
+                # whole-batch failure (vectorized kernels raise batch-wide)
+                return _materialize(rf(cols, keys), n)
+            if v.dtype == object:
+                err_mask = np.array(
+                    [isinstance(x, EngineError) for x in v], dtype=bool
+                )
+                if err_mask.any():
+                    repl = _materialize(rf(cols, keys), n)
+                    v = v.copy()
+                    v[err_mask] = repl[err_mask]
+                # all errors gone — restore the dense (vectorizable) dtype
+                return _densify(v, dt.types_lca(d, rd))
+            return v
 
         return fn, dt.types_lca(d, rd), False, refs | rrefs
 
@@ -531,11 +549,19 @@ def _build(
                             **{k: _unnp(v[i]) for k, v in karrs.items()},
                         )
                         for i in range(n)
-                    ])
+                    ], return_exceptions=True)
                 results = _run_async(gather())
                 out = np.empty(n, dtype=object)
                 for i, r in enumerate(results):
-                    out[i] = r
+                    if isinstance(r, BaseException):
+                        if not isinstance(r, Exception):
+                            raise r  # CancelledError etc. must not become data
+                        out[i] = EngineError(
+                            f"{type(r).__name__}: {r}",
+                            getattr(fn_user, "__name__", "async apply"),
+                        )
+                    else:
+                        out[i] = r
                 return _densify(out, expr._return_type)
             out = np.empty(n, dtype=object)
             for i in range(n):
@@ -543,7 +569,17 @@ def _build(
                 if prop_none and any(a is None for a in args_i):
                     out[i] = None
                     continue
-                out[i] = fn_user(*args_i, **{k: _unnp(v[i]) for k, v in karrs.items()})
+                try:
+                    out[i] = fn_user(
+                        *args_i, **{k: _unnp(v[i]) for k, v in karrs.items()}
+                    )
+                except Exception as e:
+                    # per-row failure -> Error value (reference Value::Error,
+                    # value.rs:226): the stream continues, fill_error recovers
+                    out[i] = EngineError(
+                        f"{type(e).__name__}: {e}",
+                        getattr(fn_user, "__name__", "apply"),
+                    )
             return _densify(out, expr._return_type)
 
         refs = set().union(*[p[3] for p in parts], *[p[3] for p in kparts.values()]) if (parts or kparts) else set()
@@ -659,6 +695,10 @@ def _binop_fn(op, lf, rf, ldt, rdt, xp):
                 out[i] = la[i] @ ra[i]
             return out
         return fn_mm
+    if op in ("+", "-", "*", "/", "**", "==", "!=", "<", "<=", ">", ">=",
+              "&", "|", "^") and _maybe_obj(ldt, rdt):
+        # object columns may carry None/Error rows — handle per element
+        return _objsafe(fn, op, lf, rf)
     return fn
 
 
@@ -691,7 +731,14 @@ def _objsafe(fast_fn, op, lf, rf):
         out = np.empty(n, dtype=object)
         for i in range(n):
             a, b = _unnp(la[i]), _unnp(ra[i])
-            out[i] = None if a is None or b is None else f(a, b)
+            if isinstance(a, EngineError):
+                out[i] = a  # errors flow through expressions (value.rs:226)
+            elif isinstance(b, EngineError):
+                out[i] = b
+            elif a is None or b is None:
+                out[i] = None
+            else:
+                out[i] = f(a, b)
         return out
 
     return fn
@@ -702,8 +749,8 @@ def _cast_fn(f, src: dt.DType, target: dt.DType, xp):
     su = dt.unoptionalize(src)
 
     def convert_scalar(v):
-        if v is None:
-            return None
+        if v is None or isinstance(v, EngineError):
+            return v
         if tu == dt.INT:
             return int(v)
         if tu == dt.FLOAT:

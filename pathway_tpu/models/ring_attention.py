@@ -77,8 +77,8 @@ def ring_attention(
         b, s, h, d = qb.shape
         qh = qb.transpose(0, 2, 1, 3).astype(jnp.float32)  # [B,H,s,D]
 
-        def step(_, carry):
-            o, m, l, kb, vb, mb = carry
+        def accumulate(carry_olm, kb, vb, mb):
+            o, m, l = carry_olm
             kh = kb.transpose(0, 2, 1, 3).astype(jnp.float32)
             vh = vb.transpose(0, 2, 1, 3).astype(jnp.float32)
             scores = (qh @ kh.transpose(0, 1, 3, 2)) * jnp.float32(scale)  # [B,H,s,s_blk]
@@ -88,16 +88,26 @@ def ring_attention(
             corr = jnp.exp(m - m_new)
             l_new = l * corr + p.sum(-1)
             o_new = o * corr[..., None] + p @ vh
+            return (o_new, m_new, l_new)
+
+        def step(_, carry):
+            olm, kb, vb, mb = carry
+            olm = accumulate(olm, kb, vb, mb)
             # rotate the K/V/mask blocks one hop around the ring (ICI)
             kb = lax.ppermute(kb, axis, perm)
             vb = lax.ppermute(vb, axis, perm)
             mb = lax.ppermute(mb, axis, perm)
-            return (o_new, m_new, l_new, kb, vb, mb)
+            return (olm, kb, vb, mb)
 
         o0 = jnp.zeros((b, h, s, d), jnp.float32)
         m0 = jnp.full((b, h, s), jnp.float32(_NEG), jnp.float32)
         l0 = jnp.zeros((b, h, s), jnp.float32)
-        o, m, l, *_ = lax.fori_loop(0, n, step, (o0, m0, l0, kb, vb, mb))
+        # n-1 rotations suffice: the last block is consumed without another
+        # round of collectives
+        olm, kb, vb, mb = lax.fori_loop(
+            0, n - 1, step, ((o0, m0, l0), kb, vb, mb)
+        )
+        o, m, l = accumulate(olm, kb, vb, mb)
         out = o / jnp.maximum(l, jnp.float32(1e-30))[..., None]
         return out.transpose(0, 2, 1, 3)
 

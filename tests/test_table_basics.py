@@ -389,3 +389,57 @@ def test_compute_and_print(capsys):
     pw.debug.compute_and_print(t)
     out = capsys.readouterr().out
     assert "a" in out and "1" in out
+
+
+def test_row_error_values_and_fill_error():
+    """Per-row UDF failures become Error values (reference Value::Error):
+    the stream survives, fill_error recovers, unwrap refuses."""
+    from pathway_tpu.engine.error import ERROR_LOG
+
+    ERROR_LOG.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=int), [(1,), (0,), (4,)]
+    )
+    res = t.select(
+        x=pw.this.x,
+        inv=pw.apply(lambda v: 10 // v, pw.this.x),
+    )
+    recovered = res.select(
+        x=pw.this.x,
+        inv=pw.fill_error(pw.this.inv, -1),
+    )
+    df = pw.debug.table_to_pandas(recovered).sort_values("x")
+    assert list(df["inv"]) == [-1, 10, 2]  # x=0 recovered to -1
+    assert ERROR_LOG.total == 1
+    [(msg, ctx)] = ERROR_LOG.entries()
+    assert "ZeroDivisionError" in msg
+
+    # raw (unrecovered) error renders as Error and never equals anything
+    from pathway_tpu.internals.parse_graph import G as _G
+
+    _G.clear()
+    t = pw.debug.table_from_rows(pw.schema_from_types(x=int), [(0,)])
+    res = t.select(inv=pw.apply(lambda v: 10 // v, pw.this.x))
+    [val] = pw.debug.table_to_pandas(res)["inv"].tolist()
+    assert repr(val) == "Error"
+
+    # unwrap refuses error values
+    _G.clear()
+    t = pw.debug.table_from_rows(pw.schema_from_types(x=int), [(0,)])
+    res = t.select(inv=pw.unwrap(pw.apply(lambda v: 10 // v, pw.this.x)))
+    with pytest.raises(Exception, match="Error found in column"):
+        pw.debug.table_to_pandas(res)
+
+
+def test_error_values_propagate_through_expressions():
+    t = pw.debug.table_from_rows(pw.schema_from_types(x=int), [(1,), (0,)])
+    res = t.select(
+        x=pw.this.x,
+        y=pw.apply(lambda v: 10 // v, pw.this.x) + 1,  # binop over Error row
+    )
+    df = pw.debug.table_to_pandas(res).sort_values("x")
+    vals = list(df["y"])
+    assert repr(vals[0]) == "Error"  # x=0 row: error propagated, not crashed
+    assert vals[1] == 11
+    # an Error never equals anything, including itself
+    assert (vals[0] == vals[0]) is False
