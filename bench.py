@@ -53,6 +53,8 @@ def main() -> None:
     p50 = float(np.percentile(lat, 50))
     qps = n_queries / (p50 / 1000.0)
 
+    wc_rows_per_sec = _wordcount_throughput()
+
     print(json.dumps({
         "metric": f"knn_p50_latency_{n_docs // 1000}k_docs_batch{n_queries}",
         "value": round(p50, 3),
@@ -64,9 +66,52 @@ def main() -> None:
             "dim": dim,
             "k": k,
             "queries_per_sec": round(qps, 1),
+            "wordcount_stream_rows_per_sec": round(wc_rows_per_sec, 1),
             "baseline_note": "reference publishes no in-repo numbers (BASELINE.md); 50ms north-star serve target used",
         },
     }))
+
+
+def _wordcount_throughput(n_rows: int = 50_000, batch: int = 1_000) -> float:
+    """Streaming wordcount rows/sec through the live engine (the reference's
+    in-repo perf workload, integration_tests/wordcount): python connector ->
+    incremental groupby count -> subscribe, one commit per batch."""
+    import threading
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    words = [f"w{i % 997}" for i in range(n_rows)]
+
+    class Feed(pw.io.python.ConnectorSubject):
+        def run(self) -> None:
+            for start in range(0, n_rows, batch):
+                for w in words[start:start + batch]:
+                    self.next(word=w)
+                self.commit()
+
+    t = pw.io.python.read(
+        Feed(), schema=pw.schema_from_types(word=str),
+        autocommit_duration_ms=None,
+    )
+    counts = t.groupby(pw.this.word).reduce(
+        pw.this.word, c=pw.reducers.count()
+    )
+    done = threading.Event()
+    total = {"n": 0}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            total["n"] = max(total["n"], int(row["c"]))
+
+    pw.io.subscribe(counts, on_change=on_change)
+    t0 = time.perf_counter()
+    pw.run()
+    elapsed = time.perf_counter() - t0
+    G.clear()
+    done.set()
+    return n_rows / elapsed
 
 
 if __name__ == "__main__":

@@ -1,0 +1,54 @@
+"""Streaming wordcount with persistence — the canonical end-to-end workload
+(reference ``integration_tests/wordcount/pw_wordcount.py``).
+
+Watches a directory of CSV files (column ``word``), maintains live counts,
+writes the update stream to an output file, and checkpoints input so a
+killed run resumes exactly where it stopped:
+
+    pathway-tpu spawn -t 2 python examples/wordcount/pw_wordcount.py \\
+        --input ./data --output ./counts.csv --pstorage ./pstate
+
+Feed it by appending lines to any csv in --input while it runs; stop with
+Ctrl-C and restart to see recovery (no duplicated counts).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import pathway_tpu as pw
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", default="data", help="directory of csv files")
+    ap.add_argument("--output", default="counts.csv")
+    ap.add_argument("--pstorage", default=None, help="persistence directory")
+    ap.add_argument("--mode", default="streaming", choices=["streaming", "static"])
+    args = ap.parse_args()
+
+    words = pw.io.csv.read(
+        args.input,
+        schema=pw.schema_from_types(word=str),
+        mode=args.mode,
+        name="words",
+    )
+    counts = words.groupby(pw.this.word).reduce(
+        pw.this.word, count=pw.reducers.count()
+    )
+    pw.io.csv.write(counts, args.output)
+
+    persistence_config = None
+    if args.pstorage is not None:
+        persistence_config = pw.persistence.Config.simple_config(
+            pw.persistence.Backend.filesystem(args.pstorage),
+            snapshot_interval_ms=1000,
+        )
+    pw.run(
+        persistence_config=persistence_config,
+        monitoring_level=pw.MonitoringLevel.AUTO,
+    )
+
+
+if __name__ == "__main__":
+    main()

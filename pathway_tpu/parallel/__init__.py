@@ -6,7 +6,15 @@ pool; record exchange by key becomes a bucketed all-to-all over ICI; dense
 model/index state shards with NamedSharding annotations.
 """
 
-from .mesh import make_mesh, data_model_mesh
-from .exchange import shard_rows, bucketed_all_to_all
+from .distributed import global_mesh, init_from_env
+from .exchange import bucketed_all_to_all, shard_rows
+from .mesh import data_model_mesh, make_mesh
 
-__all__ = ["make_mesh", "data_model_mesh", "shard_rows", "bucketed_all_to_all"]
+__all__ = [
+    "make_mesh",
+    "data_model_mesh",
+    "shard_rows",
+    "bucketed_all_to_all",
+    "init_from_env",
+    "global_mesh",
+]
