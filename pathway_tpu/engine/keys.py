@@ -56,7 +56,14 @@ def shard_of(keys: KeyArray, num_shards: int) -> np.ndarray:
 
 
 def _hash_object_column(col: np.ndarray) -> np.ndarray:
+    from ..native import get_native
+
     out = np.empty(len(col), dtype=np.uint64)
+    native = get_native()
+    if native is not None:
+        # group-key hot path — same per-scalar semantics, in C
+        native.hash_scalars(list(col), _hash_scalar, out)
+        return out
     for i, v in enumerate(col):
         out[i] = _hash_scalar(v)
     return out

@@ -41,12 +41,14 @@ class ConnectorSubject:
     and optionally ``self.commit()`` to close a batch."""
 
     def __init__(self, datasource_name: str = "python"):
-        self._queue: "queue.Queue[Any]" = queue.Queue()
+        # SimpleQueue: C-implemented puts/gets, ~10x cheaper than Queue —
+        # the per-row cross-thread handoff is the ingestion hot path
+        self._queue: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
 
     # -- emission API (reference io/python: next_json / next_str / next) --
 
     def next(self, **kwargs: Any) -> None:
-        self._queue.put(("row", 1, kwargs, None))
+        self._queue.put((1, kwargs, None))
 
     def next_json(self, message: dict | str) -> None:
         if isinstance(message, str):
@@ -61,11 +63,11 @@ class ConnectorSubject:
 
     def _remove(self, **kwargs: Any) -> None:
         """Retract a previously emitted row (matched by content)."""
-        self._queue.put(("row", -1, kwargs, None))
+        self._queue.put((-1, kwargs, None))
 
     def _next_with_key(self, key: int, diff: int = 1, **kwargs: Any) -> None:
         """Emit a row under an explicit engine key (rest_connector plumbing)."""
-        self._queue.put(("row", diff, kwargs, key))
+        self._queue.put((diff, kwargs, key))
 
     def commit(self) -> None:
         self._queue.put(_COMMIT)
@@ -147,10 +149,11 @@ class PythonSubjectSource(RealtimeSource):
         return Delta(keys=keys, data=rows_to_columns(rows, self.names), diffs=diffs)
 
     def poll(self) -> list[Delta]:
+        q = self.subject._queue
         out: list[Delta] = []
         while True:
             try:
-                item = self.subject._queue.get_nowait()
+                item = q.get_nowait()
             except queue.Empty:
                 break
             if item is _DONE:
@@ -168,7 +171,7 @@ class PythonSubjectSource(RealtimeSource):
                     self._partial = []
                 self._last_flush = _time.monotonic()
                 continue
-            _tag, diff, fields, key = item
+            diff, fields, key = item
             if self._skip > 0:
                 # already persisted before restart; the restarted subject
                 # re-emits its deterministic prefix (reference PythonReader

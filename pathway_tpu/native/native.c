@@ -233,6 +233,45 @@ static PyObject *py_hash_rows(PyObject *self, PyObject *args) {
     Py_RETURN_NONE;
 }
 
+/* hash_scalars(values: sequence, fallback, out: writable uint64 buffer)
+ * -> None — per-element hash_scalar (group-key/hash_column hot path) */
+static PyObject *py_hash_scalars(PyObject *self, PyObject *args) {
+    PyObject *values, *fallback, *out_obj;
+    Py_buffer out;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OOO", &values, &fallback, &out_obj))
+        return NULL;
+    if (PyObject_GetBuffer(out_obj, &out, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0)
+        return NULL;
+    {
+        PyObject *seq = PySequence_Fast(values, "values must be a sequence");
+        Py_ssize_t n, i;
+        uint64_t *dst = (uint64_t *)out.buf;
+        if (seq == NULL) {
+            PyBuffer_Release(&out);
+            return NULL;
+        }
+        n = PySequence_Fast_GET_SIZE(seq);
+        if ((Py_ssize_t)(out.len / 8) < n) {
+            Py_DECREF(seq);
+            PyBuffer_Release(&out);
+            PyErr_SetString(PyExc_ValueError, "output buffer too small");
+            return NULL;
+        }
+        for (i = 0; i < n; i++) {
+            if (hash_scalar(PySequence_Fast_GET_ITEM(seq, i), fallback,
+                            &dst[i]) < 0) {
+                Py_DECREF(seq);
+                PyBuffer_Release(&out);
+                return NULL;
+            }
+        }
+        Py_DECREF(seq);
+    }
+    PyBuffer_Release(&out);
+    Py_RETURN_NONE;
+}
+
 /* blake2b8(data: bytes-like) -> int — exposed for parity tests */
 static PyObject *py_blake2b8(PyObject *self, PyObject *arg) {
     Py_buffer buf;
@@ -255,6 +294,8 @@ static PyObject *py_splitmix(PyObject *self, PyObject *arg) {
 static PyMethodDef methods[] = {
     {"hash_rows", py_hash_rows, METH_VARARGS,
      "hash_rows(rows, salt, fallback, out_uint64_buffer)"},
+    {"hash_scalars", py_hash_scalars, METH_VARARGS,
+     "hash_scalars(values, fallback, out_uint64_buffer)"},
     {"blake2b8", py_blake2b8, METH_O, "8-byte BLAKE2b digest as uint64"},
     {"splitmix64", py_splitmix, METH_O, "splitmix64 finalizer"},
     {NULL, NULL, 0, NULL},
