@@ -149,6 +149,13 @@ class RealtimeSource(SourceNode):
     def seek(self, state) -> None:
         """Skip input already covered by `state` (recovery restart)."""
 
+    def observe_replay(self, delta: Delta) -> None:
+        """Recovery: one of this source's persisted batches is being replayed
+        through the dataflow. Diff-based sources (sqlite CDC, full-state
+        scanners) rebuild their internal last-seen state here so the first
+        live poll only emits genuinely new changes instead of re-emitting
+        every pre-existing row."""
+
 
 class Executor:
     """Runs a DAG of Nodes over logical times.
@@ -250,6 +257,23 @@ class Executor:
         operator state deterministically), seek sources past persisted
         offsets, then start recording live input. Returns the last replayed
         time (the clock floor)."""
+        unnamed_schemas: dict[tuple, int] = {}
+        for src in realtime:
+            if src.persistent_id is None:
+                unnamed_schemas[tuple(src.column_names)] = (
+                    unnamed_schemas.get(tuple(src.column_names), 0) + 1
+                )
+        dupes = [cols for cols, n in unnamed_schemas.items() if n > 1]
+        if dupes:
+            # positional fallback ids would silently swap snapshots if the
+            # sources were ever reordered and the column-name check can't
+            # tell them apart — refuse instead (advisor finding r1)
+            raise RuntimeError(
+                f"{sum(unnamed_schemas[c] for c in dupes)} unnamed sources share "
+                f"identical column sets {[list(c) for c in dupes]}; persistence "
+                "cannot distinguish their snapshots across restarts — give each "
+                "source a stable name= id"
+            )
         for i, src in enumerate(realtime):
             if src.persistent_id is None:
                 src.persistent_id = f"src-{i}"
@@ -280,6 +304,7 @@ class Executor:
                 emissions = []
             current_t = t
             emissions.append((src, delta))
+            src.observe_replay(delta)
         if emissions and current_t is not None:
             self._tick(current_t, emissions)
             clock = max(clock, current_t)

@@ -46,13 +46,19 @@ def _render_metrics(stats: Any) -> str:
 
 
 def start_http_server(
-    stats: Any, port: int | None = None, host: str = "0.0.0.0"
+    stats: Any, port: int | None = None, host: str | None = None
 ):
     """Serve /metrics (and / as a liveness probe); returns (server, thread).
-    Call ``server.shutdown()`` to stop."""
-    if port is None:
-        import os
+    Call ``server.shutdown()`` to stop.
 
+    Binds loopback by default — the endpoint exposes operator names and row
+    counts without authentication, so exposure to all interfaces is opt-in
+    via ``PATHWAY_MONITORING_HTTP_HOST=0.0.0.0`` (advisor finding r1)."""
+    import os
+
+    if host is None:
+        host = os.environ.get("PATHWAY_MONITORING_HTTP_HOST", "127.0.0.1")
+    if port is None:
         from ..internals.config import get_pathway_config
 
         base = int(os.environ.get("PATHWAY_MONITORING_HTTP_PORT", DEFAULT_PORT_BASE))

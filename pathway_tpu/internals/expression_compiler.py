@@ -426,8 +426,20 @@ def _build(
             try:
                 v = _materialize(f(cols, keys), n)
             except Exception:
-                # whole-batch failure (vectorized kernels raise batch-wide)
-                return _materialize(rf(cols, keys), n)
+                # a vectorized kernel raises batch-wide; retry row by row so
+                # only the genuinely failing rows receive the replacement —
+                # the reference's per-row Value::Error replacement semantics
+                repl = _materialize(rf(cols, keys), n)
+                v = np.empty(n, dtype=object)
+                for i in range(n):
+                    row_cols = {c: a[i : i + 1] for c, a in cols.items()}
+                    try:
+                        out_i = _materialize(f(row_cols, keys[i : i + 1]), 1)[0]
+                    except Exception:
+                        out_i = repl[i]
+                    # errors can also flow through as values (not raises)
+                    v[i] = repl[i] if isinstance(out_i, EngineError) else out_i
+                return _densify(v, dt.types_lca(d, rd))
             if v.dtype == object:
                 err_mask = np.array(
                     [isinstance(x, EngineError) for x in v], dtype=bool

@@ -35,11 +35,17 @@ class GraphRunner:
         self.persistence: Any = None  # PersistenceManager when pw.run has one
         self.monitoring_level: int = 0
         self.with_http_server: bool = False
+        #: request_stop() may fire while the graph is still building (before
+        #: the executor exists); the flag is handed to the executor on
+        #: creation so early stops aren't lost
+        self.stop_requested: bool = False
 
     # ------------------------------------------------------------------
 
     def _execute(self) -> None:
         self.executor = Executor(self._nodes, persistence=self.persistence)
+        if self.stop_requested:
+            self.executor.request_stop()
         stop_dashboard = None
         http_server = None
         if self.with_http_server:

@@ -47,8 +47,10 @@ def request_stop() -> None:
     the in-flight tick (callable from any thread)."""
     with _lock:
         runner = _current["runner"]
-    if runner is not None and runner.executor is not None:
-        runner.executor.request_stop()
+    if runner is not None:
+        runner.stop_requested = True
+        if runner.executor is not None:
+            runner.executor.request_stop()
 
 
 def run_all(**kwargs: Any) -> None:

@@ -31,26 +31,27 @@ class _KafkaSubject(ConnectorSubject):
         self._consumer = consumer
         self._topics = list(topics)
         self._format = format
-        self._running = True
 
     def run(self) -> None:
+        # the poll loop exits when the engine flags `_stopped` on teardown
+        # (PythonSubjectSource.stop); the consumer is closed on this reader
+        # thread, never concurrently with a poll
         self._consumer.subscribe(self._topics)
-        while self._running:
-            msg = self._consumer.poll(0.2)
-            if msg is None:
-                continue
-            if msg.error():
-                continue
-            value = msg.value()
-            if self._format == "raw":
-                self.next(data=value)
-            else:
-                self.next(**json.loads(value))
-            self.commit()
-
-    def on_stop(self) -> None:
-        self._running = False
-        self._consumer.close()
+        try:
+            while not self.stopped:
+                msg = self._consumer.poll(0.2)
+                if msg is None:
+                    continue
+                if msg.error():
+                    continue
+                value = msg.value()
+                if self._format == "raw":
+                    self.next(data=value)
+                else:
+                    self.next(**json.loads(value))
+                self.commit()
+        finally:
+            self._consumer.close()
 
 
 def read(

@@ -44,6 +44,12 @@ class ConnectorSubject:
         # SimpleQueue: C-implemented puts/gets, ~10x cheaper than Queue —
         # the per-row cross-thread handoff is the ingestion hot path
         self._queue: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
+        #: set when the engine requests shutdown; long-running ``run`` loops
+        #: must check ``self.stopped`` (the reference reader threads exit
+        #: when the main loop drops the channel, src/connectors/mod.rs:427)
+        self._stopped = False
+        self._on_stop_lock = threading.Lock()
+        self._on_stop_fired = False
 
     # -- emission API (reference io/python: next_json / next_str / next) --
 
@@ -78,6 +84,22 @@ class ConnectorSubject:
     def on_stop(self) -> None:
         pass
 
+    @property
+    def stopped(self) -> bool:
+        """True once the engine has requested shutdown. Long-running ``run``
+        loops should poll this (``while not self.stopped: ...``) so reader
+        threads terminate promptly on engine teardown."""
+        return self._stopped
+
+    def _fire_on_stop(self) -> None:
+        """Run ``on_stop`` exactly once, on the reader thread (it may close
+        clients the run loop is still using — never call concurrently)."""
+        with self._on_stop_lock:
+            if self._on_stop_fired:
+                return
+            self._on_stop_fired = True
+        self.on_stop()
+
     def run(self) -> None:
         raise NotImplementedError
 
@@ -87,7 +109,8 @@ class ConnectorSubject:
         except BaseException as e:  # surfaced by the engine loop, not lost
             self._queue.put(_SourceError(e))
         finally:
-            self.on_stop()
+            self._stopped = True
+            self._fire_on_stop()
             self._queue.put(_DONE)
 
 
@@ -194,7 +217,17 @@ class PythonSubjectSource(RealtimeSource):
         return self._done and not self._partial and self.subject._queue.empty()
 
     def stop(self) -> None:
-        pass
+        # flag the subject's run loop to exit so reader threads terminate
+        # and clients close on engine shutdown (advisor finding r1). on_stop
+        # itself runs on the reader thread (run()'s finally) — firing it
+        # here could close a client the loop is still polling; only if the
+        # thread never ran (or won't exit) does teardown fire it directly.
+        self.subject._stopped = True
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            if not self._thread.is_alive():
+                return
+        self.subject._fire_on_stop()
 
     def offset_state(self):
         return {"rows": self._emitted}

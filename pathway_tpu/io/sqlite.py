@@ -113,6 +113,20 @@ class SqliteStreamSource(RealtimeSource):
     def is_finished(self) -> bool:
         return False
 
+    def observe_replay(self, delta: Delta) -> None:
+        # recovery: rebuild `_last` from the replayed input snapshot so the
+        # first live poll diffs against the persisted state instead of an
+        # empty dict (which would re-emit — and double-count — every
+        # pre-existing row; advisor finding r1)
+        arrs = [delta.data[n] for n in self.names]
+        for i in range(len(delta)):
+            row = tuple(a[i] for a in arrs)
+            pk = self._pk(row)
+            if delta.diffs[i] > 0:
+                self._last[pk] = row
+            else:
+                self._last.pop(pk, None)
+
     def stop(self) -> None:
         if self._con is not None:
             self._con.close()
