@@ -159,9 +159,21 @@ def table_from_pandas(
     _stacklevel: int = 1,
 ) -> Table:
     names = [str(c) for c in df.columns if str(c) not in _SPECIAL]
-    rows = []
-    for _, row in df.iterrows():
-        rows.append(tuple(_from_pandas_value(row[c]) for c in names))
+    # columnar extraction (iterrows is ~100x slower and upcasts dtypes)
+    cols = []
+    for c in names:
+        arr = df[c].to_numpy()
+        if arr.dtype.kind in ("i", "u", "b"):
+            cols.append([v.item() for v in arr])
+        elif arr.dtype.kind == "f":
+            cols.append([None if np.isnan(v) else v.item() for v in arr])
+        elif arr.dtype.kind in ("M", "m"):
+            # datetime64/timedelta64: iterate the Series so pandas yields
+            # Timestamp/Timedelta (.item() on ns precision returns raw ints)
+            cols.append([_from_pandas_value(v) for v in df[c]])
+        else:
+            cols.append([_from_pandas_value(v) for v in arr])
+    rows = list(zip(*cols)) if names else [() for _ in range(len(df))]
     times = [int(t) for t in df["__time__"]] if "__time__" in df.columns else None
     diffs = [int(d) for d in df["__diff__"]] if "__diff__" in df.columns else None
     id_values = None

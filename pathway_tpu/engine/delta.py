@@ -73,8 +73,13 @@ def column_of_values(values: list[Any]) -> np.ndarray:
 
 def _object_column(values: list[Any]) -> np.ndarray:
     out = np.empty(len(values), dtype=object)
-    for i, v in enumerate(values):
-        out[i] = v
+    try:
+        # C-speed bulk assignment; raises for sequence-valued cells (tuples,
+        # ndarrays) that numpy would try to broadcast elementwise
+        out[:] = values
+    except (ValueError, TypeError):
+        for i, v in enumerate(values):
+            out[i] = v
     return out
 
 

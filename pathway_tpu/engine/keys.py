@@ -104,7 +104,9 @@ def _fnv1a_vec(items: Iterable[bytes]) -> np.ndarray:
 
 
 def hash_column(col: np.ndarray) -> np.ndarray:
-    """Hash one column of values to uint64, vectorized for numeric dtypes."""
+    """Hash one column of values to uint64, vectorized for numeric dtypes.
+    Narrow dtypes widen first so a value hashes identically whatever width
+    it arrived in (int32 5 == int 5 — matches ``_hash_scalar``)."""
     if col.dtype == np.uint64:
         return _splitmix(col)
     if col.dtype == np.int64:
@@ -113,6 +115,10 @@ def hash_column(col: np.ndarray) -> np.ndarray:
         return _splitmix(col.view(np.uint64))
     if col.dtype == np.bool_:
         return _splitmix(col.astype(np.uint64) + np.uint64(0xB001))
+    if col.dtype.kind in ("i", "u"):
+        return _splitmix(col.astype(np.int64).view(np.uint64))
+    if col.dtype.kind == "f":
+        return _splitmix(col.astype(np.float64).view(np.uint64))
     return _hash_object_column(col)
 
 

@@ -141,6 +141,21 @@ class GroupByReduce(Node):
     insertion of the new one for every affected group.
     Result key = hash of grouping values (consistent across tables, like the
     reference's ``Key::for_values`` result ids).
+
+    Two execution paths (SURVEY §7 step 3 — "semigroup reducers as
+    segment-reduce kernels"):
+
+    - **dense arena** (all reducers count/sum over numeric columns): group
+      state lives in columnar numpy arrays indexed by a dense slot id per
+      group key (``SlotMap``, native C hash). A batch is one argsort +
+      ``np.add.reduceat`` segment reduction + masked array updates — no
+      per-row Python. This is the analog of the reference's
+      ``SemigroupReducerImpl`` O(1)-state path (reduce.rs:40-61) at
+      XLA/numpy batch speed.
+    - **general** (min/max/tuple/custom/object dtypes): per-row multiset
+      accumulators, retraction-correct for non-semigroup reducers. A dense
+      arena demotes to this path permanently if a later batch brings a
+      non-numeric argument column.
     """
 
     def __init__(
@@ -159,6 +174,30 @@ class GroupByReduce(Node):
         self._key_from_column = key_from_column
         # group_key -> [count, group_values, [accs...], last_emitted_row|None]
         self._state: dict[int, list] = {}
+        from .reducers import CountReducer, SumReducer
+        from .slotmap import SlotMap
+
+        self._dense = all(
+            type(r) in (CountReducer, SumReducer) for _, r, _ in reducers
+        )
+        self._is_count = [type(r) is CountReducer for _, r, _ in reducers]
+        if self._dense:
+            self._slots = SlotMap()
+            self._counts = np.empty(0, dtype=np.int64)
+            self._gkey_by_slot = np.empty(0, dtype=np.uint64)
+            self._gvals: list[np.ndarray | None] = [None] * len(group_cols)
+            # sum accumulators (None for count — multiplicity IS the value);
+            # _prev holds the last *emitted* value per reducer, incl. counts
+            self._accs: list[np.ndarray | None] = [
+                None if c else np.empty(0, dtype=np.int64)
+                for c in self._is_count
+            ]
+            self._emitted = np.empty(0, dtype=bool)
+            self._prev: list[np.ndarray] = [
+                np.empty(0, dtype=np.int64) for _ in reducers
+            ]
+
+    _DENSE_DTYPES = ("i", "u", "f", "b")
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d = ins[0]
@@ -170,6 +209,178 @@ class GroupByReduce(Node):
             gkeys = np.asarray(d.data[self._key_from_column], dtype=np.uint64)
         else:
             gkeys = K.mix_columns(gcols, n, salt=self._key_salt)
+        if self._dense:
+            arg_arrays = [
+                None if is_count else np.asarray(d.data[args[0]])
+                for is_count, (_, _, args) in zip(self._is_count, self._reducers)
+            ]
+            if all(
+                a is None or a.dtype.kind in self._DENSE_DTYPES
+                for a in arg_arrays
+            ):
+                return self._process_dense(d, n, gcols, gkeys, arg_arrays)
+            self._demote()
+        return self._process_general(d, n, gcols, gkeys, time)
+
+    # -- dense arena path ------------------------------------------------
+
+    def _grow(self, total: int) -> None:
+        if total <= len(self._counts):
+            return
+        cap = max(64, len(self._counts))
+        while cap < total:
+            cap *= 2
+        self._counts = np.concatenate(
+            [self._counts, np.zeros(cap - len(self._counts), np.int64)]
+        )
+        grown = len(self._counts)
+        self._gkey_by_slot = _resize(self._gkey_by_slot, grown)
+        self._emitted = _resize(self._emitted, grown)
+        for j in range(len(self._accs)):
+            if self._accs[j] is not None:
+                self._accs[j] = _resize(self._accs[j], grown)
+            self._prev[j] = _resize(self._prev[j], grown)
+        for ci in range(len(self._gvals)):
+            if self._gvals[ci] is not None:
+                self._gvals[ci] = _resize(self._gvals[ci], grown)
+
+    def _reclaim_arena(self) -> None:
+        """Drop slots of vanished groups (count 0, nothing emitted) so
+        high-churn keyspaces don't grow the arena forever — the arena analog
+        of the general path's ``del self._state[gk]``."""
+        from .slotmap import SlotMap
+
+        n_alloc = len(self._slots)
+        live = np.flatnonzero(
+            (self._counts[:n_alloc] != 0) | self._emitted[:n_alloc]
+        )
+        if n_alloc - len(live) < max(1024, len(live)):
+            return
+        self._slots = SlotMap.rebuild(self._gkey_by_slot[live])
+        self._counts = self._counts[live].copy()
+        self._gkey_by_slot = self._gkey_by_slot[live].copy()
+        self._emitted = self._emitted[live].copy()
+        for j in range(len(self._accs)):
+            if self._accs[j] is not None:
+                self._accs[j] = self._accs[j][live].copy()
+            self._prev[j] = self._prev[j][live].copy()
+        for ci in range(len(self._gvals)):
+            if self._gvals[ci] is not None:
+                self._gvals[ci] = self._gvals[ci][live].copy()
+
+    def _process_dense(self, d, n, gcols, gkeys, arg_arrays) -> Delta | None:
+        self._reclaim_arena()
+        slots, n_new = self._slots.lookup_or_insert(gkeys)
+        old_n = len(self._slots) - n_new
+        self._grow(len(self._slots))
+        order = np.argsort(slots, kind="stable")
+        ss = slots[order]
+        boundaries = np.flatnonzero(np.diff(ss) != 0) + 1
+        starts = np.concatenate([[0], boundaries])
+        u_slots = ss[starts]
+        if n_new:
+            first_ix = order[starts]  # first batch occurrence of each u_slot
+            fresh = u_slots >= old_n
+            self._gkey_by_slot[u_slots[fresh]] = gkeys[first_ix[fresh]]
+            for ci, col in enumerate(gcols):
+                stored = self._gvals[ci]
+                if stored is None:
+                    stored = np.empty(len(self._counts), dtype=col.dtype)
+                    self._gvals[ci] = stored
+                elif not np.can_cast(col.dtype, stored.dtype):
+                    self._gvals[ci] = stored = stored.astype(object)
+                stored[u_slots[fresh]] = col[first_ix[fresh]]
+
+        diffs_sorted = d.diffs[order]
+        self._counts[u_slots] += np.add.reduceat(diffs_sorted, starts)
+        for j, arr in enumerate(arg_arrays):
+            if arr is None:
+                continue
+            acc = self._accs[j]
+            if arr.dtype.kind == "f" and acc.dtype.kind != "f":
+                self._accs[j] = acc = acc.astype(np.float64)
+                self._prev[j] = self._prev[j].astype(np.float64)
+            contrib = arr.astype(acc.dtype) * d.diffs
+            acc[u_slots] += np.add.reduceat(contrib[order], starts)
+
+        new_counts = self._counts[u_slots]
+        if (new_counts < 0).any():
+            raise ValueError("negative multiplicity in groupby input")
+        alive = new_counts > 0
+        was = self._emitted[u_slots]
+        changed = np.zeros(len(u_slots), dtype=bool)
+        for j in range(len(self._reducers)):
+            new_v = new_counts if self._is_count[j] else self._accs[j][u_slots]
+            changed |= self._prev[j][u_slots] != new_v
+        retract = was & (~alive | changed)
+        insert = alive & (~was | changed)
+        rs = u_slots[retract]
+        is_ = u_slots[insert]
+
+        out = None
+        if len(rs) or len(is_):
+            data: dict[str, np.ndarray] = {}
+            for ci, cname in enumerate(self._group_cols):
+                col = self._gvals[ci]
+                data[cname] = np.concatenate([col[rs], col[is_]])
+            for j, (rname, _, _) in enumerate(self._reducers):
+                if self._is_count[j]:
+                    old_v = self._prev[j][rs]
+                    new_v = self._counts[is_]
+                else:
+                    old_v = self._prev[j][rs]
+                    new_v = self._accs[j][is_]
+                data[rname] = np.concatenate([old_v, new_v])
+            out = Delta(
+                keys=np.concatenate(
+                    [self._gkey_by_slot[rs], self._gkey_by_slot[is_]]
+                ),
+                data=data,
+                diffs=np.concatenate(
+                    [np.full(len(rs), -1, np.int64), np.ones(len(is_), np.int64)]
+                ),
+            )
+        # commit emission bookkeeping + reset emptied groups (the general
+        # path deletes them; here the slot stays but state zeroes so a
+        # revived group starts clean)
+        self._emitted[u_slots] = alive
+        for j in range(len(self._reducers)):
+            if not self._is_count[j]:
+                self._prev[j][is_] = self._accs[j][is_]
+                self._accs[j][u_slots[~alive]] = 0
+                self._prev[j][u_slots[~alive]] = 0
+            else:
+                self._prev[j][is_] = self._counts[is_]
+                self._prev[j][u_slots[~alive]] = 0
+        return out
+
+    def _demote(self) -> None:
+        """Migrate arena state into the general dict state (a non-numeric
+        argument column arrived); one-way, per-operator."""
+        self._dense = False
+        live = np.flatnonzero(self._counts != 0)
+        for slot in live:
+            gk = int(self._gkey_by_slot[slot])
+            gvals = tuple(self._gvals[ci][slot] for ci in range(len(self._group_cols)))
+            accs = []
+            for j, (_, red, _) in enumerate(self._reducers):
+                if self._is_count[j]:
+                    accs.append(int(self._counts[slot]))
+                else:
+                    acc = self._accs[j][slot]
+                    accs.append(acc.item() if isinstance(acc, np.generic) else acc)
+            last = None
+            if self._emitted[slot]:
+                last = gvals + tuple(
+                    self._prev[j][slot].item() for j in range(len(self._reducers))
+                )
+            self._state[gk] = [int(self._counts[slot]), gvals, accs, last]
+        del self._slots, self._counts, self._gkey_by_slot
+        del self._gvals, self._accs, self._emitted, self._prev
+
+    # -- general path ----------------------------------------------------
+
+    def _process_general(self, d, n, gcols, gkeys, time) -> Delta | None:
         arg_cols = [[d.data[a] for a in args] for _, _, args in self._reducers]
         affected: dict[int, None] = {}
         for i in range(n):
@@ -224,6 +435,91 @@ class GroupByReduce(Node):
         )
 
 
+def _resize(arr: np.ndarray, total: int) -> np.ndarray:
+    out = np.zeros(total, dtype=arr.dtype)
+    out[: len(arr)] = arr
+    return out
+
+
+class _SortedSide:
+    """One join side as a log-structured arrangement of jk-sorted columnar
+    runs — the differential *arrangement* analog (sort-merge join on key
+    shards, SURVEY §7 step 3). Probes are vectorized ``searchsorted`` range
+    expansions; retractions ride as negative counts in newer runs and cancel
+    at compaction, so ``d ⋈ state`` stays a linear operator over runs."""
+
+    MAX_RUNS = 8
+
+    def __init__(self, n_cols: int):
+        self._n_cols = n_cols
+        self._runs: list[list] = []  # [jks_sorted, row_keys, cols, counts]
+
+    def __len__(self) -> int:
+        return sum(len(r[0]) for r in self._runs)
+
+    def apply(self, jks, keys, cols, diffs) -> None:
+        if not len(jks):
+            return
+        order = np.argsort(jks, kind="stable")
+        self._runs.append([
+            jks[order],
+            keys[order],
+            [np.asarray(c)[order] for c in cols],
+            diffs[order].astype(np.int64),
+        ])
+        if len(self._runs) > self.MAX_RUNS:
+            self._compact()
+
+    def _compact(self) -> None:
+        from .delta import _concat_cols
+
+        jks = np.concatenate([r[0] for r in self._runs])
+        keys = np.concatenate([r[1] for r in self._runs])
+        cols = [
+            _concat_cols([r[2][i] for r in self._runs])
+            for i in range(self._n_cols)
+        ]
+        counts = np.concatenate([r[3] for r in self._runs])
+        n = len(jks)
+        # row identity = (jk, row_key, values); multiplicities sum, zeros drop
+        sig = K.derive_pair(K.derive_pair(jks, keys), K.mix_columns(cols, n))
+        order = np.argsort(sig, kind="stable")
+        ss = sig[order]
+        starts = np.concatenate([[0], np.flatnonzero(np.diff(ss) != 0) + 1])
+        sums = np.add.reduceat(counts[order], starts)
+        keep = sums != 0
+        reps = order[starts[keep]]
+        jks, keys, counts = jks[reps], keys[reps], sums[keep]
+        cols = [c[reps] for c in cols]
+        order2 = np.argsort(jks, kind="stable")
+        self._runs = (
+            [[
+                jks[order2],
+                keys[order2],
+                [c[order2] for c in cols],
+                counts[order2],
+            ]]
+            if len(jks)
+            else []
+        )
+
+    def probe(self, qjks: np.ndarray):
+        """Yield (q_idx, row_keys, col_arrays, counts) for every state row
+        matching each query jk, per run — the vectorized pair enumeration."""
+        for jks_s, keys, cols, counts in self._runs:
+            lo = np.searchsorted(jks_s, qjks, "left")
+            hi = np.searchsorted(jks_s, qjks, "right")
+            m = hi - lo
+            total = int(m.sum())
+            if not total:
+                continue
+            q_idx = np.repeat(np.arange(len(qjks)), m)
+            side_idx = np.repeat(lo, m) + (
+                np.arange(total) - np.repeat(np.cumsum(m) - m, m)
+            )
+            yield q_idx, keys[side_idx], [c[side_idx] for c in cols], counts[side_idx]
+
+
 class Join(Node):
     """Incremental two-sided join (dataflow.rs:2270 / differential join_core).
 
@@ -231,6 +527,10 @@ class Join(Node):
     Algebra per tick:  out = L_old ⋈ dR  +  dL ⋈ (R_old + dR)
     which equals d(L ⋈ R). Outer modes additionally maintain match counts per
     row and emit/retract null-padded rows on 0↔nonzero transitions.
+
+    Inner joins run fully columnar over ``_SortedSide`` arrangements (no
+    per-row Python); outer modes keep the row-at-a-time path for the pad
+    bookkeeping.
 
     key_mode: 'pair' (result id from both row ids — default joins),
     'left' (keep left row id — backs ``.ix`` / ``id_from=left``), 'right'.
@@ -260,8 +560,13 @@ class Join(Node):
         self._key_mode = key_mode
         self._emit_matched = emit_matched
         self._react_to_right = react_to_right
-        self._left = MultiIndex(left_cols)
-        self._right = MultiIndex(right_cols)
+        self._columnar = mode == "inner"
+        if self._columnar:
+            self._cleft = _SortedSide(len(left_cols))
+            self._cright = _SortedSide(len(right_cols))
+        else:
+            self._left = MultiIndex(left_cols)
+            self._right = MultiIndex(right_cols)
         # row_key -> current pad multiplicity (for outer sides)
         self._lpad: dict[int, int] = {}
         self._rpad: dict[int, int] = {}
@@ -303,7 +608,67 @@ class Join(Node):
             for i in range(len(delta))
         ]
 
+    def _unpack(self, delta: Delta | None, jk_col: str | None, cols: list[str]):
+        if delta is None or not len(delta):
+            return None
+        jks = (
+            delta.keys
+            if jk_col is None
+            else np.asarray(delta.data[jk_col], dtype=np.uint64)
+        )
+        return jks, delta.keys, [delta.data[c] for c in cols], delta.diffs
+
+    def _out_keys_vec(self, lk: np.ndarray, rk: np.ndarray) -> np.ndarray:
+        if self._key_mode == "left":
+            return lk
+        if self._key_mode == "right":
+            return rk
+        return K.derive_pair(lk, rk)
+
+    def _process_columnar(self, ins: list[Delta | None]) -> Delta | None:
+        left = self._unpack(ins[0], self._ljk, self._lcols)
+        right = self._unpack(ins[1], self._rjk, self._rcols)
+        parts: list[Delta] = []
+
+        def emit(lk, rk, lcols, rcols, diffs):
+            data = {}
+            for name, arr in zip(self.column_names, list(lcols) + list(rcols)):
+                data[name] = np.asarray(arr)
+            parts.append(
+                Delta(keys=self._out_keys_vec(lk, rk), data=data, diffs=diffs)
+            )
+
+        # L_old ⋈ dR
+        if self._emit_matched and self._react_to_right and right is not None:
+            r_jks, r_keys, r_cols, r_diffs = right
+            for qi, lkeys, lcols, lcounts in self._cleft.probe(r_jks):
+                emit(
+                    lkeys, r_keys[qi], lcols,
+                    [np.asarray(c)[qi] for c in r_cols],
+                    lcounts * r_diffs[qi],
+                )
+        # apply dR
+        if right is not None:
+            self._cright.apply(*right)
+        # dL ⋈ R_new
+        if self._emit_matched and left is not None:
+            l_jks, l_keys, l_cols, l_diffs = left
+            for qi, rkeys, rcols, rcounts in self._cright.probe(l_jks):
+                emit(
+                    l_keys[qi], rkeys,
+                    [np.asarray(c)[qi] for c in l_cols], rcols,
+                    l_diffs[qi] * rcounts,
+                )
+        # apply dL
+        if left is not None:
+            self._cleft.apply(*left)
+        if not parts:
+            return None
+        return concat_deltas(parts, self.column_names).consolidated()
+
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
+        if self._columnar:
+            return self._process_columnar(ins)
         dl = self._rows_of(ins[0], self._ljk, self._lcols)
         dr = self._rows_of(ins[1], self._rjk, self._rcols)
         out: tuple[list, list, list] = ([], [], [])
@@ -805,6 +1170,7 @@ class Subscribe(Node):
         on_change: Callable[..., None] | None = None,
         on_time_end: Callable[[int], None] | None = None,
         on_end: Callable[[], None] | None = None,
+        on_batch: Callable[[int, Delta], None] | None = None,
         skip_until: int = -1,
     ):
         super().__init__([inp], inp.column_names)
@@ -812,6 +1178,9 @@ class Subscribe(Node):
         self._on_time_end = on_time_end
         self._had_data_at: int | None = None
         self._on_end_cb = on_end
+        #: columnar fast lane: one call per consolidated tick delta (no
+        #: per-row dict building) — the batched counterpart of on_change
+        self._on_batch = on_batch
         # suppress re-emission of already-persisted times on recovery
         # (reference io.subscribe skip_persisted_batch)
         self._skip_until = skip_until
@@ -823,6 +1192,8 @@ class Subscribe(Node):
         if time <= self._skip_until:
             return None
         d = d.consolidated()
+        if self._on_batch is not None and len(d):
+            self._on_batch(time, d)
         if self._on_change is not None:
             for key, row, diff in d.iter_rows():
                 self._on_change(

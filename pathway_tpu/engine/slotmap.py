@@ -1,0 +1,73 @@
+"""SlotMap — dense slot ids for uint64 keys, batch-vectorized.
+
+The reference engine holds per-key operator state in differential
+arrangements (indexed batches); here keyed state lives in columnar numpy
+arrays indexed by a dense *slot* id per key. The key→slot map is the native
+open-addressing ``KeyTable`` (``native/native.c``) when available, with a
+pure-Python dict fallback (identical slot assignment order: first
+occurrence wins).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["SlotMap"]
+
+
+class SlotMap:
+    def __init__(self) -> None:
+        from ..native import get_native
+
+        native = get_native()
+        self._table = native.KeyTable() if native is not None else None
+        self._dict: dict[int, int] | None = None if self._table is not None else {}
+
+    def __len__(self) -> int:
+        if self._table is not None:
+            return len(self._table)
+        return len(self._dict)
+
+    def lookup_or_insert(self, keys: np.ndarray) -> tuple[np.ndarray, int]:
+        """Slot per key (dense ids in first-seen order); returns
+        (slots int64[n], n_new)."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        out = np.empty(len(keys), dtype=np.int64)
+        if self._table is not None:
+            n_new = self._table.lookup_or_insert(keys, out)
+            return out, n_new
+        d = self._dict
+        n_new = 0
+        for i, k in enumerate(keys):
+            k = int(k)
+            slot = d.get(k)
+            if slot is None:
+                slot = len(d)
+                d[k] = slot
+                n_new += 1
+            out[i] = slot
+        return out, n_new
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Slot per key; -1 where absent."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        out = np.empty(len(keys), dtype=np.int64)
+        if self._table is not None:
+            self._table.lookup(keys, out)
+            return out
+        d = self._dict
+        for i, k in enumerate(keys):
+            out[i] = d.get(int(k), -1)
+        return out
+
+    @staticmethod
+    def rebuild(keys_in_slot_order: np.ndarray) -> "SlotMap":
+        """Reconstruct a map whose slot assignment matches a persisted
+        key-by-slot array (operator snapshot restore)."""
+        m = SlotMap()
+        if len(keys_in_slot_order):
+            slots, _ = m.lookup_or_insert(
+                np.asarray(keys_in_slot_order, dtype=np.uint64)
+            )
+            assert slots[-1] == len(keys_in_slot_order) - 1
+        return m

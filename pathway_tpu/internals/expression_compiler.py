@@ -50,6 +50,7 @@ from .expression import (
 )
 
 JIT_THRESHOLD = int(os.environ.get("PATHWAY_TPU_JIT_THRESHOLD", "4096"))
+JIT_WARMUP_BATCHES = int(os.environ.get("PATHWAY_TPU_JIT_WARMUP_BATCHES", "16"))
 
 _NUMERIC = {dt.INT, dt.FLOAT, dt.BOOL}
 
@@ -144,17 +145,56 @@ def _compile_expr_uncached(expr: ColumnExpression, env: ColumnEnv) -> Compiled:
         jitted = _make_jitted(expr, env)
         ref_cols = [c for c in refs if c is not None]
 
+        hot = [0]  # large batches seen; compile only once it pays off
+
         def fn(cols: dict[str, np.ndarray], keys: np.ndarray) -> np.ndarray:
+            import jax
+
             n = len(keys)
-            if n >= JIT_THRESHOLD and all(
-                cols[c].dtype != object for c in ref_cols
+            if (
+                n >= JIT_THRESHOLD
+                and jax.config.jax_enable_x64
+                and all(cols[c].dtype != object for c in ref_cols)
             ):
-                out = jitted(cols, keys)
-                return np.asarray(out)
+                # x64 gate: without it the traced kernel silently truncates
+                # INT/FLOAT columns to 32 bits — wrong values, and 32-bit
+                # outputs knock every downstream key hash off the fast path.
+                # warm-up gate: XLA compilation (~100ms) only pays for
+                # expressions that keep seeing large batches (long-running
+                # streams); short batch jobs stay on the numpy kernels.
+                hot[0] += 1
+                if hot[0] <= JIT_WARMUP_BATCHES:
+                    return np_fn(cols, keys)
+                # pin to the host CPU backend: streaming tick batches are
+                # latency-bound host work; shipping them to an accelerator
+                # (worse, a tunneled one) per tick costs more than the fused
+                # kernel saves. The TPU is for the dense kernels (knn,
+                # embedder, window aggregation) that amortize the transfer.
+                # Override with PATHWAY_TPU_EXPR_BACKEND=tpu.
+                dev = _engine_device()
+                if dev is not None:
+                    with jax.default_device(dev):
+                        return np.asarray(jitted(cols, keys))
+                return np.asarray(jitted(cols, keys))
             return np_fn(cols, keys)
 
         return Compiled(fn, dtype)
     return Compiled(np_fn, dtype)
+
+
+_engine_dev_cache: list = []
+
+
+def _engine_device():
+    if not _engine_dev_cache:
+        import jax
+
+        backend = os.environ.get("PATHWAY_TPU_EXPR_BACKEND", "cpu")
+        try:
+            _engine_dev_cache.append(jax.local_devices(backend=backend)[0])
+        except Exception:
+            _engine_dev_cache.append(None)
+    return _engine_dev_cache[0]
 
 
 _jax_checked: list[bool] = []

@@ -99,6 +99,7 @@ class GraphRunner:
                 on_change=sink.get("on_change"),
                 on_time_end=sink.get("on_time_end"),
                 on_end=sink.get("on_end"),
+                on_batch=sink.get("on_batch"),
                 skip_until=skip_until,
             )
             self._nodes.append(sub)

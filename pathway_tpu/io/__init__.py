@@ -82,17 +82,24 @@ def subscribe(
     on_end: Callable[[], None] | None = None,
     on_time_end: Callable[[int], None] | None = None,
     *,
+    on_batch: Callable[..., None] | None = None,
     skip_persisted_batch: bool = True,
     name: str | None = None,
     sort_by: Any = None,
 ) -> None:
     """Call ``on_change(key, row, time, is_addition)`` for every row update
-    (reference ``io/subscribe``)."""
+    (reference ``io/subscribe``).
+
+    ``on_batch(time, batch)`` is the columnar fast lane: called once per
+    consolidated tick delta with the raw batch (``batch.keys`` uint64[n],
+    ``batch.data`` {col: array}, ``batch.diffs`` ±k int64[n]) — no per-row
+    dict building, for high-throughput sinks."""
     G.add_sink({
         "kind": "subscribe",
         "table": table,
         "on_change": on_change,
         "on_time_end": on_time_end,
         "on_end": on_end,
+        "on_batch": on_batch,
         "skip_persisted_batch": skip_persisted_batch,
     })

@@ -324,22 +324,26 @@ def write(table: Table, filename: str | os.PathLike, *, format: str = "csv", nam
                 state["writer"] = w
         return state["f"]
 
-    def on_change(key, row, time, is_addition):
+    def on_batch(time, batch):
         f = ensure_open()
-        diff = 1 if is_addition else -1
+        cols = [batch.data[n] for n in names]
         if format == "csv":
-            state["writer"].writerow([row[n] for n in names] + [time, diff])
+            state["writer"].writerows(
+                list(vals) + [time, int(diff)]
+                for vals, diff in zip(zip(*cols), batch.diffs)
+            )
         else:
-            obj = {n: _jsonable(row[n]) for n in names}
-            obj["time"] = time
-            obj["diff"] = diff
-            f.write(json.dumps(obj) + "\n")
+            for vals, diff in zip(zip(*cols), batch.diffs):
+                obj = {n: _jsonable(v) for n, v in zip(names, vals)}
+                obj["time"] = time
+                obj["diff"] = int(diff)
+                f.write(json.dumps(obj) + "\n")
 
     def on_end():
         ensure_open()
         state["f"].close()
 
-    subscribe(table, on_change=on_change, on_end=on_end)
+    subscribe(table, on_batch=on_batch, on_end=on_end)
 
 
 def _jsonable(v: Any) -> Any:

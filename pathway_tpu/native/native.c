@@ -291,6 +291,184 @@ static PyObject *py_splitmix(PyObject *self, PyObject *arg) {
     return PyLong_FromUnsignedLongLong(splitmix(x));
 }
 
+/* ----------------------------------------------------------------- */
+/* KeyTable — open-addressing uint64 -> slot map with batch lookups.  */
+/* Powers the dense groupby arena and join state: slot ids are dense  */
+/* row indices into columnar (numpy) state arrays, so per-key state   */
+/* updates become vectorized array ops instead of Python dict churn   */
+/* (the role differential arrangements play in the reference).        */
+
+typedef struct {
+    PyObject_HEAD
+    uint64_t *keys;
+    int64_t *slots;
+    uint8_t *used;
+    Py_ssize_t capacity; /* power of two */
+    Py_ssize_t size;
+    int64_t next_slot;
+} KeyTableObject;
+
+static int keytable_grow(KeyTableObject *t, Py_ssize_t min_capacity) {
+    Py_ssize_t new_cap = t->capacity ? t->capacity : 64;
+    uint64_t *nk;
+    int64_t *ns;
+    uint8_t *nu;
+    Py_ssize_t i;
+    while (new_cap < min_capacity) new_cap <<= 1;
+    nk = (uint64_t *)malloc((size_t)new_cap * 8);
+    ns = (int64_t *)malloc((size_t)new_cap * 8);
+    nu = (uint8_t *)calloc((size_t)new_cap, 1);
+    if (!nk || !ns || !nu) {
+        free(nk); free(ns); free(nu);
+        PyErr_NoMemory();
+        return -1;
+    }
+    for (i = 0; i < t->capacity; i++) {
+        if (t->used[i]) {
+            uint64_t h = splitmix(t->keys[i]);
+            Py_ssize_t j = (Py_ssize_t)(h & (uint64_t)(new_cap - 1));
+            while (nu[j]) j = (j + 1) & (new_cap - 1);
+            nu[j] = 1;
+            nk[j] = t->keys[i];
+            ns[j] = t->slots[i];
+        }
+    }
+    free(t->keys); free(t->slots); free(t->used);
+    t->keys = nk; t->slots = ns; t->used = nu;
+    t->capacity = new_cap;
+    return 0;
+}
+
+/* lookup_or_insert(keys: uint64 buffer, out: int64 buffer) -> n_new */
+static PyObject *keytable_lookup_or_insert(PyObject *self, PyObject *args) {
+    KeyTableObject *t = (KeyTableObject *)self;
+    PyObject *keys_obj, *out_obj;
+    Py_buffer keys, out;
+    Py_ssize_t n, i, n_new = 0;
+    if (!PyArg_ParseTuple(args, "OO", &keys_obj, &out_obj)) return NULL;
+    if (PyObject_GetBuffer(keys_obj, &keys, PyBUF_C_CONTIGUOUS) < 0) return NULL;
+    if (PyObject_GetBuffer(out_obj, &out, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0) {
+        PyBuffer_Release(&keys);
+        return NULL;
+    }
+    n = keys.len / 8;
+    if (out.len / 8 < n) {
+        PyBuffer_Release(&keys); PyBuffer_Release(&out);
+        PyErr_SetString(PyExc_ValueError, "output buffer too small");
+        return NULL;
+    }
+    /* worst case inserts all n keys; keep load factor under 0.7 */
+    if ((t->size + n) * 10 >= t->capacity * 7) {
+        if (keytable_grow(t, (t->size + n) * 2) < 0) {
+            PyBuffer_Release(&keys); PyBuffer_Release(&out);
+            return NULL;
+        }
+    }
+    {
+        const uint64_t *src = (const uint64_t *)keys.buf;
+        int64_t *dst = (int64_t *)out.buf;
+        uint64_t mask = (uint64_t)(t->capacity - 1);
+        for (i = 0; i < n; i++) {
+            uint64_t k = src[i];
+            Py_ssize_t j = (Py_ssize_t)(splitmix(k) & mask);
+            while (t->used[j] && t->keys[j] != k) j = (j + 1) & mask;
+            if (!t->used[j]) {
+                t->used[j] = 1;
+                t->keys[j] = k;
+                t->slots[j] = t->next_slot++;
+                t->size++;
+                n_new++;
+            }
+            dst[i] = t->slots[j];
+        }
+    }
+    PyBuffer_Release(&keys);
+    PyBuffer_Release(&out);
+    return PyLong_FromSsize_t(n_new);
+}
+
+/* lookup(keys: uint64 buffer, out: int64 buffer) -> None; missing = -1 */
+static PyObject *keytable_lookup(PyObject *self, PyObject *args) {
+    KeyTableObject *t = (KeyTableObject *)self;
+    PyObject *keys_obj, *out_obj;
+    Py_buffer keys, out;
+    Py_ssize_t n, i;
+    if (!PyArg_ParseTuple(args, "OO", &keys_obj, &out_obj)) return NULL;
+    if (PyObject_GetBuffer(keys_obj, &keys, PyBUF_C_CONTIGUOUS) < 0) return NULL;
+    if (PyObject_GetBuffer(out_obj, &out, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0) {
+        PyBuffer_Release(&keys);
+        return NULL;
+    }
+    n = keys.len / 8;
+    if (out.len / 8 < n) {
+        PyBuffer_Release(&keys); PyBuffer_Release(&out);
+        PyErr_SetString(PyExc_ValueError, "output buffer too small");
+        return NULL;
+    }
+    if (t->capacity == 0) {
+        int64_t *dst = (int64_t *)out.buf;
+        for (i = 0; i < n; i++) dst[i] = -1;
+    } else {
+        const uint64_t *src = (const uint64_t *)keys.buf;
+        int64_t *dst = (int64_t *)out.buf;
+        uint64_t mask = (uint64_t)(t->capacity - 1);
+        for (i = 0; i < n; i++) {
+            uint64_t k = src[i];
+            Py_ssize_t j = (Py_ssize_t)(splitmix(k) & mask);
+            while (t->used[j] && t->keys[j] != k) j = (j + 1) & mask;
+            dst[i] = t->used[j] ? t->slots[j] : -1;
+        }
+    }
+    PyBuffer_Release(&keys);
+    PyBuffer_Release(&out);
+    Py_RETURN_NONE;
+}
+
+static Py_ssize_t keytable_len(PyObject *self) {
+    return ((KeyTableObject *)self)->size;
+}
+
+static void keytable_dealloc(PyObject *self) {
+    KeyTableObject *t = (KeyTableObject *)self;
+    free(t->keys); free(t->slots); free(t->used);
+    Py_TYPE(self)->tp_free(self);
+}
+
+static PyObject *keytable_new(PyTypeObject *type, PyObject *args,
+                              PyObject *kwds) {
+    KeyTableObject *t;
+    (void)args; (void)kwds;
+    t = (KeyTableObject *)type->tp_alloc(type, 0);
+    if (t == NULL) return NULL;
+    t->keys = NULL; t->slots = NULL; t->used = NULL;
+    t->capacity = 0; t->size = 0; t->next_slot = 0;
+    return (PyObject *)t;
+}
+
+static PyMethodDef keytable_methods[] = {
+    {"lookup_or_insert", keytable_lookup_or_insert, METH_VARARGS,
+     "lookup_or_insert(keys_u64, out_i64) -> n_new"},
+    {"lookup", keytable_lookup, METH_VARARGS,
+     "lookup(keys_u64, out_i64); missing -> -1"},
+    {NULL, NULL, 0, NULL},
+};
+
+static PySequenceMethods keytable_as_sequence = {
+    keytable_len, /* sq_length */
+};
+
+static PyTypeObject KeyTableType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "_pathway_native.KeyTable",
+    .tp_basicsize = sizeof(KeyTableObject),
+    .tp_dealloc = keytable_dealloc,
+    .tp_as_sequence = &keytable_as_sequence,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "open-addressing uint64 -> dense slot map (batch API)",
+    .tp_methods = keytable_methods,
+    .tp_new = keytable_new,
+};
+
 static PyMethodDef methods[] = {
     {"hash_rows", py_hash_rows, METH_VARARGS,
      "hash_rows(rows, salt, fallback, out_uint64_buffer)"},
@@ -308,5 +486,15 @@ static struct PyModuleDef module = {
 };
 
 PyMODINIT_FUNC PyInit__pathway_native(void) {
-    return PyModule_Create(&module);
+    PyObject *m;
+    if (PyType_Ready(&KeyTableType) < 0) return NULL;
+    m = PyModule_Create(&module);
+    if (m == NULL) return NULL;
+    Py_INCREF(&KeyTableType);
+    if (PyModule_AddObject(m, "KeyTable", (PyObject *)&KeyTableType) < 0) {
+        Py_DECREF(&KeyTableType);
+        Py_DECREF(m);
+        return NULL;
+    }
+    return m;
 }
