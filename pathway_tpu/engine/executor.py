@@ -69,10 +69,26 @@ class Node:
 
     _ids = itertools.count()
 
+    #: run process() every tick even with no local input (Exchange nodes
+    #: must join every collective; sharded peers may be sending rows)
+    always_run = False
+
     def __init__(self, inputs: list["Node"], column_names: list[str]):
         self.node_id = next(Node._ids)
         self.inputs = list(inputs)
         self.column_names = list(column_names)
+
+    def exchange_specs(self) -> list[tuple | None]:
+        """Routing requirement per input port for sharded execution: None
+        (stateless — rows may stay wherever they are) or a route spec the
+        sharding pass turns into an Exchange node (see operators.Exchange).
+        Stateful operators MUST route so each worker owns a disjoint
+        key-shard of their state (reference ShardPolicy, value.rs:93)."""
+        return [None] * len(self.inputs)
+
+    def on_shard(self, ctx) -> None:
+        """Hook called by the sharding pass on every node; sink nodes mute
+        user callbacks on workers that never receive gathered rows."""
 
     def process(self, time: int, in_deltas: list[Delta | None]) -> Delta | None:
         raise NotImplementedError
@@ -157,6 +173,47 @@ class RealtimeSource(SourceNode):
         every pre-existing row."""
 
 
+def _topological(nodes: list[Node]) -> list[Node]:
+    """Deterministic topo order (DFS post-order, children by construction
+    id): the sharding pass inserts Exchange nodes after their consumers were
+    constructed, so plain id order is no longer topological."""
+    seen: dict[int, bool] = {}
+    out: list[Node] = []
+
+    def visit(n: Node) -> None:
+        if seen.get(n.node_id):
+            return
+        seen[n.node_id] = True
+        for inp in n.inputs:
+            visit(inp)
+        out.append(n)
+
+    for n in sorted(nodes, key=lambda n: n.node_id):
+        visit(n)
+    return out
+
+
+def shard_graph(nodes: list[Node], ctx: Any) -> list[Node]:
+    """Insert Exchange nodes on every stateful-operator input (SURVEY §7
+    step 6: record exchange at groupby/join boundaries). Channel ids derive
+    from each consumer's position in the deterministic build order so the
+    same program built on every worker agrees on them."""
+    from .operators import Exchange
+
+    ordered = sorted(nodes, key=lambda n: n.node_id)
+    out = list(ordered)
+    for pos, node in enumerate(ordered):
+        node.on_shard(ctx)
+        for port, spec in enumerate(node.exchange_specs()):
+            if spec is None:
+                continue
+            ex = Exchange(node.inputs[port], spec, ctx)
+            ex.channel = pos * 16 + port
+            node.inputs[port] = ex
+            out.append(ex)
+    return out
+
+
 class Executor:
     """Runs a DAG of Nodes over logical times.
 
@@ -168,9 +225,20 @@ class Executor:
     briefly when idle.
     """
 
-    def __init__(self, nodes: list[Node], persistence: Any = None):
-        # nodes must be in construction order == topological order
-        self.nodes = sorted(nodes, key=lambda n: n.node_id)
+    def __init__(self, nodes: list[Node], persistence: Any = None, ctx: Any = None):
+        if ctx is None:
+            from ..parallel.comm import single_worker_context
+
+            ctx = single_worker_context()
+        self.ctx = ctx
+        if ctx.is_sharded:
+            if persistence is not None:
+                raise NotImplementedError(
+                    "persistence with multi-worker execution is not wired "
+                    "yet — run with one worker or without persistence"
+                )
+            nodes = shard_graph(nodes, ctx)
+        self.nodes = _topological(nodes)
         self._consumers: dict[int, list[tuple[Node, int]]] = {}
         for node in self.nodes:
             for port, inp in enumerate(node.inputs):
@@ -185,6 +253,18 @@ class Executor:
     def request_stop(self) -> None:
         self._stop_requested = True
 
+    def _partition_source(self, delta: Delta) -> Delta:
+        """Each worker reads its key-shard of every static schedule (no
+        exchange needed at sources: downstream stateful boundaries re-route
+        anyway). Times stay aligned across workers — empty partitions still
+        tick."""
+        if not self.ctx.is_sharded:
+            return delta
+        from . import keys as K
+
+        shards = K.shard_of(delta.keys, self.ctx.n_workers)
+        return delta.take(np.flatnonzero(shards == self.ctx.worker_id))
+
     def run(self) -> None:
         realtime = [n for n in self.nodes if isinstance(n, RealtimeSource)]
         if realtime:
@@ -195,7 +275,9 @@ class Executor:
         for node in self.nodes:
             if isinstance(node, SourceNode):
                 for time, delta in node.schedule():
-                    pending.setdefault(int(time), []).append((node, delta))
+                    pending.setdefault(int(time), []).append(
+                        (node, self._partition_source(delta))
+                    )
 
         for time in sorted(pending):
             self._tick(time, pending[time])
@@ -209,7 +291,9 @@ class Executor:
         for node in self.nodes:
             if isinstance(node, SourceNode) and not isinstance(node, RealtimeSource):
                 for t, delta in node.schedule():
-                    pending.setdefault(int(t), []).append((node, delta))
+                    pending.setdefault(int(t), []).append(
+                        (node, self._partition_source(delta))
+                    )
         clock = 0
         for t in sorted(pending):
             clock = max(clock + 2, int(t))
@@ -217,6 +301,11 @@ class Executor:
 
         if self.persistence is not None:
             clock = max(clock, self._recover(realtime))
+
+        if self.ctx.is_sharded:
+            self._stream_loop_sharded(realtime, clock)
+            self._finish()
+            return
 
         for src in realtime:
             src.start()
@@ -251,6 +340,57 @@ class Executor:
             for src in realtime:
                 src.stop()
         self._finish()
+
+    def _stream_loop_sharded(self, realtime: list[RealtimeSource], clock: int) -> None:
+        """Multi-worker streaming event loop: each realtime source is polled
+        by exactly one owner worker (reference ``parallel_readers`` — other
+        workers idle on that source, worker-architecture doc :40-42); every
+        poll cycle the workers allgather (rounds, finished, stop, wall) so
+        all agree on the tick times to sweep — the host-side progress
+        protocol of SURVEY §7 hard part (c) under a total order."""
+        import time as _time
+
+        ctx = self.ctx
+        owned = [
+            s for i, s in enumerate(realtime)
+            if i % ctx.n_workers == ctx.worker_id
+        ]
+        for src in owned:
+            src.start()
+        cycle = 0
+        try:
+            while True:
+                rounds: list[list[tuple[SourceNode, Delta]]] = []
+                for src in owned:
+                    for j, delta in enumerate(src.poll()):
+                        if delta is None or not len(delta):
+                            continue
+                        while len(rounds) <= j:
+                            rounds.append([])
+                        rounds[j].append((src, delta))
+                finished = all(src.is_finished() for src in owned)
+                wall = int(_time.time() * 1000) & ~1
+                gathered = ctx.comm.allgather(
+                    ("cycle", cycle), ctx.worker_id,
+                    (len(rounds), finished, self._stop_requested, wall),
+                )
+                cycle += 1
+                if any(p[2] for p in gathered):
+                    break
+                n_rounds = max(p[0] for p in gathered)
+                agreed_wall = max(p[3] for p in gathered)
+                for j in range(n_rounds):
+                    # identical on every worker: deterministic fn of the
+                    # gathered payload and the shared tick history
+                    clock = max(clock + 2, agreed_wall + 2 * j)
+                    self._tick(clock, rounds[j] if j < len(rounds) else [])
+                if n_rounds == 0:
+                    if all(p[1] for p in gathered):
+                        break
+                    _time.sleep(0.005)
+        finally:
+            for src in owned:
+                src.stop()
 
     def _recover(self, realtime: list[RealtimeSource]) -> int:
         """Replay the input snapshot through the dataflow (rebuilding all
@@ -332,14 +472,14 @@ class Executor:
             ports = inbox.get(node.node_id, {})
             if node.node_id in seeded:
                 out_parts.extend(d for d in seeded[node.node_id] if len(d))
-            elif ports or not node.inputs:
+            elif ports or not node.inputs or node.always_run:
                 ins: list[Delta | None] = [
                     concat_deltas(ports.get(p, []), node.inputs[p].column_names)
                     if p in ports
                     else None
                     for p in range(len(node.inputs))
                 ]
-                if any(x is not None for x in ins):
+                if any(x is not None for x in ins) or node.always_run:
                     if node.inputs and not self._consumers.get(node.node_id):
                         # terminal node (Subscribe/Capture/output writer):
                         # rows reaching it ARE the pipeline's output
@@ -377,7 +517,7 @@ class Executor:
         for node in self.nodes:
             out_parts: list[Delta] = []
             ports = inbox.get(node.node_id, {})
-            if ports:
+            if ports or (node.always_run and node.inputs):
                 ins = [
                     concat_deltas(ports.get(p, []), node.inputs[p].column_names)
                     if p in ports

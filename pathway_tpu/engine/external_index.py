@@ -59,6 +59,11 @@ class ExternalIndexNode(Node):
         # asof-now mode still must retract answers when the *query* retracts
         self._answered: dict[int, tuple] = {}
 
+    def exchange_specs(self):
+        # the index lives on worker 0 (sharded index variants live at the
+        # ops layer: ops/knn.py sharded_topk with all-gather merge)
+        return [("gather",), ("gather",)]
+
     def process(self, time: int, in_deltas: list[Delta | None]) -> Delta | None:
         data_d, query_d = in_deltas
         index_changed = False

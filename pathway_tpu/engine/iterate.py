@@ -71,6 +71,11 @@ class Iterate(Node):
         self.pending: dict[str, Delta] = {}
         self.out_specs = out_specs
 
+    def exchange_specs(self):
+        # the inner fixpoint is a single-worker composite: gather inputs to
+        # worker 0 (downstream stateful ops re-shard its outputs)
+        return [("gather",) for _ in self.inputs]
+
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         changed = False
         for port, d in enumerate(ins):

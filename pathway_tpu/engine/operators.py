@@ -133,6 +133,64 @@ class Concat(Node):
         return concat_deltas(parts, self.column_names)
 
 
+class Exchange(Node):
+    """Cross-worker record routing (the timely Exchange pact analog).
+
+    Inserted automatically before every stateful operator input when the
+    engine runs sharded (``shard_graph``): buckets local delta rows by the
+    owner shard of their routing key (low key bits — reference SHARD_MASK,
+    value.rs:38) and swaps buckets with all peers through the comm backend.
+    Runs EVERY tick (``always_run``) — a worker with no local rows must
+    still participate in the all-to-all to receive rows others route to it.
+
+    route_spec: ("key",) row key | ("column", name) uint64 column |
+    ("mix", cols, salt) group-value mix | ("gather",) everything→worker 0.
+    """
+
+    always_run = True
+
+    def __init__(self, inp: Node, route_spec: tuple, ctx):
+        super().__init__([inp], inp.column_names)
+        self._spec = route_spec
+        self._ctx = ctx
+        #: stable cross-worker channel id; assigned by shard_graph (node ids
+        #: are process-global counters and may differ between workers)
+        self.channel: int = -1
+
+    def _route_keys(self, d: Delta) -> np.ndarray:
+        kind = self._spec[0]
+        if kind == "key":
+            return d.keys
+        if kind == "column":
+            return np.asarray(d.data[self._spec[1]], dtype=np.uint64)
+        if kind == "mix":
+            cols = [np.asarray(d.data[c]) for c in self._spec[1]]
+            return K.mix_columns(cols, len(d), salt=self._spec[2])
+        raise AssertionError(self._spec)
+
+    def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
+        ctx = self._ctx
+        n_w = ctx.n_workers
+        d = ins[0]
+        buckets: list[Delta | None] = [None] * n_w
+        if d is not None and len(d):
+            if self._spec[0] == "gather":
+                buckets[0] = d
+            else:
+                shards = K.shard_of(self._route_keys(d), n_w)
+                for w in range(n_w):
+                    ix = np.flatnonzero(shards == w)
+                    if len(ix):
+                        buckets[w] = d.take(ix)
+        received = ctx.comm.exchange(
+            self.channel, time, ctx.worker_id, buckets
+        )
+        received = [r for r in received if r is not None and len(r)]
+        if not received:
+            return None
+        return concat_deltas(received, self.column_names)
+
+
 class GroupByReduce(Node):
     """group_by_table + reducers (graph.rs:885, reduce.rs).
 
@@ -198,6 +256,11 @@ class GroupByReduce(Node):
             ]
 
     _DENSE_DTYPES = ("i", "u", "f", "b")
+
+    def exchange_specs(self):
+        if self._key_from_column is not None:
+            return [("column", self._key_from_column)]
+        return [("mix", self._group_cols, self._key_salt)]
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d = ins[0]
@@ -571,6 +634,14 @@ class Join(Node):
         self._lpad: dict[int, int] = {}
         self._rpad: dict[int, int] = {}
 
+    def exchange_specs(self):
+        # both sides route by join key -> matching rows co-locate
+        # (ShardPolicy::LastKeyColumn analog)
+        return [
+            ("key",) if self._ljk is None else ("column", self._ljk),
+            ("key",) if self._rjk is None else ("column", self._rjk),
+        ]
+
     def _out_key(self, lk: int, rk: int) -> int:
         if self._key_mode == "left":
             return lk
@@ -755,6 +826,12 @@ class GroupedRecompute(Node):
         ]  # per input: group_key -> {row_key: [[row, count], ...]}
         self._prev_out: dict[int, dict[int, tuple]] = {}
 
+    def exchange_specs(self):
+        return [
+            ("gather",) if col is None else ("column", col)
+            for col in self._group_cols
+        ]
+
     def _gkeys(self, port: int, d: Delta) -> np.ndarray:
         col = self._group_cols[port]
         if col is None:
@@ -848,6 +925,9 @@ class UpdateRows(Node):
         self._self_state = RowState(left.column_names)
         self._other_state = RowState(left.column_names)
 
+    def exchange_specs(self):
+        return [("key",), ("key",)]
+
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d_self = ins[0].select_columns(self.column_names) if ins[0] is not None else None
         d_other = ins[1].select_columns(self.column_names) if ins[1] is not None else None
@@ -881,6 +961,9 @@ class UpdateCells(Node):
         self._override = override_cols
         self._self_state = RowState(left.column_names)
         self._other_state = RowState(override_cols)
+
+    def exchange_specs(self):
+        return [("key",), ("key",)]
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d_self = ins[0]
@@ -1085,6 +1168,11 @@ class Deduplicate(Node):
         # instance_key -> [accepted_value, row, out_key]
         self._state: dict[int, list] = {}
 
+    def exchange_specs(self):
+        if self._instance_col is None:
+            return [("gather",)]  # one global instance -> one owner
+        return [("mix", [self._instance_col], 0)]
+
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d = ins[0]
         if d is None or not len(d):
@@ -1144,6 +1232,9 @@ class Capture(Node):
     """Output sink: maintains the consolidated table and the full update
     stream (ConsolidateForOutput, output.rs:27 + capture for debug)."""
 
+    def exchange_specs(self):
+        return [("gather",)]
+
     def __init__(self, inp: Node):
         super().__init__([inp], inp.column_names)
         self.state = RowState(inp.column_names)
@@ -1184,6 +1275,20 @@ class Subscribe(Node):
         # suppress re-emission of already-persisted times on recovery
         # (reference io.subscribe skip_persisted_batch)
         self._skip_until = skip_until
+
+    def exchange_specs(self):
+        # user callbacks fire on one worker only (single-writer sinks give
+        # exactly-once output under spawn -n M)
+        return [("gather",)]
+
+    def on_shard(self, ctx):
+        if ctx.worker_id != 0:
+            # gathered rows only ever reach worker 0; without muting, every
+            # worker's copy would still fire on_end/on_time_end
+            self._on_change = None
+            self._on_time_end = None
+            self._on_end_cb = None
+            self._on_batch = None
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d = ins[0]

@@ -77,9 +77,95 @@ class GraphRunner:
         return captures
 
     def run(self) -> None:
+        from .config import get_pathway_config
+
+        cfg = get_pathway_config()
+        if cfg.total_workers > 1:
+            self._run_sharded(cfg)
+            return
         for sink in G.sinks:
             self.lower_sink(sink)
         self._execute()
+
+    def _run_sharded(self, cfg) -> None:
+        """Multi-worker execution (reference: timely workers over thread /
+        cluster allocators). Every worker builds the same dataflow from the
+        parse graph, owns the ``shard_of(key)`` slice of all stateful
+        operator state, and exchanges records at stateful boundaries
+        (engine/executor.shard_graph). Threads within this process; with
+        PATHWAY_PROCESSES > 1, a TCP full mesh links the processes."""
+        import threading
+
+        from ..engine.executor import Executor
+        from ..parallel.comm import LocalComm, WorkerContext
+
+        if self.persistence is not None:
+            raise NotImplementedError(
+                "persistence + multi-worker is not wired yet; run workers=1"
+            )
+        n_workers = cfg.total_workers
+        if cfg.processes > 1:
+            from ..parallel.cluster import ClusterComm
+
+            comm = ClusterComm(
+                process_id=cfg.process_id,
+                n_processes=cfg.processes,
+                threads_per_process=cfg.threads,
+                first_port=cfg.first_port,
+            )
+            local_worker_ids = [
+                cfg.process_id * cfg.threads + i for i in range(cfg.threads)
+            ]
+        else:
+            comm = LocalComm(n_workers)
+            local_worker_ids = list(range(n_workers))
+
+        executors: list[Executor] = []
+        for w in local_worker_ids:
+            worker_runner = GraphRunner()
+            for sink in G.sinks:
+                worker_runner.lower_sink(sink)
+            executors.append(
+                Executor(
+                    worker_runner._nodes,
+                    ctx=WorkerContext(w, n_workers, comm),
+                )
+            )
+        self.executor = executors[0]
+        self._peer_executors = executors
+        if self.stop_requested:
+            for ex in executors:
+                ex.request_stop()
+
+        errors: list[BaseException] = []
+
+        def work(ex: Executor) -> None:
+            try:
+                ex.run()
+            except BaseException as e:  # propagate cross-worker (panic model)
+                errors.append(e)
+                comm.abort()
+
+        try:
+            if len(executors) == 1:
+                work(executors[0])
+            else:
+                threads = [
+                    threading.Thread(target=work, args=(ex,), daemon=True)
+                    for ex in executors
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+        finally:
+            comm.close()
+        if errors:
+            primary = [
+                e for e in errors
+                if "peer worker failed" not in str(e)
+            ]
+            raise (primary or errors)[0]
 
     def capture(self, table: Table) -> ops.Capture:
         node = self.lower(table)

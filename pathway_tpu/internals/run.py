@@ -49,8 +49,10 @@ def request_stop() -> None:
         runner = _current["runner"]
     if runner is not None:
         runner.stop_requested = True
-        if runner.executor is not None:
-            runner.executor.request_stop()
+        for ex in getattr(runner, "_peer_executors", None) or (
+            [runner.executor] if runner.executor is not None else []
+        ):
+            ex.request_stop()
 
 
 def run_all(**kwargs: Any) -> None:

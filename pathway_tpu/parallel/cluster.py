@@ -1,0 +1,273 @@
+"""TCP cluster communication for multi-process workers.
+
+The ``zero_copy`` allocator analog (``external/timely-dataflow/communication/
+src/allocator/zero_copy/``): processes form a full mesh of sockets
+(process p listens on ``first_port + p``; higher pids dial lower ones),
+worker threads exchange pickled columnar Delta frames. One frame per
+(exchange, remote process) carries all buckets for that process's workers —
+the host serialization path for object columns; dense numeric columns ride
+the same frames as raw numpy buffers (pickle protocol 5).
+
+``pathway spawn -n M -t T program.py`` launches M processes, each hosting T
+worker threads; every process runs the identical dataflow build and owns
+the key shards of its workers (internals/graph_runner._run_sharded).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any
+
+from .comm import Comm
+
+__all__ = ["ClusterComm"]
+
+_LEN = struct.Struct(">Q")
+CONNECT_TIMEOUT_S = 30.0
+COLLECTIVE_TIMEOUT_S = 600.0
+
+
+class ClusterComm(Comm):
+    def __init__(
+        self,
+        process_id: int,
+        n_processes: int,
+        threads_per_process: int,
+        first_port: int,
+        host: str = "127.0.0.1",
+    ):
+        self.process_id = process_id
+        self.n_processes = n_processes
+        self.threads = threads_per_process
+        self.n_workers = n_processes * threads_per_process
+        self._local_workers = set(
+            process_id * threads_per_process + i
+            for i in range(threads_per_process)
+        )
+        self._cond = threading.Condition()
+        #: ("x", channel, tick, dst) -> {src: payload}
+        #: ("g", tag) -> {src: payload}
+        self._inbox: dict[Any, dict[int, Any]] = {}
+        self._gather_reads: dict[Any, int] = {}
+        self._broken: str | None = None
+        self._send_locks: dict[int, threading.Lock] = {}
+        self._socks: dict[int, socket.socket] = {}
+        self._readers: list[threading.Thread] = []
+        self._listener: socket.socket | None = None
+        self._closing = False
+        self._connect_mesh(host, first_port)
+
+    # -- mesh setup ------------------------------------------------------
+
+    def _connect_mesh(self, host: str, first_port: int) -> None:
+        if self.n_processes == 1:
+            return
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, first_port + self.process_id))
+        self._listener.listen(self.n_processes)
+
+        expected_inbound = self.n_processes - 1 - self.process_id
+
+        def accept_loop() -> None:
+            for _ in range(expected_inbound):
+                conn, _addr = self._listener.accept()
+                peer = _LEN.unpack(_recv_exact(conn, 8))[0]
+                self._register_peer(int(peer), conn)
+
+        acceptor = threading.Thread(target=accept_loop, daemon=True)
+        acceptor.start()
+
+        # dial every lower pid (they accept from us)
+        for peer in range(self.process_id):
+            deadline = time.monotonic() + CONNECT_TIMEOUT_S
+            while True:
+                try:
+                    s = socket.create_connection(
+                        (host, first_port + peer), timeout=2.0
+                    )
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"process {self.process_id}: peer {peer} not "
+                            f"reachable on {host}:{first_port + peer}"
+                        )
+                    time.sleep(0.05)
+            s.sendall(_LEN.pack(self.process_id))
+            self._register_peer(peer, s)
+        acceptor.join(CONNECT_TIMEOUT_S)
+        if len(self._socks) != self.n_processes - 1:
+            raise RuntimeError(
+                f"process {self.process_id}: cluster mesh incomplete "
+                f"({len(self._socks)}/{self.n_processes - 1} peers)"
+            )
+
+    def _register_peer(self, peer: int, sock: socket.socket) -> None:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._socks[peer] = sock
+        self._send_locks[peer] = threading.Lock()
+        t = threading.Thread(target=self._read_loop, args=(peer, sock), daemon=True)
+        t.start()
+        self._readers.append(t)
+
+    def _read_loop(self, peer: int, sock: socket.socket) -> None:
+        try:
+            while True:
+                header = _recv_exact(sock, 8)
+                frame = pickle.loads(_recv_exact(sock, _LEN.unpack(header)[0]))
+                if frame[0] == "bye":
+                    # graceful: the peer finished its dataflow (all its
+                    # collectives, incl. the END_TIME sweep, completed) and
+                    # is shutting down — everything it owed us was already
+                    # delivered in order before this frame
+                    return
+                self._deliver(frame)
+        except (OSError, EOFError, pickle.UnpicklingError):
+            if not self._closing:
+                self._break(f"connection to process {peer} lost")
+
+    def _deliver(self, frame: tuple) -> None:
+        kind = frame[0]
+        with self._cond:
+            if kind == "x":
+                _, channel, tick, src, per_dst = frame
+                for dst, payload in per_dst.items():
+                    self._inbox.setdefault(("x", channel, tick, dst), {})[src] = payload
+            else:
+                _, tag, src, obj = frame
+                self._inbox.setdefault(("g", tag), {})[src] = obj
+            self._cond.notify_all()
+
+    def _send(self, peer: int, frame: tuple) -> None:
+        blob = pickle.dumps(frame, protocol=pickle.HIGHEST_PROTOCOL)
+        with self._send_locks[peer]:
+            try:
+                self._socks[peer].sendall(_LEN.pack(len(blob)) + blob)
+            except OSError:
+                if not self._closing:
+                    self._break(f"send to process {peer} failed")
+                raise RuntimeError(self._broken or "cluster send failed")
+
+    def _process_of(self, worker: int) -> int:
+        return worker // self.threads
+
+    # -- collectives -----------------------------------------------------
+
+    def exchange(self, channel, tick, worker_id, buckets):
+        per_process: dict[int, dict[int, Any]] = {}
+        with self._cond:
+            for dst, payload in enumerate(buckets):
+                p = self._process_of(dst)
+                if p == self.process_id:
+                    self._inbox.setdefault(
+                        ("x", channel, tick, dst), {}
+                    )[worker_id] = payload
+                else:
+                    per_process.setdefault(p, {})[dst] = payload
+            self._cond.notify_all()
+        for p, per_dst in per_process.items():
+            self._send(p, ("x", channel, tick, worker_id, per_dst))
+        # remote processes always send a frame (even all-None buckets), so
+        # completion = contributions from every worker id
+        key = ("x", channel, tick, worker_id)
+        payloads = self._wait(key, self.n_workers)
+        with self._cond:
+            self._inbox.pop(key, None)
+        return [
+            payloads[src]
+            for src in range(self.n_workers)
+            if payloads.get(src) is not None
+        ]
+
+    def allgather(self, tag, worker_id, obj):
+        key = ("g", tag)
+        with self._cond:
+            self._inbox.setdefault(key, {})[worker_id] = obj
+            self._cond.notify_all()
+        # one frame per remote process, sent by each local worker for itself
+        for p in range(self.n_processes):
+            if p != self.process_id:
+                self._send(p, ("g", tag, worker_id, obj))
+        payloads = self._wait(key, self.n_workers)
+        out = [payloads[src] for src in range(self.n_workers)]
+        with self._cond:
+            self._gather_reads[key] = self._gather_reads.get(key, 0) + 1
+            if self._gather_reads[key] >= self.threads:
+                self._inbox.pop(key, None)
+                self._gather_reads.pop(key, None)
+        return out
+
+    def barrier(self):
+        self.allgather(("b", next(_barrier_seq)), 0, None)
+
+    def _wait(self, key: Any, n: int) -> dict[int, Any]:
+        deadline = time.monotonic() + COLLECTIVE_TIMEOUT_S
+        with self._cond:
+            while True:
+                if self._broken:
+                    raise RuntimeError(
+                        f"a peer worker failed: {self._broken} (reference "
+                        "cross-worker panic propagation, dataflow.rs:5674)"
+                    )
+                got = self._inbox.get(key)
+                if got is not None and len(got) >= n:
+                    return dict(got)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RuntimeError(
+                        f"cluster collective timed out waiting on {key!r}"
+                    )
+                self._cond.wait(timeout=min(remaining, 1.0))
+
+    def _break(self, reason: str) -> None:
+        with self._cond:
+            if self._broken is None:
+                self._broken = reason
+            self._cond.notify_all()
+
+    def abort(self) -> None:
+        self._break("local worker failed")
+        # peers unblock when their read loops see the closed sockets
+        self._shutdown_sockets()
+
+    def close(self) -> None:
+        self._closing = True
+        for p in list(self._socks):
+            try:
+                self._send(p, ("bye",))
+            except (RuntimeError, OSError, KeyError):
+                pass
+        self._shutdown_sockets()
+
+    def _shutdown_sockets(self) -> None:
+        self._closing = True
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise EOFError("socket closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+import itertools as _it
+
+_barrier_seq = _it.count()

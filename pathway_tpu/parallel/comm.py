@@ -1,0 +1,123 @@
+"""Worker communication backends for the sharded dataflow engine.
+
+The reference exchanges records between timely workers through channel
+allocators — ``thread`` (in-process), ``process`` (shared memory) and
+``zero_copy`` (TCP) under
+``external/timely-dataflow/communication/src/allocator/``. Here the same
+roles are:
+
+- :class:`LocalComm` — N worker threads in one process; exchange is direct
+  in-memory handoff behind a barrier (the ``thread``/``process`` allocator
+  analog; numpy batches make shared memory copies cheap).
+- :class:`ClusterComm` (``parallel/cluster.py``) — full-mesh TCP between
+  processes, pickled columnar frames (the ``zero_copy`` analog).
+- :class:`MeshComm` (``parallel/meshcomm.py``) — dense numeric columns ride
+  a ``bucketed_all_to_all`` XLA collective over a ``jax.sharding.Mesh``
+  (the ICI path); object columns fall back to the host path.
+
+The progress protocol degenerates to bulk-synchronous lock-step: every
+worker sweeps the same node order for the same tick sequence, and every
+exchange is a blocking all-to-all — so when a tick's sweep finishes on all
+workers, that logical time is complete everywhere (the role of timely's
+frontier tracking under a total order).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Sequence
+
+__all__ = ["Comm", "LocalComm", "WorkerContext", "single_worker_context"]
+
+
+class Comm:
+    """Blocking collectives among ``n_workers`` equal participants."""
+
+    n_workers: int
+
+    def exchange(self, channel: int, tick: int, worker_id: int,
+                 buckets: Sequence[Any]) -> list[Any]:
+        """All-to-all: ``buckets[w]`` is this worker's payload destined for
+        worker ``w`` (None = nothing). Returns the payloads every worker
+        destined for *this* worker, in sender order. Blocks until all
+        workers contributed to (channel, tick)."""
+        raise NotImplementedError
+
+    def allgather(self, tag: Any, worker_id: int, obj: Any) -> list[Any]:
+        """Every worker contributes ``obj``; all receive the full list."""
+        raise NotImplementedError
+
+    def barrier(self) -> None:
+        raise NotImplementedError
+
+    def abort(self) -> None:
+        """Unblock peers waiting in a collective after a local failure."""
+
+    def close(self) -> None:
+        pass
+
+
+class LocalComm(Comm):
+    """In-process comm for worker threads (timely ``thread`` allocator)."""
+
+    def __init__(self, n_workers: int):
+        self.n_workers = n_workers
+        self._barrier = threading.Barrier(n_workers)
+        self._lock = threading.Lock()
+        self._slots: dict[Any, list] = {}
+
+    def _rendezvous(self, key: Any, worker_id: int, payload: Any) -> list[Any]:
+        try:
+            with self._lock:
+                slot = self._slots.setdefault(key, [None] * self.n_workers)
+                slot[worker_id] = payload
+            self._barrier.wait()
+            out = self._slots[key]
+            # second barrier before cleanup so no worker reads a reused slot
+            self._barrier.wait()
+        except threading.BrokenBarrierError:
+            raise RuntimeError(
+                "a peer worker failed — aborting this worker's dataflow "
+                "(reference cross-worker panic propagation, dataflow.rs:5674)"
+            ) from None
+        with self._lock:
+            self._slots.pop(key, None)
+        return out
+
+    def abort(self) -> None:
+        """Break all barriers so peers blocked in a collective unwind
+        instead of deadlocking (worker panic propagation)."""
+        self._barrier.abort()
+
+    def exchange(self, channel, tick, worker_id, buckets):
+        all_buckets = self._rendezvous(
+            ("x", channel, tick), worker_id, list(buckets)
+        )
+        return [
+            all_buckets[src][worker_id]
+            for src in range(self.n_workers)
+            if all_buckets[src][worker_id] is not None
+        ]
+
+    def allgather(self, tag, worker_id, obj):
+        return list(self._rendezvous(("g", tag), worker_id, obj))
+
+    def barrier(self):
+        self._barrier.wait()
+
+
+class WorkerContext:
+    """Identity + comm handle handed to each worker's Executor."""
+
+    def __init__(self, worker_id: int, n_workers: int, comm: Comm | None):
+        self.worker_id = worker_id
+        self.n_workers = n_workers
+        self.comm = comm
+
+    @property
+    def is_sharded(self) -> bool:
+        return self.n_workers > 1
+
+
+def single_worker_context() -> WorkerContext:
+    return WorkerContext(0, 1, None)
