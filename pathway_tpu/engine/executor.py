@@ -202,13 +202,17 @@ def shard_graph(nodes: list[Node], ctx: Any) -> list[Node]:
 
     ordered = sorted(nodes, key=lambda n: n.node_id)
     out = list(ordered)
-    for pos, node in enumerate(ordered):
+    # monotone counter, not pos*16+port: nodes with >16 routed inputs
+    # (Iterate gathers one port per pinned input) must not collide
+    next_channel = 0
+    for node in ordered:
         node.on_shard(ctx)
         for port, spec in enumerate(node.exchange_specs()):
             if spec is None:
                 continue
             ex = Exchange(node.inputs[port], spec, ctx)
-            ex.channel = pos * 16 + port
+            ex.channel = next_channel
+            next_channel += 1
             node.inputs[port] = ex
             out.append(ex)
     return out
@@ -375,8 +379,6 @@ class Executor:
                     (len(rounds), finished, self._stop_requested, wall),
                 )
                 cycle += 1
-                if any(p[2] for p in gathered):
-                    break
                 n_rounds = max(p[0] for p in gathered)
                 agreed_wall = max(p[3] for p in gathered)
                 for j in range(n_rounds):
@@ -384,6 +386,11 @@ class Executor:
                     # gathered payload and the shared tick history
                     clock = max(clock + 2, agreed_wall + 2 * j)
                     self._tick(clock, rounds[j] if j < len(rounds) else [])
+                # honour stop only after flushing this cycle's rounds —
+                # breaking first would drop rows already drained from the
+                # connector queues (the single-worker loop always flushes)
+                if any(p[2] for p in gathered):
+                    break
                 if n_rounds == 0:
                     if all(p[1] for p in gathered):
                         break

@@ -278,7 +278,13 @@ class GroupByReduce(Node):
                 for is_count, (_, _, args) in zip(self._is_count, self._reducers)
             ]
             if all(
-                a is None or a.dtype.kind in self._DENSE_DTYPES
+                a is None
+                or (
+                    a.dtype.kind in self._DENSE_DTYPES
+                    # uint64 args don't fit the int64 accumulator exactly
+                    # (astype wraps); the general path sums exact Python ints
+                    and not (a.dtype.kind == "u" and a.dtype.itemsize == 8)
+                )
                 for a in arg_arrays
             ):
                 return self._process_dense(d, n, gcols, gkeys, arg_arrays)
@@ -350,7 +356,11 @@ class GroupByReduce(Node):
                 if stored is None:
                     stored = np.empty(len(self._counts), dtype=col.dtype)
                     self._gvals[ci] = stored
-                elif not np.can_cast(col.dtype, stored.dtype):
+                elif stored.dtype != object and not _lossless_cast(
+                    col.dtype, stored.dtype
+                ):
+                    # can_cast(int64, float64) is "safe" to numpy but rounds
+                    # values > 2^53 — cross-kind mixes go to object instead
                     self._gvals[ci] = stored = stored.astype(object)
                 stored[u_slots[fresh]] = col[first_ix[fresh]]
 
@@ -502,6 +512,21 @@ def _resize(arr: np.ndarray, total: int) -> np.ndarray:
     out = np.zeros(total, dtype=arr.dtype)
     out[: len(arr)] = arr
     return out
+
+
+def _lossless_cast(src: np.dtype, dst: np.dtype) -> bool:
+    """True when every value of ``src`` round-trips exactly through ``dst``
+    — stricter than numpy 'safe' casting, which allows int64→float64."""
+    if src == dst:
+        return True
+    if src.kind == "b":
+        return True
+    if src.kind == dst.kind:
+        return np.can_cast(src, dst)
+    if src.kind in "iu" and dst.kind == "f":
+        # float64 mantissa holds 53 bits: only ≤32-bit ints are exact
+        return src.itemsize <= 4 and dst.itemsize >= 8
+    return False
 
 
 class _SortedSide:

@@ -127,6 +127,10 @@ class SumReducer(ReducerImpl):
 
     def update(self, acc, values, diff, row_key, time):
         (v,) = values
+        if isinstance(v, np.integer):
+            # exact arbitrary-precision sums: np.uint64 * -1 raises under
+            # numpy 2.x and wraps mod 2^64 on overflow — Python ints don't
+            v = int(v)
         contrib = v * diff
         if acc is None:
             return contrib

@@ -49,6 +49,7 @@ class ClusterComm(Comm):
             for i in range(threads_per_process)
         )
         self._cond = threading.Condition()
+        self._barrier_seqs: dict[int, int] = {}
         #: ("x", channel, tick, dst) -> {src: payload}
         #: ("g", tag) -> {src: payload}
         self._inbox: dict[Any, dict[int, Any]] = {}
@@ -202,8 +203,15 @@ class ClusterComm(Comm):
                 self._gather_reads.pop(key, None)
         return out
 
-    def barrier(self):
-        self.allgather(("b", next(_barrier_seq)), 0, None)
+    def barrier(self, worker_id: int):
+        # barrier is a collective: every worker calls it the same number of
+        # times, so a per-worker sequence number is a globally agreed tag
+        # (a process-local counter shared by threads would diverge — the
+        # threads of one process would race for tags; advisor finding r2)
+        with self._cond:
+            seq = self._barrier_seqs.get(worker_id, 0)
+            self._barrier_seqs[worker_id] = seq + 1
+        self.allgather(("b", seq), worker_id, None)
 
     def _wait(self, key: Any, n: int) -> dict[int, Any]:
         deadline = time.monotonic() + COLLECTIVE_TIMEOUT_S
@@ -268,6 +276,3 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-import itertools as _it
-
-_barrier_seq = _it.count()

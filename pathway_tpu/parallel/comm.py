@@ -47,7 +47,7 @@ class Comm:
         """Every worker contributes ``obj``; all receive the full list."""
         raise NotImplementedError
 
-    def barrier(self) -> None:
+    def barrier(self, worker_id: int) -> None:
         raise NotImplementedError
 
     def abort(self) -> None:
@@ -102,7 +102,7 @@ class LocalComm(Comm):
     def allgather(self, tag, worker_id, obj):
         return list(self._rendezvous(("g", tag), worker_id, obj))
 
-    def barrier(self):
+    def barrier(self, worker_id: int):
         self._barrier.wait()
 
 
