@@ -520,7 +520,8 @@ def _lossless_cast(src: np.dtype, dst: np.dtype) -> bool:
     if src == dst:
         return True
     if src.kind == "b":
-        return True
+        # bool→numeric is exact; bool→str would stringify ('True')
+        return dst.kind in "biuf"
     if src.kind == dst.kind:
         return np.can_cast(src, dst)
     if src.kind in "iu" and dst.kind == "f":

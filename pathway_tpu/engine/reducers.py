@@ -131,6 +131,9 @@ class SumReducer(ReducerImpl):
             # exact arbitrary-precision sums: np.uint64 * -1 raises under
             # numpy 2.x and wraps mod 2^64 on overflow — Python ints don't
             v = int(v)
+        elif isinstance(v, np.ndarray) and v.dtype.kind == "u":
+            # same for ArraySum retractions: uint_array * -1 raises
+            v = v.astype(object)
         contrib = v * diff
         if acc is None:
             return contrib
