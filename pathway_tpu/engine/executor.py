@@ -205,16 +205,32 @@ def shard_graph(nodes: list[Node], ctx: Any) -> list[Node]:
     # monotone counter, not pos*16+port: nodes with >16 routed inputs
     # (Iterate gathers one port per pinned input) must not collide
     next_channel = 0
+    # K stateless consumers of one realtime source share ONE Exchange (one
+    # all-to-all per tick, not K identical ones)
+    source_exchanges: dict[int, Node] = {}
     for node in ordered:
         node.on_shard(ctx)
         for port, spec in enumerate(node.exchange_specs()):
+            inp = node.inputs[port]
             if spec is None:
-                continue
-            ex = Exchange(node.inputs[port], spec, ctx)
+                # realtime sources are polled by one owner worker only;
+                # spread their rows to owner shards immediately so all
+                # downstream *stateless* work (expressions, UDFs, filters)
+                # parallelizes too (reference: connector input exchanged to
+                # owner shards right after the reader, SURVEY §3.2 step 5)
+                if not isinstance(inp, RealtimeSource):
+                    continue
+                if inp.node_id in source_exchanges:
+                    node.inputs[port] = source_exchanges[inp.node_id]
+                    continue
+                spec = ("key",)
+            ex = Exchange(inp, spec, ctx)
             ex.channel = next_channel
             next_channel += 1
             node.inputs[port] = ex
             out.append(ex)
+            if isinstance(inp, RealtimeSource) and spec == ("key",):
+                source_exchanges[inp.node_id] = ex
     return out
 
 

@@ -1,0 +1,216 @@
+"""Dense-column record exchange over the device mesh — the ICI path.
+
+SURVEY §7 step 6: "record exchange as bucketed all-to-all over ICI". The
+host Exchange path (``parallel/comm.py`` / ``parallel/cluster.py``) moves
+whole pickled frames between workers; here the dense numeric part of every
+frame — row keys, diffs and every numeric column — is packed to a uint32
+word matrix and routed through ``bucketed_all_to_all``
+(``parallel/exchange.py``: ``jax.lax.all_to_all`` inside ``shard_map`` over
+a 1-D worker mesh), so on TPU the bytes move over the chip interconnect.
+Object/string columns ride the host comm alongside and are re-zipped with
+the dense arrivals by (source worker, emission order) — an ordering both
+paths preserve (the kernel assigns within-bucket slots by running count in
+source order; the host frames keep source row order).
+
+Reference being replaced: the timely ``zero_copy`` allocator
+(``external/timely-dataflow/communication/src/allocator/zero_copy/``) +
+shard-by-key-low-bits routing (``src/engine/value.rs:38,75``).
+
+Packing uses uint32 *pairs* per 8-byte value rather than uint64 because TPU
+jax runs without x64 (``utils/jaxcfg.py``) — uint64 device arrays would be
+silently narrowed there; 2×uint32 words are exact on every platform.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import numpy as np
+
+from .delta import Delta
+
+__all__ = [
+    "local_signature",
+    "agree_kinds",
+    "MeshExchangeRunner",
+    "HOST",
+]
+
+#: sentinel for "this column travels on the host path"
+HOST = "O"
+
+_CANON = {"i": np.int64, "u": np.uint64, "f": np.float64, "b": np.uint64}
+
+
+def local_signature(delta: Delta | None, column_names: list[str]) -> tuple | None:
+    """Per-column dtype kind ('i'/'u'/'f'/'b') or HOST, or None when this
+    worker has no rows this tick (no opinion — a wildcard in agreement)."""
+    if delta is None or not len(delta):
+        return None
+    return tuple(
+        k if (k := delta.data[c].dtype.kind) in _CANON else HOST
+        for c in column_names
+    )
+
+
+def agree_kinds(signatures: list[tuple | None], n_cols: int) -> list[str]:
+    """Meet of all workers' signatures: a column is dense only when every
+    contributing worker agrees on its dtype kind; any mismatch → HOST."""
+    agreed: list[str | None] = [None] * n_cols
+    for sig in signatures:
+        if sig is None:
+            continue
+        for i, k in enumerate(sig):
+            if agreed[i] is None:
+                agreed[i] = k
+            elif agreed[i] != k:
+                agreed[i] = HOST
+    return [a if a is not None else HOST for a in agreed]
+
+
+def _pow2(n: int, floor: int = 8) -> int:
+    cap = floor
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def _pack_words(arr: np.ndarray, kind: str) -> np.ndarray:
+    """One dense column → [n, 2] uint32 words (exact on x64-less TPUs)."""
+    canon = np.ascontiguousarray(arr.astype(_CANON[kind], copy=False))
+    return canon.view(np.uint32).reshape(len(arr), 2)
+
+
+def _unpack_words(words: np.ndarray, kind: str) -> np.ndarray:
+    raw = np.ascontiguousarray(words).view(_CANON[kind]).reshape(-1)
+    if kind == "b":
+        return raw != 0
+    return raw
+
+
+class MeshExchangeRunner:
+    """Packs/unpacks frames and drives the device collective.
+
+    One instance per MeshComm; the jitted kernel is cached per
+    (cap_in, cap_bucket, width) shape class (caps are rounded to powers of
+    two so streaming ticks reuse a handful of compilations).
+    """
+
+    def __init__(self, mesh: Any, axis: str):
+        self.mesh = mesh
+        self.axis = axis
+        self.n = int(mesh.shape[axis])
+        self.devices = list(np.asarray(mesh.devices).reshape(-1))
+        self._kernels: dict[tuple, Any] = {}
+
+    # -- local (per-worker) steps ---------------------------------------
+
+    def pack_local(
+        self,
+        delta: Delta | None,
+        dest: np.ndarray | None,
+        kinds: list[str],
+        column_names: list[str],
+        cap_in: int,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Local rows → padded ([cap_in, width] uint32, [cap_in] int32 dest).
+        Dense layout: keys (2 words) + diffs (2 words) + 2 per dense column."""
+        width = self.width(kinds)
+        vals = np.zeros((cap_in, width), dtype=np.uint32)
+        dst = np.full(cap_in, -1, dtype=np.int32)
+        if delta is not None and len(delta):
+            n = len(delta)
+            parts = [
+                _pack_words(delta.keys, "u"),
+                _pack_words(delta.diffs, "i"),
+            ]
+            for c, k in zip(column_names, kinds):
+                if k != HOST:
+                    parts.append(_pack_words(delta.data[c], k))
+            vals[:n] = np.hstack(parts)
+            dst[:n] = dest
+        return vals, dst
+
+    def width(self, kinds: list[str]) -> int:
+        return 2 * (2 + sum(1 for k in kinds if k != HOST))
+
+    # -- device collective (driver thread only) --------------------------
+
+    def run_collective(
+        self, shards: list[tuple[Any, Any]], cap_in: int, cap_bucket: int, width: int
+    ) -> tuple[Any, Any]:
+        """Assemble the global sharded arrays from per-device blocks and run
+        the bucketed all-to-all. Returns global (vals, valid) jax Arrays."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding_v = NamedSharding(self.mesh, P(self.axis, None))
+        sharding_d = NamedSharding(self.mesh, P(self.axis))
+        gvals = jax.make_array_from_single_device_arrays(
+            (self.n * cap_in, width), sharding_v, [s[0] for s in shards]
+        )
+        gdest = jax.make_array_from_single_device_arrays(
+            (self.n * cap_in,), sharding_d, [s[1] for s in shards]
+        )
+        kernel = self._kernel(cap_in, cap_bucket, width)
+        return kernel(gvals, gdest)
+
+    def _kernel(self, cap_in: int, cap_bucket: int, width: int):
+        key = (cap_in, cap_bucket, width)
+        if key not in self._kernels:
+            import jax
+
+            from ..parallel.exchange import bucketed_all_to_all
+
+            cap_out = self.n * cap_bucket
+
+            @jax.jit
+            def kernel(vals, dest):
+                return bucketed_all_to_all(self.mesh, self.axis, vals, dest, cap_out)
+
+            self._kernels[key] = kernel
+        return self._kernels[key]
+
+    # -- arrival unpacking ------------------------------------------------
+
+    def unpack_arrivals(
+        self,
+        vals: np.ndarray,  # [n * cap_bucket, width] this worker's shard
+        valid: np.ndarray,  # [n * cap_bucket]
+        kinds: list[str],
+        column_names: list[str],
+        host_cols: dict[int, dict[str, np.ndarray]],  # src -> {col: values}
+    ) -> list[Delta]:
+        """Per-source arrival blocks → Deltas, re-zipping host-path columns
+        (same source order on both paths)."""
+        cap_bucket = len(valid) // self.n
+        out: list[Delta] = []
+        for src in range(self.n):
+            block = slice(src * cap_bucket, (src + 1) * cap_bucket)
+            ok = valid[block]
+            n_rows = int(ok.sum())
+            hcols = host_cols.get(src, {})
+            if n_rows == 0 and not hcols:
+                continue
+            rows = vals[block][ok]
+            keys = _unpack_words(rows[:, 0:2], "u")
+            diffs = _unpack_words(rows[:, 2:4], "i")
+            data: dict[str, np.ndarray] = {}
+            w = 4
+            for c, k in zip(column_names, kinds):
+                if k != HOST:
+                    data[c] = _unpack_words(rows[:, w : w + 2], k)
+                    w += 2
+                else:
+                    hv = hcols.get(c)
+                    if hv is None or len(hv) != n_rows:
+                        raise RuntimeError(
+                            f"mesh exchange host/dense row mismatch from "
+                            f"worker {src}: column {c!r} has "
+                            f"{0 if hv is None else len(hv)} host rows vs "
+                            f"{n_rows} dense arrivals"
+                        )
+                    data[c] = hv
+            out.append(Delta(keys=keys, data=data, diffs=diffs))
+        return out

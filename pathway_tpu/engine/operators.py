@@ -182,9 +182,16 @@ class Exchange(Node):
                     ix = np.flatnonzero(shards == w)
                     if len(ix):
                         buckets[w] = d.take(ix)
-        received = ctx.comm.exchange(
-            self.channel, time, ctx.worker_id, buckets
-        )
+        if hasattr(ctx.comm, "exchange_deltas"):
+            # ICI path (MeshComm): dense columns ride the device mesh via
+            # bucketed_all_to_all; object columns fall back to host frames
+            received = ctx.comm.exchange_deltas(
+                self.channel, time, ctx.worker_id, buckets, self.column_names
+            )
+        else:
+            received = ctx.comm.exchange(
+                self.channel, time, ctx.worker_id, buckets
+            )
         received = [r for r in received if r is not None and len(r)]
         if not received:
             return None

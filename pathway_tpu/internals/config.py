@@ -52,6 +52,10 @@ class PathwayConfig:
     continue_after_replay: bool = field(
         default_factory=lambda: _env_bool("PATHWAY_CONTINUE_AFTER_REPLAY"))
     # worker layout (config.rs PATHWAY_THREADS/PROCESSES/PROCESS_ID/FIRST_PORT)
+    #: route dense Exchange columns over the jax device mesh (ICI) instead
+    #: of host memory — parallel/meshcomm.py; needs ≥ total_workers devices
+    mesh_exchange: bool = field(
+        default_factory=lambda: _env_bool("PATHWAY_MESH_EXCHANGE"))
     threads: int = field(default_factory=lambda: _env_int("PATHWAY_THREADS", 1))
     processes: int = field(default_factory=lambda: _env_int("PATHWAY_PROCESSES", 1))
     process_id: int = field(default_factory=lambda: _env_int("PATHWAY_PROCESS_ID", 0))

@@ -142,29 +142,46 @@ def compile_expr(expr: ColumnExpression, env: ColumnEnv) -> Compiled:
 def _compile_expr_uncached(expr: ColumnExpression, env: ColumnEnv) -> Compiled:
     np_fn, dtype, jax_ok, refs = _build(expr, env)
     if jax_ok and _jax_available():
-        jitted = _make_jitted(expr, env)
+        jitted_box: list = []
         ref_cols = [c for c in refs if c is not None]
 
         hot = [0]  # large batches seen; compile only once it pays off
 
         def fn(cols: dict[str, np.ndarray], keys: np.ndarray) -> np.ndarray:
-            import jax
-
             n = len(keys)
             if (
                 n >= JIT_THRESHOLD
-                and jax.config.jax_enable_x64
                 and all(cols[c].dtype != object for c in ref_cols)
             ):
-                # x64 gate: without it the traced kernel silently truncates
-                # INT/FLOAT columns to 32 bits — wrong values, and 32-bit
-                # outputs knock every downstream key hash off the fast path.
                 # warm-up gate: XLA compilation (~100ms) only pays for
                 # expressions that keep seeing large batches (long-running
                 # streams); short batch jobs stay on the numpy kernels.
+                # jax itself imports only past the gate: without bytecode
+                # caches (PYTHONDONTWRITEBYTECODE) the import costs ~2.5s
+                # per process, which must not land on spawned host workers
+                # that never reach the jit path.
                 hot[0] += 1
                 if hot[0] <= JIT_WARMUP_BATCHES:
                     return np_fn(cols, keys)
+                try:
+                    import jax
+
+                    from ..utils import jaxcfg  # noqa: F401  (configures x64)
+                except Exception:
+                    # present-but-broken jax (e.g. jaxlib mismatch): degrade
+                    # to the numpy kernels forever, as the old import-time
+                    # probe did — never crash a running stream
+                    _jax_checked[:] = [False]
+                    return np_fn(cols, keys)
+
+                # x64 gate: without it the traced kernel silently truncates
+                # INT/FLOAT columns to 32 bits — wrong values, and 32-bit
+                # outputs knock every downstream key hash off the fast path.
+                if not jax.config.jax_enable_x64:
+                    return np_fn(cols, keys)
+                if not jitted_box:
+                    jitted_box.append(_make_jitted(expr, env))
+                jitted = jitted_box[0]
                 # pin to the host CPU backend: streaming tick batches are
                 # latency-bound host work; shipping them to an accelerator
                 # (worse, a tunneled one) per tick costs more than the fused
@@ -201,11 +218,14 @@ _jax_checked: list[bool] = []
 
 
 def _jax_available() -> bool:
+    # spec lookup only — importing jax (via utils.jaxcfg) here would charge
+    # every worker process ~2.5s at expression-compile time even when the
+    # jit path is never taken
     if not _jax_checked:
-        try:
-            from ..utils import jaxcfg  # noqa: F401
+        import importlib.util
 
-            _jax_checked.append(True)
+        try:
+            _jax_checked.append(importlib.util.find_spec("jax") is not None)
         except Exception:
             _jax_checked.append(False)
     return _jax_checked[0]

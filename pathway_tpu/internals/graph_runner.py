@@ -119,6 +119,16 @@ class GraphRunner:
         else:
             comm = LocalComm(n_workers)
             local_worker_ids = list(range(n_workers))
+        if cfg.mesh_exchange:
+            if cfg.processes > 1:
+                raise NotImplementedError(
+                    "PATHWAY_MESH_EXCHANGE with multiple processes needs the "
+                    "jax.distributed multi-host mesh (parallel/distributed.py)"
+                    " — run single-process (threads only) for now"
+                )
+            from ..parallel.meshcomm import MeshComm
+
+            comm = MeshComm(comm)
 
         executors: list[Executor] = []
         for w in local_worker_ids:

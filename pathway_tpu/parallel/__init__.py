@@ -4,11 +4,13 @@ Re-designs the reference's worker/exchange model (timely workers + hash
 sharding, SURVEY §2.9) onto ``jax.sharding``: a Mesh replaces the worker
 pool; record exchange by key becomes a bucketed all-to-all over ICI; dense
 model/index state shards with NamedSharding annotations.
+
+Submodule attributes resolve lazily: the host comm path (``comm.py``,
+``cluster.py``) must be importable without pulling jax — eager jax import
+added ~3s of startup to every spawned worker process.
 """
 
-from .distributed import global_mesh, init_from_env
-from .exchange import bucketed_all_to_all, shard_rows
-from .mesh import data_model_mesh, make_mesh
+from typing import Any
 
 __all__ = [
     "make_mesh",
@@ -18,3 +20,21 @@ __all__ = [
     "init_from_env",
     "global_mesh",
 ]
+
+_LAZY = {
+    "make_mesh": "mesh",
+    "data_model_mesh": "mesh",
+    "shard_rows": "exchange",
+    "bucketed_all_to_all": "exchange",
+    "init_from_env": "distributed",
+    "global_mesh": "distributed",
+}
+
+
+def __getattr__(name: str) -> Any:
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

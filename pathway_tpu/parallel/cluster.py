@@ -109,6 +109,10 @@ class ClusterComm(Comm):
             )
 
     def _register_peer(self, peer: int, sock: socket.socket) -> None:
+        # dialed sockets inherit create_connection's 2s timeout; the mesh
+        # must tolerate arbitrarily long quiet periods (idle sources, slow
+        # peers) — make every registered socket blocking
+        sock.settimeout(None)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._socks[peer] = sock
         self._send_locks[peer] = threading.Lock()

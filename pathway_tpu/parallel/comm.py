@@ -11,9 +11,11 @@ roles are:
   analog; numpy batches make shared memory copies cheap).
 - :class:`ClusterComm` (``parallel/cluster.py``) — full-mesh TCP between
   processes, pickled columnar frames (the ``zero_copy`` analog).
-- :class:`MeshComm` (``parallel/meshcomm.py``) — dense numeric columns ride
-  a ``bucketed_all_to_all`` XLA collective over a ``jax.sharding.Mesh``
-  (the ICI path); object columns fall back to the host path.
+- :class:`MeshComm` (``parallel/meshcomm.py``) — wraps LocalComm; dense
+  numeric columns of Exchange frames ride a ``bucketed_all_to_all`` XLA
+  collective over a ``jax.sharding.Mesh`` (the ICI path,
+  ``engine/mesh_exchange.py``); object columns fall back to the host path.
+  Enabled by ``PATHWAY_MESH_EXCHANGE=1``.
 
 The progress protocol degenerates to bulk-synchronous lock-step: every
 worker sweeps the same node order for the same tick sequence, and every

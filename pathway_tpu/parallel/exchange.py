@@ -66,8 +66,9 @@ def bucketed_all_to_all(
         buckets = jnp.zeros((n_shards, cap_bucket, d), vals.dtype)
         valid = jnp.zeros((n_shards, cap_bucket), jnp.bool_)
         # scatter-add so masked-out rows (adding 0) can never clobber a slot
+        # (zero must keep vals' dtype: 0.0 would promote uint32 payloads)
         buckets = buckets.at[safe_dest, safe_pos].add(
-            jnp.where(ok[:, None], vals, 0.0)
+            jnp.where(ok[:, None], vals, jnp.zeros((), vals.dtype))
         )
         valid = valid.at[safe_dest, safe_pos].max(ok)
         # swap bucket b to device b over the ICI

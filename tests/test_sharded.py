@@ -197,6 +197,35 @@ def test_sharded_thread_parity(name, threads, monkeypatch):
     )
 
 
+@pytest.mark.parametrize("threads", [2, 8])
+@pytest.mark.parametrize(
+    "name",
+    [
+        "groupby_dense",      # all-dense frames: keys/diffs/values over mesh
+        "groupby_multiset",   # string group col -> host path re-zip
+        "join_inner",         # ("column",) routes, string payloads
+        "concat_update_rows", # ("key",) routes, dense int payloads
+        "iterate",            # ("gather",) route
+        "streaming_counts",   # realtime source auto-exchange under the
+                              # allgather-driven streaming loop (bench path)
+    ],
+)
+def test_mesh_exchange_parity(name, threads, monkeypatch):
+    """Same programs with the ICI path on: dense columns ride
+    bucketed_all_to_all over the 8-virtual-device CPU mesh (conftest),
+    object columns re-zip from the host path."""
+    expected = _baseline(name, monkeypatch)
+    monkeypatch.setenv("PATHWAY_MESH_EXCHANGE", "1")
+    try:
+        got = _collect(PROGRAMS[name], monkeypatch, threads=threads)
+    finally:
+        monkeypatch.delenv("PATHWAY_MESH_EXCHANGE", raising=False)
+    assert got == expected, (
+        f"{name} with mesh exchange at -t {threads} diverged:\n"
+        f"  missing={expected - got}\n  extra={got - expected}"
+    )
+
+
 def test_sharded_results_nonempty(monkeypatch):
     # guard against the suite passing vacuously (empty == empty)
     for name in PROGRAMS:
