@@ -73,10 +73,33 @@ class Node:
     #: must join every collective; sharded peers may be sending rows)
     always_run = False
 
+    #: instance attributes that together form this operator's durable state
+    #: (reference: the arrangement each operator persists via
+    #: ``src/engine/dataflow/persist.rs``). Empty = stateless — nothing to
+    #: snapshot. Fields listed but absent on an instance are skipped, so one
+    #: class can name mode-dependent fields.
+    STATE_FIELDS: tuple[str, ...] = ()
+
     def __init__(self, inputs: list["Node"], column_names: list[str]):
         self.node_id = next(Node._ids)
         self.inputs = list(inputs)
         self.column_names = list(column_names)
+
+    def has_state(self) -> bool:
+        return bool(self.STATE_FIELDS)
+
+    def snapshot_state(self) -> dict:
+        """Picklable snapshot of the operator's durable state. Called at a
+        consistency point (after a tick sweep, before the next); the result
+        plus replay of later input must reproduce the operator exactly
+        (reference operator_snapshot.rs:18-293)."""
+        return {
+            f: getattr(self, f) for f in self.STATE_FIELDS if hasattr(self, f)
+        }
+
+    def restore_state(self, state: dict) -> None:
+        for f, v in state.items():
+            setattr(self, f, v)
 
     def exchange_specs(self) -> list[tuple | None]:
         """Routing requirement per input port for sharded execution: None
@@ -252,11 +275,6 @@ class Executor:
             ctx = single_worker_context()
         self.ctx = ctx
         if ctx.is_sharded:
-            if persistence is not None:
-                raise NotImplementedError(
-                    "persistence with multi-worker execution is not wired "
-                    "yet — run with one worker or without persistence"
-                )
             nodes = shard_graph(nodes, ctx)
         self.nodes = _topological(nodes)
         self._consumers: dict[int, list[tuple[Node, int]]] = {}
@@ -269,6 +287,14 @@ class Executor:
         self._last_clock = 0
         self._defer_commit = False
         self.stats = EngineStats()
+        if persistence is not None:
+            # sharded mode: commits are a coordinated collective decided in
+            # _stream_loop_sharded, never a per-worker wall-clock whim — all
+            # workers must snapshot operator state at the SAME tick, or
+            # replaying one worker's input tail would re-exchange rows into
+            # peers whose state already includes them
+            persistence.auto_commit = not ctx.is_sharded
+            persistence.attach_nodes(self.nodes)
 
     def request_stop(self) -> None:
         self._stop_requested = True
@@ -390,9 +416,14 @@ class Executor:
                         rounds[j].append((src, delta))
                 finished = all(src.is_finished() for src in owned)
                 wall = int(_time.time() * 1000) & ~1
+                want_commit = (
+                    self.persistence is not None
+                    and self.persistence.should_commit()
+                )
                 gathered = ctx.comm.allgather(
                     ("cycle", cycle), ctx.worker_id,
-                    (len(rounds), finished, self._stop_requested, wall),
+                    (len(rounds), finished, self._stop_requested, wall,
+                     want_commit),
                 )
                 cycle += 1
                 n_rounds = max(p[0] for p in gathered)
@@ -402,6 +433,11 @@ class Executor:
                     # gathered payload and the shared tick history
                     clock = max(clock + 2, agreed_wall + 2 * j)
                     self._tick(clock, rounds[j] if j < len(rounds) else [])
+                # coordinated checkpoint: every worker snapshots operator
+                # state at the SAME agreed tick (reference: workers agree on
+                # the last complete snapshot, worker-architecture doc :57-61)
+                if self.persistence is not None and any(p[4] for p in gathered):
+                    self.persistence.commit(clock)
                 # honour stop only after flushing this cycle's rounds —
                 # breaking first would drop rows already drained from the
                 # connector queues (the single-worker loop always flushes)
@@ -416,10 +452,11 @@ class Executor:
                 src.stop()
 
     def _recover(self, realtime: list[RealtimeSource]) -> int:
-        """Replay the input snapshot through the dataflow (rebuilding all
-        operator state deterministically), seek sources past persisted
-        offsets, then start recording live input. Returns the last replayed
-        time (the clock floor)."""
+        """Restore operator state from the newest usable snapshot, replay
+        only the input tail recorded after it (restart cost O(state) +
+        O(tail), not O(history) — reference operator_snapshot.rs), seek
+        sources past persisted offsets, then start recording live input.
+        Returns the clock floor."""
         unnamed_schemas: dict[tuple, int] = {}
         for src in realtime:
             if src.persistent_id is None:
@@ -441,11 +478,28 @@ class Executor:
             if src.persistent_id is None:
                 src.persistent_id = f"src-{i}"
         by_pid = {src.persistent_id: src for src in realtime}
-        clock = 0
-        # group persisted entries by time (commit order is time-ordered)
-        current_t: int | None = None
-        emissions: list[tuple[SourceNode, Delta]] = []
-        for t, pid, delta in self.persistence.replay_batches():
+
+        # pick the newest operator snapshot present on EVERY worker — a crash
+        # mid-commit-wave may have left some workers one version ahead; the
+        # manager retains two versions so a common one always exists
+        local_times = self.persistence.available_op_times()
+        if self.ctx.is_sharded:
+            gathered = self.ctx.comm.allgather(
+                ("recover-op",), self.ctx.worker_id, tuple(local_times)
+            )
+            common = set(gathered[0])
+            for avail in gathered[1:]:
+                common &= set(avail)
+            op_time = max(common) if common else -1
+        else:
+            op_time = max(local_times) if local_times else -1
+        if op_time >= 0:
+            self.persistence.restore_operators(self.nodes, op_time)
+        clock = max(0, op_time)
+
+        # replay the recorded input tail (times after the operator snapshot)
+        by_time: dict[int, list[tuple[SourceNode, Delta]]] = {}
+        for t, pid, delta in self.persistence.replay_batches(after_time=op_time):
             src = by_pid.get(pid)
             if src is None:
                 raise RuntimeError(
@@ -461,16 +515,20 @@ class Executor:
                     f"{list(src.column_names)} — refusing to replay "
                     "mismatched state (did unnamed sources get reordered?)"
                 )
-            if current_t is not None and t != current_t and emissions:
-                self._tick(current_t, emissions)
-                clock = max(clock, current_t)
-                emissions = []
-            current_t = t
-            emissions.append((src, delta))
+            by_time.setdefault(int(t), []).append((src, delta))
             src.observe_replay(delta)
-        if emissions and current_t is not None:
-            self._tick(current_t, emissions)
-            clock = max(clock, current_t)
+        # sharded replay runs in lock-step over the union of all workers'
+        # recorded times (Exchange nodes join a collective every tick)
+        times = sorted(by_time)
+        if self.ctx.is_sharded:
+            gathered = self.ctx.comm.allgather(
+                ("recover-times",), self.ctx.worker_id, tuple(times)
+            )
+            times = sorted({t for tup in gathered for t in tup})
+        for t in times:
+            self._tick(t, by_time.get(t, []))
+            clock = max(clock, t)
+        clock = max(clock, self.persistence.last_time)
         for src in realtime:
             state = self.persistence.offset_for(src.persistent_id)
             if state is not None:
@@ -512,6 +570,10 @@ class Executor:
                     out = node.process(time, ins)
                     if out is not None and len(out):
                         out_parts.append(out)
+            if self.persistence is not None and node.has_state() and (
+                ports or node.node_id in seeded or out_parts
+            ):
+                self.persistence.mark_dirty(node)
             if out_parts:
                 emitted = concat_deltas(out_parts, out_parts[0].columns)
                 self.stats.note_node(

@@ -59,6 +59,18 @@ class ExternalIndexNode(Node):
         # asof-now mode still must retract answers when the *query* retracts
         self._answered: dict[int, tuple] = {}
 
+    # the engine (host arenas; device caches are dropped by the engines'
+    # __getstate__) snapshots alongside the standing queries
+    STATE_FIELDS = ("engine", "_queries", "_answered")
+
+    def restore_state(self, state: dict) -> None:
+        fresh = self.engine
+        super().restore_state(state)
+        # non-picklable config (embedder closures) carries over from the
+        # freshly-built engine — see BruteForceKnnEngine.__getstate__
+        if getattr(self.engine, "embedder", None) is None:
+            self.engine.embedder = getattr(fresh, "embedder", None)
+
     def exchange_specs(self):
         # the index lives on worker 0 (sharded index variants live at the
         # ops layer: ops/knn.py sharded_topk with all-gather merge)

@@ -71,6 +71,10 @@ class Iterate(Node):
         self.pending: dict[str, Delta] = {}
         self.out_specs = out_specs
 
+    # pending is transient (drained by IterateOutput within the same tick);
+    # only the input mirror and last-emitted outputs are durable
+    STATE_FIELDS = ("_in_state", "_out_last")
+
     def exchange_specs(self):
         # the inner fixpoint is a single-worker composite: gather inputs to
         # worker 0 (downstream stateful ops re-shard its outputs)

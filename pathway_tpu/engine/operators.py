@@ -269,6 +269,47 @@ class GroupByReduce(Node):
             return [("column", self._key_from_column)]
         return [("mix", self._group_cols, self._key_salt)]
 
+    # -- operator snapshots (persist.rs analog) ---------------------------
+
+    def has_state(self) -> bool:
+        return True
+
+    def snapshot_state(self) -> dict:
+        st: dict = {"_state": self._state, "dense": self._dense}
+        if self._dense:
+            # trim arenas to allocated slots; the SlotMap is reconstructed
+            # from _gkey_by_slot on restore (SlotMap.rebuild)
+            n = len(self._slots)
+            st["arena"] = {
+                "_counts": self._counts[:n].copy(),
+                "_gkey_by_slot": self._gkey_by_slot[:n].copy(),
+                "_emitted": self._emitted[:n].copy(),
+                "_accs": [None if a is None else a[:n].copy() for a in self._accs],
+                "_prev": [p[:n].copy() for p in self._prev],
+                "_gvals": [None if g is None else g[:n].copy() for g in self._gvals],
+            }
+        return st
+
+    def restore_state(self, state: dict) -> None:
+        from .slotmap import SlotMap
+
+        self._state = state["_state"]
+        if not state["dense"]:
+            if self._dense:
+                # snapshot was taken after a demotion — mirror it
+                self._dense = False
+                del self._slots, self._counts, self._gkey_by_slot
+                del self._gvals, self._accs, self._emitted, self._prev
+            return
+        a = state["arena"]
+        self._counts = a["_counts"]
+        self._gkey_by_slot = a["_gkey_by_slot"]
+        self._emitted = a["_emitted"]
+        self._accs = a["_accs"]
+        self._prev = a["_prev"]
+        self._gvals = a["_gvals"]
+        self._slots = SlotMap.rebuild(self._gkey_by_slot)
+
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d = ins[0]
         if d is None or not len(d):
@@ -667,6 +708,8 @@ class Join(Node):
         self._lpad: dict[int, int] = {}
         self._rpad: dict[int, int] = {}
 
+    STATE_FIELDS = ("_cleft", "_cright", "_left", "_right", "_lpad", "_rpad")
+
     def exchange_specs(self):
         # both sides route by join key -> matching rows co-locate
         # (ShardPolicy::LastKeyColumn analog)
@@ -859,6 +902,8 @@ class GroupedRecompute(Node):
         ]  # per input: group_key -> {row_key: [[row, count], ...]}
         self._prev_out: dict[int, dict[int, tuple]] = {}
 
+    STATE_FIELDS = ("_state", "_prev_out")
+
     def exchange_specs(self):
         return [
             ("gather",) if col is None else ("column", col)
@@ -953,6 +998,8 @@ class GroupedRecompute(Node):
 class UpdateRows(Node):
     """update_rows (table.py:1524): other's rows override self's by key."""
 
+    STATE_FIELDS = ("_self_state", "_other_state")
+
     def __init__(self, left: Node, right: Node):
         super().__init__([left, right], left.column_names)
         self._self_state = RowState(left.column_names)
@@ -988,6 +1035,8 @@ class UpdateRows(Node):
 class UpdateCells(Node):
     """update_cells (table.py:1439): override a subset of columns for keys
     present in `other`; both tables share the key universe."""
+
+    STATE_FIELDS = ("_self_state", "_other_state")
 
     def __init__(self, left: Node, right: Node, override_cols: list[str]):
         super().__init__([left, right], left.column_names)
@@ -1095,6 +1144,8 @@ class BufferUntil(Node):
     insert+retract pairs cancel before ever being emitted — the mechanism
     behind exactly-once window outputs."""
 
+    STATE_FIELDS = ("_buffer", "_watermark")
+
     def __init__(self, inp: Node, threshold_col: str):
         super().__init__([inp], inp.column_names)
         self._col = threshold_col
@@ -1142,6 +1193,8 @@ class ForgetAfter(Node):
     passed; if ``forget_state``, also retract previously-passed rows once the
     watermark crosses their threshold (bounding downstream state — the
     keep_results=False behavior)."""
+
+    STATE_FIELDS = ("_live", "_watermark")
 
     def __init__(self, inp: Node, threshold_col: str, forget_state: bool = False):
         super().__init__([inp], inp.column_names)
@@ -1192,6 +1245,8 @@ class Deduplicate(Node):
     order across ticks); retractions of non-accepted rows are ignored, and
     retracting the accepted row retracts the output (reference keeps accepted
     state the same way)."""
+
+    STATE_FIELDS = ("_state",)
 
     def __init__(self, inp: Node, value_col: str, instance_col: str | None, acceptor):
         super().__init__([inp], inp.column_names)
@@ -1264,6 +1319,11 @@ class Deduplicate(Node):
 class Capture(Node):
     """Output sink: maintains the consolidated table and the full update
     stream (ConsolidateForOutput, output.rs:27 + capture for debug)."""
+
+    # only the consolidated table is durable: `stream` is the unbounded
+    # debug update log — snapshotting it would make every checkpoint
+    # O(history), exactly what operator snapshots exist to avoid
+    STATE_FIELDS = ("state",)
 
     def exchange_specs(self):
         return [("gather",)]

@@ -146,11 +146,15 @@ def _compile_expr_uncached(expr: ColumnExpression, env: ColumnEnv) -> Compiled:
         ref_cols = [c for c in refs if c is not None]
 
         hot = [0]  # large batches seen; compile only once it pays off
+        jax_broken = [False]  # this fn's own short-circuit: a failed import
+        # must not be retried per batch (each retry re-runs the whole
+        # multi-second failing import inside the hot loop)
 
         def fn(cols: dict[str, np.ndarray], keys: np.ndarray) -> np.ndarray:
             n = len(keys)
             if (
-                n >= JIT_THRESHOLD
+                not jax_broken[0]
+                and n >= JIT_THRESHOLD
                 and all(cols[c].dtype != object for c in ref_cols)
             ):
                 # warm-up gate: XLA compilation (~100ms) only pays for
@@ -172,6 +176,7 @@ def _compile_expr_uncached(expr: ColumnExpression, env: ColumnEnv) -> Compiled:
                     # to the numpy kernels forever, as the old import-time
                     # probe did — never crash a running stream
                     _jax_checked[:] = [False]
+                    jax_broken[0] = True
                     return np_fn(cols, keys)
 
                 # x64 gate: without it the traced kernel silently truncates

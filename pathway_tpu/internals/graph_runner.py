@@ -99,10 +99,6 @@ class GraphRunner:
         from ..engine.executor import Executor
         from ..parallel.comm import LocalComm, WorkerContext
 
-        if self.persistence is not None:
-            raise NotImplementedError(
-                "persistence + multi-worker is not wired yet; run workers=1"
-            )
         n_workers = cfg.total_workers
         if cfg.processes > 1:
             from ..parallel.cluster import ClusterComm
@@ -130,15 +126,26 @@ class GraphRunner:
 
             comm = MeshComm(comm)
 
+        pcfg = getattr(self, "persistence_config", None)
+        managers: list[Any] = []
         executors: list[Executor] = []
         for w in local_worker_ids:
             worker_runner = GraphRunner()
+            if pcfg is not None:
+                from ..persistence import PersistenceManager
+
+                manager = PersistenceManager(
+                    pcfg, worker_id=w, n_workers=n_workers
+                )
+                worker_runner.persistence = manager
+                managers.append(manager)
             for sink in G.sinks:
                 worker_runner.lower_sink(sink)
             executors.append(
                 Executor(
                     worker_runner._nodes,
                     ctx=WorkerContext(w, n_workers, comm),
+                    persistence=worker_runner.persistence,
                 )
             )
         self.executor = executors[0]
@@ -170,6 +177,8 @@ class GraphRunner:
                     t.join()
         finally:
             comm.close()
+            for manager in managers:
+                manager.close()
         if errors:
             primary = [
                 e for e in errors

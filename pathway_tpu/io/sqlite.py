@@ -39,6 +39,12 @@ class SqliteStreamSource(RealtimeSource):
     """Polls the db; on any change, diffs the full snapshot against the
     last one by primary key and emits the delta."""
 
+    # the last-seen snapshot is connector state: operator snapshots restore
+    # it directly (the input history that used to rebuild it via
+    # observe_replay is truncated once a snapshot covers it) — the
+    # CachedObjectStorage role, cached_object_storage.rs:37
+    STATE_FIELDS = ("_last",)
+
     def __init__(
         self,
         path: str,

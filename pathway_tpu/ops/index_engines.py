@@ -95,6 +95,18 @@ class BruteForceKnnEngine:
         self._device = None  # lazily synced jax copy
         self._dirty = True
 
+    # operator snapshots pickle the whole engine; the device mirror is a
+    # cache rebuilt on first search after restore
+    def __getstate__(self):
+        st = dict(self.__dict__)
+        st["_device"] = None
+        st.pop("_device_valid", None)
+        st["_dirty"] = True
+        # the embedder may be an arbitrary closure (not picklable); the
+        # restoring node grafts the freshly-constructed engine's embedder back
+        st["embedder"] = None
+        return st
+
     # -- mutation ----------------------------------------------------------
     def _vec(self, data: Any) -> np.ndarray:
         if isinstance(data, str):

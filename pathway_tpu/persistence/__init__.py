@@ -65,8 +65,16 @@ class Config:
 
 
 def run_with_persistence(runner: Any, config: Config) -> None:
-    """Attach a PersistenceManager to the GraphRunner and run (called from
-    pw.run when persistence_config is given)."""
+    """Attach persistence to the GraphRunner and run (called from pw.run
+    when persistence_config is given). Sharded runs build one per-worker
+    PersistenceManager inside ``GraphRunner._run_sharded`` (reference:
+    per-worker WorkerPersistentStorage, tracker.rs:47)."""
+    from ..internals.config import get_pathway_config
+
+    runner.persistence_config = config
+    if get_pathway_config().total_workers > 1:
+        runner.run()
+        return
     manager = PersistenceManager(config)
     runner.persistence = manager
     try:

@@ -18,6 +18,7 @@ __all__ = [
     "MemoryBackend",
     "FilesystemBackend",
     "S3Backend",
+    "PrefixBackend",
     "open_backend",
 ]
 
@@ -117,6 +118,32 @@ class FilesystemBackend(PersistenceBackend):
             os.remove(self._path(key))
         except FileNotFoundError:
             pass
+
+
+class PrefixBackend(PersistenceBackend):
+    """View of another backend under a key prefix. Sharded runs give every
+    worker its own ``worker-{id}/`` namespace in one shared store (the
+    reference's per-worker WorkerPersistentStorage, tracker.rs:47)."""
+
+    def __init__(self, inner: PersistenceBackend, prefix: str):
+        self._inner = inner
+        self._prefix = prefix
+
+    def get_value(self, key: str) -> bytes:
+        return self._inner.get_value(self._prefix + key)
+
+    def put_value(self, key: str, value: bytes) -> None:
+        self._inner.put_value(self._prefix + key, value)
+
+    def list_keys(self) -> list[str]:
+        p = self._prefix
+        return [k[len(p):] for k in self._inner.list_keys() if k.startswith(p)]
+
+    def remove_key(self, key: str) -> None:
+        self._inner.remove_key(self._prefix + key)
+
+    def close(self) -> None:
+        self._inner.close()
 
 
 class S3Backend(PersistenceBackend):

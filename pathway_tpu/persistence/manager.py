@@ -2,52 +2,155 @@
 
 Re-design of the reference's per-worker persistent storage tracker
 (``src/persistence/tracker.rs:47``) + the connector replay protocol
-(``src/connectors/mod.rs:108-152`` PersistenceMode / SnapshotAccess):
+(``src/connectors/mod.rs:108-152`` PersistenceMode / SnapshotAccess) + the
+operator snapshot machinery (``src/persistence/operator_snapshot.rs``):
 
 1. During a run, every committed source batch is recorded to the input
-   snapshot (``record``), and on a snapshot interval the chunk is flushed
-   and metadata (last finalized time + per-source offsets) committed.
-2. On restart, ``replay_batches`` returns the persisted input stream; the
-   executor pushes it through the (deterministic) dataflow to rebuild all
-   operator state, sinks suppress re-emission for times ≤ ``last_time``
-   (``skip_persisted_batch``, reference io.subscribe), and each source is
-   ``seek``-ed past its persisted offset so only new data flows afterwards.
+   snapshot (``record``); on the snapshot interval the chunk is flushed,
+   every *dirty* stateful operator's state is written as a chunked blob,
+   and metadata (last finalized time + per-source offsets + the operator
+   snapshot catalog) is committed. Input chunks wholly covered by the
+   oldest retained operator snapshot are deleted — restart cost stays
+   O(operator state) + O(input tail), never O(history).
+2. On restart, the executor restores operator state from the newest
+   snapshot available on every worker (two versions are retained so a
+   crash mid-commit-wave in a sharded run still leaves a common one),
+   replays only the recorded input tail after it, seeks each source past
+   its persisted offset, and resumes recording.
+
+Sharded runs give each worker its own ``worker-{id}/`` namespace in the
+shared backend (``PrefixBackend``); a root-level ``cluster`` marker pins
+the worker count — resharding against existing state is refused.
 """
 
 from __future__ import annotations
 
+import json
 import time as _time
 from typing import Any
 
 from ..engine.delta import Delta
-from .backends import PersistenceBackend, open_backend
-from .snapshots import MetadataAccessor, SnapshotReader, SnapshotWriter
+from .backends import PersistenceBackend, PrefixBackend, open_backend
+from .snapshots import (
+    MetadataAccessor,
+    OperatorSnapshots,
+    SnapshotReader,
+    SnapshotWriter,
+)
 
 __all__ = ["PersistenceManager"]
 
+#: operator snapshot versions retained (reference keeps enough history for
+#: all workers to agree on a complete snapshot, worker-architecture doc)
+KEEP_OP_VERSIONS = 2
+
 
 class PersistenceManager:
-    def __init__(self, config: Any):
+    def __init__(self, config: Any, worker_id: int = 0, n_workers: int = 1):
         self.config = config
-        self.backend: PersistenceBackend = open_backend(config.backend)
+        self.worker_id = worker_id
+        self.n_workers = n_workers
+        root: PersistenceBackend = open_backend(config.backend)
+        self._root = root
+        self._check_cluster_marker(root, n_workers)
+        self.backend: PersistenceBackend = (
+            PrefixBackend(root, f"worker-{worker_id}/") if n_workers > 1 else root
+        )
         self.snapshot_interval_s = (config.snapshot_interval_ms or 0) / 1000.0
         self._meta = MetadataAccessor(self.backend)
         meta = self._meta.current or {}
         self.last_time: int = int(meta.get("last_time", -1))
         self.offsets: dict[str, Any] = dict(meta.get("offsets", {}))
         n_chunks = int(meta.get("n_chunks", 0))
-        self._reader = SnapshotReader(self.backend, n_chunks)
+        first_chunk = int(meta.get("first_chunk", 0))
+        #: live input chunk seq -> max time recorded in it
+        self.chunk_spans: dict[int, int] = {
+            int(k): int(v) for k, v in meta.get("chunk_spans", {}).items()
+        }
+        #: snapshot catalog, ascending by time; each entry:
+        #: {"time": T, "ops": {rank: {"cls", "at", "chunks"}}}
+        self.op_snapshots: list[dict] = list(meta.get("op_snapshots", []))
+        self._reader = SnapshotReader(self.backend, n_chunks, first_chunk)
         self._writer = SnapshotWriter(self.backend, n_chunks)
+        self._first_chunk = first_chunk
+        self._ops = OperatorSnapshots(self.backend)
         self._recording = False
         self._sources: list[Any] = []  # RealtimeSources with persistent ids
         self._last_flush = _time.monotonic()
         self._dirty = False
         self._last_recorded_time = self.last_time
+        #: single-worker mode commits on its own wall-clock interval;
+        #: sharded mode commits only when the workers collectively agree
+        self.auto_commit = True
+        self._stateful: list[Any] = []  # rank -> node
+        self._dirty_ranks: set[int] = set()
+
+    @staticmethod
+    def _check_cluster_marker(root: PersistenceBackend, n_workers: int) -> None:
+        key = "cluster"
+        try:
+            existing = json.loads(root.get_value(key))
+        except Exception:
+            existing = None
+        if existing is not None:
+            if int(existing.get("n_workers", 1)) != n_workers:
+                raise RuntimeError(
+                    f"persisted state was written by {existing['n_workers']} "
+                    f"worker(s) but this run has {n_workers}: operator state "
+                    "is hash-sharded by worker count and cannot be resharded "
+                    "on recovery — restart with the original worker count or "
+                    "clear the persistence backend"
+                )
+        else:
+            root.put_value(key, json.dumps({"n_workers": n_workers}).encode())
 
     # -- recovery side ----------------------------------------------------
 
-    def replay_batches(self) -> list[tuple[int, str, Delta]]:
-        return self._reader.batches()
+    def attach_nodes(self, nodes: list[Any]) -> None:
+        """Register the executor's nodes; stateful ones get stable ranks by
+        deterministic build order (same program -> same ranks on restart)."""
+        ordered = sorted(nodes, key=lambda n: n.node_id)
+        self._stateful = [n for n in ordered if n.has_state()]
+        self._rank_of = {id(n): r for r, n in enumerate(self._stateful)}
+
+    def mark_dirty(self, node: Any) -> None:
+        rank = self._rank_of.get(id(node))
+        if rank is not None:
+            self._dirty_ranks.add(rank)
+
+    def available_op_times(self) -> list[int]:
+        return [int(e["time"]) for e in self.op_snapshots]
+
+    def restore_operators(self, nodes: list[Any], at_time: int) -> None:
+        """Load every stateful operator's state from the snapshot taken at
+        ``at_time`` (must be one of ``available_op_times()``)."""
+        entry = next(
+            (e for e in self.op_snapshots if int(e["time"]) == at_time), None
+        )
+        if entry is None:
+            raise RuntimeError(f"no operator snapshot at time {at_time}")
+        ops = entry["ops"]
+        if len(ops) != len(self._stateful):
+            raise RuntimeError(
+                f"operator snapshot has {len(ops)} stateful operators but the "
+                f"program builds {len(self._stateful)} — the dataflow changed "
+                "since the snapshot was taken; clear the persistence backend"
+            )
+        for rank, node in enumerate(self._stateful):
+            desc = ops.get(str(rank)) or ops.get(rank)
+            cls = type(node).__name__
+            if desc is None or desc["cls"] != cls:
+                raise RuntimeError(
+                    f"operator snapshot mismatch at rank {rank}: snapshot has "
+                    f"{desc and desc['cls']!r}, program builds {cls!r} — the "
+                    "dataflow changed since the snapshot was taken"
+                )
+            node.restore_state(
+                self._ops.read(rank, int(desc["at"]), int(desc["chunks"]))
+            )
+
+    def replay_batches(self, after_time: int = -1) -> list[tuple[int, str, Delta]]:
+        return self._reader.batches(after_time)
 
     def offset_for(self, pid: str) -> Any | None:
         return self.offsets.get(pid)
@@ -67,35 +170,131 @@ class PersistenceManager:
         self._dirty = True
         self._last_recorded_time = max(self._last_recorded_time, int(time))
 
-    def on_time_end(self, time: int) -> None:
-        if not self._recording or not self._dirty:
-            return
-        now = _time.monotonic()
-        if now - self._last_flush >= self.snapshot_interval_s:
-            self.commit(time)
-            self._last_flush = now
+    def should_commit(self) -> bool:
+        return (
+            self._recording
+            and self._dirty
+            and _time.monotonic() - self._last_flush >= self.snapshot_interval_s
+        )
 
-    def commit(self, time: int) -> None:
-        """Flush pending chunk + finalize metadata (the consistency point —
-        reference `finalize`, tracker.rs)."""
+    def on_time_end(self, time: int) -> None:
+        if self.auto_commit and self.should_commit():
+            self.commit(time)
+
+    def commit(self, time: int, *, with_operators: bool = True) -> None:
+        """Flush the pending input chunk, snapshot dirty operator state, and
+        finalize metadata (the consistency point — reference `finalize`,
+        tracker.rs). In sharded runs this is called collectively at one
+        agreed tick on every worker.
+
+        ``with_operators=False`` persists only the input tail + offsets —
+        used by ``close()`` after abnormal exits, where operator state may
+        be torn mid-tick and must NOT be snapshotted."""
         if not self._recording:
             return
-        self._writer.flush()
+        written = self._writer.flush()
+        if written is not None:
+            seq, max_t = written
+            self.chunk_spans[seq] = max_t
         self.last_time = max(self.last_time, int(time))
         self.offsets = {
             s.persistent_id: s.offset_state() for s in self._sources
         }
+        if with_operators:
+            self._snapshot_operators(self.last_time)
+        covered = self._plan_chunk_truncation()
         self._meta.commit({
             "last_time": self.last_time,
             "n_chunks": self._writer.n_chunks,
+            "first_chunk": self._first_chunk,
+            "chunk_spans": {str(k): v for k, v in self.chunk_spans.items()},
             "offsets": self.offsets,
+            "n_workers": self.n_workers,
+            "op_snapshots": self.op_snapshots,
         })
         self._meta.prune(keep=2)  # superseded metadata versions
+        # deletions run strictly AFTER the metadata commit that stops
+        # referencing the deleted blobs: a crash in between leaves orphan
+        # blobs (harmless), never a metadata version pointing at removed
+        # chunks (unrecoverable)
+        for seq in covered:
+            self.backend.remove_key(f"chunks/chunk-{seq:08d}")
+        self._prune_op_blobs()
         self._dirty = False
+        self._last_flush = _time.monotonic()
+
+    def _snapshot_operators(self, time: int) -> None:
+        if self.op_snapshots and int(self.op_snapshots[-1]["time"]) == time:
+            # same-tick re-commit (e.g. final commit right after an interval
+            # commit): the existing snapshot already covers this time
+            return
+        prev_ops = self.op_snapshots[-1]["ops"] if self.op_snapshots else {}
+        ops: dict[str, dict] = {}
+        for rank, node in enumerate(self._stateful):
+            prev = prev_ops.get(str(rank))
+            if prev is not None and rank not in self._dirty_ranks:
+                ops[str(rank)] = prev  # unchanged state: re-reference blob
+                continue
+            n_chunks = self._ops.write(rank, time, node.snapshot_state())
+            ops[str(rank)] = {
+                "cls": type(node).__name__, "at": time, "chunks": n_chunks,
+            }
+        self.op_snapshots.append({"time": time, "ops": ops})
+        self._dirty_ranks.clear()
+
+    def _plan_chunk_truncation(self) -> list[int]:
+        """Input chunks whose every entry predates the oldest retained
+        operator snapshot are dead weight — no recovery path reads them.
+        Updates the live-chunk bookkeeping and returns the seqs to delete
+        (deletion itself happens after the metadata commit)."""
+        keep_from = len(self.op_snapshots) - KEEP_OP_VERSIONS
+        self._drop_versions = self.op_snapshots[:max(0, keep_from)]
+        self.op_snapshots = self.op_snapshots[max(0, keep_from):]
+        if not self.op_snapshots:
+            return []
+        if self.n_workers > 1 and len(self.op_snapshots) < KEEP_OP_VERSIONS:
+            # sharded: a crash between two workers' commits in the same wave
+            # leaves them one version apart; recovery then restores the
+            # older common snapshot — or, if a worker has none yet, falls
+            # back to full replay. Either way history below the newest
+            # snapshot may still be needed, so truncation waits until a
+            # full retention window exists.
+            return []
+        min_op_time = int(self.op_snapshots[0]["time"])
+        covered = [
+            seq for seq, max_t in self.chunk_spans.items() if max_t <= min_op_time
+        ]
+        for seq in covered:
+            del self.chunk_spans[seq]
+        live = [s for s in self.chunk_spans]
+        self._first_chunk = min(live) if live else self._writer.n_chunks
+        return covered
+
+    def _prune_op_blobs(self) -> None:
+        """After metadata no longer references dropped snapshot versions,
+        delete their blobs (unless a retained version still re-references
+        the same (rank, at) write)."""
+        dropped = getattr(self, "_drop_versions", [])
+        if not dropped:
+            return
+        referenced = {
+            (r, int(d["at"]))
+            for e in self.op_snapshots
+            for r, d in e["ops"].items()
+        }
+        for e in dropped:
+            for r, d in e["ops"].items():
+                if (r, int(d["at"])) not in referenced:
+                    self._ops.drop(int(r), int(d["at"]), int(d["chunks"]))
+        self._drop_versions = []
 
     def close(self) -> None:
         """Flush any uncommitted tail (covers abnormal executor exits —
-        a raising connector unwinds past _finish) and release the backend."""
+        a raising connector unwinds past _finish) and release the backend.
+        Operator state is NOT snapshotted here: after an exception the
+        executor may have died mid-tick, with some operators having applied
+        the tick's deltas and others not — recovery instead restores the
+        last complete snapshot and replays the flushed tail through it."""
         if self._dirty:
-            self.commit(self._last_recorded_time)
+            self.commit(self._last_recorded_time, with_operators=False)
         self.backend.close()

@@ -28,10 +28,16 @@ import numpy as np
 from ..engine.delta import Delta
 from .backends import PersistenceBackend
 
-__all__ = ["SnapshotWriter", "SnapshotReader", "MetadataAccessor"]
+__all__ = [
+    "SnapshotWriter",
+    "SnapshotReader",
+    "MetadataAccessor",
+    "OperatorSnapshots",
+]
 
 _CHUNK_PREFIX = "chunks/chunk-"
 _META_PREFIX = "meta/meta-"
+_OPS_PREFIX = "ops/"
 
 
 def _delta_parts(delta: Delta) -> tuple:
@@ -115,32 +121,86 @@ class SnapshotWriter:
     def n_chunks(self) -> int:
         return self._seq
 
-    def flush(self) -> bool:
-        """Write buffered entries as one chunk. Returns True if anything
-        was written (caller then commits metadata)."""
+    def flush(self) -> tuple[int, int] | None:
+        """Write buffered entries as one chunk. Returns (seq, max_time) of
+        the written chunk (None if nothing buffered) — the span feeds chunk
+        truncation once an operator snapshot covers it."""
         if not self._buffer:
-            return False
+            return None
         blob = pickle.dumps(self._buffer, protocol=pickle.HIGHEST_PROTOCOL)
         self._backend.put_value(f"{_CHUNK_PREFIX}{self._seq:08d}", blob)
+        seq = self._seq
+        max_time = max(int(t) for t, _, _ in self._buffer)
         self._seq += 1
         self._buffer = []
-        return True
+        return seq, max_time
 
 
 class SnapshotReader:
     """Reads finalized chunks (those covered by metadata) back as
     time-ordered batches (``input_snapshot.rs:67`` ReadInputSnapshot)."""
 
-    def __init__(self, backend: PersistenceBackend, n_chunks: int):
+    def __init__(
+        self, backend: PersistenceBackend, n_chunks: int, first_chunk: int = 0
+    ):
         self._backend = backend
         self._n_chunks = n_chunks
+        self._first_chunk = first_chunk
 
-    def batches(self) -> list[tuple[int, str, Delta]]:
-        """All persisted (time, pid, delta) entries, in commit order (which
-        is nondecreasing in time by construction)."""
+    def batches(self, after_time: int = -1) -> list[tuple[int, str, Delta]]:
+        """Persisted (time, pid, delta) entries with time > after_time, in
+        commit order (nondecreasing in time by construction). Chunks below
+        ``first_chunk`` were truncated — their content is covered by an
+        operator snapshot and never read again (O(state) restart)."""
         out: list[tuple[int, str, Delta]] = []
-        for seq in range(self._n_chunks):
+        for seq in range(self._first_chunk, self._n_chunks):
             blob = self._backend.get_value(f"{_CHUNK_PREFIX}{seq:08d}")
             for time, pid, parts in pickle.loads(blob):
-                out.append((int(time), pid, _delta_from_parts(parts)))
+                if int(time) > after_time:
+                    out.append((int(time), pid, _delta_from_parts(parts)))
         return out
+
+
+class OperatorSnapshots:
+    """Chunked per-operator state blobs (``operator_snapshot.rs:130-293``):
+    one pickled state per stateful operator per snapshot version, split into
+    bounded-size chunks (object stores cap value sizes; chunk writes also
+    bound peak memory on read). Keys:
+
+    ``ops/{rank:04d}/t{time}-{chunk:04d}``
+
+    where ``rank`` is the operator's position among the graph's stateful
+    nodes in deterministic build order, and ``time`` the snapshot's logical
+    time. Metadata (held by the manager) maps each snapshot version to the
+    per-rank ``{"cls", "at", "chunks"}`` descriptors; a clean operator's new
+    version re-references the blob written at an earlier ``at`` instead of
+    rewriting identical bytes — the compaction analog."""
+
+    CHUNK_BYTES = 8 << 20
+
+    def __init__(self, backend: PersistenceBackend):
+        self._backend = backend
+
+    @staticmethod
+    def _key(rank: int, at: int, chunk: int) -> str:
+        return f"{_OPS_PREFIX}{rank:04d}/t{at}-{chunk:04d}"
+
+    def write(self, rank: int, at: int, state: Any) -> int:
+        """Pickle + chunk one operator's state; returns chunk count."""
+        blob = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+        n_chunks = max(1, -(-len(blob) // self.CHUNK_BYTES))
+        for c in range(n_chunks):
+            part = blob[c * self.CHUNK_BYTES:(c + 1) * self.CHUNK_BYTES]
+            self._backend.put_value(self._key(rank, at, c), part)
+        return n_chunks
+
+    def read(self, rank: int, at: int, n_chunks: int) -> Any:
+        blob = b"".join(
+            self._backend.get_value(self._key(rank, at, c))
+            for c in range(n_chunks)
+        )
+        return pickle.loads(blob)
+
+    def drop(self, rank: int, at: int, n_chunks: int) -> None:
+        for c in range(n_chunks):
+            self._backend.remove_key(self._key(rank, at, c))
