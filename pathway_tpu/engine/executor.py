@@ -494,7 +494,7 @@ class Executor:
         else:
             op_time = max(local_times) if local_times else -1
         if op_time >= 0:
-            self.persistence.restore_operators(self.nodes, op_time)
+            self.persistence.restore_operators(op_time)
         clock = max(0, op_time)
 
         # replay the recorded input tail (times after the operator snapshot)

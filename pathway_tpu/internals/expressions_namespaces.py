@@ -43,8 +43,18 @@ class StringNamespace(_Namespace):
     def reversed(self):
         return self._method("str.reversed")
 
-    def swap_case(self):
-        return self._method("str.swap_case")
+    def swapcase(self):
+        return self._method("str.swapcase")
+
+    # pre-parity spelling kept as an alias (reference name is ``swapcase``,
+    # string.py:358)
+    swap_case = swapcase
+
+    def removeprefix(self, prefix):
+        return self._method("str.removeprefix", prefix)
+
+    def removesuffix(self, suffix):
+        return self._method("str.removesuffix", suffix)
 
     def title(self):
         return self._method("str.title")
@@ -127,8 +137,66 @@ class DateTimeNamespace(_Namespace):
     def year(self):
         return self._method("dt.year")
 
-    def timestamp(self, unit: str = "ns"):
+    def timestamp(self, unit: str | None = None):
+        """Epoch timestamp. With a unit ('s'/'ms'/'us'/'ns'): float, like the
+        reference (date_time.py:384). unit=None: int nanoseconds (the
+        reference's deprecated default)."""
         return self._method("dt.timestamp", unit=unit)
+
+    def weekday(self):
+        return self._method("dt.weekday")
+
+    def from_timestamp(self, unit: str):
+        """INT/FLOAT epoch timestamp -> DateTimeNaive (date_time.py:1466)."""
+        return self._method("dt.from_timestamp", unit=unit)
+
+    def utc_from_timestamp(self, unit: str):
+        """INT/FLOAT epoch timestamp -> DateTimeUtc (date_time.py:1525)."""
+        return self._method("dt.from_timestamp", unit=unit).dt.to_utc("UTC")
+
+    # -- Duration totals (date_time.py:1119-1465) -------------------------
+
+    def nanoseconds(self):
+        return self._method("dt.nanoseconds")
+
+    def microseconds(self):
+        return self._method("dt.microseconds")
+
+    def milliseconds(self):
+        return self._method("dt.milliseconds")
+
+    def seconds(self):
+        return self._method("dt.seconds")
+
+    def minutes(self):
+        return self._method("dt.minutes")
+
+    def hours(self):
+        return self._method("dt.hours")
+
+    def days(self):
+        return self._method("dt.days")
+
+    def weeks(self):
+        return self._method("dt.weeks")
+
+    # -- timezone-aware arithmetic (date_time.py:840-975): compositions
+    # over to_utc/to_naive_in_timezone, exactly as the reference builds them
+
+    def add_duration_in_timezone(self, duration, timezone):
+        return (self.to_utc(timezone) + duration).dt.to_naive_in_timezone(
+            timezone
+        )
+
+    def subtract_duration_in_timezone(self, duration, timezone):
+        return (self.to_utc(timezone) - duration).dt.to_naive_in_timezone(
+            timezone
+        )
+
+    def subtract_date_time_in_timezone(self, date_time, timezone):
+        return self.to_utc(timezone) - smart_coerce(date_time).dt.to_utc(
+            timezone
+        )
 
     def strftime(self, fmt):
         return self._method("dt.strftime", fmt)
@@ -156,10 +224,39 @@ class DateTimeNamespace(_Namespace):
 _UNIT_NS = {"ns": 1, "us": 1_000, "ms": 1_000_000, "s": 1_000_000_000}
 
 
+def _td_ns(d: datetime.timedelta) -> int:
+    """Exact total nanoseconds of a timedelta (int arithmetic throughout)."""
+    return ((d.days * 86400 + d.seconds) * 1_000_000 + d.microseconds) * 1000
+
+
+def _td_trunc(d: datetime.timedelta, unit_ns: int) -> int:
+    """Total whole units, truncating toward zero — chrono ``num_*``
+    semantics (reference Duration accessors), not floor division: -90s is
+    -1 minute, not -2."""
+    ns = _td_ns(d)
+    q = abs(ns) // unit_ns
+    return q if ns >= 0 else -q
+
+
 def _dur_ns(d: Any) -> int:
     if isinstance(d, datetime.timedelta):
-        return int(d.total_seconds() * 1_000_000_000)
+        return _td_ns(d)
     return int(d)
+
+
+def _dt_epoch_ns(v: datetime.datetime) -> int:
+    """Exact nanoseconds since the epoch (naive: 1970-01-01; aware: UTC)."""
+    if v.tzinfo is None:
+        epoch = datetime.datetime(1970, 1, 1)
+    else:
+        epoch = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+    return _td_ns(v - epoch)
+
+
+def _tz(name: str):
+    from zoneinfo import ZoneInfo  # module-level cache inside zoneinfo
+
+    return ZoneInfo(name)
 
 
 _METHODS: dict[str, tuple[Callable, Callable]] = {
@@ -170,7 +267,9 @@ _METHODS: dict[str, tuple[Callable, Callable]] = {
     "str.strip": (lambda s, c: s.strip(c), lambda ts: dt.STR),
     "str.len": (lambda s: len(s), lambda ts: dt.INT),
     "str.reversed": (lambda s: s[::-1], lambda ts: dt.STR),
-    "str.swap_case": (lambda s: s.swapcase(), lambda ts: dt.STR),
+    "str.swapcase": (lambda s: s.swapcase(), lambda ts: dt.STR),
+    "str.removeprefix": (lambda s, p: s.removeprefix(p), lambda ts: dt.STR),
+    "str.removesuffix": (lambda s, p: s.removesuffix(p), lambda ts: dt.STR),
     "str.title": (lambda s: s.title(), lambda ts: dt.STR),
     "str.count": (lambda s, sub: s.count(sub), lambda ts: dt.INT),
     "str.find": (lambda s, sub: s.find(sub), lambda ts: dt.INT),
@@ -195,6 +294,31 @@ _METHODS: dict[str, tuple[Callable, Callable]] = {
     "dt.millisecond": (lambda v: v.microsecond // 1000, lambda ts: dt.INT),
     "dt.nanosecond": (lambda v: v.microsecond * 1000, lambda ts: dt.INT),
     "dt.strftime": (lambda v, fmt: v.strftime(fmt), lambda ts: dt.STR),
+    "dt.weekday": (lambda v: v.weekday(), lambda ts: dt.INT),
+    # Duration totals (reference date_time.py:1119-1465: all are *total*
+    # durations as ints, truncating toward zero like chrono's num_*)
+    "dt.nanoseconds": (lambda d: _td_ns(d), lambda ts: dt.INT),
+    "dt.microseconds": (lambda d: _td_trunc(d, 1_000), lambda ts: dt.INT),
+    "dt.milliseconds": (lambda d: _td_trunc(d, 1_000_000), lambda ts: dt.INT),
+    "dt.seconds": (lambda d: _td_trunc(d, 1_000_000_000), lambda ts: dt.INT),
+    "dt.minutes": (lambda d: _td_trunc(d, 60_000_000_000), lambda ts: dt.INT),
+    "dt.hours": (lambda d: _td_trunc(d, 3_600_000_000_000), lambda ts: dt.INT),
+    "dt.days": (lambda d: _td_trunc(d, 86_400_000_000_000), lambda ts: dt.INT),
+    "dt.weeks": (
+        lambda d: _td_trunc(d, 604_800_000_000_000), lambda ts: dt.INT,
+    ),
+    # timezone conversions (reference date_time.py:660,750; zoneinfo is the
+    # chrono-tz analog)
+    "dt.to_utc": (
+        lambda v, tz: v.replace(tzinfo=_tz(tz)).astimezone(
+            datetime.timezone.utc
+        ),
+        lambda ts: dt.DATE_TIME_UTC,
+    ),
+    "dt.to_naive_in_timezone": (
+        lambda v, tz: v.astimezone(_tz(tz)).replace(tzinfo=None),
+        lambda ts: dt.DATE_TIME_NAIVE,
+    ),
 }
 
 
@@ -248,19 +372,44 @@ def compile_method(expr: MethodCallExpression, env, build, xp_name):
         return fn, (dt.Optional(out_dt) if optional else out_dt), False, refs
 
     if name == "dt.timestamp":
-        unit = _UNIT_NS[kw.get("unit", "ns")]
+        unit = kw.get("unit")
+        as_float = unit is not None  # reference: float with a unit, int ns
+        # for the deprecated no-unit form (date_time.py:384)
+        div = _UNIT_NS[unit or "ns"]
 
         def fn(cols, keys, f=parts[0][0]):
             from .expression_compiler import _materialize
 
             vals = _materialize(f(cols, keys), len(keys))
-            out = np.empty(len(vals), dtype=np.int64)
+            out = np.empty(
+                len(vals), dtype=np.float64 if as_float else np.int64
+            )
             for i, v in enumerate(vals):
-                ts = v.timestamp() if v.tzinfo is not None else v.replace(tzinfo=datetime.timezone.utc).timestamp()
-                out[i] = int(ts * 1_000_000_000) // unit
+                ns = _dt_epoch_ns(v)
+                out[i] = ns / div if as_float else ns // div
             return out
 
-        return fn, dt.INT, False, refs
+        return fn, dt.FLOAT if as_float else dt.INT, False, refs
+
+    if name == "dt.from_timestamp":
+        mul = _UNIT_NS[kw["unit"]]
+
+        def fn(cols, keys, f=parts[0][0]):
+            from .expression_compiler import _materialize
+
+            vals = _materialize(f(cols, keys), len(keys))
+            out = np.empty(len(vals), dtype=object)
+            epoch = datetime.datetime(1970, 1, 1)
+            for i, v in enumerate(vals):
+                if isinstance(v, (int, np.integer)):
+                    # exact int path: float64 can't hold current-era ns
+                    us = (int(v) * mul) // 1000
+                else:
+                    us = (v * mul) / 1000
+                out[i] = epoch + datetime.timedelta(microseconds=us)
+            return out
+
+        return fn, dt.DATE_TIME_NAIVE, False, refs
 
     if name == "dt.strptime":
         contains_tz = kw.get("contains_timezone", False)
@@ -321,7 +470,10 @@ def compile_method(expr: MethodCallExpression, env, build, xp_name):
         return fn, dt.unoptionalize(arg_dtypes[0]), False, refs
 
     if name not in _METHODS:
-        raise NotImplementedError(f"expression method {name!r} is not implemented yet")
+        # internal invariant: every namespace method constructs a name listed
+        # above (the reference's .dt/.str/.num inventory is fully mapped) —
+        # reaching here means a namespace/compiler mismatch, not a user error
+        raise AssertionError(f"unmapped expression method {name!r}")
 
     impl, dtype_fn = _METHODS[name]
     out_dt = dtype_fn(arg_dtypes)

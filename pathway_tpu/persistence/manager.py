@@ -121,9 +121,10 @@ class PersistenceManager:
     def available_op_times(self) -> list[int]:
         return [int(e["time"]) for e in self.op_snapshots]
 
-    def restore_operators(self, nodes: list[Any], at_time: int) -> None:
-        """Load every stateful operator's state from the snapshot taken at
-        ``at_time`` (must be one of ``available_op_times()``)."""
+    def restore_operators(self, at_time: int) -> None:
+        """Load the state of every operator registered via ``attach_nodes``
+        from the snapshot taken at ``at_time`` (one of
+        ``available_op_times()``)."""
         entry = next(
             (e for e in self.op_snapshots if int(e["time"]) == at_time), None
         )
