@@ -1,22 +1,148 @@
-"""``pw.io.airbyte`` — Airbyte-sourced streams (reference
-``python/pathway/io/airbyte`` over vendored airbyte_serverless, 300+
-sources). Gated: requires an airbyte runtime (docker or PyAirbyte)."""
+"""``pw.io.airbyte`` — Airbyte-sourced streams.
+
+Re-design of ``python/pathway/io/airbyte`` (which drives any of 300+
+Airbyte sources through the vendored airbyte_serverless runner). The
+connector's engine side — periodic ``extract`` runs, Airbyte-protocol
+RECORD/STATE message handling, per-record json rows in the reference's
+single-column ``_AirbyteRecordSchema`` shape, state-based incremental
+resume — is complete and unit-tested with a fake source runner; only the
+construction of a real runner (docker / PyAirbyte, both absent here) is
+gated.
+"""
 
 from __future__ import annotations
 
-from typing import Any
+import json
+import time as _time
+from typing import Any, Protocol
 
+from ..engine.executor import RealtimeSource
+from ..internals.parse_graph import Universe
+from ..internals.schema import schema_from_types
 from ..internals.table import Table
 from ._gated import unavailable
 
 __all__ = ["read"]
 
 
+class AirbyteRunner(Protocol):
+    """One Airbyte source run: yields Airbyte-protocol messages (dicts with
+    ``type`` RECORD/STATE, matching airbyte_serverless's extract API)."""
+
+    def extract(self, state: Any | None) -> Any:
+        ...
+
+
+def _default_runner(config_file_path: str, streams: list[str]) -> AirbyteRunner:
+    """Build a real runner from airbyte_serverless (the reference drives
+    Docker-packaged sources through its vendored copy,
+    ``third_party/airbyte_serverless/sources.py`` DockerAirbyteSource —
+    ``extract(state)`` yields Airbyte-protocol messages)."""
+    try:
+        import yaml  # type: ignore[import-untyped]
+        from airbyte_serverless.sources import (  # type: ignore[import-not-found]
+            DockerAirbyteSource,
+        )
+    except ImportError:
+        unavailable(
+            "pw.io.airbyte.read", "airbyte-serverless (plus a docker runtime)"
+        )
+    with open(config_file_path) as f:
+        config = yaml.safe_load(f)
+    source_config = config["source"]
+
+    class _Runner:
+        def __init__(self) -> None:
+            self._source = DockerAirbyteSource(
+                connector=source_config["docker_image"],
+                config=source_config.get("config", {}),
+                streams=",".join(streams) if streams else None,
+            )
+
+        def extract(self, state):
+            for message in self._source.extract(state=state):
+                yield (
+                    message if isinstance(message, dict) else message.__dict__
+                )
+
+    return _Runner()
+
+
+class AirbyteSource(RealtimeSource):
+    """Runs ``extract`` every refresh interval, emitting RECORD messages as
+    rows of a single json ``data`` column (the reference's
+    _AirbyteRecordSchema) and tracking STATE messages for incremental
+    resume (io/airbyte/__init__.py:107)."""
+
+    # Airbyte state makes re-extraction incremental — connector state
+    STATE_FIELDS = ("_state", "_emitted")
+
+    def __init__(self, runner: AirbyteRunner, streams: list[str],
+                 refresh_interval_s: float, mode: str):
+        super().__init__(["data"])
+        self.runner = runner
+        self.streams = list(streams)
+        self.refresh_interval_s = refresh_interval_s
+        self.mode = mode
+        self._state: Any | None = None
+        self._emitted = 0
+        self._next_poll = 0.0
+        self._done = False
+
+    def poll(self):
+        from ..engine import keys as K
+        from ..engine.delta import Delta, rows_to_columns
+
+        now = _time.monotonic()
+        if now < self._next_poll or self._done:
+            return []
+        self._next_poll = now + self.refresh_interval_s
+        rows: list[tuple] = []
+        for msg in self.runner.extract(self._state):
+            mtype = msg.get("type")
+            if mtype == "RECORD":
+                rec = msg["record"]
+                if self.streams and rec.get("stream") not in self.streams:
+                    continue
+                rows.append((json.dumps(rec.get("data", {})),))
+            elif mtype == "STATE":
+                self._state = msg.get("state")
+        if self.mode == "static":
+            self._done = True
+        if not rows:
+            return []
+        start = self._emitted
+        self._emitted += len(rows)
+        keys = K.hash_values([(start + i, r) for i, r in enumerate(rows)])
+        return [Delta(keys=keys, data=rows_to_columns(rows, ["data"]))]
+
+    def offset_state(self):
+        return {"state": self._state, "emitted": self._emitted}
+
+    def seek(self, state) -> None:
+        self._state = state.get("state")
+        self._emitted = int(state.get("emitted", 0))
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
 def read(config_file_path: str, streams: list[str], *, mode: str = "streaming",
          refresh_interval_ms: int = 60_000, name: str | None = None,
-         **kwargs: Any) -> Table:
-    try:
-        import airbyte  # type: ignore[import-not-found]  # noqa: F401
-    except ImportError:
-        unavailable("pw.io.airbyte.read", "airbyte")
-    raise NotImplementedError
+         _runner: AirbyteRunner | None = None, **kwargs: Any) -> Table:
+    """Stream records from an Airbyte source. ``_runner`` injects any
+    AirbyteRunner (tests use a fake emitting protocol messages)."""
+    runner = (
+        _runner if _runner is not None
+        else _default_runner(config_file_path, streams)
+    )
+
+    def build():
+        src = AirbyteSource(
+            runner, streams, refresh_interval_ms / 1000.0, mode
+        )
+        src.persistent_id = name
+        return src
+
+    schema = schema_from_types(data=str)
+    return Table("source", [], {"build": build}, schema, Universe())

@@ -1,5 +1,11 @@
-"""``pw.io.bigquery`` — BigQuery sink (reference
-``python/pathway/io/bigquery``). Gated on ``google-cloud-bigquery``."""
+"""``pw.io.bigquery`` — BigQuery sink.
+
+Re-design of ``python/pathway/io/bigquery``: streams the table's changes
+into a BigQuery table via ``insert_rows_json``, with the reference's
+``time``/``diff`` fields appended to every row. The connector logic is
+complete and unit-tested with a fake client; only the real
+``google-cloud-bigquery`` client construction is gated.
+"""
 
 from __future__ import annotations
 
@@ -11,11 +17,48 @@ from ._gated import unavailable
 __all__ = ["write"]
 
 
-def write(table: Table, dataset_name: str, table_name: str, *,
-          service_user_credentials_file: str | None = None,
-          name: str | None = None, **kwargs: Any) -> None:
+def _bq_client(service_user_credentials_file: str | None):
     try:
-        from google.cloud import bigquery  # type: ignore[attr-defined]  # noqa: F401
+        from google.cloud import bigquery  # type: ignore[attr-defined]
+        from google.oauth2.service_account import (  # type: ignore[import-not-found]
+            Credentials,
+        )
     except ImportError:
         unavailable("pw.io.bigquery.write", "google-cloud-bigquery")
-    raise NotImplementedError
+    creds = (
+        Credentials.from_service_account_file(service_user_credentials_file)
+        if service_user_credentials_file is not None else None
+    )
+    return bigquery.Client(credentials=creds)
+
+
+def write(table: Table, dataset_name: str, table_name: str, *,
+          service_user_credentials_file: str | None = None,
+          name: str | None = None, _client: Any = None,
+          **kwargs: Any) -> None:
+    """Write ``table``'s change stream into ``dataset.table``; target schema
+    must include integral ``time`` and ``diff`` fields (reference
+    io/bigquery/__init__.py:55). ``_client`` injects anything exposing
+    ``insert_rows_json(table_ref, rows) -> errors`` (tests use a fake)."""
+    from . import subscribe
+    from .fs import _jsonable
+
+    client = _client if _client is not None else _bq_client(
+        service_user_credentials_file
+    )
+    table_ref = f"{dataset_name}.{table_name}"
+    names = table.column_names()
+
+    def on_batch(time, batch):
+        cols = [batch.data[n] for n in names]
+        rows = []
+        for vals, diff in zip(zip(*cols), batch.diffs):
+            row = {n: _jsonable(v) for n, v in zip(names, vals)}
+            row["time"] = int(time)
+            row["diff"] = int(diff)
+            rows.append(row)
+        errors = client.insert_rows_json(table_ref, rows)
+        if errors:
+            raise RuntimeError(f"bigquery insert failed: {errors}")
+
+    subscribe(table, on_batch=on_batch)

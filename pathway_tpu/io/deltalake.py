@@ -1,32 +1,363 @@
-"""``pw.io.deltalake`` — Delta Lake source/sink (reference Rust
-``DeltaTableWriter``/``Reader``, ``src/connectors/data_storage.rs:1611,1902``).
-Gated on the ``deltalake`` library."""
+"""``pw.io.deltalake`` — Delta Lake source/sink.
+
+Re-design of the reference's Rust delta-rs integration
+(``DeltaTableWriter``/``Reader``, ``src/connectors/data_storage.rs:1611,1902``).
+Rather than wrapping a client library, this implements the open Delta
+protocol directly over pyarrow (which IS in the environment): a Delta table
+is parquet data files plus a ``_delta_log/`` of JSON commits with
+``metaData``/``add``/``remove`` actions. The writer emits standard commits
+(schema in version 0, one parquet file + add action per flushed batch, the
+reference's ``time``/``diff`` output columns appended); the reader replays
+the log and, in streaming mode, polls for new versions, turning appended
+``add`` actions into insertions and ``remove`` actions into retractions.
+Local round-trips are fully testable with no service or extra dependency.
+"""
 
 from __future__ import annotations
 
+import json
+import os
+import time as _time
+import uuid
 from typing import Any
 
+from ..engine.executor import RealtimeSource
+from ..internals import dtype as dt
+from ..internals.parse_graph import Universe
 from ..internals.schema import SchemaMetaclass
 from ..internals.table import Table
-from ._gated import unavailable
+from ..internals.table_io import rows_to_table
 
 __all__ = ["read", "write"]
+
+_LOG_DIR = "_delta_log"
+
+
+def _log_path(uri: str, version: int) -> str:
+    return os.path.join(uri, _LOG_DIR, f"{version:020d}.json")
+
+
+def _dtype_to_delta(t) -> str:
+    u = dt.unoptionalize(t)
+    if u == dt.INT:
+        return "long"
+    if u == dt.FLOAT:
+        return "double"
+    if u == dt.BOOL:
+        return "boolean"
+    if u == dt.BYTES:
+        return "binary"
+    return "string"
+
+
+def _delta_schema_json(names: list[str], schema: SchemaMetaclass | None) -> str:
+    fields = []
+    for n in names:
+        cs = schema.columns().get(n) if schema is not None else None
+        fields.append({
+            "name": n,
+            "type": _dtype_to_delta(cs.dtype) if cs is not None else "string",
+            "nullable": True,
+            "metadata": {},
+        })
+    fields.append({"name": "time", "type": "long", "nullable": False, "metadata": {}})
+    fields.append({"name": "diff", "type": "long", "nullable": False, "metadata": {}})
+    return json.dumps({"type": "struct", "fields": fields})
+
+
+def _list_versions(uri: str) -> list[int]:
+    log = os.path.join(uri, _LOG_DIR)
+    if not os.path.isdir(log):
+        return []
+    out = []
+    for fn in os.listdir(log):
+        if fn.endswith(".json"):
+            try:
+                out.append(int(fn[:-5]))
+            except ValueError:
+                pass
+    return sorted(out)
+
+
+class DeltaTableWriter:
+    """Sink state: buffers row updates, flushes each commit window as one
+    parquet file + one Delta log commit (data_storage.rs:1611)."""
+
+    def __init__(self, uri: str, names: list[str], schema: SchemaMetaclass | None,
+                 min_commit_frequency_ms: int | None):
+        self.uri = uri
+        self.names = names
+        self.schema = schema
+        self.min_commit_s = (min_commit_frequency_ms or 0) / 1000.0
+        self._buffer: list[tuple] = []
+        self._last_flush = _time.monotonic()
+        os.makedirs(os.path.join(uri, _LOG_DIR), exist_ok=True)
+        if not _list_versions(uri):
+            self._commit_actions([
+                {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}},
+                {"metaData": {
+                    "id": str(uuid.uuid4()),
+                    "format": {"provider": "parquet", "options": {}},
+                    "schemaString": _delta_schema_json(names, schema),
+                    "partitionColumns": [],
+                    "configuration": {},
+                    "createdTime": int(_time.time() * 1000),
+                }},
+            ], version=0)
+
+    def _commit_actions(self, actions: list[dict], version: int | None = None) -> None:
+        # Delta requires put-if-absent commit semantics: os.link fails on an
+        # existing target (unlike os.replace), so a concurrent writer that
+        # raced us to version N loses cleanly and retries at N+1
+        while True:
+            if version is None:
+                versions = _list_versions(self.uri)
+                v = (versions[-1] + 1) if versions else 0
+            else:
+                v = version
+            path = _log_path(self.uri, v)
+            tmp = path + f".tmp-{uuid.uuid4().hex}"
+            with open(tmp, "w") as f:
+                for a in actions:
+                    f.write(json.dumps(a) + "\n")
+            try:
+                os.link(tmp, path)
+            except FileExistsError:
+                os.remove(tmp)
+                if version is not None:
+                    raise
+                continue
+            os.remove(tmp)
+            return
+
+    def add_batch(self, time: int, batch) -> None:
+        cols = [batch.data[n] for n in self.names]
+        for vals, diff in zip(zip(*cols), batch.diffs):
+            self._buffer.append(tuple(vals) + (int(time), int(diff)))
+        now = _time.monotonic()
+        if now - self._last_flush >= self.min_commit_s:
+            self.flush()
+            self._last_flush = now
+
+    def flush(self) -> None:
+        if not self._buffer:
+            return
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        all_names = self.names + ["time", "diff"]
+        arrays = [
+            pa.array([row[i] for row in self._buffer])
+            for i in range(len(all_names))
+        ]
+        table = pa.Table.from_arrays(arrays, names=all_names)
+        fname = f"part-00000-{uuid.uuid4().hex}-c000.snappy.parquet"
+        fpath = os.path.join(self.uri, fname)
+        pq.write_table(table, fpath, compression="snappy")
+        self._commit_actions([
+            {"add": {
+                "path": fname,
+                "partitionValues": {},
+                "size": os.path.getsize(fpath),
+                "modificationTime": int(_time.time() * 1000),
+                "dataChange": True,
+            }},
+            {"commitInfo": {
+                "timestamp": int(_time.time() * 1000),
+                "operation": "WRITE",
+                "operationParameters": {"mode": "Append"},
+            }},
+        ])
+        self._buffer = []
+
+
+def write(table: Table, uri: str, *, min_commit_frequency: int | None = 60_000,
+          name: str | None = None, **kwargs: Any) -> None:
+    from . import subscribe
+
+    uri = os.fspath(uri)
+    names = table.column_names()
+    writer = DeltaTableWriter(uri, names, table.schema, min_commit_frequency)
+    subscribe(
+        table,
+        on_batch=lambda time, batch: writer.add_batch(time, batch),
+        on_end=writer.flush,
+    )
+
+
+def _version_actions(uri: str, version: int) -> tuple[list[str], list[str]]:
+    """(file paths added, file paths removed) in one log version."""
+    added: list[str] = []
+    removed: list[str] = []
+    with open(_log_path(uri, version)) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            action = json.loads(line)
+            if "add" in action:
+                added.append(action["add"]["path"])
+            elif "remove" in action:
+                removed.append(action["remove"]["path"])
+    return added, removed
+
+
+def _read_file_rows(uri: str, fname: str, names: list[str]) -> list[tuple]:
+    import pyarrow.parquet as pq
+
+    t = pq.read_table(os.path.join(uri, fname))
+    cols = [
+        t.column(n).to_pylist() if n in t.column_names else [None] * t.num_rows
+        for n in names
+    ]
+    return list(zip(*cols)) if t.num_rows else []
+
+
+def _log_schema_names(uri: str) -> list[str]:
+    with open(_log_path(uri, 0)) as f:
+        for line in f:
+            action = json.loads(line)
+            if "metaData" in action:
+                fields = json.loads(action["metaData"]["schemaString"])["fields"]
+                return [fld["name"] for fld in fields]
+    raise ValueError(f"{uri}: version 0 has no metaData action")
+
+
+class DeltaStreamSource(RealtimeSource):
+    """Polls ``_delta_log`` for new versions; emits data-column diffs.
+
+    ``add`` actions insert their file's rows (honoring a ``diff`` column if
+    present — our writer's CDC shape); ``remove`` actions (DELETE/OPTIMIZE
+    from any Delta writer) retract everything the removed file contributed.
+    """
+
+    # per-file contributed (row, diff) pairs back ``remove`` retractions —
+    # connector state restored by operator snapshots
+    STATE_FIELDS = ("_next_version", "_file_rows")
+
+    def __init__(self, uri: str, names: list[str], poll_interval_s: float = 1.0):
+        super().__init__(list(names))
+        self.uri = uri
+        self.names = list(names)
+        self.poll_interval_s = poll_interval_s
+        self._next_version = 0
+        self._next_poll = 0.0
+        self._file_rows: dict[str, list] = {}
+        self._schema_cache: tuple[list[str], list[int], bool] | None = None
+
+    def offset_state(self):
+        return {"version": self._next_version}
+
+    def seek(self, state) -> None:
+        self._next_version = int(state.get("version", 0))
+
+    def _schema(self) -> tuple[list[str], list[int], bool]:
+        if self._schema_cache is None:
+            all_names = _log_schema_names(self.uri)  # once, not per poll
+            self._schema_cache = (
+                all_names,
+                [all_names.index(n) for n in self.names],
+                "diff" in all_names,
+            )
+        return self._schema_cache
+
+    def poll(self):
+        import numpy as np
+
+        from ..engine import keys as K
+        from ..engine.delta import Delta, rows_to_columns
+
+        now = _time.monotonic()
+        if now < self._next_poll:
+            return []
+        self._next_poll = now + self.poll_interval_s
+        versions = [v for v in _list_versions(self.uri) if v >= self._next_version]
+        if not versions:
+            return []
+        try:
+            all_names, ix, has_diff = self._schema()
+        except (OSError, ValueError):
+            return []
+        diff_ix = all_names.index("diff") if has_diff else -1
+        out: list[Delta] = []
+        for v in versions:
+            added, removed = _version_actions(self.uri, v)
+            self._next_version = v + 1
+            pairs: list[tuple[tuple, int]] = []
+            for fname in removed:
+                # retract the removed file's contribution (compaction
+                # rewrites re-add the same rows in the same commit, so the
+                # pairs cancel downstream)
+                pairs.extend(
+                    (row, -d) for row, d in self._file_rows.pop(fname, [])
+                )
+            for fname in added:
+                raw = _read_file_rows(self.uri, fname, all_names)
+                contributed = [
+                    (
+                        tuple(r[i] for i in ix),
+                        int(r[diff_ix]) if has_diff else 1,
+                    )
+                    for r in raw
+                ]
+                self._file_rows[fname] = contributed
+                pairs.extend(contributed)
+            if not pairs:
+                continue
+            rows = [p[0] for p in pairs]
+            diffs = np.asarray([p[1] for p in pairs], dtype=np.int64)
+            keys = K.hash_values(rows)
+            out.append(Delta(
+                keys=keys, data=rows_to_columns(rows, self.names), diffs=diffs
+            ))
+        return out
+
+    def is_finished(self) -> bool:
+        return False
 
 
 def read(uri: str, *, schema: SchemaMetaclass | None = None, mode: str = "streaming",
          autocommit_duration_ms: int | None = 1500, name: str | None = None,
          **kwargs: Any) -> Table:
-    try:
-        import deltalake  # type: ignore[import-not-found]  # noqa: F401
-    except ImportError:
-        unavailable("pw.io.deltalake.read", "deltalake")
-    raise NotImplementedError
+    uri = os.fspath(uri)
+    log_names = _log_schema_names(uri)
+    data_names = (
+        schema.column_names() if schema is not None
+        else [n for n in log_names if n not in ("time", "diff")]
+    )
+    if mode == "static":
+        # resolve live files first: removed files (DELETE/OPTIMIZE) must not
+        # contribute rows
+        live: dict[str, None] = {}
+        for v in _list_versions(uri):
+            added, removed = _version_actions(uri, v)
+            for f in removed:
+                live.pop(f, None)
+            for f in added:
+                live[f] = None
+        rows: list[tuple] = []
+        counts: dict[tuple, int] = {}
+        has_diff = "diff" in log_names
+        ix = [log_names.index(n) for n in data_names]
+        diff_ix = log_names.index("diff") if has_diff else -1
+        for fname in live:
+            for r in _read_file_rows(uri, fname, log_names):
+                row = tuple(r[i] for i in ix)
+                d = int(r[diff_ix]) if has_diff else 1
+                counts[row] = counts.get(row, 0) + d
+        for row, c in counts.items():
+            rows.extend([row] * max(0, c))
+        return rows_to_table(data_names, rows, schema=schema)
 
+    def build():
+        src = DeltaStreamSource(
+            uri, data_names,
+            poll_interval_s=(autocommit_duration_ms or 1000) / 1000.0,
+        )
+        src.persistent_id = name
+        return src
 
-def write(table: Table, uri: str, *, min_commit_frequency: int | None = 60_000,
-          name: str | None = None, **kwargs: Any) -> None:
-    try:
-        import deltalake  # type: ignore[import-not-found]  # noqa: F401
-    except ImportError:
-        unavailable("pw.io.deltalake.write", "deltalake")
-    raise NotImplementedError
+    from ..internals.schema import schema_from_types
+
+    use_schema = schema or schema_from_types(**{n: Any for n in data_names})
+    return Table("source", [], {"build": build}, use_schema, Universe())

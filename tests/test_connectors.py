@@ -130,8 +130,10 @@ def test_gated_connectors_raise_importerror():
         pw.io.mongodb.write(t, "mongodb://x", "db", "coll")
     with pytest.raises(ImportError, match="boto3"):
         pw.io.s3.read("s3://bucket/x")
-    with pytest.raises(ImportError, match="deltalake"):
-        pw.io.deltalake.read("s3://bucket/x")
+    with pytest.raises(ImportError, match="nats-py"):
+        pw.io.nats.read("nats://x:4222", "topic", format="plaintext")
+    # deltalake needs no client library anymore: it implements the Delta
+    # protocol over pyarrow (see test_connectors_destubbed.py)
 
 
 def test_sqlite_streaming_recovery_no_double_count(tmp_path):

@@ -1,0 +1,454 @@
+"""De-stubbed connectors, driven by fakes — only the client library import
+is gated; all connector logic runs here.
+
+- s3/minio/gdrive/pyfilesystem: the shared object scanner over a
+  filesystem-backed fake endpoint (new/changed/deleted object detection).
+- deltalake: real Delta protocol over pyarrow — full local round-trip.
+- nats: in-process fake client; read drains subscription, write publishes
+  time/diff messages.
+- pubsub/bigquery: fake publisher/client sinks.
+- airbyte: fake protocol runner with RECORD/STATE messages.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io._object_scanner import ObjectMeta, ObjectScanSource
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+class DirBackedS3(object):
+    """Filesystem-backed fake S3 endpoint: objects are files under root."""
+
+    def __init__(self, root):
+        self.root = root
+
+    def list_objects(self):
+        for dirpath, _, files in os.walk(self.root):
+            for f in sorted(files):
+                p = os.path.join(dirpath, f)
+                st = os.stat(p)
+                yield ObjectMeta(
+                    key=os.path.relpath(p, self.root),
+                    version=f"{st.st_size}:{st.st_mtime_ns}",
+                    size=st.st_size,
+                    modified_at=st.st_mtime,
+                )
+
+    def read_object(self, key: str) -> bytes:
+        with open(os.path.join(self.root, key), "rb") as f:
+            return f.read()
+
+
+def _drain(src):
+    out = []
+    src._next_poll = 0.0
+    for d in src.poll():
+        for key, row, diff in d.iter_rows():
+            out.append((row, diff))
+    return out
+
+
+def test_object_scanner_add_change_delete(tmp_path):
+    (tmp_path / "a.txt").write_text("hello\nworld\n")
+    client = DirBackedS3(os.fspath(tmp_path))
+    src = ObjectScanSource(client, "plaintext", None, ["data"])
+    assert sorted(_drain(src)) == [(("hello",), 1), (("world",), 1)]
+    assert _drain(src) == []  # unchanged listing: no re-emission
+
+    (tmp_path / "b.txt").write_text("new\n")
+    assert _drain(src) == [(("new",), 1)]
+
+    # changed object: old rows retracted, new inserted
+    os.utime(tmp_path / "a.txt", ns=(1, 1))  # force version change detection
+    (tmp_path / "a.txt").write_text("hello\nthere\n")
+    changes = sorted(_drain(src))
+    assert (("world",), -1) in changes and (("there",), 1) in changes
+    assert (("hello",), 1) not in dict((r, d) for r, d in changes if d < 0)
+
+    (tmp_path / "b.txt").unlink()
+    assert _drain(src) == [(("new",), -1)]
+
+
+def test_s3_static_read_and_metadata(tmp_path):
+    (tmp_path / "x.csv").write_text("word,n\nfoo,1\nbar,2\n")
+    client = DirBackedS3(os.fspath(tmp_path))
+    t = pw.io.s3.read(
+        "s3://bucket/prefix", _client=client, format="csv",
+        schema=pw.schema_from_types(word=str, n=int), mode="static",
+    )
+    cap = pw.internals.graph_runner.GraphRunner().run_tables(t)[0]
+    rows = sorted(tuple(r) for _, r in cap.state.iter_items())
+    assert rows == [("bar", 2), ("foo", 1)]
+
+    # streaming source with metadata column exposes path/size
+    t2 = pw.io.s3.read(
+        "s3://b/p", _client=client, format="plaintext", with_metadata=True,
+    )
+    src = t2._params["build"]()
+    got = _drain(src)
+    assert len(got) == 3  # plaintext: every line of x.csv incl. header
+    md = json.loads(got[0][0][1])
+    assert md["path"] == "x.csv" and md["size"] > 0
+
+
+def test_minio_delegates_to_s3(tmp_path):
+    (tmp_path / "o.txt").write_text("payload")
+    settings = pw.io.minio.MinIOSettings(
+        endpoint="http://127.0.0.1:1", bucket_name="b",
+        access_key="k", secret_access_key="s",
+    )
+    t = pw.io.minio.read(
+        "path", minio_settings=settings, mode="static",
+        format="plaintext_by_object",
+        _client=DirBackedS3(os.fspath(tmp_path)),
+    )
+    cap = pw.internals.graph_runner.GraphRunner().run_tables(t)[0]
+    assert [r for _, r in cap.state.iter_items()] == [("payload",)]
+
+
+def test_pyfilesystem_fake_fs():
+    class Info:
+        def __init__(self, size):
+            self.size = size
+            self.modified = None
+
+    class FakeFS:
+        """Minimal PyFilesystem surface (walk/getinfo/readbytes)."""
+
+        files = {"/docs/a.txt": b"alpha", "/docs/b.txt": b"beta"}
+
+        def walk(self, path):
+            class E:
+                def __init__(self, name):
+                    self.name = name
+
+            yield "/docs", [], [E("a.txt"), E("b.txt")]
+
+        def getinfo(self, path, namespaces=()):
+            return Info(len(self.files[path]))
+
+        def readbytes(self, path):
+            return self.files[path]
+
+    t = pw.io.pyfilesystem.read(FakeFS(), path="/docs", mode="static",
+                                format="plaintext_by_object")
+    cap = pw.internals.graph_runner.GraphRunner().run_tables(t)[0]
+    assert sorted(r for _, r in cap.state.iter_items()) == [("alpha",), ("beta",)]
+
+
+def test_gdrive_read_with_injected_client(tmp_path):
+    (tmp_path / "doc1").write_bytes(b"contents-1")
+    t = pw.io.gdrive.read(
+        "folder-id", _client=DirBackedS3(os.fspath(tmp_path)), mode="static",
+    )
+    cap = pw.internals.graph_runner.GraphRunner().run_tables(t)[0]
+    assert [r for _, r in cap.state.iter_items()] == [(b"contents-1",)]
+
+
+# ---------------------------------------------------------------------------
+# deltalake: real protocol round-trip over pyarrow
+
+
+def test_deltalake_write_read_roundtrip(tmp_path):
+    uri = os.fspath(tmp_path / "dtable")
+    t = pw.debug.table_from_markdown(
+        """
+        word | n
+        foo  | 1
+        bar  | 2
+        """
+    )
+    pw.io.deltalake.write(t, uri, min_commit_frequency=None)
+    pw.run()
+
+    # valid Delta layout: version-0 metaData + a data commit
+    log = sorted(os.listdir(os.path.join(uri, "_delta_log")))
+    assert log[0] == f"{0:020d}.json"
+    v0 = [json.loads(line) for line in open(
+        os.path.join(uri, "_delta_log", log[0])
+    )]
+    assert any("metaData" in a for a in v0) and any("protocol" in a for a in v0)
+    assert len(log) >= 2  # data commit happened
+    parquets = [f for f in os.listdir(uri) if f.endswith(".parquet")]
+    assert parquets
+
+    G.clear()
+    back = pw.io.deltalake.read(uri, mode="static")
+    cap = pw.internals.graph_runner.GraphRunner().run_tables(back)[0]
+    rows = sorted(tuple(r) for _, r in cap.state.iter_items())
+    assert rows == [("bar", 2), ("foo", 1)]
+
+
+def test_deltalake_streaming_source_picks_up_new_versions(tmp_path):
+    from pathway_tpu.io.deltalake import DeltaStreamSource, DeltaTableWriter
+
+    uri = os.fspath(tmp_path / "dstream")
+    writer = DeltaTableWriter(uri, ["w"], None, min_commit_frequency_ms=None)
+
+    class B:
+        def __init__(self, rows, diffs):
+            self.data = {"w": [r[0] for r in rows]}
+            self.diffs = diffs
+
+    writer.add_batch(2, B([("x",), ("y",)], [1, 1]))
+    writer.flush()
+
+    src = DeltaStreamSource(uri, ["w"], poll_interval_s=0)
+    got = []
+    for d in src.poll():
+        got.extend((row, diff) for _, row, diff in d.iter_rows())
+    assert sorted(got) == [(("x",), 1), (("y",), 1)]
+    assert src.poll() == []  # no new versions
+
+    writer.add_batch(4, B([("x",)], [-1]))  # retraction rides diff column
+    writer.flush()
+    src._next_poll = 0.0
+    (d,) = src.poll()
+    assert [(row, diff) for _, row, diff in d.iter_rows()] == [(("x",), -1)]
+    # offset resume: a fresh source seeked past everything sees nothing
+    src2 = DeltaStreamSource(uri, ["w"], poll_interval_s=0)
+    src2.seek(src.offset_state())
+    assert src2.poll() == []
+
+
+def test_deltalake_remove_actions_retract(tmp_path):
+    """DELETE/OPTIMIZE-style `remove` actions drop the file's rows in both
+    static and streaming modes."""
+    from pathway_tpu.io.deltalake import (
+        DeltaStreamSource, DeltaTableWriter, _list_versions, _version_actions,
+    )
+
+    uri = os.fspath(tmp_path / "drm")
+    writer = DeltaTableWriter(uri, ["w"], None, min_commit_frequency_ms=None)
+
+    class B:
+        def __init__(self, rows):
+            self.data = {"w": [r[0] for r in rows]}
+            self.diffs = [1] * len(rows)
+
+    writer.add_batch(2, B([("x",), ("y",)]))
+    writer.flush()
+    src = DeltaStreamSource(uri, ["w"], poll_interval_s=0)
+    assert len(src.poll()) == 1
+
+    # emulate a DELETE: remove the data file via a remove action
+    (added, _) = _version_actions(uri, _list_versions(uri)[-1])
+    writer._commit_actions([{"remove": {"path": added[0], "dataChange": True}}])
+
+    src._next_poll = 0.0
+    (d,) = src.poll()
+    assert sorted((row, diff) for _, row, diff in d.iter_rows()) == [
+        (("x",), -1), (("y",), -1)
+    ]
+    G.clear()
+    back = pw.io.deltalake.read(uri, mode="static")
+    cap = pw.internals.graph_runner.GraphRunner().run_tables(back)[0]
+    assert list(cap.state.iter_items()) == []
+
+
+def test_static_with_metadata_matches_streaming(tmp_path):
+    (tmp_path / "f.txt").write_text("hi")
+    t = pw.io.s3.read(
+        "s3://b/p", _client=DirBackedS3(os.fspath(tmp_path)), mode="static",
+        format="plaintext_by_object", with_metadata=True,
+    )
+    assert t.column_names() == ["data", "_metadata"]
+    cap = pw.internals.graph_runner.GraphRunner().run_tables(t)[0]
+    ((_, row),) = list(cap.state.iter_items())
+    assert row[0] == "hi" and json.loads(row[1])["path"] == "f.txt"
+
+
+def test_scanner_malformed_object_not_redownloaded(tmp_path):
+    (tmp_path / "bad.jsonl").write_text("{not json")
+    client = DirBackedS3(os.fspath(tmp_path))
+    reads = []
+    orig = client.read_object
+    client.read_object = lambda k: (reads.append(k), orig(k))[1]
+    src = ObjectScanSource(
+        client, "json", None, ["word"]
+    )
+    assert _drain(src) == []  # bad object contributes nothing...
+    assert _drain(src) == []
+    assert reads == ["bad.jsonl"]  # ...and is not re-downloaded every poll
+
+
+# ---------------------------------------------------------------------------
+# nats
+
+
+class FakeNats:
+    def __init__(self):
+        self.subs: dict[str, list] = {}
+        self.published: list[tuple[str, bytes]] = []
+        self.closed = False
+
+    def subscribe(self, topic, callback):
+        self.subs.setdefault(topic, []).append(callback)
+
+    def publish(self, topic, payload):
+        self.published.append((topic, payload))
+        for cb in self.subs.get(topic, []):
+            cb(payload)
+
+    def close(self):
+        self.closed = True
+
+
+def test_nats_read_write_roundtrip():
+    fake = FakeNats()
+    t = pw.io.nats.read(
+        "nats://fake:4222", "in.topic",
+        schema=pw.schema_from_types(word=str), _client=fake,
+    )
+    counts = t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+    pw.io.nats.write(counts, "nats://fake:4222", "out.topic", _client=fake)
+
+    def feed():
+        import time
+
+        time.sleep(0.15)
+        fake.publish("in.topic", b"{not json")  # must be dropped, not crash
+        for w in ("foo", "bar", "foo"):
+            fake.publish("in.topic", json.dumps({"word": w}).encode())
+        time.sleep(0.6)
+        pw.request_stop()
+
+    th = threading.Thread(target=feed, daemon=True)
+    th.start()
+    pw.run()
+    th.join()
+
+    out = [json.loads(p) for topic, p in fake.published if topic == "out.topic"]
+    final = {}
+    for msg in out:
+        assert msg["diff"] in (1, -1) and "time" in msg
+        if msg["diff"] == 1:
+            final[msg["word"]] = msg["c"]
+        elif final.get(msg["word"]) == msg["c"]:
+            del final[msg["word"]]
+    assert final == {"foo": 2, "bar": 1}
+    assert fake.closed
+
+
+# ---------------------------------------------------------------------------
+# pubsub / bigquery sinks
+
+
+class FakePublisher:
+    def __init__(self):
+        self.messages = []
+
+    def topic_path(self, project, topic):
+        return f"projects/{project}/topics/{topic}"
+
+    def publish(self, topic_path, data, **attrs):
+        self.messages.append((topic_path, data, attrs))
+
+
+def test_pubsub_write_binary_column():
+    t = pw.debug.table_from_markdown(
+        """
+        payload
+        alpha
+        beta
+        """
+    ).select(payload=pw.apply(lambda s: s.encode(), pw.this.payload))
+    pub = FakePublisher()
+    pw.io.pubsub.write(t, pub, "proj", "top")
+    pw.run()
+    assert sorted(m[1] for m in pub.messages) == [b"alpha", b"beta"]
+    topic, _, attrs = pub.messages[0]
+    assert topic == "projects/proj/topics/top"
+    assert attrs["pathway_diff"] == "1" and "pathway_time" in attrs
+
+
+def test_pubsub_rejects_multicolumn():
+    t = pw.debug.table_from_markdown("a | b\n1 | 2")
+    with pytest.raises(ValueError, match="single-column"):
+        pw.io.pubsub.write(t, FakePublisher(), "p", "t")
+
+
+class FakeBigQuery:
+    def __init__(self):
+        self.rows = []
+
+    def insert_rows_json(self, table_ref, rows):
+        self.rows.extend((table_ref, r) for r in rows)
+        return []
+
+
+def test_bigquery_write():
+    t = pw.debug.table_from_markdown(
+        """
+        word | n
+        foo  | 3
+        """
+    )
+    client = FakeBigQuery()
+    pw.io.bigquery.write(t, "ds", "tbl", _client=client)
+    pw.run()
+    assert len(client.rows) == 1
+    ref, row = client.rows[0]
+    assert ref == "ds.tbl"
+    assert row["word"] == "foo" and row["n"] == 3
+    assert row["diff"] == 1 and "time" in row
+
+
+# ---------------------------------------------------------------------------
+# airbyte
+
+
+class FakeAirbyteRunner:
+    def __init__(self):
+        self.states_seen = []
+        self.round = 0
+
+    def extract(self, state):
+        self.states_seen.append(state)
+        self.round += 1
+        if self.round == 1:
+            return [
+                {"type": "RECORD",
+                 "record": {"stream": "users", "data": {"id": 1, "name": "a"}}},
+                {"type": "RECORD",
+                 "record": {"stream": "other", "data": {"id": 9}}},
+                {"type": "STATE", "state": {"cursor": 17}},
+            ]
+        return [
+            {"type": "RECORD",
+             "record": {"stream": "users", "data": {"id": 2, "name": "b"}}},
+        ]
+
+
+def test_airbyte_records_and_state():
+    runner = FakeAirbyteRunner()
+    t = pw.io.airbyte.read(
+        "cfg.yaml", ["users"], _runner=runner, refresh_interval_ms=0,
+    )
+    src = t._params["build"]()
+    (d,) = src.poll()
+    rows = [json.loads(r[0]) for _, r, _ in d.iter_rows()]
+    assert rows == [{"id": 1, "name": "a"}]  # 'other' stream filtered out
+    src._next_poll = 0.0
+    (d2,) = src.poll()
+    assert [json.loads(r[0]) for _, r, _ in d2.iter_rows()] == [
+        {"id": 2, "name": "b"}
+    ]
+    # the STATE message feeds the next incremental extract
+    assert runner.states_seen == [None, {"cursor": 17}]
+    # offset resume carries the airbyte state
+    assert src.offset_state()["state"] == {"cursor": 17}
