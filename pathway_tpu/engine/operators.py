@@ -121,13 +121,63 @@ class Reindex(Node):
 
 
 class Concat(Node):
-    """concat of same-schema tables with disjoint key sets."""
+    """concat of same-schema tables with disjoint key sets.
 
-    def __init__(self, inputs: list[Node]):
+    Disjointness is *promised* at build time (the universe solver refuses
+    otherwise); the engine still verifies it: a key live on two inputs at
+    once means the promise was false, and silently merged rows would be
+    wrong — raise instead (reference: engine-side key-uniqueness check
+    behind `promise_are_pairwise_disjoint`).
+    """
+
+    # per-input live-key multiplicities backing the disjointness check
+    # (only kept when verifying a promise, not a structural proof)
+    STATE_FIELDS = ("_live",)
+
+    def __init__(self, inputs: list[Node], verify: bool = True):
         super().__init__(inputs, inputs[0].column_names)
+        #: False when the universe solver PROVED disjointness from table
+        #: structure alone — no state, no exchanges, pure passthrough
+        self._verify = verify
+        self._live: list[dict[int, int]] = [{} for _ in inputs] if verify else []
+
+    def has_state(self) -> bool:
+        return self._verify
+
+    def exchange_specs(self):
+        if not self._verify:
+            return [None] * len(self.inputs)
+        # all inputs route by row key so each worker owns a consistent
+        # slice of the liveness state
+        return [("key",)] * len(self.inputs)
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
-        parts = [d.select_columns(self.column_names) for d in ins if d is not None and len(d)]
+        parts = []
+        affected: set[int] = set()
+        for port, d in enumerate(ins):
+            if d is None or not len(d):
+                continue
+            if self._verify:
+                mine = self._live[port]
+                for i in range(len(d)):
+                    k = int(d.keys[i])
+                    c = mine.get(k, 0) + int(d.diffs[i])
+                    if c:
+                        mine[k] = c
+                    else:
+                        mine.pop(k, None)
+                    affected.add(k)
+            parts.append(d.select_columns(self.column_names))
+        # verify only after ALL ports' deltas applied: a key migrating
+        # between inputs within one tick (retract on one port, insert on
+        # another) is disjoint at every tick boundary and must not trip
+        for k in affected:
+            if sum(1 for m in self._live if m.get(k, 0) > 0) > 1:
+                raise ValueError(
+                    f"concat: key {k:#x} is live in more than one input — "
+                    "the universes promised disjoint "
+                    "(promise_are_pairwise_disjoint) actually collide"
+                )
         if not parts:
             return None
         return concat_deltas(parts, self.column_names)

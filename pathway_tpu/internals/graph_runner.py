@@ -258,7 +258,13 @@ class GraphRunner:
                 self._project(n, t, table.column_names())
                 for n, t in zip(inputs, table._inputs)
             ]
-            return self._add(ops.Concat(aligned))
+            # structurally proven disjointness (difference/intersection
+            # shapes) needs no runtime liveness state; promised-only
+            # disjointness is verified by the engine
+            proven = G.solver.query_are_disjoint(
+                *[t._universe for t in table._inputs], structural_only=True
+            )
+            return self._add(ops.Concat(aligned, verify=not proven))
         if kind == "concat_reindex":
             parts = []
             for i, t in enumerate(table._inputs):

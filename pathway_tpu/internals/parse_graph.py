@@ -16,9 +16,11 @@ __all__ = ["G", "ParseGraph", "Universe"]
 
 
 class Universe:
-    """A key-set identity (reference ``internals/universe.py``). Subset links
-    + promised equivalences form the solver (a light union-find version of
-    the reference's SAT-based ``universe_solver.py``)."""
+    """A key-set identity (reference ``internals/universe.py``). Relations
+    (parent subset links, promises, intersection/difference registrations)
+    feed the propositional universe solver
+    (``internals/universe_solver.py``, mirroring the reference's SAT-based
+    ``universe_solver.py``); queries delegate to it."""
 
     _ids = 0
 
@@ -26,55 +28,39 @@ class Universe:
         Universe._ids += 1
         self.uid = Universe._ids
         self.parent = parent  # self ⊆ parent
-
-    def find(self) -> "Universe":
-        root = G.equiv.get(self, self)
-        if root is self:
-            return self
-        top = root.find()
-        G.equiv[self] = top
-        return top
+        if parent is not None:
+            G.solver.register_as_subset(self, parent)
 
     def is_equal(self, other: "Universe") -> bool:
-        return self.find() is other.find()
+        return self is other or G.solver.query_are_equal(self, other)
 
     def is_subset_of(self, other: "Universe") -> bool:
-        seen = set()
-        u: Universe | None = self
-        while u is not None and u not in seen:
-            seen.add(u)
-            if u.is_equal(other):
-                return True
-            nxt = u.find()
-            if nxt is not u and nxt not in seen:
-                u = nxt
-                continue
-            u = u.parent
-        # subset promises
-        for sub, sup in G.subset_promises:
-            if self.is_equal(sub) and sup.is_equal(other):
-                return True
-        return False
+        return self is other or G.solver.query_is_subset(self, other)
+
+    def is_disjoint_from(self, other: "Universe") -> bool:
+        return self is not other and G.solver.query_are_disjoint(self, other)
 
 
 class ParseGraph:
     def __init__(self) -> None:
+        from .universe_solver import UniverseSolver
+
         self.sinks: list[Any] = []  # sink Tables / subscribe nodes
         self.static_tables_cache: dict[Any, Any] = {}
-        self.equiv: dict[Universe, Universe] = {}
-        self.subset_promises: list[tuple[Universe, Universe]] = []
+        self.solver = UniverseSolver()
         self.error_log: list[Any] = []
 
     def clear(self) -> None:
         self.__init__()
 
     def promise_equal(self, a: Universe, b: Universe) -> None:
-        ra, rb = a.find(), b.find()
-        if ra is not rb:
-            self.equiv[ra] = rb
+        self.solver.register_as_equal(a, b, promised=True)
 
     def promise_subset(self, sub: Universe, sup: Universe) -> None:
-        self.subset_promises.append((sub, sup))
+        self.solver.register_as_subset(sub, sup, promised=True)
+
+    def promise_disjoint(self, *universes: Universe) -> None:
+        self.solver.register_as_disjoint(*universes, promised=True)
 
     def add_sink(self, sink: Any) -> None:
         self.sinks.append(sink)

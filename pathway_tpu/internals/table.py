@@ -100,14 +100,22 @@ class Table(TableLike):
 
     def _named_exprs(self, args: tuple, kwargs: dict[str, Any]) -> dict[str, ColumnExpression]:
         out: dict[str, ColumnExpression] = {}
+        from .table_slice import TableSlice
+
+        flat: list[Any] = []
         for arg in args:
+            # a TableSlice unpacks into its (possibly renamed) references
+            flat.extend(arg) if isinstance(arg, TableSlice) else flat.append(arg)
+        for arg in flat:
             arg = self._sub(arg)
             if not isinstance(arg, ColumnReference):
                 raise ValueError(
                     "positional select arguments must be column references; "
                     "use keyword arguments for expressions"
                 )
-            out[arg.name] = arg
+            # RenamedReference (from slice.rename): output name differs from
+            # the referenced column
+            out[arg.name] = getattr(arg, "_source", arg)
         for name, e in kwargs.items():
             out[name] = self._sub(e)
         return out
@@ -268,7 +276,19 @@ class Table(TableLike):
     def concat(self, *others: "Table") -> "Table":
         tables = [self, *others]
         schema = _common_schema(tables)
-        return Table("concat", tables, {}, schema, Universe())
+        universes = [t._universe for t in tables]
+        if not G.solver.query_are_disjoint(*universes):
+            # reference table.py:1334 `_concat`: concat keeps original row
+            # ids, so colliding key sets are refused at build time unless
+            # disjointness is provable or promised
+            raise ValueError(
+                "Table.concat: universes of the concatenated tables might "
+                "collide; use pw.universes.promise_are_pairwise_disjoint "
+                "(or concat_reindex, which reindexes)"
+            )
+        result = Universe()
+        G.solver.register_as_union(result, *universes)
+        return Table("concat", tables, {}, schema, result)
 
     def concat_reindex(self, *others: "Table") -> "Table":
         tables = [self, *others]
@@ -321,23 +341,19 @@ class Table(TableLike):
     def intersect(self, *tables: "Table") -> "Table":
         out = self
         for t in tables:
-            out = Table(
-                "intersect",
-                [out, t],
-                {},
-                self._schema,
-                Universe(parent=self._universe),
+            u = Universe()
+            G.solver.register_as_intersection(
+                u, out._universe, t._universe
             )
+            out = Table("intersect", [out, t], {}, self._schema, u)
         return out
 
     def difference(self, other: "Table") -> "Table":
-        return Table(
-            "difference",
-            [self, other],
-            {},
-            self._schema,
-            Universe(parent=self._universe),
+        u = Universe()
+        G.solver.register_as_difference(
+            u, self._universe, other._universe
         )
+        return Table("difference", [self, other], {}, self._schema, u)
 
     def having(self, *indexers: Any) -> "Table":
         out = self
@@ -443,6 +459,7 @@ class Table(TableLike):
         return self
 
     def promise_universes_are_disjoint(self, other: "Table") -> "Table":
+        G.promise_disjoint(self._universe, other._universe)
         return self
 
     def with_universe_of(self, other: TableLike) -> "Table":
@@ -456,8 +473,16 @@ class Table(TableLike):
 
     # -- misc ---------------------------------------------------------------
 
-    def slice(self, *args, **kwargs):
-        raise NotImplementedError("TableSlice is not implemented yet")
+    @property
+    def slice(self) -> "TableSlice":
+        """A manipulable collection of references to this table's columns
+        (reference table.py:468 / table_slice.py)."""
+        from .table_slice import TableSlice
+
+        return TableSlice(
+            {name: ColumnReference(self, name) for name in self.column_names()},
+            self,
+        )
 
     def sort(self, key: Any = None, instance: Any = None) -> "Table":
         """``prev``/``next`` pointer columns ordering this table by ``key``

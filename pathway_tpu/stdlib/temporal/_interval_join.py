@@ -132,10 +132,14 @@ class IntervalJoinResult:
 
         result = matched.select(**out_of(matched))
 
+        # pad keys are salt-derived from the unmatched side's row keys and
+        # can never collide with the pair-derived match keys
         if self._mode in (JoinMode.LEFT, JoinMode.OUTER):
-            result = result.concat(self._pads(matched, lt, rt, "left", args, kwargs))
+            pads = self._pads(matched, lt, rt, "left", args, kwargs)
+            result = result.promise_universes_are_disjoint(pads).concat(pads)
         if self._mode in (JoinMode.RIGHT, JoinMode.OUTER):
-            result = result.concat(self._pads(matched, lt, rt, "right", args, kwargs))
+            pads = self._pads(matched, lt, rt, "right", args, kwargs)
+            result = result.promise_universes_are_disjoint(pads).concat(pads)
         return result
 
     # -- helpers --------------------------------------------------------
@@ -187,7 +191,13 @@ class IntervalJoinResult:
             exprs[arg.name] = self._pad_expr(arg, unmatched, src, side, lt, rt)
         for name, e in kwargs.items():
             exprs[name] = self._pad_expr(e, unmatched, src, side, lt, rt)
-        return unmatched.select(**exprs)
+        pads = unmatched.select(**exprs)
+        # rekey with a side marker: pad rows keep their source row key
+        # otherwise, so a row unmatched on BOTH sides of a self-join (or of
+        # two tables sharing an ancestor) would collide between the left-pad
+        # and right-pad concat inputs (reference derives distinct pad keys
+        # the same way)
+        return pads.with_id_from(pads.id, f"_pw_{side}_pad")
 
     def _pad_expr(self, e, unmatched, src, side, lt, rt):
         from ...internals.expression import ColumnConstExpression

@@ -27,10 +27,9 @@ def promise_are_equal(*tables: Table) -> None:
 
 
 def promise_are_pairwise_disjoint(*tables: Table) -> None:
-    """Disjointness lets ``concat`` keep original keys safely. The solver
-    only tracks equal/subset relations; disjointness is accepted and relied
-    on by the caller (matching the reference's promise semantics — the
-    engine trusts, and errors at runtime on key collisions)."""
-    for table in tables:
-        table._universe  # touch: all args must be tables
+    """Disjointness lets ``concat`` keep original keys safely. The promise
+    feeds the universe solver (consulted by Table.concat at build time);
+    the engine additionally errors at runtime if colliding keys show up
+    (reference `_concat` + engine key-uniqueness check)."""
+    G.promise_disjoint(*[t._universe for t in tables])
 

@@ -323,6 +323,7 @@ def test_concat_and_update_rows():
         3  | 3
         """
     )
+    pw.universes.promise_are_pairwise_disjoint(t1, t2)
     res = t1.concat(t2)
     expected = T(
         """

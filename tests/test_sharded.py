@@ -108,6 +108,7 @@ def prog_concat_update_rows():
     t1 = T("\n".join(["id | a"] + [f"{i} | {i}" for i in range(1, 20)]))
     t2 = T("\n".join(["id | a"] + [f"{i} | {i}" for i in range(20, 40)]))
     t3 = T("\n".join(["id | a"] + [f"{i} | {i * 10}" for i in range(10, 30)]))
+    pw.universes.promise_are_pairwise_disjoint(t1, t2)
     return t1.concat(t2).update_rows(t3)
 
 

@@ -1,0 +1,227 @@
+"""Universe solver, TableSlice, and concat disjointness enforcement
+(reference ``internals/universe_solver.py`` / ``table_slice.py`` /
+``Table._concat``)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.universe_solver import UniverseSolver
+from pathway_tpu.testing import T, assert_table_equality
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def test_solver_subset_transitivity_and_equality():
+    s = UniverseSolver()
+    a, b, c, d = "A", "B", "C", "D"
+    s.register_as_subset(a, b)
+    s.register_as_subset(b, c)
+    assert s.query_is_subset(a, c)  # transitive closure
+    assert not s.query_is_subset(c, a)
+    s.register_as_equal(c, d)
+    assert s.query_is_subset(a, d)
+    assert s.query_are_equal(c, d)
+    assert not s.query_are_equal(a, c)
+
+
+def test_solver_disjointness_propagates_to_subsets():
+    s = UniverseSolver()
+    s.register_as_disjoint("L", "R")
+    s.register_as_subset("l1", "L")
+    s.register_as_subset("r1", "R")
+    assert s.query_are_disjoint("l1", "r1")  # subsets of disjoint sets
+    assert not s.query_are_disjoint("l1", "L")
+
+
+def test_solver_intersection_difference():
+    s = UniverseSolver()
+    s.register_as_intersection("I", "A", "B")
+    assert s.query_is_subset("I", "A") and s.query_is_subset("I", "B")
+    s.register_as_difference("D", "A", "B")
+    assert s.query_is_subset("D", "A")
+    assert s.query_are_disjoint("D", "B")
+    assert s.query_are_disjoint("D", "I")  # D∩B=∅ and I⊆B
+    s.register_as_union("U", "A", "C")
+    assert s.query_is_subset("A", "U")
+
+
+def test_filter_universe_is_subset_and_usable_in_select():
+    t = T(
+        """
+        a | b
+        1 | 10
+        2 | 20
+        3 | 30
+        """
+    )
+    f = t.filter(pw.this.a > 1)
+    assert f._universe.is_subset_of(t._universe)
+    # a filtered table can reference the parent's columns directly
+    res = f.select(pw.this.a, big=t.b)
+    cap = pw.internals.graph_runner.GraphRunner().run_tables(res)[0]
+    assert sorted(tuple(r) for _, r in cap.state.iter_items()) == [
+        (2, 20), (3, 30),
+    ]
+
+
+def test_concat_requires_disjointness_proof_or_promise():
+    t1 = T("id | a\n1 | 1")
+    t2 = T("id | a\n2 | 2")
+    with pytest.raises(ValueError, match="might collide"):
+        t1.concat(t2)
+    pw.universes.promise_are_pairwise_disjoint(t1, t2)
+    res = t1.concat(t2)
+    assert_table_equality(res, T("id | a\n1 | 1\n2 | 2"))
+
+
+def test_concat_of_difference_and_intersection_is_provably_disjoint():
+    t = T(
+        """
+        id | a
+        1  | 1
+        2  | 2
+        3  | 3
+        """
+    )
+    sub = T(
+        """
+        id | a
+        2  | 20
+        3  | 30
+        """
+    ).promise_universe_is_subset_of(t)
+    inter = t.intersect(sub)
+    diff = t.difference(sub)
+    # no promise needed: difference ∩ intersection = ∅ by construction
+    res = diff.concat(inter)
+    assert_table_equality(res, t)
+
+
+def test_concat_runtime_collision_detection():
+    """A false disjointness promise is caught by the engine, not silently
+    merged."""
+    t1 = T("id | a\n1 | 1\n7 | 7")
+    t2 = T("id | a\n7 | 70")
+    pw.universes.promise_are_pairwise_disjoint(t1, t2)
+    res = t1.concat(t2)
+    with pytest.raises(ValueError, match="live in more than one input"):
+        pw.internals.graph_runner.GraphRunner().run_tables(res)
+
+
+def test_concat_key_migration_within_tick_not_flagged():
+    """A row moving between promised-disjoint partitions delivers -1 on one
+    input and +1 on the other in the same tick — disjoint at every tick
+    boundary, so the runtime check must not trip (either port order)."""
+    from pathway_tpu.engine.delta import Delta, rows_to_columns
+    from pathway_tpu.engine.operators import Concat, StaticSource
+    import numpy as np
+
+    def delta(key, diff):
+        return Delta(
+            keys=np.array([key], dtype=np.uint64),
+            data=rows_to_columns([(1,)], ["a"]),
+            diffs=np.array([diff], dtype=np.int64),
+        )
+
+    src = StaticSource(np.array([], dtype=np.uint64), {"a": np.array([])})
+    node = Concat([src, src])
+    node.process(0, [None, delta(7, 1)])  # key lives on port 1
+    out = node.process(2, [delta(7, 1), delta(7, -1)])  # migrates to port 0
+    assert out is not None and len(out) == 2
+    out = node.process(4, [delta(7, -1), delta(7, 1)])  # and back
+    assert out is not None
+    with pytest.raises(ValueError, match="live in more than one"):
+        node.process(6, [delta(7, 1), None])  # a REAL collision still trips
+
+
+def test_proven_concat_is_stateless_passthrough():
+    """Structurally-proven disjointness (difference ⊔ intersection) skips
+    the runtime liveness state; promised-only keeps it."""
+    from pathway_tpu.engine.operators import Concat
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    t = T("id | a\n1 | 1\n2 | 2")
+    sub = T("id | a\n2 | 20").promise_universe_is_subset_of(t)
+    proven = t.difference(sub).concat(t.intersect(sub))
+    r = GraphRunner()
+    r.lower(proven)
+    proven_nodes = [n for n in r._nodes if isinstance(n, Concat)]
+    assert proven_nodes and all(not n._verify for n in proven_nodes)
+
+    t1 = T("id | a\n1 | 1")
+    t2 = T("id | a\n9 | 9")
+    pw.universes.promise_are_pairwise_disjoint(t1, t2)
+    r2 = GraphRunner()
+    r2.lower(t1.concat(t2))
+    promised_nodes = [n for n in r2._nodes if isinstance(n, Concat)]
+    assert promised_nodes and all(n._verify for n in promised_nodes)
+
+
+def test_self_outer_interval_join_pads_do_not_collide():
+    """Rows unmatched on both sides of a self interval join pad with the
+    same source key — the side-salted pad rekeying keeps the concat
+    disjoint."""
+    t = T(
+        """
+        t | v
+        0 | 10
+        100 | 20
+        """
+    )
+    res = t.interval_join_outer(
+        t, pw.left.t, pw.right.t, pw.temporal.interval(1, 2)
+    ).select(lv=pw.left.v, rv=pw.right.v)
+    cap = pw.internals.graph_runner.GraphRunner().run_tables(res)[0]
+    rows = sorted(
+        (tuple(r) for _, r in cap.state.iter_items()),
+        key=lambda r: (r[0] is None, r),
+    )
+    # every row unmatched: 2 left pads + 2 right pads
+    assert rows == [(10, None), (20, None), (None, 10), (None, 20)]
+
+
+def test_table_slice_surface():
+    t = T(
+        """
+        age | owner | pet
+        10  | Alice | dog
+        9   | Bob   | cat
+        """
+    )
+    s = t.slice
+    assert sorted(s.keys()) == ["age", "owner", "pet"]
+    assert s["age"].name == "age"
+    assert s.owner.name == "owner"
+    renamed = s.without("age").with_suffix("_col")
+    assert sorted(renamed.keys()) == ["owner_col", "pet_col"]
+    with pytest.raises(KeyError):
+        s.without("missing")
+    with pytest.raises(ValueError, match="method name"):
+        s.select  # column named like a Table method
+    # unpacks into select with the slice's names
+    res = t.select(*renamed)
+    assert sorted(res.column_names()) == ["owner_col", "pet_col"]
+    cap = pw.internals.graph_runner.GraphRunner().run_tables(res)[0]
+    names = res.column_names()
+    rows = sorted(
+        tuple(r[names.index(c)] for c in ["owner_col", "pet_col"])
+        for _, r in cap.state.iter_items()
+    )
+    assert rows == [("Alice", "dog"), ("Bob", "cat")]
+
+
+def test_table_slice_rename_dict_and_getitem_list():
+    t = T("a | b\n1 | 2")
+    s = t.slice.rename({"a": "x"})
+    assert sorted(s.keys()) == ["b", "x"]
+    sub = t.slice[["a", "b"]]
+    assert sorted(sub.keys()) == ["a", "b"]
+    assert s.slice is s
