@@ -31,28 +31,43 @@ def main() -> None:
     rng = np.random.default_rng(0)
     docs = rng.standard_normal((n_docs, dim), dtype=np.float32)
     docs /= np.linalg.norm(docs, axis=1, keepdims=True)
-    queries = rng.standard_normal((n_queries, dim), dtype=np.float32)
-    queries /= np.linalg.norm(queries, axis=1, keepdims=True)
 
     import jax.numpy as jnp
 
     d_index = jax.device_put(jnp.asarray(docs))
-    d_queries = jax.device_put(jnp.asarray(queries))
 
-    # compile + warm up
-    s, i = topk_scores(d_queries, d_index, k)
-    jax.block_until_ready((s, i))
-
-    lat = []
+    # Timing discipline for remote/tunneled devices (the axon tunnel):
+    # block_until_ready returns before execution completes and identical
+    # dispatches may be cached, so (a) every iteration gets distinct
+    # queries, (b) K searches are chained into ONE jitted call whose scalar
+    # output is fetched to host (the fetch cannot complete before the
+    # compute), and (c) the measured host<->device roundtrip is subtracted.
     iters = 30 if on_tpu else 10
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        s, i = topk_scores(d_queries, d_index, k)
-        jax.block_until_ready((s, i))
-        lat.append((time.perf_counter() - t0) * 1000.0)
-    p50 = float(np.percentile(lat, 50))
+    roundtrip_ms = _device_roundtrip_ms()
+    q_stack = rng.standard_normal((iters, n_queries, dim), dtype=np.float32)
+    q_stack /= np.linalg.norm(q_stack, axis=2, keepdims=True)
+
+    @jax.jit
+    def knn_chain(qs, index):
+        def one(q):
+            s, ids = topk_scores(q, index, k)
+            return s.sum() + ids.sum().astype(jnp.float32)
+
+        return jnp.sum(jax.lax.map(one, qs))
+
+    d_stack = jax.device_put(jnp.asarray(q_stack))
+    float(jnp.sum(d_stack))  # force the upload before timing
+    float(knn_chain(d_stack, d_index))  # compile + warm up
+    t0 = time.perf_counter()
+    float(knn_chain(d_stack, d_index))
+    wall_ms = (time.perf_counter() - t0) * 1000.0
+    p50 = max(wall_ms - roundtrip_ms, 1e-3) / iters
     qps = n_queries / (p50 / 1000.0)
 
+    roundtrip_ms = _device_roundtrip_ms()
+    embed = _embed_throughput(on_tpu)
+    rag_ingest = _rag_ingest_throughput(on_tpu)
+    rest_p50 = _rest_rag_p50()
     wc_rows_per_sec = _wordcount_throughput()
     wc_rowwise = _wordcount_throughput(rowwise=True)
     join_rows_per_sec = _join_throughput()
@@ -90,9 +105,199 @@ def main() -> None:
             "mesh_exchange_t2_rows_per_sec": (
                 round(mesh_rows_per_sec, 1) if mesh_rows_per_sec else None
             ),
+            # north-star metrics (BASELINE.json): embed throughput + MFU,
+            # RAG ingest rate, end-to-end REST serve latency vs 50 ms
+            "embed_tokens_per_sec": round(embed["tok_per_sec"], 1),
+            "embed_mfu": embed["mfu"],
+            "rag_ingest_docs_per_sec_per_chip": round(rag_ingest, 1),
+            "rest_rag_p50_ms": round(rest_p50, 2),
+            "rest_rag_vs_50ms_target": round(target_ms / rest_p50, 3),
+            # host<->device latency of the test rig's tunneled TPU; each
+            # serve-path request pays ~2 of these (query embed + search),
+            # which co-located hardware would not
+            "device_roundtrip_ms": round(roundtrip_ms, 2),
+            "rest_rag_p50_ms_excl_tunnel": round(
+                max(rest_p50 - 2 * roundtrip_ms, 0.0), 2
+            ),
             "baseline_note": "reference publishes no in-repo numbers (BASELINE.md); 50ms north-star serve target used",
         },
     }))
+
+
+def _device_roundtrip_ms() -> float:
+    """Median host->device->host latency of a trivial computation — the
+    tunnel tax subtracted from chained-compute timings (and reported so
+    serve-path numbers can be read net of it)."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a: jnp.sum(a + 1))
+    x = jax.device_put(np.zeros(8, np.float32))
+    float(f(x))  # compile
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(f(x))
+        samples.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(samples))
+
+
+def _embed_throughput(on_tpu: bool) -> dict:
+    """Embedder tokens/sec + MFU on the MiniLM-class encoder (6L, 384d,
+    bf16 on the MXU). FLOPs are analytic: per token per layer
+    2·d·3d (qkv) + 2·d·d (proj) + 4·d·h (mlp) + 4·s·d (attention), matching
+    the standard transformer accounting. Peak FLOPs for MFU come from
+    PATHWAY_TPU_PEAK_FLOPS (default: 197e12, TPU v5e bf16); MFU is null off
+    TPU where the peak is meaningless."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.embedder import Embedder, embed_tokens
+
+    batch, seq = (256, 128) if on_tpu else (16, 64)
+    emb = Embedder()
+    cfg = emb.cfg
+    rng = np.random.default_rng(11)
+    iters = 20 if on_tpu else 3
+    roundtrip_ms = _device_roundtrip_ms()
+    # K distinct batches chained in ONE jitted call with a scalar output —
+    # see the KNN loop note on tunneled-device timing discipline
+    ids_stack = rng.integers(
+        2, cfg.vocab_size, size=(iters, batch, seq)
+    ).astype(np.int32)
+
+    @jax.jit
+    def chain(params, stack):
+        return jnp.sum(
+            jax.lax.map(lambda ids: embed_tokens(params, ids, cfg).sum(), stack)
+        )
+
+    d_stack = jax.device_put(ids_stack)
+    float(jnp.sum(d_stack))  # force the upload before timing
+    float(chain(emb.params, d_stack))  # compile + warm up
+    t0 = time.perf_counter()
+    float(chain(emb.params, d_stack))
+    elapsed = (time.perf_counter() - t0) - roundtrip_ms / 1000.0
+    elapsed = max(elapsed, 1e-6)
+    tokens = batch * seq * iters
+    d, h, s = cfg.dim, cfg.dim * cfg.mlp_ratio, seq
+    flops_per_token = cfg.n_layers * (2 * d * 3 * d + 2 * d * d + 4 * d * h + 4 * s * d)
+    achieved = tokens * flops_per_token / elapsed
+    peak = float(os.environ.get("PATHWAY_TPU_PEAK_FLOPS", 197e12))
+    return {
+        "tok_per_sec": tokens / elapsed,
+        "mfu": round(achieved / peak, 4) if on_tpu else None,
+    }
+
+
+def _rag_ingest_throughput(on_tpu: bool) -> float:
+    """Documents/sec through the ingest pipeline on one chip: WordPiece-free
+    tokenize -> batched MXU embed -> KNN index add (the DocumentStore build
+    side, BASELINE.json rag_ingest_docs_per_sec_per_chip)."""
+    from pathway_tpu.models.embedder import Embedder
+    from pathway_tpu.ops.index_engines import BruteForceKnnEngine
+
+    n_docs = 4096 if on_tpu else 256
+    docs = [
+        f"document {i} about streaming dataflow engines and tpu kernels "
+        f"with incremental state number {i % 97}" for i in range(n_docs)
+    ]
+    emb = Embedder()
+    engine = BruteForceKnnEngine(emb.cfg.dim, reserved_space=n_docs)
+    emb.embed_texts(docs[:8])  # compile outside the timed region
+    t0 = time.perf_counter()
+    bs = 256
+    for start in range(0, n_docs, bs):
+        chunk = docs[start:start + bs]
+        vecs = emb.embed_texts(chunk)
+        for j, v in enumerate(vecs):
+            engine.add(start + j, v, None)
+    elapsed = time.perf_counter() - t0
+    return n_docs / elapsed
+
+
+def _rest_rag_p50() -> float:
+    """End-to-end serve latency: HTTP request -> rest_connector -> dataflow
+    retrieve (MXU KNN over the document index) -> response, p50 over 40
+    requests — the path the 50 ms north-star target is about (LLM call
+    excluded: it is an external service in the reference too)."""
+    import threading
+    import urllib.request
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.run import request_stop
+    from pathway_tpu.io.http._server import terminate_all
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import BruteForceKnnFactory
+    from pathway_tpu.xpacks.llm.document_store import DocumentStore
+    from pathway_tpu.xpacks.llm.embedders import TpuEmbedder
+    from pathway_tpu.xpacks.llm.servers import DocumentStoreServer
+
+    G.clear()
+    embedder = TpuEmbedder(max_len=32)
+    n_docs = 512
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(data=str, _metadata=dict),
+        [
+            (f"doc {i} on topic {i % 29} covering dataflow shard {i % 7}",
+             {"path": f"d{i}.txt"})
+            for i in range(n_docs)
+        ],
+    )
+    store = DocumentStore(
+        docs,
+        BruteForceKnnFactory(
+            dimensions=embedder.embedder.cfg.dim,
+            # the models.Embedder itself: the engine batches adds through
+            # embed_texts and keeps query embeddings device-resident
+            # (embed->score->top_k, one host roundtrip per request)
+            embedder=embedder.embedder,
+        ),
+    )
+    port = 28431
+    server = DocumentStoreServer("127.0.0.1", port, store)
+    lat: list[float] = []
+    try:
+        server.run(threaded=True)
+        # wait for the webserver to bind + the index build to finish (the
+        # first embed compiles XLA shape buckets)
+        deadline = time.monotonic() + 180
+        while True:
+            try:
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"http://127.0.0.1:{port}/v1/statistics", data=b"{}",
+                        headers={"Content-Type": "application/json"},
+                    ),
+                    timeout=5,
+                ).read()
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
+        for i in range(44):
+            payload = json.dumps({
+                "query": f"dataflow shard topic {i % 13}", "k": 3,
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/retrieve", data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                resp.read()
+            if i >= 4:  # skip warmup (first queries compile shape buckets)
+                lat.append((time.perf_counter() - t0) * 1000.0)
+    finally:
+        request_stop()
+        terminate_all()
+        if server._thread is not None:
+            server._thread.join(timeout=10)
+        G.clear()
+    return float(np.percentile(lat, 50))
 
 
 def _mesh_exchange_throughput(n_rows: int = 100_000, batch: int = 10_000) -> float | None:

@@ -85,6 +85,9 @@ class ExternalIndexNode(Node):
             datas = cols["__data__"]
             # removals before insertions so an in-tick update (retract+insert
             # of the same key) lands in the index as the new value
+            add_keys: list[int] = []
+            add_datas: list[Any] = []
+            add_filts: list[Any] = []
             order = np.argsort(data_d.diffs, kind="stable")
             for i in order:
                 k = int(data_d.keys[i])
@@ -93,9 +96,17 @@ class ExternalIndexNode(Node):
                         self.engine.remove(k)
                 else:
                     for _ in range(int(data_d.diffs[i])):
-                        self.engine.add(
-                            k, datas[i], filt[i] if filt is not None else None
-                        )
+                        add_keys.append(k)
+                        add_datas.append(datas[i])
+                        add_filts.append(filt[i] if filt is not None else None)
+            if add_keys:
+                add_batch = getattr(self.engine, "add_batch", None)
+                if add_batch is not None:
+                    # one batched embed + insert per tick, not per document
+                    add_batch(add_keys, add_datas, add_filts)
+                else:
+                    for k, d, f in zip(add_keys, add_datas, add_filts):
+                        self.engine.add(k, d, f)
             index_changed = True
 
         out_keys: list[int] = []

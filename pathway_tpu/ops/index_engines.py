@@ -112,7 +112,9 @@ class BruteForceKnnEngine:
         if isinstance(data, str):
             if self.embedder is None:
                 raise TypeError("string data requires an embedder")
-            data = self.embedder(data)
+            batch = getattr(self.embedder, "embed_texts", None)
+            # a models.Embedder works directly as the engine embedder
+            data = batch([data])[0] if batch is not None else self.embedder(data)
         v = np.asarray(data, dtype=np.float32).reshape(-1)
         if v.shape[0] != self.dim:
             raise ValueError(f"vector dim {v.shape[0]} != index dim {self.dim}")
@@ -134,6 +136,23 @@ class BruteForceKnnEngine:
         self._valid[slot] = True
         self._slots.meta[slot] = _as_json(filter_data)
         self._dirty = True
+
+    def add_batch(self, keys: list[int], datas: list[Any], filters: list[Any]) -> None:
+        """Bulk insertion: all string payloads of one tick are embedded in a
+        single batched device call (one MXU forward + one roundtrip instead
+        of one per document) — the ingest-path analog of the device-resident
+        query fusion. Called by ExternalIndexNode when available."""
+        batch = getattr(self.embedder, "embed_texts", None)
+        text_ix = [
+            i for i, d in enumerate(datas) if isinstance(d, str)
+        ] if batch is not None else []
+        if text_ix:
+            vecs = batch([datas[i] for i in text_ix])
+            datas = list(datas)
+            for j, i in enumerate(text_ix):
+                datas[i] = np.asarray(vecs[j], dtype=np.float32)
+        for k, d, f in zip(keys, datas, filters):
+            self.add(k, d, f)
 
     def remove(self, key: int) -> None:
         slot = self._slots.release(key)
@@ -158,7 +177,15 @@ class BruteForceKnnEngine:
 
         from .knn import topk_scores
 
-        q = np.stack([self._vec(x) for x in queries])
+        dev_embed = getattr(self.embedder, "embed_texts_device", None)
+        if dev_embed is not None and all(isinstance(x, str) for x in queries):
+            # device-resident query embeddings (already L2-normalized by the
+            # model head) flow straight into the scorer: embed -> score ->
+            # top_k pipelines as queued device work with a single blocking
+            # fetch at _pack time
+            q = dev_embed(list(queries))
+        else:
+            q = np.stack([self._vec(x) for x in queries])
         if self._dirty or self._device is None:
             self._device = jnp.asarray(self._host)
             self._device_valid = jnp.asarray(self._valid)

@@ -57,12 +57,21 @@ class TpuEmbedder(BaseEmbedder):
     micro-batched through a shape-bucketed jitted forward, so streaming
     ingestion still hits the MXU with real batches."""
 
-    def __init__(self, embedder: Any = None, *, max_len: int = 128, **kwargs: Any):
+    def __init__(self, embedder: Any = None, *, model_path: str | None = None,
+                 max_len: int = 128, **kwargs: Any):
+        """``model_path``: local directory with a MiniLM-class HF checkpoint
+        (``pytorch_model.bin`` + ``vocab.txt``) — loads pretrained weights
+        and the real WordPiece tokenizer (``models/embedder.py``
+        ``Embedder.from_pretrained``). Default: deterministic-init encoder
+        (self-contained, no checkpoint needed)."""
         super().__init__(**kwargs)
         if embedder is None:
             from ...models.embedder import Embedder
 
-            embedder = Embedder()
+            if model_path is not None:
+                embedder = Embedder.from_pretrained(model_path)
+            else:
+                embedder = Embedder()
         self.embedder = embedder
         self.max_len = max_len
 

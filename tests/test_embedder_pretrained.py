@@ -1,0 +1,142 @@
+"""Pretrained-embedder path: WordPiece tokenizer parity with
+``transformers.BertTokenizer`` and numerical parity of the BERT-arch JAX
+encoder with ``transformers.BertModel`` over a loaded HF state dict.
+
+Everything runs offline: the HF model is random-initialized from a config
+(no download), its state dict loaded through ``load_hf_state_dict``, and
+the two forwards compared — proving a real MiniLM checkpoint would load
+and reproduce the reference embedder's numbers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pathway_tpu.models.embedder import Embedder, load_hf_state_dict
+from pathway_tpu.models.wordpiece import WordPieceTokenizer
+
+VOCAB = (
+    "[PAD] [UNK] [CLS] [SEP] [MASK] the quick brown fox jump ##s ##ed over "
+    "lazy dog stream process ##ing engine tpu ! , . ' word count hello world"
+).split()
+
+
+def _tokenizer() -> WordPieceTokenizer:
+    return WordPieceTokenizer({t: i for i, t in enumerate(VOCAB)})
+
+
+def test_wordpiece_matches_transformers_bert_tokenizer(tmp_path):
+    transformers = pytest.importorskip("transformers")
+    vocab_file = tmp_path / "vocab.txt"
+    vocab_file.write_text("\n".join(VOCAB) + "\n")
+    theirs = transformers.BertTokenizer(vocab_file=str(vocab_file))
+    ours = WordPieceTokenizer.from_vocab_file(str(vocab_file))
+    cases = [
+        "The quick brown fox jumps over the lazy dog!",
+        "streaming engines process words",        # ##ing / ##s pieces
+        "hello, world.",                           # punctuation splitting
+        "HELLO WoRLD",                             # lowercasing
+        "unknownword the",                         # [UNK] fallback
+        "  spaced\tout\n text ",
+        "café hello",                          # accent stripping
+    ]
+    for text in cases:
+        assert ours.encode(text) == theirs.encode(text), text
+
+
+def test_wordpiece_truncation_and_batch():
+    tok = _tokenizer()
+    ids = tok.encode("the quick brown fox", max_len=4)
+    assert len(ids) == 4 and ids[0] == tok.cls_id and ids[-1] == tok.sep_id
+    batch = tok.encode_batch(["the dog", "hello world jumps"], max_len=8)
+    assert batch.shape == (2, 8)
+    assert batch[0, 0] == tok.cls_id
+    assert (batch[:, -1] == tok.pad_id).all()  # right-padded
+
+
+def _tiny_hf_bert():
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    cfg = transformers.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=48, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(7)
+    model = transformers.BertModel(cfg).eval()
+    # sharpen attention: random-init weights give near-uniform attention,
+    # which would mask a wrong head partition (trained checkpoints have
+    # sharp attention, where the partition matters)
+    with torch.no_grad():
+        for layer in model.encoder.layer:
+            layer.attention.self.query.weight.mul_(4.0)
+            layer.attention.self.key.weight.mul_(4.0)
+    return model
+
+
+def test_bert_arch_matches_transformers_forward():
+    import jax.numpy as jnp
+    import torch
+
+    model = _tiny_hf_bert()
+    emb = Embedder.from_pretrained(
+        model.state_dict(), dtype=jnp.float32, n_heads=4
+    )
+    assert emb.cfg.arch == "bert" and emb.cfg.n_layers == 2
+    rng = np.random.default_rng(3)
+    ids = rng.integers(1, 64, size=(3, 10)).astype(np.int32)
+    ids[0, 7:] = 0  # padding on one row
+    ids[2, 4:] = 0
+
+    with torch.no_grad():
+        theirs = model(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor((ids > 0).astype(np.int64)),
+        ).last_hidden_state.numpy()
+    mask = (ids > 0)[:, :, None]
+    ref_pooled = (theirs * mask).sum(1) / mask.sum(1)
+    ref = ref_pooled / np.linalg.norm(ref_pooled, axis=-1, keepdims=True)
+
+    ours = emb(ids)
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+    # discriminating power: a WRONG head partition must NOT match — this
+    # guards the whole parity claim (review r3: a dim-divisibility guess
+    # passed only because near-uniform attention masked the partition)
+    wrong = Embedder.from_pretrained(
+        model.state_dict(), dtype=jnp.float32, n_heads=1
+    )
+    assert not np.allclose(wrong(ids), ref, atol=2e-4)
+
+    # head count is required for raw state dicts (not derivable from shapes)
+    with pytest.raises(ValueError, match="n_heads"):
+        Embedder.from_pretrained(model.state_dict())
+
+
+def test_from_pretrained_directory_with_vocab(tmp_path):
+    import json
+
+    import torch
+
+    model = _tiny_hf_bert()
+    torch.save(model.state_dict(), tmp_path / "pytorch_model.bin")
+    (tmp_path / "config.json").write_text(
+        json.dumps({"num_attention_heads": 4, "hidden_size": 32})
+    )
+    (tmp_path / "vocab.txt").write_text("\n".join(VOCAB) + "\n")
+    emb = Embedder.from_pretrained(tmp_path)
+    assert emb.cfg.n_heads == 4  # read from config.json
+    assert emb.tokenizer is not None
+    vecs = emb.embed_texts(["the quick fox", "hello world"], max_len=16)
+    assert vecs.shape == (2, 32)
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=1), 1.0, atol=1e-3)
+    # deterministic for identical batch shapes (bf16 kernels may differ
+    # slightly between batch-size compilations; that is expected)
+    again = emb.embed_texts(["the quick fox", "hello world"], max_len=16)
+    np.testing.assert_allclose(vecs, again, atol=1e-6)
+    # a different batch shape still lands within bf16 tolerance
+    solo = emb.embed_texts(["the quick fox"], max_len=16)
+    np.testing.assert_allclose(vecs[0], solo[0], atol=5e-3)
