@@ -1,0 +1,76 @@
+"""pw.viz live-mirror machinery (reference stdlib/viz/plotting.py); the
+Bokeh/Panel render layer is gated, the data path is tested here."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.stdlib.viz import LiveTableSource, plot, show, table_viz
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def test_live_source_mirrors_stream_with_retractions():
+    class S(pw.io.python.ConnectorSubject):
+        def run(self):
+            for w in ("a", "b", "a", "c", "a"):
+                self.next(word=w)
+                self.commit()
+
+    t = pw.io.python.read(
+        S(), schema=pw.schema_from_types(word=str), autocommit_duration_ms=None
+    )
+    counts = t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+    updates = []
+    src = plot(counts, plotting_function=lambda cds: None, sorting_col="word")
+    assert isinstance(src, LiveTableSource)  # no bokeh/panel installed
+    src.on_update(lambda cols: updates.append(cols))
+    pw.run()
+    # final mirror: counts with retractions applied, sorted by word
+    assert src.columns() == {"word": ["a", "b", "c"], "c": [3, 1, 1]}
+    assert len(src) == 3
+    assert updates, "listeners fire on every applied tick"
+    assert updates[-1] == src.columns()
+
+
+def test_table_viz_and_show_gating():
+    t = pw.debug.table_from_markdown("a\n1")
+    src = table_viz(t)
+    assert isinstance(src, LiveTableSource)
+    with pytest.raises(ImportError, match="panel"):
+        show(object())
+    with pytest.raises(ValueError, match="sorting_col"):
+        table_viz(t, sorting_col="missing")
+
+
+def test_live_source_ndarray_cells():
+    """Array-valued cells (embedding columns) survive retraction matching."""
+    import numpy as np
+
+    class S(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k="a", v=np.ones(3))
+            self.commit()
+            self.next(k="a", v=np.zeros(3))  # same key, new array row
+            self.commit()
+
+    t = pw.io.python.read(
+        S(), schema=pw.schema_from_types(k=str, v=np.ndarray),
+        autocommit_duration_ms=None,
+    )
+    latest = t.groupby(pw.this.k).reduce(
+        pw.this.k, v=pw.reducers.latest(pw.this.v)
+    )
+    src = table_viz(latest)
+    pw.run()
+    cols = src.columns()
+    assert cols["k"] == ["a"] and np.allclose(cols["v"][0], 0.0)
