@@ -146,6 +146,10 @@ class Delta:
         diffs = self.diffs.tolist()
         col_lists = [list(c) if c.dtype == object else c.tolist()
                      for c in self.data.values()]
+        if len(diffs) != n:
+            raise ValueError(
+                f"corrupted Delta: {len(diffs)} diffs for {n} keys"
+            )
         for name, col in zip(self.data, col_lists):
             if len(col) != n:
                 # zip() would silently truncate a ragged (corrupted) batch

@@ -75,6 +75,11 @@ class DiskCache(CacheStrategy):
     def __init__(self, name: str | None = None):
         self._name = name
 
+    #: one open shelf per path per process: gdbm holds an exclusive lock, so
+    #: a second wrap() of the same cache (engine restart in-process, two
+    #: UDFs sharing a name) must reuse the handle instead of re-opening
+    _open_stores: dict[str, Any] = {}
+
     def wrap(self, fn: Callable) -> Callable:
         import hashlib
         import os
@@ -84,10 +89,26 @@ class DiskCache(CacheStrategy):
         root = os.environ.get("PATHWAY_PERSISTENT_STORAGE", "/tmp/pathway_tpu_cache")
         os.makedirs(root, exist_ok=True)
         path = os.path.join(root, self._name or fn.__name__)
-        store = shelve.open(path)
+        store = DiskCache._open_stores.get(path)
+        if store is None:
+            store = shelve.open(path)
+            DiskCache._open_stores[path] = store
+
+        # the function identity is part of the key: two UDFs resolving to
+        # the same store path (shared __name__, no explicit cache name)
+        # must never serve each other's results; the line number separates
+        # same-scope lambdas, and is stable across restarts of one source
+        code = getattr(fn, "__code__", None)
+        fn_id = (
+            getattr(fn, "__module__", ""),
+            getattr(fn, "__qualname__", ""),
+            getattr(code, "co_firstlineno", 0),
+        )
 
         def key_of(args):
-            return hashlib.blake2b(pickle.dumps(args), digest_size=16).hexdigest()
+            return hashlib.blake2b(
+                pickle.dumps((fn_id, args)), digest_size=16
+            ).hexdigest()
 
         if asyncio.iscoroutinefunction(fn):
             @functools.wraps(fn)
@@ -95,6 +116,7 @@ class DiskCache(CacheStrategy):
                 k = key_of(args)
                 if k not in store:
                     store[k] = await fn(*args)
+                    store.sync()  # durable without close (process may be killed)
                 return store[k]
 
             return awrapper
@@ -104,6 +126,7 @@ class DiskCache(CacheStrategy):
             k = key_of(args)
             if k not in store:
                 store[k] = fn(*args)
+                store.sync()  # durable without close (process may be killed)
             return store[k]
 
         return wrapper
@@ -322,9 +345,10 @@ def udf(
 
 
 def udf_async(fn: Callable | None = None, **kwargs: Any):
+    kwargs.setdefault("executor", async_executor())  # caller's executor wins
     if fn is None:
-        return lambda f: udf(f, executor=async_executor(), **kwargs)
-    return udf(fn, executor=async_executor(), **kwargs)
+        return lambda f: udf(f, **kwargs)
+    return udf(fn, **kwargs)
 
 
 UDFSync = UDF

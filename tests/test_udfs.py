@@ -1,0 +1,214 @@
+"""UDF machinery: sync/async executors, caching, retries, wrappers
+(reference ``python/pathway/internals/udfs/`` + ``test_udf.py``)."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T, assert_table_equality
+from pathway_tpu.udfs import (
+    DiskCache,
+    ExponentialBackoffRetryStrategy,
+    FixedDelayRetryStrategy,
+    InMemoryCache,
+    async_executor,
+    coerce_async,
+    udf,
+    udf_async,
+    with_cache_strategy,
+    with_capacity,
+    with_retry_strategy,
+    with_timeout,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _col(table, col):
+    cap = pw.internals.graph_runner.GraphRunner().run_tables(table)[0]
+    names = table.column_names()
+    return sorted(r[names.index(col)] for _, r in cap.state.iter_items())
+
+
+def test_sync_udf_decorator():
+    @udf
+    def double(x: int) -> int:
+        return 2 * x
+
+    t = T("a\n1\n2\n3")
+    assert _col(t.select(b=double(pw.this.a)), "b") == [2, 4, 6]
+
+
+def test_async_udf_runs_on_event_loop():
+    calls = []
+
+    @udf_async
+    async def slow_double(x: int) -> int:
+        calls.append(x)
+        await asyncio.sleep(0.01)
+        return 2 * x
+
+    t = T("a\n1\n2\n3")
+    assert _col(t.select(b=slow_double(pw.this.a)), "b") == [2, 4, 6]
+    assert sorted(calls) == [1, 2, 3]
+
+
+def test_async_udf_capacity_limits_concurrency():
+    live = {"now": 0, "max": 0}
+
+    @udf_async(executor=async_executor(capacity=2))
+    async def probe(x: int) -> int:
+        live["now"] += 1
+        live["max"] = max(live["max"], live["now"])
+        await asyncio.sleep(0.03)
+        live["now"] -= 1
+        return x
+
+    t = T("a\n" + "\n".join(str(i) for i in range(6)))
+    assert _col(t.select(b=probe(pw.this.a)), "b") == list(range(6))
+    assert live["max"] <= 2
+
+
+def test_udf_in_memory_cache_dedupes_calls():
+    calls = []
+
+    @udf(cache_strategy=InMemoryCache())
+    def tracked(x: int) -> int:
+        calls.append(x)
+        return x + 10
+
+    t = T("a\n5\n5\n5\n7")
+    assert _col(t.select(b=tracked(pw.this.a)), "b") == [15, 15, 15, 17]
+    assert sorted(calls) == [5, 7]  # one evaluation per distinct argument
+
+
+def test_disk_cache_survives_restart(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_PERSISTENT_STORAGE", str(tmp_path))
+    calls = []
+
+    def make():
+        @udf(cache_strategy=DiskCache(name="f"))
+        def costly(x: int) -> int:
+            calls.append(x)
+            return x * x
+
+        t = T("a\n3\n4")
+        return _col(t.select(b=costly(pw.this.a)), "b")
+
+    assert make() == [9, 16]
+    G.clear()
+    # simulate a process restart: the in-process shelf handle is dropped,
+    # forcing the second run to actually read back from disk
+    for store in DiskCache._open_stores.values():
+        store.close()
+    DiskCache._open_stores.clear()
+    assert make() == [9, 16]
+    assert sorted(calls) == [3, 4]  # second run served from disk
+
+
+def test_disk_cache_shared_path_does_not_cross_contaminate(tmp_path, monkeypatch):
+    """Two different functions landing on the same store file (same name)
+    must not serve each other's cached results."""
+    monkeypatch.setenv("PATHWAY_PERSISTENT_STORAGE", str(tmp_path))
+    cache = DiskCache(name="shared")
+    f = cache.wrap(lambda x: x + 1)
+    g = cache.wrap(lambda x: x * 100)
+    assert f(5) == 6
+    assert g(5) == 500  # not f's cached 6
+
+
+def test_retry_strategy_retries_until_success():
+    attempts = {"n": 0}
+
+    @udf_async(executor=async_executor(
+        retry_strategy=FixedDelayRetryStrategy(max_retries=5, delay_ms=1)
+    ))
+    async def flaky(x: int) -> int:
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise RuntimeError("transient")
+        return x
+
+    t = T("a\n42")
+    assert _col(t.select(b=flaky(pw.this.a)), "b") == [42]
+    assert attempts["n"] == 3
+
+
+def test_retry_strategy_exhaustion_propagates_as_error_row():
+    @udf_async(executor=async_executor(
+        retry_strategy=FixedDelayRetryStrategy(max_retries=2, delay_ms=1)
+    ))
+    async def always_fails(x: int) -> int:
+        raise RuntimeError("permanent")
+
+    t = T("a\n1")
+    res = t.select(b=always_fails(pw.this.a))
+    recovered = res.select(b=pw.fill_error(pw.this.b, -1))
+    assert _col(recovered, "b") == [-1]
+
+
+def test_wrapper_combinators():
+    calls = []
+
+    async def base(x):
+        calls.append(x)
+        await asyncio.sleep(0.001)
+        return x * 3
+
+    fn = with_cache_strategy(
+        with_retry_strategy(
+            with_capacity(with_timeout(base, timeout=5.0), capacity=4),
+            ExponentialBackoffRetryStrategy(max_retries=2),
+        ),
+        InMemoryCache(),
+    )
+
+    async def drive():
+        return [await fn(2), await fn(2), await fn(5)]
+
+    assert asyncio.run(drive()) == [6, 6, 15]
+    assert sorted(calls) == [2, 5]
+
+
+def test_with_timeout_raises():
+    async def sleepy(x):
+        await asyncio.sleep(1.0)
+        return x
+
+    fn = with_timeout(sleepy, timeout=0.02)
+    with pytest.raises(asyncio.TimeoutError):
+        asyncio.run(fn(1))
+
+
+def test_coerce_async_wraps_sync_fn():
+    fn = coerce_async(lambda x: x + 1)
+
+    async def drive():
+        return await fn(41)
+
+    assert asyncio.run(drive()) == 42
+
+
+def test_udf_with_error_values():
+    """A raising sync UDF produces per-row Error values, not a crashed run
+    (reference Value::Error semantics)."""
+    @udf
+    def maybe_fail(x: int) -> int:
+        if x == 2:
+            raise ValueError("bad row")
+        return x * 10
+
+    t = T("a\n1\n2\n3")
+    res = t.select(b=maybe_fail(pw.this.a))
+    recovered = res.select(b=pw.fill_error(pw.this.b, 0))
+    assert _col(recovered, "b") == [0, 10, 30]
