@@ -1366,6 +1366,133 @@ class Deduplicate(Node):
         )
 
 
+class GradualBroadcast(Node):
+    """apx_value column from a moving threshold (gradual_broadcast.rs:65).
+
+    Every key gets a deterministic hash fraction in [0, 1); with threshold
+    (lower, value, upper) the key's apx_value is ``upper`` when
+    frac < (value-lower)/(upper-lower) else ``lower``. As ``value`` sweeps,
+    only keys whose fraction lies in the crossed band flip — the
+    incremental-broadcast property the reference built this operator for
+    (a naive join against the threshold row would retract EVERY key on
+    every threshold change).
+    """
+
+    _SALT = 0x6BCA_57A1_0000_0001
+
+    STATE_FIELDS = ("_keys", "_fracs", "_thr")
+
+    def __init__(self, main: Node, thr: Node, cols: tuple[str, str, str]):
+        super().__init__([main, thr], ["apx_value"])
+        self._cols = cols  # (lower, value, upper) column names on thr input
+        self._keys = np.empty(0, dtype=np.uint64)
+        self._fracs = np.empty(0, dtype=np.float64)
+        self._thr: tuple | None = None  # (lower, value, upper)
+
+    def exchange_specs(self):
+        # single-owner composite (like Iterate): the threshold is one global
+        # row and the apx output re-shards downstream anyway
+        return [("gather",), ("gather",)]
+
+    @staticmethod
+    def _frac_of(keys: np.ndarray) -> np.ndarray:
+        return K.derive(keys, GradualBroadcast._SALT).astype(np.float64) / 2.0**64
+
+    @staticmethod
+    def _fraction(thr: tuple) -> float:
+        lower, value, upper = thr
+        if upper <= lower:
+            return 1.0
+        return min(max((value - lower) / (upper - lower), 0.0), 1.0)
+
+    def _apx(self, fracs: np.ndarray, thr: tuple) -> np.ndarray:
+        lower, _, upper = thr
+        return np.where(fracs < self._fraction(thr), upper, lower)
+
+    def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
+        parts: list[Delta] = []
+        new_thr = self._thr
+        if ins[1] is not None and len(ins[1]):
+            d = ins[1].consolidated()
+            for i in range(len(d)):
+                row = tuple(
+                    float(d.data[c][i]) for c in self._cols
+                )
+                if d.diffs[i] > 0:
+                    new_thr = row
+                elif new_thr == row:
+                    new_thr = None
+
+        if new_thr != self._thr:
+            old, new = self._thr, new_thr
+            if len(self._keys):
+                if old is not None and new is not None:
+                    old_apx = self._apx(self._fracs, old)
+                    new_apx = self._apx(self._fracs, new)
+                    changed = np.flatnonzero(old_apx != new_apx)
+                    if len(changed):
+                        parts.append(Delta(
+                            keys=np.concatenate([self._keys[changed]] * 2),
+                            data={"apx_value": np.concatenate(
+                                [old_apx[changed], new_apx[changed]]
+                            )},
+                            diffs=np.concatenate([
+                                np.full(len(changed), -1, np.int64),
+                                np.full(len(changed), 1, np.int64),
+                            ]),
+                        ))
+                elif old is None and new is not None:
+                    parts.append(Delta(
+                        keys=self._keys,
+                        data={"apx_value": self._apx(self._fracs, new)},
+                    ))
+                elif old is not None and new is None:
+                    parts.append(Delta(
+                        keys=self._keys,
+                        data={"apx_value": self._apx(self._fracs, old)},
+                        diffs=np.full(len(self._keys), -1, np.int64),
+                    ))
+            self._thr = new_thr
+
+        if ins[0] is not None and len(ins[0]):
+            d = ins[0].consolidated()
+            ins_ix = np.flatnonzero(d.diffs > 0)
+            del_ix = np.flatnonzero(d.diffs < 0)
+            # net out same-tick updates of one key: a (retract old row,
+            # insert new row) pair must leave the key tracked with net-zero
+            # apx output — deletions only count keys NOT re-inserted this
+            # tick, and re-inserted keys are not appended twice
+            add_keys = d.keys[ins_ix]
+            gone = d.keys[del_ix]
+            if len(gone):
+                gone = gone[~np.isin(gone, add_keys)]
+            if len(add_keys):
+                fresh = ~np.isin(add_keys, self._keys)
+                add_keys = add_keys[fresh]
+            if len(gone):
+                mask = np.isin(self._keys, gone)
+                if self._thr is not None and mask.any():
+                    parts.append(Delta(
+                        keys=self._keys[mask],
+                        data={"apx_value": self._apx(self._fracs[mask], self._thr)},
+                        diffs=np.full(int(mask.sum()), -1, np.int64),
+                    ))
+                self._keys = self._keys[~mask]
+                self._fracs = self._fracs[~mask]
+            if len(add_keys):
+                add_fracs = self._frac_of(add_keys)
+                self._keys = np.concatenate([self._keys, add_keys])
+                self._fracs = np.concatenate([self._fracs, add_fracs])
+                if self._thr is not None:
+                    parts.append(Delta(
+                        keys=add_keys,
+                        data={"apx_value": self._apx(add_fracs, self._thr)},
+                    ))
+        if not parts:
+            return None
+        return concat_deltas(parts, ["apx_value"]).consolidated()
+
+
 class Capture(Node):
     """Output sink: maintains the consolidated table and the full update
     stream (ConsolidateForOutput, output.rs:27 + capture for debug)."""

@@ -351,6 +351,21 @@ class GraphRunner:
             return self._add(ops.Rowwise(dd, {
                 c: _colref(c) for c in table.column_names()
             }))
+        if kind == "gradual_broadcast":
+            main_t, thr_t = table._inputs
+            main = self.lower(main_t)
+            lower_e, value_e, upper_e = p["cols"]
+            thr_node, env = self._zip_env(thr_t, {
+                "__l": lower_e, "__v": value_e, "__u": upper_e,
+            })
+            thr_rw = self._add(ops.Rowwise(thr_node, {
+                "__l": compile_expr(lower_e, env).fn,
+                "__v": compile_expr(value_e, env).fn,
+                "__u": compile_expr(upper_e, env).fn,
+            }))
+            return self._add(ops.GradualBroadcast(
+                main, thr_rw, ("__l", "__v", "__u")
+            ))
         if kind == "custom":
             # stdlib escape hatch: the table carries its own lowering function
             return p["lower"](self, table)

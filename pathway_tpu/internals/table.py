@@ -444,6 +444,31 @@ class Table(TableLike):
             params["origin_id"] = origin_id
         return Table("flatten", [self], params, schema, Universe())
 
+    def _gradual_broadcast(
+        self, threshold_table: "Table", lower_column: Any, value_column: Any,
+        upper_column: Any,
+    ) -> "Table":
+        """Append an ``apx_value`` column split by a moving threshold
+        (reference table.py:631 over ``gradual_broadcast.rs``): each key
+        deterministically lands on ``lower`` or ``upper`` such that about
+        (value-lower)/(upper-lower) of keys read ``upper``; a threshold
+        move re-emits only the keys whose side flips."""
+        apx = Table(
+            "gradual_broadcast",
+            [self, threshold_table],
+            {
+                "cols": (
+                    self._sub(lower_column), self._sub(value_column),
+                    self._sub(upper_column),
+                )
+            },
+            schema_from_columns({
+                "apx_value": ColumnSchema(name="apx_value", dtype=dt.FLOAT)
+            }),
+            self._universe,
+        )
+        return self + apx
+
     # -- universe promises --------------------------------------------------
 
     def promise_universes_are_equal(self, other: "Table") -> "Table":

@@ -1,5 +1,5 @@
 """``pw.xpacks`` — extension packs (reference python/pathway/xpacks)."""
 
-from . import llm  # noqa: F401
+from . import connectors, llm  # noqa: F401
 
-__all__ = ["llm"]
+__all__ = ["connectors", "llm"]

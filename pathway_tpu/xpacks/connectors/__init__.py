@@ -1,0 +1,6 @@
+"""``pw.xpacks.connectors`` — service connectors beyond ``pw.io``
+(reference ``python/pathway/xpacks/connectors``)."""
+
+from . import sharepoint
+
+__all__ = ["sharepoint"]

@@ -452,3 +452,17 @@ def test_airbyte_records_and_state():
     assert runner.states_seen == [None, {"cursor": 17}]
     # offset resume carries the airbyte state
     assert src.offset_state()["state"] == {"cursor": 17}
+
+
+def test_sharepoint_read_with_injected_client(tmp_path):
+    from pathway_tpu.xpacks.connectors import sharepoint
+
+    (tmp_path / "Shared Documents").mkdir()
+    (tmp_path / "Shared Documents" / "report.bin").write_bytes(b"\x01\x02")
+    t = sharepoint.read(
+        "https://example.sharepoint.com/sites/x", tenant="t", client_id="c",
+        cert_path="p", thumbprint="tp", root_path="Shared Documents",
+        mode="static", _client=DirBackedS3(os.fspath(tmp_path)),
+    )
+    cap = pw.internals.graph_runner.GraphRunner().run_tables(t)[0]
+    assert [r for _, r in cap.state.iter_items()] == [(b"\x01\x02",)]

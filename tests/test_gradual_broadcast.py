@@ -1,0 +1,167 @@
+"""_gradual_broadcast (reference ``gradual_broadcast.rs:65`` +
+``tests/test_gradual_broadcast.py``): a threshold ladder splits keys
+between ``lower`` and ``upper`` apx values proportionally to
+(value-lower)/(upper-lower), and a moving threshold flips only the
+crossed keys."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _rows(table):
+    cap = pw.internals.graph_runner.GraphRunner().run_tables(table)[0]
+    names = table.column_names()
+    return {
+        tuple(r)[names.index("val")]: tuple(r)[names.index("apx_value")]
+        for _, r in cap.state.iter_items()
+    }
+
+
+def _tab(n=200):
+    return T("\n".join(["val"] + [str(10 * (i + 1)) for i in range(n)]))
+
+
+def test_split_fraction_tracks_value():
+    tab = _tab()
+    for value, want in ((20.5, 0.0), (25.5, 0.5), (30.5, 1.0)):
+        G.clear()
+        tab = _tab()
+        thr = T(f"lower | value | upper\n20.5 | {value} | 30.5")
+        ext = tab._gradual_broadcast(thr, thr.lower, thr.value, thr.upper)
+        got = _rows(ext)
+        assert len(got) == 200
+        frac_upper = sum(1 for v in got.values() if v == 30.5) / len(got)
+        assert abs(frac_upper - want) <= 0.1, (value, frac_upper)
+        assert set(got.values()) <= {20.5, 30.5}
+
+
+def test_value_at_lower_gives_no_upper():
+    tab = _tab(50)
+    thr = T("lower | value | upper\n10.0 | 10.0 | 20.0")
+    ext = tab._gradual_broadcast(thr, thr.lower, thr.value, thr.upper)
+    assert set(_rows(ext).values()) == {10.0}
+
+
+def test_monotone_flips_only_crossed_band():
+    """A threshold sweep emits changes ONLY for keys in the crossed band —
+    the whole point of the operator (vs. rejoining the threshold row, which
+    would re-emit every key on every move)."""
+    from pathway_tpu.engine.delta import Delta, rows_to_columns
+    from pathway_tpu.engine.operators import GradualBroadcast, StaticSource
+
+    keys = np.arange(1, 301, dtype=np.uint64) * 7919
+    main = StaticSource(keys, {"x": np.arange(300)})
+    thr_src = StaticSource(np.array([1], dtype=np.uint64), {
+        "__l": np.array([0.0]), "__v": np.array([0.0]), "__u": np.array([1.0]),
+    })
+    node = GradualBroadcast(main, thr_src, ("__l", "__v", "__u"))
+
+    def thr_delta(old_v, new_v):
+        rows, diffs = [], []
+        if old_v is not None:
+            rows.append((0.0, old_v, 1.0))
+            diffs.append(-1)
+        rows.append((0.0, new_v, 1.0))
+        diffs.append(1)
+        return Delta(
+            keys=np.array([1] * len(rows), dtype=np.uint64),
+            data=rows_to_columns(rows, ["__l", "__v", "__u"]),
+            diffs=np.array(diffs, dtype=np.int64),
+        )
+
+    main_delta = Delta(keys=keys, data={"x": np.arange(300)})
+    out0 = node.process(0, [main_delta, thr_delta(None, 0.3)])
+    ups0 = sum(1 for _, r, d in out0.iter_rows() if d > 0 and r[0] == 1.0)
+    assert abs(ups0 / 300 - 0.3) < 0.1
+
+    # sweep 0.3 -> 0.5: only the band's keys change
+    out1 = node.process(2, [None, thr_delta(0.3, 0.5)])
+    changes = list(out1.iter_rows())
+    n_flipped = sum(1 for _, r, d in changes if d > 0)
+    assert 0 < n_flipped < 120  # ~20% of 300, not all 300
+    assert all(r[0] in (0.0, 1.0) for _, r, _ in changes)
+    ups_total = ups0 + sum(
+        (1 if d > 0 else -1) for _, r, d in changes if r[0] == 1.0
+    )
+    assert abs(ups_total / 300 - 0.5) < 0.1
+
+    # sweep back down retracts exactly the same band
+    out2 = node.process(4, [None, thr_delta(0.5, 0.3)])
+    back = sum(1 for _, r, d in out2.iter_rows() if d > 0 and r[0] == 0.0)
+    assert back == n_flipped
+
+
+def test_same_tick_row_update_keeps_key_tracked():
+    """(retract old row, insert new row) of one key in one tick must net to
+    zero apx output and keep the key in operator state (review r3)."""
+    from pathway_tpu.engine.delta import Delta, rows_to_columns
+    from pathway_tpu.engine.operators import GradualBroadcast, StaticSource
+
+    main = StaticSource(np.array([], dtype=np.uint64), {"x": np.array([])})
+    thr_src = StaticSource(np.array([1], dtype=np.uint64), {
+        "__l": np.array([0.0]), "__v": np.array([1.0]), "__u": np.array([1.0]),
+    })
+    node = GradualBroadcast(main, thr_src, ("__l", "__v", "__u"))
+    thr = Delta(
+        keys=np.array([1], dtype=np.uint64),
+        data=rows_to_columns([(0.0, 1.0, 1.0)], ["__l", "__v", "__u"]),
+    )
+    node.process(0, [None, thr])
+    node.process(2, [Delta(keys=np.array([55], dtype=np.uint64),
+                           data={"x": np.array([1])}), None])
+    update = Delta(
+        keys=np.array([55, 55], dtype=np.uint64),
+        data={"x": np.array([1, 2])},
+        diffs=np.array([-1, 1], dtype=np.int64),
+    )
+    out = node.process(4, [update, None])
+    assert out is None or len(out) == 0  # net zero: apx row unchanged
+    assert list(node._keys) == [55]  # key still tracked
+    # and it still participates in later threshold sweeps
+    move = Delta(
+        keys=np.array([1, 1], dtype=np.uint64),
+        data=rows_to_columns(
+            [(0.0, 1.0, 1.0), (0.0, 0.0, 1.0)], ["__l", "__v", "__u"]
+        ),
+        diffs=np.array([-1, 1], dtype=np.int64),
+    )
+    out2 = node.process(6, [None, move])
+    assert out2 is not None and len(out2) == 2  # flips upper -> lower
+
+
+def test_key_insert_and_retract_under_threshold():
+    from pathway_tpu.engine.delta import Delta, rows_to_columns
+    from pathway_tpu.engine.operators import GradualBroadcast, StaticSource
+
+    main = StaticSource(np.array([], dtype=np.uint64), {"x": np.array([])})
+    thr_src = StaticSource(np.array([1], dtype=np.uint64), {
+        "__l": np.array([0.0]), "__v": np.array([1.0]), "__u": np.array([1.0]),
+    })
+    node = GradualBroadcast(main, thr_src, ("__l", "__v", "__u"))
+    thr = Delta(
+        keys=np.array([1], dtype=np.uint64),
+        data=rows_to_columns([(0.0, 1.0, 1.0)], ["__l", "__v", "__u"]),
+    )
+    node.process(0, [None, thr])
+    add = Delta(keys=np.array([55], dtype=np.uint64), data={"x": np.array([1])})
+    (row,) = list(node.process(2, [add, None]).iter_rows())
+    assert row[1] == (1.0,) and row[2] == 1  # value==upper -> all upper
+    drop = Delta(
+        keys=np.array([55], dtype=np.uint64), data={"x": np.array([1])},
+        diffs=np.array([-1], dtype=np.int64),
+    )
+    (row,) = list(node.process(4, [drop, None]).iter_rows())
+    assert row[2] == -1
