@@ -1,0 +1,215 @@
+"""Exact update-stream semantics over artificial timed streams — the
+reference's core streaming test idiom (``__time__``/``__diff__`` markdown
+tables + update-stream assertions, ``tests/test_streaming_test_utils.py``):
+not just final states, but the precise retract/insert sequence each
+operator emits per logical time."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _stream(table):
+    """[(time, row_tuple, diff)] — times in order; entries within one time
+    sorted (retractions first, then by row) since within-tick emission
+    order is unspecified."""
+    cap = GraphRunner().run_tables(table)[0]
+    entries = [(t, row, d) for (t, _key, row, d) in cap.stream]
+    return sorted(entries, key=lambda e: (e[0], e[2], str(e[1])))
+
+
+def test_groupby_count_ladder():
+    """Each arrival retracts the previous count and inserts the next —
+    differential reduce semantics, never an in-place overwrite."""
+    t = T(
+        """
+        w | __time__
+        a | 2
+        a | 4
+        a | 6
+        """
+    )
+    counts = t.groupby(pw.this.w).reduce(pw.this.w, c=pw.reducers.count())
+    assert _stream(counts) == [
+        (2, ("a", 1), 1),
+        (4, ("a", 1), -1), (4, ("a", 2), 1),
+        (6, ("a", 2), -1), (6, ("a", 3), 1),
+    ]
+
+
+def test_retraction_cancels_group():
+    t = T(
+        """
+        w | __time__ | __diff__
+        a | 2        | 1
+        a | 4        | -1
+        """
+    )
+    counts = t.groupby(pw.this.w).reduce(pw.this.w, c=pw.reducers.count())
+    assert _stream(counts) == [
+        (2, ("a", 1), 1),
+        (4, ("a", 1), -1),  # group vanishes entirely, no 0-count row
+    ]
+
+
+def test_min_recovers_previous_on_retraction():
+    """Non-semigroup reducer keeps the multiset: retracting the current
+    minimum resurfaces the runner-up, not a recomputation artifact."""
+    t = T(
+        """
+        w | v | __time__ | __diff__
+        a | 5 | 2        | 1
+        a | 3 | 4        | 1
+        a | 3 | 6        | -1
+        """
+    )
+    m = t.groupby(pw.this.w).reduce(pw.this.w, m=pw.reducers.min(pw.this.v))
+    assert _stream(m) == [
+        (2, ("a", 5), 1),
+        (4, ("a", 5), -1), (4, ("a", 3), 1),
+        (6, ("a", 3), -1), (6, ("a", 5), 1),
+    ]
+
+
+def test_update_rows_override_then_release():
+    """update_rows: the override wins while live; retracting it falls back
+    to the base row (reference UpdateRowsContext)."""
+    base = T("id | x\n1 | 10")
+    over = T(
+        """
+        id | x | __time__ | __diff__
+        1  | 99 | 4       | 1
+        1  | 99 | 6       | -1
+        """
+    )
+    res = base.update_rows(over)
+    assert _stream(res) == [
+        (0, (10,), 1),
+        (4, (10,), -1), (4, (99,), 1),
+        (6, (99,), -1), (6, (10,), 1),
+    ]
+
+
+def test_join_emits_pairs_as_sides_arrive():
+    left = T(
+        """
+        k | v | __time__
+        1 | a | 2
+        1 | b | 6
+        """
+    )
+    right = T(
+        """
+        k | w | __time__
+        1 | X | 4
+        """
+    )
+    j = left.join(right, left.k == right.k).select(pw.left.v, pw.right.w)
+    assert _stream(j) == [
+        (4, ("a", "X"), 1),  # right arrival matches existing left
+        (6, ("b", "X"), 1),  # later left arrival matches standing right
+    ]
+
+
+def test_left_join_pad_retracted_on_first_match():
+    left = T("k | v\n1 | a")
+    right = T(
+        """
+        k | w | __time__
+        1 | X | 4
+        """
+    )
+    j = left.join_left(right, left.k == right.k).select(pw.left.v, pw.right.w)
+    assert _stream(j) == [
+        (0, ("a", None), 1),            # unmatched: padded immediately
+        (4, ("a", None), -1), (4, ("a", "X"), 1),  # match replaces the pad
+    ]
+
+
+def test_deduplicate_accepts_in_time_order():
+    t = T(
+        """
+        v | __time__
+        3 | 2
+        1 | 4
+        7 | 6
+        5 | 8
+        """
+    )
+    d = t.deduplicate(value=pw.this.v, acceptor=lambda new, old: new > old)
+    assert _stream(d) == [
+        (2, (3,), 1),
+        (6, (3,), -1), (6, (7,), 1),  # 1 rejected; 7 accepted; 5 rejected
+    ]
+
+
+def test_iterate_reconverges_on_new_input():
+    t = T(
+        """
+        a | __time__
+        3 | 2
+        50 | 4
+        """
+    )
+
+    def double_small(t):
+        return t.select(a=pw.if_else(t.a < 100, t.a * 2, t.a))
+
+    res = pw.iterate(double_small, t=t)
+    assert _stream(res) == [
+        (2, (192,), 1),   # 3 -> 192 (first fixpoint)
+        (4, (100,), 1),   # 50 -> 100 joins; 192 already stable
+    ]
+
+
+def test_tumbling_window_updates_as_rows_arrive():
+    t = T(
+        """
+        t | v | __time__
+        1 | 10 | 2
+        2 | 20 | 4
+        12 | 5 | 4
+        """
+    )
+    w = t.windowby(pw.this.t, window=pw.temporal.tumbling(duration=10)).reduce(
+        start=pw.this._pw_window_start, s=pw.reducers.sum(pw.this.v)
+    )
+    assert _stream(w) == [
+        (2, (0, 10), 1),
+        (4, (0, 10), -1), (4, (0, 30), 1),  # same window grows
+        (4, (10, 5), 1),                     # new window opens
+    ]
+
+
+def test_intersect_difference_track_membership_changes():
+    base = T("id | x\n1 | 10\n2 | 20")
+    member = T(
+        """
+        id | y | __time__ | __diff__
+        1  | 0 | 4        | 1
+        1  | 0 | 6        | -1
+        """
+    )
+    inter = base.intersect(member)
+    diff = base.difference(member)
+    assert _stream(inter) == [
+        (4, (10,), 1),
+        (6, (10,), -1),
+    ]
+    assert _stream(diff) == [
+        (0, (10,), 1), (0, (20,), 1),
+        (4, (10,), -1),
+        (6, (10,), 1),
+    ]
