@@ -693,7 +693,8 @@ class _SortedSide:
     def probe(self, qjks: np.ndarray):
         """Yield (q_idx, row_keys, col_arrays, counts) for every state row
         matching each query jk, per run — the vectorized pair enumeration."""
-        for jks_s, keys, cols, counts in self._runs:
+        for run in self._runs:
+            jks_s, keys, cols, counts = run[0], run[1], run[2], run[3]
             lo = np.searchsorted(jks_s, qjks, "left")
             hi = np.searchsorted(jks_s, qjks, "right")
             m = hi - lo
@@ -705,6 +706,21 @@ class _SortedSide:
                 np.arange(total) - np.repeat(np.cumsum(m) - m, m)
             )
             yield q_idx, keys[side_idx], [c[side_idx] for c in cols], counts[side_idx]
+
+    def totals(self, qjks: np.ndarray) -> np.ndarray:
+        """Total row multiplicity per query jk (the match-count vector the
+        pad bookkeeping needs) — searchsorted over a per-run prefix sum,
+        cached on the (immutable-between-compactions) run."""
+        out = np.zeros(len(qjks), dtype=np.int64)
+        for run in self._runs:
+            jks_s, counts = run[0], run[3]
+            if len(run) == 4:  # lazily attach the prefix sum to the run
+                run.append(np.concatenate([[0], np.cumsum(counts)]))
+            csum = run[4]
+            lo = np.searchsorted(jks_s, qjks, "left")
+            hi = np.searchsorted(jks_s, qjks, "right")
+            out += csum[hi] - csum[lo]
+        return out
 
 
 class Join(Node):
@@ -747,14 +763,19 @@ class Join(Node):
         self._key_mode = key_mode
         self._emit_matched = emit_matched
         self._react_to_right = react_to_right
-        self._columnar = mode == "inner"
+        # asof_now (react_to_right=False) OUTER modes keep the row-at-a-time
+        # path: their pads deliberately do NOT react to later right changes,
+        # which the columnar pad bookkeeping is built to do. Inner joins are
+        # always columnar (the react_to_right guard in the matched algebra
+        # covers asof_now, and inner has no pads).
+        self._columnar = react_to_right or mode == "inner"
         if self._columnar:
             self._cleft = _SortedSide(len(left_cols))
             self._cright = _SortedSide(len(right_cols))
         else:
             self._left = MultiIndex(left_cols)
             self._right = MultiIndex(right_cols)
-        # row_key -> current pad multiplicity (for outer sides)
+        # row_key -> current pad multiplicity (row path only)
         self._lpad: dict[int, int] = {}
         self._rpad: dict[int, int] = {}
 
@@ -826,6 +847,24 @@ class Join(Node):
         left = self._unpack(ins[0], self._ljk, self._lcols)
         right = self._unpack(ins[1], self._rjk, self._rcols)
         parts: list[Delta] = []
+        # pad bookkeeping is fully recomputable from the arrangements:
+        # snapshot each padded side's current pads at the affected jks
+        # BEFORE the deltas apply; after applying, emit (new pads) −
+        # (old pads) — the final consolidation nets every unchanged pad
+        # away, so only genuine 0↔nonzero match transitions surface
+        affected_l = affected_r = None
+        if self._mode in ("left", "outer"):
+            affected_l = self._affected_jks(left, right)
+            if affected_l is not None:
+                self._emit_pads(
+                    parts, affected_l, self._cleft, self._cright, "left", -1
+                )
+        if self._mode in ("right", "outer"):
+            affected_r = self._affected_jks(right, left)
+            if affected_r is not None:
+                self._emit_pads(
+                    parts, affected_r, self._cright, self._cleft, "right", -1
+                )
 
         def emit(lk, rk, lcols, rcols, diffs):
             data = {}
@@ -859,9 +898,60 @@ class Join(Node):
         # apply dL
         if left is not None:
             self._cleft.apply(*left)
+        # post-apply pad snapshots: (new pads) + the pre-apply (− old pads)
+        # already in `parts` net to exactly the pad transitions
+        if affected_l is not None:
+            self._emit_pads(
+                parts, affected_l, self._cleft, self._cright, "left", 1
+            )
+        if affected_r is not None:
+            self._emit_pads(
+                parts, affected_r, self._cright, self._cleft, "right", 1
+            )
         if not parts:
             return None
         return concat_deltas(parts, self.column_names).consolidated()
+
+    @staticmethod
+    def _affected_jks(this, other) -> np.ndarray | None:
+        """jks whose pads may change this tick: any jk touched by either
+        side's delta."""
+        pieces = [t[0] for t in (this, other) if t is not None]
+        if not pieces:
+            return None
+        jks = np.unique(np.concatenate(pieces))
+        return jks if len(jks) else None
+
+    def _emit_pads(self, parts, jks: np.ndarray, this_arr: _SortedSide,
+                   other_arr: _SortedSide, side: str, sign: int) -> None:
+        """Append ``sign`` × (current pads of ``this`` side at ``jks``):
+        rows at jks with zero other-side multiplicity, null-padded.
+        Everything is arrangement probes — no per-row python, no pad
+        ledger state (the pre/post pair plus consolidation replaces it)."""
+        tot = other_arr.totals(jks)
+        zjks = jks[tot == 0]
+        if not len(zjks):
+            return
+        n_other = len(self._rcols) if side == "left" else len(self._lcols)
+        for _qi, rks, cols, counts in this_arr.probe(zjks):
+            src = np.asarray(rks, dtype=np.uint64)
+            if self._key_mode == "pair":
+                salt = _PAD_SALT if side == "left" else (_PAD_SALT ^ 0xF)
+                keys = K.derive(src, salt)
+            else:
+                keys = src
+            none_col = np.empty(len(src), dtype=object)
+            none_col[:] = None
+            this_cols = [np.asarray(c) for c in cols]
+            pad_cols = [none_col] * n_other
+            ordered = (
+                this_cols + pad_cols if side == "left" else pad_cols + this_cols
+            )
+            parts.append(Delta(
+                keys=keys,
+                data=dict(zip(self.column_names, ordered)),
+                diffs=np.asarray(counts, dtype=np.int64) * sign,
+            ))
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         if self._columnar:
