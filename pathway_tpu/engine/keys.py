@@ -28,6 +28,8 @@ __all__ = [
     "pointer_from_ints",
     "derive",
     "derive_pair",
+    "derive_scalar",
+    "derive_pair_scalar",
     "ref_scalar",
 ]
 
@@ -176,6 +178,33 @@ def derive_pair(left: KeyArray, right: KeyArray) -> KeyArray:
     """Key for a joined row from the two source row keys."""
     with np.errstate(over="ignore"):
         return _splitmix(_splitmix(left) ^ (right * _GOLDEN))
+
+
+# -- scalar fast paths (bit-identical to the vectorized forms above) --------
+# per-row compute functions (asof/session-window recompute, join row path)
+# derive one key at a time; building a 1-element ndarray per call costs ~10x
+# the mix itself, so these run the same splitmix in plain int arithmetic.
+
+_M64 = (1 << 64) - 1
+# single source of truth: int views of the vectorized constants
+_GOLDEN_I = int(_GOLDEN)
+_MIX1_I = int(_MIX1)
+_MIX2_I = int(_MIX2)
+
+
+def _splitmix_int(x: int) -> int:
+    x = (x + _GOLDEN_I) & _M64
+    x = ((x ^ (x >> 30)) * _MIX1_I) & _M64
+    x = ((x ^ (x >> 27)) * _MIX2_I) & _M64
+    return x ^ (x >> 31)
+
+
+def derive_scalar(key: int, salt: int) -> int:
+    return _splitmix_int(key ^ _splitmix_int(salt))
+
+
+def derive_pair_scalar(left: int, right: int) -> int:
+    return _splitmix_int(_splitmix_int(left) ^ ((right * _GOLDEN_I) & _M64))
 
 
 def ref_scalar(*values: Any, salt: int = 0) -> int:

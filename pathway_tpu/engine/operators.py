@@ -644,16 +644,23 @@ class _SortedSide:
     def __len__(self) -> int:
         return sum(len(r[0]) for r in self._runs)
 
+    @staticmethod
+    def _make_run(jks, keys, cols, counts) -> list:
+        """Runs are immutable after construction: [jks, keys, cols, counts,
+        count-prefix-sum] — the prefix sum backs O(log N) totals()."""
+        return [jks, keys, cols, counts,
+                np.concatenate([[0], np.cumsum(counts)])]
+
     def apply(self, jks, keys, cols, diffs) -> None:
         if not len(jks):
             return
         order = np.argsort(jks, kind="stable")
-        self._runs.append([
+        self._runs.append(self._make_run(
             jks[order],
             keys[order],
             [np.asarray(c)[order] for c in cols],
             diffs[order].astype(np.int64),
-        ])
+        ))
         if len(self._runs) > self.MAX_RUNS:
             self._compact()
 
@@ -680,12 +687,12 @@ class _SortedSide:
         cols = [c[reps] for c in cols]
         order2 = np.argsort(jks, kind="stable")
         self._runs = (
-            [[
+            [self._make_run(
                 jks[order2],
                 keys[order2],
                 [c[order2] for c in cols],
                 counts[order2],
-            ]]
+            )]
             if len(jks)
             else []
         )
@@ -693,8 +700,7 @@ class _SortedSide:
     def probe(self, qjks: np.ndarray):
         """Yield (q_idx, row_keys, col_arrays, counts) for every state row
         matching each query jk, per run — the vectorized pair enumeration."""
-        for run in self._runs:
-            jks_s, keys, cols, counts = run[0], run[1], run[2], run[3]
+        for jks_s, keys, cols, counts, _csum in self._runs:
             lo = np.searchsorted(jks_s, qjks, "left")
             hi = np.searchsorted(jks_s, qjks, "right")
             m = hi - lo
@@ -712,11 +718,7 @@ class _SortedSide:
         pad bookkeeping needs) — searchsorted over a per-run prefix sum,
         cached on the (immutable-between-compactions) run."""
         out = np.zeros(len(qjks), dtype=np.int64)
-        for run in self._runs:
-            jks_s, counts = run[0], run[3]
-            if len(run) == 4:  # lazily attach the prefix sum to the run
-                run.append(np.concatenate([[0], np.cumsum(counts)]))
-            csum = run[4]
+        for jks_s, _keys, _cols, _counts, csum in self._runs:
             lo = np.searchsorted(jks_s, qjks, "left")
             hi = np.searchsorted(jks_s, qjks, "right")
             out += csum[hi] - csum[lo]
@@ -731,9 +733,12 @@ class Join(Node):
     which equals d(L ⋈ R). Outer modes additionally maintain match counts per
     row and emit/retract null-padded rows on 0↔nonzero transitions.
 
-    Inner joins run fully columnar over ``_SortedSide`` arrangements (no
-    per-row Python); outer modes keep the row-at-a-time path for the pad
-    bookkeeping.
+    All reactive modes run fully columnar over ``_SortedSide`` arrangements
+    (no per-row Python); outer pads are recomputed from arrangement probes
+    before/after the tick's deltas apply, with consolidation netting the
+    unchanged ones. Only asof_now (react_to_right=False) outer modes keep
+    the row-at-a-time path — their pads intentionally ignore later
+    right-side changes.
 
     key_mode: 'pair' (result id from both row ids — default joins),
     'left' (keep left row id — backs ``.ix`` / ``id_from=left``), 'right'.
@@ -794,7 +799,7 @@ class Join(Node):
             return lk
         if self._key_mode == "right":
             return rk
-        return int(K.derive_pair(np.array([lk], dtype=np.uint64), np.array([rk], dtype=np.uint64))[0])
+        return K.derive_pair_scalar(lk, rk)
 
     def _emit(self, out, lk, rk, lrow, rrow, diff):
         out[0].append(self._out_key(lk, rk))
@@ -802,13 +807,13 @@ class Join(Node):
         out[2].append(diff)
 
     def _pad_left(self, out, lk, lrow, diff):
-        key = int(K.derive(np.array([lk], dtype=np.uint64), _PAD_SALT)[0]) if self._key_mode == "pair" else lk
+        key = K.derive_scalar(lk, _PAD_SALT) if self._key_mode == "pair" else lk
         out[0].append(key)
         out[1].append(tuple(lrow) + (None,) * len(self._rcols))
         out[2].append(diff)
 
     def _pad_right(self, out, rk, rrow, diff):
-        key = int(K.derive(np.array([rk], dtype=np.uint64), _PAD_SALT ^ 0xF)[0]) if self._key_mode == "pair" else rk
+        key = K.derive_scalar(rk, _PAD_SALT ^ 0xF) if self._key_mode == "pair" else rk
         out[0].append(key)
         out[1].append((None,) * len(self._lcols) + tuple(rrow))
         out[2].append(diff)

@@ -121,13 +121,11 @@ class AsofJoinResult:
                             if mode == JoinMode.INNER:
                                 continue
                             rpart = (None,) * (n_r + 1)
-                            okey = int(K.derive(np.array([lrk], np.uint64), 0xA50F)[0])
+                            okey = K.derive_scalar(lrk, 0xA50F)
                         else:
                             rrk, rrow = match
                             rpart = rrow[: n_r + 1]
-                            okey = int(K.derive_pair(
-                                np.array([lrk], np.uint64), np.array([rrk], np.uint64)
-                            )[0])
+                            okey = K.derive_pair_scalar(lrk, rrk)
                         out.append((okey, lrow[: n_l + 1] + rpart))
                     return out
 

@@ -185,7 +185,7 @@ class SessionWindow(Window):
                         extra = (start, end)
                         if has_instance:
                             extra = extra + (row[i_ix],)
-                        out.append((int(K.derive(np.array([rk], np.uint64), 0x5E55)[0]), base + extra))
+                        out.append((K.derive_scalar(rk, 0x5E55), base + extra))
                     cluster.clear()
 
                 for rk, row in entries:
