@@ -15,7 +15,41 @@ import time
 import numpy as np
 
 
+def _probe_backend() -> None:
+    """The tunneled TPU backend can wedge client init indefinitely (observed:
+    make_c_api_client hanging). Probe device init in a subprocess with a
+    timeout; if it hangs, fall back to the CPU platform so the bench still
+    reports numbers instead of hanging the driver."""
+    import os
+    import subprocess
+    import sys
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        from pathway_tpu.utils.jaxcfg import guard_cpu_platform
+
+        guard_cpu_platform()
+        return
+    if os.environ.get("PATHWAY_BENCH_SKIP_PROBE"):
+        return  # operator opt-out: skip the ~backend-init-cost health probe
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=180, check=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+        print(
+            "bench: accelerator backend init hung/failed; falling back to cpu",
+            file=sys.stderr,
+        )
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        from pathway_tpu.utils.jaxcfg import guard_cpu_platform
+
+        guard_cpu_platform()
+
+
 def main() -> None:
+    _probe_backend()
     import jax
 
     platform = jax.default_backend()

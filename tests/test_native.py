@@ -77,18 +77,3 @@ def test_native_speedup_on_string_rows():
     # native should be dramatically faster; 3x is a conservative floor
     assert t_native * 3 < t_py, (t_native, t_py)
 
-
-def test_scalar_derivations_bit_identical_to_vectorized():
-    """derive_scalar/derive_pair_scalar (plain-int splitmix) must match the
-    numpy-vectorized derive/derive_pair bit for bit — per-row compute
-    functions and columnar operators share one keyspace."""
-    rng = np.random.default_rng(11)
-    ks = rng.integers(0, 2**64, 300, dtype=np.uint64)
-    rs = rng.integers(0, 2**64, 300, dtype=np.uint64)
-    for salt in (0, 0xA50F, 0x5E55, 0x00AD_0000_0000_0001):
-        vec = K.derive(ks, salt)
-        assert [int(x) for x in vec] == [K.derive_scalar(int(k), salt) for k in ks]
-    vec_pair = K.derive_pair(ks, rs)
-    assert [int(x) for x in vec_pair] == [
-        K.derive_pair_scalar(int(l), int(r)) for l, r in zip(ks, rs)
-    ]
