@@ -287,6 +287,9 @@ class Executor:
         self._last_clock = 0
         self._defer_commit = False
         self.stats = EngineStats()
+        from ..internals.tracing import get_tracer
+
+        self.tracer = get_tracer()
         if persistence is not None:
             # sharded mode: commits are a coordinated collective decided in
             # _stream_loop_sharded, never a per-worker wall-clock whim — all
@@ -312,6 +315,26 @@ class Executor:
         return delta.take(np.flatnonzero(shards == self.ctx.worker_id))
 
     def run(self) -> None:
+        if self.tracer is not None:
+            try:
+                with self.tracer.span(
+                    "engine.run",
+                    n_nodes=len(self.nodes),
+                    worker=self.ctx.worker_id,
+                    n_workers=self.ctx.n_workers,
+                ):
+                    self._run_inner()
+            finally:
+                if not self.ctx.is_sharded:
+                    # failed runs are the ones worth a trace; sharded runs
+                    # flush once after every worker joined
+                    # (graph_runner._run_sharded) — a per-worker flush here
+                    # would freeze the file at the first worker's finish
+                    self.tracer.flush()
+        else:
+            self._run_inner()
+
+    def _run_inner(self) -> None:
         realtime = [n for n in self.nodes if isinstance(n, RealtimeSource)]
         if realtime:
             self._run_streaming(realtime)
@@ -537,6 +560,11 @@ class Executor:
         return clock
 
     def _tick(self, time: int, source_emissions: list[tuple[SourceNode, Delta]]) -> None:
+        tracer = self.tracer
+        if tracer is not None:
+            import time as _wall
+
+            tick_t0 = _wall.perf_counter_ns()
         inbox: dict[int, dict[int, list[Delta]]] = {}
         seeded: dict[int, list[Delta]] = {}
         for src, delta in source_emissions:
@@ -546,6 +574,8 @@ class Executor:
                     self.persistence.record(time, src.persistent_id, delta)
         self._last_clock = max(self._last_clock, time) if time != END_TIME else self._last_clock
         for node in self.nodes:
+            if tracer is not None:
+                node_t0 = _wall.perf_counter_ns()
             out_parts: list[Delta] = []
             released = node.advance_to(time)
             if released is not None and len(released):
@@ -574,13 +604,26 @@ class Executor:
                 ports or node.node_id in seeded or out_parts
             ):
                 self.persistence.mark_dirty(node)
+            emitted_rows = 0
             if out_parts:
                 emitted = concat_deltas(out_parts, out_parts[0].columns)
+                emitted_rows = len(emitted)
                 self.stats.note_node(
-                    node, len(emitted),
+                    node, emitted_rows,
                     is_source=isinstance(node, SourceNode),
                 )
                 self._route(node, emitted, inbox)
+            if tracer is not None and (
+                out_parts or ports or node.node_id in seeded or node.always_run
+            ):
+                # record nodes that did work even when they emitted nothing
+                # (an expensive filter/join producing an empty delta is the
+                # exact hot spot a trace exists to show)
+                tracer.complete(
+                    f"{type(node).__name__}#{node.node_id}",
+                    node_t0,
+                    {"rows": emitted_rows},
+                )
         self.stats.note_tick(time)
         for cb in self._on_time_end:
             cb(time)
@@ -590,6 +633,18 @@ class Executor:
             and not self._defer_commit
         ):
             self.persistence.on_time_end(time)
+        if tracer is not None:
+            # after the callbacks and the persistence commit: both can
+            # dominate a tick and must show inside its span
+            tracer.complete("tick", tick_t0, {"time": time})
+            # worker id in the name: counter tracks merge by (pid, name)
+            tracer.counter(
+                f"engine_rows.w{self.ctx.worker_id}",
+                {
+                    "input": self.stats.input_rows,
+                    "output": self.stats.output_rows,
+                },
+            )
 
     def _route(
         self, node: Node, delta: Delta, inbox: dict[int, dict[int, list[Delta]]]

@@ -1068,19 +1068,7 @@ class GroupedRecompute(Node):
                 continue
             gkeys = self._gkeys(port, d)
             state = self._state[port]
-            # bulk-convert once (tolist is C-speed, python scalars out) and
-            # zip rows in C — the same discipline as Delta.iter_rows
-            gk_list = gkeys.tolist()
-            rk_list = d.keys.tolist()
-            diff_list = d.diffs.tolist()
-            col_lists = [
-                list(c) if c.dtype == object else c.tolist()
-                for c in d.data.values()
-            ]
-            rows_it = (
-                zip(*col_lists) if col_lists else (() for _ in gk_list)
-            )
-            for gk, rk, row, diff in zip(gk_list, rk_list, rows_it, diff_list):
+            for gk, (rk, row, diff) in zip(gkeys.tolist(), d.iter_rows()):
                 grp = state.setdefault(gk, {})
                 entries = grp.get(rk)
                 if entries is None:

@@ -51,6 +51,10 @@ class PathwayConfig:
         default_factory=lambda: os.environ.get("PATHWAY_MONITORING_SERVER"))
     continue_after_replay: bool = field(
         default_factory=lambda: _env_bool("PATHWAY_CONTINUE_AFTER_REPLAY"))
+    #: span tracing → Chrome-trace JSON (internals/tracing.py; the OTLP
+    #: telemetry analog of src/engine/telemetry.rs for a no-egress world)
+    trace_file: str | None = field(
+        default_factory=lambda: os.environ.get("PATHWAY_TRACE_FILE"))
     # worker layout (config.rs PATHWAY_THREADS/PROCESSES/PROCESS_ID/FIRST_PORT)
     #: route dense Exchange columns over the jax device mesh (ICI) instead
     #: of host memory — parallel/meshcomm.py; needs ≥ total_workers devices

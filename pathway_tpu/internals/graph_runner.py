@@ -78,14 +78,23 @@ class GraphRunner:
 
     def run(self) -> None:
         from .config import get_pathway_config
+        from .tracing import get_tracer, span
 
         cfg = get_pathway_config()
         if cfg.total_workers > 1:
             self._run_sharded(cfg)
             return
-        for sink in G.sinks:
-            self.lower_sink(sink)
-        self._execute()
+        try:
+            with span("graph.build", n_sinks=len(G.sinks)):
+                for sink in G.sinks:
+                    self.lower_sink(sink)
+            self._execute()
+        finally:
+            # a failed lowering still deserves its partial trace (executor
+            # flushes are no-ops when nothing new happened since)
+            tracer = get_tracer()
+            if tracer is not None:
+                tracer.flush()
 
     def _run_sharded(self, cfg) -> None:
         """Multi-worker execution (reference: timely workers over thread /
@@ -129,30 +138,7 @@ class GraphRunner:
         pcfg = getattr(self, "persistence_config", None)
         managers: list[Any] = []
         executors: list[Executor] = []
-        for w in local_worker_ids:
-            worker_runner = GraphRunner()
-            if pcfg is not None:
-                from ..persistence import PersistenceManager
-
-                manager = PersistenceManager(
-                    pcfg, worker_id=w, n_workers=n_workers
-                )
-                worker_runner.persistence = manager
-                managers.append(manager)
-            for sink in G.sinks:
-                worker_runner.lower_sink(sink)
-            executors.append(
-                Executor(
-                    worker_runner._nodes,
-                    ctx=WorkerContext(w, n_workers, comm),
-                    persistence=worker_runner.persistence,
-                )
-            )
-        self.executor = executors[0]
-        self._peer_executors = executors
-        if self.stop_requested:
-            for ex in executors:
-                ex.request_stop()
+        from .tracing import span as _span
 
         errors: list[BaseException] = []
 
@@ -163,7 +149,37 @@ class GraphRunner:
                 errors.append(e)
                 comm.abort()
 
+        # comm exists from here on: a failed build must still close it (and
+        # any managers), and still flush the partial trace
         try:
+            with _span(
+                "graph.build", n_sinks=len(G.sinks), n_workers=n_workers
+            ):
+                for w in local_worker_ids:
+                    worker_runner = GraphRunner()
+                    if pcfg is not None:
+                        from ..persistence import PersistenceManager
+
+                        manager = PersistenceManager(
+                            pcfg, worker_id=w, n_workers=n_workers
+                        )
+                        worker_runner.persistence = manager
+                        managers.append(manager)
+                    for sink in G.sinks:
+                        worker_runner.lower_sink(sink)
+                    executors.append(
+                        Executor(
+                            worker_runner._nodes,
+                            ctx=WorkerContext(w, n_workers, comm),
+                            persistence=worker_runner.persistence,
+                        )
+                    )
+            self.executor = executors[0]
+            self._peer_executors = executors
+            if self.stop_requested:
+                for ex in executors:
+                    ex.request_stop()
+
             if len(executors) == 1:
                 work(executors[0])
             else:
@@ -179,6 +195,11 @@ class GraphRunner:
             comm.close()
             for manager in managers:
                 manager.close()
+            from .tracing import get_tracer
+
+            tracer = get_tracer()
+            if tracer is not None:
+                tracer.flush()
         if errors:
             primary = [
                 e for e in errors

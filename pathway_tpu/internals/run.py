@@ -25,6 +25,9 @@ def run(
     """Build and run the whole dataflow (all sinks registered so far).
     Blocks until all sources finish (streaming sources may run forever —
     stop from another thread with ``request_stop()``)."""
+    from .tracing import init_from_env
+
+    init_from_env()  # each pw.run re-reads PATHWAY_TRACE_FILE
     runner = GraphRunner()
     runner.monitoring_level = monitoring_level
     runner.with_http_server = with_http_server

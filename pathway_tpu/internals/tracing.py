@@ -1,0 +1,271 @@
+"""In-process span tracing and run profiling.
+
+Re-design of the reference's telemetry pair — Rust OTLP traces/metrics
+(``src/engine/telemetry.rs:47-156``) and the Python build/run spans
+(``python/pathway/internals/graph_runner/telemetry.py``,
+``graph_runner/__init__.py:146-176``) — for an environment with no
+network egress: instead of pushing OTLP over gRPC, the tracer records
+spans in memory and writes the Chrome Trace Event format (the catapult
+JSON array understood by ``chrome://tracing`` and ``ui.perfetto.dev``)
+when the run finishes.
+
+Activation is env-first like every other engine knob
+(``internals/config.py``): set ``PATHWAY_TRACE_FILE=/path/run.json``.
+When unset, ``get_tracer()`` returns ``None`` and every instrumentation
+site is a single ``is None`` check — no timestamps are taken.
+
+Span taxonomy (mirrors the reference's span names where it has them):
+
+- ``graph.build`` — lowering the parse graph to engine nodes
+  (reference span ``graph_runner/__init__.py:146``);
+- ``engine.run`` — the whole executor run;
+- ``tick`` — one logical-time sweep, with the minted timestamp attached;
+- per-node events under each tick, named ``<NodeClass>#<id>``, with the
+  emitted row count — the analog of timely's event logging stream
+  (``DIFFERENTIAL_LOG_ADDR``, reference ``dataflow.rs:5540-5548``);
+- counter samples of ``EngineStats`` totals per tick, rendered by the
+  trace viewers as time series.
+
+Multi-process runs write one file per process (``<path>.p<process_id>``,
+like the per-process metrics ports of ``engine/http_server.rs:21``);
+worker threads separate naturally by ``tid``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+__all__ = [
+    "Tracer",
+    "activate",
+    "deactivate",
+    "get_tracer",
+    "init_from_env",
+    "span",
+]
+
+
+class _Span:
+    __slots__ = ("tracer", "name", "args", "t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args: dict[str, Any]):
+        self.tracer = tracer
+        self.name = name
+        self.args = args
+
+    def __enter__(self) -> "_Span":
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.tracer.complete(self.name, self.t0, self.args or None)
+
+
+class Tracer:
+    """Collects Chrome-trace events; thread-safe, append-only."""
+
+    def __init__(self, path: str, max_events: int | None = None):
+        self.path = path
+        self._events: list[dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+        #: perf_counter origin so timestamps start near zero in the viewer
+        self._origin = time.perf_counter_ns()
+        #: streaming pipelines run forever (run.py) — bound the buffer so
+        #: tracing a long-lived run keeps the most recent window instead of
+        #: growing without limit; oldest half is dropped on overflow
+        if max_events is None:
+            max_events = int(
+                os.environ.get("PATHWAY_TRACE_MAX_EVENTS", "500000")
+            )
+        self._max_events = max(max_events, 2)
+        self._dropped = 0
+        self._appended = 0
+        self._flush_mark = -1  # _appended value at the last write
+
+    # -- recording ----------------------------------------------------
+
+    def _ts(self, ns: int) -> float:
+        return (ns - self._origin) / 1e3  # µs
+
+    def span(self, name: str, **args: Any) -> _Span:
+        """``with tracer.span("graph.build", tables=3): ...``"""
+        return _Span(self, name, args)
+
+    def complete(
+        self, name: str, t0_ns: int, args: dict[str, Any] | None = None
+    ) -> None:
+        """A finished duration event that began at ``t0_ns``."""
+        ev = {
+            "name": name,
+            "ph": "X",
+            "ts": self._ts(t0_ns),
+            "dur": (time.perf_counter_ns() - t0_ns) / 1e3,
+            "pid": self._pid,
+            "tid": threading.get_ident() % 2**31,
+        }
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def _append(self, ev: dict[str, Any]) -> None:
+        with self._lock:
+            self._events.append(ev)
+            self._appended += 1
+            if len(self._events) > self._max_events:
+                drop = len(self._events) // 2
+                self._dropped += drop
+                del self._events[:drop]
+
+    def instant(self, name: str, **args: Any) -> None:
+        ev = {
+            "name": name,
+            "ph": "i",
+            "s": "t",
+            "ts": self._ts(time.perf_counter_ns()),
+            "pid": self._pid,
+            "tid": threading.get_ident() % 2**31,
+        }
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def counter(self, name: str, values: dict[str, float]) -> None:
+        """A counter sample (rendered as stacked time series). Callers with
+        per-worker counters must put the worker id in ``name`` — trace
+        viewers key counter tracks by (pid, name), so same-named samples
+        from different workers would interleave into one garbled series."""
+        self._append(
+            {
+                "name": name,
+                "ph": "C",
+                "ts": self._ts(time.perf_counter_ns()),
+                "pid": self._pid,
+                "args": values,
+            }
+        )
+
+    # -- output -------------------------------------------------------
+
+    def flush(self) -> str | None:
+        """Write the full event buffer to the trace file. Re-flushable: a
+        tracer kept alive across several ``pw.run`` calls (``activate()``)
+        rewrites the file with the accumulated events each time; a flush
+        with nothing new since the last write is a no-op. Never raises —
+        tracing is auxiliary and must not fail (or mask the error of) the
+        run it observes."""
+        with self._lock:
+            if self._flush_mark == self._appended:
+                return None
+            self._flush_mark = self._appended
+            events = list(self._events)
+        path = self.path
+        # raw env read, not PathwayConfig: config validation can refuse the
+        # worker layout (e.g. over the worker cap) and flush must not raise
+        try:
+            n_processes = int(os.environ.get("PATHWAY_PROCESSES", "1"))
+            process_id = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+        except ValueError:
+            n_processes, process_id = 1, 0
+        if n_processes > 1:
+            path = f"{path}.p{process_id}"
+        meta = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": self._pid,
+                "args": {"name": "pathway_tpu"},
+            }
+        ]
+        if self._dropped:
+            meta.append(
+                {
+                    "name": "trace.dropped_events",
+                    "ph": "i",
+                    "s": "g",
+                    "ts": 0.0,
+                    "pid": self._pid,
+                    "tid": 0,
+                    "args": {"count": self._dropped},
+                }
+            )
+        try:
+            with open(path, "w") as f:
+                json.dump(
+                    {"traceEvents": meta + events, "displayTimeUnit": "ms"}, f
+                )
+        except (OSError, TypeError, ValueError) as e:
+            import warnings
+
+            warnings.warn(
+                f"could not write trace file {path!r}: {e}", RuntimeWarning
+            )
+            return None
+        return path
+
+
+_active: Tracer | None = None
+_env_checked = False
+_programmatic = False
+
+
+def activate(path: str) -> Tracer:
+    """Programmatic activation (the env var is the usual route). Survives
+    ``pw.run``'s env re-read until ``deactivate()``."""
+    global _active, _env_checked, _programmatic
+    _active = Tracer(path)
+    _env_checked = True
+    _programmatic = True
+    return _active
+
+
+def deactivate() -> None:
+    global _active, _env_checked, _programmatic
+    _active = None
+    _env_checked = True
+    _programmatic = False
+
+
+def init_from_env() -> Tracer | None:
+    """Install a tracer if ``PATHWAY_TRACE_FILE`` is set (read through
+    ``PathwayConfig`` so the config snapshot and the tracer agree). Called
+    at the top of every run so each ``pw.run`` re-reads the environment; a
+    tracer installed via ``activate()`` is kept as-is."""
+    global _active, _env_checked
+    if _programmatic:
+        return _active
+    try:
+        from .config import get_pathway_config
+
+        path = get_pathway_config().trace_file
+    except (ImportError, RuntimeError):
+        # config can refuse bad worker env vars; tracing still works
+        path = os.environ.get("PATHWAY_TRACE_FILE")
+    if path:
+        _active = Tracer(path)
+    else:
+        _active = None
+    _env_checked = True
+    return _active
+
+
+def get_tracer() -> Tracer | None:
+    global _env_checked
+    if not _env_checked:
+        init_from_env()
+    return _active
+
+
+def span(name: str, **args: Any):
+    """Span on the active tracer, or a no-op context when tracing is off —
+    lets instrumentation sites keep a single code path."""
+    import contextlib
+
+    tracer = get_tracer()
+    if tracer is None:
+        return contextlib.nullcontext()
+    return tracer.span(name, **args)

@@ -1,0 +1,163 @@
+"""Span tracing (internals/tracing.py) — the no-egress analog of the
+reference's OTLP telemetry (src/engine/telemetry.rs:47-156 + the build/run
+spans in python/pathway/internals/graph_runner/telemetry.py)."""
+
+import json
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import tracing
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _reset_graph_and_tracer():
+    G.clear()
+    yield
+    G.clear()
+    tracing.deactivate()
+
+
+def _small_pipeline():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | x
+        2 | x
+        3 | y
+        """
+    )
+    return t.groupby(pw.this.b).reduce(pw.this.b, s=pw.reducers.sum(pw.this.a))
+
+
+def test_trace_file_written(tmp_path, monkeypatch):
+    path = tmp_path / "trace.json"
+    monkeypatch.setenv("PATHWAY_TRACE_FILE", str(path))
+    out = _small_pipeline()
+    rows = []
+    pw.io.subscribe(out, on_change=lambda **kw: rows.append(kw))
+    pw.run()
+    assert path.exists()
+    doc = json.loads(path.read_text())
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert "graph.build" in names
+    assert "engine.run" in names
+    assert "tick" in names
+    # per-node duration events carry emitted row counts
+    node_events = [
+        e
+        for e in doc["traceEvents"]
+        if "#" in e.get("name", "") and e.get("ph") == "X"
+    ]
+    assert node_events and all("rows" in e["args"] for e in node_events)
+    # spans nest: every tick lies inside engine.run
+    run_ev = next(e for e in doc["traceEvents"] if e["name"] == "engine.run")
+    for tick in (e for e in doc["traceEvents"] if e["name"] == "tick"):
+        assert tick["ts"] >= run_ev["ts"]
+        assert tick["ts"] + tick["dur"] <= run_ev["ts"] + run_ev["dur"] + 1e3
+
+
+def test_no_trace_file_when_disabled(tmp_path, monkeypatch):
+    monkeypatch.delenv("PATHWAY_TRACE_FILE", raising=False)
+    out = _small_pipeline()
+    pw.io.subscribe(out, on_change=lambda **kw: None)
+    pw.run()
+    assert list(tmp_path.iterdir()) == []
+    assert tracing.get_tracer() is None
+
+
+def test_sharded_run_traces_all_workers(tmp_path, monkeypatch):
+    path = tmp_path / "sharded.json"
+    monkeypatch.setenv("PATHWAY_TRACE_FILE", str(path))
+    monkeypatch.setenv("PATHWAY_THREADS", "3")
+    out = _small_pipeline()
+    pw.io.subscribe(out, on_change=lambda **kw: None)
+    pw.run()
+    monkeypatch.delenv("PATHWAY_THREADS")
+    doc = json.loads(path.read_text())
+    runs = [e for e in doc["traceEvents"] if e["name"] == "engine.run"]
+    assert len(runs) == 3
+    assert {e["args"]["worker"] for e in runs} == {0, 1, 2}
+    # three workers → three distinct threads in the trace
+    assert len({e["tid"] for e in runs}) == 3
+
+
+def test_programmatic_activation_survives_run(tmp_path, monkeypatch):
+    monkeypatch.delenv("PATHWAY_TRACE_FILE", raising=False)
+    path = tmp_path / "prog_run.json"
+    tracing.activate(str(path))
+    out = _small_pipeline()
+    pw.io.subscribe(out, on_change=lambda **kw: None)
+    pw.run()  # init_from_env must not clobber the activated tracer
+    assert path.exists()
+    names = {e["name"] for e in json.loads(path.read_text())["traceEvents"]}
+    assert "engine.run" in names
+    # a second run on the same tracer re-flushes with both runs' spans
+    G.clear()
+    out = _small_pipeline()
+    pw.io.subscribe(out, on_change=lambda **kw: None)
+    pw.run()
+    events = json.loads(path.read_text())["traceEvents"]
+    assert sum(1 for e in events if e["name"] == "engine.run") == 2
+
+
+def test_flush_write_failure_warns_not_raises(tmp_path):
+    tracer = tracing.Tracer(str(tmp_path / "no/such/dir/t.json"))
+    tracer.instant("x")
+    with pytest.warns(RuntimeWarning, match="could not write trace file"):
+        assert tracer.flush() is None
+
+
+def test_trace_flushed_when_run_raises(tmp_path, monkeypatch):
+    path = tmp_path / "failing.json"
+    monkeypatch.setenv("PATHWAY_TRACE_FILE", str(path))
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+
+    def boom(row):
+        raise RuntimeError("node failure")
+
+    pw.io.subscribe(t.select(b=pw.apply(boom, pw.this.a)),
+                    on_change=lambda **kw: None)
+    with pytest.raises(Exception):
+        # apply errors become Error rows; force a hard failure via on_change
+        out = _small_pipeline()
+        pw.io.subscribe(out, on_change=lambda **kw: 1 / 0)
+        pw.run()
+    assert path.exists()  # flush happens in finally even on failure
+
+
+def test_event_buffer_is_bounded(tmp_path):
+    tracer = tracing.Tracer(str(tmp_path / "cap.json"), max_events=10)
+    for i in range(100):
+        tracer.instant(f"e{i}")
+    assert len(tracer._events) <= 10
+    tracer.flush()
+    doc = json.loads((tmp_path / "cap.json").read_text())
+    dropped = [
+        e for e in doc["traceEvents"] if e["name"] == "trace.dropped_events"
+    ]
+    assert dropped and dropped[0]["args"]["count"] >= 90
+    # the surviving window is the most recent one
+    assert any(e["name"] == "e99" for e in doc["traceEvents"])
+
+
+def test_programmatic_activation(tmp_path):
+    tracer = tracing.activate(str(tmp_path / "prog.json"))
+    with tracer.span("outer", k=1):
+        tracer.instant("marker")
+    tracer.counter("c", {"v": 2.0})
+    written = tracer.flush()
+    assert written == str(tmp_path / "prog.json")
+    doc = json.loads((tmp_path / "prog.json").read_text())
+    phases = {e["name"]: e["ph"] for e in doc["traceEvents"]}
+    assert phases["outer"] == "X"
+    assert phases["marker"] == "i"
+    assert phases["c"] == "C"
+    # flush is idempotent
+    assert tracer.flush() is None
