@@ -25,7 +25,13 @@ def main() -> None:
 
 
 def _spawn_processes(
-    threads: int, processes: int, first_port: int, env_extra: dict, args: tuple[str, ...]
+    threads: int,
+    processes: int,
+    first_port: int,
+    env_extra: dict,
+    args: tuple[str, ...],
+    addresses: str | None = None,
+    local_ids: tuple[int, ...] = (),
 ) -> int:
     if threads * processes > MAX_WORKERS:
         raise click.ClickException(
@@ -41,11 +47,35 @@ def _spawn_processes(
         "PATHWAY_FIRST_PORT": str(first_port),
         **env_extra,
     }
+    if addresses:
+        entries = [a.strip() for a in addresses.split(",") if a.strip()]
+        if len(entries) != processes:
+            raise click.ClickException(
+                "--addresses must list one host[:port] per process"
+            )
+        # fail malformed entries at launch, not in every child's traceback
+        from .parallel.cluster import _address_book
+
+        try:
+            _address_book(entries, processes, "127.0.0.1", first_port)
+        except ValueError as e:
+            raise click.ClickException(str(e))
+        base_env["PATHWAY_ADDRESSES"] = ",".join(entries)
+    # multi-host ensembles run spawn once per machine, each launching only
+    # its own process ids (reference: timely hostfile + per-machine -p)
+    pids = list(local_ids) if local_ids else list(range(processes))
+    bad = [p for p in pids if not 0 <= p < processes]
+    if bad:
+        raise click.ClickException(
+            f"--process ids {bad} out of range for {processes} processes"
+        )
+    if len(set(pids)) != len(pids):
+        raise click.ClickException("--process ids must be distinct")
     if processes <= 1:
         env = {**base_env, "PATHWAY_PROCESS_ID": "0"}
         return subprocess.call(program, env=env)
     procs = []
-    for pid in range(processes):
+    for pid in pids:
         env = {**base_env, "PATHWAY_PROCESS_ID": str(pid)}
         procs.append(subprocess.Popen(program, env=env))
     code = 0
@@ -62,14 +92,28 @@ def _spawn_processes(
               help="record input streams for later replay")
 @click.option("--record-path", type=str, default="record",
               help="where recorded input lands")
+@click.option("-a", "--addresses", type=str, default=None,
+              help="multi-host address book: comma-separated host[:port], "
+                   "one per process (timely hostfile analog)")
+@click.option("-p", "--process", "local_ids", type=int, multiple=True,
+              help="launch only these process ids on this machine "
+                   "(repeatable; default: all — use with --addresses when "
+                   "the ensemble spans machines)")
 @click.argument("program", nargs=-1, type=click.UNPROCESSED)
-def spawn(threads, processes, first_port, record, record_path, program):
-    """Launch PROGRAM with the worker environment set (reference cli.py:53)."""
+def spawn(threads, processes, first_port, record, record_path, addresses,
+          local_ids, program):
+    """Launch PROGRAM with the worker environment set (reference cli.py:53).
+
+    Multi-host: run once per machine with the same ``--addresses`` book and
+    that machine's ``-p`` ids, e.g.
+    ``spawn -n 2 -t 2 -a hostA:10000,hostB:10000 -p 0 python app.py``."""
     env_extra: dict[str, str] = {}
     if record:
         env_extra["PATHWAY_REPLAY_STORAGE"] = record_path
         env_extra["PATHWAY_SNAPSHOT_ACCESS"] = "record"
-    sys.exit(_spawn_processes(threads, processes, first_port, env_extra, program))
+    sys.exit(_spawn_processes(threads, processes, first_port, env_extra,
+                              program, addresses=addresses,
+                              local_ids=local_ids))
 
 
 @main.command(context_settings={"ignore_unknown_options": True})

@@ -33,6 +33,13 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_addresses(name: str) -> list[str] | None:
+    v = os.environ.get(name)
+    if not v or not v.strip():
+        return None
+    return [a.strip() for a in v.split(",") if a.strip()]
+
+
 @dataclass
 class PathwayConfig:
     ignore_asserts: bool = field(
@@ -64,6 +71,11 @@ class PathwayConfig:
     processes: int = field(default_factory=lambda: _env_int("PATHWAY_PROCESSES", 1))
     process_id: int = field(default_factory=lambda: _env_int("PATHWAY_PROCESS_ID", 0))
     first_port: int = field(default_factory=lambda: _env_int("PATHWAY_FIRST_PORT", 10000))
+    #: multi-host cluster address book: comma-separated host[:port], one per
+    #: process (the timely hostfile analog — communication/src/initialize.rs);
+    #: unset = all processes on 127.0.0.1 at first_port+pid
+    addresses: list[str] | None = field(
+        default_factory=lambda: _env_addresses("PATHWAY_ADDRESSES"))
 
     def __post_init__(self) -> None:
         if self.threads * self.processes > MAX_WORKERS:
@@ -71,6 +83,11 @@ class PathwayConfig:
                 f"too many workers: {self.threads}×{self.processes} > "
                 f"{MAX_WORKERS} (reference free-tier limit, "
                 "dataflow/config.rs:7-11)"
+            )
+        if self.addresses is not None and len(self.addresses) != self.processes:
+            raise RuntimeError(
+                f"PATHWAY_ADDRESSES lists {len(self.addresses)} hosts for "
+                f"{self.processes} processes — one host[:port] per process"
             )
 
     @property

@@ -117,6 +117,7 @@ class GraphRunner:
                 n_processes=cfg.processes,
                 threads_per_process=cfg.threads,
                 first_port=cfg.first_port,
+                addresses=cfg.addresses,
             )
             local_worker_ids = [
                 cfg.process_id * cfg.threads + i for i in range(cfg.threads)

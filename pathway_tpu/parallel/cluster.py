@@ -2,7 +2,10 @@
 
 The ``zero_copy`` allocator analog (``external/timely-dataflow/communication/
 src/allocator/zero_copy/``): processes form a full mesh of sockets
-(process p listens on ``first_port + p``; higher pids dial lower ones),
+(process p listens at its address-book entry — default ``first_port + p``
+on one machine, or one ``host[:port]`` per process via ``PATHWAY_ADDRESSES``
+for multi-host/DCN clusters, the timely hostfile analog
+(``communication/src/initialize.rs``); higher pids dial lower ones),
 worker threads exchange pickled columnar Delta frames. One frame per
 (exchange, remote process) carries all buckets for that process's workers —
 the host serialization path for object columns; dense numeric columns ride
@@ -39,11 +42,16 @@ class ClusterComm(Comm):
         threads_per_process: int,
         first_port: int,
         host: str = "127.0.0.1",
+        addresses: list[str] | None = None,
     ):
         self.process_id = process_id
         self.n_processes = n_processes
         self.threads = threads_per_process
         self.n_workers = n_processes * threads_per_process
+        #: per-process (host, port) book — the timely hostfile analog
+        #: (communication/src/initialize.rs); default: one machine, ports
+        #: first_port..first_port+n-1
+        self._addrs = _address_book(addresses, n_processes, host, first_port)
         self._local_workers = set(
             process_id * threads_per_process + i
             for i in range(threads_per_process)
@@ -60,16 +68,20 @@ class ClusterComm(Comm):
         self._readers: list[threading.Thread] = []
         self._listener: socket.socket | None = None
         self._closing = False
-        self._connect_mesh(host, first_port)
+        self._connect_mesh()
 
     # -- mesh setup ------------------------------------------------------
 
-    def _connect_mesh(self, host: str, first_port: int) -> None:
+    def _connect_mesh(self) -> None:
         if self.n_processes == 1:
             return
+        my_port = self._addrs[self.process_id][1]
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind((host, first_port + self.process_id))
+        # bind all interfaces: with an address book, peers dial in over DCN
+        # from other machines; the book entry is how THEY reach us
+        self._listener.bind(("" if len({h for h, _ in self._addrs}) > 1
+                             else self._addrs[self.process_id][0], my_port))
         self._listener.listen(self.n_processes)
 
         expected_inbound = self.n_processes - 1 - self.process_id
@@ -85,18 +97,19 @@ class ClusterComm(Comm):
 
         # dial every lower pid (they accept from us)
         for peer in range(self.process_id):
+            peer_host, peer_port = self._addrs[peer]
             deadline = time.monotonic() + CONNECT_TIMEOUT_S
             while True:
                 try:
                     s = socket.create_connection(
-                        (host, first_port + peer), timeout=2.0
+                        (peer_host, peer_port), timeout=2.0
                     )
                     break
                 except OSError:
                     if time.monotonic() > deadline:
                         raise RuntimeError(
                             f"process {self.process_id}: peer {peer} not "
-                            f"reachable on {host}:{first_port + peer}"
+                            f"reachable on {peer_host}:{peer_port}"
                         )
                     time.sleep(0.05)
             s.sendall(_LEN.pack(self.process_id))
@@ -268,6 +281,53 @@ class ClusterComm(Comm):
                 self._listener.close()
             except OSError:
                 pass
+
+
+def _address_book(
+    addresses: list[str] | None, n: int, host: str, first_port: int
+) -> list[tuple[str, int]]:
+    """Resolve per-process (host, port). ``addresses`` entries are
+    ``host[:port]``; a bare host gets ``first_port + pid`` (so a hostfile of
+    machine names works unchanged, like timely's)."""
+    if addresses is None:
+        return [(host, first_port + p) for p in range(n)]
+    if len(addresses) != n:
+        raise ValueError(
+            f"address book lists {len(addresses)} hosts for {n} processes"
+        )
+    book: list[tuple[str, int]] = []
+    for p, entry in enumerate(addresses):
+        h, port = _parse_address(entry, first_port + p)
+        book.append((h, port))
+    return book
+
+
+def _parse_address(entry: str, default_port: int) -> tuple[str, int]:
+    """``host``, ``host:port``, ``[v6]:port``, or a bare IPv6 literal."""
+    if entry.startswith("["):  # [::1]:port
+        h, bracket, rest = entry[1:].partition("]")
+        if not bracket or not h:
+            raise ValueError(f"malformed address {entry!r}")
+        if not rest:
+            return h, default_port
+        if not rest.startswith(":"):
+            raise ValueError(f"malformed address {entry!r}")
+        port_s = rest[1:]
+    elif entry.count(":") > 1:  # bare IPv6 literal, no port
+        return entry, default_port
+    elif ":" in entry:
+        h, _, port_s = entry.rpartition(":")
+    else:
+        return entry, default_port
+    if not h:
+        raise ValueError(f"address {entry!r} has an empty host")
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise ValueError(f"address {entry!r} has a non-numeric port") from None
+    if not 0 < port < 65536:
+        raise ValueError(f"address {entry!r} port out of range")
+    return h, port
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:

@@ -349,3 +349,110 @@ def test_cluster_process_parity(tmp_path, monkeypatch):
     cluster = json.loads(out_cluster.read_text())
     assert single == cluster
     assert len(single) == 7
+
+
+# multi-host address book (PATHWAY_ADDRESSES — timely hostfile analog)
+
+
+def test_address_book_resolution():
+    from pathway_tpu.parallel.cluster import _address_book
+
+    # default: one machine, contiguous ports
+    assert _address_book(None, 3, "127.0.0.1", 9000) == [
+        ("127.0.0.1", 9000), ("127.0.0.1", 9001), ("127.0.0.1", 9002)
+    ]
+    # explicit host:port entries win over first_port
+    assert _address_book(["a:1", "b:2"], 2, "x", 9000) == [("a", 1), ("b", 2)]
+    # bare hostnames (a plain hostfile) get first_port + pid
+    assert _address_book(["hostA", "hostB"], 2, "x", 7000) == [
+        ("hostA", 7000), ("hostB", 7001)
+    ]
+    with pytest.raises(ValueError, match="2 hosts for 3 processes"):
+        _address_book(["a", "b"], 3, "x", 9000)
+
+
+def test_config_addresses_validation(monkeypatch):
+    from pathway_tpu.internals.config import get_pathway_config
+
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    monkeypatch.setenv("PATHWAY_ADDRESSES", "hostA:1234, hostB:5678")
+    assert get_pathway_config().addresses == ["hostA:1234", "hostB:5678"]
+    monkeypatch.setenv("PATHWAY_ADDRESSES", "onlyone:1")
+    with pytest.raises(RuntimeError, match="one host\\[:port\\] per process"):
+        get_pathway_config()
+
+
+def test_cluster_parity_with_address_book(tmp_path):
+    """The 2-process mesh forms from PATHWAY_ADDRESSES with non-contiguous
+    ports and a bogus first_port, proving connections use the book (the
+    multi-host path, here with both 'hosts' on loopback)."""
+    prog = tmp_path / "prog.py"
+    prog.write_text(textwrap.dedent(_CLUSTER_PROGRAM))
+    out_single = tmp_path / "single.json"
+    out_cluster = tmp_path / "cluster.json"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root}
+    subprocess.run(
+        [sys.executable, str(prog), str(out_single)],
+        env={**base_env, "PATHWAY_THREADS": "1", "PATHWAY_PROCESSES": "1"},
+        check=True, timeout=120,
+    )
+    book = f"127.0.0.1:{_free_port()},127.0.0.1:{_free_port()}"
+    subprocess.run(
+        [
+            sys.executable, "-m", "pathway_tpu.cli", "spawn",
+            "-n", "2", "-t", "2", "--first-port", "1",
+            "-a", book, "-p", "0", "-p", "1",
+            sys.executable, str(prog), str(out_cluster),
+        ],
+        env=base_env, check=True, timeout=180,
+    )
+    assert json.loads(out_single.read_text()) == json.loads(
+        out_cluster.read_text()
+    )
+
+
+def test_spawn_rejects_bad_address_book_and_pids(tmp_path):
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import main
+
+    runner = CliRunner()
+    r = runner.invoke(main, [
+        "spawn", "-n", "2", "-a", "onlyhost:1", "true"
+    ])
+    assert r.exit_code != 0
+    assert "one host[:port] per process" in r.output
+    r = runner.invoke(main, ["spawn", "-n", "2", "-p", "5", "true"])
+    assert r.exit_code != 0
+    assert "out of range" in r.output
+
+
+def test_address_parsing_edge_cases():
+    from pathway_tpu.parallel.cluster import _parse_address
+
+    assert _parse_address("host", 9) == ("host", 9)
+    assert _parse_address("host:123", 9) == ("host", 123)
+    assert _parse_address("::1", 9) == ("::1", 9)  # bare IPv6 = host only
+    assert _parse_address("[::1]:80", 9) == ("::1", 80)
+    assert _parse_address("[fe80::2]", 9) == ("fe80::2", 9)
+    for bad in (":1", "h:", "h:abc", "h:0", "h:70000", "[::1", "[::1]x"):
+        with pytest.raises(ValueError):
+            _parse_address(bad, 9)
+
+
+def test_spawn_rejects_malformed_book_and_duplicate_pids():
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import main
+
+    runner = CliRunner()
+    r = runner.invoke(main, [
+        "spawn", "-n", "2", "-a", "hostA:abc,hostB:1", "true"
+    ])
+    assert r.exit_code != 0 and "non-numeric port" in r.output
+    r = runner.invoke(main, [
+        "spawn", "-n", "2", "-a", "hostA:1,hostB:2",
+        "-p", "0", "-p", "0", "true",
+    ])
+    assert r.exit_code != 0 and "distinct" in r.output
