@@ -1693,8 +1693,11 @@ def _as_column(arr: Any, n: int) -> np.ndarray:
             return np.asarray(arr)
     except Exception:
         pass
-    if np.isscalar(arr) or arr is None or isinstance(arr, (tuple, dict)):
-        # tuples are row *values* (constant per row), never column vectors
+    if not isinstance(arr, (np.ndarray, list)):
+        # anything else — scalars, None, tuples, dicts, Json, arbitrary
+        # objects — is a row *value* (constant per row), never a column
+        # vector; np.asarray on an iterable value (pw.Json wraps one)
+        # would silently spread its elements across rows
         return column_of_values([arr] * n)
     a = np.asarray(arr)
     if a.ndim == 1 and len(a) == n:

@@ -16,6 +16,8 @@ from typing import Any
 
 import numpy as np
 
+from .json import Json
+
 __all__ = [
     "DType",
     "ANY",
@@ -295,7 +297,7 @@ def dtype_of_value(v: Any) -> DType:
         return Array(v.ndim, wrap(type(v.reshape(-1)[0].item())) if v.size else FLOAT)
     if isinstance(v, tuple):
         return Tuple(*[dtype_of_value(x) for x in v])
-    if isinstance(v, dict):
+    if isinstance(v, (dict, Json)):
         return JSON
     return ANY
 

@@ -23,6 +23,7 @@ from . import dtype as dt
 from . import expression as expr_mod
 from ..engine import keys as K
 from ..engine.error import Error as EngineError
+from .json import Json
 from .expression import (
     ApplyExpression,
     AsyncApplyExpression,
@@ -827,6 +828,21 @@ def _cast_fn(f, src: dt.DType, target: dt.DType, xp):
 
     def convert_scalar(v):
         if v is None or isinstance(v, EngineError):
+            return v
+        if isinstance(v, Json):
+            # .as_int()/.as_str()/… are STRICT typed accessors over the
+            # json VALUE (reference expression.py as_* over Value::Json):
+            # a type mismatch yields None per the Optional return type —
+            # and str(Json) would re-serialize ('"x"', not 'x')
+            v = v.value
+            if tu == dt.INT:
+                return v if type(v) is int else None
+            if tu == dt.FLOAT:
+                return float(v) if type(v) in (int, float) else None
+            if tu == dt.BOOL:
+                return v if type(v) is bool else None
+            if tu == dt.STR:
+                return v if type(v) is str else None
             return v
         if tu == dt.INT:
             return int(v)

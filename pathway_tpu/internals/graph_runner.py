@@ -328,18 +328,18 @@ class GraphRunner:
                 mode="left", key_mode="left", emit_matched=False,
             ))
         if kind == "having":
+            # result = rows of the indexer's table whose pointer is a key
+            # of base, keyed by the indexer table's ids and carrying base's
+            # columns (reference HavingContext: universe ⊆ indexer's)
             base_t, other_t = table._inputs
-            node, env = self._zip_env(base_t, {"__k": p["key_expr"]})
+            other_node, env = self._zip_env(other_t, {"__k": p["key_expr"]})
             kc = compile_expr(p["key_expr"], env)
-            rw = self._add(ops.Rowwise(node, {
-                **{c: _colref(c) for c in base_t.column_names()},
-                "__ptr__": kc.fn,
-            }))
-            other_node = self.lower(other_t)
+            rw = self._add(ops.Rowwise(other_node, {"__ptr__": kc.fn}))
+            base_node = self.lower(base_t)
             cols = table.column_names()
             return self._add(ops.Join(
-                rw, other_node, "__ptr__", None,
-                left_cols=cols, right_cols=[], out_names=cols,
+                rw, base_node, "__ptr__", None,
+                left_cols=[], right_cols=cols, out_names=cols,
                 mode="inner", key_mode="left",
             ))
         if kind == "ix":

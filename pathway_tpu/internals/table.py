@@ -330,6 +330,12 @@ class Table(TableLike):
         return self._rowwise(exprs)
 
     def restrict(self, other: TableLike) -> "Table":
+        if not other._universe.is_subset_of(self._universe):
+            raise ValueError(
+                "restrict requires other's universe to be a provable subset "
+                "of self's; use promise_universe_is_subset_of if you know "
+                "it holds (reference table.py:1334)"
+            )
         return Table(
             "restrict",
             [self, other],  # type: ignore[list-item]
@@ -356,14 +362,29 @@ class Table(TableLike):
         return Table("difference", [self, other], {}, self._schema, u)
 
     def having(self, *indexers: Any) -> "Table":
+        """Rows of each indexer's table whose pointer value is a key of
+        ``self``, carrying ``self``'s columns — the result universe is a
+        provable subset of the indexer table's (reference ``_having``,
+        table.py:2027 / ``HavingContext`` column.py:794: universe =
+        ``key_column.universe.subset()``)."""
         out = self
         for ix in indexers:
+            if not isinstance(ix, ColumnReference) or not isinstance(
+                getattr(ix, "table", None), Table
+            ):
+                # pw.this.x is a ColumnReference too, but its "table" is
+                # the ThisPlaceholder — there is no concrete universe to
+                # subset, so refuse it here with a clear error
+                raise TypeError(
+                    "having takes pointer-valued column references on a "
+                    "concrete table (e.g. q.select(p=t.pointer_from(q.k)).p)"
+                )
             out = Table(
                 "having",
                 [out, ix.table],
-                {"key_expr": self._sub(ix)},
+                {"key_expr": ix},
                 out._schema,
-                Universe(parent=out._universe),
+                Universe(parent=ix.table._universe),
             )
         return out
 
@@ -488,6 +509,12 @@ class Table(TableLike):
         return self
 
     def with_universe_of(self, other: TableLike) -> "Table":
+        if not self._universe.is_equal(other._universe):
+            raise ValueError(
+                "with_universe_of requires provably equal universes; use "
+                "promise_universes_are_equal if you know they match "
+                "(reference table.py:1613)"
+            )
         return Table(
             "with_universe_of",
             [self, other],  # type: ignore[list-item]

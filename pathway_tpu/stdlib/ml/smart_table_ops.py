@@ -124,8 +124,16 @@ def fuzzy_match(
         right=this.right,
         best=pw.reducers.argmax(this.weight),
     )
-    keep_l = scored.restrict(best_left.with_id(this.best))
-    mutual = keep_l.restrict(best_right.with_id(this.best))
+    # argmax values ARE keys of `scored`, so both reindexed winner tables
+    # are subsets of it by construction — promised, since the solver can't
+    # prove it across the reindex. A best-for-right row need NOT be
+    # best-for-left, so the second cut is an intersection, not a restrict.
+    keep_l = scored.restrict(
+        best_left.with_id(this.best).promise_universe_is_subset_of(scored)
+    )
+    mutual = keep_l.intersect(
+        best_right.with_id(this.best).promise_universe_is_subset_of(scored)
+    )
     return mutual
 
 

