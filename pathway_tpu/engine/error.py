@@ -12,7 +12,7 @@ from __future__ import annotations
 import logging
 import threading
 
-__all__ = ["Error", "is_error", "ERROR_LOG"]
+__all__ = ["Error", "is_error", "errors_seen", "ERROR_LOG"]
 
 logger = logging.getLogger("pathway_tpu.errors")
 
@@ -28,6 +28,8 @@ class _ErrorLog:
         self._max_logged = max_logged
 
     def record(self, message: str, context: str) -> None:
+        global _errors_seen
+        _errors_seen = True
         with self._lock:
             self.total += 1
             if len(self._entries) < self._max_kept:
@@ -42,12 +44,23 @@ class _ErrorLog:
             return list(self._entries)
 
     def clear(self) -> None:
+        # clears the LOG, not the errors-seen latch: live Error values may
+        # still sit in operator state, so error-aware paths must stay on
         with self._lock:
             self._entries.clear()
             self.total = 0
 
 
 ERROR_LOG = _ErrorLog()
+
+#: latched True by every Error construction or unpickle and never reset —
+#: the zero-cost "may any Error value exist in this process?" gate used by
+#: the engine's error-aware fast paths
+_errors_seen = False
+
+
+def errors_seen() -> bool:
+    return _errors_seen
 
 
 class Error:
@@ -60,6 +73,17 @@ class Error:
     def __init__(self, message: str = "Error", context: str = "<expression>"):
         self.message = message
         ERROR_LOG.record(message, context)
+
+    @classmethod
+    def silent(cls, message: str = "Error") -> "Error":
+        """An Error value without a log entry — for re-derived errors (a
+        group aggregate re-read while its error rows persist) whose root
+        cause was already logged when the original row Error was built."""
+        global _errors_seen
+        _errors_seen = True
+        e = cls.__new__(cls)
+        e.message = message
+        return e
 
     def __repr__(self) -> str:
         return "Error"
@@ -75,6 +99,16 @@ class Error:
 
     def __hash__(self) -> int:
         return id(self)
+
+    # unpickling (cluster exchange frames, operator-state snapshots) must
+    # set the process-wide latch without re-logging
+    def __getstate__(self):
+        return self.message
+
+    def __setstate__(self, state):
+        global _errors_seen
+        _errors_seen = True
+        self.message = state
 
 
 def is_error(v: object) -> bool:

@@ -19,6 +19,7 @@ import numpy as np
 
 from . import keys as K
 from .delta import Delta, column_of_values, concat_deltas, rows_to_columns
+from .error import ERROR_LOG, Error as EngineError, errors_seen
 from .executor import END_TIME, Node, SourceNode
 from .reducers import ReducerImpl
 from .state import MultiIndex, RowState
@@ -44,7 +45,11 @@ def _rows_equal(a: tuple | None, b: tuple | None) -> bool:
             ):
                 return False
         elif x != y and not (x is None and y is None):
-            return False
+            # Error compares equal to nothing, but for EMISSION stability
+            # two Error cells are the same output (no retract/re-insert
+            # churn for a group stuck in error)
+            if not (type(x) is EngineError and type(y) is EngineError):
+                return False
     return True
 
 
@@ -289,6 +294,10 @@ class GroupByReduce(Node):
         self._key_from_column = key_from_column
         # group_key -> [count, group_values, [accs...], last_emitted_row|None]
         self._state: dict[int, list] = {}
+        # group_key -> per-reducer Error multiplicity (reference
+        # reduce.rs:162-173 error_count: any Error in a reduced column makes
+        # that group's aggregate Error until the error rows retract)
+        self._gerrs: dict[int, list[int]] = {}
         from .reducers import CountReducer, SumReducer
         from .slotmap import SlotMap
 
@@ -325,7 +334,11 @@ class GroupByReduce(Node):
         return True
 
     def snapshot_state(self) -> dict:
-        st: dict = {"_state": self._state, "dense": self._dense}
+        st: dict = {
+            "_state": self._state,
+            "dense": self._dense,
+            "gerrs": self._gerrs,
+        }
         if self._dense:
             # trim arenas to allocated slots; the SlotMap is reconstructed
             # from _gkey_by_slot on restore (SlotMap.rebuild)
@@ -344,6 +357,7 @@ class GroupByReduce(Node):
         from .slotmap import SlotMap
 
         self._state = state["_state"]
+        self._gerrs = state.get("gerrs", {})
         if not state["dense"]:
             if self._dense:
                 # snapshot was taken after a demotion — mirror it
@@ -363,6 +377,9 @@ class GroupByReduce(Node):
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d = ins[0]
         if d is None or not len(d):
+            return None
+        d = self._skip_error_keys(d)
+        if not len(d):
             return None
         n = len(d)
         gcols = [np.asarray(d.data[c]) for c in self._group_cols]
@@ -388,6 +405,32 @@ class GroupByReduce(Node):
                 return self._process_dense(d, n, gcols, gkeys, arg_arrays)
             self._demote()
         return self._process_general(d, n, gcols, gkeys, time)
+
+    def _skip_error_keys(self, d: Delta) -> Delta:
+        """Drop rows whose grouping values contain an Error (reference
+        ErrorInGroupby, dataflow.rs:3026: log + skip, never poison the
+        group). Free when no Error was ever created in this process."""
+        if not errors_seen():
+            return d
+        key_cols = (
+            [self._key_from_column]
+            if self._key_from_column is not None
+            else self._group_cols
+        )
+        mask = None
+        for c in key_cols:
+            col = np.asarray(d.data[c])
+            if col.dtype == object:
+                m = np.fromiter(
+                    (type(v) is EngineError for v in col), bool, len(col)
+                )
+                mask = m if mask is None else (mask | m)
+        if mask is None or not mask.any():
+            return d
+        ERROR_LOG.record(
+            "Error value in grouping key; row skipped", "groupby"
+        )
+        return d.take(np.flatnonzero(~mask))
 
     # -- dense arena path ------------------------------------------------
 
@@ -553,6 +596,10 @@ class GroupByReduce(Node):
 
     def _process_general(self, d, n, gcols, gkeys, time) -> Delta | None:
         arg_cols = [[d.data[a] for a in args] for _, _, args in self._reducers]
+        # Error-aware only when errors exist at all (the errors_seen latch
+        # trips on every Error construction/unpickle — zero-cost guard on
+        # clean pipelines, immune to ERROR_LOG.clear() and state restores)
+        watch_errors = errors_seen()
         affected: dict[int, None] = {}
         for i in range(n):
             gk = int(gkeys[i])
@@ -565,6 +612,17 @@ class GroupByReduce(Node):
             row_key = int(d.keys[i])
             for j, (_, red, _) in enumerate(self._reducers):
                 vals = tuple(col[i] for col in arg_cols[j])
+                if watch_errors and any(
+                    type(v) is EngineError for v in vals
+                ):
+                    # reference reduce.rs error_count: the Error row joins
+                    # the group's error multiplicity, not the accumulator —
+                    # the aggregate reads Error until it retracts
+                    errs = self._gerrs.setdefault(
+                        gk, [0] * len(self._reducers)
+                    )
+                    errs[j] += diff
+                    continue
                 st[2][j] = red.update(st[2][j], vals, diff, row_key, time)
             affected[gk] = None
 
@@ -576,15 +634,23 @@ class GroupByReduce(Node):
             old_row = st[3]
             if st[0] < 0:
                 raise ValueError("negative multiplicity in groupby input")
+            errs = self._gerrs.get(gk)
+            if errs is not None and not any(errs):
+                self._gerrs.pop(gk)
+                errs = None
             if st[0] == 0:
                 new_row = None
             else:
                 new_row = st[1] + tuple(
-                    red.extract(st[2][j]) for j, (_, red, _) in enumerate(self._reducers)
+                    EngineError.silent("error value in reduced column")
+                    if errs is not None and errs[j] > 0
+                    else red.extract(st[2][j])
+                    for j, (_, red, _) in enumerate(self._reducers)
                 )
             if _rows_equal(old_row, new_row):
                 if new_row is None:
                     del self._state[gk]
+                    self._gerrs.pop(gk, None)
                 continue
             if old_row is not None:
                 out_keys.append(gk)
@@ -597,6 +663,7 @@ class GroupByReduce(Node):
                 st[3] = new_row
             else:
                 del self._state[gk]
+                self._gerrs.pop(gk, None)
         if not out_keys:
             return None
         return Delta(

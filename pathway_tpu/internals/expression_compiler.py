@@ -372,6 +372,9 @@ def _build(
             and not rdt.is_optional
             and dt.unoptionalize(ldt) in _NUMERIC
             and dt.unoptionalize(rdt) in _NUMERIC
+            # divisions stay on the numpy path: zero denominators must
+            # become per-row Error values, which a jitted kernel can't hold
+            and op not in ("/", "//", "%")
         )
         return fn, out_dt, jax_ok, lrefs | rrefs
 
@@ -724,26 +727,42 @@ def _densify(arr: np.ndarray, dtype: dt.DType) -> np.ndarray:
 def _binop_fn(op, lf, rf, ldt, rdt, xp):
     lu, ru = dt.unoptionalize(ldt), dt.unoptionalize(rdt)
 
-    if op == "/" and lu in _NUMERIC and ru in _NUMERIC:
+    if op in ("/", "//", "%") and (
+        op != "/" or (lu in _NUMERIC and ru in _NUMERIC)
+    ):
+        base = {
+            "/": xp.true_divide, "//": xp.floor_divide, "%": xp.mod
+        }[op]
+
         def fn(cols, keys):
-            return xp.true_divide(lf(cols, keys), rf(cols, keys))
-        return _objsafe(fn, op, lf, rf) if _maybe_obj(ldt, rdt) else fn
-    if op == "//":
-        def fn(cols, keys):
-            return xp.floor_divide(lf(cols, keys), rf(cols, keys))
-        return _objsafe(fn, op, lf, rf) if _maybe_obj(ldt, rdt) else fn
-    if op == "%":
-        def fn(cols, keys):
-            return xp.mod(lf(cols, keys), rf(cols, keys))
-        return _objsafe(fn, op, lf, rf) if _maybe_obj(ldt, rdt) else fn
+            lv, rv = lf(cols, keys), rf(cols, keys)
+            if xp is not np:  # inside a fused jax kernel: no Error carriers
+                return base(lv, rv)
+            ra = np.asarray(rv)
+            if ra.dtype.kind in "iuf":
+                zeros = ra == 0
+                if zeros.any():
+                    # reference DivisionByZero (expression.rs:846,935):
+                    # zero denominators yield per-row Error values, not
+                    # numpy's silent 0/inf
+                    n = len(keys)
+                    with np.errstate(divide="ignore", invalid="ignore"):
+                        res = base(lv, rv)
+                    out = _materialize(res, n).astype(object)
+                    for i in np.flatnonzero(np.broadcast_to(zeros, (n,))):
+                        out[i] = EngineError("division by zero", op)
+                    return out
+            return base(lv, rv)
+
+        return _objsafe(fn, op, lf, rf)
     if op == "&" and lu == dt.BOOL and ru == dt.BOOL:
         def fn(cols, keys):
             return xp.logical_and(lf(cols, keys), rf(cols, keys))
-        return _objsafe(fn, op, lf, rf) if _maybe_obj(ldt, rdt) else fn
+        return _objsafe(fn, op, lf, rf)
     if op == "|" and lu == dt.BOOL and ru == dt.BOOL:
         def fn(cols, keys):
             return xp.logical_or(lf(cols, keys), rf(cols, keys))
-        return _objsafe(fn, op, lf, rf) if _maybe_obj(ldt, rdt) else fn
+        return _objsafe(fn, op, lf, rf)
 
     import operator as _op
 
@@ -774,8 +793,11 @@ def _binop_fn(op, lf, rf, ldt, rdt, xp):
             return out
         return fn_mm
     if op in ("+", "-", "*", "/", "**", "==", "!=", "<", "<=", ">", ">=",
-              "&", "|", "^") and _maybe_obj(ldt, rdt):
-        # object columns may carry None/Error rows — handle per element
+              "&", "|", "^"):
+        # object columns may carry None/Error rows — handle per element.
+        # Applied even for statically dense dtypes: upstream zero-division
+        # injects Error rows into columns typed non-optional, and _objsafe
+        # only pays one dtype check when the operands stay dense
         return _objsafe(fn, op, lf, rf)
     return fn
 
@@ -816,7 +838,11 @@ def _objsafe(fast_fn, op, lf, rf):
             elif a is None or b is None:
                 out[i] = None
             else:
-                out[i] = f(a, b)
+                try:
+                    out[i] = f(a, b)
+                except Exception as e:  # noqa: BLE001 — row error, not batch
+                    # reference: any DataError becomes a per-row Value::Error
+                    out[i] = EngineError(f"{type(e).__name__}: {e}", op)
         return out
 
     return fn

@@ -1,0 +1,187 @@
+"""Value::Error semantics (reference ``src/engine/error.rs`` +
+``python/pathway/tests/test_errors.py``): errors are per-row values that
+flow through the dataflow without poisoning the stream — division by zero
+makes an Error row (expression.rs:846,935), an Error in a reduced column
+makes the group's aggregate Error until it retracts (reduce.rs:162-173),
+and an Error grouping key skips the row with a log entry
+(dataflow.rs:3026 ErrorInGroupby)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.error import ERROR_LOG
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T, run_table
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    G.clear()
+    yield
+    G.clear()
+
+
+def rows(table):
+    state, _ = run_table(table)
+    return sorted(state.values(), key=repr)
+
+
+def test_division_by_zero_is_error_row():
+    t = T("a | b\n6 | 2\n5 | 0")
+    out = t.select(d=pw.fill_error(pw.this.a // pw.this.b, -1))
+    assert rows(out) == [(-1,), (3,)]
+
+
+def test_mod_and_truediv_by_zero():
+    t = T("a | b\n7 | 0\n7 | 2")
+    out = t.select(
+        m=pw.fill_error(pw.this.a % pw.this.b, -1),
+        q=pw.fill_error(pw.this.a / pw.this.b, -1.0),
+    )
+    assert rows(out) == [(-1, -1.0), (1, 3.5)]
+
+
+def test_unwrap_refuses_error():
+    t = T("a | b\n5 | 0")
+    out = t.select(d=pw.unwrap(pw.this.a // pw.this.b))
+    with pytest.raises(Exception):
+        run_table(out)
+
+
+def test_error_in_reduced_column_makes_group_error():
+    t = T("g | v\na | 1\na | 0\nb | 2")
+    s = t.select(g=pw.this.g, inv=10 // pw.this.v)
+    r = s.groupby(pw.this.g).reduce(
+        pw.this.g,
+        s=pw.reducers.sum(pw.this.inv),
+        c=pw.reducers.count(),
+    )
+    rec = r.select(pw.this.g, s=pw.fill_error(pw.this.s, -999), c=pw.this.c)
+    # count still counts the error row; only the sum turns Error
+    assert rows(rec) == [("a", -999, 2), ("b", 5, 1)]
+
+
+def test_error_retraction_recovers_group():
+    t = T(
+        """
+        g | v | __time__ | __diff__
+        a | 1 | 2        | 1
+        a | 0 | 2        | 1
+        b | 2 | 2        | 1
+        a | 0 | 4        | -1
+        """
+    )
+    s = t.select(g=pw.this.g, inv=10 // pw.this.v)
+    r = s.groupby(pw.this.g).reduce(
+        pw.this.g, s=pw.reducers.sum(pw.this.inv)
+    )
+    rec = r.select(pw.this.g, s=pw.fill_error(pw.this.s, -999))
+    # after the zero row retracts, group a's sum is clean again
+    assert rows(rec) == [("a", 10), ("b", 5)]
+
+
+def test_error_group_key_skips_row_and_logs():
+    before = ERROR_LOG.total
+    t = T("k | v\n2 | 10\n0 | 20")
+    s = t.select(gk=pw.this.v // pw.this.k, v=pw.this.v)
+    r = s.groupby(pw.this.gk).reduce(pw.this.gk, c=pw.reducers.count())
+    assert rows(r) == [(5, 1)]
+    assert ERROR_LOG.total > before
+    assert any("grouping key" in m for m, _ in ERROR_LOG.entries())
+
+
+def test_error_in_min_max_reducers():
+    t = T("g | v\na | 4\na | 0\nb | 3")
+    s = t.select(g=pw.this.g, inv=12 // pw.this.v)
+    r = s.groupby(pw.this.g).reduce(
+        pw.this.g,
+        lo=pw.fill_error(pw.reducers.min(pw.this.inv), -1),
+        hi=pw.fill_error(pw.reducers.max(pw.this.inv), -1),
+    )
+    assert rows(r) == [("a", -1, -1), ("b", 4, 4)]
+
+
+def test_error_join_key_drops_row():
+    l = T("k | x\n1 | 10\n0 | 20")
+    r2 = T("k | y\n10 | 2")
+    lk = l.select(kk=10 // pw.this.k, x=pw.this.x)
+    j = lk.join(r2, lk.kk == r2.k).select(pw.this.x, pw.this.y)
+    assert rows(j) == [(10, 2)]
+
+
+def test_errors_propagate_through_expressions():
+    t = T("a | b\n5 | 0")
+    out = t.select(d=pw.fill_error((pw.this.a // pw.this.b) + 100, -1))
+    assert rows(out) == [(-1,)]
+
+
+def test_division_by_zero_on_optional_column():
+    # optional (object-dtype) denominators hit the per-row path; a zero
+    # must become an Error row there too, not a batch ZeroDivisionError
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int, b=int | None),
+        [(6, 2), (5, 0), (4, None)],
+    )
+    out = t.select(
+        d=pw.fill_error(pw.this.a // pw.this.b, -1),
+    )
+    assert rows(out) == [(-1,), (3,), (None,)]
+
+
+def test_error_flows_through_dense_downstream_ops():
+    # the division's static dtype stays INT, so the downstream * and +
+    # run on a statically-dense column that carries an Error at runtime —
+    # they must pass it through per-row, not crash batch-wide
+    t = T("a | b | c\n8 | 0 | 2\n9 | 3 | 3")
+    out = t.select(d=pw.fill_error((pw.this.a // pw.this.b) * pw.this.c + 1, -1))
+    assert rows(out) == [(-1,), (10,)]
+
+
+def test_errors_seen_latch_survives_log_clear():
+    from pathway_tpu.engine import error as err_mod
+
+    t = T("a | b\n5 | 0")
+    out = t.select(d=pw.fill_error(pw.this.a // pw.this.b, -1))
+    assert rows(out) == [(-1,)]
+    ERROR_LOG.clear()
+    assert err_mod.errors_seen()  # the latch must not reset with the log
+
+
+def test_error_pickle_roundtrip_sets_latch():
+    import pickle
+
+    from pathway_tpu.engine.error import Error
+
+    e = pickle.loads(pickle.dumps(Error("boom", "test")))
+    assert e.message == "boom"
+    assert repr(e) == "Error"
+
+
+def test_stuck_error_group_does_not_spam_log():
+    # a group stuck in error re-derives its aggregate on every later
+    # update; only the original row errors may log (review finding)
+    t = T(
+        """
+        g | v | __time__ | __diff__
+        a | 0 | 2        | 1
+        a | 5 | 4        | 1
+        a | 6 | 6        | 1
+        a | 7 | 8        | 1
+        """
+    )
+    before = ERROR_LOG.total
+    s = t.select(g=pw.this.g, inv=10 // pw.this.v)
+    r = s.groupby(pw.this.g).reduce(pw.this.g, s=pw.reducers.sum(pw.this.inv))
+    rec = r.select(pw.this.g, s=pw.fill_error(pw.this.s, -999))
+    assert rows(rec) == [("a", -999)]
+    # one zero-division row error (possibly re-derived once per batch
+    # retry) — NOT one entry per later clean update
+    assert ERROR_LOG.total - before <= 3
+
+
+def test_zero_denominator_constant():
+    t = T("a\n5\n6")
+    out = t.select(d=pw.fill_error(pw.this.a // 0, -1))
+    assert rows(out) == [(-1,), (-1,)]

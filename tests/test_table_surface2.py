@@ -292,17 +292,22 @@ def test_fuzzy_match_mutual_best_is_intersection():
     # have mis-declared the universe here (review finding)
     from pathway_tpu.stdlib.ml import fuzzy_match
 
+    # l0="alpha beta gamma" matches r0 strongly (3 shared) and r1 weakly
+    # (beta only); nothing else matches r1. best-for-l0 = r0, but
+    # best-for-r1 = l0 — that pair is NOT mutual and must be cut, so
+    # exactly one pair survives. The old restrict-based cut promised a
+    # false subset here (review finding).
     left = pw.debug.table_from_rows(
         pw.schema_from_types(v=str),
-        [("alpha beta gamma",), ("delta",)],
+        [("alpha beta gamma",), ("zeta",)],
     )
     right = pw.debug.table_from_rows(
         pw.schema_from_types(v=str),
-        [("alpha beta gamma",), ("beta delta epsilon",)],
+        [("alpha beta gamma",), ("beta epsilon",)],
     )
     m = fuzzy_match(left.v, right.v)
     got = rows_of(m.select(pw.this.weight))
-    assert len(got) >= 1  # runs clean end-to-end with the intersection cut
+    assert len(got) == 1
 
 
 def test_declare_type_changes_dtype():
