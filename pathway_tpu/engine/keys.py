@@ -124,6 +124,13 @@ def hash_column(col: np.ndarray) -> np.ndarray:
     return _hash_object_column(col)
 
 
+#: reserved join-key sentinel for rows whose key expression evaluated to an
+#: Error: deterministic (retraction-consistent) yet never entered into join
+#: state — the Join node drops sentinel rows with a log entry, so Error
+#: keys match nothing, including each other (reference: Error == nothing)
+ERROR_KEY = np.uint64(0xE707_0E0E_DEAD_0001)
+
+
 def mix_columns(cols: list[np.ndarray], n: int, salt: int = 0) -> KeyArray:
     """Derive a key per row from the given columns (vectorized).
 

@@ -103,7 +103,22 @@ class Filter(Node):
             return None
         mask = np.asarray(self._predicate(d.data, d.keys))
         if mask.dtype == object:
-            mask = np.array([bool(x) for x in mask], dtype=bool)
+            # an Error condition drops the row with a log entry instead of
+            # crashing the batch (reference: filter skips error rows)
+            out = np.empty(len(mask), dtype=bool)
+            logged = False
+            for i, x in enumerate(mask):
+                if type(x) is EngineError:
+                    out[i] = False
+                    if not logged:
+                        ERROR_LOG.record(
+                            "Error value in filter condition; row skipped",
+                            "filter",
+                        )
+                        logged = True
+                else:
+                    out[i] = bool(x)
+            mask = out
         return d.take(np.flatnonzero(mask))
 
 
@@ -886,6 +901,21 @@ class Join(Node):
         out[2].append(diff)
 
     @staticmethod
+    def _drop_error_keys(delta: Delta | None, jk_col: str | None):
+        """Rows whose join key evaluated to an Error carry the reserved
+        ``K.ERROR_KEY`` sentinel (graph_runner jk_fn) — drop them with a
+        log entry before they reach join state, so Error keys match
+        nothing (Error compares equal to nothing, value.rs:226)."""
+        if delta is None or jk_col is None or not len(delta):
+            return delta
+        jks = np.asarray(delta.data[jk_col], dtype=np.uint64)
+        m = jks == K.ERROR_KEY
+        if not m.any():
+            return delta
+        ERROR_LOG.record("Error value in join key; row skipped", "join")
+        return delta.take(np.flatnonzero(~m))
+
+    @staticmethod
     def _rows_of(delta: Delta | None, jk_col: str | None, cols: list[str]):
         """Yield (jk, row_key, row_values, diff) for a delta. jk_col=None
         means join on the row key itself (restrict/ix/zip-by-universe)."""
@@ -1026,6 +1056,11 @@ class Join(Node):
             ))
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
+        if errors_seen():
+            ins = [
+                self._drop_error_keys(d, jk)
+                for d, jk in zip(ins, (self._ljk, self._rjk))
+            ]
         if self._columnar:
             return self._process_columnar(ins)
         dl = self._rows_of(ins[0], self._ljk, self._lcols)

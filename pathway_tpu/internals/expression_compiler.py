@@ -734,8 +734,7 @@ def _binop_fn(op, lf, rf, ldt, rdt, xp):
             "/": xp.true_divide, "//": xp.floor_divide, "%": xp.mod
         }[op]
 
-        def fn(cols, keys):
-            lv, rv = lf(cols, keys), rf(cols, keys)
+        def vec(lv, rv, keys):
             if xp is not np:  # inside a fused jax kernel: no Error carriers
                 return base(lv, rv)
             ra = np.asarray(rv)
@@ -754,15 +753,15 @@ def _binop_fn(op, lf, rf, ldt, rdt, xp):
                     return out
             return base(lv, rv)
 
-        return _objsafe(fn, op, lf, rf)
+        return _objsafe(vec, op, lf, rf)
     if op == "&" and lu == dt.BOOL and ru == dt.BOOL:
-        def fn(cols, keys):
-            return xp.logical_and(lf(cols, keys), rf(cols, keys))
-        return _objsafe(fn, op, lf, rf)
+        return _objsafe(
+            lambda lv, rv, keys: xp.logical_and(lv, rv), op, lf, rf
+        )
     if op == "|" and lu == dt.BOOL and ru == dt.BOOL:
-        def fn(cols, keys):
-            return xp.logical_or(lf(cols, keys), rf(cols, keys))
-        return _objsafe(fn, op, lf, rf)
+        return _objsafe(
+            lambda lv, rv, keys: xp.logical_or(lv, rv), op, lf, rf
+        )
 
     import operator as _op
 
@@ -778,9 +777,6 @@ def _binop_fn(op, lf, rf, ldt, rdt, xp):
         def fn(cols, keys):
             return f(np.asarray(lf(cols, keys), dtype=np.uint64), np.asarray(rf(cols, keys), dtype=np.uint64))
         return fn
-
-    def fn(cols, keys):
-        return f(lf(cols, keys), rf(cols, keys))
 
     if op == "@":
         def fn_mm(cols, keys):
@@ -798,15 +794,19 @@ def _binop_fn(op, lf, rf, ldt, rdt, xp):
         # Applied even for statically dense dtypes: upstream zero-division
         # injects Error rows into columns typed non-optional, and _objsafe
         # only pays one dtype check when the operands stay dense
-        return _objsafe(fn, op, lf, rf)
+        return _objsafe(lambda lv, rv, keys: f(lv, rv), op, lf, rf)
+
+    def fn(cols, keys):
+        return f(lf(cols, keys), rf(cols, keys))
     return fn
 
 
-def _maybe_obj(ldt, rdt) -> bool:
-    return ldt.is_optional or rdt.is_optional or ldt == dt.ANY or rdt == dt.ANY
-
-
-def _objsafe(fast_fn, op, lf, rf):
+def _objsafe(vec_fn, op, lf, rf):
+    """Wrap a value-level vectorized op: operands are evaluated ONCE, then
+    either handed to ``vec_fn`` (dense fast path) or walked per-row with
+    None/Error semantics. ``vec_fn(lv, rv, keys)`` must not re-invoke the
+    operand closures — that re-evaluation compounds 2**depth over nested
+    expressions (review finding r3)."""
     import operator as _op
 
     py_ops = {
@@ -825,7 +825,7 @@ def _objsafe(fast_fn, op, lf, rf):
         lo = isinstance(l, np.ndarray) and l.dtype == object
         ro = isinstance(r, np.ndarray) and r.dtype == object
         if not lo and not ro:
-            return fast_fn(cols, keys)
+            return vec_fn(l, r, keys)
         n = len(keys)
         la, ra = _materialize(l, n), _materialize(r, n)
         out = np.empty(n, dtype=object)

@@ -551,8 +551,23 @@ class GraphRunner:
 
         def jk_fn(fns):
             def fn(cols, keys):
-                vals = [np.asarray(_mat(f(cols, keys), len(keys))) for f in fns]
-                return K.mix_columns(vals, len(keys))
+                n = len(keys)
+                vals = [np.asarray(_mat(f(cols, keys), n)) for f in fns]
+                jks = K.mix_columns(vals, n)
+                from ..engine.error import Error as _Err, errors_seen
+
+                if errors_seen():
+                    # Error join keys hash by repr and would spuriously
+                    # match each other — mark them with the reserved
+                    # sentinel; the Join node drops sentinel rows + logs
+                    for v in vals:
+                        if v.dtype == object:
+                            m = np.fromiter(
+                                (type(x) is _Err for x in v), bool, n
+                            )
+                            if m.any():
+                                jks[m] = K.ERROR_KEY
+                return jks
             return fn
 
         lrw = self._add(ops.Rowwise(lnode, {

@@ -624,6 +624,12 @@ class Table(TableLike):
 
         return _diff(self, timestamp, *values, instance=instance)
 
+    def interpolate(self, timestamp: Any, *values: Any, **kwargs: Any) -> "Table":
+        # reference attaches the stdlib fn as a Table method (table.py:75)
+        from ..stdlib.statistical import interpolate as _interp
+
+        return _interp(self, timestamp, *values, **kwargs)
+
 
 def _expression_table(expr: Any):
     """The unique concrete table an expression refers to (for ix context)."""

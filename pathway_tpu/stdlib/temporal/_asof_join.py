@@ -182,6 +182,15 @@ class AsofJoinResult:
                     return ColumnReference(self._left, x.name)
                 if x.table is pw_right:
                     return ColumnReference(self._right, x.name)
+                if x.table is this:
+                    from ._shared import this_side as _this_side
+
+                    side = _this_side(
+                        x.name, self._left, self._right, "asof_join"
+                    )
+                    return ColumnReference(
+                        self._left if side == "l" else self._right, x.name
+                    )
                 return x
             if not getattr(x, "_deps", ()):
                 return x

@@ -25,6 +25,7 @@ from ...internals.expression import (
 from ...internals.joins import JoinMode
 from ...internals.table import Table
 from ...internals.thisclass import left as pw_left, right as pw_right, substitute, this
+from ._shared import this_side as _this_side
 
 __all__ = [
     "interval",
@@ -162,7 +163,10 @@ class IntervalJoinResult:
                 if x.table is rt or x.table is pw_right:
                     return ColumnReference(matched_t, f"r.{x.name}")
                 if x.table is this:
-                    raise ValueError("use pw.left/pw.right in interval_join select")
+                    # pw.this desugars by column-name side lookup, exactly
+                    # like the plain-join result (joins.py _lookup)
+                    side = _this_side(x.name, lt, rt, "interval_join")
+                    return ColumnReference(matched_t, f"{side}.{x.name}")
                 return x
             if not getattr(x, "_deps", ()):
                 return x

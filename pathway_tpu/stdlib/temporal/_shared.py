@@ -1,0 +1,25 @@
+"""Shared helpers for the temporal join family."""
+
+from __future__ import annotations
+
+from ...internals.table import Table
+
+__all__ = ["this_side"]
+
+
+def this_side(name: str, lt: Table, rt: Table, ctx: str) -> str:
+    """Which side a ``pw.this.name`` reference means in a two-sided join
+    result: 'l' or 'r' by column-name lookup, refusing ambiguity (the
+    plain-join model: joins.py ``_lookup``)."""
+    in_l = name in lt.column_names()
+    in_r = name in rt.column_names()
+    if in_l and in_r:
+        raise ValueError(
+            f"column {name!r} exists on both sides of the {ctx}; "
+            "use pw.left / pw.right to disambiguate"
+        )
+    if in_l:
+        return "l"
+    if in_r:
+        return "r"
+    raise AttributeError(f"{ctx} result has no column {name!r}")

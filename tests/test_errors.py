@@ -185,3 +185,38 @@ def test_zero_denominator_constant():
     t = T("a\n5\n6")
     out = t.select(d=pw.fill_error(pw.this.a // 0, -1))
     assert rows(out) == [(-1,), (-1,)]
+
+
+def test_error_keys_on_both_sides_never_match():
+    # two Error join keys must not match each other (Error == nothing)
+    l = T("k | x\n1 | 10\n0 | 20")
+    r2 = T("k | y\n1 | 2\n0 | 3")
+    lk = l.select(kk=10 // pw.this.k, x=pw.this.x)
+    rk = r2.select(kk=10 // pw.this.k, y=pw.this.y)
+    j = lk.join(rk, lk.kk == rk.kk).select(pw.this.x, pw.this.y)
+    assert rows(j) == [(10, 2)]
+    assert any("join key" in m for m, _ in ERROR_LOG.entries())
+
+
+def test_error_filter_condition_skips_row():
+    t = T("a | b\n6 | 2\n5 | 0")
+    f = t.filter((pw.this.a // pw.this.b) == 3)
+    assert rows(f) == [(6, 2)]
+    assert any("filter condition" in m for m, _ in ERROR_LOG.entries())
+
+
+def test_error_join_key_retraction_consistent():
+    # insert then retract a row with an Error key: state stays clean and
+    # the live rows still join (the sentinel is deterministic)
+    l = T(
+        """
+        k | x | __time__ | __diff__
+        1 | 10 | 2       | 1
+        0 | 20 | 2       | 1
+        0 | 20 | 4       | -1
+        """
+    )
+    r2 = T("k | y\n10 | 7")
+    lk = l.select(kk=10 // pw.this.k, x=pw.this.x)
+    j = lk.join(r2, lk.kk == r2.k).select(pw.this.x, pw.this.y)
+    assert rows(j) == [(10, 7)]

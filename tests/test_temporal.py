@@ -4,9 +4,11 @@ mirrors reference temporal/test_windows.py, test_interval_joins.py style."""
 import pytest
 
 import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.testing import (
     T,
     assert_table_equality_wo_index,
+    run_table,
 )
 
 
@@ -443,3 +445,36 @@ def test_common_behavior_keep_results_false():
     final = {row[0]: row[1] for _, row in cap.state.iter_items()}
     # windows [0,10) and [10,20) are past cutoff by the final time → dropped
     assert final == {30: 3}, final
+
+
+def test_temporal_joins_desugar_this():
+    """pw.this in interval/asof/window join select desugars by column-name
+    side lookup, like the plain-join result (reference desugaring)."""
+    G.clear()
+    l = T("t | a\n1 | x\n5 | y")
+    r = T("t | b\n2 | p\n9 | q")
+    j = l.interval_join(r, l.t, r.t, pw.temporal.interval(-2, 2)).select(
+        pw.this.a, pw.this.b
+    )
+    assert sorted(run_table(j)[0].values()) == [("x", "p")]
+    with pytest.raises(ValueError, match="both sides"):
+        l.interval_join(r, l.t, r.t, pw.temporal.interval(-2, 2)).select(
+            pw.this.t
+        )
+    G.clear()
+    l = T("t | a\n1 | 10\n5 | 50")
+    r = T("t | b\n0 | 1\n4 | 2")
+    j = l.asof_join(r, l.t, r.t).select(pw.this.a, pw.this.b)
+    assert sorted(run_table(j)[0].values()) == [(10, 1), (50, 2)]
+
+
+def test_table_interpolate_method():
+    """Table.interpolate (stdlib statistical attached as a method,
+    reference table.py:75)."""
+    G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(t=int, v=float | None),
+        [(1, 1.0), (2, None), (3, 3.0)],
+    )
+    r = t.interpolate(pw.this.t, pw.this.v)
+    assert sorted(run_table(r)[0].values()) == [(1, 1.0), (2, 2.0), (3, 3.0)]
