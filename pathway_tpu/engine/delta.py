@@ -41,33 +41,34 @@ def rows_equal(a: tuple | None, b: tuple | None) -> bool:
 
 
 def column_of_values(values: list[Any]) -> np.ndarray:
-    """Build a column array from python values, picking the densest dtype."""
+    """Build a column array from python values, picking the densest dtype.
+
+    Dispatches on ONE C-speed ``set(map(type, ...))`` pass instead of
+    several per-value ``any``/``all`` generator scans — this sits on the
+    per-row ingestion hot path (ConnectorSubject.next → rows_to_columns)."""
     if not values:
         return np.empty(0, dtype=object)
-    # unwrap numpy scalars so cells extracted from dense arrays (groupby/join
-    # rebuilds) re-densify instead of degrading every column to object dtype
-    if any(isinstance(v, np.generic) for v in values):
-        values = [v.item() if isinstance(v, np.generic) else v for v in values]
-    first_non_none = next((v for v in values if v is not None), None)
-    if any(v is None for v in values):
-        return _object_column(values)
-    if isinstance(first_non_none, bool):
-        if all(isinstance(v, bool) for v in values):
-            return np.array(values, dtype=np.bool_)
-        return _object_column(values)
-    if isinstance(first_non_none, int) and not isinstance(first_non_none, bool):
-        if all(type(v) is int for v in values):
+    types = set(map(type, values))
+    if len(types) == 1:
+        t = next(iter(types))
+        if t is int:
             try:
                 return np.array(values, dtype=np.int64)
             except OverflowError:
                 return _object_column(values)
-        if all(isinstance(v, (int, float)) and not isinstance(v, bool) for v in values):
+        if t is float:
             return np.array(values, dtype=np.float64)
-        return _object_column(values)
-    if isinstance(first_non_none, float):
-        if all(isinstance(v, (int, float)) and not isinstance(v, bool) for v in values):
-            return np.array(values, dtype=np.float64)
-        return _object_column(values)
+        if t is bool:
+            return np.array(values, dtype=np.bool_)
+    if any(issubclass(t, np.generic) for t in types):
+        # unwrap numpy scalars so cells extracted from dense arrays
+        # (groupby/join rebuilds) re-densify instead of degrading every
+        # column to object dtype
+        return column_of_values(
+            [v.item() if isinstance(v, np.generic) else v for v in values]
+        )
+    if types == {int, float}:
+        return np.array(values, dtype=np.float64)
     return _object_column(values)
 
 

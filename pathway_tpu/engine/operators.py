@@ -908,8 +908,16 @@ class Join(Node):
         nothing (Error compares equal to nothing, value.rs:226)."""
         if delta is None or jk_col is None or not len(delta):
             return delta
-        jks = np.asarray(delta.data[jk_col], dtype=np.uint64)
-        m = jks == K.ERROR_KEY
+        col = np.asarray(delta.data[jk_col])
+        if col.dtype == object:
+            # raw pointer key columns (optional ix / having) may hold
+            # None or Error objects — drop only the Errors here; None
+            # keeps its pre-existing downstream handling
+            m = np.fromiter(
+                (type(v) is EngineError for v in col), bool, len(col)
+            )
+        else:
+            m = col.astype(np.uint64, copy=False) == K.ERROR_KEY
         if not m.any():
             return delta
         ERROR_LOG.record("Error value in join key; row skipped", "join")

@@ -795,10 +795,7 @@ def _binop_fn(op, lf, rf, ldt, rdt, xp):
         # injects Error rows into columns typed non-optional, and _objsafe
         # only pays one dtype check when the operands stay dense
         return _objsafe(lambda lv, rv, keys: f(lv, rv), op, lf, rf)
-
-    def fn(cols, keys):
-        return f(lf(cols, keys), rf(cols, keys))
-    return fn
+    raise AssertionError(f"unhandled binop {op!r}")  # every py_ops key is covered above
 
 
 def _objsafe(vec_fn, op, lf, rf):

@@ -66,7 +66,10 @@ def _coerce_column(col: np.ndarray, target: dt.DType) -> np.ndarray:
     if col.dtype == object and not target.is_optional and target.numpy_dtype != np.dtype(object):
         try:
             return col.astype(target.numpy_dtype)
-        except (ValueError, TypeError):
+        except (ValueError, TypeError, OverflowError):
+            # e.g. a python int beyond int64: the engine's general paths
+            # handle big ints exactly as objects (vs the reference's hard
+            # i64 Value::Int) — degrade, don't crash ingestion
             return col
     return col
 

@@ -212,6 +212,14 @@ class IntervalJoinResult:
             import copy
 
             if isinstance(x, ColumnReference):
+                if x.table is this:
+                    # same side lookup as the matched path — an own-side
+                    # pw.this column keeps its value in pad rows
+                    this_side_ = _this_side(x.name, lt, rt, "interval_join")
+                    own = (this_side_ == "l") == (side == "left")
+                    if own:
+                        return ColumnReference(unmatched, x.name)
+                    return ColumnConstExpression(None)
                 own = (x.table is lt or x.table is pw_left) if side == "left" else (
                     x.table is rt or x.table is pw_right
                 )

@@ -220,3 +220,16 @@ def test_error_join_key_retraction_consistent():
     lk = l.select(kk=10 // pw.this.k, x=pw.this.x)
     j = lk.join(r2, lk.kk == r2.k).select(pw.this.x, pw.this.y)
     assert rows(j) == [(10, 7)]
+
+
+def test_optional_ix_after_errors_latched():
+    # an unrelated error latches errors_seen(); a later optional-pointer
+    # ix join (object key column holding None) must still work
+    t0 = T("a\n0")
+    assert rows(t0.select(e=pw.fill_error(10 // pw.this.a, -1))) == [(-1,)]
+    G.clear()
+    src = T("k | v\na | 1\nb | 2").with_id_from(pw.this.k)
+    q = T("k\na\nz")
+    ptr = q.select(p=src.pointer_from(q.k))
+    r = src.ix(ptr.p, optional=True, context=ptr).select(pw.this.v)
+    assert rows(r) == sorted([(1,), (None,)], key=repr)

@@ -478,3 +478,17 @@ def test_table_interpolate_method():
     )
     r = t.interpolate(pw.this.t, pw.this.v)
     assert sorted(run_table(r)[0].values()) == [(1, 1.0), (2, 2.0), (3, 3.0)]
+
+
+def test_interval_join_left_pads_keep_this_columns():
+    """Pad rows of outer modes must keep own-side pw.this values (review:
+    the pad path used to null them while pw.left kept them)."""
+    G.clear()
+    l = T("t | a\n1 | x\n9 | y")
+    r = T("t | b\n2 | p")
+    j = l.interval_join_left(r, l.t, r.t, pw.temporal.interval(-2, 2)).select(
+        pw.this.a, pw.this.b
+    )
+    assert sorted(run_table(j)[0].values(), key=repr) == [
+        ("x", "p"), ("y", None)
+    ]
