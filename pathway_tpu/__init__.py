@@ -88,6 +88,11 @@ Duration = _dt.DURATION
 
 
 from .internals.iterate import iterate, iterate_universe  # noqa: E402
+from .internals.interactive import (  # noqa: E402
+    LiveTable,
+    enable_interactive_mode,
+    is_interactive_mode_enabled,
+)
 
 
 def set_license_key(key: str | None) -> None:  # compatibility no-op

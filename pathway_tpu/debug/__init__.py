@@ -272,13 +272,17 @@ def _format_cell(v: Any, short_pointers: bool) -> str:
     return repr(v) if isinstance(v, str) else str(v)
 
 
-def _print_table(header: list[str], rows: list[list[str]]) -> None:
+def _render_table(header: list[str], rows: list[list[str]]) -> str:
     widths = [len(h) for h in header]
     for r in rows:
         widths = [max(w, len(c)) for w, c in zip(widths, r)]
-    print(" | ".join(h.ljust(w) for h, w in zip(header, widths)))
-    for r in rows:
-        print(" | ".join(c.ljust(w) for c, w in zip(r, widths)))
+    lines = [" | ".join(h.ljust(w) for h, w in zip(header, widths))]
+    lines += [" | ".join(c.ljust(w) for c, w in zip(r, widths)) for r in rows]
+    return "\n".join(lines)
+
+
+def _print_table(header: list[str], rows: list[list[str]]) -> None:
+    print(_render_table(header, rows))
 
 
 def table_to_dicts(table: Table):
@@ -337,3 +341,14 @@ class StreamGenerator:
         return self.table_from_list_of_batches_by_workers(
             [{0: b} for b in batches], schema
         )
+
+
+def _format_snapshot(names: list[str], rows: dict[int, tuple], frontier: int) -> str:
+    """Render a LiveTable snapshot (internals/interactive.py) in the same
+    table format compute_and_print uses, returned as a string."""
+    header = ["id"] + names
+    lines = [
+        [_format_pointer(key)] + [_format_cell(v, True) for v in row]
+        for key, row in sorted(rows.items())
+    ]
+    return _render_table(header, lines) + f"\n[frontier {frontier}]"

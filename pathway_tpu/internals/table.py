@@ -630,6 +630,14 @@ class Table(TableLike):
 
         return _interp(self, timestamp, *values, **kwargs)
 
+    def live(self):
+        """Run this table's subgraph on a background engine thread and
+        return a continuously-updated handle (reference interactive.py:130
+        ``LiveTable``; observe with snapshot()/frontier()/subscribe())."""
+        from .interactive import LiveTable
+
+        return LiveTable(self)
+
 
 def _expression_table(expr: Any):
     """The unique concrete table an expression refers to (for ix context)."""

@@ -1,0 +1,120 @@
+"""Interactive LiveTable (internals/interactive.py — reference
+``python/pathway/internals/interactive.py:130``)."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    G.clear()
+    yield
+    G.clear()
+
+
+def test_live_static_table_snapshot():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | x
+        2 | y
+        """
+    )
+    live = t.select(pw.this.a, up=pw.this.b.str.upper()).live()
+    live._stopped.wait(10)  # static graph finishes on its own
+    assert not live.failed()
+    snap = live.snapshot()
+    assert len(snap) == 2
+    assert sorted(snap.rows.values()) == [(1, "X"), (2, "Y")]
+    rendered = str(live)
+    assert "up" in rendered and "'X'" in rendered
+
+
+def test_live_streaming_updates_and_subscribe():
+    class Feed(pw.io.python.ConnectorSubject):
+        def run(self) -> None:
+            for i in range(3):
+                self.next(v=i)
+                self.commit()
+                time.sleep(0.02)
+
+    t = pw.io.python.read(
+        Feed(), schema=pw.schema_from_types(v=int),
+        autocommit_duration_ms=None,
+    )
+    total = t.groupby().reduce(s=pw.reducers.sum(pw.this.v))
+    live = total.live()
+    seen = []
+    live.subscribe(
+        lambda **kw: seen.append(kw["row"]["s"]) if kw["is_addition"] else None
+    )
+    live._stopped.wait(15)
+    assert not live.failed(), live._error
+    snap = live.snapshot()
+    assert list(snap.rows.values()) == [(3,)]  # 0+1+2
+    assert seen[-1] == 3
+    assert live.frontier() > 0
+
+
+def test_live_failure_is_reported():
+    t = pw.debug.table_from_markdown("a\n1")
+
+    def boom(v):
+        raise RuntimeError("kaboom")
+
+    live = t.select(b=pw.unwrap(pw.apply(boom, pw.this.a))).live()
+    live._stopped.wait(10)
+    assert live.failed()
+    assert "FAILED" in str(live)
+
+
+def test_enable_interactive_mode_displayhook(capsys):
+    ctrl = pw.enable_interactive_mode()
+    try:
+        assert pw.is_interactive_mode_enabled()
+        t = pw.debug.table_from_markdown("a\n7")
+        live = t.live()
+        live._stopped.wait(10)
+        sys.displayhook(live)  # what the REPL does for a bare expression
+        out = capsys.readouterr().out
+        assert "a" in out and "7" in out
+    finally:
+        ctrl.disable()
+
+
+def test_live_stop_races_startup():
+    class Feed(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time as _t
+
+            while True:
+                self.next(v=1)
+                self.commit()
+                _t.sleep(0.01)
+
+    t = pw.io.python.read(
+        Feed(), schema=pw.schema_from_types(v=int),
+        autocommit_duration_ms=None,
+    )
+    live = t.live()
+    live.stop()  # may fire before the executor exists — must still stop
+    assert live._stopped.is_set()
+
+
+def test_interactive_mode_reenable_after_disable():
+    ctrl = pw.enable_interactive_mode()
+    ctrl.disable()
+    assert not pw.is_interactive_mode_enabled()
+    ctrl2 = pw.enable_interactive_mode()
+    try:
+        assert pw.is_interactive_mode_enabled()
+        assert ctrl2 is not ctrl
+    finally:
+        ctrl2.disable()
