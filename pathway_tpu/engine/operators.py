@@ -1387,18 +1387,45 @@ class Flatten(Node):
         )
 
 
+def _pop_due(store: dict[int, list], watermark: int) -> list:
+    """Pop all (key, row, diff) entries whose threshold <= watermark."""
+    due = [t for t in store if t <= watermark]
+    entries = []
+    for t in sorted(due):
+        entries.extend(store.pop(t))
+    return entries
+
+
+def _entries_delta(
+    entries: list, names: list[str], negate: bool = False
+) -> Delta | None:
+    if not entries:
+        return None
+    keys = np.array([e[0] for e in entries], dtype=np.uint64)
+    rows = [e[1] for e in entries]
+    sign = -1 if negate else 1
+    diffs = np.array([sign * e[2] for e in entries], dtype=np.int64)
+    return Delta(
+        keys=keys, data=rows_to_columns(rows, names), diffs=diffs
+    ).consolidated()
+
+
 class BufferUntil(Node):
     """Temporal buffer (reference ``time_column.rs`` postpone_core/
-    TimeColumnBuffer :255,380): hold each row until logical time reaches its
-    threshold column value; release on advance_to / end of stream. Buffered
-    insert+retract pairs cancel before ever being emitted — the mechanism
-    behind exactly-once window outputs."""
+    TimeColumnBuffer :255,380): hold each row until the EVENT-TIME
+    watermark (max value of ``watermark_col`` seen so far — the reference's
+    time-column frontier) reaches its threshold column value; release on
+    watermark progress / end of stream. Without a ``watermark_col`` the
+    engine's logical time drives releases instead. Buffered insert+retract
+    pairs cancel before ever being emitted — the mechanism behind
+    exactly-once window outputs."""
 
     STATE_FIELDS = ("_buffer", "_watermark")
 
-    def __init__(self, inp: Node, threshold_col: str):
+    def __init__(self, inp: Node, threshold_col: str, watermark_col: str | None = None):
         super().__init__([inp], inp.column_names)
         self._col = threshold_col
+        self._wm_col = watermark_col
         # threshold -> list[(key, row, diff)]
         self._buffer: dict[int, list] = {}
         self._watermark = -(1 << 62)
@@ -1408,33 +1435,45 @@ class BufferUntil(Node):
         if d is None or not len(d):
             return None
         thr = np.asarray(d.data[self._col], dtype=np.int64)
+        if self._wm_col is not None:
+            evt = np.asarray(d.data[self._wm_col], dtype=np.int64)
+            self._watermark = max(self._watermark, int(evt.max()))
         pass_now = thr <= self._watermark
-        out = d.take(np.flatnonzero(pass_now))
+        out_parts = [d.take(np.flatnonzero(pass_now))]
         hold_ix = np.flatnonzero(~pass_now)
         cols = list(d.data.values())
         for i in hold_ix:
             self._buffer.setdefault(int(thr[i]), []).append(
                 (int(d.keys[i]), tuple(c[i] for c in cols), int(d.diffs[i]))
             )
-        return out if len(out) else None
+        if self._wm_col is not None:
+            # logical-time mode releases in advance_to (already ran this
+            # tick); scanning the buffer here would be guaranteed-empty work
+            released = _entries_delta(
+                _pop_due(self._buffer, self._watermark), self.column_names
+            )
+            if released is not None:
+                out_parts.append(released)
+        out_parts = [p for p in out_parts if p is not None and len(p)]
+        if not out_parts:
+            return None
+        return concat_deltas(out_parts, self.column_names)
 
     def advance_to(self, time: int) -> Delta | None:
-        self._watermark = time
-        due = [t for t in self._buffer if t <= time]
-        if not due:
+        if self._wm_col is not None:
+            # event-time mode: logical time does not move the watermark
+            # (data does); END flushes via on_end
             return None
-        entries = []
-        for t in sorted(due):
-            entries.extend(self._buffer.pop(t))
-        keys = np.array([e[0] for e in entries], dtype=np.uint64)
-        rows = [e[1] for e in entries]
-        diffs = np.array([e[2] for e in entries], dtype=np.int64)
-        return Delta(
-            keys=keys, data=rows_to_columns(rows, self.column_names), diffs=diffs
-        ).consolidated()
+        self._watermark = time
+        return _entries_delta(
+            _pop_due(self._buffer, self._watermark), self.column_names
+        )
 
     def on_end(self) -> Delta | None:
-        return self.advance_to(END_TIME)
+        self._watermark = 1 << 62
+        return _entries_delta(
+            _pop_due(self._buffer, self._watermark), self.column_names
+        )
 
 
 class ForgetAfter(Node):
@@ -1442,17 +1481,33 @@ class ForgetAfter(Node):
     :556 / ignore_late :631): drop rows arriving after their threshold has
     passed; if ``forget_state``, also retract previously-passed rows once the
     watermark crosses their threshold (bounding downstream state — the
-    keep_results=False behavior)."""
+    keep_results=False behavior). With a ``watermark_col`` the watermark is
+    the max EVENT-TIME value seen (the reference's time-column frontier);
+    otherwise the engine's logical time. Lateness is judged against the
+    watermark BEFORE the arriving batch — a row never makes itself late."""
 
     STATE_FIELDS = ("_live", "_watermark")
 
-    def __init__(self, inp: Node, threshold_col: str, forget_state: bool = False):
+    def __init__(
+        self,
+        inp: Node,
+        threshold_col: str,
+        forget_state: bool = False,
+        watermark_col: str | None = None,
+    ):
         super().__init__([inp], inp.column_names)
         self._col = threshold_col
         self._forget = forget_state
+        self._wm_col = watermark_col
         self._watermark = -(1 << 62)
         # threshold -> list[(key, row, diff)] of rows passed through
         self._live: dict[int, list] = {}
+
+    def _retract_due(self) -> Delta | None:
+        return _entries_delta(
+            _pop_due(self._live, self._watermark), self.column_names,
+            negate=True,
+        )
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d = ins[0]
@@ -1461,6 +1516,9 @@ class ForgetAfter(Node):
         thr = np.asarray(d.data[self._col], dtype=np.int64)
         keep = thr > self._watermark
         out = d.take(np.flatnonzero(keep))
+        if self._wm_col is not None:
+            evt = np.asarray(d.data[self._wm_col], dtype=np.int64)
+            self._watermark = max(self._watermark, int(evt.max()))
         if self._forget and len(out):
             cols = list(out.data.values())
             thr_kept = np.asarray(out.data[self._col], dtype=np.int64)
@@ -1468,24 +1526,25 @@ class ForgetAfter(Node):
                 self._live.setdefault(int(thr_kept[i]), []).append(
                     (int(out.keys[i]), tuple(c[i] for c in cols), int(out.diffs[i]))
                 )
-        return out if len(out) else None
+        parts = [out] if len(out) else []
+        if self._forget and self._wm_col is not None:
+            retracted = self._retract_due()
+            if retracted is not None and len(retracted):
+                parts.append(retracted)
+        if not parts:
+            return None
+        return concat_deltas(parts, self.column_names)
 
     def advance_to(self, time: int) -> Delta | None:
+        if self._wm_col is not None:
+            # event-time mode: watermark moves with data only; windows past
+            # their cutoff at stream END stay emitted (keep_results
+            # retraction happens only when data pushed the watermark past)
+            return None
         self._watermark = time
         if not self._forget:
             return None
-        due = [t for t in self._live if t <= time]
-        if not due:
-            return None
-        entries = []
-        for t in sorted(due):
-            entries.extend(self._live.pop(t))
-        keys = np.array([e[0] for e in entries], dtype=np.uint64)
-        rows = [e[1] for e in entries]
-        diffs = np.array([-e[2] for e in entries], dtype=np.int64)
-        return Delta(
-            keys=keys, data=rows_to_columns(rows, self.column_names), diffs=diffs
-        ).consolidated()
+        return self._retract_due()
 
 
 class Deduplicate(Node):

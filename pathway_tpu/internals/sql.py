@@ -97,6 +97,7 @@ class _Node(dict):
 
 class _Parser:
     def __init__(self, tokens: list[tuple[str, Any]]):
+        self._anon = 0
         self.toks = tokens
         self.i = 0
 
@@ -129,13 +130,19 @@ class _Parser:
     # -- grammar --
 
     def parse(self) -> _Node:
+        node = self.query()
+        if self.peek()[0] != "eof":
+            raise SqlSyntaxError(f"trailing tokens: {self.toks[self.i:]}")
+        return node
+
+    def query(self) -> _Node:
+        """SELECT with optional UNION [ALL] chain (also the body of a
+        parenthesized derived table)."""
         node = self.select()
         while self.accept("kw", "union"):
             all_ = self.accept("kw", "all")
             rhs = self.select()
             node = _Node("union", left=node, right=rhs, all=all_)
-        if self.peek()[0] != "eof":
-            raise SqlSyntaxError(f"trailing tokens: {self.toks[self.i:]}")
         return node
 
     def select(self) -> _Node:
@@ -187,6 +194,21 @@ class _Parser:
         )
 
     def table_ref(self) -> _Node:
+        if self.accept("op", "("):
+            # derived table: FROM (SELECT ... [UNION ...]) [AS] alias
+            inner = self.query()
+            self.expect("op", ")")
+            alias = None
+            if self.accept("kw", "as"):
+                alias = self.expect("name")
+            elif self.peek()[0] == "name":
+                alias = self.next()[1]
+            if alias is None:
+                # distinct fallback aliases: two anonymous derived tables
+                # in one query must not evict each other from the env
+                self._anon += 1
+                alias = f"_subquery_{self._anon}"
+            return _Node("subquery", select=inner, alias=alias)
         name = self.expect("name")
         alias = None
         if self.accept("kw", "as"):
@@ -377,6 +399,8 @@ class _Compiler:
         """The working table + alias env. Joins compile to pw joins keeping
         both sides' columns (qualified names disambiguated)."""
         def lookup(tref: _Node) -> Table:
+            if tref["kind"] == "subquery":
+                return self.compile(tref["select"])  # handles UNION bodies
             name = tref["name"]
             if name not in self.tables:
                 raise KeyError(f"unknown table {name!r} in SQL (pass it as kwarg)")

@@ -321,6 +321,12 @@ def windowby(
     behavior: Any = None,
 ) -> WindowedTable:
     time_expr = substitute(smart_coerce(time_expr), {this: table})
+    if behavior is not None:
+        # carry the event time as a column: behaviors' buffer/forget
+        # watermark is the max TIME-COLUMN value seen (reference
+        # time_column.rs frontier), not the engine's processing time
+        table = table.with_columns(_pw_t=time_expr)
+        time_expr = ColumnReference(table, "_pw_t")
     instance_expr = (
         substitute(smart_coerce(instance), {this: table}) if instance is not None else None
     )
@@ -373,9 +379,14 @@ def _apply_behavior(expanded: Table, behavior) -> Table:
         # cutoff BEFORE buffer: lateness is judged at arrival time, and
         # buffered rows released later must still pass through
         if cutoff_expr is not None:
-            node = runner._add(ops.ForgetAfter(node, "__cut", forget_state=not keep_results))
+            node = runner._add(ops.ForgetAfter(
+                node, "__cut", forget_state=not keep_results,
+                watermark_col="_pw_t",
+            ))
         if buffer_expr is not None:
-            node = runner._add(ops.BufferUntil(node, "__buf"))
+            node = runner._add(ops.BufferUntil(
+                node, "__buf", watermark_col="_pw_t"
+            ))
         if exprs:
             node = runner._add(ops.Rowwise(
                 node, {c: (lambda cols_, keys_, n=c: cols_[n]) for c in base_cols}

@@ -7,7 +7,7 @@ import pytest
 
 import pathway_tpu as pw
 from pathway_tpu.internals.parse_graph import G
-from pathway_tpu.testing import T, assert_table_equality_wo_index
+from pathway_tpu.testing import T, assert_table_equality_wo_index, run_table
 
 
 @pytest.fixture(autouse=True)
@@ -216,3 +216,61 @@ def test_aggregate_inside_case():
         """
     )
     assert_table_equality_wo_index(res, expected)
+
+
+def test_sql_subquery_in_from():
+    G.clear()
+    t = T("a | b\n1 | 10\n2 | 20\n3 | 30")
+    r = pw.sql(
+        "SELECT a, b FROM (SELECT a, b FROM t WHERE a > 1) q WHERE b < 30",
+        t=t,
+    )
+    assert sorted(run_table(r)[0].values()) == [(2, 20)]
+
+
+def test_sql_subquery_with_aggregate_then_filter():
+    G.clear()
+    t = T("k | v\na | 1\na | 2\nb | 5")
+    r = pw.sql(
+        "SELECT k, s FROM (SELECT k, SUM(v) AS s FROM t GROUP BY k) x "
+        "WHERE s > 3",
+        t=t,
+    )
+    assert sorted(run_table(r)[0].values()) == [("b", 5)]
+
+
+def test_sql_join_against_subquery():
+    G.clear()
+    orders = T("cid | item\n1 | apple\n2 | pear")
+    customers = T("cid | name\n1 | ann\n2 | bob\n1 | ann2")
+    r = pw.sql(
+        "SELECT o.item, c.cnt FROM orders o "
+        "JOIN (SELECT cid, COUNT(*) AS cnt FROM customers GROUP BY cid) c "
+        "ON o.cid = c.cid",
+        orders=orders, customers=customers,
+    )
+    assert sorted(run_table(r)[0].values()) == [("apple", 2), ("pear", 1)]
+
+
+def test_sql_two_anonymous_subqueries_join():
+    G.clear()
+    a = T("k | x\n1 | 10")
+    b = T("k | y\n1 | 20")
+    r = pw.sql(
+        "SELECT q1.x, q2.y FROM (SELECT k, x FROM a) q1 "
+        "JOIN (SELECT k, y FROM b) q2 ON q1.k = q2.k",
+        a=a, b=b,
+    )
+    assert sorted(run_table(r)[0].values()) == [(10, 20)]
+
+
+def test_sql_union_inside_derived_table():
+    G.clear()
+    x = T("a\n1")
+    y = T("a\n2")
+    r = pw.sql(
+        "SELECT a FROM (SELECT a FROM x UNION ALL SELECT a FROM y) u "
+        "WHERE a > 1",
+        x=x, y=y,
+    )
+    assert sorted(run_table(r)[0].values()) == [(2,)]

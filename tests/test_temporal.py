@@ -492,3 +492,73 @@ def test_interval_join_left_pads_keep_this_columns():
     assert sorted(run_table(j)[0].values(), key=repr) == [
         ("x", "p"), ("y", None)
     ]
+
+
+def test_behavior_cutoff_drops_late_rows_event_time():
+    """Lateness is judged against the max EVENT time seen (reference
+    time_column.rs frontier), not the engine's processing time — the old
+    processing-time comparison kept this late row."""
+    G.clear()
+    t = T(
+        """
+        t  | v | __time__
+        1  | 1 | 2
+        2  | 2 | 2
+        20 | 3 | 4
+        3  | 9 | 6
+        """
+    )
+    r = t.windowby(
+        pw.this.t, window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.common_behavior(cutoff=5),
+    ).reduce(start=pw.this._pw_window_start, s=pw.reducers.sum(pw.this.v))
+    assert sorted(run_table(r)[0].values()) == [(0, 3), (20, 3)]
+
+
+def test_behavior_keep_results_false_retracts_closed_windows():
+    G.clear()
+    t = T(
+        """
+        t  | v | __time__
+        1  | 1 | 2
+        25 | 3 | 4
+        """
+    )
+    r = t.windowby(
+        pw.this.t, window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.common_behavior(cutoff=2, keep_results=False),
+    ).reduce(start=pw.this._pw_window_start, s=pw.reducers.sum(pw.this.v))
+    assert sorted(run_table(r)[0].values()) == [(20, 3)]
+
+
+def test_behavior_under_wall_clock_streaming():
+    """Behaviors must work when engine timestamps are wall-clock ms and
+    event times are small ints — event-time watermark, not tick time."""
+    import time as _time
+
+    G.clear()
+
+    class Feed(pw.io.python.ConnectorSubject):
+        def run(self):
+            for t_, v in [(1, 1), (2, 2), (20, 3), (3, 9)]:
+                self.next(t=t_, v=v)
+                self.commit()
+                _time.sleep(0.01)
+
+    src = pw.io.python.read(
+        Feed(), schema=pw.schema_from_types(t=int, v=int),
+        autocommit_duration_ms=None,
+    )
+    r = src.windowby(
+        pw.this.t, window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.common_behavior(cutoff=5),
+    ).reduce(start=pw.this._pw_window_start, s=pw.reducers.sum(pw.this.v))
+    acc = {}
+    pw.io.subscribe(
+        r,
+        on_change=lambda key, row, time, is_addition: (
+            acc.__setitem__(row["start"], row["s"]) if is_addition else None
+        ),
+    )
+    pw.run()
+    assert sorted(acc.items()) == [(0, 3), (20, 3)]
