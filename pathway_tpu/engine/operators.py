@@ -1387,13 +1387,30 @@ class Flatten(Node):
         )
 
 
-def _pop_due(store: dict[int, list], watermark: int) -> list:
-    """Pop all (key, row, diff) entries whose threshold <= watermark."""
-    due = [t for t in store if t <= watermark]
+def _pop_due(store: dict, watermark, strict: bool = False) -> list:
+    """Pop all (key, row, diff) entries whose threshold <= watermark
+    (``strict``: < watermark). Thresholds may be ints, floats or
+    datetimes — any consistently ordered time domain."""
+    if strict:
+        due = [t for t in store if t < watermark]
+    else:
+        due = [t for t in store if t <= watermark]
     entries = []
     for t in sorted(due):
         entries.extend(store.pop(t))
     return entries
+
+
+def _time_column(col) -> np.ndarray:
+    """A threshold/event-time column in its natural ordered domain:
+    int64 / float64 arrays, or objects (datetimes, Durations) as-is —
+    NEVER an int cast that would truncate float event times."""
+    a = np.asarray(col)
+    if a.dtype.kind in "iu":
+        return a.astype(np.int64, copy=False)
+    if a.dtype.kind == "f":
+        return a.astype(np.float64, copy=False)
+    return a
 
 
 def _entries_delta(
@@ -1427,28 +1444,37 @@ class BufferUntil(Node):
         self._col = threshold_col
         self._wm_col = watermark_col
         # threshold -> list[(key, row, diff)]
-        self._buffer: dict[int, list] = {}
-        self._watermark = -(1 << 62)
+        self._buffer: dict = {}
+        self._watermark = None  # None = nothing seen yet
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d = ins[0]
         if d is None or not len(d):
             return None
-        thr = np.asarray(d.data[self._col], dtype=np.int64)
+        thr = _time_column(d.data[self._col])
+        wm_moved = False
         if self._wm_col is not None:
-            evt = np.asarray(d.data[self._wm_col], dtype=np.int64)
-            self._watermark = max(self._watermark, int(evt.max()))
-        pass_now = thr <= self._watermark
+            batch_max = max(_time_column(d.data[self._wm_col]).tolist())
+            if self._watermark is None or batch_max > self._watermark:
+                self._watermark = batch_max
+                wm_moved = True
+        if self._watermark is None:
+            pass_now = np.zeros(len(d), dtype=bool)
+        else:
+            wm = self._watermark
+            pass_now = np.array([t <= wm for t in thr.tolist()], dtype=bool) \
+                if thr.dtype == object else (thr <= wm)
         out_parts = [d.take(np.flatnonzero(pass_now))]
         hold_ix = np.flatnonzero(~pass_now)
         cols = list(d.data.values())
+        thr_list = thr.tolist()
         for i in hold_ix:
-            self._buffer.setdefault(int(thr[i]), []).append(
+            self._buffer.setdefault(thr_list[i], []).append(
                 (int(d.keys[i]), tuple(c[i] for c in cols), int(d.diffs[i]))
             )
-        if self._wm_col is not None:
-            # logical-time mode releases in advance_to (already ran this
-            # tick); scanning the buffer here would be guaranteed-empty work
+        if self._wm_col is not None and wm_moved:
+            # only when the watermark advanced can anything come due
+            # (logical-time mode releases in advance_to instead)
             released = _entries_delta(
                 _pop_due(self._buffer, self._watermark), self.column_names
             )
@@ -1470,10 +1496,10 @@ class BufferUntil(Node):
         )
 
     def on_end(self) -> Delta | None:
-        self._watermark = 1 << 62
-        return _entries_delta(
-            _pop_due(self._buffer, self._watermark), self.column_names
-        )
+        entries = []
+        for t in sorted(self._buffer):
+            entries.extend(self._buffer.pop(t))
+        return _entries_delta(entries, self.column_names)
 
 
 class ForgetAfter(Node):
@@ -1499,35 +1525,44 @@ class ForgetAfter(Node):
         self._col = threshold_col
         self._forget = forget_state
         self._wm_col = watermark_col
-        self._watermark = -(1 << 62)
+        self._watermark = None  # None = nothing seen yet
         # threshold -> list[(key, row, diff)] of rows passed through
-        self._live: dict[int, list] = {}
+        self._live: dict = {}
 
     def _retract_due(self) -> Delta | None:
+        # a row at EXACTLY the watermark is still valid (keep is thr >= wm)
         return _entries_delta(
-            _pop_due(self._live, self._watermark), self.column_names,
-            negate=True,
+            _pop_due(self._live, self._watermark, strict=True),
+            self.column_names, negate=True,
         )
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d = ins[0]
         if d is None or not len(d):
             return None
-        thr = np.asarray(d.data[self._col], dtype=np.int64)
-        keep = thr > self._watermark
+        thr = _time_column(d.data[self._col])
+        if self._watermark is None:
+            keep = np.ones(len(d), dtype=bool)
+        else:
+            wm = self._watermark
+            keep = np.array([t >= wm for t in thr.tolist()], dtype=bool) \
+                if thr.dtype == object else (thr >= wm)
         out = d.take(np.flatnonzero(keep))
+        wm_moved = False
         if self._wm_col is not None:
-            evt = np.asarray(d.data[self._wm_col], dtype=np.int64)
-            self._watermark = max(self._watermark, int(evt.max()))
+            batch_max = max(_time_column(d.data[self._wm_col]).tolist())
+            if self._watermark is None or batch_max > self._watermark:
+                self._watermark = batch_max
+                wm_moved = True
         if self._forget and len(out):
             cols = list(out.data.values())
-            thr_kept = np.asarray(out.data[self._col], dtype=np.int64)
+            thr_kept = _time_column(out.data[self._col]).tolist()
             for i in range(len(out)):
-                self._live.setdefault(int(thr_kept[i]), []).append(
+                self._live.setdefault(thr_kept[i], []).append(
                     (int(out.keys[i]), tuple(c[i] for c in cols), int(out.diffs[i]))
                 )
         parts = [out] if len(out) else []
-        if self._forget and self._wm_col is not None:
+        if self._forget and self._wm_col is not None and wm_moved:
             retracted = self._retract_due()
             if retracted is not None and len(retracted):
                 parts.append(retracted)

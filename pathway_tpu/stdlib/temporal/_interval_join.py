@@ -72,6 +72,31 @@ def _bucket_width(lo, up):
     return max(float(span), 1.0) if isinstance(span, float) else max(int(span), 1)
 
 
+def _apply_side_behavior(table: Table, time_col: str, behavior) -> Table:
+    """Per-side temporal behavior for interval joins (reference
+    ``_interval_join.py`` behavior param): judged against each side's OWN
+    event-time watermark — ``cutoff`` drops rows arriving after
+    ``t + cutoff`` has passed (and with ``keep_results=False`` retracts
+    them from join state, bounding memory); ``delay`` buffers rows until
+    the watermark reaches ``t + delay``."""
+    if behavior is None:
+        return table
+    from ._shared import apply_behavior_nodes
+    from .temporal_behavior import CommonBehavior
+
+    if not isinstance(behavior, CommonBehavior):
+        raise TypeError(
+            "interval_join behavior must be pw.temporal.common_behavior(...)"
+        )
+    return apply_behavior_nodes(
+        table,
+        this[time_col] + behavior.delay if behavior.delay is not None else None,
+        this[time_col] + behavior.cutoff if behavior.cutoff is not None else None,
+        time_col,
+        behavior.keep_results,
+    )
+
+
 class IntervalJoinResult:
     def __init__(self, left_t: Table, right_t: Table, left_time, right_time,
                  iv: Interval, on: tuple, mode: JoinMode, behavior=None):
@@ -89,11 +114,15 @@ class IntervalJoinResult:
         lo, up = self._iv.lower_bound, self._iv.upper_bound
         width = _bucket_width(lo, up)
 
-        # working copies with private time/bucket columns
-        lt2 = lt.with_columns(_pw_lt=self._left_time, _pw_lid=this.id)
-        lt2 = _expand_buckets(lt2, this._pw_lt, lo, up, "_pw_b")
-        rt2 = rt.with_columns(_pw_rt=self._right_time, _pw_rid=this.id)
-        rt2 = rt2.with_columns(
+        # working copies with private time/bucket columns; behavior wraps
+        # apply BEFORE expansion and are ALSO the pad sources — a row the
+        # behavior dropped/forgot must not resurface as an outer pad
+        lb = lt.with_columns(_pw_lt=self._left_time, _pw_lid=this.id)
+        lb = _apply_side_behavior(lb, "_pw_lt", self._behavior)
+        lt2 = _expand_buckets(lb, this._pw_lt, lo, up, "_pw_b")
+        rb = rt.with_columns(_pw_rt=self._right_time, _pw_rid=this.id)
+        rb = _apply_side_behavior(rb, "_pw_rt", self._behavior)
+        rt2 = rb.with_columns(
             _pw_b=ApplyExpression(
                 lambda t: _bucket_of(t, width), dt.INT, (this._pw_rt,), {}
             ),
@@ -136,10 +165,10 @@ class IntervalJoinResult:
         # pad keys are salt-derived from the unmatched side's row keys and
         # can never collide with the pair-derived match keys
         if self._mode in (JoinMode.LEFT, JoinMode.OUTER):
-            pads = self._pads(matched, lt, rt, "left", args, kwargs)
+            pads = self._pads(matched, lt, rt, "left", args, kwargs, src=lb)
             result = result.promise_universes_are_disjoint(pads).concat(pads)
         if self._mode in (JoinMode.RIGHT, JoinMode.OUTER):
-            pads = self._pads(matched, lt, rt, "right", args, kwargs)
+            pads = self._pads(matched, lt, rt, "right", args, kwargs, src=rb)
             result = result.promise_universes_are_disjoint(pads).concat(pads)
         return result
 
@@ -182,9 +211,12 @@ class IntervalJoinResult:
 
         return rewrite(substitute(e, {pw_left: lt, pw_right: rt}))
 
-    def _pads(self, matched, lt, rt, side, args, kwargs):
-        """Unmatched rows of one side, padded with None on the other side."""
-        src = lt if side == "left" else rt
+    def _pads(self, matched, lt, rt, side, args, kwargs, src=None):
+        """Unmatched rows of one side, padded with None on the other side.
+        ``src`` is the behavior-wrapped side (defaults to the raw table
+        when no behavior is set)."""
+        if src is None:
+            src = lt if side == "left" else rt
         id_col = "_pw_lid" if side == "left" else "_pw_rid"
         # anti-join: source rows whose id is not among matched ids
         unmatched = _anti_join_by_pointer(src, matched, id_col)

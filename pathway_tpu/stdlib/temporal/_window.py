@@ -336,63 +336,31 @@ def windowby(
 
 def _apply_behavior(expanded: Table, behavior) -> Table:
     """Wrap the expanded window-membership stream with buffer/forget engine
-    nodes per the behavior (reference: engine buffer/forget/freeze)."""
+    nodes per the behavior (reference: engine buffer/forget/freeze). The
+    watermark is the max EVENT time seen (the ``_pw_t`` column threaded
+    through by windowby)."""
     if behavior is None:
         return expanded
-    from ...engine import operators as ops
-    from ...internals.expression_compiler import compile_expr
+    from ._shared import apply_behavior_nodes
 
     if isinstance(behavior, ExactlyOnceBehavior):
         shift = behavior.shift or 0
         buffer_expr = this._pw_window_end + shift
-        # forget threshold one past the buffer release tick so the released
-        # batch itself passes through before lateness kicks in
-        cutoff_expr = this._pw_window_end + shift + 1
+        # lateness is inclusive at the threshold (ForgetAfter keeps
+        # thr >= watermark), so the released batch itself passes through
+        cutoff_expr = this._pw_window_end + shift
         keep_results = True
     else:
         buffer_expr = (
             this._pw_window_start + behavior.delay if behavior.delay is not None else None
         )
         cutoff_expr = (
-            this._pw_window_end + behavior.cutoff + 1
+            this._pw_window_end + behavior.cutoff
             if behavior.cutoff is not None
             else None
         )
         keep_results = behavior.keep_results
 
-    base_cols = expanded.column_names()
-    schema = expanded.schema
-
-    def lower(runner, tbl):
-        inner = expanded
-        exprs = {}
-        if buffer_expr is not None:
-            exprs["__buf"] = substitute(smart_coerce(buffer_expr), {this: inner})
-        if cutoff_expr is not None:
-            exprs["__cut"] = substitute(smart_coerce(cutoff_expr), {this: inner})
-        node, env = runner._zip_env(inner, exprs) if exprs else (runner.lower(inner), None)
-        rw = {c: (lambda cols_, keys_, n=c: cols_[n]) for c in base_cols}
-        for name, e in exprs.items():
-            rw[name] = compile_expr(e, env).fn
-        if exprs:
-            node = runner._add(ops.Rowwise(node, rw))
-        # cutoff BEFORE buffer: lateness is judged at arrival time, and
-        # buffered rows released later must still pass through
-        if cutoff_expr is not None:
-            node = runner._add(ops.ForgetAfter(
-                node, "__cut", forget_state=not keep_results,
-                watermark_col="_pw_t",
-            ))
-        if buffer_expr is not None:
-            node = runner._add(ops.BufferUntil(
-                node, "__buf", watermark_col="_pw_t"
-            ))
-        if exprs:
-            node = runner._add(ops.Rowwise(
-                node, {c: (lambda cols_, keys_, n=c: cols_[n]) for c in base_cols}
-            ))
-        return node
-
-    from ...internals.parse_graph import Universe as _U
-
-    return Table("custom", [expanded], {"lower": lower}, schema, _U())
+    return apply_behavior_nodes(
+        expanded, buffer_expr, cutoff_expr, "_pw_t", keep_results
+    )

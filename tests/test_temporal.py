@@ -562,3 +562,51 @@ def test_behavior_under_wall_clock_streaming():
     )
     pw.run()
     assert sorted(acc.items()) == [(0, 3), (20, 3)]
+
+
+def test_interval_join_behavior_cutoff():
+    """interval_join applies its behavior (it used to be silently
+    ignored): a left row later than cutoff behind its side's event-time
+    watermark never joins."""
+    G.clear()
+    l = T(
+        """
+        t | a | __time__
+        1 | x | 2
+        9 | z | 4
+        2 | y | 6
+        """
+    )
+    r = T("t | b\n1 | p\n2 | q\n9 | w")
+    j = l.interval_join(
+        r, l.t, r.t, pw.temporal.interval(0, 0),
+        behavior=pw.temporal.common_behavior(cutoff=2),
+    ).select(pw.this.a, pw.this.b)
+    assert sorted(run_table(j)[0].values()) == [("x", "p"), ("z", "w")]
+
+
+def test_interval_join_left_behavior_pads_respect_cutoff():
+    """Rows dropped by the behavior must not resurface as outer pads
+    (review: pads used to derive from the unwrapped side)."""
+    G.clear()
+    l = T("t | a | __time__\n1 | x | 2\n9 | z | 4\n2 | y | 6")
+    r = T("t | b\n1 | p\n9 | w")
+    j = l.interval_join_left(
+        r, l.t, r.t, pw.temporal.interval(0, 0),
+        behavior=pw.temporal.common_behavior(cutoff=2),
+    ).select(pw.this.a, pw.this.b)
+    assert sorted(run_table(j)[0].values()) == [("x", "p"), ("z", "w")]
+
+
+def test_behavior_float_event_times():
+    """Cutoffs work in the float time domain (review: int64 casts
+    truncated float event times, granting up to a unit of extra
+    lateness)."""
+    G.clear()
+    l = T("t | a | __time__\n1.0 | x | 2\n9.9 | z | 4\n9.0 | y | 6")
+    r = T("t | b\n1.0 | p\n9.9 | w\n9.0 | q")
+    j = l.interval_join(
+        r, l.t, r.t, pw.temporal.interval(0.0, 0.0),
+        behavior=pw.temporal.common_behavior(cutoff=0.5),
+    ).select(pw.this.a, pw.this.b)
+    assert sorted(run_table(j)[0].values()) == [("x", "p"), ("z", "w")]
