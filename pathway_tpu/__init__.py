@@ -93,6 +93,15 @@ from .internals.interactive import (  # noqa: E402
     enable_interactive_mode,
     is_interactive_mode_enabled,
 )
+from .internals.row_transformer import (  # noqa: E402
+    ClassArg,
+    attribute,
+    input_attribute,
+    input_method,
+    method,
+    output_attribute,
+    transformer,
+)
 
 
 def set_license_key(key: str | None) -> None:  # compatibility no-op
@@ -105,6 +114,7 @@ def set_monitoring_config(*args, **kwargs) -> None:
 
 __all__ = [
     "BaseCustomAccumulator",
+    "ClassArg",
     "ColumnExpression",
     "ColumnReference",
     "GroupedTable",
@@ -122,6 +132,7 @@ __all__ = [
     "Universe",
     "apply",
     "apply_async",
+    "attribute",
     "apply_with_type",
     "assert_table_has_schema",
     "cast",
@@ -135,10 +146,18 @@ __all__ = [
     "groupby",
     "if_else",
     "indexing",
+    "input_attribute",
+    "input_method",
     "io",
     "iterate",
     "iterate_universe",
+    "LiveTable",
+    "enable_interactive_mode",
+    "is_interactive_mode_enabled",
     "join",
+    "method",
+    "output_attribute",
+    "transformer",
     "join_inner",
     "join_left",
     "join_outer",

@@ -1,0 +1,214 @@
+"""Legacy @pw.transformer class API (internals/row_transformer.py —
+reference ``python/pathway/internals/row_transformer.py`` +
+``tests/test_transformers.py``)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T, run_table
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    G.clear()
+    yield
+    G.clear()
+
+
+def rows(table):
+    state, _ = run_table(table)
+    return dict(state)
+
+
+def test_simple_transformer():
+    @pw.transformer
+    class foo_transformer:
+        class table(pw.ClassArg):
+            arg = pw.input_attribute()
+
+            @pw.output_attribute
+            def ret(self) -> int:
+                return self.arg + 1
+
+    t = T("arg\n1\n2\n3")
+    got = rows(foo_transformer(t).table)
+    # keyed by the INPUT rows' ids, values incremented
+    src = rows(t.select(pw.this.arg))
+    assert {k: (v[0] + 1,) for k, v in src.items()} == got
+
+
+def test_aux_objects_and_attribute():
+    @pw.transformer
+    class foo_transformer:
+        class table(pw.ClassArg):
+            arg = pw.input_attribute()
+
+            const = 10
+
+            def fun(self, a) -> int:
+                return a * self.arg + self.const
+
+            @staticmethod
+            def sfun(b) -> int:
+                return b * 100
+
+            @pw.attribute
+            def attr(self) -> int:
+                return self.arg / 2
+
+            @pw.output_attribute
+            def ret(self) -> int:
+                return (
+                    self.arg + self.const + self.fun(1)
+                    + self.sfun(self.arg) + self.attr
+                )
+
+    t = T("arg\n10\n20\n30")
+    got = sorted(v[0] for v in rows(foo_transformer(t).table).values())
+    # reference test_aux_objects expects 1045/2070/3095
+    assert got == [1045.0, 2070.0, 3095.0]
+
+
+def test_pointer_chasing_across_tables():
+    @pw.transformer
+    class list_traversal:
+        class nodes(pw.ClassArg):
+            next = pw.input_attribute()
+            val = pw.input_attribute()
+
+        class requests(pw.ClassArg):
+            node = pw.input_attribute()
+            steps = pw.input_attribute()
+
+            @pw.output_attribute
+            def reached_value(self) -> int:
+                node = self.transformer.nodes[self.node]
+                for _ in range(self.steps):
+                    node = self.transformer.nodes[node.next]
+                return node.val
+
+    raw = T("k | nxt | val\n1 | 2 | 11\n2 | 3 | 12\n3 | 3 | 13").with_id_from(
+        pw.this.k
+    )
+    nodes = raw.select(next=raw.pointer_from(raw.nxt), val=raw.val)
+    req0 = T("node | steps\n1 | 1\n3 | 0")
+    requests = req0.select(
+        node=raw.pointer_from(req0.node), steps=req0.steps
+    )
+    out = list_traversal(nodes, requests).requests
+    assert sorted(v[0] for v in rows(out).values()) == [12, 13]
+
+
+def test_output_attribute_rename():
+    @pw.transformer
+    class foo_transformer:
+        class table(pw.ClassArg):
+            arg = pw.input_attribute()
+
+            @pw.output_attribute(output_name="foo")
+            def ret(self) -> int:
+                return self.arg + 1
+
+    t = T("arg\n1")
+    out = foo_transformer(t).table
+    assert out.column_names() == ["foo"]
+    assert sorted(v[0] for v in rows(out).values()) == [2]
+
+
+def test_output_attributes_reference_each_other():
+    @pw.transformer
+    class chain:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+
+            @pw.output_attribute
+            def b(self) -> int:
+                return self.a * 2
+
+            @pw.output_attribute
+            def c(self) -> int:
+                return self.b + 1  # depends on another output attribute
+
+    t = T("a\n3")
+    assert list(rows(chain(t).table).values()) == [(6, 7)]
+
+
+def test_transformer_is_incremental_across_ticks():
+    @pw.transformer
+    class doubler:
+        class table(pw.ClassArg):
+            v = pw.input_attribute()
+
+            @pw.output_attribute
+            def d(self) -> int:
+                return self.v * 2
+
+    t = T(
+        """
+        v | __time__ | __diff__
+        1 | 2        | 1
+        5 | 4        | 1
+        1 | 6        | -1
+        """
+    )
+    assert sorted(v[0] for v in rows(doubler(t).table).values()) == [10]
+
+
+def test_method_markers_refused():
+    with pytest.raises(NotImplementedError):
+        pw.method(lambda self: 1)
+    with pytest.raises(NotImplementedError):
+        pw.input_method(int)
+
+
+def test_call_signature_validation():
+    @pw.transformer
+    class one:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+
+            @pw.output_attribute
+            def b(self):
+                return self.a
+
+    t = T("a\n1")
+    with pytest.raises(TypeError, match="takes 1 table"):
+        one(t, t)
+    with pytest.raises(TypeError, match="no table"):
+        one(tabel=t)
+    with pytest.raises(TypeError, match="both"):
+        one(t, table=t)
+
+
+def test_input_only_class_error_is_helpful():
+    @pw.transformer
+    class tf:
+        class src(pw.ClassArg):
+            a = pw.input_attribute()
+
+        class out(pw.ClassArg):
+            b = pw.input_attribute()
+
+            @pw.output_attribute
+            def c(self):
+                return self.b
+
+    with pytest.raises(AttributeError, match="no output attributes"):
+        tf(T("a\n1"), T("b\n2")).src
+
+
+def test_output_attribute_rename_non_decorator():
+    def fn(self):
+        return self.a + 1
+
+    @pw.transformer
+    class tf:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+            ret = pw.output_attribute(fn, output_name="foo")
+
+    out = tf(T("a\n1")).table
+    assert out.column_names() == ["foo"]
