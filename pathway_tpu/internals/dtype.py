@@ -240,11 +240,17 @@ _FROM_PY: dict[Any, DType] = {
 
 
 def wrap(t: Any) -> DType:
-    """Convert a python type / typing annotation / DType into a DType."""
+    """Convert a python type / typing annotation / DType into a DType.
+    String type names ("int", "str", "float", ...) are accepted too, for
+    schemas loaded from JSON/YAML (reference schema.py:783: "both int and
+    'int' are accepted"); unrecognized strings degrade to ANY like any
+    other unresolvable annotation (e.g. an unevaluated forward ref)."""
     if isinstance(t, DType):
         return t
     if t is None:
         return NONE
+    if isinstance(t, str):
+        return _FROM_NAME.get(t.strip().lower(), ANY)
     origin = typing.get_origin(t)
     if origin is typing.Union:
         args = typing.get_args(t)
@@ -270,6 +276,16 @@ def wrap(t: Any) -> DType:
     if isinstance(t, type) and issubclass(t, np.floating):
         return FLOAT
     return ANY
+
+
+#: string type names for JSON/YAML-loaded schemas (wrap() docstring)
+_FROM_NAME = {
+    "int": INT, "float": FLOAT, "str": STR, "string": STR,
+    "bool": BOOL, "bytes": BYTES, "any": ANY, "json": JSON,
+    "pointer": POINTER, "datetime": DATE_TIME_NAIVE,
+    "datetimenaive": DATE_TIME_NAIVE, "datetimeutc": DATE_TIME_UTC,
+    "duration": DURATION,
+}
 
 
 def unoptionalize(t: DType) -> DType:

@@ -160,3 +160,38 @@ def test_yaml_pw_alias():
     from pathway_tpu.xpacks.llm.splitters import NullSplitter
 
     assert isinstance(objs["s"], NullSplitter)
+
+
+def test_yaml_schema_type_names_coerce(tmp_path):
+    """String type names in YAML/JSON-loaded schemas resolve to real
+    dtypes (reference schema.py:783: both int and "int" accepted), so
+    csv reads under the yaml loader coerce numerics."""
+    G.clear()
+    csv = tmp_path / "in.csv"
+    csv.write_text("a,b\n1,2\n3,4\n")
+    cfg = pw.load_yaml(
+        f"""
+source: !pw.io.csv.read
+  path: {csv}
+  schema: !pw.schema_from_types
+    a: int
+    b: int
+  mode: static
+"""
+    )
+    acc = {}
+    pw.io.subscribe(
+        cfg["source"].groupby().reduce(s=pw.reducers.sum(pw.this.a)),
+        on_change=lambda key, row, time, is_addition: acc.update(row),
+    )
+    pw.run()
+    assert acc == {"s": 4}
+
+
+def test_schema_from_dict_string_types():
+    sch = pw.schema_from_dict({"a": "int", "b": {"dtype": "str"}})
+    hints = sch.typehints()
+    assert hints["a"] is int and hints["b"] is str
+    # unknown strings degrade to ANY (unresolvable forward refs must not
+    # crash schema definition)
+    pw.schema_from_dict({"c": "np.ndarray"})
