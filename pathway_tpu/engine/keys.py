@@ -15,6 +15,7 @@ probability ~n^2/2^65); the module is the single place to widen to 128-bit
 from __future__ import annotations
 
 import hashlib
+import weakref
 from typing import Any, Iterable
 
 import numpy as np
@@ -57,7 +58,24 @@ def shard_of(keys: KeyArray, num_shards: int) -> np.ndarray:
     return (keys & np.uint64((1 << SHARD_BITS) - 1)).astype(np.int64) % num_shards
 
 
+#: per-array hash memo: the SAME column array object commonly gets hashed
+#: several times per tick (ingestion row keys, groupby routing, exchange
+#: specs), and string hashing dominates the stream hot path. Keyed by
+#: id() with a weakref liveness guard (ids recycle); columns are
+#: immutable by engine convention.
+_OBJ_HASH_CACHE: dict[int, tuple] = {}
+_OBJ_HASH_CACHE_MIN_ROWS = 1024
+_OBJ_HASH_CACHE_MAX = 64
+
+
 def _hash_object_column(col: np.ndarray) -> np.ndarray:
+    cache_key = None
+    if len(col) >= _OBJ_HASH_CACHE_MIN_ROWS:
+        cache_key = id(col)
+        hit = _OBJ_HASH_CACHE.get(cache_key)
+        if hit is not None and hit[0]() is col:
+            return hit[1]
+
     from ..native import get_native
 
     out = np.empty(len(col), dtype=np.uint64)
@@ -65,9 +83,22 @@ def _hash_object_column(col: np.ndarray) -> np.ndarray:
     if native is not None:
         # group-key hot path — same per-scalar semantics, in C
         native.hash_scalars(list(col), _hash_scalar, out)
-        return out
-    for i, v in enumerate(col):
-        out[i] = _hash_scalar(v)
+    else:
+        for i, v in enumerate(col):
+            out[i] = _hash_scalar(v)
+    if cache_key is not None:
+        try:
+            # callback evicts promptly when the column is collected — no
+            # dead entries pinning big hash arrays in a long-lived stream
+            ref = weakref.ref(
+                col, lambda _r, k=cache_key: _OBJ_HASH_CACHE.pop(k, None)
+            )
+        except TypeError:
+            return out
+        if len(_OBJ_HASH_CACHE) >= _OBJ_HASH_CACHE_MAX:
+            _OBJ_HASH_CACHE.clear()  # bounded: reset rather than grow
+        out.flags.writeable = False  # shared across callers from now on
+        _OBJ_HASH_CACHE[cache_key] = (ref, out)
     return out
 
 
