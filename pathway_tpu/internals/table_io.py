@@ -27,13 +27,42 @@ from .schema import ColumnSchema, SchemaMetaclass, schema_from_columns
 from .table import Table
 
 
-def _infer_dtypes(names: list[str], rows: list[tuple]) -> dict[str, dt.DType]:
-    out: dict[str, dt.DType] = {}
-    for i, name in enumerate(names):
-        vals = [r[i] for r in rows]
-        ts = [dt.dtype_of_value(v) for v in vals] or [dt.ANY]
-        out[name] = dt.types_lca_many(ts)
-    return out
+#: python types whose dtype depends only on the TYPE, so a column scan can
+#: dedupe by set(map(type, ...)) (C-speed) instead of per-value inference —
+#: datetimes excluded (naive vs utc depends on tzinfo), tuples excluded
+#: (Tuple(args) depends on the content)
+_TYPE_ONLY_DTYPES: dict[type, dt.DType] = {
+    t: dt._FROM_PY[t]
+    for t in (str, bool, int, float, bytes, type(None), dict)
+}
+
+
+def _infer_dtype_of_column(arr: "np.ndarray", vals: list) -> dt.DType:
+    if arr.dtype == np.int64:
+        return dt.INT
+    if arr.dtype == np.float64:
+        return dt.FLOAT
+    if arr.dtype == np.bool_:
+        return dt.BOOL
+    if not vals:
+        return dt.ANY
+    types = set(map(type, vals))
+    if all(t in _TYPE_ONLY_DTYPES for t in types):
+        return dt.types_lca_many([_TYPE_ONLY_DTYPES[t] for t in types])
+    # mixed/complex values (tuples, datetimes, arrays): per-value inference
+    return dt.types_lca_many([dt.dtype_of_value(v) for v in vals])
+
+
+def _infer_dtypes(
+    names: list[str], data: dict[str, "np.ndarray"]
+) -> dict[str, dt.DType]:
+    return {
+        name: _infer_dtype_of_column(
+            data[name],
+            list(data[name]) if data[name].dtype == object else [],
+        )
+        for name in names
+    }
 
 
 def _coerce_column(col: np.ndarray, target: dt.DType) -> np.ndarray:
@@ -92,7 +121,6 @@ def rows_to_table(
         if id_from is None:
             id_from = schema.primary_key_columns()
     else:
-        dtypes = _infer_dtypes(names, rows)
         col_order = names
 
     n = len(rows)
@@ -100,6 +128,11 @@ def rows_to_table(
         name: column_of_values([r[names.index(name)] for r in rows])
         for name in col_order
     }
+    if schema is None:
+        # infer from the BUILT columns: dense dtypes read off the array,
+        # object columns dedupe by value type — O(distinct types), not
+        # O(rows) python-level dtype_of_value calls
+        dtypes = _infer_dtypes(col_order, data)
     for name in col_order:
         data[name] = _coerce_column(data[name], dtypes[name])
 

@@ -69,6 +69,15 @@ class ConnectorSubject:
         (all the same length). The engine hashes keys and builds the delta
         vectorized — use this from sources that naturally read in blocks
         (file chunks, kafka poll batches) for high-throughput ingestion."""
+        # snapshot ndarray columns NOW, on the subject thread: the engine
+        # drains the queue later, and a subject refilling one preallocated
+        # buffer across next_batch calls must not alias engine state (the
+        # per-array hash memo in engine/keys.py relies on column
+        # immutability)
+        data = {
+            k: (v.copy() if isinstance(v, np.ndarray) else v)
+            for k, v in data.items()
+        }
         self._queue.put(_Batch(data, diffs))
 
     def next_json(self, message: dict | str) -> None:
@@ -199,6 +208,8 @@ class PythonSubjectSource(RealtimeSource):
         data: dict[str, np.ndarray] = {}
         n = None
         for name, col in batch.data.items():
+            # ndarrays were snapshotted at next_batch() enqueue time —
+            # the engine owns them from here on
             arr = (
                 col
                 if isinstance(col, np.ndarray) and col.ndim == 1

@@ -138,3 +138,39 @@ def test_rest_connector_missing_field_400():
     pw.run()
     th.join(timeout=10)
     assert status == [400]
+
+
+def test_next_batch_reused_buffer_is_copied():
+    """A subject refilling ONE preallocated ndarray across next_batch calls
+    must not corrupt engine keys (the per-array hash memo assumes the
+    engine owns its columns — review finding)."""
+    import numpy as np
+
+    G.clear()
+    buf = np.empty(1500, dtype=object)
+
+    class Feed(pw.io.python.ConnectorSubject):
+        def run(self):
+            for tag in ("a", "b"):
+                buf[:] = [f"{tag}{i}" for i in range(1500)]
+                self.next_batch({"word": buf})
+                self.commit()
+
+    t = pw.io.python.read(
+        Feed(), schema=pw.schema_from_types(word=str),
+        autocommit_duration_ms=None,
+    )
+    counts = t.groupby(pw.this.word).reduce(
+        pw.this.word, c=pw.reducers.count()
+    )
+    acc = {}
+    pw.io.subscribe(
+        counts,
+        on_change=lambda key, row, time, is_addition: (
+            acc.__setitem__(row["word"], row["c"]) if is_addition else None
+        ),
+    )
+    pw.run()
+    # 3000 distinct words, each counted exactly once
+    assert len(acc) == 3000
+    assert all(v == 1 for v in acc.values())
