@@ -151,6 +151,11 @@ class SourceNode(Node):
 class RealtimeSource(SourceNode):
     """A live long-running source, polled by the streaming event loop.
 
+    ``attach_waker`` hands the source the loop's wake event: setting it on
+    new data ends the idle park immediately (the reference's unpark on
+    channel activity) instead of waiting out the poll interval — this is
+    what keeps serve-path latency at data-arrival time, not park cadence.
+
     The reference runs each connector on its own thread feeding a channel
     drained by the worker loop's pollers (``src/connectors/mod.rs:427``,
     ``dataflow.rs:5596-5650``); subclasses here do the same — a producer
@@ -166,6 +171,11 @@ class RealtimeSource(SourceNode):
 
     def start(self) -> None:
         """Begin producing (spawn the reader thread)."""
+
+    def attach_waker(self, event) -> None:
+        """Receive the streaming loop's wake event; implementations may set
+        it when new data arrives to end the idle park immediately."""
+        self.waker = event
 
     def poll(self) -> list[Delta]:
         """Drain everything produced since the last poll. Each returned
@@ -376,7 +386,11 @@ class Executor:
             self._finish()
             return
 
+        import threading
+
+        wake = threading.Event()
         for src in realtime:
+            src.attach_waker(wake)
             src.start()
         try:
             while not self._stop_requested:
@@ -404,7 +418,10 @@ class Executor:
                 elif all(src.is_finished() for src in realtime):
                     break
                 else:
-                    _time.sleep(0.005)  # park (step_or_park's wait)
+                    # park until data arrives (waker) or the poll interval
+                    # lapses (step_or_park's timed wait)
+                    wake.wait(0.005)
+                    wake.clear()
         finally:
             for src in realtime:
                 src.stop()
@@ -419,12 +436,16 @@ class Executor:
         protocol of SURVEY §7 hard part (c) under a total order."""
         import time as _time
 
+        import threading
+
         ctx = self.ctx
         owned = [
             s for i, s in enumerate(realtime)
             if i % ctx.n_workers == ctx.worker_id
         ]
+        wake = threading.Event()
         for src in owned:
+            src.attach_waker(wake)
             src.start()
         cycle = 0
         try:
@@ -469,7 +490,11 @@ class Executor:
                 if n_rounds == 0:
                     if all(p[1] for p in gathered):
                         break
-                    _time.sleep(0.005)
+                    # park until owned-source data arrives or the poll
+                    # interval lapses; peers' data surfaces via the next
+                    # cycle's allgather either way
+                    wake.wait(0.005)
+                    wake.clear()
         finally:
             for src in owned:
                 src.stop()

@@ -69,15 +69,20 @@ class ConnectorSubject:
         (all the same length). The engine hashes keys and builds the delta
         vectorized — use this from sources that naturally read in blocks
         (file chunks, kafka poll batches) for high-throughput ingestion."""
-        # snapshot ndarray columns NOW, on the subject thread: the engine
+        # snapshot columns AND diffs NOW, on the subject thread: the engine
         # drains the queue later, and a subject refilling one preallocated
-        # buffer across next_batch calls must not alias engine state (the
-        # per-array hash memo in engine/keys.py relies on column
-        # immutability)
+        # buffer (ndarray or list) across next_batch calls must not alias
+        # engine state (the per-array hash memo in engine/keys.py relies on
+        # column immutability)
         data = {
-            k: (v.copy() if isinstance(v, np.ndarray) else v)
+            k: (v.copy() if isinstance(v, np.ndarray)
+                else list(v) if isinstance(v, list) else v)
             for k, v in data.items()
         }
+        if isinstance(diffs, np.ndarray):
+            diffs = diffs.copy()
+        elif isinstance(diffs, list):
+            diffs = list(diffs)
         self._queue.put(_Batch(data, diffs))
 
     def next_json(self, message: dict | str) -> None:
@@ -101,9 +106,15 @@ class ConnectorSubject:
 
     def commit(self) -> None:
         self._queue.put(_COMMIT)
+        waker = getattr(self, "_waker", None)
+        if waker is not None:
+            waker.set()  # end the engine loop's park immediately
 
     def close(self) -> None:
         self._queue.put(_DONE)
+        waker = getattr(self, "_waker", None)
+        if waker is not None:
+            waker.set()
 
     def on_stop(self) -> None:
         pass
@@ -169,6 +180,10 @@ class PythonSubjectSource(RealtimeSource):
     def start(self) -> None:
         self._thread = threading.Thread(target=self.subject.start, daemon=True)
         self._thread.start()
+
+    def attach_waker(self, event) -> None:
+        self.waker = event
+        self.subject._waker = event
 
     def _row_tuple(self, fields: dict[str, Any]) -> tuple:
         row = []
