@@ -228,7 +228,10 @@ class PythonSubjectSource(RealtimeSource):
             arr = (
                 col
                 if isinstance(col, np.ndarray) and col.ndim == 1
-                else column_of_values(list(col))
+                # lists were snapshotted at enqueue — owned, no second copy
+                else column_of_values(
+                    col if isinstance(col, list) else list(col)
+                )
             )
             if n is None:
                 n = len(arr)

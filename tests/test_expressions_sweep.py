@@ -1,0 +1,159 @@
+"""Expression-semantics sweep (reference ``tests/test_common.py`` /
+``test_expressions.py`` style): coalesce/require/if_else/make_tuple/get,
+unary ops, casts, string concat, None handling, ndarray columns and the
+array-valued reducers."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T, run_table
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    G.clear()
+    yield
+    G.clear()
+
+
+def vals(t):
+    return sorted(run_table(t)[0].values(), key=repr)
+
+
+def test_coalesce_picks_first_non_none():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int | None, b=int), [(None, 2), (1, 3)]
+    )
+    assert vals(t.select(c=pw.coalesce(pw.this.a, pw.this.b))) == [(1,), (2,)]
+
+
+def test_if_else_nested():
+    t = T("a\n1\n5\n10")
+    out = t.select(
+        c=pw.if_else(
+            pw.this.a < 3, "low", pw.if_else(pw.this.a < 7, "mid", "high")
+        )
+    )
+    assert vals(out) == [("high",), ("low",), ("mid",)]
+
+
+def test_make_tuple_get_and_negative_index():
+    t = T("a | b\n1 | 2").select(t=pw.make_tuple(pw.this.a, pw.this.b, 7))
+    assert vals(t.select(x=pw.this.t[2], y=pw.this.t[-1])) == [(7, 7)]
+
+
+def test_get_with_default():
+    t = T("a\n1").select(t=pw.make_tuple(pw.this.a))
+    assert vals(t.select(x=pw.this.t.get(5, default=-1))) == [(-1,)]
+
+
+def test_require_yields_none_when_dep_is_none():
+    # reference require(): the value when all deps are non-None, else None
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int | None), [(None,), (2,)]
+    )
+    out = t.select(r=pw.fill_error(pw.require(pw.this.a * 2, pw.this.a), -5))
+    assert vals(out) == [(4,), (None,)]
+
+
+def test_string_concat_with_plus():
+    assert vals(T("a | b\nx | y").select(c=pw.this.a + pw.this.b)) == [("xy",)]
+
+
+def test_int64_wraparound_matches_engine_model():
+    # dense int64 arithmetic wraps like the reference's release-mode Rust
+    # i64 (exact bigint survives on the object path, e.g. sum reducers)
+    out = vals(T("a\n9223372036854775807").select(b=pw.this.a + 1))
+    assert out == [(-9223372036854775808,)]
+
+
+def test_unary_ops():
+    assert vals(T("a\n5").select(b=-pw.this.a, c=~(pw.this.a > 1))) == [
+        (-5, False)
+    ]
+
+
+def test_pow_int_and_float():
+    assert vals(T("a\n2").select(b=pw.this.a ** 10, c=pw.this.a ** 0.5)) == [
+        (1024, 2 ** 0.5)
+    ]
+
+
+def test_boolean_combinators():
+    out = vals(T("a\n1").select(
+        b=(pw.this.a == 1) & (pw.this.a != 2) | (pw.this.a > 5)
+    ))
+    assert out == [(True,)]
+
+
+def test_is_none_is_not_none():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int | None), [(None,), (1,)]
+    )
+    assert vals(t.select(b=pw.this.a.is_none(), c=pw.this.a.is_not_none())) \
+        == [(False, True), (True, False)]
+
+
+def test_abs_round_cast():
+    assert vals(T("a\n-2.7").select(b=abs(pw.this.a))) == [(2.7,)]
+    assert vals(T("a\n2.9").select(b=pw.cast(int, pw.this.a))) == [(2,)]
+
+
+def test_duration_seconds():
+    t = T("a | b\n100 | 40").select(
+        d=(
+            pw.this.a.dt.utc_from_timestamp(unit="s")
+            - pw.this.b.dt.utc_from_timestamp(unit="s")
+        ).dt.seconds()
+    )
+    assert vals(t) == [(60,)]
+
+
+def _nd_table():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(g=str, v=np.ndarray),
+        [
+            ("a", np.array([1.0, 2.0])),
+            ("a", np.array([3.0, 4.0])),
+            ("b", np.array([5.0, 6.0])),
+        ],
+    )
+
+
+def test_ndarray_sum_reducer():
+    r = _nd_table().groupby(pw.this.g).reduce(
+        pw.this.g, s=pw.reducers.sum(pw.this.v)
+    )
+    got = [(g, v.tolist()) for g, v in vals(r)]
+    assert got == [("a", [4.0, 6.0]), ("b", [5.0, 6.0])]
+
+
+def test_ndarray_elementwise_and_matmul():
+    got = sorted(v[0].tolist() for v in vals(_nd_table().select(d=pw.this.v * 2.0)))
+    assert got == [[2.0, 4.0], [6.0, 8.0], [10.0, 12.0]]
+    dots = sorted(float(v[0]) for v in vals(_nd_table().select(d=pw.this.v @ pw.this.v)))
+    assert dots == [5.0, 25.0, 61.0]
+
+
+def test_ndarray_stack_reducer():
+    r = _nd_table().groupby(pw.this.g).reduce(
+        pw.this.g, m=pw.reducers.ndarray(pw.this.v)
+    )
+    got = {g: np.asarray(m).tolist() for g, m in vals(r)}
+    assert got == {"a": [[1.0, 2.0], [3.0, 4.0]], "b": [[5.0, 6.0]]}
+
+
+def test_avg_earliest_latest():
+    t = T("g | v\na | 1\na | 2")
+    assert vals(t.groupby(pw.this.g).reduce(m=pw.reducers.avg(pw.this.v))) == [
+        (1.5,)
+    ]
+    s = T("g | v | __time__\na | 1 | 2\na | 9 | 4")
+    r = s.groupby(pw.this.g).reduce(
+        e=pw.reducers.earliest(pw.this.v), l=pw.reducers.latest(pw.this.v)
+    )
+    assert vals(r) == [(1, 9)]
