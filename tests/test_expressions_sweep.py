@@ -6,18 +6,11 @@ array-valued reducers."""
 from __future__ import annotations
 
 import numpy as np
-import pytest
 
 import pathway_tpu as pw
-from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.testing import T, run_table
 
-
-@pytest.fixture(autouse=True)
-def _fresh():
-    G.clear()
-    yield
-    G.clear()
+# parse-graph reset per test comes from the tests/ conftest autouse fixture
 
 
 def vals(t):
@@ -152,7 +145,9 @@ def test_avg_earliest_latest():
     assert vals(t.groupby(pw.this.g).reduce(m=pw.reducers.avg(pw.this.v))) == [
         (1.5,)
     ]
-    s = T("g | v | __time__\na | 1 | 2\na | 9 | 4")
+    # later-time row listed FIRST: earliest/latest must order by __time__,
+    # not arrival order
+    s = T("g | v | __time__\na | 9 | 4\na | 1 | 2")
     r = s.groupby(pw.this.g).reduce(
         e=pw.reducers.earliest(pw.this.v), l=pw.reducers.latest(pw.this.v)
     )
