@@ -192,8 +192,12 @@ def table_from_pandas(
 
 def _looks_like_ids(index: Any) -> bool:
     try:
-        return not all(int(index[i]) == i for i in range(len(index)))
-    except (TypeError, ValueError, KeyError):
+        arr = np.asarray(index)
+        if arr.dtype.kind not in "iu":
+            arr = arr.astype(np.int64)
+        return not np.array_equal(arr, np.arange(len(arr)))
+    except (TypeError, ValueError, KeyError, OverflowError):
+        # e.g. python ints beyond int64 in the index: treat as opaque
         return False
 
 

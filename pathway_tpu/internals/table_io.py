@@ -147,7 +147,19 @@ def rows_to_table(
         # debug/__init__.py:380-384)
         keys = K.mix_columns([data[c] for c in col_order], n)
     else:
-        fp = K.ref_scalar(repr(col_order), *(repr(r) for r in rows))
+        # content fingerprint from the BUILT columns (vectorized) — the
+        # old per-row repr() was ~40% of static-table construction
+        content = K.mix_columns([data[c] for c in col_order], n)
+        mixed = K.derive(content, K.ref_scalar(repr(col_order)))
+        # bind position INSIDE the per-row mix (derive_pair) before the
+        # XOR fold — a bare `^ arange` outside the mix would be
+        # permutation-invariant (XOR separates), keying reordered or
+        # pairwise-duplicated tables identically
+        positions = K._splitmix(np.arange(n, dtype=np.uint64))
+        order_fp = int(np.bitwise_xor.reduce(
+            K.derive_pair(mixed, positions)
+        )) if n else 0
+        fp = K.ref_scalar(repr(col_order), order_fp)
         keys = K.derive(np.arange(n, dtype=np.uint64), fp)
 
     schema_obj = schema if schema is not None else schema_from_columns(

@@ -136,7 +136,9 @@ def test_ndarray_stack_reducer():
     r = _nd_table().groupby(pw.this.g).reduce(
         pw.this.g, m=pw.reducers.ndarray(pw.this.v)
     )
-    got = {g: np.asarray(m).tolist() for g, m in vals(r)}
+    # rows within a group stack in (time, key) order — deterministic but
+    # key-dependent for same-time rows, so compare as multisets
+    got = {g: sorted(np.asarray(m).tolist()) for g, m in vals(r)}
     assert got == {"a": [[1.0, 2.0], [3.0, 4.0]], "b": [[5.0, 6.0]]}
 
 
