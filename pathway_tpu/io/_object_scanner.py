@@ -74,6 +74,7 @@ def parse_object(
     format: str,
     schema: SchemaMetaclass | None,
     names: list[str],
+    delimiter: str = ",",
 ) -> list[tuple]:
     """Object bytes -> row tuples (DsvParser/JsonLinesParser/IdentityParser
     analog, ``src/connectors/data_format.rs:500,831,1443``)."""
@@ -85,7 +86,7 @@ def parse_object(
             return [(text,)]
         return [(line,) for line in text.splitlines() if line.strip()]
     if format in ("csv", "dsv"):
-        reader = _csv.DictReader(_io.StringIO(text))
+        reader = _csv.DictReader(_io.StringIO(text), delimiter=delimiter)
         out = []
         for rec in reader:
             if schema is not None:
@@ -131,6 +132,7 @@ class ObjectScanSource(RealtimeSource):
         with_metadata: bool = False,
         refresh_interval_s: float = 1.0,
         autocommit_ms: int | None = 1500,
+        delimiter: str = ",",
     ):
         cols = list(names) + ([METADATA_COLUMN] if with_metadata else [])
         super().__init__(cols)
@@ -139,14 +141,21 @@ class ObjectScanSource(RealtimeSource):
         self.fschema = schema
         self.names = list(names)
         self.with_metadata = with_metadata
+        # each poll is one commit batch: an explicit autocommit cadence IS
+        # the refresh cadence on this source
+        if autocommit_ms is not None:
+            refresh_interval_s = min(refresh_interval_s, autocommit_ms / 1000.0)
         self.refresh_interval_s = refresh_interval_s
         self.autocommit_ms = autocommit_ms
+        self.delimiter = delimiter
         self._seen: dict[str, list] = {}
         self._next_poll = 0.0
         self._stopped = False
 
     def _make_rows(self, meta: ObjectMeta, data: bytes) -> list[tuple]:
-        rows = parse_object(data, self.format, self.fschema, self.names)
+        rows = parse_object(
+            data, self.format, self.fschema, self.names, self.delimiter
+        )
         if self.with_metadata:
             md = {
                 "path": meta.key,

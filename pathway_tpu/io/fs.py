@@ -207,6 +207,39 @@ class FsStreamSource(RealtimeSource):
         return False  # watches forever (stop via pw.request_stop)
 
 
+class _LocalFsClient:
+    """ObjectStoreClient over the local filesystem (reference
+    ``posix_like.rs``): each file is an object versioned by
+    (mtime_ns, size), so the shared scanner's modified/deleted-object
+    retraction semantics apply to plain directories."""
+
+    def __init__(self, path: str):
+        self._path = path
+
+    def list_objects(self):
+        from ._object_scanner import ObjectMeta
+
+        out = []
+        for p in _paths_of(self._path):
+            if not os.path.isfile(p):
+                continue  # glob patterns can match directories
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            out.append(ObjectMeta(
+                key=p,
+                version=f"{st.st_mtime_ns}:{st.st_size}",
+                size=st.st_size,
+                modified_at=st.st_mtime,
+            ))
+        return out
+
+    def read_object(self, key: str) -> bytes:
+        with open(key, "rb") as f:
+            return f.read()
+
+
 def read(
     path: str | os.PathLike,
     *,
@@ -220,6 +253,38 @@ def read(
     name: str | None = None,
     **kwargs: Any,
 ) -> Table:
+    if (
+        mode == "streaming"
+        and with_metadata
+        and format in ("csv", "dsv", "json", "jsonlines", "plaintext")
+    ):
+        # object semantics (the reference's posix_like scanner): each file
+        # is one object — a modified file retracts its old rows and inserts
+        # the new version's, a deleted file retracts everything, and every
+        # row carries a _metadata column. The default (tail) path below is
+        # the append-log fast lane.
+        from .s3 import object_source_table
+
+        spath = os.fspath(path)
+        delimiter = getattr(csv_settings, "delimiter", ",") if csv_settings else ","
+        if format == "plaintext":
+            schema = schema or schema_from_types(data=str)
+        if schema is None:
+            probe = read(spath, format=format, schema=None, mode="static",
+                         csv_settings=csv_settings)
+            schema = probe.schema
+            if not schema.column_names():
+                raise ValueError(
+                    f"pw.io.fs.read({spath!r}, mode='streaming'): no files "
+                    "to infer columns from yet — pass schema= explicitly"
+                )
+        return object_source_table(
+            _LocalFsClient(spath), format, schema,
+            mode="streaming", with_metadata=True,
+            refresh_interval_ms=1000,
+            autocommit_duration_ms=autocommit_duration_ms,
+            name=name, delimiter=delimiter,
+        )
     if mode == "streaming" and format in ("csv", "dsv", "json", "jsonlines", "plaintext"):
         from ..internals.parse_graph import Universe
 

@@ -122,6 +122,7 @@ def object_source_table(
     refresh_interval_ms: int,
     autocommit_duration_ms: int | None,
     name: str | None,
+    delimiter: str = ",",
 ) -> Table:
     """Shared source construction for all object-store connectors (s3,
     minio, gdrive, pyfilesystem)."""
@@ -133,7 +134,7 @@ def object_source_table(
         rows: list[tuple] = []
         for meta in sorted(client.list_objects(), key=lambda m: m.key):
             data = client.read_object(meta.key)
-            parsed = parse_object(data, format, schema, names)
+            parsed = parse_object(data, format, schema, names, delimiter)
             if with_metadata:
                 md = _json.dumps({
                     "path": meta.key,
@@ -157,6 +158,7 @@ def object_source_table(
         src = ObjectScanSource(
             client, format, schema, names,
             with_metadata=with_metadata,
+            delimiter=delimiter,
             refresh_interval_s=refresh_interval_ms / 1000.0,
             autocommit_ms=autocommit_duration_ms,
         )

@@ -174,3 +174,46 @@ def test_next_batch_reused_buffer_is_copied():
     # 3000 distinct words, each counted exactly once
     assert len(acc) == 3000
     assert all(v == 1 for v in acc.values())
+
+
+def test_fs_streaming_object_semantics(tmp_path):
+    """with_metadata=True routes fs streaming through the object scanner
+    (reference posix_like.rs): a modified file retracts its old version's
+    rows, a deleted file retracts everything it contributed."""
+    p = tmp_path / "log.csv"
+    p.write_text("word\nalpha\n")
+    extra = tmp_path / "extra.csv"
+
+    t = pw.io.csv.read(
+        str(tmp_path), schema=pw.schema_from_types(word=str),
+        mode="streaming", with_metadata=True,
+        autocommit_duration_ms=100,
+    )
+    assert "_metadata" in t.column_names()
+    counts = t.groupby(pw.this.word).reduce(
+        pw.this.word, c=pw.reducers.count()
+    )
+    acc = {}
+    pw.io.subscribe(
+        counts,
+        on_change=lambda key, row, time, is_addition: (
+            acc.__setitem__(row["word"], row["c"])
+            if is_addition
+            else acc.pop(row["word"], None)
+        ),
+    )
+
+    def writer():
+        time.sleep(1.6)
+        p.write_text("word\ngamma\nbeta\n")
+        extra.write_text("word\ndelta\n")
+        time.sleep(2.2)
+        extra.unlink()
+        time.sleep(2.2)
+        from pathway_tpu.internals.run import request_stop
+
+        request_stop()
+
+    threading.Thread(target=writer, daemon=True).start()
+    pw.run()
+    assert sorted(acc.items()) == [("beta", 1), ("gamma", 1)]
