@@ -93,6 +93,7 @@ from .internals.interactive import (  # noqa: E402
     enable_interactive_mode,
     is_interactive_mode_enabled,
 )
+from .stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
 from .internals.row_transformer import (  # noqa: E402
     ClassArg,
     attribute,
@@ -113,6 +114,7 @@ def set_monitoring_config(*args, **kwargs) -> None:
 
 
 __all__ = [
+    "AsyncTransformer",
     "BaseCustomAccumulator",
     "ClassArg",
     "ColumnExpression",

@@ -37,6 +37,12 @@ _FAILED = object()
 class AsyncTransformer(ABC):
     output_schema: ClassVar[SchemaMetaclass]
 
+    def __init_subclass__(cls, output_schema: Any = None, **kw: Any) -> None:
+        # reference form: class X(pw.AsyncTransformer, output_schema=Schema)
+        super().__init_subclass__(**kw)
+        if output_schema is not None:
+            cls.output_schema = output_schema
+
     def __init__(self, input_table: Table, *, instance: Any = None, **kwargs: Any):
         if not hasattr(self, "output_schema"):
             raise ValueError("AsyncTransformer subclass must set output_schema")

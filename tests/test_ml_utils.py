@@ -9,7 +9,7 @@ import pytest
 
 import pathway_tpu as pw
 from pathway_tpu.internals.parse_graph import G
-from pathway_tpu.testing import T, assert_table_equality_wo_index
+from pathway_tpu.testing import T, assert_table_equality_wo_index, run_table
 
 
 @pytest.fixture(autouse=True)
@@ -233,3 +233,19 @@ def test_hmm_reducer():
     )
     [state] = pw.debug.table_to_pandas(decoded)["state"].tolist()
     assert state == "B"
+
+
+def test_async_transformer_class_keyword_schema():
+    """Reference form: class X(pw.AsyncTransformer, output_schema=Schema)
+    — the schema rides the class keyword, and pw.AsyncTransformer is a
+    top-level export."""
+    G.clear()
+
+    class Doubler(pw.AsyncTransformer, output_schema=pw.schema_from_types(d=int)):
+        async def invoke(self, v):
+            return {"d": v * 2}
+
+    t = T("v\n3\n4")
+    out = Doubler(input_table=t).successful
+    state, _ = run_table(out)
+    assert sorted(state.values()) == [(6,), (8,)]
