@@ -41,6 +41,11 @@ class AsyncTransformer(ABC):
         # reference form: class X(pw.AsyncTransformer, output_schema=Schema)
         super().__init_subclass__(**kw)
         if output_schema is not None:
+            if not isinstance(output_schema, SchemaMetaclass):
+                raise TypeError(
+                    f"output_schema must be a pw.Schema subclass, got "
+                    f"{output_schema!r}"
+                )
             cls.output_schema = output_schema
 
     def __init__(self, input_table: Table, *, instance: Any = None, **kwargs: Any):

@@ -1,0 +1,172 @@
+"""Randomized differential consistency (fixed seeds): a random update
+stream through a pipeline must end in exactly the state of a batch run
+over the net surviving rows — the incremental-computation contract
+(reference README: outputs continuously consistent under changes), and
+the sharded run must match the single-worker run row-for-row."""
+
+from __future__ import annotations
+
+import os
+import random
+from collections import Counter
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T, _norm, run_table
+
+
+def _random_stream(rng, n_lo, n_hi, make_row, retract_p=0.4):
+    """Random insert/retract event stream over rows from ``make_row``;
+    returns (net surviving rows, (row..., time, diff) events)."""
+    live, events, t_now = [], [], 2
+    for _ in range(rng.randint(n_lo, n_hi)):
+        if live and rng.random() < retract_p:
+            row = live.pop(rng.randrange(len(live)))
+            events.append((*row, t_now, -1))
+        else:
+            row = make_row(rng)
+            live.append(row)
+            events.append((*row, t_now, 1))
+        if rng.random() < 0.5:
+            t_now += 2
+    return live, events
+
+
+def _stream_table(events):
+    lines = ["k | v | __time__ | __diff__"] + [
+        f"{k} | {v} | {t} | {d}" for k, v, t, d in events
+    ]
+    return T("\n".join(lines))
+
+
+def _batch_table(live):
+    if not live:
+        return T("k | v\nzz | 0").filter(pw.this.v > 99)
+    return T("\n".join(["k | v"] + [f"{k} | {v}" for k, v in live]))
+
+
+def _groupby_join_pipeline(t, names):
+    counts = t.groupby(pw.this.k).reduce(
+        pw.this.k,
+        s=pw.reducers.sum(pw.this.v),
+        mx=pw.reducers.max(pw.this.v),
+        c=pw.reducers.count(),
+    )
+    j = counts.join_left(names, counts.k == names.k).select(
+        pw.left.k, s=pw.this.s, mx=pw.this.mx, c=pw.this.c,
+        label=pw.right.label,
+    )
+    return j.filter(pw.this.c > 0)
+
+
+def _names():
+    return T("\n".join(["k | label"] + [f"k{i} | L{i}" for i in range(4)]))
+
+
+def test_stream_vs_batch_groupby_join():
+    for seed in range(25):
+        rng = random.Random(seed)
+        live, events = _random_stream(
+            rng, 10, 40,
+            lambda r: (r.choice([f"k{i}" for i in range(6)]), r.randint(-5, 20)),
+        )
+        G.clear()
+        streamed = sorted(
+            run_table(_groupby_join_pipeline(_stream_table(events), _names()))[0].values(),
+            key=repr,
+        )
+        G.clear()
+        batch = sorted(
+            run_table(_groupby_join_pipeline(_batch_table(live), _names()))[0].values(),
+            key=repr,
+        )
+        assert streamed == batch, (seed, streamed, batch)
+
+
+def _win_pipeline(t):
+    return t.windowby(
+        pw.this.ts,
+        window=pw.temporal.sliding(hop=3, duration=6),
+        instance=pw.this.k,
+    ).reduce(
+        k=pw.this._pw_instance,
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+        mn=pw.reducers.min(pw.this.v),
+    )
+
+
+def test_stream_vs_batch_sliding_windows():
+    for seed in range(20):
+        rng = random.Random(seed)
+        live, events = _random_stream(
+            rng, 8, 30,
+            lambda r: (r.choice("ab"), r.randint(0, 12), r.randint(-4, 9)),
+            retract_p=0.35,
+        )
+        G.clear()
+        lines = ["k | ts | v | __time__ | __diff__"] + [
+            f"{k} | {ts} | {v} | {t} | {d}" for k, ts, v, t, d in events
+        ]
+        streamed = sorted(
+            run_table(_win_pipeline(T("\n".join(lines))))[0].values(), key=repr
+        )
+        G.clear()
+        if live:
+            lines2 = ["k | ts | v"] + [f"{k} | {ts} | {v}" for k, ts, v in live]
+            batch = sorted(
+                run_table(_win_pipeline(T("\n".join(lines2))))[0].values(),
+                key=repr,
+            )
+        else:
+            batch = []
+        assert streamed == batch, (seed, streamed, batch)
+
+
+def _collect(build, workers):
+    G.clear()
+    acc: Counter = Counter()
+    table = build()
+    cols = table.column_names()
+    pw.io.subscribe(
+        table,
+        on_change=lambda key, row, time, is_addition: acc.update(
+            {tuple(_norm(row[c]) for c in cols): 1 if is_addition else -1}
+        ),
+    )
+    prev = os.environ.get("PATHWAY_THREADS")
+    os.environ["PATHWAY_THREADS"] = str(workers)
+    try:
+        pw.run()
+    finally:
+        if prev is None:
+            os.environ.pop("PATHWAY_THREADS", None)
+        else:
+            os.environ["PATHWAY_THREADS"] = prev
+        G.clear()
+    assert all(v >= 0 for v in acc.values())
+    return +acc
+
+
+def test_randomized_sharded_outer_join_parity():
+    def pipeline(t, names):
+        counts = t.groupby(pw.this.k).reduce(
+            pw.this.k, s=pw.reducers.sum(pw.this.v), mx=pw.reducers.max(pw.this.v)
+        )
+        return counts.join_outer(names, counts.k == names.k).select(
+            k=pw.left.k, s=pw.this.s, label=pw.right.label
+        )
+
+    for seed in range(6):
+        rng = random.Random(seed)
+        live, events = _random_stream(
+            rng, 10, 35, lambda r: (r.choice("abcdef"), r.randint(-5, 20))
+        )
+
+        def build():
+            names = T("\n".join(["k | label"] + [f"{c} | L{c}" for c in "abc"]))
+            return pipeline(_stream_table(events), names)
+
+        single = _collect(build, 1)
+        sharded = _collect(build, 4)
+        assert single == sharded, (seed, single - sharded, sharded - single)
