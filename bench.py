@@ -15,11 +15,17 @@ import time
 import numpy as np
 
 
+LAST_GOOD_TPU = "BENCH_TPU_LASTGOOD.json"
+
+
 def _probe_backend() -> None:
     """The tunneled TPU backend can wedge client init indefinitely (observed:
     make_c_api_client hanging). Probe device init in a subprocess with a
-    timeout; if it hangs, fall back to the CPU platform so the bench still
-    reports numbers instead of hanging the driver."""
+    timeout — THREE attempts with backoff, because a wedged tunnel can
+    recover between retries (r3 lost its whole TPU story to one failed
+    probe). Only after all attempts fail fall back to the CPU platform;
+    main() then publishes the CPU numbers with the last-good TPU capture
+    attached (keyed off the resulting jax platform, see _record_capture)."""
     import os
     import subprocess
     import sys
@@ -30,22 +36,34 @@ def _probe_backend() -> None:
         guard_cpu_platform()
         return
     if os.environ.get("PATHWAY_BENCH_SKIP_PROBE"):
-        return  # operator opt-out: skip the ~backend-init-cost health probe
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=180, check=True,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-        )
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
-        print(
-            "bench: accelerator backend init hung/failed; falling back to cpu",
-            file=sys.stderr,
-        )
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        from pathway_tpu.utils.jaxcfg import guard_cpu_platform
+        return  # operator opt-out: skip the ~backend-init-cost probe
+    attempts = (120, 180, 240)
+    for attempt, timeout_s in enumerate(attempts):
+        try:
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s, check=True,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            return
+        except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+            print(
+                f"bench: accelerator probe attempt {attempt + 1} "
+                f"hung/failed (timeout {timeout_s}s)",
+                file=sys.stderr,
+            )
+            if attempt < len(attempts) - 1:
+                time.sleep(10 * (attempt + 1))
+    print(
+        "bench: accelerator backend init hung/failed after 3 attempts; "
+        "falling back to cpu",
+        file=sys.stderr,
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from pathway_tpu.utils.jaxcfg import guard_cpu_platform
 
-        guard_cpu_platform()
+    guard_cpu_platform()
+    return False
 
 
 def main() -> None:
@@ -100,8 +118,8 @@ def main() -> None:
 
     roundtrip_ms = _device_roundtrip_ms()
     embed = _embed_throughput(on_tpu)
-    rag_ingest = _rag_ingest_throughput(on_tpu)
-    rest_p50 = _rest_rag_p50()
+    rag_ingest, ingest_docs = _rag_ingest_throughput(on_tpu)
+    rest_p50, serve_docs = _rest_rag_p50(on_tpu)
     wc_rows_per_sec = _wordcount_throughput()
     wc_rowwise = _wordcount_throughput(rowwise=True)
     join_rows_per_sec = _join_throughput()
@@ -113,7 +131,7 @@ def main() -> None:
 
     n_cores = _os.cpu_count() or 1
 
-    print(json.dumps({
+    result = {
         "metric": f"knn_p50_latency_{n_docs // 1000}k_docs_batch{n_queries}",
         "value": round(p50, 3),
         "unit": "ms",
@@ -146,7 +164,9 @@ def main() -> None:
             "embed_tokens_per_sec": round(embed["tok_per_sec"], 1),
             "embed_mfu": embed["mfu"],
             "rag_ingest_docs_per_sec_per_chip": round(rag_ingest, 1),
+            "rag_ingest_n_docs": ingest_docs,
             "rest_rag_p50_ms": round(rest_p50, 2),
+            "rest_serve_n_docs": serve_docs,
             "rest_rag_vs_50ms_target": round(target_ms / rest_p50, 3),
             # host<->device latency of the test rig's tunneled TPU; each
             # serve-path request pays ~2 of these (query embed + search),
@@ -157,7 +177,41 @@ def main() -> None:
             ),
             "baseline_note": "reference publishes no in-repo numbers (BASELINE.md); 50ms north-star serve target used",
         },
-    }))
+    }
+    _record_capture(result, platform)
+    print(json.dumps(result))
+
+
+def _record_capture(result: dict, platform: str) -> None:
+    """A perf-gated project must never publish an evidence-free round: a
+    TPU run saves itself as the last-good capture; a CPU fallback attaches
+    the saved capture (clearly marked stale) under ``extra.last_good_tpu``."""
+    import datetime
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        LAST_GOOD_TPU)
+    if platform != "cpu":
+        try:
+            with open(path, "w") as f:
+                json.dump({
+                    "captured_at": datetime.datetime.now(
+                        datetime.timezone.utc
+                    ).isoformat(),
+                    "result": result,
+                }, f, indent=1)
+        except OSError:
+            pass
+    else:
+        try:
+            with open(path) as f:
+                saved = json.load(f)
+        except (OSError, ValueError):
+            return
+        result["extra"]["last_good_tpu"] = {
+            "note": "this run fell back to cpu; stale TPU capture attached",
+            **saved,
+        }
 
 
 def _device_roundtrip_ms() -> float:
@@ -228,38 +282,55 @@ def _embed_throughput(on_tpu: bool) -> dict:
     }
 
 
-def _rag_ingest_throughput(on_tpu: bool) -> float:
+def _rag_ingest_throughput(on_tpu: bool) -> tuple[float, int]:
     """Documents/sec through the ingest pipeline on one chip: WordPiece-free
-    tokenize -> batched MXU embed -> KNN index add (the DocumentStore build
-    side, BASELINE.json rag_ingest_docs_per_sec_per_chip)."""
+    tokenize -> batched MXU embed -> bulk KNN index insert (the
+    DocumentStore build side, BASELINE.json rag_ingest_docs_per_sec_per_chip).
+    North-star scale on TPU: >=100k documents (VERDICT r3 #2); the CPU
+    fallback keeps a small corpus so a wedged-tunnel round still finishes."""
+    import os
+
     from pathway_tpu.models.embedder import Embedder
     from pathway_tpu.ops.index_engines import BruteForceKnnEngine
 
-    n_docs = 4096 if on_tpu else 256
+    n_docs = int(os.environ.get(
+        "PATHWAY_BENCH_INGEST_DOCS", 100_000 if on_tpu else 512
+    ))
     docs = [
         f"document {i} about streaming dataflow engines and tpu kernels "
         f"with incremental state number {i % 97}" for i in range(n_docs)
     ]
     emb = Embedder()
-    engine = BruteForceKnnEngine(emb.cfg.dim, reserved_space=n_docs)
+    engine = BruteForceKnnEngine(
+        emb.cfg.dim, reserved_space=n_docs, embedder=emb
+    )
     emb.embed_texts(docs[:8])  # compile outside the timed region
     t0 = time.perf_counter()
-    bs = 256
+    bs = 1024 if on_tpu else 256
     for start in range(0, n_docs, bs):
         chunk = docs[start:start + bs]
-        vecs = emb.embed_texts(chunk)
-        for j, v in enumerate(vecs):
-            engine.add(start + j, v, None)
+        engine.add_batch(
+            list(range(start, start + len(chunk))), chunk,
+            [None] * len(chunk),
+        )
     elapsed = time.perf_counter() - t0
-    return n_docs / elapsed
+    return n_docs / elapsed, n_docs
 
 
-def _rest_rag_p50() -> float:
+def _rest_rag_p50(on_tpu: bool) -> tuple[float, int]:
     """End-to-end serve latency: HTTP request -> rest_connector -> dataflow
     retrieve (MXU KNN over the document index) -> response, p50 over 40
     requests — the path the 50 ms north-star target is about (LLM call
-    excluded: it is an external service in the reference too)."""
-    import threading
+    excluded: it is an external service in the reference too).
+
+    North-star scale on TPU: the index holds 1M documents
+    (BASELINE.json "1M docs indexed, p50 < 50ms"). Document vectors are
+    precomputed unit vectors fed through the DocumentStore's pre-embedded
+    mode (embedding 1M docs is the *ingest* bench's claim, measured
+    separately at 100k real embeds); every request still pays the full
+    production path — HTTP -> dataflow tick -> on-device query embed ->
+    MXU scoring over all 1M vectors -> response."""
+    import os
     import urllib.request
 
     import pathway_tpu as pw
@@ -273,47 +344,79 @@ def _rest_rag_p50() -> float:
 
     G.clear()
     embedder = TpuEmbedder(max_len=32)
-    n_docs = 512
-    docs = pw.debug.table_from_rows(
-        pw.schema_from_types(data=str, _metadata=dict),
-        [
-            (f"doc {i} on topic {i % 29} covering dataflow shard {i % 7}",
-             {"path": f"d{i}.txt"})
-            for i in range(n_docs)
-        ],
+    n_docs = int(os.environ.get(
+        "PATHWAY_BENCH_SERVE_DOCS", 1_000_000 if on_tpu else 512
+    ))
+    dim = embedder.embedder.cfg.dim
+    rng = np.random.default_rng(3)
+    feed_bs = 100_000
+
+    class DocFeed(pw.io.python.ConnectorSubject):
+        def run(self) -> None:
+            for start in range(0, n_docs, feed_bs):
+                stop = min(start + feed_bs, n_docs)
+                vecs = rng.standard_normal(
+                    (stop - start, dim), dtype=np.float32
+                )
+                vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+                self.next_batch({
+                    "data": [
+                        f"doc {i} on topic {i % 29} covering dataflow "
+                        f"shard {i % 7}" for i in range(start, stop)
+                    ],
+                    "_metadata": [
+                        {"path": f"d{i}.txt"} for i in range(start, stop)
+                    ],
+                    "vec": list(vecs),
+                })
+                self.commit()
+
+    docs = pw.io.python.read(
+        DocFeed(),
+        schema=pw.schema_from_types(
+            data=str, _metadata=dict, vec=np.ndarray
+        ),
+        autocommit_duration_ms=None,
     )
     store = DocumentStore(
         docs,
         BruteForceKnnFactory(
-            dimensions=embedder.embedder.cfg.dim,
+            dimensions=dim,
+            reserved_space=n_docs,
             # the models.Embedder itself: the engine batches adds through
             # embed_texts and keeps query embeddings device-resident
             # (embed->score->top_k, one host roundtrip per request)
             embedder=embedder.embedder,
         ),
+        vector_column="vec",
     )
     port = 28431
     server = DocumentStoreServer("127.0.0.1", port, store)
     lat: list[float] = []
     try:
         server.run(threaded=True)
-        # wait for the webserver to bind + the index build to finish (the
-        # first embed compiles XLA shape buckets)
-        deadline = time.monotonic() + 180
+        # wait for the webserver to bind + the FULL corpus to be indexed
+        # (statistics reports the live doc count; measuring against a
+        # half-built index would understate the scoring cost)
+        deadline = time.monotonic() + (1800 if n_docs > 10_000 else 300)
         while True:
             try:
-                urllib.request.urlopen(
+                body = urllib.request.urlopen(
                     urllib.request.Request(
                         f"http://127.0.0.1:{port}/v1/statistics", data=b"{}",
                         headers={"Content-Type": "application/json"},
                     ),
-                    timeout=5,
+                    timeout=10,
                 ).read()
-                break
+                if json.loads(body).get("file_count") == n_docs:
+                    break
             except Exception:
-                if time.monotonic() > deadline:
-                    raise
-                time.sleep(0.5)
+                pass
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"index build did not reach {n_docs} docs in time"
+                )
+            time.sleep(1.0)
         for i in range(44):
             payload = json.dumps({
                 "query": f"dataflow shard topic {i % 13}", "k": 3,
@@ -333,7 +436,7 @@ def _rest_rag_p50() -> float:
         if server._thread is not None:
             server._thread.join(timeout=10)
         G.clear()
-    return float(np.percentile(lat, 50))
+    return float(np.percentile(lat, 50)), n_docs
 
 
 def _mesh_exchange_throughput(n_rows: int = 100_000, batch: int = 10_000) -> float | None:
@@ -376,8 +479,26 @@ def _mesh_exchange_throughput(n_rows: int = 100_000, batch: int = 10_000) -> flo
             [sys.executable, "-c", prog], env=env, capture_output=True,
             text=True, timeout=300,
         )
-        return float(out.stdout.strip().splitlines()[-1])
-    except Exception:
+    except subprocess.TimeoutExpired:
+        print("bench: mesh-exchange subprocess timed out", file=sys.stderr)
+        return None
+    if out.returncode != 0:
+        print(
+            "bench: mesh-exchange subprocess failed "
+            f"(rc={out.returncode}):\n{out.stderr.strip()[-2000:]}",
+            file=sys.stderr,
+        )
+        return None
+    lines = out.stdout.strip().splitlines()
+    try:
+        # the program prints exactly one float as its final line; anything
+        # else (stray prints, truncated output) is a failure, not a number
+        return float(lines[-1])
+    except (IndexError, ValueError):
+        print(
+            f"bench: unexpected mesh-exchange subprocess output: {lines[-3:]}",
+            file=sys.stderr,
+        )
         return None
 
 

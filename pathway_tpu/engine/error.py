@@ -28,8 +28,6 @@ class _ErrorLog:
         self._max_logged = max_logged
 
     def record(self, message: str, context: str) -> None:
-        global _errors_seen
-        _errors_seen = True
         with self._lock:
             self.total += 1
             if len(self._entries) < self._max_kept:
@@ -53,14 +51,23 @@ class _ErrorLog:
 
 ERROR_LOG = _ErrorLog()
 
-#: latched True by every Error construction or unpickle and never reset —
-#: the zero-cost "may any Error value exist in this process?" gate used by
-#: the engine's error-aware fast paths
-_errors_seen = False
+#: count of Error values alive in this process — the cheap "may any Error
+#: value exist?" gate used by the engine's error-aware fast paths. Counting
+#: live objects (not a sticky latch) lets a long-lived multi-pipeline
+#: process recover the no-error fast path once all Error values are
+#: garbage-collected (ADVICE r3: scope the latch per-run).
+_live_errors = 0
+_count_lock = threading.Lock()
+
+
+def _incr() -> None:
+    global _live_errors
+    with _count_lock:
+        _live_errors += 1
 
 
 def errors_seen() -> bool:
-    return _errors_seen
+    return _live_errors > 0
 
 
 class Error:
@@ -71,6 +78,7 @@ class Error:
     __slots__ = ("message",)
 
     def __init__(self, message: str = "Error", context: str = "<expression>"):
+        _incr()
         self.message = message
         ERROR_LOG.record(message, context)
 
@@ -79,11 +87,28 @@ class Error:
         """An Error value without a log entry — for re-derived errors (a
         group aggregate re-read while its error rows persist) whose root
         cause was already logged when the original row Error was built."""
-        global _errors_seen
-        _errors_seen = True
+        _incr()
         e = cls.__new__(cls)
         e.message = message
         return e
+
+    def __del__(self) -> None:
+        global _live_errors
+        try:
+            # _incr() runs exactly when `message` is set (init / silent /
+            # __setstate__); a half-built instance must not decrement.
+            # Non-blocking acquire: __del__ can run from a GC pass while
+            # this same thread holds the lock inside _incr — blocking here
+            # would deadlock. On contention we skip the decrement: the
+            # count only ever over-states, which keeps the error-aware
+            # paths conservatively on (never silently off).
+            if hasattr(self, "message") and _count_lock.acquire(blocking=False):
+                try:
+                    _live_errors -= 1
+                finally:
+                    _count_lock.release()
+        except Exception:  # interpreter shutdown: globals may be gone
+            pass
 
     def __repr__(self) -> str:
         return "Error"
@@ -106,8 +131,7 @@ class Error:
         return self.message
 
     def __setstate__(self, state):
-        global _errors_seen
-        _errors_seen = True
+        _incr()
         self.message = state
 
 

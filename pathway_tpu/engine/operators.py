@@ -19,7 +19,7 @@ import numpy as np
 
 from . import keys as K
 from .delta import Delta, column_of_values, concat_deltas, rows_to_columns
-from .error import ERROR_LOG, Error as EngineError, errors_seen
+from .error import ERROR_LOG, Error as EngineError, errors_seen, is_error
 from .executor import END_TIME, Node, SourceNode
 from .reducers import ReducerImpl
 from .state import MultiIndex, RowState
@@ -905,7 +905,15 @@ class Join(Node):
         """Rows whose join key evaluated to an Error carry the reserved
         ``K.ERROR_KEY`` sentinel (graph_runner jk_fn) — drop them with a
         log entry before they reach join state, so Error keys match
-        nothing (Error compares equal to nothing, value.rs:226)."""
+        nothing (Error compares equal to nothing, value.rs:226).
+
+        The uint64 sentinel compare runs UNCONDITIONALLY: the Error
+        objects that produced the sentinel were transient (freed when
+        jk_fn returned), so the live-error gate may already be off by the
+        time the Join node runs — only the sentinel remains. The
+        object-column scan stays gated on ``errors_seen()``, which is safe
+        there because any Error it could find is alive inside this very
+        delta and therefore counted."""
         if delta is None or jk_col is None or not len(delta):
             return delta
         col = np.asarray(delta.data[jk_col])
@@ -913,6 +921,8 @@ class Join(Node):
             # raw pointer key columns (optional ix / having) may hold
             # None or Error objects — drop only the Errors here; None
             # keeps its pre-existing downstream handling
+            if not errors_seen():
+                return delta
             m = np.fromiter(
                 (type(v) is EngineError for v in col), bool, len(col)
             )
@@ -1064,11 +1074,10 @@ class Join(Node):
             ))
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
-        if errors_seen():
-            ins = [
-                self._drop_error_keys(d, jk)
-                for d, jk in zip(ins, (self._ljk, self._rjk))
-            ]
+        ins = [
+            self._drop_error_keys(d, jk)
+            for d, jk in zip(ins, (self._ljk, self._rjk))
+        ]
         if self._columnar:
             return self._process_columnar(ins)
         dl = self._rows_of(ins[0], self._ljk, self._lcols)
@@ -1413,6 +1422,21 @@ def _time_column(col) -> np.ndarray:
     return a
 
 
+def _watermark_max(col, context: str):
+    """Max of an event-time watermark column, skipping values that cannot
+    advance a frontier (None / Error) with an error-log entry instead of a
+    TypeError that would kill the run. None = nothing comparable."""
+    raw = _time_column(col).tolist()
+    comparable = [v for v in raw if v is not None and not is_error(v)]
+    if len(comparable) != len(raw):
+        ERROR_LOG.record(
+            f"{len(raw) - len(comparable)} non-comparable watermark "
+            "value(s) skipped",
+            context,
+        )
+    return max(comparable) if comparable else None
+
+
 def _entries_delta(
     entries: list, names: list[str], negate: bool = False
 ) -> Delta | None:
@@ -1454,8 +1478,12 @@ class BufferUntil(Node):
         thr = _time_column(d.data[self._col])
         wm_moved = False
         if self._wm_col is not None:
-            batch_max = max(_time_column(d.data[self._wm_col]).tolist())
-            if self._watermark is None or batch_max > self._watermark:
+            batch_max = _watermark_max(
+                d.data[self._wm_col], "BufferUntil(watermark)"
+            )
+            if batch_max is not None and (
+                self._watermark is None or batch_max > self._watermark
+            ):
                 self._watermark = batch_max
                 wm_moved = True
         if self._watermark is None:
@@ -1550,8 +1578,12 @@ class ForgetAfter(Node):
         out = d.take(np.flatnonzero(keep))
         wm_moved = False
         if self._wm_col is not None:
-            batch_max = max(_time_column(d.data[self._wm_col]).tolist())
-            if self._watermark is None or batch_max > self._watermark:
+            batch_max = _watermark_max(
+                d.data[self._wm_col], "ForgetLate(watermark)"
+            )
+            if batch_max is not None and (
+                self._watermark is None or batch_max > self._watermark
+            ):
                 self._watermark = batch_max
                 wm_moved = True
         if self._forget and len(out):

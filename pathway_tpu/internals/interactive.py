@@ -98,17 +98,25 @@ class LiveTable(DisplayAsStr):
     # -- state ingestion (engine thread) -------------------------------
 
     def _on_tick_delta(self, time, delta) -> None:
+        from ..engine.delta import rows_equal
+
         entries = list(delta.iter_rows())  # (key, row_tuple, diff)
         with self._lock:
             for key, values, diff in entries:
                 if diff > 0:
                     self._rows[key] = values
-                elif self._rows.get(key) == values:
-                    # value-aware: within a tick the retract of the OLD row
-                    # may come after the insert of the new one for the same
+                elif rows_equal(self._rows.get(key), values):
+                    # value-aware (array-safe: tuple == on ndarray cells
+                    # raises): within a tick the retract of the OLD row may
+                    # come after the insert of the new one for the same
                     # key — only remove what is actually stored
                     self._rows.pop(key, None)
-        for cb in self._callbacks:
+            # snapshot under the lock: subscribe() appends concurrently.
+            # Callbacks run on the engine thread, after the tick's rows are
+            # applied but before the next tick can mutate them (the engine
+            # sweep is single-threaded per worker).
+            cbs = list(self._callbacks)
+        for cb in cbs:
             for key, values, diff in entries:
                 cb(
                     key=key,

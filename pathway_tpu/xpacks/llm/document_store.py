@@ -47,6 +47,7 @@ class DocumentStore:
         parser: Callable | None = None,
         splitter: Callable | None = None,
         doc_post_processors: list[Callable] | None = None,
+        vector_column: str | None = None,
     ):
         from .parsers import ParseUtf8
         from .splitters import NullSplitter
@@ -66,6 +67,12 @@ class DocumentStore:
         self.splitter = splitter or NullSplitter()
         self.doc_post_processors = doc_post_processors or []
         self.retriever_factory = retriever_factory
+        #: pre-embedded mode: when set, ``docs`` rows are already chunks and
+        #: this column holds their embedding vectors — parse/split are
+        #: skipped and the index is built over the vectors directly (the
+        #: retriever's embedder then only embeds queries). The common
+        #: "embeddings computed offline / by another pipeline" deployment.
+        self.vector_column = vector_column
         self.build_pipeline()
 
     # ------------------------------------------------------------------
@@ -79,6 +86,24 @@ class DocumentStore:
 
     def build_pipeline(self) -> None:
         docs = self._ensure_metadata(self.docs)
+
+        if self.vector_column is not None:
+            # pre-embedded chunks: index straight over the vector column
+            chunked = docs.select(
+                text=this.data,
+                _metadata=this._metadata,
+                _pw_vector=this[self.vector_column],
+            )
+            self.parsed_documents = chunked.select(
+                text=this.text, _metadata=this._metadata
+            )
+            self.chunked_documents = chunked
+            self.index = self.retriever_factory.build_index(
+                pw.ColumnReference(chunked, "_pw_vector"),
+                chunked,
+                metadata_column=this._metadata,
+            )
+            return
 
         # parse: data -> [(text, meta)]; one row per parsed part
         parsed = docs.select(

@@ -139,14 +139,22 @@ def test_error_flows_through_dense_downstream_ops():
     assert rows(out) == [(-1,), (10,)]
 
 
-def test_errors_seen_latch_survives_log_clear():
+def test_errors_seen_gate_scoped_to_live_errors():
+    # r3 ADVICE: the gate is a live-object count, not a sticky process
+    # latch — it stays on while any Error value is alive (even after the
+    # log clears) and recovers the fast path once they are collected
+    import gc
+
     from pathway_tpu.engine import error as err_mod
 
-    t = T("a | b\n5 | 0")
-    out = t.select(d=pw.fill_error(pw.this.a // pw.this.b, -1))
-    assert rows(out) == [(-1,)]
+    base = err_mod._live_errors
+    e = err_mod.Error.silent("held")
     ERROR_LOG.clear()
-    assert err_mod.errors_seen()  # the latch must not reset with the log
+    assert err_mod.errors_seen()  # clearing the log must not reset the gate
+    assert err_mod._live_errors == base + 1
+    del e
+    gc.collect()
+    assert err_mod._live_errors == base
 
 
 def test_error_pickle_roundtrip_sets_latch():

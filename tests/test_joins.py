@@ -490,3 +490,40 @@ def test_foreign_subset_universe_rejected():
         from pathway_tpu.internals.graph_runner import GraphRunner
 
         GraphRunner().run_tables(t.select(pw.this.a, y=f.b))
+
+
+def test_join_error_keys_dropped_even_without_live_errors():
+    # The Errors produced while computing a join key are TRANSIENT — freed
+    # as soon as the key expression returns, leaving only the ERROR_KEY
+    # sentinel in the key column. The sentinel drop must therefore not be
+    # gated on live-error detection (regression: r4 errors_seen() rework).
+    import gc
+
+    gc.collect()
+    left = T(
+        """
+        a | b
+        6 | 2
+        5 | 0
+        7 | 0
+        """
+    )
+    right = T(
+        """
+        k | d
+        3 | 1
+        9 | 0
+        """
+    )
+    j = left.join(right, left.a // left.b == right.k // right.d).select(
+        left.a, right.k
+    )
+    expected = T(
+        """
+        a | k
+        6 | 3
+        """
+    )
+    # without the unconditional sentinel check, the two left Error rows
+    # and the right Error row all share ERROR_KEY and spuriously match
+    assert_table_equality_wo_index(j, expected)

@@ -256,3 +256,54 @@ def test_qa_rest_server_roundtrip():
         terminate_all()
         if server._thread is not None:
             server._thread.join(timeout=10)
+
+
+def test_document_store_pre_embedded_mode():
+    # vector_column: docs arrive as chunks with precomputed embeddings;
+    # the index scores those vectors while queries go through the embedder
+    rows = [
+        (text, meta, fake_embed(text)) for text, meta in DOCS
+    ]
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(data=str, _metadata=dict, vec=np.ndarray), rows
+    )
+    store = DocumentStore(
+        docs,
+        BruteForceKnnFactory(dimensions=16, embedder=fake_embed),
+        vector_column="vec",
+    )
+    queries = pw.debug.table_from_rows(
+        DocumentStore.RetrieveQuerySchema,
+        [("systolic array MXU matrices", 2, None, None)],
+    )
+    [row] = pw.debug.table_to_pandas(store.retrieve_query(queries))["result"].tolist()
+    assert row[0]["metadata"]["path"] == "tpu.txt"
+    assert row[0]["text"].startswith("TPUs multiply")
+    assert len(row) == 2
+
+
+def test_brute_force_bulk_add_matches_per_row():
+    from pathway_tpu.ops.index_engines import BruteForceKnnEngine
+
+    rng = np.random.default_rng(5)
+    vecs = rng.standard_normal((300, 16)).astype(np.float32)
+    a = BruteForceKnnEngine(16, reserved_space=16)
+    b = BruteForceKnnEngine(16, reserved_space=16)
+    for i, v in enumerate(vecs):
+        a.add(i, v, {"path": f"{i}.txt"} if i % 3 == 0 else None)
+    b.add_batch(
+        list(range(300)), list(vecs),
+        [{"path": f"{i}.txt"} if i % 3 == 0 else None for i in range(300)],
+    )
+    # updates through the bulk path replace, not duplicate
+    b.add_batch([7, 8], [vecs[7], vecs[8]], [None, None])
+    a.add(7, vecs[7], None)
+    a.add(8, vecs[8], None)
+    q = rng.standard_normal((4, 16)).astype(np.float32)
+    ra = a.search(list(q), [5] * 4, [None] * 4)
+    rb = b.search(list(q), [5] * 4, [None] * 4)
+    assert [[k for k, _ in r] for r in ra] == [[k for k, _ in r] for r in rb]
+    # metadata filters survive the bulk path
+    [fa] = a.search([q[0]], [3], ["globmatch('9.txt', path)"])
+    [fb] = b.search([q[0]], [3], ["globmatch('9.txt', path)"])
+    assert [k for k, _ in fa] == [k for k, _ in fb] == [9]
