@@ -122,6 +122,7 @@ def main() -> None:
     rest_p50, serve_docs = _rest_rag_p50(on_tpu)
     wc_rows_per_sec = _wordcount_throughput()
     wc_rowwise = _wordcount_throughput(rowwise=True)
+    apply_lifted, apply_perrow = _apply_throughput()
     join_rows_per_sec = _join_throughput()
     outer_join_rows_per_sec = _join_throughput(mode="left")
     wc_sharded_t2 = _wordcount_throughput(threads=2)
@@ -145,6 +146,12 @@ def main() -> None:
             "queries_per_sec": round(qps, 1),
             "wordcount_stream_rows_per_sec": round(wc_rows_per_sec, 1),
             "wordcount_rowwise_api_rows_per_sec": round(wc_rowwise, 1),
+            # pw.apply with a pure-operator lambda: traced + compiled to the
+            # same columnar kernel as native expression syntax (the
+            # reference's no-Python-in-the-hot-loop, expression.rs:325);
+            # _perrow is the untraceable-lambda fallback lane
+            "apply_lifted_rows_per_sec": round(apply_lifted, 1),
+            "apply_perrow_rows_per_sec": round(apply_perrow, 1),
             "join_stream_rows_per_sec": round(join_rows_per_sec, 1),
             "outer_join_stream_rows_per_sec": round(outer_join_rows_per_sec, 1),
             # sharded engine numbers are HONEST, not flattering: this host
@@ -663,6 +670,48 @@ def _wordcount_throughput(
         G.clear()
     assert total["n"] == (n_rows + 996) // 997, total
     return n_rows / elapsed
+
+
+def _apply_throughput(n_rows: int = 1_000_000, batch: int = 100_000) -> tuple[float, float]:
+    """Streaming select with a ``pw.apply`` lambda: (lifted, per-row-fallback)
+    rows/sec. A pure-operator lambda is traced into the columnar expression
+    compiler — no Python in the hot loop; a lambda reading a closure cell
+    falls back to the exact per-row interpreter."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+
+    def run(fn) -> float:
+        G.clear()
+        vals = np.arange(n_rows, dtype=np.int64)
+
+        class Feed(pw.io.python.ConnectorSubject):
+            def run(self) -> None:
+                for s in range(0, n_rows, batch):
+                    self.next_batch({"a": vals[s:s + batch]})
+                    self.commit()
+
+        t = pw.io.python.read(
+            Feed(), schema=pw.schema_from_types(a=int),
+            autocommit_duration_ms=None,
+        )
+        sel = t.select(c=pw.apply_with_type(fn, int, pw.this.a))
+        acc = {"s": 0}
+
+        def on_batch(time_, b):
+            acc["s"] += int(np.asarray(b.data["c"]).sum())
+
+        pw.io.subscribe(sel, on_batch=on_batch)
+        t0 = time.perf_counter()
+        pw.run()
+        elapsed = time.perf_counter() - t0
+        assert acc["s"] == int(vals.sum()) * 3 + 7 * n_rows
+        G.clear()
+        return n_rows / elapsed
+
+    lifted = run(lambda a: a * 3 + 7)
+    cell = 3  # closure read → bytecode gate rejects → per-row lane
+    perrow = run(lambda a: a * cell + 7)
+    return lifted, perrow
 
 
 def _join_throughput(n_left: int = 300_000, n_right: int = 50_000,

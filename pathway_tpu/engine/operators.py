@@ -268,6 +268,45 @@ class Exchange(Node):
         return concat_deltas(received, self.column_names)
 
 
+class IxStrictCheck(Node):
+    """End-of-stream guard behind non-optional ``ix`` (reference ix
+    missing-key KeyError, test_common.py:2480): tracks probe rows (input 0,
+    keyed by probe row key) against matched join output (input 1, same
+    keys). A probe may lawfully arrive ticks before its indexed row —
+    incremental join semantics withhold it — but a probe still unmatched
+    when the frontier CLOSES is a permanent dangling pointer and raises.
+    Infinite streams never close, so they only ever withhold."""
+
+    STATE_FIELDS = ("_probes", "_matched")
+
+    def __init__(self, probes: Node, joined: Node):
+        super().__init__([probes, joined], [])
+        self._probes: dict[int, int] = {}
+        self._matched: dict[int, int] = {}
+
+    def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
+        p, j = ins
+        if p is not None and len(p):
+            for k, d in zip(p.keys.tolist(), p.diffs.tolist()):
+                self._probes[k] = self._probes.get(k, 0) + d
+        if j is not None and len(j):
+            for k, d in zip(j.keys.tolist(), j.diffs.tolist()):
+                self._matched[k] = self._matched.get(k, 0) + d
+        return None
+
+    def on_end(self) -> Delta | None:
+        missing = sum(
+            1 for k, c in self._probes.items()
+            if c > 0 and self._matched.get(k, 0) <= 0
+        )
+        if missing:
+            raise KeyError(
+                f"ix: {missing} row(s) reference key(s) missing from the "
+                "indexed table (use optional=True for left-join semantics)"
+            )
+        return None
+
+
 class GroupByReduce(Node):
     """group_by_table + reducers (graph.rs:885, reduce.rs).
 

@@ -608,8 +608,6 @@ def _build(
         return fn, out_dt, False, orefs | ixrefs | drefs
 
     if isinstance(expr, (AsyncApplyExpression, ApplyExpression)):
-        parts = [_build(a, env, xp_name) for a in expr._args]
-        kparts = {k: _build(v, env, xp_name) for k, v in expr._kwargs.items()}
         fn_user = expr._fn
         prop_none = expr._propagate_none
 
@@ -617,6 +615,38 @@ def _build(
         import inspect
 
         is_coro = inspect.iscoroutinefunction(fn_user)
+
+        if not is_coro and not prop_none and _liftable(fn_user):
+            # AST-lift (reference expression.rs:325 — no Python in the hot
+            # loop): trace the lambda by calling it on the ARGUMENT
+            # EXPRESSIONS themselves. A pure-operator lambda returns a
+            # ColumnExpression tree, which compiles to the same fused
+            # columnar kernel as native expression syntax — per-row Python
+            # disappears. Anything untraceable (branches on values, calls,
+            # closures — the bytecode gate rejects most up front) falls
+            # back to the exact per-row path.
+            try:
+                traced = fn_user(*expr._args, **expr._kwargs)
+            except Exception:
+                traced = None
+            if isinstance(traced, ColumnExpression) and not isinstance(
+                traced, (ApplyExpression, AsyncApplyExpression)
+            ):
+                try:
+                    lifted, _odt, agg, refs = _build(traced, env, xp_name)
+                except Exception:
+                    # the traced tree may hit operator/dtype combinations
+                    # the columnar compiler refuses (e.g. str * int);
+                    # per-row Python still handles those
+                    lifted = None
+                if lifted is not None:
+                    return (
+                        _align_dtype(lifted, expr._return_type),
+                        expr._return_type, agg, refs,
+                    )
+
+        parts = [_build(a, env, xp_name) for a in expr._args]
+        kparts = {k: _build(v, env, xp_name) for k, v in expr._kwargs.items()}
 
         def fn(cols, keys):
             n = len(keys)
@@ -677,6 +707,69 @@ def _build(
         )
 
     raise NotImplementedError(f"cannot compile {type(expr).__name__}")
+
+
+def _liftable(fn: Callable) -> bool:
+    """Safe to trace symbolically: a plain function whose bytecode contains
+    no calls, no global/closure reads and no imports — so executing it once
+    on expression placeholders cannot run user side effects per trace that
+    the per-row path would have run per row, and captures no late-binding
+    state. Operator expressions (``lambda x: x * 2 + 1``) pass; anything
+    calling functions, reading globals/closures, or branching on values
+    (guarded separately by ColumnExpression.__bool__ raising) falls back."""
+    import dis
+
+    try:
+        instructions = list(dis.get_instructions(fn))
+    except TypeError:
+        return False
+    blocked = (
+        "CALL", "LOAD_GLOBAL", "LOAD_DEREF", "IMPORT", "MAKE_FUNCTION",
+        # writes are side effects too: lifting would elide the per-row
+        # store and leave the target bound to an expression placeholder
+        "STORE_GLOBAL", "STORE_DEREF", "STORE_ATTR", "STORE_SUBSCR",
+        # iteration over a ColumnExpression placeholder never terminates
+        # (__getitem__ exists, __iter__ does not → legacy protocol spins)
+        "GET_ITER", "FOR_ITER", "GET_AITER",
+        # generator/comprehension machinery implies iteration as well
+        "YIELD", "RETURN_GENERATOR",
+        # identity tests fold silently at trace time: `a is None` on the
+        # placeholder is plain False with NO __bool__ call, so a
+        # None-handling branch would vanish from the traced tree
+        "IS_OP", "POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE",
+    )
+    return not any(
+        ins.opname.startswith(blocked) for ins in instructions
+    )
+
+
+def _align_dtype(fn: Callable, want: dt.DType) -> Callable:
+    """Cast a lifted-apply column to the dtype the ``apply`` declared, so
+    downstream consumers see the same runtime dtype the per-row path's
+    ``_densify`` would have produced (e.g. int arithmetic lifted under a
+    declared float return)."""
+    target = {
+        dt.INT: np.int64, dt.FLOAT: np.float64, dt.BOOL: np.bool_
+    }.get(want)
+    if target is None:
+        return fn
+
+    def cast(cols, keys):
+        out = fn(cols, keys)
+        # trace-safe: never np.asarray here — under the fused-DAG jit
+        # (``_make_jitted``) ``out`` is a jax tracer. astype exists on both
+        # numpy arrays and tracers; anything without a dtype passes through.
+        dtype = getattr(out, "dtype", None)
+        if (
+            dtype is not None
+            and getattr(dtype, "kind", None) in "ifb"
+            and getattr(out, "ndim", None) == 1
+            and np.dtype(dtype) != target
+        ):
+            return out.astype(target)
+        return out
+
+    return cast
 
 
 def _run_async(coro):

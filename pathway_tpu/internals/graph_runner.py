@@ -610,12 +610,28 @@ class GraphRunner:
         src_node = self.lower(src_t)
         cols = table.column_names()
         src_proj = self._project(src_node, src_t, src_t.column_names())
-        return self._add(ops.Join(
+        if p["optional"]:
+            return self._add(ops.Join(
+                rw, src_proj, "__ptr__", None,
+                left_cols=[], right_cols=src_t.column_names(), out_names=cols,
+                mode="left",
+                key_mode="left",
+            ))
+        # strict ix: a PERMANENTLY missing key is a runtime KeyError
+        # (reference test_common.py:2480 test_ix_missing_key). The check
+        # fires at end-of-stream, not per tick — a probe may legitimately
+        # arrive a commit before its indexed row does (incremental join
+        # semantics); only a probe still unmatched when the frontier
+        # closes is an error. Infinite streams never raise, they just
+        # withhold the unmatched probe rows, exactly as the inner join.
+        joined = self._add(ops.Join(
             rw, src_proj, "__ptr__", None,
             left_cols=[], right_cols=src_t.column_names(), out_names=cols,
-            mode="left" if p["optional"] else "inner",
+            mode="inner",
             key_mode="left",
         ))
+        self._add(ops.IxStrictCheck(rw, joined))
+        return joined
 
 
 def _colref(name: str):

@@ -97,8 +97,26 @@ class JoinResult:
     def select(self, *args: Any, **kwargs: Any):
         from .table import Table
 
+        from .thisclass import ThisWithout, left as left_ph, right as right_ph
+
         exprs: dict[str, ColumnExpression] = {}
+        flat: list[Any] = []
         for arg in args:
+            if isinstance(arg, ThisWithout):
+                # pw.left/pw.right wildcards expand against their side;
+                # bare pw.this expands against the left (reference join
+                # desugaring binds this to the join's row namespace)
+                side = (
+                    self._right if arg.placeholder is right_ph else self._left
+                )
+                flat.extend(
+                    ColumnReference(side, n)
+                    for n in side.column_names()
+                    if n not in arg.excluded
+                )
+            else:
+                flat.append(arg)
+        for arg in flat:
             resolved = self._resolve(arg)
             if not isinstance(resolved, ColumnReference):
                 raise ValueError("positional select args must be column references")

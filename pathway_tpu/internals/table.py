@@ -102,10 +102,23 @@ class Table(TableLike):
         out: dict[str, ColumnExpression] = {}
         from .table_slice import TableSlice
 
+        from .thisclass import ThisWithout
+
         flat: list[Any] = []
         for arg in args:
-            # a TableSlice unpacks into its (possibly renamed) references
-            flat.extend(arg) if isinstance(arg, TableSlice) else flat.append(arg)
+            if isinstance(arg, ThisWithout):
+                # pw.this / pw.this.without(...): all of this table's
+                # columns minus the excluded names
+                flat.extend(
+                    ColumnReference(self, n)
+                    for n in self.column_names()
+                    if n not in arg.excluded
+                )
+            elif isinstance(arg, TableSlice):
+                # a TableSlice unpacks into its (possibly renamed) references
+                flat.extend(arg)
+            else:
+                flat.append(arg)
         for arg in flat:
             arg = self._sub(arg)
             if not isinstance(arg, ColumnReference):
@@ -185,6 +198,12 @@ class Table(TableLike):
         return self.rename_columns(**kwargs)
 
     def _rename(self, mapping: dict[str, str]) -> "Table":
+        unknown = set(mapping) - set(self.column_names())
+        if unknown:
+            raise KeyError(
+                f"rename: unknown column(s) {sorted(unknown)}; columns: "
+                f"{self.column_names()}"
+            )
         exprs = {
             mapping.get(n, n): ColumnReference(self, n) for n in self.column_names()
         }
@@ -205,6 +224,20 @@ class Table(TableLike):
             else:
                 exprs[n] = ColumnReference(self, n)
         return self._rowwise(exprs)
+
+    def update_types(self, **kwargs: Any) -> "Table":
+        """Override DECLARED column dtypes without touching runtime values
+        (reference ``Table.update_types`` — a type annotation, not a cast;
+        use ``cast_to_types`` to convert values)."""
+        cols = dict(self._schema.columns())
+        unknown = set(kwargs) - set(cols)
+        if unknown:
+            raise KeyError(f"update_types: unknown column(s) {sorted(unknown)}")
+        for n, t in kwargs.items():
+            cols[n] = ColumnSchema(name=n, dtype=dt.wrap(t))
+        schema = schema_from_columns(cols, name="Retyped")
+        # "with_universe_of" lowers to a pure pass-through of input 0
+        return Table("with_universe_of", [self], {}, schema, self._universe)
 
     # -- groupby / reduce (table.py:942, :1025) -----------------------------
 
@@ -315,6 +348,21 @@ class Table(TableLike):
             self._schema,
             self._universe,
         )
+
+    def __lshift__(self, other: "Table") -> "Table":
+        """``self << other`` = ``update_cells`` (reference table.py
+        ``__lshift__`` alias)."""
+        return self.update_cells(other)
+
+    @staticmethod
+    def from_columns(*args: Any, **kwargs: Any) -> "Table":
+        """Build a table from columns of (universe-compatible) tables
+        (reference table.py ``Table.from_columns``)."""
+        refs = list(args) + list(kwargs.values())
+        if not refs:
+            raise ValueError("from_columns needs at least one column")
+        base = refs[0].table
+        return base.select(*args, **kwargs)
 
     def __add__(self, other: "Table") -> "Table":
         """Column-wise sum of two same-universe tables (zip columns)."""
@@ -430,7 +478,17 @@ class Table(TableLike):
 
     def ix_ref(self, *args: Any, optional: bool = False, context: Any = None, instance: Any = None) -> "Table":
         if context is None:
-            raise ValueError("ix_ref requires context= (or use table.ix(table.pointer_from(...)))")
+            for a in args:
+                context = _expression_table(smart_coerce(a))
+                if context is not None:
+                    break
+        if context is None:
+            # no args (singleton broadcast) or only pw.this args: the
+            # context table is the enclosing select's — defer until its
+            # desugaring binds pw.this (reference desugaring ix support)
+            from .thisclass import DeferredIxTable
+
+            return DeferredIxTable(self, args, optional, instance)  # type: ignore[return-value]
         return self.ix(
             PointerExpression(self, *args, instance=instance),
             optional=optional,

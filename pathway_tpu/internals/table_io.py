@@ -147,20 +147,13 @@ def rows_to_table(
         # debug/__init__.py:380-384)
         keys = K.mix_columns([data[c] for c in col_order], n)
     else:
-        # content fingerprint from the BUILT columns (vectorized) — the
-        # old per-row repr() was ~40% of static-table construction
-        content = K.mix_columns([data[c] for c in col_order], n)
-        mixed = K.derive(content, K.ref_scalar(repr(col_order)))
-        # bind position INSIDE the per-row mix (derive_pair) before the
-        # XOR fold — a bare `^ arange` outside the mix would be
-        # permutation-invariant (XOR separates), keying reordered or
-        # pairwise-duplicated tables identically
-        positions = K._splitmix(np.arange(n, dtype=np.uint64))
-        order_fp = int(np.bitwise_xor.reduce(
-            K.derive_pair(mixed, positions)
-        )) if n else 0
-        fp = K.ref_scalar(repr(col_order), order_fp)
-        keys = K.derive(np.arange(n, dtype=np.uint64), fp)
+        # row-ordinal ids, exactly the reference's unindexed-table rule
+        # (ids hash pandas' RangeIndex, debug/__init__.py:373-375): the
+        # Nth row of ANY unindexed static table gets the same id as an
+        # explicit integer index N. Content-independent — so a table
+        # derived by select() compares index-equal to a freshly built
+        # expected table, the contract the reference test corpus leans on.
+        keys = K.pointer_from_ints(np.arange(n, dtype=np.int64))
 
     schema_obj = schema if schema is not None else schema_from_columns(
         {name: ColumnSchema(name=name, dtype=dtypes[name]) for name in col_order},
@@ -187,7 +180,24 @@ def rows_to_table(
             Universe(),
         )
 
-    return Table("static", [], {"keys": keys, "data": data}, schema_obj, Universe())
+    # the reference's static-tables universe cache (debug/__init__.py:
+    # 384-401): two static tables built with the SAME id material — equal
+    # explicit ids, equal id_from key columns, or equal unindexed row
+    # counts — share one Universe, so columns of one are selectable into
+    # the other without an explicit promise (the test-corpus contract).
+    from .parse_graph import G
+
+    if id_values is not None:
+        cache_key = ("ids", tuple(id_values))
+    elif id_from:
+        cache_key = ("id_from", tuple(np.asarray(keys).tolist()))
+    else:
+        cache_key = ("ordinal", n)
+    universe = G.static_tables_cache.get(cache_key)
+    if universe is None:
+        universe = Universe()
+        G.static_tables_cache[cache_key] = universe
+    return Table("static", [], {"keys": keys, "data": data}, schema_obj, universe)
 
 
 def empty_table(schema: SchemaMetaclass) -> Table:

@@ -39,13 +39,76 @@ class ThisPlaceholder:
     def pointer_from(self, *args: Any, instance: Any = None, optional: bool = False):
         return PointerExpression(self, *args, instance=instance, optional=optional)  # type: ignore[arg-type]
 
+    def without(self, *columns: Any) -> "ThisWithout":
+        """Wildcard minus named columns (reference ``pw.this.without``):
+        ``t.select(*pw.this.without(pw.this.c))`` selects every column of
+        the binding table except ``c``."""
+        return ThisWithout(columns, self)
+
+    def __iter__(self):
+        # ``t.select(*pw.this)`` — all columns of the binding table
+        return iter((ThisWithout((), self),))
+
     def __repr__(self) -> str:
         return f"<pw.{self._label}>"
+
+
+class ThisWithout:
+    """Deferred 'all columns except…' marker, expanded by select. Carries
+    its source placeholder so join selects expand the correct side
+    (``pw.left.without(...)`` vs ``pw.right.without(...)``)."""
+
+    def __init__(self, excluded: tuple, placeholder: "ThisPlaceholder"):
+        self.placeholder = placeholder
+        self.excluded = tuple(
+            c.name if isinstance(c, ColumnReference) else str(c)
+            for c in excluded
+        )
+
+    def __iter__(self):
+        return iter((self,))
 
 
 this = ThisPlaceholder("this")
 left = ThisPlaceholder("left")
 right = ThisPlaceholder("right")
+
+
+class DeferredIxTable:
+    """``table.ix_ref(...)`` whose context table cannot be inferred from the
+    arguments (no args, or only ``pw.this`` args) — the reference resolves
+    these during select desugaring (``desugaring.py`` ix machinery); here a
+    column read off this proxy becomes a :class:`DeferredIxColumn` that
+    ``substitute`` binds once the enclosing select knows its table."""
+
+    def __init__(self, table: Any, args: tuple, optional: bool, instance: Any):
+        self._dtable = table
+        self._dargs = args
+        self._doptional = optional
+        self._dinstance = instance
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeferredIxColumn(self, name)
+
+    def __getitem__(self, name: str):
+        return DeferredIxColumn(self, name)
+
+
+class DeferredIxColumn(ColumnExpression):
+    def __init__(self, deferred: DeferredIxTable, name: str):
+        self._dix = deferred
+        self._name = name
+
+    @property
+    def _deps(self):
+        return tuple(
+            a for a in self._dix._dargs if isinstance(a, ColumnExpression)
+        )
+
+    def __repr__(self) -> str:
+        return f"<deferred {self._dix._dtable!r}.ix_ref(...).{self._name}>"
 
 
 def substitute(expr: ColumnExpression, mapping: dict[Any, Any]) -> ColumnExpression:
@@ -56,6 +119,26 @@ def substitute(expr: ColumnExpression, mapping: dict[Any, Any]) -> ColumnExpress
     """
     import copy
 
+    if isinstance(expr, DeferredIxColumn):
+        ctx = mapping.get(this)
+        if ctx is None:
+            for ph in (left, right):
+                if ph in mapping:
+                    ctx = mapping[ph]
+                    break
+        if ctx is None:
+            raise ValueError(
+                "ix_ref context could not be inferred; pass context="
+            )
+        d = expr._dix
+        args = tuple(
+            substitute(a, mapping) if isinstance(a, ColumnExpression) else a
+            for a in d._dargs
+        )
+        ixed = d._dtable.ix_ref(
+            *args, optional=d._doptional, instance=d._dinstance, context=ctx
+        )
+        return ColumnReference(ixed, expr._name)
     if isinstance(expr, IdReference):
         if expr.table in mapping:
             return IdReference(mapping[expr.table])

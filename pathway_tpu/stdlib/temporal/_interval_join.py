@@ -44,6 +44,13 @@ class Interval:
 
 
 def interval(lower_bound, upper_bound) -> Interval:
+    if lower_bound > upper_bound:
+        # reference temporal/test_interval_joins.py:286 — an empty interval
+        # is a build-time error, not a silent never-matching join
+        raise ValueError(
+            "interval: lower_bound has to be less than or equal to "
+            "upper_bound"
+        )
     return Interval(lower_bound, upper_bound)
 
 

@@ -87,6 +87,10 @@ class TumblingWindow(_FixedWindow):
 
             origin = datetime.datetime(1970, 1, 1, tzinfo=getattr(t, "tzinfo", None))
         k = math.floor((t - origin) / d)
+        if self.origin is not None and k < 0:
+            # an explicit origin is the FIRST window's start (reference
+            # temporal/_window.py): earlier times belong to no window
+            return ()
         start = origin + k * d
         return ((start, start + d),)
 
@@ -111,7 +115,9 @@ class SlidingWindow(_FixedWindow):
         s = origin + math.floor((t - origin) / h) * h
         out = []
         while s + d > t:
-            if s <= t:
+            if s <= t and (self.origin is None or s >= origin):
+                # explicit origin truncates: no window starts before it
+                # (reference sliding origin semantics, test_windows.py:430)
                 out.append((s, s + d))
             s = s - h
         out.reverse()
@@ -239,6 +245,9 @@ class IntervalsOverWindow(Window):
             _pw_window_start=anchors._pw_anchor + lo,
             _pw_window_end=anchors._pw_anchor + up,
             _pw_instance=anchors._pw_anchor,
+            # the probe point itself (reference intervals_over exposes
+            # `_pw_window_location`, temporal/test_windows.py:961)
+            _pw_window_location=anchors._pw_anchor,
         )
         return _apply_behavior(expanded, behavior)
 
@@ -274,6 +283,10 @@ class WindowedTable:
         group_cols = [exp._pw_window_start, exp._pw_window_end]
         if self._has_instance:
             group_cols.append(exp._pw_instance)
+        if "_pw_window_location" in exp.column_names():
+            # intervals_over: the probe point is constant per window and
+            # selectable in reduce (reference _pw_window_location)
+            group_cols.append(exp._pw_window_location)
         grouped = exp.groupby(*group_cols)
         # rewrite pw.this references against the expanded table; synthesize
         # the _pw_window tuple from the grouping columns

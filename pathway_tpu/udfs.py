@@ -53,8 +53,15 @@ class InMemoryCache(CacheStrategy):
             async def awrapper(*args):
                 key = args
                 if key not in cache:
-                    cache[key] = await fn(*args)
-                return cache[key]
+                    # cache the TASK, not the value: concurrent async calls
+                    # for one key must coalesce into a single execution
+                    # (reference caches.py in-flight dedup)
+                    cache[key] = asyncio.ensure_future(fn(*args))
+                try:
+                    return await cache[key]
+                except BaseException:
+                    cache.pop(key, None)  # do not cache failures
+                    raise
 
             return awrapper
 

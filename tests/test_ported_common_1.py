@@ -1,0 +1,1064 @@
+"""Ported from the reference's behavioral spec: select / expression /
+broadcast / ix / concat / flatten cases.
+
+Source: ``/root/reference/python/pathway/tests/test_common.py`` (VERDICT r4
+item 7 — translate the highest-density reference suites instead of
+inventing new cases). Each test cites its origin line. Tables and expected
+outputs are the reference's test DATA (a behavioral contract, kept
+verbatim so the spec is the same); the harness is this repo's
+``pathway_tpu.testing``. Intentional semantic deltas, where present, are
+marked inline and recorded in PARITY.md.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.testing import (
+    T,
+    assert_table_equality,
+    assert_table_equality_wo_index,
+    assert_table_equality_wo_types,
+)
+
+
+# -- select & expressions (test_common.py:97-520) ---------------------------
+
+
+def test_select_column_ref():  # ref :97
+    t_latin = T(
+        """
+            | lower | upper
+        1   | a     | A
+        2   | b     | B
+        26  | z     | Z
+        """
+    )
+    t_num = T(
+        """
+            | num
+        1   | 1
+        2   | 2
+        26  | 26
+        """
+    )
+    res = t_latin.select(num=t_num.num, upper=t_latin["upper"])
+    assert_table_equality(
+        res,
+        T(
+            """
+                | num | upper
+            1   | 1   | A
+            2   | 2   | B
+            26  | 26  | Z
+            """
+        ),
+    )
+
+
+def test_select_arithmetic_with_const():  # ref :130
+    table = T("a\n42")
+    res = table.select(
+        table.a,
+        add=table.a + 1,
+        radd=1 + table.a,
+        sub=table.a - 1,
+        rsub=1 - table.a,
+        mul=table.a * 2,
+        rmul=2 * table.a,
+        truediv=table.a / 4,
+        rtruediv=63 / table.a,
+        floordiv=table.a // 4,
+        rfloordiv=63 // table.a,
+        mod=table.a % 4,
+        rmod=63 % table.a,
+        pow=table.a**2,
+        rpow=2**table.a,
+    )
+    assert_table_equality(
+        res,
+        T(
+            """
+            a  | add | radd | sub | rsub | mul | rmul | truediv | rtruediv | floordiv | rfloordiv | mod | rmod | pow  | rpow
+            42 | 43  | 43   | 41  | -41  | 84  | 84   | 10.5    | 1.5      | 10       | 1         | 2   | 21   | 1764 | 4398046511104
+            """  # noqa: E501
+        ),
+    )
+
+
+def test_select_values():  # ref :167
+    t1 = T(
+        """
+        lower | upper
+        a     | A
+        b     | B
+        """
+    )
+    res = t1.select(foo="alpha", bar="beta")
+    assert_table_equality(
+        res,
+        T(
+            """
+            foo   | bar
+            alpha | beta
+            alpha | beta
+            """
+        ),
+    )
+
+
+def test_select_column_different_universe():  # ref :189
+    foo = T(
+        """
+           | col
+        1  | a
+        2  | b
+        """
+    )
+    bar = T(
+        """
+           | col
+        3  | a
+        4  | b
+        5  | c
+        """
+    )
+    with pytest.raises((ValueError, KeyError, RuntimeError)):
+        run = foo.select(ret=bar.col)
+        pw.debug.table_to_pandas(run)
+
+
+def test_select_const_expression():  # ref :209
+    inp = T(
+        """
+        foo | bar
+        1   | 3
+        2   | 4
+        """
+    )
+    assert_table_equality(
+        inp.select(a=42),
+        T(
+            """
+            a
+            42
+            42
+            """
+        ),
+    )
+
+
+def test_select_simple_expression():  # ref :232
+    inp = T(
+        """
+        foo | bar
+        1   | 3
+        2   | 4
+        """
+    )
+    assert_table_equality(
+        inp.select(a=inp.bar + inp.foo),
+        T(
+            """
+            a
+            4
+            6
+            """
+        ),
+    )
+
+
+def test_select_int_unary():  # ref :255
+    inp = T("a\n1")
+    assert_table_equality(
+        inp.select(inp.a, minus=-inp.a),
+        T(
+            """
+            a | minus
+            1 | -1
+            """
+        ),
+    )
+
+
+def test_select_int_binary():  # ref :279
+    inp = T("a | b\n1 | 2")
+    res = inp.select(
+        inp.a,
+        inp.b,
+        add=inp.a + inp.b,
+        sub=inp.a - inp.b,
+        truediv=inp.a / inp.b,
+        floordiv=inp.a // inp.b,
+        mul=inp.a * inp.b,
+    )
+    assert_table_equality(
+        res,
+        T(
+            """
+            a | b | add | sub | truediv | floordiv | mul
+            1 | 2 | 3   | -1  | 0.5     | 0        | 2
+            """
+        ),
+    )
+
+
+def test_select_int_comparison():  # ref :308
+    inp = T(
+        """
+        a | b
+        1 | 2
+        2 | 2
+        3 | 2
+        """
+    )
+    res = inp.select(
+        inp.a,
+        inp.b,
+        eq=inp.a == inp.b,
+        ne=inp.a != inp.b,
+        lt=inp.a < inp.b,
+        le=inp.a <= inp.b,
+        gt=inp.a > inp.b,
+        ge=inp.a >= inp.b,
+    )
+    assert_table_equality(
+        res,
+        T(
+            """
+            a | b | eq    | ne    | lt    | le    | gt    | ge
+            1 | 2 | false | true  | true  | true  | false | false
+            2 | 2 | true  | false | false | true  | false | true
+            3 | 2 | false | true  | false | false | true  | true
+            """
+        ),
+    )
+
+
+def test_select_float_comparison():  # ref :342
+    inp = T(
+        """
+        a   | b
+        1.5 | 2.5
+        2.5 | 2.5
+        3.5 | 2.5
+        """
+    )
+    res = inp.select(
+        inp.a,
+        inp.b,
+        eq=inp.a == inp.b,
+        ne=inp.a != inp.b,
+        lt=inp.a < inp.b,
+        le=inp.a <= inp.b,
+        gt=inp.a > inp.b,
+        ge=inp.a >= inp.b,
+    )
+    assert_table_equality(
+        res,
+        T(
+            """
+            a   | b   | eq    | ne    | lt    | le    | gt    | ge
+            1.5 | 2.5 | false | true  | true  | true  | false | false
+            2.5 | 2.5 | true  | false | false | true  | false | true
+            3.5 | 2.5 | false | true  | false | false | true  | true
+            """
+        ),
+    )
+
+
+def test_select_mixed_comparison():  # ref :376
+    inp = T(
+        """
+        a   | b
+        1.5 | 2
+        2.0 | 2
+        3.5 | 2
+        """
+    )
+    res = inp.select(
+        inp.a,
+        inp.b,
+        eq=inp.a == inp.b,
+        ne=inp.a != inp.b,
+        lt=inp.a < inp.b,
+        le=inp.a <= inp.b,
+        gt=inp.a > inp.b,
+        ge=inp.a >= inp.b,
+    )
+    assert_table_equality(
+        res,
+        T(
+            """
+            a   | b | eq    | ne    | lt    | le    | gt    | ge
+            1.5 | 2 | false | true  | true  | true  | false | false
+            2.0 | 2 | true  | false | false | true  | false | true
+            3.5 | 2 | false | true  | false | false | true  | true
+            """
+        ),
+    )
+
+
+def test_select_float_unary():  # ref :409
+    inp = T("a\n1.25")
+    assert_table_equality(
+        inp.select(inp.a, minus=-inp.a),
+        T(
+            """
+            a    | minus
+            1.25 | -1.25
+            """
+        ),
+    )
+
+
+def test_select_float_binary():  # ref :433
+    inp = T("a    | b\n1.25 | 2.5")
+    res = inp.select(
+        inp.a,
+        inp.b,
+        add=inp.a + inp.b,
+        sub=inp.a - inp.b,
+        truediv=inp.a / inp.b,
+        floordiv=inp.a // inp.b,
+        mul=inp.a * inp.b,
+    )
+    assert_table_equality(
+        res,
+        T(
+            """
+            a    | b   | add  | sub   | truediv | floordiv | mul
+            1.25 | 2.5 | 3.75 | -1.25 | 0.5     | 0.0      | 3.125
+            """
+        ).update_types(floordiv=float),
+    )
+
+
+def test_select_bool_unary():  # ref :462
+    inp = T(
+        """
+        a
+        true
+        false
+        """
+    )
+    assert_table_equality(
+        inp.select(inp.a, not_=~inp.a),
+        T(
+            """
+            a     | not_
+            true  | false
+            false | true
+            """
+        ),
+    )
+
+
+def test_select_bool_binary():  # ref :488
+    inp = T(
+        """
+        a     | b
+        false | false
+        false | true
+        true  | false
+        true  | true
+        """
+    )
+    res = inp.select(
+        inp.a,
+        inp.b,
+        and_=inp.a & inp.b,
+        or_=inp.a | inp.b,
+        xor=inp.a ^ inp.b,
+    )
+    assert_table_equality(
+        res,
+        T(
+            """
+            a     |  b    | and_  | or_   | xor
+            false | false | false | false | false
+            false | true  | false | true  | true
+            true  | false | false | true  | true
+            true  | true  | true  | true  | false
+            """
+        ),
+    )
+
+
+# -- broadcast via groupby-ix (test_common.py:521-745) ----------------------
+
+
+def test_broadcasting_singlerow():  # ref :521
+    table = T(
+        """
+        pet  |  owner  | age
+         1   | Alice   | 10
+         1   | Bob     | 9
+         2   | Alice   | 8
+         1   | Bob     | 7
+         0   | Eve     | 10
+        """
+    )
+    single = table.reduce(amax=pw.reducers.max(table.age))
+    res = table.select(table.pet, amax=single.ix_ref().amax)
+    assert_table_equality(
+        res,
+        T(
+            """
+            pet | amax
+             1  | 10
+             1  | 10
+             2  | 10
+             1  | 10
+             0  | 10
+            """
+        ),
+    )
+
+
+def test_indexing_single_value_groupby():  # ref :549
+    indexed_table = T(
+        """
+        colA | colB
+        1    | val_1
+        2    | val_2
+        """
+    )
+    grouped = indexed_table.groupby(indexed_table.colA).reduce(
+        indexed_table.colA, col=pw.reducers.max(indexed_table.colB)
+    )
+    res = indexed_table.select(
+        indexed_table.colA,
+        col=grouped.ix_ref(indexed_table.colA).col,
+    )
+    assert_table_equality(
+        res,
+        T(
+            """
+            colA | col
+            1    | val_1
+            2    | val_2
+            """
+        ),
+    )
+
+
+def test_ixref_optional():  # ref :641
+    indexed = T(
+        """
+        colA | colB
+        1    | val_1
+        2    | val_2
+        """
+    )
+    grouped = indexed.groupby(indexed.colA).reduce(
+        indexed.colA, col=pw.reducers.max(indexed.colB)
+    )
+    queries = T(
+        """
+        q
+        1
+        3
+        """
+    )
+    res = queries.select(
+        queries.q, col=grouped.ix_ref(queries.q, optional=True).col
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            q | col
+            1 | val_1
+            3 | None
+            """
+        ),
+    )
+
+
+def test_ix_ref_with_primary_keys():  # ref :719
+    t = T(
+        """
+        colA | colB
+        1    | val_1
+        2    | val_2
+        """
+    ).with_id_from(pw.this.colA)
+    res = T(
+        """
+        key
+        1
+        2
+        """
+    )
+    res = res.select(val=t.ix_ref(res.key).colB)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            val
+            val_1
+            val_2
+            """
+        ),
+    )
+
+
+# -- concat (test_common.py:869-999) ----------------------------------------
+
+
+def test_concat():  # ref :869 (concat_reindex)
+    t1 = T(
+        """
+           | lower | upper
+        1  | a     | A
+        2  | b     | B
+        """
+    )
+    t2 = T(
+        """
+           | lower | upper
+        3  | c     | C
+        4  | d     | D
+        """
+    )
+    res = pw.Table.concat_reindex(t1, t2)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            lower | upper
+            a     | A
+            b     | B
+            c     | C
+            d     | D
+            """
+        ),
+    )
+
+
+def test_concat_reversed_columns():  # ref :898 (concat_reindex)
+    t1 = T(
+        """
+           | lower | upper
+        1  | a     | A
+        2  | b     | B
+        """
+    )
+    t2 = T(
+        """
+           | upper | lower
+        3  | C     | c
+        4  | D     | d
+        """
+    )
+    res = pw.Table.concat_reindex(t1, t2)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            lower | upper
+            a     | A
+            b     | B
+            c     | C
+            d     | D
+            """
+        ),
+    )
+
+
+def test_concat_unsafe():  # ref :927
+    t1 = T(
+        """
+           | lower | upper
+        1  | a     | A
+        2  | b     | B
+        """
+    )
+    t2 = T(
+        """
+           | lower | upper
+        3  | c     | C
+        4  | d     | D
+        """
+    )
+    pw.universes.promise_are_pairwise_disjoint(t1, t2)
+    res = pw.Table.concat(t1, t2)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            lower | upper
+            a     | A
+            b     | B
+            c     | C
+            d     | D
+            """
+        ),
+    )
+
+
+def test_concat_errors_on_intersecting_universes():  # ref :975
+    t1 = T(
+        """
+           | lower
+        1  | a
+        """
+    )
+    t2 = T(
+        """
+           | lower
+        1  | b
+        """
+    )
+    with pytest.raises(Exception):
+        res = t1.concat(t2)
+        pw.debug.table_to_pandas(res)
+
+
+# -- flatten (test_common.py:1000-1110) -------------------------------------
+
+
+def test_flatten_string():  # ref :1055
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(s=str), [("abc",), ("xy",)]
+    )
+    res = t.flatten(t.s)
+    got = sorted(pw.debug.table_to_pandas(res)["s"].tolist())
+    assert got == ["a", "b", "c", "x", "y"]
+
+
+def test_flatten_tuples():  # ref :1000 (int dtype case)
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, xs=tuple),
+        [(1, (10, 20)), (2, (30,)), (3, ())],
+    )
+    res = t.flatten(t.xs)
+    got = sorted(pw.debug.table_to_pandas(res)["xs"].tolist())
+    assert got == [10, 20, 30]
+
+
+def test_flatten_incorrect_type():  # ref :1095
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    with pytest.raises(Exception):
+        res = t.flatten(t.a)
+        pw.debug.table_to_pandas(res)
+
+
+# -- column ops (test_common.py:1111-1292) ----------------------------------
+
+
+def test_from_columns():  # ref :1111
+    t1 = T(
+        """
+        lower
+        a
+        b
+        """
+    )
+    t2 = T(
+        """
+        upper
+        A
+        B
+        """
+    )
+    res = pw.Table.from_columns(t1.lower, t2.upper)
+    assert_table_equality(
+        res,
+        T(
+            """
+            lower | upper
+            a     | A
+            b     | B
+            """
+        ),
+    )
+
+
+def test_rename_columns_1():  # ref :1173
+    t = T(
+        """
+        lower | upper
+        a     | A
+        """
+    )
+    res = t.rename_columns(foo=pw.this.lower, bar=pw.this.upper)
+    assert_table_equality(
+        res,
+        T(
+            """
+            foo | bar
+            a   | A
+            """
+        ),
+    )
+
+
+def test_rename_by_dict():  # ref :1212
+    t = T(
+        """
+        lower | upper
+        a     | A
+        """
+    )
+    res = t.rename_by_dict({"lower": "foo", "upper": "bar"})
+    assert_table_equality(
+        res,
+        T(
+            """
+            foo | bar
+            a   | A
+            """
+        ),
+    )
+
+
+def test_rename_columns_unknown_column_name():  # ref :1260
+    t = T(
+        """
+        lower
+        a
+        """
+    )
+    with pytest.raises(Exception):
+        res = t.rename_by_dict({"nosuch": "foo"})
+        pw.debug.table_to_pandas(res)
+
+
+def test_drop_columns():  # ref :1272
+    t = T(
+        """
+        a | b | c
+        1 | 2 | 3
+        """
+    )
+    res = t.without(pw.this.a, pw.this.b)
+    assert_table_equality(
+        res,
+        T(
+            """
+            c
+            3
+            """
+        ),
+    )
+
+
+# -- filter (test_common.py:1293-1370) --------------------------------------
+
+
+def test_filter():  # ref :1293
+    t = T(
+        """
+          | k | v
+        1 | 1 | a
+        2 | 2 | b
+        3 | 3 | c
+        4 | 4 | d
+        """
+    )
+    res = t.filter(t.k % 2 == 0)
+    assert_table_equality(
+        res,
+        T(
+            """
+              | k | v
+            2 | 2 | b
+            4 | 4 | d
+            """
+        ),
+    )
+
+
+def test_filter_no_columns():  # ref :1325
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    res = t.filter(pw.this.a > 0).select()
+    assert len(pw.debug.table_to_pandas(res)) == 2
+
+
+# -- reindex / difference / intersect (test_common.py:1371-3473) -------------
+
+
+def test_reindex():  # ref :1371
+    t = T(
+        """
+          | a
+        1 | 10
+        2 | 20
+        """
+    )
+    ids = T(
+        """
+          | new_id
+        1 | 7
+        2 | 8
+        """
+    )
+    res = t.with_id_from(ids.new_id)
+    assert sorted(pw.debug.table_to_pandas(res)["a"].tolist()) == [10, 20]
+
+
+def test_difference():  # ref :3293
+    t1 = T(
+        """
+          | v
+        1 | a
+        2 | b
+        3 | c
+        """
+    )
+    t2 = T(
+        """
+          | w
+        2 | x
+        """
+    )
+    res = t1.difference(t2)
+    assert_table_equality(
+        res,
+        T(
+            """
+              | v
+            1 | a
+            3 | c
+            """
+        ),
+    )
+
+
+def test_intersect():  # ref :3322
+    t1 = T(
+        """
+          | v
+        1 | a
+        2 | b
+        3 | c
+        """
+    )
+    t2 = T(
+        """
+          | w
+        2 | x
+        3 | y
+        """
+    )
+    res = t1.intersect(t2)
+    assert_table_equality(
+        res,
+        T(
+            """
+              | v
+            2 | b
+            3 | c
+            """
+        ),
+    )
+
+
+def test_intersect_many_tables():  # ref :3370
+    t1 = T(
+        """
+          | v
+        1 | a
+        2 | b
+        3 | c
+        4 | d
+        """
+    )
+    t2 = T(
+        """
+          | w
+        2 | x
+        3 | y
+        4 | z
+        """
+    )
+    t3 = T(
+        """
+          | u
+        3 | q
+        4 | w
+        """
+    )
+    res = t1.intersect(t2, t3)
+    assert_table_equality(
+        res,
+        T(
+            """
+              | v
+            3 | c
+            4 | d
+            """
+        ),
+    )
+
+
+# -- update_cells / update_rows / with_columns (test_common.py:3474-3919) ----
+
+
+def test_update_cells():  # ref :3474
+    old = T(
+        """
+          | a | b
+        1 | 1 | x
+        2 | 2 | y
+        """
+    )
+    new = T(
+        """
+          | b
+        1 | z
+        """
+    )
+    pw.universes.promise_is_subset_of(new, old)
+    res = old.update_cells(new)
+    expected = T(
+        """
+          | a | b
+        1 | 1 | z
+        2 | 2 | y
+        """
+    )
+    assert_table_equality(res, expected)
+    assert_table_equality(old << new, expected)
+
+
+def test_update_rows():  # ref :3644
+    old = T(
+        """
+          | a | b
+        1 | 1 | x
+        2 | 2 | y
+        """
+    )
+    new = T(
+        """
+          | a | b
+        2 | 5 | z
+        3 | 6 | w
+        """
+    )
+    res = old.update_rows(new)
+    assert_table_equality(
+        res,
+        T(
+            """
+              | a | b
+            1 | 1 | x
+            2 | 5 | z
+            3 | 6 | w
+            """
+        ),
+    )
+
+
+def test_update_rows_subset():  # ref :3754
+    old = T(
+        """
+          | a | b
+        1 | 1 | x
+        2 | 2 | y
+        3 | 3 | z
+        """
+    )
+    new = T(
+        """
+          | a | b
+        2 | 9 | q
+        """
+    )
+    res = old.update_rows(new)
+    assert_table_equality(
+        res,
+        T(
+            """
+              | a | b
+            1 | 1 | x
+            2 | 9 | q
+            3 | 3 | z
+            """
+        ),
+    )
+
+
+def test_with_columns():  # ref :3823
+    t1 = T(
+        """
+          | a
+        1 | 1
+        2 | 2
+        """
+    )
+    t2 = T(
+        """
+          | b
+        1 | x
+        2 | y
+        """
+    )
+    res = t1.with_columns(b=t2.b)
+    assert_table_equality(
+        res,
+        T(
+            """
+              | a | b
+            1 | 1 | x
+            2 | 2 | y
+            """
+        ),
+    )
+
+
+# -- this magic / wildcards (test_common.py:4097-4176) -----------------------
+
+
+def test_wildcard_basic_usage():  # ref :4097
+    t = T(
+        """
+        a | b | c
+        1 | 2 | 3
+        """
+    )
+    res = t.select(*pw.this.without(pw.this.c), d=pw.this.a + pw.this.b)
+    assert_table_equality(
+        res,
+        T(
+            """
+            a | b | d
+            1 | 2 | 3
+            """
+        ),
+    )
+
+
+def test_this_magic_1():  # ref :4134
+    t = T(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    res = t.select(pw.this.a, c=pw.this.b * 2)
+    assert_table_equality(
+        res,
+        T(
+            """
+            a | c
+            1 | 4
+            """
+        ),
+    )

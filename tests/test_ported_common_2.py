@@ -1,0 +1,721 @@
+"""Ported from the reference's behavioral spec: apply / groupby / reducers /
+join cases.
+
+Source: ``/root/reference/python/pathway/tests/test_common.py`` (second
+block; see ``tests/test_ported_common_1.py`` for the porting contract and
+``PORTED_TESTS.md`` for the manifest).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.testing import (
+    T,
+    assert_table_equality,
+    assert_table_equality_wo_index,
+)
+
+
+# -- apply (test_common.py:1659-1825) ---------------------------------------
+
+
+def test_apply():  # ref :1659
+    a = T(
+        """
+        foo
+        1
+        2
+        3
+        """
+    )
+
+    def inc(x: int) -> int:
+        return x + 1
+
+    result = a.select(ret=pw.apply(inc, a.foo))
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            2
+            3
+            4
+            """
+        ),
+    )
+
+
+def test_apply_inspect_wrapped_signature():  # ref :1687
+    a = T(
+        """
+        foo
+        1
+        2
+        3
+        """
+    )
+
+    def decorator(func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    @decorator
+    def inc(x: int) -> int:
+        return x + 1
+
+    result = a.select(ret=pw.apply(inc, a.foo))
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            2
+            3
+            4
+            """
+        ),
+    )
+
+
+def test_apply_consts():  # ref :1723
+    a = T(
+        """
+        foo
+        1
+        2
+        3
+        """
+    )
+
+    def inc(x: int) -> int:
+        return x + 1
+
+    result = a.select(ret=pw.apply(inc, 1))
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            2
+            2
+            2
+            """
+        ),
+    )
+
+
+def test_apply_more_args():  # ref :1751
+    a = T(
+        """
+        foo
+        1
+        2
+        3
+        """
+    )
+    b = T(
+        """
+        bar
+        2
+        -1
+        4
+        """
+    )
+
+    def add(x: int, y: int) -> int:
+        return x + y
+
+    result = a.select(ret=pw.apply(add, x=a.foo, y=b.bar))
+    assert_table_equality(
+        result,
+        T(
+            """
+            ret
+            3
+            1
+            7
+            """
+        ),
+    )
+
+
+# -- groupby & reducers (test_common.py:2663-3292) ---------------------------
+
+
+def test_groupby_simplest():  # ref :2663
+    left = T(
+        """
+        pet  |  owner  | age
+        dog  | Alice   | 10
+        dog  | Bob     | 9
+        cat  | Alice   | 8
+        dog  | Bob     | 7
+        """
+    )
+    left_res = left.groupby(left.pet).reduce(left.pet)
+    assert_table_equality_wo_index(
+        left_res,
+        T(
+            """
+            pet
+            dog
+            cat
+            """
+        ),
+    )
+
+
+def test_groupby_singlecol():  # ref :2688
+    left = T(
+        """
+        pet  |  owner  | age
+        dog  | Alice   | 10
+        dog  | Bob     | 9
+        cat  | Alice   | 8
+        dog  | Bob     | 7
+        """
+    )
+    left_res = left.groupby(left.pet).reduce(
+        left.pet, ageagg=pw.reducers.sum(left.age)
+    )
+    assert_table_equality_wo_index(
+        left_res,
+        T(
+            """
+            pet  | ageagg
+            dog  | 26
+            cat  | 8
+            """
+        ),
+    )
+
+
+def test_groupby_int_sum():  # ref :2713
+    left = T(
+        """
+        owner   | val
+        Alice   | 1
+        Alice   | -1
+        Bob     | 0
+        Bob     | 0
+        Charlie | 1
+        Charlie | 0
+        Dee     | 5
+        Dee     | 5
+        """
+    )
+    left_res = left.groupby(left.owner).reduce(
+        left.owner, val=pw.reducers.sum(left.val)
+    )
+    assert_table_equality_wo_index(
+        left_res,
+        T(
+            """
+            owner   | val
+            Alice   | 0
+            Bob     | 0
+            Charlie | 1
+            Dee     | 10
+            """
+        ),
+    )
+
+
+def test_groupby_filter_singlecol():  # ref :2746
+    left = T(
+        """
+        pet  |  owner  | age
+        dog  | Alice   | 10
+        dog  | Bob     | 9
+        cat  | Alice   | 8
+        dog  | Bob     | 7
+        cat  | Alice   | 6
+        dog  | Bob     | 5
+        """
+    )
+    left_res = (
+        left.filter(left.age > 6)
+        .groupby(pw.this.pet)
+        .reduce(pw.this.pet, ageagg=pw.reducers.sum(pw.this.age))
+    )
+    assert_table_equality_wo_index(
+        left_res,
+        T(
+            """
+            pet  | ageagg
+            dog  | 26
+            cat  | 8
+            """
+        ),
+    )
+
+
+def test_groupby_reducer_on_expression():  # ref :2829
+    left = T(
+        """
+        pet  |  owner  | age
+        dog  | Alice   | 10
+        dog  | Bob     | 9
+        cat  | Alice   | 8
+        dog  | Bob     | 7
+        """
+    )
+    left_res = left.groupby(left.pet).reduce(
+        left.pet, ageagg=pw.reducers.sum(left.age + left.age)
+    )
+    assert_table_equality_wo_index(
+        left_res,
+        T(
+            """
+            pet  | ageagg
+            dog  | 52
+            cat  | 16
+            """
+        ),
+    )
+
+
+def test_groupby_expression_on_reducers():  # ref :2856
+    left = T(
+        """
+        pet  |  owner  | age
+        dog  | Alice   | 10
+        dog  | Bob     | 9
+        cat  | Alice   | 8
+        dog  | Bob     | 7
+        """
+    )
+    left_res = left.groupby(left.pet).reduce(
+        left.pet, ageagg=pw.reducers.sum(left.age) + pw.reducers.count()
+    )
+    assert_table_equality_wo_index(
+        left_res,
+        T(
+            """
+            pet  | ageagg
+            dog  | 29
+            cat  | 9
+            """
+        ),
+    )
+
+
+def test_groupby_mutlicol():  # ref :2905
+    left = T(
+        """
+        pet  |  owner  | age
+        dog  | Alice   | 10
+        dog  | Bob     | 9
+        cat  | Alice   | 8
+        dog  | Alice   | 7
+        """
+    )
+    left_res = left.groupby(left.pet, left.owner).reduce(
+        left.pet, left.owner, ageagg=pw.reducers.sum(left.age)
+    )
+    assert_table_equality_wo_index(
+        left_res,
+        T(
+            """
+            pet | owner | ageagg
+            dog | Alice | 17
+            dog | Bob   | 9
+            cat | Alice | 8
+            """
+        ),
+    )
+
+
+def test_avg_reducer():  # ref :3113
+    t1 = T(
+        """
+        owner   | age
+        Alice   | 10
+        Bob     | 5
+        Alice   | 20
+        Bob     | 10
+        """
+    )
+    res = t1.groupby(pw.this.owner).reduce(
+        pw.this.owner, avg=pw.reducers.avg(pw.this.age)
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            owner  | avg
+            Alice  | 15
+            Bob    | 7.5
+            """
+        ),
+    )
+
+
+def test_earliest_and_latest_reducer():  # ref :3239
+    t = T(
+        """
+        t | v | __time__
+        1 | 1 |     2
+        2 | 2 |     2
+        1 | 3 |     4
+        2 | 4 |     6
+        1 | 5 |     8
+        """
+    )
+    res = t.groupby(pw.this.t).reduce(
+        pw.this.t,
+        earliest=pw.reducers.earliest(pw.this.v),
+        latest=pw.reducers.latest(pw.this.v),
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            t | earliest | latest
+            1 | 1        | 5
+            2 | 2        | 4
+            """
+        ),
+    )
+
+
+# -- joins (test_common.py:1994-2390) ----------------------------------------
+
+
+def test_join():  # ref :2111
+    t1 = T(
+        """
+            | pet | owner | age
+        1   |   1 | Alice |  10
+        2   |   1 |   Bob |   9
+        3   |   2 | Alice |   8
+        """
+    )
+    t2 = T(
+        """
+            | pet | owner | age | size
+        11  |   3 | Alice |  10 |    M
+        12  |   1 |   Bob |   9 |    L
+        13  |   1 |   Tom |   8 |   XL
+        """
+    )
+    res = t1.join(t2, t1.pet == t2.pet, t1.owner == t2.owner).select(
+        owner_name=t2.owner, age=t1.age
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            owner_name | age
+            Bob        |   9
+            """
+        ),
+    )
+
+
+def test_join_default():  # ref :2246
+    t1 = T(
+        """
+            | pet | owner | age
+        1   |   1 | Alice |  10
+        2   |   1 |   Bob |   9
+        3   |   2 | Alice |   8
+        """
+    )
+    t2 = T(
+        """
+            | pet | owner | age | size
+        11  |   3 | Alice |  10 |    M
+        12  |   1 |   Bob |   9 |    L
+        13  |   1 |   Tom |   8 |   XL
+        """
+    )
+    res = t1.join(t2, t1.pet == t2.pet).select(
+        owner_name=t2.owner, age=t1.age
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            owner_name  | age
+            Bob         | 10
+            Tom         | 10
+            Bob         |  9
+            Tom         |  9
+            """
+        ),
+    )
+
+
+def test_join_self():  # ref :2282
+    inp = T(
+        """
+        foo   | bar
+        1     | 1
+        1     | 2
+        1     | 3
+        """
+    )
+    with pytest.raises(Exception):
+        res = inp.join(inp, inp.foo == inp.bar)
+        pw.debug.table_to_pandas(res.select(x=pw.left.foo))
+
+
+def test_join_select_no_columns():  # ref :2295
+    left = T(
+        """
+           | a
+        1  | 1
+        2  | 2
+        """
+    )
+    right = T(
+        """
+           | b
+        1  | foo
+        2  | bar
+        """
+    )
+    ret = left.join(right, left.id == right.id).select().select(col=42)
+    assert_table_equality_wo_index(
+        ret,
+        T(
+            """
+                | col
+            1   | 42
+            2   | 42
+            """
+        ),
+    )
+
+
+def test_cross_join():  # ref :2324
+    t1 = T(
+        """
+            | v
+        1   | 1
+        2   | 2
+        """
+    )
+    t2 = T(
+        """
+            | w
+        11  | a
+        12  | b
+        """
+    )
+    res = t1.join(t2).select(pw.left.v, pw.right.w)
+    assert sorted(
+        map(tuple, pw.debug.table_to_pandas(res)[["v", "w"]].values.tolist())
+    ) == [(1, "a"), (1, "b"), (2, "a"), (2, "b")]
+
+
+def test_empty_join():  # ref :1994
+    left = T(
+        """
+        a | b
+        1 | x
+        """
+    )
+    right = T(
+        """
+        c | d
+        2 | y
+        """
+    )
+    res = left.join(right, left.a == right.c).select(left.b, right.d)
+    assert len(pw.debug.table_to_pandas(res)) == 0
+
+
+# -- ix (test_common.py:2390-2662) -------------------------------------------
+
+
+def test_ix():  # ref :2390
+    t_animals = T(
+        """
+          | epithet    | genus
+        1 | upupa      | epops
+        2 | acherontia | atropos
+        3 | bubo       | scandiacus
+        4 | dynastes   | hercules
+        """
+    )
+    t_birds = T(
+        """
+          | desc
+        2 | hoopoe
+        4 | owl
+        """
+    )
+    ret = t_birds.select(
+        t_birds.desc, latin=t_animals.ix(t_birds.id).genus
+    )
+    assert_table_equality(
+        ret,
+        T(
+            """
+              | desc   | latin
+            2 | hoopoe | atropos
+            4 | owl    | hercules
+            """
+        ),
+    )
+
+
+def test_ix_missing_key():  # ref :2480
+    t = T(
+        """
+          | v
+        1 | a
+        """
+    )
+    q = T(
+        """
+          | p
+        1 | 5
+        """
+    )
+    ptr = q.select(p=t.pointer_from(q.p))
+    with pytest.raises(Exception):
+        res = t.ix(ptr.p, context=ptr).select(pw.this.v)
+        pw.debug.table_to_pandas(res)
+
+
+def test_groupby_ix_this():  # ref :2635
+    # argmin + row lookup. IDIOM DELTA (PORTED_TESTS.md): the reference's
+    # in-reduce `table.ix(argmin, context=pw.this)` is expressed here as the
+    # equivalent two-phase reduce-then-ix over the argmin pointer.
+    table = T(
+        """
+        name    | age
+        Charlie | 18
+        Alice   | 18
+        Bob     | 18
+        David   | 19
+        Erin    | 19
+        Frank   | 20
+        """
+    )
+    red = table.groupby(table.age).reduce(
+        table.age, lo=pw.reducers.argmin(table.age)
+    )
+    res = red.select(red.age, min_name=table.ix(red.lo).name)
+    df = pw.debug.table_to_pandas(res).sort_values("age")
+    assert df["age"].tolist() == [18, 19, 20]
+    assert df["min_name"].tolist()[2] == "Frank"
+    assert set(df["min_name"].tolist()) <= {
+        "Charlie", "Alice", "Bob", "David", "Erin", "Frank"
+    }
+
+
+# -- r4 review regressions ---------------------------------------------------
+
+
+def test_strict_ix_tolerates_late_arriving_indexed_row():
+    # a probe arriving a commit BEFORE its indexed row must not crash the
+    # stream; the strict missing-key check fires only at end-of-stream
+    from pathway_tpu.internals.parse_graph import G as _G
+
+    _G.clear()
+
+    class Dims(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time as _t
+
+            _t.sleep(0.15)  # dim row arrives AFTER the probe's commit
+            self.next(k="a", v=1)
+            self.commit()
+
+    class Probes(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k="a")
+            self.commit()
+
+    dims_raw = pw.io.python.read(
+        Dims(), schema=pw.schema_from_types(k=str, v=int),
+        autocommit_duration_ms=None,
+    )
+    dims = dims_raw.with_id_from(pw.this.k)
+    probes = pw.io.python.read(
+        Probes(), schema=pw.schema_from_types(k=str),
+        autocommit_duration_ms=None,
+    )
+    ptr = probes.select(p=dims.pointer_from(probes.k))
+    res = dims.ix(ptr.p, context=ptr).select(pw.this.v)
+    got = []
+    pw.io.subscribe(
+        res, on_change=lambda key, row, time, is_addition: got.append(row["v"])
+    )
+    pw.run()
+    assert got == [1]
+
+
+def test_strict_ix_raises_at_stream_end_for_missing_key():
+    t = T(
+        """
+          | v
+        1 | a
+        """
+    )
+    q = T(
+        """
+          | p
+        1 | 5
+        """
+    )
+    ptr = q.select(p=t.pointer_from(q.p))
+    with pytest.raises(KeyError):
+        res = t.ix(ptr.p, context=ptr).select(pw.this.v)
+        pw.debug.table_to_pandas(res)
+
+
+def test_apply_is_none_branch_not_lifted():
+    # `a is None` folds to False on the expression placeholder with no
+    # blocked call — the bytecode gate must reject identity tests so the
+    # None branch executes per row
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int), [(None,), (5,)]
+    )
+    res = t.select(
+        c=pw.apply_with_type(lambda a: 0 if a is None else a, int, pw.this.a)
+    )
+    assert sorted(pw.debug.table_to_pandas(res)["c"].tolist()) == [0, 5]
+
+
+def test_update_types_does_not_cast_values():
+    t = pw.debug.table_from_rows(pw.schema_from_types(a=int), [(1,), (2,)])
+    res = t.update_types(a=float)
+    vals = sorted(pw.debug.table_to_pandas(res)["a"].tolist())
+    assert vals == [1, 2]  # values untouched; only the declared type moved
+    assert "FLOAT" in repr(res.schema.dtypes()["a"]).upper() or str(
+        res.schema.dtypes()["a"]
+    ).lower().find("float") >= 0
+
+
+def test_join_select_left_wildcard_without():
+    a = T(
+        """
+        k | x | y
+        1 | 2 | 3
+        """
+    )
+    b = T(
+        """
+        k | z
+        1 | 9
+        """
+    )
+    res = a.join(b, a.k == b.k).select(*pw.left.without(pw.left.x), b.z)
+    df = pw.debug.table_to_pandas(res)
+    assert sorted(df.columns.tolist()) == ["k", "y", "z"]
+    assert df[["k", "y", "z"]].values.tolist() == [[1, 3, 9]]

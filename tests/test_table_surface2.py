@@ -56,10 +56,22 @@ def test_restrict_refuses_unprovable_universe():
 
 
 def test_with_universe_of_refuses_unprovable():
+    # same-length unindexed tables share a universe by the reference's
+    # ordinal-id rule, so use different key material to stay unprovable
     t = T("a\n1\n2")
-    other = T("b\n5\n6")
+    other = T("b\n5\n6\n7")
     with pytest.raises(ValueError, match="provably equal"):
         t.with_universe_of(other)
+
+
+def test_same_length_static_tables_share_universe():
+    # the reference's static-tables cache (debug/__init__.py:384-401): the
+    # Nth unindexed row always gets the same id, so equal-length tables are
+    # cross-selectable without promises
+    t = T("a\n1\n2")
+    other = T("b\n5\n6")
+    res = t.select(pw.this.a, b=other.b)
+    assert rows_of(res) == [(1, 5), (2, 6)]
 
 
 def test_having_filters_to_existing_keys():
@@ -207,16 +219,18 @@ def test_promise_universes_are_equal_allows_zip():
 
 
 def test_promise_disjoint_allows_concat():
+    # explicit distinct ids: unindexed same-length tables would now REALLY
+    # collide (ordinal ids), exactly as in the reference
     a = T(
         """
-        x
-        1
+          | x
+        1 | 1
         """
     )
     b = T(
         """
-        x
-        2
+          | x
+        2 | 2
         """
     )
     a.promise_universes_are_disjoint(b)

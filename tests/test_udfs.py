@@ -212,3 +212,130 @@ def test_udf_with_error_values():
     res = t.select(b=maybe_fail(pw.this.a))
     recovered = res.select(b=pw.fill_error(pw.this.b, 0))
     assert _col(recovered, "b") == [0, 10, 30]
+
+
+# -- apply AST-lift (traced pure-operator lambdas -> columnar kernels) -----
+
+
+def test_apply_lift_matches_per_row_semantics():
+    import pathway_tpu.debug as dbg
+
+    t = T("a | b\n3 | 4\n5 | 0")
+    out = t.select(
+        c=pw.apply_with_type(lambda a, b: a * 2 + b, int, pw.this.a, pw.this.b)
+    )
+    assert sorted(dbg.table_to_pandas(out)["c"].tolist()) == [10, 10]
+
+
+def test_apply_lift_preserves_error_semantics():
+    import pathway_tpu.debug as dbg
+
+    t = T("a | b\n8 | 2\n9 | 0")
+    out = t.select(c=pw.fill_error(
+        pw.apply_with_type(lambda a, b: a // b, int, pw.this.a, pw.this.b), -1
+    ))
+    assert sorted(dbg.table_to_pandas(out)["c"].tolist()) == [-1, 4]
+
+
+def test_apply_impure_lambda_not_lifted():
+    import pathway_tpu.debug as dbg
+
+    seen = []
+
+    def note(x):
+        seen.append(x)
+        return x + 1
+
+    t = T("a\n1\n2\n3")
+    out = t.select(c=pw.apply_with_type(note, int, pw.this.a))
+    assert sorted(dbg.table_to_pandas(out)["c"].tolist()) == [2, 3, 4]
+    # the side effect MUST run once per row — lifting would run it once
+    assert len(seen) == 3
+
+
+def test_apply_closure_lambda_not_lifted_late_binding():
+    import pathway_tpu.debug as dbg
+
+    # closure cells are late-binding in the per-row path; the bytecode gate
+    # (LOAD_DEREF) must refuse to freeze them into a traced constant
+    factor = [2]
+
+    def fn(x):
+        return x * factor[0]
+
+    t = T("a\n10")
+    out = t.select(c=pw.apply_with_type(fn, int, pw.this.a))
+    assert dbg.table_to_pandas(out)["c"].tolist() == [20]
+
+
+def test_apply_value_branching_falls_back():
+    import pathway_tpu.debug as dbg
+
+    t = T("a\n-2\n5")
+    out = t.select(
+        c=pw.apply_with_type(lambda a: a if a > 0 else 0, int, pw.this.a)
+    )
+    assert sorted(dbg.table_to_pandas(out)["c"].tolist()) == [0, 5]
+
+
+def test_apply_lift_declared_float_over_int_args():
+    import pathway_tpu.debug as dbg
+
+    t = T("a\n3")
+    out = t.select(c=pw.apply_with_type(lambda a: a * 2, float, pw.this.a))
+    [v] = dbg.table_to_pandas(out)["c"].tolist()
+    assert v == 6.0 and isinstance(v, float)
+
+
+def test_apply_loop_lambda_not_lifted():
+    import pathway_tpu.debug as dbg
+
+    # iterating the argument must NOT be traced (a ColumnExpression has
+    # __getitem__ but no __iter__ — legacy iteration would spin forever)
+    def total(t):
+        s = 0
+        for v in t:
+            s = s + v
+        return s
+
+    tt = pw.debug.table_from_rows(
+        pw.schema_from_types(t=tuple), [((1, 2, 3),)]
+    )
+    out = tt.select(c=pw.apply_with_type(total, int, pw.this.t))
+    assert dbg.table_to_pandas(out)["c"].tolist() == [6]
+
+
+def test_apply_global_store_lambda_not_lifted():
+    import pathway_tpu.debug as dbg
+
+    def fn(x):
+        global _lift_probe_last
+        _lift_probe_last = x
+        return x * 2
+
+    t = T("a\n4")
+    out = t.select(c=pw.apply_with_type(fn, int, pw.this.a))
+    assert dbg.table_to_pandas(out)["c"].tolist() == [8]
+    # the per-row store must have run with the row VALUE, not a placeholder
+    assert _lift_probe_last == 4
+
+
+def test_subject_tail_rows_flushed_without_commit():
+    # run() returning without commit()/close() must not strand buffered rows
+    class Feed(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(300):  # 256 chunk + 44 tail
+                self.next(a=i)
+
+    from pathway_tpu.internals.parse_graph import G as _G
+
+    _G.clear()
+    t = pw.io.python.read(
+        Feed(), schema=pw.schema_from_types(a=int), autocommit_duration_ms=10
+    )
+    got = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, is_addition: got.append(row["a"])
+    )
+    pw.run()
+    assert sorted(got) == list(range(300))
