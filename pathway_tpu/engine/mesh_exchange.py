@@ -152,23 +152,10 @@ class MeshExchangeRunner:
         cap_bucket = _pow2(max(int(c.max()) for c in counts_all))
         width = self.width(kinds)
 
-        vals, dst = self._stage(cap_in, width)
-        dst.fill(-1)
-        for w, (sig, counts, local, dest) in enumerate(payloads):
-            if local is None or not len(local):
-                continue
-            n_w = len(local)
-            base = w * cap_in
-            parts = [
-                _pack_words(local.keys, "u"),
-                _pack_words(local.diffs, "i"),
-            ]
-            for c, k in zip(column_names, kinds):
-                if k != HOST:
-                    parts.append(_pack_words(local.data[c], k))
-            vals[base : base + n_w] = np.hstack(parts)
-            dst[base : base + n_w] = dest
-
+        vals, dst = self.pack_blocks(
+            [(local, dest) for _, _, local, dest in payloads],
+            kinds, column_names, cap_in,
+        )
         sh_v, sh_d = self._mesh_shardings()
         # one batched transfer for both arrays — halves dispatch overhead
         gvals, gdest = jax.device_put((vals, dst), (sh_v, sh_d))
@@ -187,13 +174,46 @@ class MeshExchangeRunner:
             )
         return self._shardings
 
-    def _stage(self, cap_in: int, width: int) -> tuple[np.ndarray, np.ndarray]:
-        key = (cap_in, width)
+    def pack_blocks(
+        self,
+        blocks: list[tuple[Delta | None, np.ndarray | None]],
+        kinds: list[str],
+        column_names: list[str],
+        cap_in: int,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Pack per-block (local Delta, dest) pairs into one pinned staging
+        buffer of ``len(blocks) * cap_in`` rows — the single definition of
+        the packed-word layout shared by the single-process driver
+        (blocks = all workers) and each multi-host process leader
+        (blocks = this process's workers)."""
+        width = self.width(kinds)
+        vals, dst = self._stage(len(blocks), cap_in, width)
+        dst.fill(-1)
+        for b, (local, dest) in enumerate(blocks):
+            if local is None or not len(local):
+                continue
+            n_b = len(local)
+            base = b * cap_in
+            parts = [
+                _pack_words(local.keys, "u"),
+                _pack_words(local.diffs, "i"),
+            ]
+            for c, k in zip(column_names, kinds):
+                if k != HOST:
+                    parts.append(_pack_words(local.data[c], k))
+            vals[base : base + n_b] = np.hstack(parts)
+            dst[base : base + n_b] = dest
+        return vals, dst
+
+    def _stage(
+        self, n_blocks: int, cap_in: int, width: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        key = (n_blocks, cap_in, width)
         buf = self._staging.get(key)
         if buf is None:
             buf = (
-                np.zeros((self.n * cap_in, width), dtype=np.uint32),
-                np.empty(self.n * cap_in, dtype=np.int32),
+                np.zeros((n_blocks * cap_in, width), dtype=np.uint32),
+                np.empty(n_blocks * cap_in, dtype=np.int32),
             )
             self._staging[key] = buf
         return buf

@@ -127,14 +127,23 @@ class GraphRunner:
             local_worker_ids = list(range(n_workers))
         if cfg.mesh_exchange:
             if cfg.processes > 1:
-                raise NotImplementedError(
-                    "PATHWAY_MESH_EXCHANGE with multiple processes needs the "
-                    "jax.distributed multi-host mesh (parallel/distributed.py)"
-                    " — run single-process (threads only) for now"
-                )
-            from ..parallel.meshcomm import MeshComm
+                # cross-host: bootstrap jax.distributed so the device mesh
+                # spans every process (ICI within a pod, DCN across);
+                # record exchange then rides MultiHostMeshComm
+                from ..parallel import distributed
+                from ..parallel.meshcomm import MultiHostMeshComm
 
-            comm = MeshComm(comm)
+                distributed.init_from_env()
+                comm = MultiHostMeshComm(
+                    comm,
+                    process_id=cfg.process_id,
+                    n_processes=cfg.processes,
+                    threads=cfg.threads,
+                )
+            else:
+                from ..parallel.meshcomm import MeshComm
+
+                comm = MeshComm(comm)
 
         pcfg = getattr(self, "persistence_config", None)
         managers: list[Any] = []

@@ -31,8 +31,10 @@ def init_from_env(coordinator_host: str = "127.0.0.1") -> None:
     if cfg.processes <= 1:
         _initialized = True
         return
+    # default coordinator port offset: first_port itself belongs to the
+    # ClusterComm TCP mesh listeners
     coordinator = os.environ.get(
-        "PATHWAY_COORDINATOR", f"{coordinator_host}:{cfg.first_port}"
+        "PATHWAY_COORDINATOR", f"{coordinator_host}:{cfg.first_port + 1000}"
     )
     jax.distributed.initialize(
         coordinator_address=coordinator,

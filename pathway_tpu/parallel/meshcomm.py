@@ -24,9 +24,11 @@ path) is:
 Total host synchronization: 2 barriers per channel-tick (was 8), one
 device upload (was one per worker plus a result allgather).
 
-Enable with ``PATHWAY_MESH_EXCHANGE=1`` (single-process workers only; the
-multi-host variant needs ``jax.distributed`` — ``parallel/distributed.py``
-— and rides DCN, not wired to the engine yet).
+Enable with ``PATHWAY_MESH_EXCHANGE=1``. Single-process runs use
+:class:`MeshComm` (threads over one process's devices); ``spawn -n M``
+runs bootstrap ``jax.distributed`` (``parallel/distributed.py``) and use
+:class:`MultiHostMeshComm`, whose collective spans every process's
+devices — ICI within a pod, DCN across pods.
 
 Reference being replaced: timely's ``zero_copy`` allocator
 (``external/timely-dataflow/communication/src/allocator/zero_copy/``).
@@ -47,7 +49,7 @@ from ..engine.mesh_exchange import (
 )
 from .comm import Comm
 
-__all__ = ["MeshComm"]
+__all__ = ["MeshComm", "MultiHostMeshComm"]
 
 
 class MeshComm(Comm):
@@ -193,3 +195,216 @@ class _DriverError:
 
     def __init__(self, error: BaseException):
         self.error = error
+
+
+class MultiHostMeshComm(Comm):
+    """Cross-process mesh exchange: the DCN/ICI data plane over a
+    ``jax.distributed`` multi-controller mesh (VERDICT r4 item 6).
+
+    Processes each own ``threads`` workers and (at least) ``threads``
+    local devices; the global 1-D mesh orders devices process-major so
+    worker ``p*threads + t`` owns device ``t`` of process ``p``. Per
+    channel-tick:
+
+    1. every worker allgathers its tiny control tuple (dtype signature,
+       per-destination counts) over the host ClusterComm, and deposits its
+       local Delta in a PROCESS-local slot;
+    2. each process's leader thread packs its workers' dense rows into
+       process-local staging, forms its slice of the global array with
+       ``jax.make_array_from_process_local_data``, and all leaders execute
+       the same jitted ``bucketed_all_to_all`` simultaneously
+       (multi-controller SPMD) — the record bytes ride ICI/DCN;
+    3. every worker reads back its own addressable shard; object/string
+       columns swap over the host ClusterComm and re-zip by source order.
+
+    Reference: timely's cluster allocator
+    (``communication/src/allocator/zero_copy/``) + bootstrap
+    (``communication/src/initialize.rs``).
+    """
+
+    def __init__(self, inner: Comm, process_id: int, n_processes: int,
+                 threads: int):
+        import jax
+        from jax.sharding import Mesh
+
+        self.inner = inner
+        self.n_workers = inner.n_workers
+        self.process_id = process_id
+        self.n_processes = n_processes
+        self.threads = threads
+        by_process: dict[int, list] = {}
+        for d in jax.devices():
+            by_process.setdefault(d.process_index, []).append(d)
+        ordered = []
+        for p in sorted(by_process):
+            local = by_process[p]
+            if len(local) < threads:
+                raise RuntimeError(
+                    f"process {p} exposes {len(local)} devices < "
+                    f"{threads} workers — mesh exchange needs one device "
+                    "per worker"
+                )
+            ordered.extend(local[:threads])
+        if len(ordered) < self.n_workers:
+            raise RuntimeError(
+                f"mesh exchange needs ≥{self.n_workers} devices across "
+                f"processes, have {len(ordered)}"
+            )
+        self.mesh = Mesh(np.array(ordered[: self.n_workers]), ("workers",))
+        self.runner = MeshExchangeRunner(self.mesh, "workers")
+        # process-local coordination among this process's worker threads
+        self._local_barrier = threading.Barrier(threads)
+        self._slot_lock = threading.Lock()
+        self._slots: dict[tuple, dict] = {}
+
+    # host-comm delegation
+
+    def exchange(self, channel, tick, worker_id, buckets):
+        return self.inner.exchange(channel, tick, worker_id, buckets)
+
+    def allgather(self, tag, worker_id, obj):
+        return self.inner.allgather(tag, worker_id, obj)
+
+    def barrier(self, worker_id: int):
+        self.inner.barrier(worker_id)
+
+    def abort(self):
+        self._local_barrier.abort()
+        self.inner.abort()
+
+    def close(self):
+        with self._slot_lock:
+            self._slots.clear()
+        self.inner.close()
+
+    def _local_index(self, worker_id: int) -> int:
+        return worker_id - self.process_id * self.threads
+
+    def exchange_deltas(
+        self,
+        channel: int,
+        tick: int,
+        worker_id: int,
+        buckets: Sequence[Delta | None],
+        column_names: list[str],
+    ) -> list[Delta]:
+        from ..engine.mesh_exchange import _pow2, agree_kinds
+
+        n = self.n_workers
+        parts = [
+            (dst, d) for dst, d in enumerate(buckets) if d is not None and len(d)
+        ]
+        local = concat_deltas([d for _, d in parts], column_names) if parts else None
+        dest = (
+            np.concatenate(
+                [np.full(len(d), dst, dtype=np.int32) for dst, d in parts]
+            )
+            if parts
+            else np.empty(0, dtype=np.int32)
+        )
+        counts = np.zeros(n, dtype=np.int64)
+        for dst, d in parts:
+            counts[dst] += len(d)
+        sig = local_signature(local, column_names)
+
+        key = (channel, tick)
+        with self._slot_lock:
+            slot = self._slots.setdefault(
+                key, {"payloads": [None] * self.threads}
+            )
+            slot["payloads"][self._local_index(worker_id)] = (local, dest)
+        # ONE global control allgather per channel-tick
+        metas = self.inner.allgather(
+            ("mxh", channel, tick), worker_id, (sig, counts.tolist())
+        )
+        total = sum(sum(m[1]) for m in metas)
+        kinds = agree_kinds([m[0] for m in metas], len(column_names))
+        cap_in = _pow2(max(sum(m[1]) for m in metas)) if total else 8
+        cap_bucket = _pow2(max(max(m[1]) for m in metas)) if total else 8
+
+        try:
+            self._local_barrier.wait()  # all local deposits visible
+            leader = self._local_index(worker_id) == 0
+            if leader:
+                with self._slot_lock:
+                    stale = [k for k in self._slots if k[1] < tick]
+                    for k in stale:
+                        del self._slots[k]
+                    slot = self._slots[key]
+                try:
+                    slot["result"] = (
+                        self._run_collective(
+                            slot["payloads"], column_names, kinds,
+                            cap_in, cap_bucket,
+                        )
+                        if total
+                        else None
+                    )
+                except BaseException as e:  # noqa: BLE001
+                    slot["result"] = _DriverError(e)
+                    self._local_barrier.wait()
+                    raise
+                self._local_barrier.wait()
+            else:
+                self._local_barrier.wait()
+                slot = self._slots[key]
+        except threading.BrokenBarrierError:
+            raise RuntimeError(
+                "a peer worker failed — aborting mesh exchange"
+            ) from None
+
+        result = slot["result"]
+        if isinstance(result, _DriverError):
+            raise RuntimeError(
+                "mesh exchange failed on the process leader"
+            ) from result.error
+
+        host_names = [c for c, k in zip(column_names, kinds) if k == HOST]
+        host_cols: dict[int, dict[str, np.ndarray]] = {}
+        if host_names and total:
+            obj_buckets: list[Any] = [None] * n
+            if parts:
+                per_dst: dict[int, dict[str, list]] = {}
+                for dst, d in parts:
+                    cols = per_dst.setdefault(dst, {c: [] for c in host_names})
+                    for c in host_names:
+                        cols[c].append(d.data[c])
+                for dst, cols in per_dst.items():
+                    obj_buckets[dst] = (
+                        worker_id,
+                        {c: np.concatenate(v) for c, v in cols.items()},
+                    )
+            received = self.inner.exchange(
+                ("mxh-obj", channel), tick, worker_id, obj_buckets
+            )
+            for src, cols in received:
+                host_cols[src] = cols
+
+        if result is None:
+            return []
+        gvals, gvalid = result
+        per_dev = n * cap_bucket
+        my_vals = self.runner.my_shard(gvals, worker_id, per_dev)
+        my_valid = self.runner.my_shard(gvalid, worker_id, per_dev)
+        return self.runner.unpack_arrivals(
+            vals=my_vals,
+            valid=my_valid.astype(bool),
+            kinds=kinds,
+            column_names=column_names,
+            host_cols=host_cols,
+        )
+
+    def _run_collective(self, payloads, column_names, kinds, cap_in, cap_bucket):
+        """Leader thread: pack this PROCESS's workers, form the process-local
+        slice of the global array, run the collective with every other
+        process's leader."""
+        import jax
+
+        vals, dst = self.runner.pack_blocks(
+            list(payloads), kinds, column_names, cap_in
+        )
+        sh_v, sh_d = self.runner._mesh_shardings()
+        gvals = jax.make_array_from_process_local_data(sh_v, vals)
+        gdest = jax.make_array_from_process_local_data(sh_d, dst)
+        width = self.runner.width(kinds)
+        return self.runner._kernel(cap_in, cap_bucket, width)(gvals, gdest)

@@ -1,0 +1,311 @@
+"""Pure-stdlib document text extraction — the local fallback parsers.
+
+The reference ships 928 LoC of parsers built on unstructured/openparse/OCR
+(``python/pathway/xpacks/llm/parsers.py``), none of which are installable
+in a no-egress environment. These extractors cover the common formats with
+ONLY the standard library, so the RAG ingest path handles more than UTF-8
+text without gated clients (VERDICT r4 item 9):
+
+- PDF: scan content streams (FlateDecode via zlib), evaluate the text
+  operators (Tj / TJ / ' / ") with PDF string escapes; layout-free but
+  reading-ordered for the single-column documents generators emit.
+- HTML: ``html.parser`` strip of script/style/head with block-level
+  newlines and heading capture.
+- Markdown: syntax strip + heading-section splitting.
+- DOCX: the document.xml inside the zip container, ``w:p`` paragraphs and
+  ``w:t`` runs.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+from html.parser import HTMLParser
+from typing import Any
+
+__all__ = [
+    "pdf_extract_text",
+    "html_extract_text",
+    "markdown_extract_sections",
+    "docx_extract_text",
+    "sniff_format",
+]
+
+
+# ---------------------------------------------------------------------------
+# PDF
+# ---------------------------------------------------------------------------
+
+_STREAM_RE = re.compile(rb"stream\r?\n(.*?)endstream", re.DOTALL)
+
+
+def _pdf_streams(data: bytes) -> list[bytes]:
+    """All content streams, decompressed when FlateDecode."""
+    out = []
+    pos = 0
+    while True:
+        m = _STREAM_RE.search(data, pos)
+        if m is None:
+            break
+        raw = m.group(1)
+        head = data[max(0, m.start() - 400) : m.start()]
+        if b"FlateDecode" in head:
+            try:
+                raw = zlib.decompress(raw)
+            except zlib.error:
+                try:  # stream may carry trailing EOL garbage
+                    raw = zlib.decompressobj().decompress(raw)
+                except zlib.error:
+                    raw = b""
+        out.append(raw)
+        pos = m.end()
+    return out
+
+
+def _pdf_unescape(s: bytes) -> str:
+    out = []
+    i = 0
+    n = len(s)
+    while i < n:
+        c = s[i]
+        if c == 0x5C and i + 1 < n:  # backslash
+            nxt = s[i + 1]
+            mapped = {
+                0x6E: "\n", 0x72: "\r", 0x74: "\t", 0x62: "\b",
+                0x66: "\f", 0x28: "(", 0x29: ")", 0x5C: "\\",
+            }.get(nxt)
+            if mapped is not None:
+                out.append(mapped)
+                i += 2
+                continue
+            if 0x30 <= nxt <= 0x37:  # octal escape, up to 3 digits
+                j = i + 1
+                digits = b""
+                while j < n and len(digits) < 3 and 0x30 <= s[j] <= 0x37:
+                    digits += bytes([s[j]])
+                    j += 1
+                out.append(chr(int(digits, 8)))
+                i = j
+                continue
+            if nxt == 0x0A:  # line continuation
+                i += 2
+                continue
+        out.append(chr(c))
+        i += 1
+    return "".join(out)
+
+
+_TEXT_OP_RE = re.compile(
+    rb"(\((?:[^()\\]|\\.)*\))\s*(Tj|')"  # (string) Tj / '
+    rb"|(<[0-9A-Fa-f\s]*>)\s*(Tj|')"  # <hex> Tj
+    rb"|(\[(?:[^\]\\]|\\.)*\])\s*TJ"  # [(a) -120 (b)] TJ
+    rb"|(T\*|TD|Td|BT|ET)"  # line/positioning breaks
+)
+_INNER_STR_RE = re.compile(rb"\((?:[^()\\]|\\.)*\)")
+
+
+def pdf_extract_text(data: bytes) -> str:
+    """Text of all content streams, newline-separated at line operators."""
+    parts: list[str] = []
+    for stream in _pdf_streams(data):
+        if b"Tj" not in stream and b"TJ" not in stream and b"'" not in stream:
+            continue
+        for m in _TEXT_OP_RE.finditer(stream):
+            if m.group(1) is not None:
+                parts.append(_pdf_unescape(m.group(1)[1:-1]))
+            elif m.group(3) is not None:
+                hexstr = re.sub(rb"\s", b"", m.group(3)[1:-1])
+                if len(hexstr) % 2:
+                    hexstr += b"0"
+                try:
+                    raw = bytes.fromhex(hexstr.decode())
+                    # UTF-16BE when BOM'd (common for CID fonts), else latin
+                    parts.append(
+                        raw.decode("utf-16-be")
+                        if raw[:2] == b"\xfe\xff"
+                        else raw.decode("latin-1")
+                    )
+                except ValueError:
+                    pass
+            elif m.group(5) is not None:
+                for sm in _INNER_STR_RE.finditer(m.group(5)):
+                    parts.append(_pdf_unescape(sm.group(0)[1:-1]))
+            else:
+                op = m.group(6)
+                if op in (b"T*", b"TD", b"Td", b"ET") and parts and not (
+                    parts and parts[-1] == "\n"
+                ):
+                    parts.append("\n")
+    text = "".join(parts)
+    # collapse intra-line runs the positioning ops produced
+    return re.sub(r"\n{3,}", "\n\n", text).strip()
+
+
+# ---------------------------------------------------------------------------
+# HTML
+# ---------------------------------------------------------------------------
+
+_BLOCK_TAGS = {
+    "p", "div", "br", "li", "tr", "h1", "h2", "h3", "h4", "h5", "h6",
+    "section", "article", "header", "footer", "blockquote", "pre",
+    "table", "ul", "ol",
+}
+_SKIP_TAGS = {"script", "style", "head", "noscript", "template"}
+
+
+class _TextHTMLParser(HTMLParser):
+    def __init__(self) -> None:
+        super().__init__(convert_charrefs=True)
+        self.parts: list[str] = []
+        self.title: str | None = None
+        self._skip_depth = 0
+        self._in_title = False
+
+    def handle_starttag(self, tag, attrs):
+        if tag in _SKIP_TAGS:
+            self._skip_depth += 1
+        elif tag == "title":
+            self._in_title = True
+        elif tag in _BLOCK_TAGS:
+            self.parts.append("\n")
+
+    def handle_endtag(self, tag):
+        if tag in _SKIP_TAGS and self._skip_depth:
+            self._skip_depth -= 1
+        elif tag == "title":
+            self._in_title = False
+        elif tag in _BLOCK_TAGS:
+            self.parts.append("\n")
+
+    def handle_data(self, data):
+        if self._in_title:
+            # title sits inside <head>, which is otherwise skipped
+            self.title = (self.title or "") + data
+            return
+        if self._skip_depth:
+            return
+        self.parts.append(data)
+
+
+def html_extract_text(data: bytes | str) -> tuple[str, dict]:
+    if isinstance(data, bytes):
+        data = data.decode("utf-8", errors="replace")
+    p = _TextHTMLParser()
+    p.feed(data)
+    p.close()
+    text = re.sub(r"[ \t]+", " ", "".join(p.parts))
+    text = re.sub(r" ?\n ?", "\n", text)
+    text = re.sub(r"\n{3,}", "\n\n", text).strip()
+    meta = {"title": p.title.strip()} if p.title else {}
+    return text, meta
+
+
+# ---------------------------------------------------------------------------
+# Markdown
+# ---------------------------------------------------------------------------
+
+_MD_HEADING_RE = re.compile(r"^(#{1,6})\s+(.*)$")
+
+
+def _md_strip(text: str) -> str:
+    text = re.sub(r"```.*?```", "", text, flags=re.DOTALL)  # fenced code
+    text = re.sub(r"`([^`]*)`", r"\1", text)  # inline code
+    text = re.sub(r"!\[([^\]]*)\]\([^)]*\)", r"\1", text)  # images
+    text = re.sub(r"\[([^\]]*)\]\([^)]*\)", r"\1", text)  # links
+    text = re.sub(r"(\*\*|__)(.*?)\1", r"\2", text)  # bold
+    text = re.sub(r"(\*|_)(.*?)\1", r"\2", text)  # italics
+    text = re.sub(r"^\s{0,3}([-*+]|\d+\.)\s+", "", text, flags=re.MULTILINE)
+    text = re.sub(r"^\s{0,3}>\s?", "", text, flags=re.MULTILINE)  # quotes
+    text = re.sub(r"^\s*([-*_]\s*){3,}$", "", text, flags=re.MULTILINE)
+    return text
+
+
+def markdown_extract_sections(data: bytes | str) -> list[tuple[str, dict]]:
+    """Split by headings; each section carries its heading as metadata."""
+    if isinstance(data, bytes):
+        data = data.decode("utf-8", errors="replace")
+    sections: list[tuple[str, dict]] = []
+    heading: str | None = None
+    buf: list[str] = []
+
+    def flush():
+        body = _md_strip("\n".join(buf)).strip()
+        if body or heading:
+            meta = {"heading": heading} if heading else {}
+            sections.append((body, meta))
+
+    for line in data.splitlines():
+        m = _MD_HEADING_RE.match(line)
+        if m:
+            flush()
+            buf = []
+            heading = m.group(2).strip()
+        else:
+            buf.append(line)
+    flush()
+    if not sections:
+        sections.append(("", {}))
+    return sections
+
+
+# ---------------------------------------------------------------------------
+# DOCX
+# ---------------------------------------------------------------------------
+
+
+def docx_extract_text(data: bytes) -> str:
+    import io
+    import zipfile
+    from xml.etree import ElementTree
+
+    ns = "{http://schemas.openxmlformats.org/wordprocessingml/2006/main}"
+    with zipfile.ZipFile(io.BytesIO(data)) as zf:
+        with zf.open("word/document.xml") as f:
+            root = ElementTree.parse(f).getroot()
+    paras = []
+    for p in root.iter(f"{ns}p"):
+        runs = [t.text or "" for t in p.iter(f"{ns}t")]
+        paras.append("".join(runs))
+    return "\n".join(paras).strip()
+
+
+# ---------------------------------------------------------------------------
+# format sniffing
+# ---------------------------------------------------------------------------
+
+
+def sniff_format(data: Any) -> str:
+    """'pdf' | 'docx' | 'html' | 'markdown' | 'text'."""
+    if isinstance(data, str):
+        head = data[:2048].lstrip().lower()
+        if head.startswith("<!doctype html") or head.startswith("<html"):
+            return "html"
+        if _looks_markdown(data):
+            return "markdown"
+        return "text"
+    if data[:5] == b"%PDF-":
+        return "pdf"
+    if data[:4] == b"PK\x03\x04" and b"word/" in data[:4096]:
+        return "docx"
+    head = data[:2048].lstrip().lower()
+    if head.startswith(b"<!doctype html") or head.startswith(b"<html"):
+        return "html"
+    try:
+        text = data.decode("utf-8")
+    except UnicodeDecodeError:
+        return "text"
+    return "markdown" if _looks_markdown(text) else "text"
+
+
+def _looks_markdown(text: str) -> bool:
+    sample = text[:4000]
+    signals = 0
+    if re.search(r"^#{1,6}\s+\S", sample, re.MULTILINE):
+        signals += 2
+    if re.search(r"^\s{0,3}[-*+]\s+\S", sample, re.MULTILINE):
+        signals += 1
+    if re.search(r"\[[^\]]+\]\([^)]+\)", sample):
+        signals += 1
+    if re.search(r"```", sample):
+        signals += 1
+    return signals >= 2

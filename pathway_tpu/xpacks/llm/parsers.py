@@ -2,8 +2,12 @@
 928 LoC — Utf8/Unstructured/OpenParse/OCR).
 
 A parser is a UDF ``bytes -> list[(text, metadata)]``. ``ParseUtf8`` is
-always available; the heavyweight parsers gate on their libraries
-(unstructured / openparse are not baked into this environment).
+always available; the heavyweight client parsers gate on their libraries
+(unstructured / openparse are not baked into this environment). The
+``ParsePdf`` / ``ParseHtml`` / ``ParseMarkdown`` / ``ParseDocx`` /
+``ParseLocal`` family runs on the standard library alone
+(``_local_parsers.py``) so RAG pipelines ingest beyond plain text without
+any gated client.
 """
 
 from __future__ import annotations
@@ -11,8 +15,18 @@ from __future__ import annotations
 from typing import Any
 
 from ...udfs import UDF
+from . import _local_parsers as LP
 
-__all__ = ["ParseUtf8", "ParseUnstructured", "OpenParse"]
+__all__ = [
+    "ParseUtf8",
+    "ParsePdf",
+    "ParseHtml",
+    "ParseMarkdown",
+    "ParseDocx",
+    "ParseLocal",
+    "ParseUnstructured",
+    "OpenParse",
+]
 
 
 class ParseUtf8(UDF):
@@ -25,6 +39,68 @@ class ParseUtf8(UDF):
         else:
             text = str(contents)
         return [(text, {})]
+
+
+class ParsePdf(UDF):
+    """Pure-stdlib PDF text extraction (content-stream text operators +
+    FlateDecode; ``_local_parsers.pdf_extract_text``). Layout-free — the
+    local stand-in for the reference's openparse/unstructured PDF path."""
+
+    def __wrapped__(self, contents: Any, **kwargs: Any) -> list[tuple[str, dict]]:
+        data = contents if isinstance(contents, bytes) else str(contents).encode()
+        return [(LP.pdf_extract_text(data), {"format": "pdf"})]
+
+
+class ParseHtml(UDF):
+    """Stdlib ``html.parser`` text extraction with block-level structure;
+    the page title lands in metadata."""
+
+    def __wrapped__(self, contents: Any, **kwargs: Any) -> list[tuple[str, dict]]:
+        text, meta = LP.html_extract_text(
+            contents if isinstance(contents, (bytes, str)) else str(contents)
+        )
+        return [(text, {"format": "html", **meta})]
+
+
+class ParseMarkdown(UDF):
+    """Markdown split into heading-delimited sections, one part per
+    section with its heading as metadata."""
+
+    def __wrapped__(self, contents: Any, **kwargs: Any) -> list[tuple[str, dict]]:
+        sections = LP.markdown_extract_sections(
+            contents if isinstance(contents, (bytes, str)) else str(contents)
+        )
+        return [
+            (text, {"format": "markdown", **meta}) for text, meta in sections
+        ]
+
+
+class ParseDocx(UDF):
+    """DOCX paragraph text from the zip container's document.xml."""
+
+    def __wrapped__(self, contents: Any, **kwargs: Any) -> list[tuple[str, dict]]:
+        data = contents if isinstance(contents, bytes) else str(contents).encode()
+        return [(LP.docx_extract_text(data), {"format": "docx"})]
+
+
+class ParseLocal(UDF):
+    """Auto-dispatching local parser: sniffs PDF / DOCX / HTML / Markdown /
+    plain text by magic bytes + content and routes to the matching
+    extractor — the default choice for mixed-format document folders."""
+
+    def __wrapped__(self, contents: Any, **kwargs: Any) -> list[tuple[str, dict]]:
+        fmt = LP.sniff_format(
+            contents if isinstance(contents, (bytes, str)) else str(contents)
+        )
+        if fmt == "pdf":
+            return ParsePdf.__wrapped__(self, contents)
+        if fmt == "docx":
+            return ParseDocx.__wrapped__(self, contents)
+        if fmt == "html":
+            return ParseHtml.__wrapped__(self, contents)
+        if fmt == "markdown":
+            return ParseMarkdown.__wrapped__(self, contents)
+        return ParseUtf8.__wrapped__(self, contents)
 
 
 class ParseUnstructured(UDF):

@@ -456,3 +456,43 @@ def test_spawn_rejects_malformed_book_and_duplicate_pids():
         "-p", "0", "-p", "0", "true",
     ])
     assert r.exit_code != 0 and "distinct" in r.output
+
+
+def test_multihost_mesh_exchange_parity(tmp_path):
+    """2-process loopback mesh over jax.distributed: dense Exchange columns
+    ride the cross-process device collective (MultiHostMeshComm) and the
+    output matches the single-worker run (VERDICT r4 item 6 — the engine
+    call site + test for parallel/distributed.py)."""
+    prog = tmp_path / "prog.py"
+    prog.write_text(textwrap.dedent(_CLUSTER_PROGRAM))
+    out_single = tmp_path / "single.json"
+    out_mesh = tmp_path / "mesh.json"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root}
+    subprocess.run(
+        [sys.executable, str(prog), str(out_single)],
+        env={**base_env, "PATHWAY_THREADS": "1", "PATHWAY_PROCESSES": "1"},
+        check=True, timeout=120,
+    )
+    first_port = _free_port()
+    coord_port = _free_port()
+    while coord_port in (first_port, first_port + 1):
+        coord_port = _free_port()  # the -n 2 mesh binds first_port(+1)
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "pathway_tpu.cli", "spawn",
+            "-n", "2", "-t", "2", "--first-port", str(first_port),
+            sys.executable, str(prog), str(out_mesh),
+        ],
+        env={
+            **base_env,
+            "PATHWAY_MESH_EXCHANGE": "1",
+            "PATHWAY_COORDINATOR": f"127.0.0.1:{coord_port}",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        },
+        check=False, timeout=300, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert json.loads(out_single.read_text()) == json.loads(
+        out_mesh.read_text()
+    )
