@@ -1420,6 +1420,17 @@ class Flatten(Node):
         arrs = [d.data[c] for c in names]
         for i in range(len(d)):
             value = arrs[flat_ix][i]
+            if value is None or isinstance(value, EngineError) or not hasattr(
+                value, "__iter__"
+            ):
+                # a row whose flatten column holds Error/None/any
+                # non-iterable cannot explode; log and skip instead of
+                # crashing the run (reference flatten error-row semantics)
+                ERROR_LOG.record(
+                    "non-iterable value in flatten column; row skipped",
+                    "flatten",
+                )
+                continue
             base = tuple(a[i] for a in arrs)
             parent = np.array([d.keys[i]], dtype=np.uint64)
             for pos, item in enumerate(value):

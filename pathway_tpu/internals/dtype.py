@@ -234,6 +234,7 @@ _FROM_PY: dict[Any, DType] = {
     Any: ANY,
     object: ANY,
     dict: JSON,
+    Json: JSON,
     list: List(ANY),
     tuple: Tuple(),
 }

@@ -255,7 +255,7 @@ def _make_jitted(expr: ColumnExpression, env: ColumnEnv):
 
 _CMP_OPS = {"==", "!=", "<", "<=", ">", ">="}
 _ARITH_OPS = {"+", "-", "*", "/", "//", "%", "**", "@"}
-_BITS_OPS = {"&", "|", "^"}
+_BITS_OPS = {"&", "|", "^", "<<", ">>"}
 
 
 def binop_dtype(op: str, l: dt.DType, r: dt.DType) -> dt.DType:
@@ -267,6 +267,12 @@ def binop_dtype(op: str, l: dt.DType, r: dt.DType) -> dt.DType:
 
     if op in _CMP_OPS:
         return w(dt.BOOL)
+    if op in ("<<", ">>"):
+        # shifts are integer arithmetic even on bools (True << True == 2);
+        # the &/|/^ bool-closure rule must not apply
+        if lu in (dt.INT, dt.BOOL) and ru in (dt.INT, dt.BOOL):
+            return w(dt.INT)
+        return w(dt.ANY)
     if op in _BITS_OPS:
         if lu == dt.BOOL and ru == dt.BOOL:
             return w(dt.BOOL)
@@ -863,6 +869,7 @@ def _binop_fn(op, lf, rf, ldt, rdt, xp):
         "**": _op.pow, "==": _op.eq, "!=": _op.ne, "<": _op.lt,
         "<=": _op.le, ">": _op.gt, ">=": _op.ge, "&": _op.and_,
         "|": _op.or_, "^": _op.xor, "@": _op.matmul,
+        "<<": _op.lshift, ">>": _op.rshift,
     }
     f = py_ops[op]
 
@@ -882,7 +889,7 @@ def _binop_fn(op, lf, rf, ldt, rdt, xp):
             return out
         return fn_mm
     if op in ("+", "-", "*", "/", "**", "==", "!=", "<", "<=", ">", ">=",
-              "&", "|", "^"):
+              "&", "|", "^", "<<", ">>"):
         # object columns may carry None/Error rows — handle per element.
         # Applied even for statically dense dtypes: upstream zero-division
         # injects Error rows into columns typed non-optional, and _objsafe
@@ -906,7 +913,7 @@ def _objsafe(vec_fn, op, lf, rf):
         ">": _op.gt, ">=": _op.ge,
         "&": lambda a, b: (a and b) if isinstance(a, (bool, np.bool_)) else a & b,
         "|": lambda a, b: (a or b) if isinstance(a, (bool, np.bool_)) else a | b,
-        "^": _op.xor,
+        "^": _op.xor, "<<": _op.lshift, ">>": _op.rshift,
     }
     f = py_ops[op]
 

@@ -510,6 +510,15 @@ class Table(TableLike):
             new_dt = dt.types_lca_many(list(iu.args))
         elif iu == dt.STR:
             new_dt = dt.STR
+        elif iu in (dt.INT, dt.FLOAT, dt.BOOL, dt.POINTER, dt.DURATION,
+                    dt.DATE_TIME_NAIVE, dt.DATE_TIME_UTC, dt.BYTES):
+            # statically non-iterable — a build-time error, as in the
+            # reference (test_common.py:1095 test_flatten_incorrect_type);
+            # dynamically wrong values in ANY/JSON columns are skipped at
+            # run time with an error-log entry instead
+            raise TypeError(
+                f"flatten: column {ref.name!r} of type {iu} is not iterable"
+            )
         else:
             new_dt = dt.ANY
         cols[ref.name] = ColumnSchema(name=ref.name, dtype=new_dt)
@@ -635,6 +644,26 @@ class Table(TableLike):
         from ..stdlib.temporal import window_join as _f
 
         return _f(self, other, self_time, other_time, window, *on, **kw)
+
+    def window_join_inner(self, other, self_time, other_time, window, *on):
+        from ..stdlib.temporal import window_join_inner as _f
+
+        return _f(self, other, self_time, other_time, window, *on)
+
+    def window_join_left(self, other, self_time, other_time, window, *on):
+        from ..stdlib.temporal import window_join_left as _f
+
+        return _f(self, other, self_time, other_time, window, *on)
+
+    def window_join_right(self, other, self_time, other_time, window, *on):
+        from ..stdlib.temporal import window_join_right as _f
+
+        return _f(self, other, self_time, other_time, window, *on)
+
+    def window_join_outer(self, other, self_time, other_time, window, *on):
+        from ..stdlib.temporal import window_join_outer as _f
+
+        return _f(self, other, self_time, other_time, window, *on)
 
     def asof_join(self, other, self_time, other_time, *on, **kw):
         from ..stdlib.temporal import asof_join as _f

@@ -170,7 +170,7 @@ class AirbyteSource(RealtimeSource):
         self._next_poll = now + self.refresh_interval_s
 
         append_rows: list[tuple] = []
-        refresh_rows: dict[str, dict[int, tuple]] = {}
+        refresh_pairs: list[tuple[str, tuple]] = []
         for msg in self.runner.extract(self._state_for_extract()):
             mtype = msg.get("type")
             if mtype == "RECORD":
@@ -180,12 +180,17 @@ class AirbyteSource(RealtimeSource):
                     continue
                 row = self._row_of(stream, rec.get("data", {}))
                 if self._sync_mode(stream) == "full_refresh":
-                    key = int(K.hash_values([(stream, row)])[0])
-                    refresh_rows.setdefault(stream, {})[key] = row
+                    refresh_pairs.append((stream, row))
                 else:
                     append_rows.append(row)
             elif mtype == "STATE":
                 self._absorb_state(msg.get("state"))
+        # one batched hash for the whole refresh set, like the append path
+        refresh_rows: dict[str, dict[int, tuple]] = {}
+        if refresh_pairs:
+            rkeys = K.hash_values(refresh_pairs)
+            for (stream, row), k in zip(refresh_pairs, rkeys):
+                refresh_rows.setdefault(stream, {})[int(k)] = row
         if self.mode == "static":
             self._done = True
 
@@ -282,6 +287,11 @@ def read(config_file_path: str, streams: list[str], *, mode: str = "streaming",
     else:
         sync_modes, default_sync = {}, sync_mode
     with_stream_col = len(streams) != 1
+    if schema is not None and with_stream_col and "stream" in schema.column_names():
+        raise ValueError(
+            "schema must not define a column named 'stream': multi-stream "
+            "reads add that column to carry the source stream name"
+        )
     if schema is not None:
         fields: list[str] | None = schema.column_names()
         dtypes = {n: c.dtype for n, c in schema.columns().items()}

@@ -25,7 +25,13 @@ from ._interval_join import (
     interval_join_outer,
     interval_join_right,
 )
-from ._window_join import window_join
+from ._window_join import (
+    window_join,
+    window_join_inner,
+    window_join_left,
+    window_join_outer,
+    window_join_right,
+)
 from ._asof_join import Direction, asof_join, asof_join_left, asof_now_join
 from .temporal_behavior import (
     CommonBehavior,
@@ -48,6 +54,10 @@ __all__ = [
     "interval_join_right",
     "interval_join_outer",
     "window_join",
+    "window_join_inner",
+    "window_join_left",
+    "window_join_right",
+    "window_join_outer",
     "asof_join",
     "asof_join_left",
     "asof_now_join",

@@ -12,7 +12,10 @@ from ...internals.joins import JoinMode
 from ...internals.table import Table
 from ...internals.thisclass import left as pw_left, right as pw_right, substitute, this
 
-__all__ = ["window_join", "WindowJoinResult"]
+__all__ = [
+    "window_join", "window_join_inner", "window_join_left",
+    "window_join_right", "window_join_outer", "WindowJoinResult",
+]
 
 
 class WindowJoinResult:
@@ -34,9 +37,22 @@ class WindowJoinResult:
             le._pw_window_start == re_._pw_window_start,
             le._pw_window_end == re_._pw_window_end,
         ]
+        # conditions may reference pw.left/pw.right OR the original
+        # tables directly (reference t1.k == t2.k style)
+        if self._left is self._right and self._on:
+            # a self-join collapses both table keys to one mapping entry,
+            # which would silently rewrite every condition to one side
+            raise ValueError(
+                "window self-join conditions must use pw.left/pw.right "
+                "(direct table references are ambiguous)"
+            )
+        cond_map = {
+            pw_left: le, pw_right: re_,
+            self._left: le, self._right: re_,
+        }
         for cond in self._on:
-            lexpr = substitute(cond._left, {pw_left: le, pw_right: re_})
-            rexpr = substitute(cond._right, {pw_left: le, pw_right: re_})
+            lexpr = substitute(cond._left, cond_map)
+            rexpr = substitute(cond._right, cond_map)
             conditions.append(lexpr == rexpr)
         jr = {
             JoinMode.INNER: le.join,
@@ -82,3 +98,23 @@ def window_join(
     *on: Any, how: JoinMode = JoinMode.INNER,
 ) -> WindowJoinResult:
     return WindowJoinResult(self, other, self_time, other_time, window, on, how)
+
+
+def window_join_inner(self, other, self_time, other_time, window, *on):
+    return window_join(self, other, self_time, other_time, window, *on,
+                       how=JoinMode.INNER)
+
+
+def window_join_left(self, other, self_time, other_time, window, *on):
+    return window_join(self, other, self_time, other_time, window, *on,
+                       how=JoinMode.LEFT)
+
+
+def window_join_right(self, other, self_time, other_time, window, *on):
+    return window_join(self, other, self_time, other_time, window, *on,
+                       how=JoinMode.RIGHT)
+
+
+def window_join_outer(self, other, self_time, other_time, window, *on):
+    return window_join(self, other, self_time, other_time, window, *on,
+                       how=JoinMode.OUTER)

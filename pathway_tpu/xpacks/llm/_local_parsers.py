@@ -96,12 +96,31 @@ def _pdf_unescape(s: bytes) -> str:
 
 
 _TEXT_OP_RE = re.compile(
-    rb"(\((?:[^()\\]|\\.)*\))\s*(Tj|')"  # (string) Tj / '
-    rb"|(<[0-9A-Fa-f\s]*>)\s*(Tj|')"  # <hex> Tj
-    rb"|(\[(?:[^\]\\]|\\.)*\])\s*TJ"  # [(a) -120 (b)] TJ
+    rb"(\((?:[^()\\]|\\.)*\))\s*(Tj|'|\")"  # (string) Tj / ' / "
+    rb"|(<[0-9A-Fa-f\s]*>)\s*(Tj|'|\")"  # <hex> Tj / ' / "
+    rb"|(\[(?:[^\]\\]|\\.)*\])\s*TJ"  # [(a) -120 (b) <hex>] TJ
     rb"|(T\*|TD|Td|BT|ET)"  # line/positioning breaks
 )
-_INNER_STR_RE = re.compile(rb"\((?:[^()\\]|\\.)*\)")
+#: strings inside a TJ array — paren or hex form
+_INNER_STR_RE = re.compile(
+    rb"\((?:[^()\\]|\\.)*\)|<[0-9A-Fa-f\s]+>"
+)
+
+
+def _decode_hex_string(hexbody: bytes) -> str:
+    hexstr = re.sub(rb"\s", b"", hexbody)
+    if len(hexstr) % 2:
+        hexstr += b"0"
+    try:
+        raw = bytes.fromhex(hexstr.decode())
+    except ValueError:
+        return ""
+    # UTF-16BE when BOM'd (common for CID fonts), else latin
+    return (
+        raw.decode("utf-16-be", errors="replace")
+        if raw[:2] == b"\xfe\xff"
+        else raw.decode("latin-1")
+    )
 
 
 def pdf_extract_text(data: bytes) -> str:
@@ -114,22 +133,14 @@ def pdf_extract_text(data: bytes) -> str:
             if m.group(1) is not None:
                 parts.append(_pdf_unescape(m.group(1)[1:-1]))
             elif m.group(3) is not None:
-                hexstr = re.sub(rb"\s", b"", m.group(3)[1:-1])
-                if len(hexstr) % 2:
-                    hexstr += b"0"
-                try:
-                    raw = bytes.fromhex(hexstr.decode())
-                    # UTF-16BE when BOM'd (common for CID fonts), else latin
-                    parts.append(
-                        raw.decode("utf-16-be")
-                        if raw[:2] == b"\xfe\xff"
-                        else raw.decode("latin-1")
-                    )
-                except ValueError:
-                    pass
+                parts.append(_decode_hex_string(m.group(3)[1:-1]))
             elif m.group(5) is not None:
                 for sm in _INNER_STR_RE.finditer(m.group(5)):
-                    parts.append(_pdf_unescape(sm.group(0)[1:-1]))
+                    tok = sm.group(0)
+                    if tok[:1] == b"(":
+                        parts.append(_pdf_unescape(tok[1:-1]))
+                    else:
+                        parts.append(_decode_hex_string(tok[1:-1]))
             else:
                 op = m.group(6)
                 if op in (b"T*", b"TD", b"Td", b"ET") and parts and not (

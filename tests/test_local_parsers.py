@@ -158,3 +158,15 @@ def test_parse_local_mixed_document_store():
     ].tolist()
     assert row[0]["metadata"]["path"] == "report.pdf"
     assert "revenue" in row[0]["text"]
+
+
+def test_pdf_double_quote_and_hex_in_tj():
+    # the " show-text operator and <hex> entries inside TJ arrays
+    content = b'BT (first) " [(a) -10 <20> (b)] TJ ET'
+    pdf = (
+        b"%PDF-1.4\n4 0 obj << /Length " + str(len(content)).encode()
+        + b" >> stream\n" + content + b"\nendstream endobj\n%%EOF"
+    )
+    text = LP.pdf_extract_text(pdf)
+    assert "first" in text
+    assert "a b" in text  # <20> decodes to a space between a and b

@@ -1,0 +1,274 @@
+"""Ported from the reference's asof-join and window-join suites.
+
+Sources: ``/root/reference/python/pathway/tests/temporal/test_asof_joins.py``
+and ``.../test_window_joins.py`` (VERDICT r4 item 7). Porting contract as in
+``tests/test_ported_common_1.py``; manifest in ``PORTED_TESTS.md``.
+Reference expected tables are re-expressed as (key, left value, right value)
+triples selected through ``pw.left`` / ``pw.right`` — this framework's
+AsofJoinResult does not expose the reference's synthesized ``pw.this.t`` /
+``pw.this.instance`` columns (idiom delta recorded in the manifest).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.temporal._asof_join import Direction
+from pathway_tpu.testing import T
+
+
+def _t1():
+    return T(
+        """
+            | K | val |  t
+        1   | 0 | 1   |  1
+        2   | 0 | 2   |  4
+        3   | 0 | 3   |  5
+        4   | 0 | 4   |  6
+        5   | 0 | 5   |  7
+        6   | 0 | 6   |  11
+        7   | 0 | 7   |  12
+        8   | 1 | 8   |  5
+        9   | 1 | 9   |  7
+        """
+    )
+
+
+def _t2():
+    return T(
+        """
+             | K | val | t
+        21   | 1 | 7  | 2
+        22   | 1 | 3  | 8
+        23   | 0 | 0  | 2
+        24   | 0 | 6  | 3
+        25   | 0 | 2  | 7
+        26   | 0 | 3  | 8
+        27   | 0 | 9  | 9
+        28   | 0 | 7  | 13
+        29   | 0 | 4  | 14
+        """
+    )
+
+
+def _triples(res, cols=("k", "t", "v")):
+    df = pw.debug.table_to_pandas(res)
+    return sorted(map(tuple, df[list(cols)].values.tolist()))
+
+
+def test_asof_left():  # ref :17 (expected table re-keyed by K, 2t)
+    res = _t1().asof_join(
+        _t2(),
+        pw.left.t * 2,
+        pw.right.t * 2,
+        pw.left.K == pw.right.K,
+        how=pw.JoinMode.LEFT,
+        defaults={_t2().val: -1},
+    ).select(
+        k=pw.left.K,
+        t=pw.left.t * 2,
+        v=pw.coalesce(pw.right.val, -1),
+    )
+    # reference expected: (instance, t, val_right) rows at :60-73
+    assert _triples(res) == sorted([
+        (0, 2, -1), (0, 8, 6), (0, 10, 6), (0, 12, 6), (0, 14, 2),
+        (0, 22, 9), (0, 24, 9), (1, 10, 7), (1, 14, 7),
+    ])
+
+
+def test_asof_left_forward():  # ref :153
+    res = _t1().asof_join(
+        _t2(),
+        pw.left.t * 2,
+        pw.right.t * 2,
+        pw.left.K == pw.right.K,
+        how=pw.JoinMode.LEFT,
+        direction=Direction.FORWARD,
+        defaults={_t2().val: 100},
+    ).select(
+        k=pw.left.K,
+        t=pw.left.t * 2,
+        v=pw.coalesce(pw.right.val, 100),
+    )
+    # reference expected at :200-212 (without the t=40 row — _t1 here has
+    # no K=1,t=20 row; that row exists only in the forward variant's input)
+    assert _triples(res) == sorted([
+        (0, 2, 0), (0, 8, 2), (0, 10, 2), (0, 12, 2), (0, 14, 2),
+        (0, 22, 7), (0, 24, 7), (1, 10, 3), (1, 14, 3),
+    ])
+
+
+def test_asof_left_nearest():  # ref :218
+    res = _t1().asof_join(
+        _t2(),
+        pw.left.t,
+        pw.right.t,
+        pw.left.K == pw.right.K,
+        how=pw.JoinMode.LEFT,
+        direction=Direction.NEAREST,
+    ).select(k=pw.left.K, t=pw.left.t, v=pw.right.val)
+    got = {(k, t): v for k, t, v in _triples(res)}
+    # nearest by |t_l - t_r| per K: spot-check the reference's semantics
+    assert got[(0, 1)] == 0  # t=1: nearest right is t=2 (val 0)
+    assert got[(0, 7)] == 2  # exact match t=7 (val 2)
+    assert got[(0, 12)] == 7  # t=12: nearest is t=13 (val 7)
+    assert got[(1, 7)] == 3  # K=1 t=7: nearest of {2,8} is 8 (val 3)
+
+
+def test_asof_multiple_keys():  # ref :267
+    t1 = T(
+        """
+          | K | L | v | t
+        1 | 0 | a | 1 | 3
+        2 | 0 | b | 2 | 3
+        3 | 1 | a | 3 | 3
+        """
+    )
+    t2 = T(
+        """
+           | K | L | w | t
+        11 | 0 | a | 7 | 1
+        12 | 0 | b | 8 | 2
+        13 | 1 | a | 9 | 2
+        14 | 0 | a | 5 | 9
+        """
+    )
+    res = t1.asof_join(
+        t2, pw.left.t, pw.right.t,
+        pw.left.K == pw.right.K, pw.left.L == pw.right.L,
+        how=pw.JoinMode.LEFT,
+    ).select(k=pw.left.K, t=pw.left.v, v=pw.right.w)
+    assert _triples(res) == sorted([(0, 1, 7), (0, 2, 8), (1, 3, 9)])
+
+
+def test_asof_join_eq_direction():  # ref :616 (BACKWARD includes equal t)
+    t1 = T(
+        """
+          | v | t
+        1 | 1 | 5
+        """
+    )
+    t2 = T(
+        """
+           | w | t
+        11 | 9 | 5
+        """
+    )
+    res = t1.asof_join(
+        t2, pw.left.t, pw.right.t, how=pw.JoinMode.LEFT
+    ).select(k=0, t=pw.left.t, v=pw.right.w)
+    assert _triples(res) == [(0, 5, 9)]
+
+
+# -- window joins (test_window_joins.py) -------------------------------------
+
+
+def test_window_join_tumbling_1():  # ref :25, tumbling(1), INNER
+    t1 = T(
+        """
+          | a | t
+        0 | 1 | -2
+        1 | 2 | 1
+        2 | 3 | 2
+        3 | 4 | 3
+        4 | 5 | 7
+        5 | 6 | 13
+        """
+    )
+    t2 = T(
+        """
+          | b | t
+        0 | 1 | 2
+        1 | 2 | 5
+        2 | 3 | 6
+        3 | 4 | 7
+        4 | 5 | 14
+        """
+    )
+    res = t1.window_join(
+        t2, t1.t, t2.t, pw.temporal.tumbling(1)
+    ).select(a=pw.left.a, b=pw.right.b)
+    df = pw.debug.table_to_pandas(res)
+    got = sorted(map(tuple, df[["a", "b"]].values.tolist()))
+    assert got == sorted([(3, 1), (5, 4)])
+
+
+def test_window_join_tumbling_2():  # ref :25, tumbling(2), INNER
+    t1 = T(
+        """
+          | a | t
+        0 | 1 | -2
+        1 | 2 | 1
+        2 | 3 | 2
+        3 | 4 | 3
+        4 | 5 | 7
+        5 | 6 | 13
+        """
+    )
+    t2 = T(
+        """
+          | b | t
+        0 | 1 | 2
+        1 | 2 | 5
+        2 | 3 | 6
+        3 | 4 | 7
+        4 | 5 | 14
+        """
+    )
+    res = t1.window_join(
+        t2, t1.t, t2.t, pw.temporal.tumbling(2)
+    ).select(a=pw.left.a, b=pw.right.b)
+    df = pw.debug.table_to_pandas(res)
+    got = sorted(map(tuple, df[["a", "b"]].values.tolist()))
+    assert got == sorted([(3, 1), (4, 1), (5, 3), (5, 4)])
+
+
+def test_window_join_sharded():  # ref :177 (on= equality condition)
+    t1 = T(
+        """
+          | k | a | t
+        0 | 0 | 1 | 1
+        1 | 0 | 2 | 5
+        2 | 1 | 3 | 1
+        """
+    )
+    t2 = T(
+        """
+          | k | b | t
+        0 | 0 | 7 | 1
+        1 | 1 | 8 | 1
+        2 | 1 | 9 | 5
+        """
+    )
+    res = t1.window_join(
+        t2, t1.t, t2.t, pw.temporal.tumbling(2), t1.k == t2.k
+    ).select(k=pw.left.k, a=pw.left.a, b=pw.right.b)
+    df = pw.debug.table_to_pandas(res)
+    got = sorted(map(tuple, df[["k", "a", "b"]].values.tolist()))
+    assert got == sorted([(0, 1, 7), (1, 3, 8)])
+
+
+def test_window_join_left_pads():  # ref :25 LEFT branch shape
+    t1 = T(
+        """
+          | a | t
+        0 | 1 | 0
+        1 | 2 | 10
+        """
+    )
+    t2 = T(
+        """
+          | b | t
+        0 | 5 | 0
+        """
+    )
+    res = t1.window_join_left(
+        t2, t1.t, t2.t, pw.temporal.tumbling(2)
+    ).select(a=pw.left.a, b=pw.right.b)
+    df = pw.debug.table_to_pandas(res)
+    got = sorted(
+        (int(a), None if b is None or b != b else int(b))
+        for a, b in df[["a", "b"]].values.tolist()
+    )
+    assert got == [(1, 5), (2, None)]
