@@ -203,8 +203,13 @@ def hash_values(rows: Iterable[tuple], salt: int = 0) -> KeyArray:
 
 def pointer_from_ints(vals: np.ndarray) -> KeyArray:
     """Deterministic pointer from user-provided integer ids
-    (reference: unsafe_trusted_ids / ``Key::for_value``)."""
-    return _splitmix(np.asarray(vals, dtype=np.int64).view(np.uint64) ^ np.uint64(0x1D))
+    (reference: unsafe_trusted_ids / ``Key::for_value``). MUST agree with
+    ``mix_columns`` over a single int column: the reference keys explicit
+    markdown indices through the same value hash as ``pointer_from``, so
+    ``t.ix(other.pointer_from(n))`` reaches the row indexed ``n``
+    (test_common.py:817)."""
+    arr = np.asarray(vals, dtype=np.int64)
+    return mix_columns([arr], len(arr))
 
 
 def derive(keys: KeyArray, salt: int) -> KeyArray:

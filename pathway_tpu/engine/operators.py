@@ -232,7 +232,20 @@ class Exchange(Node):
         if kind == "key":
             return d.keys
         if kind == "column":
-            return np.asarray(d.data[self._spec[1]], dtype=np.uint64)
+            col = np.asarray(d.data[self._spec[1]])
+            if col.dtype == object:
+                # optional pointer columns (ix optional / sort prev-next)
+                # may hold None: route them by a fixed sentinel — the
+                # downstream Join maps None to a never-matching key, so
+                # WHERE the row lands only needs to be deterministic
+                return np.array(
+                    [
+                        0xE707_0E0E_DEAD_0001 if v is None else int(v)
+                        for v in col
+                    ],
+                    dtype=np.uint64,
+                )
+            return col.astype(np.uint64, copy=False)
         if kind == "mix":
             cols = [np.asarray(d.data[c]) for c in self._spec[1]]
             return K.mix_columns(cols, len(d), salt=self._spec[2])
@@ -972,6 +985,34 @@ class Join(Node):
         ERROR_LOG.record("Error value in join key; row skipped", "join")
         return delta.take(np.flatnonzero(~m))
 
+    #: per-side sentinels for a None join key: a None key matches NOTHING
+    #: (SQL/reference semantics) — distinct sentinels per side prevent two
+    #: None keys from spuriously matching each other, while left/outer pad
+    #: emission still fires (the sentinel simply never finds a partner)
+    _NONE_JK = (
+        np.uint64(0xE707_0E0E_DEAD_0002),
+        np.uint64(0xE707_0E0E_DEAD_0003),
+    )
+
+    @classmethod
+    def _normalize_none_keys(
+        cls, delta: Delta | None, jk_col: str | None, side: int
+    ):
+        """Object-dtype join-key columns (optional pointers from
+        ``ix(optional=True)`` / sort prev-next chains) may hold None —
+        replace with the side sentinel and densify to uint64 so the join
+        paths never cast None."""
+        if delta is None or jk_col is None or not len(delta):
+            return delta
+        col = np.asarray(delta.data[jk_col])
+        if col.dtype != object:
+            return delta
+        out = np.empty(len(col), dtype=np.uint64)
+        sent = cls._NONE_JK[side]
+        for i, v in enumerate(col):
+            out[i] = sent if v is None else np.uint64(v)
+        return delta.replace_data({**delta.data, jk_col: out})
+
     @staticmethod
     def _rows_of(delta: Delta | None, jk_col: str | None, cols: list[str]):
         """Yield (jk, row_key, row_values, diff) for a delta. jk_col=None
@@ -1114,8 +1155,10 @@ class Join(Node):
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         ins = [
-            self._drop_error_keys(d, jk)
-            for d, jk in zip(ins, (self._ljk, self._rjk))
+            self._normalize_none_keys(
+                self._drop_error_keys(d, jk), jk, side
+            )
+            for side, (d, jk) in enumerate(zip(ins, (self._ljk, self._rjk)))
         ]
         if self._columnar:
             return self._process_columnar(ins)
@@ -1420,9 +1463,17 @@ class Flatten(Node):
         arrs = [d.data[c] for c in names]
         for i in range(len(d)):
             value = arrs[flat_ix][i]
-            if value is None or isinstance(value, EngineError) or not hasattr(
-                value, "__iter__"
-            ):
+            items = None
+            if value is not None and not isinstance(value, EngineError):
+                try:
+                    # listifying (not hasattr __iter__) also catches
+                    # wrappers whose __iter__ fails at runtime, e.g. a
+                    # scalar pw.Json — Json.__iter__ exists but iter(42)
+                    # inside it raises
+                    items = list(value)
+                except TypeError:
+                    items = None
+            if items is None:
                 # a row whose flatten column holds Error/None/any
                 # non-iterable cannot explode; log and skip instead of
                 # crashing the run (reference flatten error-row semantics)
@@ -1433,7 +1484,7 @@ class Flatten(Node):
                 continue
             base = tuple(a[i] for a in arrs)
             parent = np.array([d.keys[i]], dtype=np.uint64)
-            for pos, item in enumerate(value):
+            for pos, item in enumerate(items):
                 keys_out.append(int(K.derive(parent, pos * 2 + 0x7)[0]))
                 rows_out.append(base[:flat_ix] + (item,) + base[flat_ix + 1 :])
                 diffs_out.append(int(d.diffs[i]))

@@ -34,10 +34,17 @@ def _paths_of(path: str | os.PathLike) -> list[str]:
     return matched if matched else [path]
 
 
-def _convert(value: str, dtype: dt.DType) -> Any:
+def _convert(value: str, col: Any) -> Any:
+    dtype = col.dtype if hasattr(col, "dtype") else col
     u = dt.unoptionalize(dtype)
-    if value == "" and dtype.is_optional:
-        return None
+    if value == "":
+        # an empty cell takes the schema default when one is declared
+        # (reference test_io.py:458 test_csv_default_values), else None
+        # for optional columns
+        if getattr(col, "has_default", False):
+            return col.default_value
+        if dtype.is_optional:
+            return None
     if u == dt.INT:
         return int(value)
     if u == dt.FLOAT:
@@ -124,7 +131,7 @@ class FsStreamSource(RealtimeSource):
             rec = dict(zip(header, next(_csv.reader([line], delimiter=self.delimiter))))
             if self.fschema is not None:
                 return tuple(
-                    _convert(rec.get(n, ""), self.fschema.columns()[n].dtype)
+                    _convert(rec.get(n, ""), self.fschema.columns()[n])
                     for n in self.names
                 )
             return tuple(_auto(rec.get(n, "")) for n in self.names)
@@ -253,6 +260,8 @@ def read(
     name: str | None = None,
     **kwargs: Any,
 ) -> Table:
+    if format == "raw":
+        format = "binary"  # reference alias (io/fs raw == whole-file bytes)
     if (
         mode == "streaming"
         and with_metadata
@@ -329,7 +338,7 @@ def read(
                 for rec in reader:
                     if schema is not None:
                         rows.append(tuple(
-                            _convert(rec[n], schema.columns()[n].dtype) for n in names
+                            _convert(rec[n], schema.columns()[n]) for n in names
                         ))
                     else:
                         rows.append(tuple(_auto(rec[n]) for n in names))

@@ -18,15 +18,31 @@ __all__ = [
 ]
 
 
+def _refs_table(expr: Any, table: Table) -> bool:
+    """True when the expression contains a direct ColumnReference to the
+    given concrete table (pw.left/pw.right placeholders do not count)."""
+    if isinstance(expr, ColumnReference):
+        return expr.table is table
+    return any(
+        _refs_table(d, table) for d in getattr(expr, "_deps", ())
+    )
+
+
 class WindowJoinResult:
     def __init__(self, left_t, right_t, left_time, right_time, window, on, mode):
         self._left = left_t
         self._right = right_t
         self._lexp = window._assign(
-            left_t, substitute(smart_coerce(left_time), {this: left_t}), None, None
+            left_t,
+            substitute(smart_coerce(left_time), {this: left_t, pw_left: left_t}),
+            None, None,
         )
         self._rexp = window._assign(
-            right_t, substitute(smart_coerce(right_time), {this: right_t}), None, None
+            right_t,
+            substitute(
+                smart_coerce(right_time), {this: right_t, pw_right: right_t}
+            ),
+            None, None,
         )
         self._on = on
         self._mode = mode
@@ -39,9 +55,12 @@ class WindowJoinResult:
         ]
         # conditions may reference pw.left/pw.right OR the original
         # tables directly (reference t1.k == t2.k style)
-        if self._left is self._right and self._on:
+        if self._left is self._right and any(
+            _refs_table(c, self._left) for c in self._on
+        ):
             # a self-join collapses both table keys to one mapping entry,
-            # which would silently rewrite every condition to one side
+            # which would silently rewrite a direct reference to one side;
+            # pw.left/pw.right conditions stay unambiguous and allowed
             raise ValueError(
                 "window self-join conditions must use pw.left/pw.right "
                 "(direct table references are ambiguous)"

@@ -1,0 +1,329 @@
+"""Ported from the reference's behavioral spec: iterate fixpoints, sort /
+prev-next pointers, groupby instance, concat_unsafe collision, update_cells
+edge cases.
+
+Source: ``/root/reference/python/pathway/tests/test_common.py`` (third
+block; porting contract as in ``tests/test_ported_common_1.py``; manifest
+in ``PORTED_TESTS.md``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.testing import (
+    T,
+    assert_table_equality,
+    assert_table_equality_wo_index,
+)
+
+
+# -- iterate (test_common.py:1442-1658) --------------------------------------
+
+
+def test_column_fixpoint():  # ref :1442 (collatz)
+    def collatz_transformer(iterated):
+        @pw.udf(deterministic=True)
+        def collatz_step(x: float) -> float:
+            if x == 1:
+                return 1
+            elif x % 2 == 0:
+                return x / 2
+            else:
+                return 3 * x + 1
+
+        return iterated.select(val=collatz_step(iterated.val))
+
+    ret = pw.iterate(
+        collatz_transformer,
+        iterated=pw.debug.table_from_pandas(
+            pd.DataFrame(
+                index=range(1, 101), data={"val": np.arange(1.0, 101.0)}
+            )
+        ),
+    )
+    expected = pw.debug.table_from_pandas(
+        pd.DataFrame(index=range(1, 101), data={"val": 1.0})
+    )
+    assert_table_equality(ret, expected)
+
+
+def test_rows_fixpoint():  # ref :1468 (shrinking universe to empty)
+    def min_id_remove(iterated: pw.Table):
+        min_id_table = iterated.reduce(min_id=pw.reducers.min(iterated.id))
+        return iterated.filter(iterated.id != min_id_table.ix_ref().min_id)
+
+    ret = pw.iterate(
+        min_id_remove,
+        iterated=pw.iterate_universe(
+            T(
+                """
+                    | foo
+                1   | 1
+                2   | 2
+                3   | 3
+                4   | 4
+                5   | 5
+                """
+            )
+        ),
+    )
+    assert len(pw.debug.table_to_pandas(ret)) == 0
+
+
+def test_iteration_column_order():  # ref :1522
+    def iteration_step(iterated):
+        return iterated.select(
+            bar=iterated.bar, foo=iterated.foo - iterated.foo
+        )
+
+    ret = pw.iterate(
+        iteration_step,
+        iterated=T(
+            """
+            foo | bar
+            1   | 2
+            """
+        ),
+    )
+    assert_table_equality_wo_index(
+        ret,
+        T(
+            """
+            bar | foo
+            2   | 0
+            """
+        ),
+    )
+
+
+def test_iterate_with_limit():  # ref :1571
+    def double(t):
+        return t.select(a=t.a * 2)
+
+    ret = pw.iterate(double, iteration_limit=3, t=T("a\n1"))
+    assert pw.debug.table_to_pandas(ret)["a"].tolist() == [8]
+
+
+def test_iterate_with_wrong_limit():  # ref :1552
+    def double(t):
+        return t.select(a=t.a * 2)
+
+    for limit in (0, -1):
+        with pytest.raises(ValueError):
+            pw.iterate(double, iteration_limit=limit, t=T("a\n1"))
+
+
+# -- sort / prev-next (test_common.py:2579-2634) -----------------------------
+
+
+def test_ix_sort_1():  # ref :2579
+    data = T(
+        """
+        a | t
+        0 | 1
+        0 | 2
+        0 | 3
+        1 | 1
+        1 | 2
+        """
+    )
+    data_prev_next = data.sort(key=pw.this.t, instance=pw.this.a)
+    data_prev = data.ix(data_prev_next.prev, optional=True)
+    data_next = data.ix(data_prev_next.next, optional=True)
+    result = data.select(
+        pw.this.a, pw.this.t, prev_t=data_prev.t, next_t=data_next.t
+    )
+    df = pw.debug.table_to_pandas(result)
+
+    def norm(v):
+        return None if v is None or v != v else int(v)
+
+    got = sorted(
+        (int(a), int(t), norm(p), norm(n))
+        for a, t, p, n in df[["a", "t", "prev_t", "next_t"]].values.tolist()
+    )
+    assert got == sorted([
+        (0, 1, None, 2), (0, 2, 1, 3), (0, 3, 2, None),
+        (1, 1, None, 2), (1, 2, 1, None),
+    ])
+
+
+# -- groupby instance (test_common.py:3981) ----------------------------------
+
+
+def test_groupby_instance():  # ref :3981
+    t = T(
+        """
+        instance | k | v
+        0        | a | 1
+        0        | a | 2
+        0        | b | 3
+        1        | a | 4
+        1        | b | 5
+        """
+    )
+    res = t.groupby(pw.this.k, instance=pw.this.instance).reduce(
+        pw.this.k,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    df = pw.debug.table_to_pandas(res)
+    got = sorted(map(tuple, df[["k", "s"]].values.tolist()))
+    assert got == sorted([("a", 3), ("b", 3), ("a", 4), ("b", 5)])
+
+
+# -- concat_unsafe collision / update_cells edges (test_common.py:956, 3507) --
+
+
+def test_concat_unsafe_collision():  # ref :956
+    t1 = T(
+        """
+          | v
+        1 | a
+        """
+    )
+    t2 = T(
+        """
+          | v
+        1 | b
+        """
+    )
+    pw.universes.promise_are_pairwise_disjoint(t1, t2)  # untrue promise
+    res = pw.Table.concat(t1, t2)
+    with pytest.raises(Exception):
+        pw.debug.table_to_pandas(res)  # runtime key collision
+
+
+def test_update_cells_0_rows():  # ref :3507
+    old = T(
+        """
+          | a | b
+        1 | 1 | x
+        """
+    )
+    empty = old.filter(pw.this.a > 100).select(b=pw.this.b)
+    res = old.update_cells(empty)
+    assert_table_equality(
+        res,
+        T(
+            """
+              | a | b
+            1 | 1 | x
+            """
+        ),
+    )
+
+
+def test_update_rows_0_rows():  # ref :3707
+    old = T(
+        """
+          | a
+        1 | 1
+        """
+    )
+    empty = old.filter(pw.this.a > 100)
+    res = old.update_rows(empty)
+    assert_table_equality_wo_index(res, T("a\n1"))
+
+
+# -- select with ix args (test_common.py:817, :3873) --------------------------
+
+
+def test_select_column_ix_args():  # ref :817
+    t_animals = T(
+        """
+          | epithet    | genus
+        1 | upupa      | epops
+        2 | acherontia | atropos
+        3 | bubo       | scandiacus
+        """
+    )
+    t_birds = T(
+        """
+          | ptr
+        1 | 2
+        2 | 3
+        """
+    )
+    ret = t_birds.select(
+        latin=t_animals.ix(t_animals.pointer_from(t_birds.ptr)).genus
+    )
+    assert sorted(pw.debug.table_to_pandas(ret)["latin"].tolist()) == [
+        "atropos", "scandiacus",
+    ]
+
+
+# -- r4 review regressions ---------------------------------------------------
+
+
+def test_sorted_optional_ix_sharded(monkeypatch):
+    # None pointers must route through the sharded Exchange (the uint64
+    # cast used to crash at -t 4 before the Join ever saw the row)
+    monkeypatch.setenv("PATHWAY_THREADS", "4")
+    data = T(
+        """
+        a | t
+        0 | 1
+        0 | 2
+        1 | 5
+        """
+    )
+    pn = data.sort(key=pw.this.t, instance=pw.this.a)
+    prev = data.ix(pn.prev, optional=True)
+    out = data.select(pw.this.t, p=prev.t)
+    df = pw.debug.table_to_pandas(out)
+    vals = sorted(
+        (int(t), None if p is None or p != p else int(p))
+        for t, p in df[["t", "p"]].values.tolist()
+    )
+    assert vals == [(1, None), (2, 1), (5, None)]
+
+
+def test_window_self_join_via_copy():
+    # the reference refuses joining a table with itself (interval joins:
+    # test_errors_on_equal_tables); a COPY joins fine with direct-table
+    # conditions and the ambiguity guard must not fire
+    t = T(
+        """
+        k | t
+        0 | 1
+        0 | 2
+        1 | 1
+        """
+    )
+    t2 = t.copy()
+    res = t.window_join(
+        t2, t.t, t2.t, pw.temporal.tumbling(2), t.k == t2.k
+    ).select(a=pw.left.t, b=pw.right.t, k=pw.left.k)
+    df = pw.debug.table_to_pandas(res)
+    got = sorted(map(tuple, df[["k", "a", "b"]].values.tolist()))
+    assert (0, 1, 1) in got and (1, 1, 1) in got
+
+
+def test_window_true_self_join_with_direct_refs_refused():
+    t = T(
+        """
+        k | t
+        0 | 1
+        """
+    )
+    with pytest.raises(ValueError, match="pw.left/pw.right"):
+        t.window_join(
+            t, t.t, t.t, pw.temporal.tumbling(2), t.k == t.k
+        ).select(a=pw.left.t)
+
+
+def test_flatten_scalar_json_skipped():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(data=pw.Json),
+        [(pw.Json([1, 2]),), (pw.Json(42),)],
+    )
+    res = t.flatten(pw.this.data)
+    vals = sorted(
+        v.value if isinstance(v, pw.Json) else v
+        for v in pw.debug.table_to_pandas(res)["data"].tolist()
+    )
+    assert vals == [1, 2]  # the scalar row skipped with a logged error
