@@ -45,7 +45,7 @@ _KEYWORDS = {
     "select", "distinct", "from", "where", "group", "by", "having", "as",
     "join", "inner", "left", "right", "outer", "full", "on", "and", "or",
     "not", "is", "null", "in", "between", "like", "union", "all", "case",
-    "when", "then", "else", "end", "true", "false",
+    "when", "then", "else", "end", "true", "false", "with",
 }
 
 
@@ -103,8 +103,9 @@ class _Parser:
 
     # -- token helpers --
 
-    def peek(self) -> tuple[str, Any]:
-        return self.toks[self.i] if self.i < len(self.toks) else ("eof", None)
+    def peek(self, ahead: int = 0) -> tuple[str, Any]:
+        j = self.i + ahead
+        return self.toks[j] if j < len(self.toks) else ("eof", None)
 
     def next(self) -> tuple[str, Any]:
         t = self.peek()
@@ -136,13 +137,26 @@ class _Parser:
         return node
 
     def query(self) -> _Node:
-        """SELECT with optional UNION [ALL] chain (also the body of a
-        parenthesized derived table)."""
+        """[WITH ctes] SELECT with optional UNION [ALL] chain (also the
+        body of a parenthesized derived table)."""
+        ctes: list[tuple[str, _Node]] = []
+        if self.accept("kw", "with"):
+            while True:
+                name = self.expect("name")
+                self.expect("kw", "as")
+                self.expect("op", "(")
+                body = self.query()
+                self.expect("op", ")")
+                ctes.append((name, body))
+                if not self.accept("op", ","):
+                    break
         node = self.select()
         while self.accept("kw", "union"):
             all_ = self.accept("kw", "all")
             rhs = self.select()
             node = _Node("union", left=node, right=rhs, all=all_)
+        if ctes:
+            node = _Node("with", ctes=ctes, body=node)
         return node
 
     def select(self) -> _Node:
@@ -181,13 +195,22 @@ class _Parser:
         where = self.expr() if self.accept("kw", "where") else None
         group = None
         having = None
-        if self.accept("kw", "group"):
-            self.expect("kw", "by")
-            group = [self.expr()]
-            while self.accept("op", ","):
-                group.append(self.expr())
-            if self.accept("kw", "having"):
+        # the reference (via sqlglot) tolerates HAVING before GROUP BY —
+        # accept the clauses in either order, each at most once
+        while True:
+            if self.accept("kw", "group"):
+                if group is not None:
+                    raise SqlSyntaxError("duplicate GROUP BY clause")
+                self.expect("kw", "by")
+                group = [self.expr()]
+                while self.accept("op", ","):
+                    group.append(self.expr())
+            elif self.accept("kw", "having"):
+                if having is not None:
+                    raise SqlSyntaxError("duplicate HAVING clause")
                 having = self.expr()
+            else:
+                break
         return _Node(
             "select", items=items, table=table, joins=joins,
             where=where, group=group, having=having, distinct=distinct,
@@ -219,7 +242,16 @@ class _Parser:
 
     def select_item(self) -> _Node:
         if self.accept("op", "*"):
-            return _Node("star")
+            return _Node("star", table=None)
+        if (
+            self.peek()[0] == "name"
+            and self.peek(1) == ("op", ".")
+            and self.peek(2) == ("op", "*")
+        ):
+            tname = self.next()[1]
+            self.next()
+            self.next()
+            return _Node("star", table=tname)
         e = self.expr()
         alias = None
         if self.accept("kw", "as"):
@@ -384,6 +416,17 @@ class _Compiler:
         self.tables = tables
 
     def compile(self, node: _Node) -> Table:
+        if node["kind"] == "with":
+            # each CTE materializes into the table env for the WITH body
+            # only — restore afterwards so a CTE inside a subquery cannot
+            # shadow outer tables
+            saved = self.tables
+            try:
+                for name, body in node.ctes:
+                    self.tables = {**self.tables, name: self.compile(body)}
+                return self.compile(node.body)
+            finally:
+                self.tables = saved
         if node["kind"] == "union":
             left = self.compile(node.left)
             right = self.compile(node.right)
@@ -397,7 +440,9 @@ class _Compiler:
 
     def _resolve_source(self, sel: _Node) -> tuple[Table, dict[str, Table]]:
         """The working table + alias env. Joins compile to pw joins keeping
-        both sides' columns (qualified names disambiguated)."""
+        both sides' columns (qualified names disambiguated). Also records
+        ``self._alias_cols``: alias -> the names its columns carry in the
+        working table (for qualified ``alias.*`` expansion)."""
         def lookup(tref: _Node) -> Table:
             if tref["kind"] == "subquery":
                 return self.compile(tref["select"])  # handles UNION bodies
@@ -408,6 +453,7 @@ class _Compiler:
 
         base = lookup(sel.table)
         env: dict[str, Table] = {sel.table["alias"]: base}
+        self._alias_cols = {sel.table["alias"]: list(base.column_names())}
         current = base
         for join in sel.joins:
             right = lookup(join.table)
@@ -434,11 +480,15 @@ class _Compiler:
 
             for c in current.column_names():
                 out_cols[c] = getattr(l_, c)
+            right_names = []
             for c in right.column_names():
                 if c in out_cols:
                     out_cols[f"{alias}.{c}"] = getattr(r_, c)
+                    right_names.append(f"{alias}.{c}")
                 else:
                     out_cols[c] = getattr(r_, c)
+                    right_names.append(c)
+            self._alias_cols[alias] = right_names
             current = joined.select(**out_cols)
             env = {a: current for a in env}  # all aliases now view the join
         return current, env
@@ -646,13 +696,33 @@ class _Compiler:
         grouped = sel.group is not None or any(
             n["kind"] == "item" and _has_aggregate(n["expr"]) for n in sel["items"]
         )
+        if sel.having is not None and not grouped:
+            raise SqlSyntaxError(
+                "HAVING requires GROUP BY or aggregate select items"
+            )
 
         if not grouped:
             out_cols: dict[str, Any] = {}
             for i, item in enumerate(sel["items"]):
                 if item["kind"] == "star":
-                    for c in current.column_names():
-                        out_cols[c] = current[c]
+                    # `tab.*` expands only the named alias's columns (a
+                    # typo'd alias raises, like qualified column refs);
+                    # bare `*` expands the whole working table
+                    if item["table"] is not None:
+                        if item["table"] not in self._alias_cols:
+                            raise KeyError(
+                                f"unknown table alias {item['table']!r}"
+                            )
+                        for c in self._alias_cols[item["table"]]:
+                            out = (
+                                c.split(".", 1)[1]
+                                if c.startswith(item["table"] + ".")
+                                else c
+                            )
+                            out_cols[out] = current[c]
+                    else:
+                        for c in current.column_names():
+                            out_cols[c] = current[c]
                     continue
                 name = item["alias"] or _default_name(item["expr"], i)
                 out_cols[name] = self._expr(item["expr"], env)

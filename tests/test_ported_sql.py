@@ -1,0 +1,311 @@
+"""Ported from the reference's pw.sql suite.
+
+Source: ``/root/reference/python/pathway/tests/test_sql.py`` (VERDICT r4
+item 7). Porting contract as in ``tests/test_ported_common_1.py``;
+manifest in ``PORTED_TESTS.md``. The reference parses via sqlglot; this
+framework uses its own recursive-descent parser (``internals/sql.py``) —
+these cases pin the shared dialect surface.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.testing import (
+    T,
+    assert_table_equality,
+    assert_table_equality_wo_index,
+)
+
+
+def _tab():
+    return T(
+        """
+        a | b
+        2 | 3
+        5 | 6
+        """
+    )
+
+
+def test_select_1():  # ref :9
+    tab = _tab()
+    assert_table_equality(
+        pw.sql("SELECT a FROM tab", tab=tab), tab.select(tab.a)
+    )
+
+
+def test_select_2():  # ref :21
+    tab = _tab()
+    assert_table_equality(
+        pw.sql("SELECT a, b, 1 as c, a+b+1 as d FROM tab", tab=tab),
+        tab.select(tab.a, tab.b, c=1, d=tab.a + tab.b + 1),
+    )
+
+
+def test_where():  # ref :35
+    tab = T(
+        """
+        a | b
+        1 | 3
+        2 | 4
+        5 | 2
+        """
+    )
+    assert_table_equality(
+        pw.sql("SELECT a, b FROM tab WHERE a>b", tab=tab),
+        tab.filter(pw.this.a > pw.this.b),
+    )
+    assert_table_equality(
+        pw.sql("SELECT a, b FROM tab WHERE NOT (a>b)", tab=tab),
+        tab.filter(~(pw.this.a > pw.this.b)),
+    )
+
+
+def test_star():  # ref :54
+    tab = _tab()
+    assert_table_equality(pw.sql("SELECT * FROM tab", tab=tab), tab)
+
+
+def test_tab_star():  # ref :68
+    tab = _tab()
+    assert_table_equality(pw.sql("SELECT tab.* FROM tab", tab=tab), tab)
+
+
+def test_with():  # ref :82
+    tab = _tab()
+    assert_table_equality(
+        pw.sql(
+            "WITH foo AS (SELECT a+1 AS a, b+1 AS b FROM tab) "
+            "SELECT a+1 AS a, b+1 AS b FROM foo",
+            tab=tab,
+        ),
+        tab.select(a=tab.a + 2, b=tab.b + 2),
+    )
+
+
+def test_dot():  # ref :99
+    tab = _tab()
+    assert_table_equality(
+        pw.sql("SELECT tab.a FROM tab", tab=tab), tab.select(tab.a)
+    )
+
+
+def test_groupby():  # ref :116
+    tab = T(
+        """
+        a | b
+        x | 5
+        x | 6
+        y | 7
+        y | 8
+        """
+    )
+    assert_table_equality_wo_index(
+        pw.sql(
+            "SELECT a, SUM(b) as col1, COUNT(*) as col2 FROM tab GROUP BY a",
+            tab=tab,
+        ),
+        T(
+            """
+            a | col1 | col2
+            x | 11   | 2
+            y | 15   | 2
+            """
+        ),
+    )
+
+
+def test_where_groupby():  # ref :141
+    tab = T(
+        """
+        a | b
+        x | 5
+        x | 6
+        y | 7
+        y | 8
+        z | 9
+        z | 10
+        """
+    )
+    assert_table_equality_wo_index(
+        pw.sql(
+            "SELECT a, SUM(b) as col1, COUNT(*) as col2 FROM tab "
+            "WHERE b<9 GROUP BY a",
+            tab=tab,
+        ),
+        T(
+            """
+            a | col1 | col2
+            x | 11   | 2
+            y | 15   | 2
+            """
+        ),
+    )
+
+
+def test_having():  # ref :168
+    tab = T(
+        """
+        a | b
+        x | 5
+        x | 6
+        y | 7
+        y | 8
+        z | 9
+        z | 10
+        z | 11
+        """
+    )
+    assert_table_equality_wo_index(
+        pw.sql(
+            "SELECT a, SUM(b) as col1, COUNT(*) as col2 FROM tab "
+            "HAVING COUNT(*)<3 GROUP BY a",
+            tab=tab,
+        ),
+        T(
+            """
+            a | col1 | col2
+            x | 11   | 2
+            y | 15   | 2
+            """
+        ),
+    )
+
+
+def test_table_alias():  # ref :252
+    tab = _tab()
+    assert_table_equality(
+        pw.sql("SELECT t.a FROM tab AS t", tab=tab), tab.select(tab.a)
+    )
+
+
+def test_nested():  # ref :267
+    tab = _tab()
+    assert_table_equality(
+        pw.sql(
+            "SELECT a FROM (SELECT a, b FROM tab WHERE a > 3)",
+            tab=tab,
+        ),
+        tab.filter(pw.this.a > 3).select(pw.this.a),
+    )
+
+
+def test_explicit_join():  # ref :427
+    t1 = T(
+        """
+          | k | x
+        1 | 1 | a
+        2 | 2 | b
+        """
+    )
+    t2 = T(
+        """
+           | k | y
+        11 | 1 | p
+        12 | 3 | q
+        """
+    )
+    res = pw.sql(
+        "SELECT t1.x, t2.y FROM t1 JOIN t2 ON t1.k = t2.k",
+        t1=t1, t2=t2,
+    )
+    df = pw.debug.table_to_pandas(res)
+    assert sorted(map(tuple, df[["x", "y"]].values.tolist())) == [("a", "p")]
+
+
+def test_union():  # ref :510
+    t1 = T(
+        """
+          | a
+        1 | 1
+        """
+    )
+    t2 = T(
+        """
+          | a
+        2 | 2
+        """
+    )
+    res = pw.sql("SELECT a FROM t1 UNION ALL SELECT a FROM t2", t1=t1, t2=t2)
+    assert sorted(pw.debug.table_to_pandas(res)["a"].tolist()) == [1, 2]
+
+
+def test_case():  # ref :648
+    tab = T(
+        """
+        a
+        1
+        5
+        """
+    )
+    res = pw.sql(
+        "SELECT a, CASE WHEN a > 3 THEN 1 ELSE 0 END AS big FROM tab",
+        tab=tab,
+    )
+    df = pw.debug.table_to_pandas(res)
+    assert sorted(map(tuple, df[["a", "big"]].values.tolist())) == [
+        (1, 0), (5, 1),
+    ]
+
+
+# -- r4 review regressions ---------------------------------------------------
+
+
+def test_having_without_group_errors():
+    from pathway_tpu.internals.sql import SqlSyntaxError
+
+    with pytest.raises(SqlSyntaxError):
+        pw.sql("SELECT a FROM tab HAVING a > 1", tab=_tab())
+
+
+def test_duplicate_clause_errors():
+    from pathway_tpu.internals.sql import SqlSyntaxError
+
+    with pytest.raises(SqlSyntaxError):
+        pw.sql(
+            "SELECT a, COUNT(*) AS c FROM tab GROUP BY a HAVING COUNT(*)>0 "
+            "HAVING COUNT(*)>5",
+            tab=_tab(),
+        )
+
+
+def test_qualified_star_after_join_expands_one_side():
+    t1 = T(
+        """
+          | k | x
+        1 | 1 | a
+        """
+    )
+    t2 = T(
+        """
+           | k | y
+        11 | 1 | p
+        """
+    )
+    res = pw.sql(
+        "SELECT b.* FROM t1 AS a JOIN t2 AS b ON a.k = b.k", t1=t1, t2=t2
+    )
+    assert sorted(res.column_names()) == ["k", "y"]
+    with pytest.raises(KeyError):
+        pw.sql("SELECT bogus.* FROM t1", t1=t1)
+
+
+def test_cte_scope_does_not_leak():
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    # the subquery's CTE shadows `t` INSIDE the subquery only; the outer
+    # FROM t must still see the kwarg table
+    res = pw.sql(
+        "SELECT s.a AS sa, t.a AS ta FROM "
+        "(WITH t AS (SELECT a+10 AS a FROM t) SELECT a FROM t) s "
+        "JOIN t ON s.a = t.a + 10",
+        t=t,
+    )
+    df = pw.debug.table_to_pandas(res)
+    assert df[["sa", "ta"]].values.tolist() == [[11, 1]]
