@@ -262,6 +262,10 @@ class DataIndex:
             collapsed,
             (_id_of(query_table) == _id_of(collapsed),),
             JoinMode.LEFT,
+            # output rows keep the QUERY row ids (reference: a maintained
+            # query() result is keyed by its query table, so
+            # `queries + index.get_nearest_items(...)` zips directly)
+            id=_id_of(query_table),
         )
 
 

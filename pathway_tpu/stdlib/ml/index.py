@@ -76,6 +76,7 @@ class KNNIndex:
             ),
             collapse_rows,
             with_distances,
+            query_embedding,
         )
 
     def get_nearest_items_asof_now(
@@ -97,9 +98,11 @@ class KNNIndex:
             ),
             collapse_rows,
             with_distances,
+            query_embedding,
         )
 
-    def _package(self, join_result, collapse_rows: bool, with_distances: bool) -> Table:
+    def _package(self, join_result, collapse_rows: bool, with_distances: bool,
+                 query_embedding: ColumnReference | None = None) -> Table:
         from ...internals.thisclass import right as r_
         from ..indexing.data_index import _SCORE
 
@@ -118,4 +121,12 @@ class KNNIndex:
                     lambda s: -float(s) if s is not None else None,
                     dt.Optional(dt.FLOAT), getattr(r_, _SCORE),
                 )
-        return join_result.select(**cols)
+        res = join_result.select(**cols)
+        qt = getattr(query_embedding, "table", None)
+        if collapse_rows and isinstance(qt, Table):
+            # one result row per query row BY CONSTRUCTION (the index
+            # answers are re-keyed by query id) — declare the universes
+            # equal so `queries + result` zips without a user promise
+            # (reference get_nearest_items keeps the queries' universe)
+            res = res.promise_universe_is_equal_to(qt)
+        return res

@@ -1,0 +1,198 @@
+"""Ported from the reference's ml KNN-index suite.
+
+Source: ``/root/reference/python/pathway/tests/ml/test_index.py``
+(VERDICT r4 item 7). Porting contract as in
+``tests/test_ported_common_1.py``; manifest in ``PORTED_TESTS.md``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+
+def to_tuple_of_floats(inp: Iterable[Any]) -> tuple[float, ...]:
+    return tuple(float(x) for x in inp)
+
+
+def sort_arrays(arrays) -> list[tuple[float, ...]]:
+    if arrays is None:
+        return []
+    return sorted(tuple(np.asarray(a).tolist()) for a in arrays)
+
+
+def get_points() -> list[tuple[tuple[float, ...], bool]]:
+    points = [
+        (2, 2, 0), (3, -2, 0), (0, 0, 1), (-1, 0, 0), (2, -2, 1),
+        (1, 2, 0), (-1, 1, 1), (-3, 1, 0), (-2, -3, 1), (1, -4, 0),
+    ]
+    return [(p[:-1], p[-1] == 1) for p in points]
+
+
+def _tables():
+    data = get_points()
+    df = pd.DataFrame({
+        "coords": [to_tuple_of_floats(p[0]) for p in data],
+        "is_query": [p[1] for p in data],
+    })
+    table = pw.debug.table_from_pandas(df)
+    points = table.filter(~pw.this.is_query).without(pw.this.is_query)
+    queries = table.filter(pw.this.is_query).without(pw.this.is_query)
+    return points, queries
+
+
+EXPECTED = {
+    (0.0, 0.0): [(-1.0, 0.0), (1.0, 2.0)],
+    (2.0, -2.0): [(1.0, -4.0), (3.0, -2.0)],
+    (-1.0, 1.0): [(-3.0, 1.0), (-1.0, 0.0)],
+    (-2.0, -3.0): [(-1.0, 0.0), (1.0, -4.0)],
+}
+
+
+def _check(result, col="nn"):
+    df = pw.debug.table_to_pandas(result)
+    got = {
+        tuple(np.asarray(c).tolist()): sorted(
+            tuple(np.asarray(x).tolist()) for x in nn
+        )
+        for c, nn in df[["coords", col]].values.tolist()
+    }
+    assert got == {k: sorted(v) for k, v in EXPECTED.items()}, got
+
+
+def test_all_at_once():  # ref :121
+    points, queries = _tables()
+    index = KNNIndex(points.coords, points, n_dimensions=2)
+    result = queries + index.get_nearest_items(queries.coords, k=2).select(
+        nn=pw.apply(sort_arrays, pw.this.coords)
+    )
+    _check(result)
+
+
+def test_all_at_once_lsh():  # ref :121 (LshKnn branch)
+    # IDIOM DELTA (PORTED_TESTS.md): this LSH is random-hyperplane, not the
+    # reference's bucketed projections, so candidate SETS differ — assert
+    # approximation-shaped properties instead of exact neighbors (k results
+    # max, every result is a real point)
+    points, queries = _tables()
+    all_points = {to_tuple_of_floats(p[0]) for p in get_points() if not p[1]}
+    index = KNNIndex(points.coords, points, n_dimensions=2, n_and=5)
+    result = queries + index.get_nearest_items(queries.coords, k=2).select(
+        nn=pw.apply(sort_arrays, pw.this.coords)
+    )
+    df = pw.debug.table_to_pandas(result)
+    assert len(df) == 4
+    for _, row in df.iterrows():
+        nn = [tuple(np.asarray(x).tolist()) for x in row["nn"]]
+        assert len(nn) <= 2
+        assert set(nn) <= all_points
+
+
+def test_all_at_once_metadata_filter():  # ref :158
+    points, queries = _tables()
+    points = points.with_columns(
+        meta=pw.apply_with_type(
+            lambda c: {"x": float(np.asarray(c)[0])}, dict, pw.this.coords
+        )
+    )
+    index = KNNIndex(
+        points.coords, points, n_dimensions=2, metadata=points.meta
+    )
+    queries = queries.with_columns(flt="x < `0`")
+    result = queries + index.get_nearest_items(
+        queries.coords, k=2, metadata_filter=queries.flt
+    ).select(nn=pw.apply(sort_arrays, pw.this.coords))
+    df = pw.debug.table_to_pandas(result)
+    for coords, nn in df[["coords", "nn"]].values.tolist():
+        for n in nn:
+            assert float(np.asarray(n)[0]) < 0, (coords, nn)
+
+
+def test_update_old():  # ref :250 (index updates re-answer standing queries)
+    # maintained semantics: a better point arriving AFTER the query was
+    # answered must retract the old answer and emit the new one
+    from pathway_tpu.internals.parse_graph import G as _G
+
+    _G.clear()
+
+    class Points(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time as _t
+
+            self.next(x=2.0, y=2.0)
+            self.next(x=3.0, y=-2.0)
+            self.commit()
+            _t.sleep(0.1)
+            self.next(x=0.1, y=0.1)  # late, closer to the query point
+            self.commit()
+
+    pts = pw.io.python.read(
+        Points(), schema=pw.schema_from_types(x=float, y=float),
+        autocommit_duration_ms=None,
+    )
+    pts = pts.select(coords=pw.apply_with_type(
+        lambda x, y: (x, y), tuple, pw.this.x, pw.this.y
+    ))
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(qc=tuple), [((0.0, 0.0),)]
+    )
+    index = KNNIndex(pts.coords, pts, n_dimensions=2)
+    res = queries + index.get_nearest_items(queries.qc, k=1).select(
+        nn=pw.apply_with_type(
+            lambda c: tuple(np.asarray(c[0]).tolist()) if c else None,
+            tuple, pw.this.coords,
+        )
+    )
+    from collections import Counter
+
+    net: Counter = Counter()
+    history = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, is_addition: (
+            history.append((row["nn"], is_addition)),
+            net.update({row["nn"]: 1 if is_addition else -1}),
+        ),
+    )
+    pw.run()
+    final = {v for v, c in net.items() if c > 0}
+    # net state: only the late, closer point remains
+    assert final == {(0.1, 0.1)}, (final, history)
+    # and the earlier answer really was emitted then retracted
+    assert ((2.0, 2.0), True) in history and ((2.0, 2.0), False) in history
+
+
+def test_get_distances():  # ref :401
+    points, queries = _tables()
+    index = KNNIndex(points.coords, points, n_dimensions=2)
+    result = queries + index.get_nearest_items(
+        queries.coords, k=1, with_distances=True
+    ).select(dist=pw.this.dist)
+    df = pw.debug.table_to_pandas(result)
+    assert "dist" in df.columns
+    dists = {
+        tuple(np.asarray(c).tolist()): [float(x) for x in d]
+        for c, d in df[["coords", "dist"]].values.tolist()
+    }
+    # nearest neighbor of (0,0) is (-1,0) at squared distance 1 — the
+    # score negation must surface POSITIVE distances (reference :401)
+    assert dists[(0.0, 0.0)] == [1.0], dists
+    for d in dists.values():
+        assert len(d) == 1 and d[0] >= 0
+
+
+def test_no_match_is_empty_list():  # ref :752
+    points, queries = _tables()
+    points = points.filter(pw.this.coords != pw.this.coords)  # empty
+    index = KNNIndex(points.coords, points, n_dimensions=2)
+    result = index.get_nearest_items(queries.coords, k=2).select(
+        nn=pw.apply(sort_arrays, pw.this.coords)
+    )
+    for nn in pw.debug.table_to_pandas(result)["nn"].tolist():
+        assert list(nn) == []
