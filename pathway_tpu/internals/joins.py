@@ -131,6 +131,17 @@ class JoinResult:
             if not isinstance(id_expr, IdReference):
                 raise ValueError("join id= must be pw.left.id or pw.right.id")
             id_side = "left" if id_expr.table is self._left else "right"
+        # id=pw.left.id with a LEFT join emits exactly one row per left row
+        # under the reference's uniqueness contract ("result.id == left.id";
+        # duplicate matches are a runtime error) — so the output IS the
+        # id-side universe, and downstream zips need no promise
+        # (symmetrically for RIGHT joins keyed by the right side)
+        if id_side == "left" and self._mode == JoinMode.LEFT:
+            universe = self._left._universe
+        elif id_side == "right" and self._mode == JoinMode.RIGHT:
+            universe = self._right._universe
+        else:
+            universe = Universe()
         return Table(
             "join_select",
             [self._left, self._right],
@@ -143,7 +154,7 @@ class JoinResult:
                 "asof_now": getattr(self, "_asof_now", False),
             },
             schema,
-            Universe(),
+            universe,
         )
 
     def reduce(self, *args: Any, **kwargs: Any):

@@ -76,7 +76,6 @@ class KNNIndex:
             ),
             collapse_rows,
             with_distances,
-            query_embedding,
         )
 
     def get_nearest_items_asof_now(
@@ -98,11 +97,9 @@ class KNNIndex:
             ),
             collapse_rows,
             with_distances,
-            query_embedding,
         )
 
-    def _package(self, join_result, collapse_rows: bool, with_distances: bool,
-                 query_embedding: ColumnReference | None = None) -> Table:
+    def _package(self, join_result, collapse_rows: bool, with_distances: bool) -> Table:
         from ...internals.thisclass import right as r_
         from ..indexing.data_index import _SCORE
 
@@ -121,12 +118,7 @@ class KNNIndex:
                     lambda s: -float(s) if s is not None else None,
                     dt.Optional(dt.FLOAT), getattr(r_, _SCORE),
                 )
-        res = join_result.select(**cols)
-        qt = getattr(query_embedding, "table", None)
-        if collapse_rows and isinstance(qt, Table):
-            # one result row per query row BY CONSTRUCTION (the index
-            # answers are re-keyed by query id) — declare the universes
-            # equal so `queries + result` zips without a user promise
-            # (reference get_nearest_items keeps the queries' universe)
-            res = res.promise_universe_is_equal_to(qt)
-        return res
+        # the collapsed DataIndex result is a LEFT join keyed by
+        # pw.left.id, so join_select already carries the queries' universe
+        # (joins.py) — `queries + result` zips with no promise needed here
+        return join_result.select(**cols)

@@ -1,0 +1,146 @@
+"""Ported from the reference's flatten and string-namespace suites.
+
+Sources: ``/root/reference/python/pathway/tests/test_flatten.py`` and
+``.../expressions/test_string.py`` (VERDICT r4 item 7). Porting contract
+as in ``tests/test_ported_common_1.py``; manifest in ``PORTED_TESTS.md``.
+"""
+
+from __future__ import annotations
+
+import pandas as pd
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.testing import T, assert_table_equality_wo_index
+
+
+# -- flatten (test_flatten.py) -----------------------------------------------
+
+
+def test_flatten_simple():  # ref :14
+    tab = pw.debug.table_from_pandas(
+        pd.DataFrame.from_dict({"col": [[1, 2, 3, 4]]})
+    )
+    res = tab.flatten(pw.this.col, origin_id="origin_id")
+    df = pw.debug.table_to_pandas(res)
+    assert sorted(df["col"].tolist()) == [1, 2, 3, 4]
+    # every exploded row points back at its ONE parent
+    assert len(set(df["origin_id"].tolist())) == 1
+
+
+def test_flatten_no_origin():  # ref :31
+    tab = pw.debug.table_from_pandas(
+        pd.DataFrame.from_dict({"col": [[1, 2, 3, 4]]})
+    )
+    res = tab.flatten(pw.this.col)
+    assert sorted(pw.debug.table_to_pandas(res)["col"].tolist()) == [1, 2, 3, 4]
+
+
+def test_flatten_inner_repeats():  # ref :48 (repeated values keep distinct rows)
+    tab = pw.debug.table_from_pandas(
+        pd.DataFrame.from_dict({"col": [[1, 1, 1, 3]]})
+    )
+    res = tab.flatten(pw.this.col)
+    assert sorted(pw.debug.table_to_pandas(res)["col"].tolist()) == [1, 1, 1, 3]
+
+
+def test_flatten_more_repeats():  # ref :65
+    tab = pw.debug.table_from_pandas(
+        pd.DataFrame.from_dict({"col": [[1, 1, 1, 3], [1]]})
+    )
+    res = tab.flatten(pw.this.col, origin_id="origin_id")
+    df = pw.debug.table_to_pandas(res)
+    assert sorted(df["col"].tolist()) == [1, 1, 1, 1, 3]
+    assert len(set(df["origin_id"].tolist())) == 2
+
+
+def test_flatten_empty_lists():  # ref :83
+    tab = pw.debug.table_from_pandas(
+        pd.DataFrame.from_dict({"col": [[], []]})
+    )
+    res = tab.flatten(pw.this.col)
+    assert len(pw.debug.table_to_pandas(res)) == 0
+
+
+# -- .str namespace (expressions/test_string.py) -----------------------------
+
+
+def _col(res, name="c"):
+    return pw.debug.table_to_pandas(res)[name].tolist()
+
+
+def test_strip():  # ref :11
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(s=str), [("  pad  ",), ("x",)]
+    )
+    res = t.select(c=pw.this.s.str.strip())
+    assert sorted(_col(res)) == ["pad", "x"]
+
+
+def test_count():  # ref :22
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(s=str), [("banana",)]
+    )
+    res = t.select(c=pw.this.s.str.count("an"))
+    assert _col(res) == [2]
+
+
+def test_find_and_rfind():  # ref :87/:165
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(s=str), [("abcabc",)]
+    )
+    res = t.select(
+        f=pw.this.s.str.find("bc"),
+        rf=pw.this.s.str.rfind("bc"),
+        miss=pw.this.s.str.find("zz"),
+    )
+    df = pw.debug.table_to_pandas(res)
+    assert df[["f", "rf", "miss"]].values.tolist() == [[1, 4, -1]]
+
+
+def test_parse_int():  # ref :249
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(s=str), [("42",), ("-7",)]
+    )
+    res = t.select(c=pw.this.s.str.parse_int())
+    assert sorted(_col(res)) == [-7, 42]
+
+
+def test_parse_float():  # ref :259
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(s=str), [("1.5",), ("-0.25",)]
+    )
+    res = t.select(c=pw.this.s.str.parse_float())
+    assert sorted(_col(res)) == [-0.25, 1.5]
+
+
+def test_parse_bool():  # ref :285
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(s=str), [("true",), ("false",)]
+    )
+    res = t.select(c=pw.this.s.str.parse_bool())
+    assert sorted(_col(res), key=repr) == sorted([True, False], key=repr)
+
+
+def test_parse_int_bad_value_is_error():  # ref :326 family
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(s=str), [("42",), ("nope",)]
+    )
+    res = t.select(c=pw.fill_error(pw.this.s.str.parse_int(), -1))
+    assert sorted(_col(res)) == [-1, 42]
+
+
+def test_slice_upper_lower_len():  # string namespace basics used everywhere
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(s=str), [("Hello",)]
+    )
+    res = t.select(
+        u=pw.this.s.str.upper(),
+        lo=pw.this.s.str.lower(),
+        n=pw.this.s.str.len(),
+        sub=pw.this.s.str.slice(1, 3),
+    )
+    df = pw.debug.table_to_pandas(res)
+    assert df[["u", "lo", "n", "sub"]].values.tolist() == [
+        ["HELLO", "hello", 5, "el"]
+    ]
