@@ -8,9 +8,18 @@ from typing import Any, Callable
 def deduplicate(
     table,
     *,
-    value,
+    col=None,
+    value=None,
     instance=None,
     acceptor: Callable[[Any, Any], bool],
     persistent_id: str | None = None,
 ):
-    return table.deduplicate(value=value, instance=instance, acceptor=acceptor)
+    """``col=`` is the reference keyword (deduplicate.py:9); ``value=``
+    is kept as an alias matching ``Table.deduplicate``."""
+    if (col is None) == (value is None):
+        raise TypeError("deduplicate needs exactly one of col= / value=")
+    return table.deduplicate(
+        value=col if col is not None else value,
+        instance=instance,
+        acceptor=acceptor,
+    )
