@@ -66,43 +66,45 @@ def _probe_backend() -> None:
     return False
 
 
-def main() -> None:
-    _probe_backend()
-    import jax
+KNN_DIM = 384
+KNN_QUERIES = 64
+KNN_K = 10
 
-    platform = jax.default_backend()
-    on_tpu = platform not in ("cpu",)
-    n_docs = 1_000_000 if on_tpu else 50_000
-    dim = 384
-    n_queries = 64
-    k = 10
-    target_ms = 50.0
+
+def _knn_p50(on_tpu: bool) -> tuple[float, float, int, float]:
+    """p50 KNN query latency (MXU scoring + top-k) ->
+    (p50_ms, qps, n_docs, roundtrip_ms) — the roundtrip returned is the
+    SAME sample subtracted from p50, so the published JSON stays
+    self-consistent under tunnel jitter.
+
+    Timing discipline for remote/tunneled devices (the axon tunnel):
+    block_until_ready returns before execution completes and identical
+    dispatches may be cached, so (a) every iteration gets distinct
+    queries, (b) K searches are chained into ONE jitted call whose scalar
+    output is fetched to host (the fetch cannot complete before the
+    compute), and (c) the measured host<->device roundtrip is subtracted."""
+    import jax
+    import jax.numpy as jnp
 
     from pathway_tpu.ops.knn import topk_scores
 
+    n_docs = 1_000_000 if on_tpu else 50_000
     rng = np.random.default_rng(0)
-    docs = rng.standard_normal((n_docs, dim), dtype=np.float32)
+    docs = rng.standard_normal((n_docs, KNN_DIM), dtype=np.float32)
     docs /= np.linalg.norm(docs, axis=1, keepdims=True)
-
-    import jax.numpy as jnp
-
     d_index = jax.device_put(jnp.asarray(docs))
 
-    # Timing discipline for remote/tunneled devices (the axon tunnel):
-    # block_until_ready returns before execution completes and identical
-    # dispatches may be cached, so (a) every iteration gets distinct
-    # queries, (b) K searches are chained into ONE jitted call whose scalar
-    # output is fetched to host (the fetch cannot complete before the
-    # compute), and (c) the measured host<->device roundtrip is subtracted.
     iters = 30 if on_tpu else 10
     roundtrip_ms = _device_roundtrip_ms()
-    q_stack = rng.standard_normal((iters, n_queries, dim), dtype=np.float32)
+    q_stack = rng.standard_normal(
+        (iters, KNN_QUERIES, KNN_DIM), dtype=np.float32
+    )
     q_stack /= np.linalg.norm(q_stack, axis=2, keepdims=True)
 
     @jax.jit
     def knn_chain(qs, index):
         def one(q):
-            s, ids = topk_scores(q, index, k)
+            s, ids = topk_scores(q, index, KNN_K)
             return s.sum() + ids.sum().astype(jnp.float32)
 
         return jnp.sum(jax.lax.map(one, qs))
@@ -114,9 +116,55 @@ def main() -> None:
     float(knn_chain(d_stack, d_index))
     wall_ms = (time.perf_counter() - t0) * 1000.0
     p50 = max(wall_ms - roundtrip_ms, 1e-3) / iters
-    qps = n_queries / (p50 / 1000.0)
+    return p50, KNN_QUERIES / (p50 / 1000.0), n_docs, roundtrip_ms
 
-    roundtrip_ms = _device_roundtrip_ms()
+
+def micro_main() -> None:
+    """TPU-only micro-slice (``bench.py --tpu-micro``): KNN p50 + embed
+    MFU + device roundtrip, captured to BENCH_TPU_LASTGOOD.json. Run by
+    the tunnel watcher the moment a probe succeeds, so a round whose full
+    suite never reaches TPU still carries fresh TPU evidence (VERDICT r4
+    #1). Exits rc=3 when the backend is not an accelerator."""
+    import sys
+
+    _probe_backend()
+    import jax
+
+    platform = jax.default_backend()
+    if platform == "cpu":
+        print("tpu-micro: no accelerator backend", file=sys.stderr)
+        raise SystemExit(3)
+    target_ms = 50.0
+    p50, qps, n_docs, roundtrip_ms = _knn_p50(on_tpu=True)
+    embed = _embed_throughput(True)
+    result = {
+        "metric": f"knn_p50_latency_{n_docs // 1000}k_docs_batch{KNN_QUERIES}",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(target_ms / p50, 3),
+        "extra": {
+            "platform": platform,
+            "micro_slice": True,
+            "n_docs": n_docs,
+            "queries_per_sec": round(qps, 1),
+            "embed_tokens_per_sec": round(embed["tok_per_sec"], 1),
+            "embed_mfu": embed["mfu"],
+            "device_roundtrip_ms": round(roundtrip_ms, 2),
+        },
+    }
+    _record_capture(result, platform)
+    print(json.dumps(result))
+
+
+def main() -> None:
+    _probe_backend()
+    import jax
+
+    platform = jax.default_backend()
+    on_tpu = platform not in ("cpu",)
+    target_ms = 50.0
+
+    p50, qps, n_docs, roundtrip_ms = _knn_p50(on_tpu)
     embed = _embed_throughput(on_tpu)
     rag_ingest, ingest_docs = _rag_ingest_throughput(on_tpu)
     rest_p50, serve_docs = _rest_rag_p50(on_tpu)
@@ -134,15 +182,15 @@ def main() -> None:
     n_cores = _os.cpu_count() or 1
 
     result = {
-        "metric": f"knn_p50_latency_{n_docs // 1000}k_docs_batch{n_queries}",
+        "metric": f"knn_p50_latency_{n_docs // 1000}k_docs_batch{KNN_QUERIES}",
         "value": round(p50, 3),
         "unit": "ms",
         "vs_baseline": round(target_ms / p50, 3),
         "extra": {
             "platform": platform,
             "n_docs": n_docs,
-            "dim": dim,
-            "k": k,
+            "dim": KNN_DIM,
+            "k": KNN_K,
             "queries_per_sec": round(qps, 1),
             "wordcount_stream_rows_per_sec": round(wc_rows_per_sec, 1),
             "wordcount_rowwise_api_rows_per_sec": round(wc_rowwise, 1),
@@ -771,4 +819,9 @@ def _join_throughput(n_left: int = 300_000, n_right: int = 50_000,
 
 
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+
+    if "--tpu-micro" in _sys.argv:
+        micro_main()
+    else:
+        main()

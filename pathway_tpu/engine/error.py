@@ -12,7 +12,7 @@ from __future__ import annotations
 import logging
 import threading
 
-__all__ = ["Error", "is_error", "errors_seen", "ERROR_LOG"]
+__all__ = ["Error", "is_error", "errors_seen", "live_errors", "ERROR_LOG"]
 
 logger = logging.getLogger("pathway_tpu.errors")
 
@@ -58,16 +58,40 @@ ERROR_LOG = _ErrorLog()
 #: garbage-collected (ADVICE r3: scope the latch per-run).
 _live_errors = 0
 _count_lock = threading.Lock()
+#: decrements deferred from ``Error.__del__``. ``list.append`` is atomic
+#: under the GIL and safe from a GC pass that interrupts ``_incr`` on the
+#: same thread (no lock to deadlock on), so __del__ never skips a
+#: decrement; the pending entries are drained into ``_live_errors`` by the
+#: next ``_incr`` (ADVICE r4: contended-skip made the count drift upward
+#: permanently, pinning pipelines on the slow error-aware paths).
+_pending_decr: list[None] = []
 
 
 def _incr() -> None:
     global _live_errors
     with _count_lock:
-        _live_errors += 1
+        n = len(_pending_decr)
+        if n:
+            del _pending_decr[:n]
+        _live_errors += 1 - n
+
+
+def live_errors() -> int:
+    """Net count of Error values alive right now. Also drains the pending
+    decrements (safe: never called from ``__del__``), so a burst of
+    collected Errors does not retain an ever-growing pending list when no
+    new Error is constructed afterwards."""
+    global _live_errors
+    with _count_lock:
+        n = len(_pending_decr)
+        if n:
+            del _pending_decr[:n]
+            _live_errors -= n
+        return _live_errors
 
 
 def errors_seen() -> bool:
-    return _live_errors > 0
+    return live_errors() > 0
 
 
 class Error:
@@ -93,20 +117,16 @@ class Error:
         return e
 
     def __del__(self) -> None:
-        global _live_errors
         try:
             # _incr() runs exactly when `message` is set (init / silent /
             # __setstate__); a half-built instance must not decrement.
-            # Non-blocking acquire: __del__ can run from a GC pass while
-            # this same thread holds the lock inside _incr — blocking here
-            # would deadlock. On contention we skip the decrement: the
-            # count only ever over-states, which keeps the error-aware
-            # paths conservatively on (never silently off).
-            if hasattr(self, "message") and _count_lock.acquire(blocking=False):
-                try:
-                    _live_errors -= 1
-                finally:
-                    _count_lock.release()
+            # Deferred decrement: __del__ can run from a GC pass while
+            # this same thread holds _count_lock inside _incr, so taking
+            # the lock here could deadlock and skipping would drift the
+            # count upward forever. list.append is GIL-atomic and
+            # reentrancy-safe; _incr drains the pending list.
+            if hasattr(self, "message"):
+                _pending_decr.append(None)
         except Exception:  # interpreter shutdown: globals may be gone
             pass
 

@@ -917,8 +917,16 @@ class Join(Node):
         # row_key -> current pad multiplicity (row path only)
         self._lpad: dict[int, int] = {}
         self._rpad: dict[int, int] = {}
+        # id-keyed joins (key_mode left/right) promise one output row per
+        # id-side row ("result.id == left.id"); a second match silently
+        # duplicates a row key inside a table labeled with the id side's
+        # universe, so enforce the reference's duplicate-id runtime error
+        # (ADVICE r4). out_key -> live multiplicity, maintained per tick.
+        self._idcount: dict[int, int] = {}
 
-    STATE_FIELDS = ("_cleft", "_cright", "_left", "_right", "_lpad", "_rpad")
+    STATE_FIELDS = (
+        "_cleft", "_cright", "_left", "_right", "_lpad", "_rpad", "_idcount"
+    )
 
     def exchange_specs(self):
         # both sides route by join key -> matching rows co-locate
@@ -1161,7 +1169,7 @@ class Join(Node):
             for side, (d, jk) in enumerate(zip(ins, (self._ljk, self._rjk)))
         ]
         if self._columnar:
-            return self._process_columnar(ins)
+            return self._check_unique_ids(self._process_columnar(ins))
         dl = self._rows_of(ins[0], self._ljk, self._lcols)
         dr = self._rows_of(ins[1], self._rjk, self._rcols)
         out: tuple[list, list, list] = ([], [], [])
@@ -1194,11 +1202,40 @@ class Join(Node):
             )
         if not out[0]:
             return None
-        return Delta(
+        return self._check_unique_ids(Delta(
             keys=np.array(out[0], dtype=np.uint64),
             data=rows_to_columns(out[1], self.column_names),
             diffs=np.array(out[2], dtype=np.int64),
-        ).consolidated()
+        ).consolidated())
+
+    def _check_unique_ids(self, delta: Delta | None) -> Delta | None:
+        """key_mode left/right: every output key is an id-side row id and
+        must stay at multiplicity ≤ 1 (pads included — a pad and a match
+        for the same id are exclusive, so legal transitions net to ≤ 1).
+        Mirrors the reference's "duplicate key" runtime error for
+        id-preserving joins (value.rs key contract; joins keyed by a
+        side's id carry that side's universe)."""
+        if self._key_mode == "pair" or delta is None or not len(delta):
+            return delta
+        uniq, inv = np.unique(delta.keys, return_inverse=True)
+        sums = np.zeros(len(uniq), dtype=np.int64)
+        np.add.at(sums, inv, delta.diffs)
+        for k, s in zip(uniq.tolist(), sums.tolist()):
+            if s == 0:
+                continue
+            cnt = self._idcount.get(k, 0) + s
+            if cnt > 1:
+                side = self._key_mode
+                raise ValueError(
+                    f"duplicate row id in {side}-id join: {side} row "
+                    f"{k} matched multiple rows of the other side "
+                    "(join with id= requires at most one match per id row)"
+                )
+            if cnt:
+                self._idcount[k] = cnt
+            else:
+                self._idcount.pop(k, None)
+        return delta
 
     def _repad(self, out, d_this, d_other, this_idx: MultiIndex, other_idx: MultiIndex, pad_state: dict[int, int], pad_fn) -> None:
         affected_jks = {jk for jk, _, _, _ in d_this} | {jk for jk, _, _, _ in d_other}

@@ -453,7 +453,10 @@ class _Compiler:
 
         base = lookup(sel.table)
         env: dict[str, Table] = {sel.table["alias"]: base}
-        self._alias_cols = {sel.table["alias"]: list(base.column_names())}
+        # built locally: lookup() of a derived table (subquery in JOIN
+        # position) recursively compiles and would clobber self._alias_cols
+        # mid-loop (ADVICE r4) — publish only once all joins resolve
+        alias_cols = {sel.table["alias"]: list(base.column_names())}
         current = base
         for join in sel.joins:
             right = lookup(join.table)
@@ -488,9 +491,10 @@ class _Compiler:
                 else:
                     out_cols[c] = getattr(r_, c)
                     right_names.append(c)
-            self._alias_cols[alias] = right_names
+            alias_cols[alias] = right_names
             current = joined.select(**out_cols)
             env = {a: current for a in env}  # all aliases now view the join
+        self._alias_cols = alias_cols
         return current, env
 
     # -- expressions --

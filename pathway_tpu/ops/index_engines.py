@@ -193,6 +193,7 @@ class BruteForceKnnEngine:
             ikeys = [ikeys[i] for i in keep]
             vecs = vecs[keep]
             filters = [filters[i] for i in keep]
+            n = len(ikeys)
         for k in ikeys:
             if k in self._slots.key_to_slot:
                 self._slots.release(k)

@@ -147,14 +147,17 @@ def test_errors_seen_gate_scoped_to_live_errors():
 
     from pathway_tpu.engine import error as err_mod
 
-    base = err_mod._live_errors
+    base = err_mod.live_errors()
     e = err_mod.Error.silent("held")
     ERROR_LOG.clear()
     assert err_mod.errors_seen()  # clearing the log must not reset the gate
-    assert err_mod._live_errors == base + 1
+    assert err_mod.live_errors() == base + 1
     del e
     gc.collect()
-    assert err_mod._live_errors == base
+    # __del__ defers its decrement (GC-reentrancy-safe, ADVICE r4);
+    # live_errors() applies pending decrements without waiting for the
+    # next _incr to drain them
+    assert err_mod.live_errors() == base
 
 
 def test_error_pickle_roundtrip_sets_latch():

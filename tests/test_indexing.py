@@ -289,3 +289,23 @@ class TestSorting:
         by_ts = {data["ts"][k]: (data["prev_value"][k], data["next_value"][k]) for k in data["ts"]}
         assert by_ts[2] == (10.0, 30.0)
         assert by_ts[1] == (None, 30.0)
+
+
+def test_bulk_add_duplicate_new_keys_empty_freelist():
+    # ADVICE r4 index_engines.py:204: dedup shrank ikeys/vecs but the
+    # fresh-block path still allocated the pre-dedup count of slots,
+    # broadcasting mismatched shapes and corrupting the slot directory
+    from pathway_tpu.ops.index_engines import BruteForceKnnEngine
+
+    eng = BruteForceKnnEngine(4, reserved_space=16)
+    v1 = np.array([1.0, 0, 0, 0], dtype=np.float32)
+    v2 = np.array([0, 1.0, 0, 0], dtype=np.float32)
+    # same NEW key twice in one tick, free list empty -> last occurrence wins
+    eng.add_batch([7, 7], [v1, v2], [None, None])
+    assert eng._slots.high == 1
+    assert eng._slots.key_to_slot == {7: 0}
+    res = eng.search([v2], [1], [None])
+    assert [k for k, _ in res[0]] == [7]
+    # directory stays consistent for subsequent inserts
+    eng.add_batch([8], [v1], [None])
+    assert eng._slots.key_to_slot == {7: 0, 8: 1}

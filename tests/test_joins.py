@@ -527,3 +527,59 @@ def test_join_error_keys_dropped_even_without_live_errors():
     # without the unconditional sentinel check, the two left Error rows
     # and the right Error row all share ERROR_KEY and spuriously match
     assert_table_equality_wo_index(j, expected)
+
+
+def test_id_join_duplicate_match_raises():
+    # id=pw.left.id promises result.id == left.id; a left row matching two
+    # right rows would duplicate a row key inside a table carrying the
+    # left universe — the reference raises at runtime, so must we
+    # (ADVICE r4: joins.py:140)
+    left = T(
+        """
+        k | v
+        1 | 10
+        """
+    )
+    right = T(
+        """
+        k | w
+        1 | 100
+        1 | 200
+        """
+    )
+    j = left.join_left(right, left.k == right.k, id=pw.left.id).select(
+        pw.left.v, pw.right.w
+    )
+    with pytest.raises(Exception, match="[Dd]uplicate"):
+        from pathway_tpu.internals.graph_runner import GraphRunner
+
+        GraphRunner().run_tables(j)
+
+
+def test_id_join_unique_matches_ok_incremental():
+    # pad -> match transitions for the same id row are legal (multiplicity
+    # stays at 1); only a genuine second match raises
+    left = T(
+        """
+        k | v | __time__ | __diff__
+        1 | 10 | 2       | 1
+        """
+    )
+    right = T(
+        """
+        k | w   | __time__ | __diff__
+        1 | 100 | 4        | 1
+        1 | 100 | 6        | -1
+        1 | 300 | 8        | 1
+        """
+    )
+    j = left.join_left(right, left.k == right.k, id=pw.left.id).select(
+        pw.left.v, w=pw.fill_error(pw.right.w, -1)
+    )
+    expected = T(
+        """
+        v  | w
+        10 | 300
+        """
+    )
+    assert_table_equality_wo_index(j, expected)

@@ -274,3 +274,17 @@ def test_sql_union_inside_derived_table():
         x=x, y=y,
     )
     assert sorted(run_table(r)[0].values()) == [(2,)]
+
+
+def test_sql_qualified_star_with_derived_table_join():
+    # ADVICE r4 sql.py:459: compiling the subquery in JOIN position used to
+    # clobber the outer query's alias-cols map, so a.* raised KeyError
+    G.clear()
+    t = T("cid | item\n1 | apple\n2 | pear")
+    u = T("cid | n\n1 | 5\n2 | 7")
+    r = pw.sql(
+        "SELECT a.*, b.n FROM t a "
+        "JOIN (SELECT cid, n FROM u) b ON a.cid = b.cid",
+        t=t, u=u,
+    )
+    assert sorted(run_table(r)[0].values()) == [(1, "apple", 5), (2, "pear", 7)]
