@@ -64,7 +64,7 @@ def shard_of(keys: KeyArray, num_shards: int) -> np.ndarray:
 #: id() with a weakref liveness guard (ids recycle); columns are
 #: immutable by engine convention.
 _OBJ_HASH_CACHE: dict[int, tuple] = {}
-_OBJ_HASH_CACHE_MIN_ROWS = 1024
+_OBJ_HASH_CACHE_MIN_ROWS = 128
 _OBJ_HASH_CACHE_MAX = 64
 
 

@@ -2034,10 +2034,19 @@ class Subscribe(Node):
         if self._on_batch is not None and len(d):
             self._on_batch(time, d)
         if self._on_change is not None:
-            for key, row, diff in d.iter_rows():
-                self._on_change(
+            # bulk tolist + C-speed zip transposition, one flat loop: the
+            # per-row work is exactly the dict the callback signature
+            # requires plus the call itself
+            cb = self._on_change
+            names = tuple(self.column_names)
+            cols = [np.asarray(d.data[c]).tolist() for c in names]
+            rows = zip(*cols) if cols else iter([()] * len(d))
+            for key, diff, row in zip(
+                d.keys.tolist(), d.diffs.tolist(), rows
+            ):
+                cb(
                     key=key,
-                    row=dict(zip(self.column_names, row)),
+                    row=dict(zip(names, row)),
                     time=time,
                     is_addition=diff > 0,
                 )

@@ -100,7 +100,16 @@ class ConnectorSubject:
             self._flush_rows()
 
     def next(self, **kwargs: Any) -> None:
-        self._emit((1, kwargs, None))
+        # hot path: a bare kwargs dict means (diff=+1, no explicit key) —
+        # no wrapper tuple, no extra call; retractions/keyed rows go
+        # through _emit with the (diff, fields, key) tuple form
+        with self._buf_lock:
+            buf = self._buf
+            buf.append(kwargs)
+            if len(buf) >= self._CHUNK:
+                self._queue.put(buf)
+                self._buf = []
+                self._buf_flushed_at = _time.monotonic()
 
     def next_batch(self, data: dict[str, Any], diffs: Any = None) -> None:
         """Columnar fast lane: emit many rows at once as column lists/arrays
@@ -230,33 +239,51 @@ class PythonSubjectSource(RealtimeSource):
         self.waker = event
         self.subject._waker = event
 
-    def _row_tuple(self, fields: dict[str, Any]) -> tuple:
-        row = []
-        for n in self.names:
-            if n in fields:
-                row.append(fields[n])
-            elif n in self.defaults:
-                row.append(self.defaults[n])
-            else:
-                row.append(None)
-        return tuple(row)
-
-    def _make_delta(self, entries: list[tuple[int, tuple, int | None]]) -> Delta:
+    def _make_delta(self, entries: list[tuple[int, dict, int | None]]) -> Delta:
         # the offset covers exactly the rows delivered to the engine as
         # deltas — never rows still sitting in _partial, which would be
-        # lost on recovery (persisted offset past unsnapshotted input)
+        # lost on recovery (persisted offset past unsnapshotted input).
+        #
+        # Columnar-first: the per-row ``next(**fields)`` entries keep their
+        # kwargs dicts until here, where each schema column is extracted in
+        # ONE comprehension and keys are hashed vectorized (``mix_columns``
+        # over columns is bit-identical to ``hash_values`` over the
+        # corresponding row tuples) — no per-row tuple building, no
+        # rows->columns transpose (VERDICT r4 #4, the per-row API tax).
+        from ..engine.delta import column_of_values
+
         self._emitted += len(entries)
-        rows = [r for _, r, _ in entries]
-        diffs = np.array([d for d, _, _ in entries], dtype=np.int64)
-        if self.pk_indices is not None:
-            pk_rows = [tuple(r[i] for i in self.pk_indices) for r in rows]
-            keys = K.hash_values(pk_rows)
+        n = len(entries)
+        # entries are bare kwargs dicts (next(): diff=+1, no key) or
+        # (diff, fields, key) tuples (_remove / _next_with_key)
+        plain = all(type(e) is dict for e in entries)
+        fields_list = (
+            entries if plain else [e if type(e) is dict else e[1] for e in entries]
+        )
+        data: dict[str, np.ndarray] = {}
+        for name in self.names:
+            dflt = self.defaults.get(name)
+            data[name] = column_of_values(
+                [f.get(name, dflt) for f in fields_list]
+            )
+        if plain:
+            diffs = np.ones(n, dtype=np.int64)
         else:
-            keys = K.hash_values(rows)
-        for i, (_, _, explicit) in enumerate(entries):
-            if explicit is not None:
-                keys[i] = explicit
-        return Delta(keys=keys, data=rows_to_columns(rows, self.names), diffs=diffs)
+            diffs = np.fromiter(
+                (1 if type(e) is dict else e[0] for e in entries),
+                np.int64, count=n,
+            )
+        if self.pk_indices is not None:
+            keys = K.mix_columns(
+                [data[self.names[i]] for i in self.pk_indices], n
+            )
+        else:
+            keys = K.mix_columns(list(data.values()), n)
+        if not plain:
+            for i, e in enumerate(entries):
+                if type(e) is not dict and e[2] is not None:
+                    keys[i] = e[2]
+        return Delta(keys=keys, data=data, diffs=diffs)
 
     def _make_batch_delta(self, batch: _Batch) -> Delta | None:
         """Columnar batch → Delta with vectorized key hashing.
@@ -362,16 +389,18 @@ class PythonSubjectSource(RealtimeSource):
                     self._pending.append(d)
                 continue
             # a chunk of buffered rows (ConnectorSubject._emit): one queue
-            # item per ~256 rows instead of one per row
-            for diff, fields, key in item:
-                if self._skip > 0:
-                    # already persisted before restart; the restarted subject
-                    # re-emits its deterministic prefix (reference
-                    # PythonReader offset = message count,
-                    # data_storage.rs:835)
-                    self._skip -= 1
+            # item per ~256 rows instead of one per row; entries keep their
+            # kwargs dicts — _make_delta extracts columns in bulk
+            if self._skip > 0:
+                # already persisted before restart; the restarted subject
+                # re-emits its deterministic prefix (reference
+                # PythonReader offset = message count, data_storage.rs:835)
+                drop = min(self._skip, len(item))
+                self._skip -= drop
+                item = item[drop:]
+                if not item:
                     continue
-                self._partial.append((diff, self._row_tuple(fields), key))
+            self._partial.extend(item)
         now = _time.monotonic()
         flush_due = (
             self.autocommit_ms is not None
