@@ -180,7 +180,8 @@ class Delta:
         # asymmetric combine — a plain xor would zero out whenever row keys
         # are themselves content-derived (same mix as the row hash)
         row_sig = K.derive_pair(
-            self.keys, K.mix_columns(list(self.data.values()), len(self))
+            self.keys,
+            K.mix_columns(list(self.data.values()), len(self), register=False),
         )
         order = np.argsort(row_sig, kind="stable")
         sig_sorted = row_sig[order]

@@ -1,15 +1,30 @@
-"""Vectorized keyspace for the engine.
+"""Vectorized 128-bit keyspace for the engine.
 
 Re-design of the reference's ``Key(u128)`` xxh3 keyspace
-(``src/engine/value.rs:30-75``): keys here are 64-bit avalanche mixes held in
-numpy ``uint64`` arrays so that key derivation, resharding and grouping are
-all vectorized (and can be fused onto the TPU via ``jax.numpy`` on the same
-arrays). The shard of a key is its low bits (reference ``SHARD_MASK``,
-``value.rs:38``). All derivation is deterministic across runs and processes.
+(``src/engine/value.rs:30-75``). Keys are derived as **128-bit values** —
+two independent 64-bit lanes (LO: splitmix64 folds / BLAKE2b-8; HI:
+moremur folds / the second word of BLAKE2b-16) — and the engine transports
+the LO lane in numpy ``uint64`` arrays so key derivation, resharding and
+grouping stay vectorized (and can fuse onto the TPU via ``jax.numpy`` on
+the same arrays). The shard of a key is its low bits (reference
+``SHARD_MASK``, ``value.rs:38``). All derivation is deterministic across
+runs and processes.
 
-The 64-bit width is an explicit engineering choice for this layer (collision
-probability ~n^2/2^65); the module is the single place to widen to 128-bit
-(two-lane mixes) later without touching operator code.
+Why not two-lane arrays end to end: numpy structured/void 16-byte dtypes
+lose 7-20x on ``unique``/``argsort``/``tolist`` (measured on this host),
+which would tax every groupby/join/consolidation tick far beyond the
+<10 ms budgets the engine runs at — the vectorized uint64 lane IS the
+TPU-native design. Instead, every key-creation batch registers its
+(lo, hi) pair in a process-wide native registry
+(``_pathway_native.KeyRegistry``): two distinct 128-bit keys colliding on
+the 64-bit transport lane are DETECTED and fail the run (the reference
+never conflates because it keys by the full u128; we fail-stop at the
+same probability scale, ~n^2/2^129 for a silent miss, instead of
+~n^2/2^65 for silent conflation). Derived keys (``derive``/
+``derive_pair`` salts) occupy structurally disjoint salted domains and
+are not re-registered. The registry is bounded
+(``PATHWAY_KEY_REGISTRY_CAP`` entries, default 4M): at cap it freezes —
+existing entries keep detecting, new keys pass unchecked — and logs once.
 """
 
 from __future__ import annotations
@@ -22,6 +37,7 @@ import numpy as np
 
 __all__ = [
     "KeyArray",
+    "KeyCollisionError",
     "SHARD_BITS",
     "shard_of",
     "mix_columns",
@@ -42,6 +58,16 @@ _GOLDEN = np.uint64(0x9E3779B97F4A7C15)
 _MIX1 = np.uint64(0xBF58476D1CE4E5B9)
 _MIX2 = np.uint64(0x94D049BB133111EB)
 
+# HI-lane (moremur-family) constants — independent of the LO-lane mix so
+# the two lanes of a 128-bit key never co-collide
+_GOLDEN_H = np.uint64(0xD1B54A32D192ED03)
+_MIXH1 = np.uint64(0xAEF17502108EF2D9)
+_MIXH2 = np.uint64(0xD1342543DE82EF95)
+#: HI-lane seeds (native.c NONE_TAG_HI / TUPLE_SEED_HI / ROW_SEED_HI)
+_NONE_TAG_HI = 0x6E6F6E655F686921
+_TUPLE_SEED_HI = 0xD1B5
+_ROW_SEED_HI = 0xE7037ED1A0B428DB
+
 
 def _splitmix(x: np.ndarray) -> np.ndarray:
     """Vectorized splitmix64 finalizer — full-avalanche 64-bit mix."""
@@ -50,6 +76,16 @@ def _splitmix(x: np.ndarray) -> np.ndarray:
         x = (x ^ (x >> np.uint64(30))) * _MIX1
         x = (x ^ (x >> np.uint64(27))) * _MIX2
         x = x ^ (x >> np.uint64(31))
+    return x
+
+
+def _splitmix2(x: np.ndarray) -> np.ndarray:
+    """Vectorized HI-lane finalizer (must match native splitmix2)."""
+    with np.errstate(over="ignore"):
+        x = (x + _GOLDEN_H).astype(np.uint64)
+        x = (x ^ (x >> np.uint64(32))) * _MIXH1
+        x = (x ^ (x >> np.uint64(29))) * _MIXH2
+        x = x ^ (x >> np.uint64(32))
     return x
 
 
@@ -67,6 +103,13 @@ _OBJ_HASH_CACHE: dict[int, tuple] = {}
 _OBJ_HASH_CACHE_MIN_ROWS = 128
 _OBJ_HASH_CACHE_MAX = 64
 
+#: value-level string digest memos consumed by the native kernels: the
+#: stream hot path hashes the same (equal-valued) words every tick, and a
+#: dict probe replaces the BLAKE2b digest(s). Bounded in C (cleared at
+#: 64k entries).
+_STR_MEMO: dict = {}
+_STR_MEMO2: dict = {}
+
 
 def _hash_object_column(col: np.ndarray) -> np.ndarray:
     cache_key = None
@@ -82,7 +125,7 @@ def _hash_object_column(col: np.ndarray) -> np.ndarray:
     native = get_native()
     if native is not None:
         # group-key hot path — same per-scalar semantics, in C
-        native.hash_scalars(list(col), _hash_scalar, out)
+        native.hash_scalars(list(col), _hash_scalar, out, _STR_MEMO)
     else:
         for i, v in enumerate(col):
             out[i] = _hash_scalar(v)
@@ -100,6 +143,95 @@ def _hash_object_column(col: np.ndarray) -> np.ndarray:
         out.flags.writeable = False  # shared across callers from now on
         _OBJ_HASH_CACHE[cache_key] = (ref, out)
     return out
+
+
+_OBJ_HASH2_CACHE: dict[int, tuple] = {}
+
+
+def _hash_object_column2(col: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Both lanes of the 128-bit hash for an object column (one native
+    pass; strings memoized value-wise)."""
+    cache_key = None
+    if len(col) >= _OBJ_HASH_CACHE_MIN_ROWS:
+        cache_key = id(col)
+        hit = _OBJ_HASH2_CACHE.get(cache_key)
+        if hit is not None and hit[0]() is col:
+            return hit[1], hit[2]
+
+    from ..native import get_native
+
+    lo = np.empty(len(col), dtype=np.uint64)
+    hi = np.empty(len(col), dtype=np.uint64)
+    native = get_native()
+    if native is not None:
+        native.hash_scalars2(
+            list(col), _hash_scalar, _hash_scalar_hi, _STR_MEMO2, lo, hi
+        )
+    else:
+        for i, v in enumerate(col):
+            lo[i] = _hash_scalar(v)
+            hi[i] = _hash_scalar_hi(v)
+    if cache_key is not None:
+        try:
+            ref = weakref.ref(
+                col, lambda _r, k=cache_key: _OBJ_HASH2_CACHE.pop(k, None)
+            )
+        except TypeError:
+            return lo, hi
+        if len(_OBJ_HASH2_CACHE) >= _OBJ_HASH_CACHE_MAX:
+            _OBJ_HASH2_CACHE.clear()
+        lo.flags.writeable = False
+        hi.flags.writeable = False
+        _OBJ_HASH2_CACHE[cache_key] = (ref, lo, hi)
+    return lo, hi
+
+
+_M64_ = (1 << 64) - 1
+
+
+def _splitmix2_int(x: int) -> int:
+    x = (x + 0xD1B54A32D192ED03) & _M64_
+    x = ((x ^ (x >> 32)) * 0xAEF17502108EF2D9) & _M64_
+    x = ((x ^ (x >> 29)) * 0xD1342543DE82EF95) & _M64_
+    return x ^ (x >> 32)
+
+
+def _hash_scalar_hi(v: Any) -> int:
+    """HI lane of the 128-bit scalar hash (native hash_scalar2 parity)."""
+    if v is None:
+        return _NONE_TAG_HI
+    if isinstance(v, (bool, np.bool_)):
+        return _splitmix2_int(int(v) + 0xB001)
+    if isinstance(v, (int, np.integer)):
+        x = (
+            int(np.int64(v).view(np.uint64))
+            if isinstance(v, np.integer)
+            else int(v) & _M64_
+        )
+        return _splitmix2_int(x)
+    if isinstance(v, (float, np.floating)):
+        return _splitmix2_int(int(np.float64(v).view(np.uint64)))
+    if isinstance(v, str):
+        return _blake16hi(v.encode("utf-8"))
+    if isinstance(v, bytes):
+        return _blake16hi(v)
+    if isinstance(v, tuple):
+        acc = _TUPLE_SEED_HI
+        for x in v:
+            acc = _splitmix2_int(acc ^ _hash_scalar_hi(x))
+        return acc
+    if isinstance(v, np.ndarray):
+        return _blake16hi(v.tobytes()) ^ _blake16hi(str(v.shape).encode())
+    return _blake16hi(repr(v).encode("utf-8"))
+
+
+def _blake16hi(data: bytes) -> int:
+    """Second word of the 16-byte BLAKE2b digest — the HI string lane.
+    A separate digest from the LO lane's 8-byte one (the blake2b param
+    block folds digest length into the IV), so lanes are independent."""
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=16).digest()[8:16], "little"
+    )
 
 
 def _hash_scalar(v: Any) -> int:
@@ -155,6 +287,28 @@ def hash_column(col: np.ndarray) -> np.ndarray:
     return _hash_object_column(col)
 
 
+def _column_lanes(col: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(LO, HI) lanes of one column's 128-bit hashes, vectorized."""
+    if col.dtype == np.uint64:
+        return _splitmix(col), _splitmix2(col)
+    if col.dtype == np.int64:
+        u = col.view(np.uint64)
+        return _splitmix(u), _splitmix2(u)
+    if col.dtype == np.float64:
+        u = col.view(np.uint64)
+        return _splitmix(u), _splitmix2(u)
+    if col.dtype == np.bool_:
+        u = col.astype(np.uint64) + np.uint64(0xB001)
+        return _splitmix(u), _splitmix2(u)
+    if col.dtype.kind in ("i", "u"):
+        u = col.astype(np.int64).view(np.uint64)
+        return _splitmix(u), _splitmix2(u)
+    if col.dtype.kind == "f":
+        u = col.astype(np.float64).view(np.uint64)
+        return _splitmix(u), _splitmix2(u)
+    return _hash_object_column2(col)
+
+
 #: reserved join-key sentinel for rows whose key expression evaluated to an
 #: Error: deterministic (retraction-consistent) yet never entered into join
 #: state — the Join node drops sentinel rows with a log entry, so Error
@@ -162,13 +316,108 @@ def hash_column(col: np.ndarray) -> np.ndarray:
 ERROR_KEY = np.uint64(0xE707_0E0E_DEAD_0001)
 
 
-def mix_columns(cols: list[np.ndarray], n: int, salt: int = 0) -> KeyArray:
-    """Derive a key per row from the given columns (vectorized).
+class KeyCollisionError(RuntimeError):
+    """Two distinct 128-bit keys collided on the 64-bit transport lane.
 
-    Used for group keys, reindexing (``with_id_from``) and pointer
-    expressions — the analog of the reference's ``Key::for_values``.
+    Probability ~n^2/2^65 per creation domain; the reference keys by the
+    full u128 (value.rs:30-47) and never conflates — we fail-stop instead
+    of silently merging two rows' state."""
+
+
+_REGISTRY = None
+_REGISTRY_WARNED = False
+
+
+class _PyKeyRegistry:
+    """Pure-python fallback registry (native module unavailable)."""
+
+    def __init__(self, cap: int):
+        self._map: dict[int, int] = {}
+        self._cap = cap
+        self.frozen = False
+
+    def register(self, lo: np.ndarray, hi: np.ndarray) -> int:
+        m = self._map
+        for i, (l, h) in enumerate(zip(lo.tolist(), hi.tolist())):
+            cur = m.get(l)
+            if cur is None:
+                if not self.frozen:
+                    m[l] = h
+                    if len(m) >= self._cap:
+                        self.frozen = True
+            elif cur != h:
+                return i
+        return -1
+
+    def stats(self):
+        return len(self._map), int(self.frozen)
+
+
+def _get_registry():
+    global _REGISTRY
+    if _REGISTRY is None:
+        import os
+
+        from ..native import get_native
+
+        cap = int(os.environ.get("PATHWAY_KEY_REGISTRY_CAP", 1 << 22))
+        native = get_native()
+        _REGISTRY = (
+            native.KeyRegistry(cap) if native is not None
+            else _PyKeyRegistry(cap)
+        )
+    return _REGISTRY
+
+
+def _register_keys(lo: np.ndarray, hi: np.ndarray) -> None:
+    global _REGISTRY_WARNED
+    reg = _get_registry()
+    idx = reg.register(
+        np.ascontiguousarray(lo, dtype=np.uint64),
+        np.ascontiguousarray(hi, dtype=np.uint64),
+    )
+    if idx >= 0:
+        raise KeyCollisionError(
+            f"64-bit key-lane collision between two distinct 128-bit keys "
+            f"(lane value {int(lo[idx]):#x}). Two different rows would have "
+            "been silently conflated; rerun with distinct key columns or "
+            "raise PATHWAY_KEY_REGISTRY_CAP if this is a re-keyed replay."
+        )
+    if not _REGISTRY_WARNED and reg.stats()[1]:
+        _REGISTRY_WARNED = True
+        import logging
+
+        logging.getLogger("pathway_tpu.keys").warning(
+            "key registry reached PATHWAY_KEY_REGISTRY_CAP; 128-bit "
+            "conflation detection is frozen to the first %d keys",
+            reg.stats()[0],
+        )
+
+
+def mix_columns(
+    cols: list[np.ndarray], n: int, salt: int = 0, register: bool = True
+) -> KeyArray:
+    """Derive a key per row from the given columns (vectorized) — the
+    analog of the reference's ``Key::for_values`` over its u128 space.
+
+    Used for group keys, reindexing (``with_id_from``), pointer
+    expressions and row ingestion. ``register=True`` (the default for
+    identity-creating callers) computes the HI lane of the 128-bit key as
+    well and registers the pair for conflation detection; sig-only callers
+    (consolidation row sigs) pass ``register=False`` and pay one lane.
     """
     acc = np.full(n, np.uint64(0xA076_1D64_78BD_642F) ^ np.uint64(salt), dtype=np.uint64)
+    if register:
+        acc_hi = np.full(
+            n, np.uint64(_ROW_SEED_HI) ^ np.uint64(salt), dtype=np.uint64
+        )
+        with np.errstate(over="ignore"):
+            for col in cols:
+                lo, hi = _column_lanes(np.asarray(col))
+                acc = _splitmix(acc ^ lo)
+                acc_hi = _splitmix2(acc_hi ^ hi)
+        _register_keys(acc, acc_hi)
+        return acc
     with np.errstate(over="ignore"):
         for col in cols:
             acc = _splitmix(acc ^ hash_column(np.asarray(col)))
@@ -186,19 +435,42 @@ def _hash_values_py(rows: list[tuple], salt: int = 0) -> KeyArray:
     return np.array(out, dtype=np.uint64)
 
 
-def hash_values(rows: Iterable[tuple], salt: int = 0) -> KeyArray:
+def hash_values(
+    rows: Iterable[tuple], salt: int = 0, register: bool = True
+) -> KeyArray:
     """Hash python row tuples — the row-ingestion hot path. Runs in the
     native C kernel when available (bit-identical; the reference's Rust
-    xxh3 keyspace analog, value.rs:30-75), pure Python otherwise."""
+    xxh3-u128 keyspace analog, value.rs:30-75), pure Python otherwise.
+    ``register=True`` also derives the HI lane and registers the 128-bit
+    pair for conflation detection."""
     from ..native import get_native
 
     rows = rows if isinstance(rows, list) else list(rows)
     native = get_native()  # memoized; O(1) after first call
+    salt64 = int(salt) & 0xFFFFFFFFFFFFFFFF
+    if not register:
+        if native is None:
+            return _hash_values_py(rows, salt)
+        out = np.empty(len(rows), dtype=np.uint64)
+        native.hash_rows(rows, salt64, _hash_scalar, out)
+        return out
+    lo = np.empty(len(rows), dtype=np.uint64)
+    hi = np.empty(len(rows), dtype=np.uint64)
     if native is None:
-        return _hash_values_py(rows, salt)
-    out = np.empty(len(rows), dtype=np.uint64)
-    native.hash_rows(rows, int(salt) & 0xFFFFFFFFFFFFFFFF, _hash_scalar, out)
-    return out
+        lo = _hash_values_py(rows, salt)
+        base = _ROW_SEED_HI ^ salt64
+        for i, row in enumerate(rows):
+            acc = base
+            for v in row:
+                acc = _splitmix2_int(acc ^ _hash_scalar_hi(v))
+            hi[i] = acc
+    else:
+        native.hash_rows2(
+            rows, salt64, salt64, _hash_scalar, _hash_scalar_hi,
+            _STR_MEMO2, lo, hi,
+        )
+    _register_keys(lo, hi)
+    return lo
 
 
 def pointer_from_ints(vals: np.ndarray) -> KeyArray:

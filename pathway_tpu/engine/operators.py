@@ -810,7 +810,9 @@ class _SortedSide:
         counts = np.concatenate([r[3] for r in self._runs])
         n = len(jks)
         # row identity = (jk, row_key, values); multiplicities sum, zeros drop
-        sig = K.derive_pair(K.derive_pair(jks, keys), K.mix_columns(cols, n))
+        sig = K.derive_pair(
+            K.derive_pair(jks, keys), K.mix_columns(cols, n, register=False)
+        )
         order = np.argsort(sig, kind="stable")
         ss = sig[order]
         starts = np.concatenate([[0], np.flatnonzero(np.diff(ss) != 0) + 1])

@@ -124,8 +124,9 @@ def rows_to_table(
         col_order = names
 
     n = len(rows)
+    _ix = {name: names.index(name) for name in col_order}
     data = {
-        name: column_of_values([r[names.index(name)] for r in rows])
+        name: column_of_values([r[_ix[name]] for r in rows])
         for name in col_order
     }
     if schema is None:

@@ -101,6 +101,29 @@ static uint64_t blake2b8(const uint8_t *data, Py_ssize_t len) {
     return h[0];
 }
 
+/* second 8 bytes (little-endian) of hashlib.blake2b(data, digest_size=16)
+ * — the HI key lane for strings/bytes. A separate digest from blake2b8:
+ * the blake2b parameter block folds the digest length into h[0], so the
+ * 16-byte digest is independent of the 8-byte one (the lanes must not be
+ * derivable from each other or low-lane collisions would always agree on
+ * the high lane and conflation detection could never fire). */
+static uint64_t blake2b16hi(const uint8_t *data, Py_ssize_t len) {
+    uint64_t h[8];
+    uint8_t block[128];
+    Py_ssize_t remaining = len, off = 0;
+    memcpy(h, blake2b_iv, sizeof(h));
+    h[0] ^= 0x01010000ULL ^ 16ULL; /* digest_size=16 */
+    while (remaining > 128) {
+        blake2b_compress(h, data + off, (uint64_t)(off + 128), 0);
+        off += 128;
+        remaining -= 128;
+    }
+    memset(block, 0, sizeof(block));
+    if (remaining > 0) memcpy(block, data + off, (size_t)remaining);
+    blake2b_compress(h, block, (uint64_t)len, 1);
+    return h[1];
+}
+
 /* ----------------------------------------------------------------- */
 /* splitmix64 finalizer — must match keys._splitmix exactly           */
 
@@ -114,6 +137,21 @@ static inline uint64_t splitmix(uint64_t x) {
 #define NONE_TAG 0x736E6F6E65736E6FULL
 #define TUPLE_SEED 0x9E37ULL
 #define ROW_SEED 0xA0761D6478BD642FULL
+
+/* HI key lane (the upper 64 bits of the 128-bit keyspace): same scalar
+ * taxonomy as the LO lane but mixed with an independent finalizer
+ * (moremur constants) so the lanes never co-collide. Must match
+ * keys._hash_scalar_hi / keys._splitmix2 bit-for-bit. */
+#define NONE_TAG_HI 0x6E6F6E655F686921ULL
+#define TUPLE_SEED_HI 0xD1B5ULL
+#define ROW_SEED_HI 0xE7037ED1A0B428DBULL
+
+static inline uint64_t splitmix2(uint64_t x) {
+    x += 0xD1B54A32D192ED03ULL;
+    x = (x ^ (x >> 32)) * 0xAEF17502108EF2D9ULL;
+    x = (x ^ (x >> 29)) * 0xD1342543DE82EF95ULL;
+    return x ^ (x >> 32);
+}
 
 /* hash one scalar with keys._hash_scalar semantics; `fallback` is the
  * Python implementation used for types this C path doesn't know
@@ -177,6 +215,247 @@ static int hash_scalar(PyObject *v, PyObject *fallback, uint64_t *out) {
     }
 }
 
+/* hash one scalar on BOTH key lanes. fb_lo/fb_hi are the Python fallback
+ * implementations for types this C path doesn't know. */
+static int hash_scalar2(PyObject *v, PyObject *fb_lo, PyObject *fb_hi,
+                        uint64_t *lo, uint64_t *hi) {
+    if (v == Py_None) {
+        *lo = NONE_TAG;
+        *hi = NONE_TAG_HI;
+        return 0;
+    }
+    if (PyBool_Check(v)) {
+        uint64_t x = (v == Py_True ? 1ULL : 0ULL) + 0xB001ULL;
+        *lo = splitmix(x);
+        *hi = splitmix2(x);
+        return 0;
+    }
+    if (PyLong_CheckExact(v)) {
+        uint64_t x = PyLong_AsUnsignedLongLongMask(v);
+        if (x == (uint64_t)-1 && PyErr_Occurred()) return -1;
+        *lo = splitmix(x);
+        *hi = splitmix2(x);
+        return 0;
+    }
+    if (PyFloat_CheckExact(v)) {
+        double d = PyFloat_AS_DOUBLE(v);
+        uint64_t bits;
+        memcpy(&bits, &d, 8);
+        *lo = splitmix(bits);
+        *hi = splitmix2(bits);
+        return 0;
+    }
+    if (PyUnicode_CheckExact(v)) {
+        Py_ssize_t len;
+        const char *utf8 = PyUnicode_AsUTF8AndSize(v, &len);
+        if (utf8 == NULL) return -1;
+        *lo = blake2b8((const uint8_t *)utf8, len);
+        *hi = blake2b16hi((const uint8_t *)utf8, len);
+        return 0;
+    }
+    if (PyBytes_CheckExact(v)) {
+        *lo = blake2b8((const uint8_t *)PyBytes_AS_STRING(v),
+                       PyBytes_GET_SIZE(v));
+        *hi = blake2b16hi((const uint8_t *)PyBytes_AS_STRING(v),
+                          PyBytes_GET_SIZE(v));
+        return 0;
+    }
+    if (PyTuple_CheckExact(v)) {
+        uint64_t acc_lo = TUPLE_SEED, acc_hi = TUPLE_SEED_HI, l, h;
+        Py_ssize_t i, n = PyTuple_GET_SIZE(v);
+        for (i = 0; i < n; i++) {
+            if (hash_scalar2(PyTuple_GET_ITEM(v, i), fb_lo, fb_hi, &l, &h) < 0)
+                return -1;
+            acc_lo = splitmix(acc_lo ^ l);
+            acc_hi = splitmix2(acc_hi ^ h);
+        }
+        *lo = acc_lo;
+        *hi = acc_hi;
+        return 0;
+    }
+    {
+        PyObject *res = PyObject_CallFunctionObjArgs(fb_lo, v, NULL);
+        uint64_t x;
+        if (res == NULL) return -1;
+        x = PyLong_AsUnsignedLongLongMask(res);
+        Py_DECREF(res);
+        if (x == (uint64_t)-1 && PyErr_Occurred()) return -1;
+        *lo = x;
+        res = PyObject_CallFunctionObjArgs(fb_hi, v, NULL);
+        if (res == NULL) return -1;
+        x = PyLong_AsUnsignedLongLongMask(res);
+        Py_DECREF(res);
+        if (x == (uint64_t)-1 && PyErr_Occurred()) return -1;
+        *hi = x;
+        return 0;
+    }
+}
+
+#define STR_MEMO_CAP 65536
+
+/* memoized two-lane hash of an exact str: the stream hot path hashes the
+ * same (equal-valued) words every tick — a dict probe (~40ns) replaces
+ * two BLAKE2b digests (~600ns). memo may be NULL. */
+static int hash_scalar2_memo(PyObject *v, PyObject *fb_lo, PyObject *fb_hi,
+                             PyObject *memo, uint64_t *lo, uint64_t *hi) {
+    PyObject *hit, *pair, *plo, *phi;
+    if (memo == NULL || !PyUnicode_CheckExact(v))
+        return hash_scalar2(v, fb_lo, fb_hi, lo, hi);
+    hit = PyDict_GetItemWithError(memo, v); /* borrowed */
+    if (hit != NULL) {
+        *lo = PyLong_AsUnsignedLongLongMask(PyTuple_GET_ITEM(hit, 0));
+        *hi = PyLong_AsUnsignedLongLongMask(PyTuple_GET_ITEM(hit, 1));
+        return 0;
+    }
+    if (PyErr_Occurred()) return -1;
+    if (hash_scalar2(v, fb_lo, fb_hi, lo, hi) < 0) return -1;
+    if (PyDict_GET_SIZE(memo) >= STR_MEMO_CAP) PyDict_Clear(memo);
+    plo = PyLong_FromUnsignedLongLong(*lo);
+    phi = PyLong_FromUnsignedLongLong(*hi);
+    if (plo == NULL || phi == NULL) {
+        Py_XDECREF(plo); Py_XDECREF(phi);
+        return -1;
+    }
+    pair = PyTuple_Pack(2, plo, phi);
+    Py_DECREF(plo); Py_DECREF(phi);
+    if (pair == NULL) return -1;
+    if (PyDict_SetItem(memo, v, pair) < 0) {
+        Py_DECREF(pair);
+        return -1;
+    }
+    Py_DECREF(pair);
+    return 0;
+}
+
+/* hash_scalars2(values, fb_lo, fb_hi, memo_or_None,
+ *               out_lo_u64, out_hi_u64) -> None */
+static PyObject *py_hash_scalars2(PyObject *self, PyObject *args) {
+    PyObject *values, *fb_lo, *fb_hi, *memo, *lo_obj, *hi_obj;
+    Py_buffer lo, hi;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OOOOOO", &values, &fb_lo, &fb_hi, &memo,
+                          &lo_obj, &hi_obj))
+        return NULL;
+    if (memo == Py_None) memo = NULL;
+    if (PyObject_GetBuffer(lo_obj, &lo, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0)
+        return NULL;
+    if (PyObject_GetBuffer(hi_obj, &hi, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0) {
+        PyBuffer_Release(&lo);
+        return NULL;
+    }
+    {
+        PyObject *seq = PySequence_Fast(values, "values must be a sequence");
+        Py_ssize_t n, i;
+        uint64_t *dlo = (uint64_t *)lo.buf, *dhi = (uint64_t *)hi.buf;
+        if (seq == NULL) goto fail;
+        n = PySequence_Fast_GET_SIZE(seq);
+        if ((Py_ssize_t)(lo.len / 8) < n || (Py_ssize_t)(hi.len / 8) < n) {
+            Py_DECREF(seq);
+            PyErr_SetString(PyExc_ValueError, "output buffer too small");
+            goto fail;
+        }
+        for (i = 0; i < n; i++) {
+            if (hash_scalar2_memo(PySequence_Fast_GET_ITEM(seq, i), fb_lo,
+                                  fb_hi, memo, &dlo[i], &dhi[i]) < 0) {
+                Py_DECREF(seq);
+                goto fail;
+            }
+        }
+        Py_DECREF(seq);
+    }
+    PyBuffer_Release(&lo);
+    PyBuffer_Release(&hi);
+    Py_RETURN_NONE;
+fail:
+    PyBuffer_Release(&lo);
+    PyBuffer_Release(&hi);
+    return NULL;
+}
+
+/* hash_rows2(rows, salt_lo, salt_hi, fb_lo, fb_hi, memo_or_None,
+ *            out_lo_u64, out_hi_u64) -> None — both key lanes per row */
+static PyObject *py_hash_rows2(PyObject *self, PyObject *args) {
+    PyObject *rows, *fb_lo, *fb_hi, *memo, *lo_obj, *hi_obj;
+    unsigned long long salt_lo, salt_hi;
+    Py_buffer lo, hi;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OKKOOOOO", &rows, &salt_lo, &salt_hi,
+                          &fb_lo, &fb_hi, &memo, &lo_obj, &hi_obj))
+        return NULL;
+    if (memo == Py_None) memo = NULL;
+    if (PyObject_GetBuffer(lo_obj, &lo, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0)
+        return NULL;
+    if (PyObject_GetBuffer(hi_obj, &hi, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0) {
+        PyBuffer_Release(&lo);
+        return NULL;
+    }
+    {
+        PyObject *seq = PySequence_Fast(rows, "rows must be a sequence");
+        Py_ssize_t n, i;
+        uint64_t *dlo = (uint64_t *)lo.buf, *dhi = (uint64_t *)hi.buf;
+        if (seq == NULL) goto fail;
+        n = PySequence_Fast_GET_SIZE(seq);
+        if ((Py_ssize_t)(lo.len / 8) < n || (Py_ssize_t)(hi.len / 8) < n) {
+            Py_DECREF(seq);
+            PyErr_SetString(PyExc_ValueError, "output buffer too small");
+            goto fail;
+        }
+        for (i = 0; i < n; i++) {
+            PyObject *row = PySequence_Fast_GET_ITEM(seq, i);
+            uint64_t acc_lo = ROW_SEED ^ (uint64_t)salt_lo;
+            uint64_t acc_hi = ROW_SEED_HI ^ (uint64_t)salt_hi;
+            uint64_t l, h;
+            Py_ssize_t j, m;
+            PyObject *rowseq = PySequence_Fast(row, "row must be a sequence");
+            if (rowseq == NULL) {
+                Py_DECREF(seq);
+                goto fail;
+            }
+            m = PySequence_Fast_GET_SIZE(rowseq);
+            for (j = 0; j < m; j++) {
+                if (hash_scalar2_memo(PySequence_Fast_GET_ITEM(rowseq, j),
+                                      fb_lo, fb_hi, memo, &l, &h) < 0) {
+                    Py_DECREF(rowseq);
+                    Py_DECREF(seq);
+                    goto fail;
+                }
+                acc_lo = splitmix(acc_lo ^ l);
+                acc_hi = splitmix2(acc_hi ^ h);
+            }
+            Py_DECREF(rowseq);
+            dlo[i] = acc_lo;
+            dhi[i] = acc_hi;
+        }
+        Py_DECREF(seq);
+    }
+    PyBuffer_Release(&lo);
+    PyBuffer_Release(&hi);
+    Py_RETURN_NONE;
+fail:
+    PyBuffer_Release(&lo);
+    PyBuffer_Release(&hi);
+    return NULL;
+}
+
+/* splitmix64_2(x: int) -> int — HI-lane finalizer, for parity tests */
+static PyObject *py_splitmix2(PyObject *self, PyObject *arg) {
+    unsigned long long x = PyLong_AsUnsignedLongLongMask(arg);
+    (void)self;
+    if (x == (unsigned long long)-1 && PyErr_Occurred()) return NULL;
+    return PyLong_FromUnsignedLongLong(splitmix2(x));
+}
+
+/* blake2b16hi(data) -> int — HI string lane, for parity tests */
+static PyObject *py_blake2b16hi(PyObject *self, PyObject *arg) {
+    Py_buffer buf;
+    uint64_t h;
+    (void)self;
+    if (PyObject_GetBuffer(arg, &buf, PyBUF_C_CONTIGUOUS) < 0) return NULL;
+    h = blake2b16hi((const uint8_t *)buf.buf, buf.len);
+    PyBuffer_Release(&buf);
+    return PyLong_FromUnsignedLongLong(h);
+}
+
 /* hash_rows(rows: sequence of tuples, salt: int, fallback, out: writable
  * uint64 buffer of len(rows)) -> None */
 static PyObject *py_hash_rows(PyObject *self, PyObject *args) {
@@ -233,14 +512,40 @@ static PyObject *py_hash_rows(PyObject *self, PyObject *args) {
     Py_RETURN_NONE;
 }
 
-/* hash_scalars(values: sequence, fallback, out: writable uint64 buffer)
- * -> None — per-element hash_scalar (group-key/hash_column hot path) */
+/* memoized LO-lane hash of an exact str (see hash_scalar2_memo) */
+static int hash_scalar_memo(PyObject *v, PyObject *fallback, PyObject *memo,
+                            uint64_t *out) {
+    PyObject *hit, *plo;
+    if (memo == NULL || !PyUnicode_CheckExact(v))
+        return hash_scalar(v, fallback, out);
+    hit = PyDict_GetItemWithError(memo, v); /* borrowed */
+    if (hit != NULL) {
+        *out = PyLong_AsUnsignedLongLongMask(hit);
+        return 0;
+    }
+    if (PyErr_Occurred()) return -1;
+    if (hash_scalar(v, fallback, out) < 0) return -1;
+    if (PyDict_GET_SIZE(memo) >= STR_MEMO_CAP) PyDict_Clear(memo);
+    plo = PyLong_FromUnsignedLongLong(*out);
+    if (plo == NULL) return -1;
+    if (PyDict_SetItem(memo, v, plo) < 0) {
+        Py_DECREF(plo);
+        return -1;
+    }
+    Py_DECREF(plo);
+    return 0;
+}
+
+/* hash_scalars(values: sequence, fallback, out: writable uint64 buffer
+ * [, memo_dict]) -> None — per-element hash_scalar (group-key/hash_column
+ * hot path; the optional memo caches string digests value-wise) */
 static PyObject *py_hash_scalars(PyObject *self, PyObject *args) {
-    PyObject *values, *fallback, *out_obj;
+    PyObject *values, *fallback, *out_obj, *memo = NULL;
     Py_buffer out;
     (void)self;
-    if (!PyArg_ParseTuple(args, "OOO", &values, &fallback, &out_obj))
+    if (!PyArg_ParseTuple(args, "OOO|O", &values, &fallback, &out_obj, &memo))
         return NULL;
+    if (memo == Py_None) memo = NULL;
     if (PyObject_GetBuffer(out_obj, &out, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0)
         return NULL;
     {
@@ -259,8 +564,8 @@ static PyObject *py_hash_scalars(PyObject *self, PyObject *args) {
             return NULL;
         }
         for (i = 0; i < n; i++) {
-            if (hash_scalar(PySequence_Fast_GET_ITEM(seq, i), fallback,
-                            &dst[i]) < 0) {
+            if (hash_scalar_memo(PySequence_Fast_GET_ITEM(seq, i), fallback,
+                                 memo, &dst[i]) < 0) {
                 Py_DECREF(seq);
                 PyBuffer_Release(&out);
                 return NULL;
@@ -469,13 +774,172 @@ static PyTypeObject KeyTableType = {
     .tp_new = keytable_new,
 };
 
+/* ----------------------------------------------------------------- */
+/* KeyRegistry — process-wide LO->HI lane map for 128-bit key          */
+/* conflation detection. Keys are created as 128-bit values (two       */
+/* independent lanes); the engine transports the LO lane in its        */
+/* vectorized uint64 arrays, and every key-creation batch registers    */
+/* (lo, hi) here: a lo that re-registers with a DIFFERENT hi is two    */
+/* distinct 128-bit keys colliding on the transport lane — fail-stop   */
+/* instead of silent row conflation (reference keys by the full u128,  */
+/* value.rs:30-47, so it never conflates; we detect at the same        */
+/* probability scale). Bounded: at cap the registry freezes (existing  */
+/* entries still detect; new keys pass unchecked) — callers log once.  */
+
+typedef struct {
+    PyObject_HEAD
+    uint64_t *keys;
+    uint64_t *his;
+    uint8_t *used;
+    Py_ssize_t capacity; /* power of two */
+    Py_ssize_t size;
+    Py_ssize_t max_entries;
+    int frozen;
+} KeyRegistryObject;
+
+static int keyregistry_grow(KeyRegistryObject *t, Py_ssize_t min_capacity) {
+    Py_ssize_t new_cap = t->capacity ? t->capacity : 1024;
+    uint64_t *nk, *nh;
+    uint8_t *nu;
+    Py_ssize_t i;
+    while (new_cap < min_capacity) new_cap <<= 1;
+    nk = (uint64_t *)malloc((size_t)new_cap * 8);
+    nh = (uint64_t *)malloc((size_t)new_cap * 8);
+    nu = (uint8_t *)calloc((size_t)new_cap, 1);
+    if (!nk || !nh || !nu) {
+        free(nk); free(nh); free(nu);
+        PyErr_NoMemory();
+        return -1;
+    }
+    for (i = 0; i < t->capacity; i++) {
+        if (t->used[i]) {
+            uint64_t h = splitmix(t->keys[i]);
+            Py_ssize_t j = (Py_ssize_t)(h & (uint64_t)(new_cap - 1));
+            while (nu[j]) j = (j + 1) & (new_cap - 1);
+            nu[j] = 1;
+            nk[j] = t->keys[i];
+            nh[j] = t->his[i];
+        }
+    }
+    free(t->keys); free(t->his); free(t->used);
+    t->keys = nk; t->his = nh; t->used = nu;
+    t->capacity = new_cap;
+    return 0;
+}
+
+/* register(lo_u64_buf, hi_u64_buf) -> first conflicting index or -1 */
+static PyObject *keyregistry_register(PyObject *self, PyObject *args) {
+    KeyRegistryObject *t = (KeyRegistryObject *)self;
+    PyObject *lo_obj, *hi_obj;
+    Py_buffer lo, hi;
+    Py_ssize_t n, i, conflict = -1;
+    if (!PyArg_ParseTuple(args, "OO", &lo_obj, &hi_obj)) return NULL;
+    if (PyObject_GetBuffer(lo_obj, &lo, PyBUF_C_CONTIGUOUS) < 0) return NULL;
+    if (PyObject_GetBuffer(hi_obj, &hi, PyBUF_C_CONTIGUOUS) < 0) {
+        PyBuffer_Release(&lo);
+        return NULL;
+    }
+    n = lo.len / 8;
+    if (hi.len / 8 < n) {
+        PyBuffer_Release(&lo); PyBuffer_Release(&hi);
+        PyErr_SetString(PyExc_ValueError, "hi buffer too small");
+        return NULL;
+    }
+    if (!t->frozen && (t->size + n) * 10 >= t->capacity * 7) {
+        /* clamp to 2x the entry cap: the insert loop freezes at
+         * max_entries, so load factor stays <= 0.5 in the frozen table */
+        Py_ssize_t want = (t->size + n) * 2;
+        if (want > t->max_entries * 2) want = t->max_entries * 2;
+        if (want > t->capacity && keyregistry_grow(t, want) < 0) {
+            PyBuffer_Release(&lo); PyBuffer_Release(&hi);
+            return NULL;
+        }
+    }
+    if (t->capacity) {
+        const uint64_t *slo = (const uint64_t *)lo.buf;
+        const uint64_t *shi = (const uint64_t *)hi.buf;
+        uint64_t mask = (uint64_t)(t->capacity - 1);
+        for (i = 0; i < n; i++) {
+            uint64_t k = slo[i];
+            Py_ssize_t j = (Py_ssize_t)(splitmix(k) & mask);
+            while (t->used[j] && t->keys[j] != k) j = (j + 1) & mask;
+            if (t->used[j]) {
+                if (t->his[j] != shi[i]) {
+                    conflict = i;
+                    break;
+                }
+            } else if (!t->frozen) {
+                t->used[j] = 1;
+                t->keys[j] = k;
+                t->his[j] = shi[i];
+                t->size++;
+                if (t->size >= t->max_entries) t->frozen = 1;
+            }
+        }
+    }
+    PyBuffer_Release(&lo);
+    PyBuffer_Release(&hi);
+    return PyLong_FromSsize_t(conflict);
+}
+
+static PyObject *keyregistry_stats(PyObject *self, PyObject *noarg) {
+    KeyRegistryObject *t = (KeyRegistryObject *)self;
+    (void)noarg;
+    return Py_BuildValue("(ni)", t->size, t->frozen);
+}
+
+static void keyregistry_dealloc(PyObject *self) {
+    KeyRegistryObject *t = (KeyRegistryObject *)self;
+    free(t->keys); free(t->his); free(t->used);
+    Py_TYPE(self)->tp_free(self);
+}
+
+static PyObject *keyregistry_new(PyTypeObject *type, PyObject *args,
+                                 PyObject *kwds) {
+    KeyRegistryObject *t;
+    Py_ssize_t max_entries = 1 << 22;
+    (void)kwds;
+    if (!PyArg_ParseTuple(args, "|n", &max_entries)) return NULL;
+    t = (KeyRegistryObject *)type->tp_alloc(type, 0);
+    if (t == NULL) return NULL;
+    t->keys = NULL; t->his = NULL; t->used = NULL;
+    t->capacity = 0; t->size = 0; t->frozen = 0;
+    t->max_entries = max_entries > 0 ? max_entries : 1;
+    return (PyObject *)t;
+}
+
+static PyMethodDef keyregistry_methods[] = {
+    {"register", keyregistry_register, METH_VARARGS,
+     "register(lo_u64, hi_u64) -> first conflicting index or -1"},
+    {"stats", keyregistry_stats, METH_NOARGS, "stats() -> (size, frozen)"},
+    {NULL, NULL, 0, NULL},
+};
+
+static PyTypeObject KeyRegistryType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "_pathway_native.KeyRegistry",
+    .tp_basicsize = sizeof(KeyRegistryObject),
+    .tp_dealloc = keyregistry_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "lo->hi key-lane registry for 128-bit conflation detection",
+    .tp_methods = keyregistry_methods,
+    .tp_new = keyregistry_new,
+};
+
 static PyMethodDef methods[] = {
     {"hash_rows", py_hash_rows, METH_VARARGS,
      "hash_rows(rows, salt, fallback, out_uint64_buffer)"},
     {"hash_scalars", py_hash_scalars, METH_VARARGS,
-     "hash_scalars(values, fallback, out_uint64_buffer)"},
+     "hash_scalars(values, fallback, out_uint64_buffer[, memo])"},
+    {"hash_rows2", py_hash_rows2, METH_VARARGS,
+     "hash_rows2(rows, salt_lo, salt_hi, fb_lo, fb_hi, memo, out_lo, out_hi)"},
+    {"hash_scalars2", py_hash_scalars2, METH_VARARGS,
+     "hash_scalars2(values, fb_lo, fb_hi, memo, out_lo, out_hi)"},
     {"blake2b8", py_blake2b8, METH_O, "8-byte BLAKE2b digest as uint64"},
+    {"blake2b16hi", py_blake2b16hi, METH_O,
+     "second word of the 16-byte BLAKE2b digest (HI string lane)"},
     {"splitmix64", py_splitmix, METH_O, "splitmix64 finalizer"},
+    {"splitmix64_2", py_splitmix2, METH_O, "HI-lane finalizer"},
     {NULL, NULL, 0, NULL},
 };
 
@@ -493,6 +957,13 @@ PyMODINIT_FUNC PyInit__pathway_native(void) {
     Py_INCREF(&KeyTableType);
     if (PyModule_AddObject(m, "KeyTable", (PyObject *)&KeyTableType) < 0) {
         Py_DECREF(&KeyTableType);
+        Py_DECREF(m);
+        return NULL;
+    }
+    if (PyType_Ready(&KeyRegistryType) < 0) return NULL;
+    Py_INCREF(&KeyRegistryType);
+    if (PyModule_AddObject(m, "KeyRegistry", (PyObject *)&KeyRegistryType) < 0) {
+        Py_DECREF(&KeyRegistryType);
         Py_DECREF(m);
         return NULL;
     }

@@ -77,3 +77,144 @@ def test_native_speedup_on_string_rows():
     # native should be dramatically faster; 3x is a conservative floor
     assert t_native * 3 < t_py, (t_native, t_py)
 
+
+
+# -- 128-bit keyspace: HI lane parity + conflation detection -----------------
+
+
+def test_blake2b16hi_matches_hashlib():
+    for data in (b"", b"hello", b"x" * 1000, "héllo".encode()):
+        exp = int.from_bytes(
+            hashlib.blake2b(data, digest_size=16).digest()[8:16], "little"
+        )
+        assert native.blake2b16hi(data) == exp
+
+
+def test_splitmix2_matches_python():
+    for x in (0, 1, 2**63, 0xDEADBEEF, 2**64 - 1):
+        assert native.splitmix64_2(x) == K._splitmix2_int(x)
+        assert native.splitmix64_2(x) == int(K._splitmix2(np.uint64(x)))
+
+
+def test_hash_scalars2_parity_with_python():
+    flat = [v for row in CORPUS_ROWS for v in row]
+    lo = np.empty(len(flat), dtype=np.uint64)
+    hi = np.empty(len(flat), dtype=np.uint64)
+    native.hash_scalars2(flat, K._hash_scalar, K._hash_scalar_hi, None, lo, hi)
+    for i, v in enumerate(flat):
+        assert int(lo[i]) == K._hash_scalar(v) & ((1 << 64) - 1), v
+        assert int(hi[i]) == K._hash_scalar_hi(v), v
+
+
+def test_hash_rows2_lo_lane_bit_identical_to_hash_rows():
+    # the LO lane is the persisted engine keyspace: widening must not
+    # change a single existing key
+    lo = np.empty(len(CORPUS_ROWS), dtype=np.uint64)
+    hi = np.empty(len(CORPUS_ROWS), dtype=np.uint64)
+    native.hash_rows2(
+        CORPUS_ROWS, 7, 7, K._hash_scalar, K._hash_scalar_hi, None, lo, hi
+    )
+    old = np.empty(len(CORPUS_ROWS), dtype=np.uint64)
+    native.hash_rows(CORPUS_ROWS, 7, K._hash_scalar, old)
+    assert list(lo) == list(old)
+    assert list(lo) == list(K._hash_values_py(CORPUS_ROWS, 7))
+
+
+def test_hi_lane_independent_of_lo_lane():
+    # if HI were a function of LO, lane collisions would always agree on
+    # HI and detection could never fire; check the lanes decorrelate
+    vals = [f"s{i}" for i in range(64)] + list(range(64))
+    lo = np.empty(len(vals), dtype=np.uint64)
+    hi = np.empty(len(vals), dtype=np.uint64)
+    native.hash_scalars2(vals, K._hash_scalar, K._hash_scalar_hi, None, lo, hi)
+    assert len(set(map(int, lo))) == len(vals)
+    assert len(set(map(int, hi))) == len(vals)
+    assert not np.any(lo == hi)
+
+
+def test_string_memo_bit_identical():
+    vals = ["alpha", "beta", "alpha", "beta", "alpha"] * 10
+    memo: dict = {}
+    lo_m = np.empty(len(vals), dtype=np.uint64)
+    hi_m = np.empty(len(vals), dtype=np.uint64)
+    native.hash_scalars2(vals, K._hash_scalar, K._hash_scalar_hi, memo, lo_m, hi_m)
+    lo = np.empty(len(vals), dtype=np.uint64)
+    hi = np.empty(len(vals), dtype=np.uint64)
+    native.hash_scalars2(vals, K._hash_scalar, K._hash_scalar_hi, None, lo, hi)
+    assert list(lo_m) == list(lo) and list(hi_m) == list(hi)
+    assert set(memo) == {"alpha", "beta"}
+    out_m = np.empty(len(vals), dtype=np.uint64)
+    lomemo: dict = {}
+    native.hash_scalars(vals, K._hash_scalar, out_m, lomemo)
+    assert list(out_m) == list(lo)
+
+
+def test_key_registry_detects_lane_collision():
+    reg = native.KeyRegistry(1000)
+    lo = np.array([10, 20, 30], dtype=np.uint64)
+    hi = np.array([1, 2, 3], dtype=np.uint64)
+    assert reg.register(lo, hi) == -1
+    assert reg.register(lo, hi) == -1  # re-registering same keys is fine
+    clash_lo = np.array([20], dtype=np.uint64)
+    clash_hi = np.array([99], dtype=np.uint64)
+    assert reg.register(clash_lo, clash_hi) == 0
+    assert reg.stats()[0] == 3
+
+
+def test_key_registry_freezes_at_cap():
+    reg = native.KeyRegistry(4)
+    lo = np.arange(100, 110, dtype=np.uint64)
+    hi = np.arange(200, 210, dtype=np.uint64)
+    assert reg.register(lo, hi) == -1
+    size, frozen = reg.stats()
+    assert frozen == 1 and size <= 8
+    # frozen: registered prefix still detects, unregistered keys pass
+    assert reg.register(np.array([100], np.uint64), np.array([5], np.uint64)) == 0
+
+
+def test_register_keys_raises_key_collision_error():
+    import pathway_tpu.engine.keys as keys_mod
+
+    saved = keys_mod._REGISTRY
+    keys_mod._REGISTRY = None
+    try:
+        keys_mod._get_registry()
+        keys_mod._register_keys(
+            np.array([77], dtype=np.uint64), np.array([1], dtype=np.uint64)
+        )
+        with pytest.raises(K.KeyCollisionError, match="collision"):
+            keys_mod._register_keys(
+                np.array([77], dtype=np.uint64), np.array([2], dtype=np.uint64)
+            )
+    finally:
+        keys_mod._REGISTRY = saved
+
+
+def test_py_key_registry_matches_native_semantics():
+    pyreg = K._PyKeyRegistry(1000)
+    lo = np.array([10, 20], dtype=np.uint64)
+    hi = np.array([1, 2], dtype=np.uint64)
+    assert pyreg.register(lo, hi) == -1
+    assert pyreg.register(np.array([20], np.uint64), np.array([9], np.uint64)) == 0
+
+
+def test_mix_columns_registers_and_detects_synthetic_conflation(monkeypatch):
+    # two different value columns whose LO lanes collide (forced via a
+    # stubbed LO hash) must fail the run instead of conflating rows
+    import pathway_tpu.engine.keys as keys_mod
+
+    saved = keys_mod._REGISTRY
+    keys_mod._REGISTRY = None
+    try:
+        keys_mod._get_registry()
+        a = keys_mod.mix_columns([np.array(["x1"], dtype=object)], 1)
+        # same LO fold can only repeat with the same values -> no error
+        keys_mod.mix_columns([np.array(["x1"], dtype=object)], 1)
+        # now register a forged pair with the same LO but different HI
+        with pytest.raises(K.KeyCollisionError):
+            keys_mod._register_keys(
+                np.asarray(a, dtype=np.uint64),
+                np.array([0xBAD], dtype=np.uint64),
+            )
+    finally:
+        keys_mod._REGISTRY = saved
