@@ -48,8 +48,12 @@ class Backend:
         return cls("memory", name=name)
 
     @classmethod
-    def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
-        return cls("s3", root_path=root_path, bucket_settings=bucket_settings)
+    def s3(cls, root_path: str, bucket_settings: Any = None,
+           _client: Any = None) -> "Backend":
+        """``root_path`` = ``s3://bucket/prefix``; ``_client`` injects a
+        boto3-surface client (tests run against an in-memory fake)."""
+        return cls("s3", root_path=root_path,
+                   bucket_settings=bucket_settings, _client=_client)
 
 
 @dataclass

@@ -147,21 +147,91 @@ class PrefixBackend(PersistenceBackend):
 
 
 class S3Backend(PersistenceBackend):
-    """S3/GCS object-store backend (``backends/s3.rs:34``). Requires boto3,
-    which is not part of the baked environment — gated import."""
+    """S3/GCS object-store backend (``backends/s3.rs:34``).
 
-    def __init__(self, root_path: str, bucket_settings: Any = None):
+    ``root_path`` is ``s3://bucket/prefix``; keys map to objects under the
+    prefix. Speaks the boto3 S3 client surface (``get_object`` /
+    ``put_object`` / ``delete_object`` / paginated ``list_objects_v2``) —
+    the client is injectable (``client=``) so the backend is fully
+    exercisable against a fake without credentials, matching the repo's
+    other client-gated connectors; without one, boto3 is required (not in
+    the baked environment)."""
+
+    def __init__(self, root_path: str, bucket_settings: Any = None,
+                 client: Any = None):
+        if root_path.startswith("s3://"):
+            rest = root_path[len("s3://"):]
+            bucket, _, prefix = rest.partition("/")
+        else:
+            bucket, _, prefix = root_path.partition("/")
+        if not bucket:
+            raise ValueError(f"S3 root_path has no bucket: {root_path!r}")
+        self._bucket = bucket
+        self._prefix = prefix.strip("/")
+        if self._prefix:
+            self._prefix += "/"
+        if client is None:
+            try:
+                import boto3  # type: ignore[import-not-found]
+            except ImportError as e:  # pragma: no cover - env has no boto3
+                raise ImportError(
+                    "pw.persistence.Backend.s3 requires the 'boto3' package "
+                    "(or pass client=)"
+                ) from e
+            kwargs: dict[str, Any] = {}
+            s = bucket_settings
+            if s is not None:  # reference AwsCredentials/endpoint analog
+                for attr, kw in (
+                    ("endpoint", "endpoint_url"),
+                    ("region", "region_name"),
+                    ("access_key", "aws_access_key_id"),
+                    ("secret_access_key", "aws_secret_access_key"),
+                ):
+                    v = getattr(s, attr, None) if not isinstance(s, dict) else s.get(attr)
+                    if v is not None:
+                        kwargs[kw] = v
+            client = boto3.client("s3", **kwargs)
+        self._client = client
+
+    def _obj_key(self, key: str) -> str:
+        return self._prefix + key
+
+    def get_value(self, key: str) -> bytes:
         try:
-            import boto3  # type: ignore[import-not-found]
-        except ImportError as e:  # pragma: no cover - env has no boto3
-            raise ImportError(
-                "pw.persistence.Backend.s3 requires the 'boto3' package"
-            ) from e
-        self._boto3 = boto3
-        raise NotImplementedError(
-            "S3 backend requires object-store credentials; unavailable in "
-            "this environment"
+            resp = self._client.get_object(
+                Bucket=self._bucket, Key=self._obj_key(key)
+            )
+        except Exception as e:
+            if type(e).__name__ in ("NoSuchKey", "ClientError", "KeyError"):
+                raise KeyError(key) from e
+            raise
+        body = resp["Body"]
+        return body.read() if hasattr(body, "read") else body
+
+    def put_value(self, key: str, value: bytes) -> None:
+        self._client.put_object(
+            Bucket=self._bucket, Key=self._obj_key(key), Body=value
         )
+
+    def list_keys(self) -> list[str]:
+        out: list[str] = []
+        token: str | None = None
+        while True:
+            kwargs: dict[str, Any] = {
+                "Bucket": self._bucket, "Prefix": self._prefix,
+            }
+            if token:
+                kwargs["ContinuationToken"] = token
+            resp = self._client.list_objects_v2(**kwargs)
+            for entry in resp.get("Contents", []):
+                out.append(entry["Key"][len(self._prefix):])
+            if not resp.get("IsTruncated"):
+                break
+            token = resp.get("NextContinuationToken")
+        return sorted(out)
+
+    def remove_key(self, key: str) -> None:
+        self._client.delete_object(Bucket=self._bucket, Key=self._obj_key(key))
 
 
 def open_backend(backend_spec: Any) -> PersistenceBackend:
@@ -176,5 +246,6 @@ def open_backend(backend_spec: Any) -> PersistenceBackend:
         return S3Backend(
             backend_spec.options["root_path"],
             backend_spec.options.get("bucket_settings"),
+            client=backend_spec.options.get("_client"),
         )
     raise ValueError(f"unknown persistence backend kind {kind!r}")

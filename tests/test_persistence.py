@@ -272,3 +272,113 @@ def test_fs_stream_truncation_and_partial_lines(tmp_path):
     f.write_text("word\nqux\n")
     (d,) = src.poll()
     assert list(d.data["word"]) == ["qux"]
+
+
+# -- S3 backend (fake boto3-surface client; backends/s3.rs:34) ---------------
+
+
+class FakeS3Client:
+    """In-memory boto3-surface S3: get/put/delete/list_objects_v2 with
+    pagination, so the backend's continuation-token loop is exercised."""
+
+    def __init__(self, page_size=2):
+        self.objects: dict[tuple[str, str], bytes] = {}
+        self.page_size = page_size
+
+    def put_object(self, Bucket, Key, Body):
+        self.objects[(Bucket, Key)] = bytes(Body)
+
+    def get_object(self, Bucket, Key):
+        if (Bucket, Key) not in self.objects:
+            raise KeyError(Key)
+        import io as _io
+
+        return {"Body": _io.BytesIO(self.objects[(Bucket, Key)])}
+
+    def delete_object(self, Bucket, Key):
+        self.objects.pop((Bucket, Key), None)
+
+    def list_objects_v2(self, Bucket, Prefix, ContinuationToken=None):
+        keys = sorted(
+            k for (b, k) in self.objects if b == Bucket and k.startswith(Prefix)
+        )
+        start = int(ContinuationToken) if ContinuationToken else 0
+        page = keys[start:start + self.page_size]
+        truncated = start + self.page_size < len(keys)
+        resp = {
+            "Contents": [{"Key": k} for k in page],
+            "IsTruncated": truncated,
+        }
+        if truncated:
+            resp["NextContinuationToken"] = str(start + self.page_size)
+        return resp
+
+
+def test_s3_backend_kv_roundtrip():
+    from pathway_tpu.persistence.backends import S3Backend
+
+    client = FakeS3Client(page_size=2)
+    b = S3Backend("s3://state-bucket/pipeline/a", client=client)
+    b.put_value("meta/offsets", b"o1")
+    b.put_value("snap/chunk-0", b"c0")
+    b.put_value("snap/chunk-1", b"c1")
+    b.put_value("snap/chunk-2", b"c2")
+    # paginated listing (page_size 2 forces the continuation loop)
+    assert b.list_keys() == [
+        "meta/offsets", "snap/chunk-0", "snap/chunk-1", "snap/chunk-2"
+    ]
+    assert b.get_value("snap/chunk-1") == b"c1"
+    b.put_value("snap/chunk-1", b"c1v2")  # overwrite
+    assert b.get_value("snap/chunk-1") == b"c1v2"
+    b.remove_key("snap/chunk-0")
+    assert "snap/chunk-0" not in b.list_keys()
+    with pytest.raises(KeyError):
+        b.get_value("snap/chunk-0")
+    # prefix isolation: another pipeline's state is invisible
+    other = S3Backend("s3://state-bucket/pipeline/b", client=client)
+    assert other.list_keys() == []
+
+
+def test_s3_backend_streaming_recovery():
+    """Full engine recovery over the fake S3 store: run, 'crash', restart —
+    replayed times suppressed, counts continue (the reference S3 snapshot
+    recovery contract, backends/s3.rs + integration recovery tests)."""
+    client = FakeS3Client(page_size=3)
+    cfg = Config.simple_config(
+        Backend.s3("s3://pstate/wordcount", _client=client)
+    )
+
+    seen1 = []
+    counts = _word_pipeline(_Emitter(WORDS, 6))
+    pw.io.subscribe(counts, on_change=lambda key, row, time, is_addition:
+                    seen1.append((row["word"], int(row["c"]), is_addition)))
+    pw.run(persistence_config=cfg)
+    assert {w: c for w, c, add in seen1 if add} == {"foo": 3, "bar": 2, "baz": 1}
+    assert client.objects  # snapshots actually landed in the object store
+
+    G.clear()
+    seen2 = []
+    counts = _word_pipeline(_Emitter(WORDS, 10))
+    pw.io.subscribe(counts, on_change=lambda key, row, time, is_addition:
+                    seen2.append((row["word"], int(row["c"]), is_addition)))
+    pw.run(persistence_config=cfg)
+    final2 = {w: c for w, c, add in seen2 if add}
+    assert final2 == {"foo": 4, "bar": 3, "baz": 2, "qux": 1}
+    foo_updates = [c for w, c, add in seen2 if w == "foo" and add]
+    assert foo_updates == [4]  # 3 replayed silently from the S3 snapshot
+
+
+def test_s3_backend_sharded_worker_namespaces():
+    """Per-worker PrefixBackend namespaces over one shared fake S3 bucket."""
+    from pathway_tpu.persistence.backends import PrefixBackend, S3Backend
+
+    client = FakeS3Client()
+    shared = S3Backend("s3://pstate/cluster", client=client)
+    w0 = PrefixBackend(shared, "worker-0/")
+    w1 = PrefixBackend(shared, "worker-1/")
+    w0.put_value("snap", b"zero")
+    w1.put_value("snap", b"one")
+    assert w0.get_value("snap") == b"zero"
+    assert w1.get_value("snap") == b"one"
+    assert w0.list_keys() == ["snap"]
+    assert shared.list_keys() == ["worker-0/snap", "worker-1/snap"]
