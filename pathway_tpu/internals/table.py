@@ -93,6 +93,54 @@ class Table(TableLike):
         inner = ", ".join(f"{n}: {c.dtype!r}" for n, c in self._schema.columns().items())
         return f"<pw.Table ({inner})>"
 
+    # -- live visualization (reference table.py:96 binds stdlib.viz) --------
+
+    def plot(self, plotting_function, sorting_col: str | None = None):
+        """Live-updating Bokeh plot of this table (reference viz.plot):
+        ``plotting_function(source) -> figure`` gets a ColumnDataSource
+        that streams append-only ticks incrementally after ``pw.run()``.
+        Without bokeh/panel, returns the LiveTableSource mirror."""
+        from ..stdlib.viz import plot as _plot
+
+        return _plot(self, plotting_function, sorting_col)
+
+    def show(self, sorting_col: str | None = None, **kwargs):
+        """Live table widget (reference viz.table_viz/show)."""
+        from ..stdlib.viz import table_viz as _table_viz
+
+        return _table_viz(self, sorting_col, **kwargs)
+
+    def _has_realtime_inputs(self) -> bool:
+        seen: set[int] = set()
+        stack: list[Table] = [self]
+        while stack:
+            t = stack.pop()
+            if id(t) in seen:
+                continue
+            seen.add(id(t))
+            if t._kind == "source":
+                return True
+            stack.extend(t._inputs)
+        return False
+
+    def _repr_html_(self) -> str:
+        """Notebook display: a static snapshot when the table has no
+        streaming inputs; otherwise the reference's run-first hint
+        (plotting.py:81) — computing a live table here could block on an
+        unbounded source."""
+        if self._has_realtime_inputs():
+            return (
+                f"<em>{self!r} — depends on streaming inputs; run "
+                "pw.run() with t.plot(...)/t.show() for live output</em>"
+            )
+        try:
+            from ..debug import table_to_pandas
+
+            df = table_to_pandas(self, include_id=False)
+            return df.to_html()
+        except Exception:
+            return f"<em>{self!r}</em>"
+
     # -- desugaring helpers -------------------------------------------------
 
     def _sub(self, expr: Any) -> ColumnExpression:

@@ -56,17 +56,32 @@ class LiveTableSource:
             # and (K, new, +1) in arbitrary order, and the retraction must
             # not clobber the freshly-inserted row
             pending = list(batch.iter_rows())
+            appended: list[tuple] | None = []
             for key, row, diff in pending:
-                if diff < 0 and key in self._rows and rows_equal(
-                    self._rows[key], row
-                ):
-                    self._rows.pop(key, None)
+                if diff < 0:
+                    appended = None  # retraction: not an append-only tick
+                    if key in self._rows and rows_equal(self._rows[key], row):
+                        self._rows.pop(key, None)
             for key, row, diff in pending:
                 if diff > 0:
+                    if appended is not None and key in self._rows:
+                        appended = None  # in-place update, not an append
                     self._rows[key] = row
+                    if appended is not None:
+                        appended.append(row)
             cols = self._columns_locked()
+            # append-only ticks on an unsorted mirror carry the new rows
+            # as an incremental hint: renderers stream JUST those to the
+            # browser (reference plotting.py ColumnDataSource.stream)
+            # instead of re-sending the whole snapshot
+            inc = None
+            if appended and self._sort_ix is None:
+                inc = {
+                    name: [r[i] for r in appended]
+                    for i, name in enumerate(self.names)
+                }
         for fn in list(self._listeners):
-            fn(cols)
+            fn(cols, inc)
 
     def _columns_locked(self) -> dict[str, list]:
         rows = list(self._rows.values())
@@ -81,7 +96,12 @@ class LiveTableSource:
         with self._lock:
             return self._columns_locked()
 
-    def on_update(self, fn: Callable[[dict[str, list]], None]) -> None:
+    def on_update(
+        self, fn: Callable[[dict[str, list], dict[str, list] | None], None]
+    ) -> None:
+        """``fn(columns, appended)``: full snapshot columns plus, for
+        append-only ticks on an unsorted mirror, just the appended rows
+        (None otherwise) — the incremental-update channel."""
         self._listeners.append(fn)
 
     def __len__(self) -> int:
@@ -114,15 +134,21 @@ def plot(table: Any, plotting_function: Callable, sorting_col: str | None = None
     cds = ColumnDataSource(data=source.columns())
     fig = plotting_function(cds)
 
-    def push(cols: dict[str, list]) -> None:
+    def push(cols: dict[str, list], appended: dict[str, list] | None) -> None:
         # updates arrive on the engine thread; a served Bokeh document owns
         # its state on the session thread and requires next-tick callbacks
-        # for cross-thread mutation
+        # for cross-thread mutation. Append-only ticks stream JUST the new
+        # rows (browser-side append, reference plotting.py:99); anything
+        # with retractions/updates swaps the full snapshot.
+        if appended is not None:
+            apply = lambda: cds.stream(appended)  # noqa: E731
+        else:
+            apply = lambda: setattr(cds, "data", cols)  # noqa: E731
         doc = getattr(cds, "document", None)
         if doc is not None:
-            doc.add_next_tick_callback(lambda: setattr(cds, "data", cols))
+            doc.add_next_tick_callback(apply)
         else:
-            cds.data = cols
+            apply()
 
     source.on_update(push)
     return panel.pane.Bokeh(fig)
@@ -141,14 +167,20 @@ def table_viz(table: Any, sorting_col: str | None = None, **kwargs: Any):
         pd.DataFrame(source.columns()), **kwargs
     )
 
-    def push(cols: dict[str, list]) -> None:
-        doc = getattr(widget, "document", None)
-        if doc is not None:
-            doc.add_next_tick_callback(
-                lambda: setattr(widget, "value", pd.DataFrame(cols))
+    def push(cols: dict[str, list], appended: dict[str, list] | None) -> None:
+        if appended is not None and hasattr(widget, "stream"):
+            apply = lambda: widget.stream(  # noqa: E731
+                pd.DataFrame(appended), follow=True
             )
         else:
-            widget.value = pd.DataFrame(cols)
+            apply = lambda: setattr(  # noqa: E731
+                widget, "value", pd.DataFrame(cols)
+            )
+        doc = getattr(widget, "document", None)
+        if doc is not None:
+            doc.add_next_tick_callback(apply)
+        else:
+            apply()
 
     source.on_update(push)
     return widget

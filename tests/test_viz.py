@@ -33,7 +33,7 @@ def test_live_source_mirrors_stream_with_retractions():
     updates = []
     src = plot(counts, plotting_function=lambda cds: None, sorting_col="word")
     assert isinstance(src, LiveTableSource)  # no bokeh/panel installed
-    src.on_update(lambda cols: updates.append(cols))
+    src.on_update(lambda cols, appended: updates.append(cols))
     pw.run()
     # final mirror: counts with retractions applied, sorted by word
     assert src.columns() == {"word": ["a", "b", "c"], "c": [3, 1, 1]}
@@ -74,3 +74,90 @@ def test_live_source_ndarray_cells():
     pw.run()
     cols = src.columns()
     assert cols["k"] == ["a"] and np.allclose(cols["v"][0], 0.0)
+
+
+def test_live_source_incremental_append_hints():
+    """Append-only ticks surface the new rows as the incremental channel
+    (what the Bokeh layer feeds ColumnDataSource.stream, reference
+    plotting.py:99); retraction ticks surface None (full swap)."""
+
+    class S(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k="a", v=1)
+            self.commit()
+            self.next(k="b", v=2)
+            self.next(k="c", v=3)
+            self.commit()
+
+    t = pw.io.python.read(
+        S(), schema=pw.schema_from_types(k=str, v=int),
+        autocommit_duration_ms=None,
+    )
+    src = LiveTableSource(t)  # unsorted: append hints allowed
+    events = []
+    src.on_update(lambda cols, appended: events.append((cols, appended)))
+    pw.run()
+    appends = [a for _, a in events if a is not None]
+    assert appends == [
+        {"k": ["a"], "v": [1]},
+        {"k": ["b", "c"], "v": [2, 3]},
+    ]
+    assert src.columns()["k"] == ["a", "b", "c"]
+
+
+def test_live_source_update_tick_disables_append_hint():
+    class S(pw.io.python.ConnectorSubject):
+        def run(self):
+            for w in ("a", "a"):  # second row bumps the count: -1/+1 tick
+                self.next(word=w)
+                self.commit()
+
+    t = pw.io.python.read(
+        S(), schema=pw.schema_from_types(word=str), autocommit_duration_ms=None
+    )
+    counts = t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+    src = LiveTableSource(counts)
+    events = []
+    src.on_update(lambda cols, appended: events.append(appended))
+    pw.run()
+    assert events[0] == {"word": ["a"], "c": [1]}  # first tick is an append
+    assert events[1] is None  # count update retracts: full-swap tick
+    assert src.columns() == {"word": ["a"], "c": [2]}
+
+
+def test_sorted_mirror_never_hints_append():
+    class S(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k="b")
+            self.commit()
+            self.next(k="a")
+            self.commit()
+
+    t = pw.io.python.read(
+        S(), schema=pw.schema_from_types(k=str), autocommit_duration_ms=None
+    )
+    src = LiveTableSource(t, sorting_col="k")
+    events = []
+    src.on_update(lambda cols, appended: events.append(appended))
+    pw.run()
+    # a sorted mirror re-orders on every tick: appends can't stream
+    assert events == [None, None]
+    assert src.columns()["k"] == ["a", "b"]
+
+
+def test_table_plot_show_methods_and_repr_html():
+    t = pw.debug.table_from_markdown("a | b\n1 | x\n2 | y")
+    src = t.plot(lambda cds: None)
+    assert isinstance(src, LiveTableSource)
+    src2 = t.show()
+    assert isinstance(src2, LiveTableSource)
+    html = t._repr_html_()
+    assert "<table" in html and "x" in html
+
+    class S(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(a=1)
+
+    G.clear()
+    live = pw.io.python.read(S(), schema=pw.schema_from_types(a=int))
+    assert "pw.run()" in live._repr_html_()
