@@ -25,9 +25,12 @@ from typing import Any
 
 __all__ = [
     "pdf_extract_text",
+    "pdf_extract_layout",
     "html_extract_text",
     "markdown_extract_sections",
     "docx_extract_text",
+    "pptx_extract_slides",
+    "image_metadata",
     "sniff_format",
 ]
 
@@ -150,6 +153,259 @@ def pdf_extract_text(data: bytes) -> str:
     text = "".join(parts)
     # collapse intra-line runs the positioning ops produced
     return re.sub(r"\n{3,}", "\n\n", text).strip()
+
+
+# ---------------------------------------------------------------------------
+# PDF layout: positioned runs -> lines -> table/heading/text nodes
+# (the local OpenParse-class engine; reference parsers.py:235)
+# ---------------------------------------------------------------------------
+
+#: one positioned token stream: strings + the positioning/font operators
+_LAYOUT_OP_RE = re.compile(
+    rb"(\((?:[^()\\]|\\.)*\))\s*(Tj|'|\")"
+    rb"|(<[0-9A-Fa-f\s]*>)\s*(Tj|'|\")"
+    rb"|(\[(?:[^\]\\]|\\.)*\])\s*TJ"
+    rb"|(-?[\d.]+)\s+(-?[\d.]+)\s+(-?[\d.]+)\s+(-?[\d.]+)\s+(-?[\d.]+)\s+(-?[\d.]+)\s+Tm"
+    rb"|(-?[\d.]+)\s+(-?[\d.]+)\s+(TD|Td)"
+    rb"|/(\w+)\s+(-?[\d.]+)\s+Tf"
+    rb"|(T\*|BT|ET)"
+)
+
+
+def _pdf_positioned_runs(stream: bytes) -> list[tuple[float, float, float, str]]:
+    """(x, y, font_size, text) for every shown string, tracking the text
+    matrix (Tm), line translations (Td/TD/T*) and font size (Tf)."""
+    runs: list[tuple[float, float, float, str]] = []
+    x = y = 0.0
+    lx = ly = 0.0  # line start (Td/TD translate from here)
+    leading = 14.0
+    size = 12.0
+    for m in _LAYOUT_OP_RE.finditer(stream):
+        if m.group(1) is not None or m.group(3) is not None:
+            s = (
+                _pdf_unescape(m.group(1)[1:-1])
+                if m.group(1) is not None
+                else _decode_hex_string(m.group(3)[1:-1])
+            )
+            if s.strip():
+                runs.append((x, y, size, s))
+            # crude advance so same-line strings keep their order
+            x += max(len(s), 1) * size * 0.5
+        elif m.group(5) is not None:
+            parts = []
+            for sm in _INNER_STR_RE.finditer(m.group(5)):
+                tok = sm.group(0)
+                parts.append(
+                    _pdf_unescape(tok[1:-1]) if tok[:1] == b"(" else
+                    _decode_hex_string(tok[1:-1])
+                )
+            s = "".join(parts)
+            if s.strip():
+                runs.append((x, y, size, s))
+            x += max(len(s), 1) * size * 0.5
+        elif m.group(6) is not None:  # Tm: full matrix, e/f are x/y
+            x = lx = float(m.group(10))
+            y = ly = float(m.group(11))
+        elif m.group(12) is not None:  # Td / TD
+            tx, ty = float(m.group(12)), float(m.group(13))
+            if m.group(14) == b"TD":
+                leading = -ty if ty else leading
+            lx, ly = lx + tx, ly + ty
+            x, y = lx, ly
+        elif m.group(15) is not None:  # Tf
+            size = float(m.group(16)) or size
+        else:
+            op = m.group(17)
+            if op == b"T*":
+                ly -= leading
+                x, y = lx, ly
+            elif op == b"BT":
+                x = y = lx = ly = 0.0
+    return runs
+
+
+def _cluster_lines(
+    runs: list[tuple[float, float, float, str]], tol: float = 3.0
+) -> list[list[tuple[float, float, float, str]]]:
+    """Group runs into visual lines by y (descending page order)."""
+    lines: list[list[tuple[float, float, float, str]]] = []
+    for run in sorted(runs, key=lambda r: (-r[1], r[0])):
+        if lines and abs(lines[-1][0][1] - run[1]) <= tol:
+            lines[-1].append(run)
+        else:
+            lines.append([run])
+    for line in lines:
+        line.sort(key=lambda r: r[0])
+    return lines
+
+
+def _columns_of(line: list[tuple[float, float, float, str]]) -> list[float]:
+    return [r[0] for r in line]
+
+
+def _aligned(a: list[float], b: list[float], tol: float = 6.0) -> bool:
+    if len(a) != len(b) or len(a) < 2:
+        return False
+    return all(abs(x - y) <= tol for x, y in zip(a, b))
+
+
+def pdf_extract_layout(data: bytes) -> list[dict]:
+    """Layout nodes from a PDF: ``{"type": "table"|"heading"|"text",
+    "text": str, "page": int}`` in reading order.
+
+    Tables are reconstructed from column alignment — ≥2 consecutive lines
+    with the same ≥2 x-positions become one node whose text is a markdown
+    table (the role of the reference's OpenParse table extraction,
+    ``parsers.py:235``, rebuilt from PDF text-positioning operators).
+    Headings are lines whose font size exceeds the page median."""
+    nodes: list[dict] = []
+    for page_no, stream in enumerate(_pdf_streams(data)):
+        if b"BT" not in stream:
+            continue
+        runs = _pdf_positioned_runs(stream)
+        if not runs:
+            continue
+        lines = _cluster_lines(runs)
+        sizes = sorted(r[2] for r in runs)
+        median = sizes[len(sizes) // 2]
+        i = 0
+        while i < len(lines):
+            cols = _columns_of(lines[i])
+            block = [lines[i]]
+            j = i + 1
+            while (
+                len(cols) >= 2
+                and j < len(lines)
+                and _aligned(cols, _columns_of(lines[j]))
+            ):
+                block.append(lines[j])
+                j += 1
+            if len(block) >= 2 and len(cols) >= 2:
+                header, *rows = [
+                    [r[3].strip() for r in line] for line in block
+                ]
+                md = ["| " + " | ".join(header) + " |",
+                      "|" + "---|" * len(header)]
+                md += ["| " + " | ".join(row) + " |" for row in rows]
+                nodes.append({
+                    "type": "table", "text": "\n".join(md), "page": page_no,
+                })
+                i = j
+                continue
+            text = " ".join(r[3] for r in lines[i]).strip()
+            if text:
+                kind = (
+                    "heading"
+                    if lines[i][0][2] > median and len(text) < 120
+                    else "text"
+                )
+                # merge runs of plain text lines into one node
+                if (
+                    kind == "text" and nodes
+                    and nodes[-1]["type"] == "text"
+                    and nodes[-1]["page"] == page_no
+                ):
+                    nodes[-1]["text"] += "\n" + text
+                else:
+                    nodes.append({"type": kind, "text": text, "page": page_no})
+            i += 1
+    return nodes
+
+
+# ---------------------------------------------------------------------------
+# PPTX slides (slide text + speaker notes; reference parsers.py:569)
+# ---------------------------------------------------------------------------
+
+
+def pptx_extract_slides(data: bytes) -> list[tuple[str, dict]]:
+    """One ``(text, metadata)`` per slide: shape text in document order
+    with the title separated, plus speaker notes under
+    ``metadata["notes"]``."""
+    import io
+    import zipfile
+    from xml.etree import ElementTree
+
+    A = "{http://schemas.openxmlformats.org/drawingml/2006/main}"
+    P = "{http://schemas.openxmlformats.org/presentationml/2006/main}"
+
+    def shape_texts(root) -> tuple[str | None, list[str]]:
+        title = None
+        bodies = []
+        for sp in root.iter(f"{P}sp"):
+            is_title = False
+            for ph in sp.iter(f"{P}ph"):
+                if ph.get("type") in ("title", "ctrTitle"):
+                    is_title = True
+            paras = []
+            for para in sp.iter(f"{A}p"):
+                text = "".join(t.text or "" for t in para.iter(f"{A}t"))
+                if text.strip():
+                    paras.append(text.strip())
+            if not paras:
+                continue
+            if is_title and title is None:
+                title = " ".join(paras)
+            else:
+                bodies.append("\n".join(paras))
+        return title, bodies
+
+    out: list[tuple[str, dict]] = []
+    with zipfile.ZipFile(io.BytesIO(data)) as zf:
+        slide_names = sorted(
+            (n for n in zf.namelist()
+             if re.fullmatch(r"ppt/slides/slide\d+\.xml", n)),
+            key=lambda n: int(re.search(r"\d+", n.rsplit("/", 1)[1]).group()),
+        )
+        for idx, name in enumerate(slide_names, start=1):
+            with zf.open(name) as f:
+                root = ElementTree.parse(f).getroot()
+            title, bodies = shape_texts(root)
+            meta: dict = {"slide": idx, "format": "pptx"}
+            if title:
+                meta["title"] = title
+            notes_name = f"ppt/notesSlides/notesSlide{idx}.xml"
+            if notes_name in zf.namelist():
+                with zf.open(notes_name) as f:
+                    nroot = ElementTree.parse(f).getroot()
+                _, notes = shape_texts(nroot)
+                notes_text = "\n".join(notes).strip()
+                if notes_text:
+                    meta["notes"] = notes_text
+            text = "\n\n".join(([title] if title else []) + bodies).strip()
+            out.append((text, meta))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# image metadata (dimensions/format by magic bytes; OCR/vision is the
+# client-gated layer above — reference ImageParser, parsers.py:396)
+# ---------------------------------------------------------------------------
+
+
+def image_metadata(data: bytes) -> dict | None:
+    """``{"format", "width", "height"}`` for PNG/JPEG/GIF, else None."""
+    import struct
+
+    if data[:8] == b"\x89PNG\r\n\x1a\n" and len(data) >= 24:
+        w, h = struct.unpack(">II", data[16:24])
+        return {"format": "png", "width": int(w), "height": int(h)}
+    if data[:6] in (b"GIF87a", b"GIF89a") and len(data) >= 10:
+        w, h = struct.unpack("<HH", data[6:10])
+        return {"format": "gif", "width": int(w), "height": int(h)}
+    if data[:2] == b"\xff\xd8":  # JPEG: walk segments to a SOFn frame
+        i = 2
+        while i + 9 < len(data):
+            if data[i] != 0xFF:
+                i += 1
+                continue
+            marker = data[i + 1]
+            if 0xC0 <= marker <= 0xCF and marker not in (0xC4, 0xC8, 0xCC):
+                h, w = struct.unpack(">HH", data[i + 5:i + 9])
+                return {"format": "jpeg", "width": int(w), "height": int(h)}
+            seg_len = struct.unpack(">H", data[i + 2:i + 4])[0]
+            i += 2 + seg_len
+        return {"format": "jpeg", "width": None, "height": None}
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -286,7 +542,7 @@ def docx_extract_text(data: bytes) -> str:
 
 
 def sniff_format(data: Any) -> str:
-    """'pdf' | 'docx' | 'html' | 'markdown' | 'text'."""
+    """'pdf' | 'docx' | 'pptx' | 'image' | 'html' | 'markdown' | 'text'."""
     if isinstance(data, str):
         head = data[:2048].lstrip().lower()
         if head.startswith("<!doctype html") or head.startswith("<html"):
@@ -298,6 +554,10 @@ def sniff_format(data: Any) -> str:
         return "pdf"
     if data[:4] == b"PK\x03\x04" and b"word/" in data[:4096]:
         return "docx"
+    if data[:4] == b"PK\x03\x04" and b"ppt/" in data[:4096]:
+        return "pptx"
+    if image_metadata(data[:64] if len(data) > 64 else data) is not None:
+        return "image"
     head = data[:2048].lstrip().lower()
     if head.startswith(b"<!doctype html") or head.startswith(b"<html"):
         return "html"

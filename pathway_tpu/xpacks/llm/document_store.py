@@ -49,7 +49,6 @@ class DocumentStore:
         doc_post_processors: list[Callable] | None = None,
         vector_column: str | None = None,
     ):
-        from .parsers import ParseUtf8
         from .splitters import NullSplitter
 
         if isinstance(docs, Table):
@@ -63,7 +62,7 @@ class DocumentStore:
             if len(docs_list) == 1
             else docs_list[0].concat_reindex(*docs_list[1:])
         )
-        self.parser = parser or ParseUtf8()
+        self.parser = parser or self.default_parser()
         self.splitter = splitter or NullSplitter()
         self.doc_post_processors = doc_post_processors or []
         self.retriever_factory = retriever_factory
@@ -74,6 +73,12 @@ class DocumentStore:
         #: "embeddings computed offline / by another pipeline" deployment.
         self.vector_column = vector_column
         self.build_pipeline()
+
+    @staticmethod
+    def default_parser():
+        from .parsers import ParseUtf8
+
+        return ParseUtf8()
 
     # ------------------------------------------------------------------
 
@@ -244,7 +249,15 @@ class DocumentStore:
 
 class SlidesDocumentStore(DocumentStore):
     """Slide-deck flavor of the store (reference document_store.py:471):
-    identical pipeline with a page/slide-aware default parser surface."""
+    identical pipeline whose default parser is the slide pipeline
+    (``parsers.SlideParser`` — per-slide parts with title/notes metadata,
+    vision stage injectable), so decks land one searchable part per slide."""
+
+    @staticmethod
+    def default_parser():
+        from .parsers import SlideParser
+
+        return SlideParser()
 
     def parsed_documents_with_metadata(self) -> Table:
         return self.parsed_documents

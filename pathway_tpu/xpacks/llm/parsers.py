@@ -20,10 +20,13 @@ from . import _local_parsers as LP
 __all__ = [
     "ParseUtf8",
     "ParsePdf",
+    "ParsePdfLayout",
     "ParseHtml",
     "ParseMarkdown",
     "ParseDocx",
     "ParseLocal",
+    "SlideParser",
+    "ImageParser",
     "ParseUnstructured",
     "OpenParse",
 ]
@@ -96,11 +99,102 @@ class ParseLocal(UDF):
             return ParsePdf.__wrapped__(self, contents)
         if fmt == "docx":
             return ParseDocx.__wrapped__(self, contents)
+        if fmt == "pptx":
+            data = contents if isinstance(contents, bytes) else str(contents).encode()
+            return LP.pptx_extract_slides(data)
+        if fmt == "image":
+            data = contents if isinstance(contents, bytes) else str(contents).encode()
+            return [("", LP.image_metadata(data) or {})]
         if fmt == "html":
             return ParseHtml.__wrapped__(self, contents)
         if fmt == "markdown":
             return ParseMarkdown.__wrapped__(self, contents)
         return ParseUtf8.__wrapped__(self, contents)
+
+
+class ParsePdfLayout(UDF):
+    """PDF layout parser (the reference's OpenParse table/layout role,
+    ``parsers.py:235`` — rebuilt locally from the PDF text-positioning
+    operators, no dependencies): emits one part per layout node, with
+    tables reconstructed as markdown from column alignment and headings
+    detected by font size. ``mode="single"`` joins all nodes into one
+    document part."""
+
+    def __init__(self, mode: str = "elements"):
+        super().__init__()
+        if mode not in ("elements", "single"):
+            raise ValueError("mode must be 'elements' or 'single'")
+        self.mode = mode
+
+    def __wrapped__(self, contents: Any, **kwargs: Any) -> list[tuple[str, dict]]:
+        data = contents if isinstance(contents, bytes) else str(contents).encode()
+        nodes = LP.pdf_extract_layout(data)
+        if self.mode == "single":
+            text = "\n\n".join(n["text"] for n in nodes)
+            return [(text, {"format": "pdf"})]
+        return [
+            (n["text"], {"format": "pdf", "node_type": n["type"],
+                         "page": n["page"]})
+            for n in nodes
+        ]
+
+
+class SlideParser(UDF):
+    """Slide deck parser (reference parsers.py:569): PPTX decks yield one
+    part per slide — shape text in document order, the title and speaker
+    notes in metadata — extracted locally from the slide XML. A vision/OCR
+    stage over rendered slide images plugs in via ``vision_fn`` (called
+    with the raw deck bytes and the slide index, its text is appended):
+    rendering engines (libreoffice) and vision LLMs are not baked into
+    this environment, so that stage is injectable rather than vendored,
+    like every other client-gated integration here. PDFs fall back to the
+    per-page layout parser."""
+
+    def __init__(self, vision_fn: Any = None):
+        super().__init__()
+        self.vision_fn = vision_fn
+
+    def __wrapped__(self, contents: Any, **kwargs: Any) -> list[tuple[str, dict]]:
+        data = contents if isinstance(contents, bytes) else str(contents).encode()
+        fmt = LP.sniff_format(data)
+        if fmt == "pdf":
+            nodes = LP.pdf_extract_layout(data)
+            pages: dict[int, list[str]] = {}
+            for n in nodes:
+                pages.setdefault(n["page"], []).append(n["text"])
+            return [
+                ("\n".join(texts), {"format": "pdf", "slide": page + 1})
+                for page, texts in sorted(pages.items())
+            ]
+        parts = LP.pptx_extract_slides(data)
+        if self.vision_fn is not None:
+            enriched = []
+            for text, meta in parts:
+                extra = self.vision_fn(data, meta["slide"])
+                if extra:
+                    text = (text + "\n\n" + str(extra)).strip()
+                enriched.append((text, meta))
+            parts = enriched
+        return parts
+
+
+class ImageParser(UDF):
+    """Image parser (reference parsers.py:396): dimensions/format land in
+    metadata from the file header (PNG/JPEG/GIF, stdlib); the text comes
+    from an injectable ``ocr_fn(image_bytes) -> str`` (an OCR engine or a
+    vision LLM — client-gated like the reference's)."""
+
+    def __init__(self, ocr_fn: Any = None):
+        super().__init__()
+        self.ocr_fn = ocr_fn
+
+    def __wrapped__(self, contents: Any, **kwargs: Any) -> list[tuple[str, dict]]:
+        data = contents if isinstance(contents, bytes) else str(contents).encode()
+        meta = LP.image_metadata(data) or {"format": "unknown"}
+        text = ""
+        if self.ocr_fn is not None:
+            text = str(self.ocr_fn(data) or "")
+        return [(text, meta)]
 
 
 class ParseUnstructured(UDF):

@@ -1,0 +1,219 @@
+"""Layout-depth parsers: PDF table/heading extraction, the PPTX slide
+pipeline, image metadata (reference parsers.py:235 OpenParse tables,
+:396 ImageParser, :569 SlideParser — rebuilt locally)."""
+
+from __future__ import annotations
+
+import io
+import struct
+import zipfile
+import zlib
+
+import pytest
+
+from pathway_tpu.xpacks.llm import _local_parsers as LP
+from pathway_tpu.xpacks.llm.parsers import (
+    ImageParser,
+    ParseLocal,
+    ParsePdfLayout,
+    SlideParser,
+)
+
+
+def _pdf_with(content_stream: bytes, compress: bool = False) -> bytes:
+    """Minimal one-page PDF wrapping the given content stream."""
+    if compress:
+        body = zlib.compress(content_stream)
+        filt = b"/Filter /FlateDecode "
+    else:
+        body = content_stream
+        filt = b""
+    return (
+        b"%PDF-1.4\n"
+        b"1 0 obj << /Type /Catalog /Pages 2 0 R >> endobj\n"
+        b"2 0 obj << /Type /Pages /Kids [3 0 R] /Count 1 >> endobj\n"
+        b"3 0 obj << /Type /Page /Parent 2 0 R /Contents 4 0 R >> endobj\n"
+        b"4 0 obj << " + filt +
+        b"/Length " + str(len(body)).encode() + b" >>\nstream\n" +
+        body + b"\nendstream endobj\n"
+        b"%%EOF\n"
+    )
+
+
+TABLE_PDF_STREAM = (
+    b"BT\n"
+    b"/F1 18 Tf\n"
+    b"72 720 Td\n"
+    b"(Quarterly Report) Tj\n"
+    b"/F1 10 Tf\n"
+    b"1 0 0 1 72 690 Tm (Revenue grew this quarter across regions.) Tj\n"
+    b"1 0 0 1 72 676 Tm (Details follow in the table below.) Tj\n"
+    # table: 3 columns at x=72, 200, 330 over 3 aligned rows
+    b"1 0 0 1 72 640 Tm (Region) Tj\n"
+    b"1 0 0 1 200 640 Tm (Q1) Tj\n"
+    b"1 0 0 1 330 640 Tm (Q2) Tj\n"
+    b"1 0 0 1 72 624 Tm (EMEA) Tj\n"
+    b"1 0 0 1 200 624 Tm (10) Tj\n"
+    b"1 0 0 1 330 624 Tm (14) Tj\n"
+    b"1 0 0 1 72 608 Tm (APAC) Tj\n"
+    b"1 0 0 1 200 608 Tm (21) Tj\n"
+    b"1 0 0 1 330 608 Tm (25) Tj\n"
+    b"1 0 0 1 72 580 Tm (Totals exclude one-off items.) Tj\n"
+    b"ET\n"
+)
+
+
+def test_pdf_layout_extracts_table_heading_and_text():
+    pdf = _pdf_with(TABLE_PDF_STREAM)
+    nodes = LP.pdf_extract_layout(pdf)
+    kinds = [n["type"] for n in nodes]
+    assert kinds == ["heading", "text", "table", "text"], nodes
+    assert nodes[0]["text"] == "Quarterly Report"
+    table = nodes[2]["text"].splitlines()
+    assert table[0] == "| Region | Q1 | Q2 |"
+    assert table[1] == "|---|---|---|"
+    assert table[2] == "| EMEA | 10 | 14 |"
+    assert table[3] == "| APAC | 21 | 25 |"
+    # the two body lines merged into one text node
+    assert "Revenue grew" in nodes[1]["text"]
+    assert "table below" in nodes[1]["text"]
+
+
+def test_pdf_layout_flate_compressed_stream():
+    nodes = LP.pdf_extract_layout(_pdf_with(TABLE_PDF_STREAM, compress=True))
+    assert any(n["type"] == "table" for n in nodes)
+
+
+def test_parse_pdf_layout_udf_modes():
+    pdf = _pdf_with(TABLE_PDF_STREAM)
+    parts = ParsePdfLayout().__wrapped__(pdf)
+    assert any(m["node_type"] == "table" for _, m in parts)
+    assert all(m["page"] == 0 for _, m in parts)
+    (single, meta), = ParsePdfLayout(mode="single").__wrapped__(pdf)
+    assert "| EMEA | 10 | 14 |" in single and "Quarterly Report" in single
+
+
+# -- pptx fixtures -----------------------------------------------------------
+
+_SLIDE_XML = """<?xml version="1.0"?>
+<p:sld xmlns:a="http://schemas.openxmlformats.org/drawingml/2006/main"
+       xmlns:p="http://schemas.openxmlformats.org/presentationml/2006/main">
+  <p:cSld><p:spTree>
+    <p:sp>
+      <p:nvSpPr><p:nvPr><p:ph type="title"/></p:nvPr></p:nvSpPr>
+      <p:txBody><a:p><a:r><a:t>{title}</a:t></a:r></a:p></p:txBody>
+    </p:sp>
+    <p:sp>
+      <p:nvSpPr><p:nvPr><p:ph type="body"/></p:nvPr></p:nvSpPr>
+      <p:txBody>{body}</p:txBody>
+    </p:sp>
+  </p:spTree></p:cSld>
+</p:sld>"""
+
+_NOTES_XML = """<?xml version="1.0"?>
+<p:notes xmlns:a="http://schemas.openxmlformats.org/drawingml/2006/main"
+         xmlns:p="http://schemas.openxmlformats.org/presentationml/2006/main">
+  <p:cSld><p:spTree><p:sp>
+    <p:txBody><a:p><a:r><a:t>{notes}</a:t></a:r></a:p></p:txBody>
+  </p:sp></p:spTree></p:cSld>
+</p:notes>"""
+
+
+def _pptx(slides: list[tuple[str, list[str], str | None]]) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("[Content_Types].xml", "<Types/>")
+        for i, (title, paras, notes) in enumerate(slides, start=1):
+            body = "".join(
+                f"<a:p><a:r><a:t>{p}</a:t></a:r></a:p>" for p in paras
+            )
+            zf.writestr(
+                f"ppt/slides/slide{i}.xml",
+                _SLIDE_XML.format(title=title, body=body),
+            )
+            if notes:
+                zf.writestr(
+                    f"ppt/notesSlides/notesSlide{i}.xml",
+                    _NOTES_XML.format(notes=notes),
+                )
+    return buf.getvalue()
+
+
+def test_pptx_slides_with_titles_and_notes():
+    deck = _pptx([
+        ("Intro", ["Welcome to the deck", "Agenda below"], "greet the room"),
+        ("Results", ["Revenue up 20%"], None),
+    ])
+    parts = SlideParser().__wrapped__(deck)
+    assert len(parts) == 2
+    text1, meta1 = parts[0]
+    assert meta1["slide"] == 1 and meta1["title"] == "Intro"
+    assert meta1["notes"] == "greet the room"
+    assert "Welcome to the deck" in text1 and text1.startswith("Intro")
+    text2, meta2 = parts[1]
+    assert meta2["slide"] == 2 and "notes" not in meta2
+    assert "Revenue up 20%" in text2
+
+
+def test_slide_parser_vision_stage_injectable():
+    deck = _pptx([("T", ["body"], None)])
+    calls = []
+
+    def vision(deck_bytes, slide_no):
+        calls.append(slide_no)
+        return f"ocr text {slide_no}"
+
+    parts = SlideParser(vision_fn=vision).__wrapped__(deck)
+    assert calls == [1]
+    assert parts[0][0].endswith("ocr text 1")
+
+
+def test_slide_parser_pdf_pages_as_slides():
+    pdf = _pdf_with(TABLE_PDF_STREAM)
+    parts = SlideParser().__wrapped__(pdf)
+    assert len(parts) == 1 and parts[0][1]["slide"] == 1
+    assert "Quarterly Report" in parts[0][0]
+
+
+# -- images ------------------------------------------------------------------
+
+
+def _png(w=64, h=48):
+    header = b"\x89PNG\r\n\x1a\n"
+    ihdr = struct.pack(">II", w, h) + b"\x08\x02\x00\x00\x00"
+    return header + struct.pack(">I", 13) + b"IHDR" + ihdr + b"\x00" * 8
+
+
+def test_image_parser_metadata_and_ocr_hook():
+    (text, meta), = ImageParser().__wrapped__(_png())
+    assert meta == {"format": "png", "width": 64, "height": 48}
+    assert text == ""
+    (text2, _), = ImageParser(ocr_fn=lambda b: "seen text").__wrapped__(_png())
+    assert text2 == "seen text"
+
+
+def test_image_metadata_jpeg_gif():
+    jpeg = (
+        b"\xff\xd8" + b"\xff\xe0" + struct.pack(">H", 16) + b"JFIF\x00" + b"\x00" * 10
+        + b"\xff\xc0" + struct.pack(">H", 11) + b"\x08" + struct.pack(">HH", 33, 44)
+        + b"\x03"
+    )
+    assert LP.image_metadata(jpeg) == {"format": "jpeg", "width": 44, "height": 33}
+    gif = b"GIF89a" + struct.pack("<HH", 7, 9)
+    assert LP.image_metadata(gif) == {"format": "gif", "width": 7, "height": 9}
+    assert LP.image_metadata(b"not an image") is None
+
+
+def test_parse_local_routes_pptx_and_images():
+    deck = _pptx([("T", ["hello body"], None)])
+    parts = ParseLocal().__wrapped__(deck)
+    assert parts[0][1]["format"] == "pptx" and "hello body" in parts[0][0]
+    (text, meta), = ParseLocal().__wrapped__(_png())
+    assert meta["format"] == "png"
+
+
+def test_slides_document_store_defaults_to_slide_parser():
+    from pathway_tpu.xpacks.llm.document_store import SlidesDocumentStore
+    from pathway_tpu.xpacks.llm.parsers import SlideParser as SP
+
+    assert isinstance(SlidesDocumentStore.default_parser(), SP)
