@@ -66,6 +66,10 @@ class GraphRunner:
             if http_server is not None:
                 http_server.shutdown()
                 http_server.server_close()
+            from .telemetry import export_from_env
+            from .tracing import get_tracer
+
+            export_from_env(get_tracer())
 
     def run_tables(self, *tables: Table, include_sinks: bool = False):
         """Build + execute; return one Capture per requested table."""
@@ -95,6 +99,9 @@ class GraphRunner:
             tracer = get_tracer()
             if tracer is not None:
                 tracer.flush()
+                from .telemetry import export_from_env
+
+                export_from_env(tracer)  # lowering-failure partial spans
 
     def _run_sharded(self, cfg) -> None:
         """Multi-worker execution (reference: timely workers over thread /

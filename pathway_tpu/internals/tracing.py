@@ -66,9 +66,13 @@ class _Span:
 
 
 class Tracer:
-    """Collects Chrome-trace events; thread-safe, append-only."""
+    """Collects Chrome-trace events; thread-safe, append-only.
 
-    def __init__(self, path: str, max_events: int | None = None):
+    ``path=None`` collects without writing a local trace file — the mode
+    used when only an OTLP endpoint (``internals/telemetry.py``) consumes
+    the spans."""
+
+    def __init__(self, path: str | None, max_events: int | None = None):
         self.path = path
         self._events: list[dict[str, Any]] = []
         self._lock = threading.Lock()
@@ -158,6 +162,8 @@ class Tracer:
         with nothing new since the last write is a no-op. Never raises —
         tracing is auxiliary and must not fail (or mask the error of) the
         run it observes."""
+        if self.path is None:  # OTLP-only mode: no local file
+            return None
         with self._lock:
             if self._flush_mark == self._appended:
                 return None
@@ -247,6 +253,13 @@ def init_from_env() -> Tracer | None:
         path = os.environ.get("PATHWAY_TRACE_FILE")
     if path:
         _active = Tracer(path)
+    elif os.environ.get("PATHWAY_TELEMETRY_SERVER") or os.environ.get(
+        "PATHWAY_MONITORING_SERVER"
+    ):
+        # an OTLP endpoint alone still needs a span collector — file-less
+        # tracer (the reference enables telemetry without local tracing,
+        # telemetry.rs:215-221)
+        _active = Tracer(None)
     else:
         _active = None
     _env_checked = True

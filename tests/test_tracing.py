@@ -161,3 +161,102 @@ def test_programmatic_activation(tmp_path):
     assert phases["c"] == "C"
     # flush is idempotent
     assert tracer.flush() is None
+
+
+# -- OTLP push (reference telemetry.rs:63-156) -------------------------------
+
+
+class _Collector:
+    """Loopback OTLP/HTTP collector capturing POSTed payloads."""
+
+    def __init__(self):
+        import http.server
+        import json as _json
+        import threading as _threading
+
+        collector = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = _json.loads(self.rfile.read(n))
+                collector.received.append((self.path, body))
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        self.received = []
+        self.server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = _threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_otlp_exporter_payload_shapes():
+    from pathway_tpu.internals.telemetry import OtlpExporter
+    from pathway_tpu.internals.tracing import Tracer
+
+    tracer = Tracer(None)
+    with tracer.span("graph.build", tables=2):
+        pass
+    tracer.counter("engine.rows", {"ingested": 42.0})
+    exp = OtlpExporter("http://127.0.0.1:1", run_id="r1")
+    spans = exp.spans_payload(tracer._events, 1_000_000_000)
+    span_list = spans["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert span_list[0]["name"] == "graph.build"
+    assert span_list[0]["traceId"] == exp.trace_id
+    assert int(span_list[0]["endTimeUnixNano"]) >= int(
+        span_list[0]["startTimeUnixNano"]
+    )
+    assert {"key": "tables", "value": {"intValue": "2"}} in span_list[0][
+        "attributes"
+    ]
+    res_attrs = {
+        a["key"]: a["value"]["stringValue"]
+        for a in spans["resourceSpans"][0]["resource"]["attributes"]
+    }
+    assert res_attrs["service.name"] == "pathway_tpu"
+    assert res_attrs["run.id"] == "r1"
+    metrics = exp.metrics_payload(tracer._events, 1_000_000_000)
+    m = metrics["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]
+    assert m[0]["name"] == "engine.rows.ingested"
+    assert m[0]["gauge"]["dataPoints"][0]["asDouble"] == 42.0
+
+
+def test_otlp_export_posts_to_collector(monkeypatch):
+    collector = _Collector()
+    try:
+        monkeypatch.setenv(
+            "PATHWAY_TELEMETRY_SERVER", f"http://127.0.0.1:{collector.port}"
+        )
+        monkeypatch.delenv("PATHWAY_TRACE_FILE", raising=False)
+        import pathway_tpu as pw
+        from pathway_tpu.internals import tracing
+        from pathway_tpu.internals.parse_graph import G
+
+        tracing._env_checked = False  # re-read env
+        G.clear()
+        t = pw.debug.table_from_markdown("a\n1\n2")
+        out = t.select(b=pw.this.a + 1)
+        pw.debug.compute_and_print(out)
+        G.clear()
+        paths = [p for p, _ in collector.received]
+        assert "/v1/traces" in paths, paths
+        _, traces = next(x for x in collector.received if x[0] == "/v1/traces")
+        names = [
+            s["name"]
+            for s in traces["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        ]
+        assert "engine.run" in names  # run_tables path: executor spans
+    finally:
+        collector.stop()
+        tracing._env_checked = False
