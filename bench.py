@@ -288,7 +288,7 @@ def _diff_vs_previous_round(result: dict) -> None:
     if prev is None:
         return
     name, prev_res = prev
-    higher_is_better = lambda k: not k.endswith("_ms") and "latency" not in k
+    higher_is_better = lambda k: "_ms" not in k and "latency" not in k
     regressions = []
     for key, new in result["extra"].items():
         old = prev_res.get("extra", {}).get(key)

@@ -42,6 +42,12 @@ class EngineStats:
         self.latency_ms: float | None = None
         self.last_time: int = 0
         self.rows_by_node: dict[str, int] = {}
+        #: cumulative processing nanoseconds per node (the dashboard's
+        #: per-operator latency column, reference monitoring.py:56-190);
+        #: populated when detailed monitoring or tracing is on
+        self.time_by_node: dict[str, int] = {}
+        #: set by the dashboard at level >= ALL to turn on per-node timing
+        self.detailed = False
         self.finished = False
 
     def note_node(self, node: "Node", n_rows: int, is_source: bool) -> None:
@@ -50,6 +56,10 @@ class EngineStats:
             self.input_rows += n_rows
         label = f"{type(node).__name__}#{node.node_id}"
         self.rows_by_node[label] = self.rows_by_node.get(label, 0) + n_rows
+
+    def note_node_time(self, node: "Node", ns: int) -> None:
+        label = f"{type(node).__name__}#{node.node_id}"
+        self.time_by_node[label] = self.time_by_node.get(label, 0) + ns
 
     def note_tick(self, time: int) -> None:
         import time as _time
@@ -325,24 +335,38 @@ class Executor:
         return delta.take(np.flatnonzero(shards == self.ctx.worker_id))
 
     def run(self) -> None:
-        if self.tracer is not None:
-            try:
-                with self.tracer.span(
-                    "engine.run",
-                    n_nodes=len(self.nodes),
-                    worker=self.ctx.worker_id,
-                    n_workers=self.ctx.n_workers,
-                ):
-                    self._run_inner()
-            finally:
-                if not self.ctx.is_sharded:
-                    # failed runs are the ones worth a trace; sharded runs
-                    # flush once after every worker joined
-                    # (graph_runner._run_sharded) — a per-worker flush here
-                    # would freeze the file at the first worker's finish
-                    self.tracer.flush()
-        else:
-            self._run_inner()
+        from . import keys as K
+
+        # stateless dataflows (no keyed operator state anywhere) suspend
+        # 128-bit key registration for the duration of the run: conflation
+        # can only corrupt coexisting keyed STATE, and the registry probe
+        # costs real throughput on unique-key streams (see keys.py)
+        stateless = not any(n.has_state() for n in self.nodes)
+        if stateless:
+            K._registration_suspended += 1
+        try:
+            if self.tracer is not None:
+                try:
+                    with self.tracer.span(
+                        "engine.run",
+                        n_nodes=len(self.nodes),
+                        worker=self.ctx.worker_id,
+                        n_workers=self.ctx.n_workers,
+                    ):
+                        self._run_inner()
+                finally:
+                    if not self.ctx.is_sharded:
+                        # failed runs are the ones worth a trace; sharded
+                        # runs flush once after every worker joined
+                        # (graph_runner._run_sharded) — a per-worker flush
+                        # here would freeze the file at the first worker's
+                        # finish
+                        self.tracer.flush()
+            else:
+                self._run_inner()
+        finally:
+            if stateless:
+                K._registration_suspended -= 1
 
     def _run_inner(self) -> None:
         realtime = [n for n in self.nodes if isinstance(n, RealtimeSource)]
@@ -586,7 +610,8 @@ class Executor:
 
     def _tick(self, time: int, source_emissions: list[tuple[SourceNode, Delta]]) -> None:
         tracer = self.tracer
-        if tracer is not None:
+        timed = tracer is not None or self.stats.detailed
+        if timed:
             import time as _wall
 
             tick_t0 = _wall.perf_counter_ns()
@@ -599,7 +624,7 @@ class Executor:
                     self.persistence.record(time, src.persistent_id, delta)
         self._last_clock = max(self._last_clock, time) if time != END_TIME else self._last_clock
         for node in self.nodes:
-            if tracer is not None:
+            if timed:
                 node_t0 = _wall.perf_counter_ns()
             out_parts: list[Delta] = []
             released = node.advance_to(time)
@@ -638,17 +663,22 @@ class Executor:
                     is_source=isinstance(node, SourceNode),
                 )
                 self._route(node, emitted, inbox)
-            if tracer is not None and (
+            if timed and (
                 out_parts or ports or node.node_id in seeded or node.always_run
             ):
                 # record nodes that did work even when they emitted nothing
                 # (an expensive filter/join producing an empty delta is the
                 # exact hot spot a trace exists to show)
-                tracer.complete(
-                    f"{type(node).__name__}#{node.node_id}",
-                    node_t0,
-                    {"rows": emitted_rows},
-                )
+                if tracer is not None:
+                    tracer.complete(
+                        f"{type(node).__name__}#{node.node_id}",
+                        node_t0,
+                        {"rows": emitted_rows},
+                    )
+                if self.stats.detailed:
+                    self.stats.note_node_time(
+                        node, _wall.perf_counter_ns() - node_t0
+                    )
         self.stats.note_tick(time)
         for cb in self._on_time_end:
             cb(time)

@@ -326,6 +326,13 @@ class KeyCollisionError(RuntimeError):
 
 _REGISTRY = None
 _REGISTRY_WARNED = False
+#: >0 while every live executor's dataflow is stateless (no keyed
+#: operator state — nothing two conflated keys could corrupt): key
+#: creation skips the registry probe, which costs ~150ns/row of random
+#: DRAM access on unique-key streams. Executors with ANY stateful node
+#: never suspend, so the 128-bit guarantee holds exactly where key
+#: identity is load-bearing. Managed by engine/executor.py.
+_registration_suspended = 0
 
 
 class _PyKeyRegistry:
@@ -407,6 +414,8 @@ def mix_columns(
     (consolidation row sigs) pass ``register=False`` and pay one lane.
     """
     acc = np.full(n, np.uint64(0xA076_1D64_78BD_642F) ^ np.uint64(salt), dtype=np.uint64)
+    if register and _registration_suspended:
+        register = False
     if register:
         acc_hi = np.full(
             n, np.uint64(_ROW_SEED_HI) ^ np.uint64(salt), dtype=np.uint64
@@ -448,6 +457,8 @@ def hash_values(
     rows = rows if isinstance(rows, list) else list(rows)
     native = get_native()  # memoized; O(1) after first call
     salt64 = int(salt) & 0xFFFFFFFFFFFFFFFF
+    if register and _registration_suspended:
+        register = False
     if not register:
         if native is None:
             return _hash_values_py(rows, salt)

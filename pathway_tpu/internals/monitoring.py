@@ -33,9 +33,16 @@ def _rows(stats: Any, level: int) -> list[tuple[str, str]]:
         ),
     ]
     if level >= MonitoringLevel.ALL:
-        # snapshot: the executor thread inserts node keys concurrently
+        # snapshot: the executor thread inserts node keys concurrently.
+        # per-operator row counts + cumulative processing time (the
+        # reference's connector/operator latency table, monitoring.py:56-190)
+        times = dict(stats.time_by_node)
         for label, count in sorted(list(stats.rows_by_node.items())):
-            out.append((f"  {label}", str(count)))
+            ms = times.get(label, 0) / 1e6
+            out.append((
+                f"  {label}",
+                f"{count} rows / {ms:.1f} ms" if ms else f"{count} rows",
+            ))
     return out
 
 
@@ -51,6 +58,8 @@ def start_dashboard(stats: Any, level: int, refresh_s: float = 1.0):
             if level == MonitoringLevel.AUTO_ALL
             else MonitoringLevel.IN_OUT
         )
+    if level >= MonitoringLevel.ALL:
+        stats.detailed = True  # turn on per-node timing in the executor
     stop_event = threading.Event()
 
     def plain_loop() -> None:

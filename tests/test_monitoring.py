@@ -1,0 +1,65 @@
+"""Monitoring dashboard: per-operator rows + processing-time table
+(reference internals/monitoring.py:56-190)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.monitoring import MonitoringLevel, _rows
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _run_pipeline(detailed: bool):
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    t = pw.debug.table_from_markdown("a\n" + "\n".join(str(i) for i in range(50)))
+    out = t.select(b=pw.this.a * 2)
+    gr = GraphRunner()
+    cap = gr.capture(out)
+    gr.executor = None
+    # run through _execute so stats flow like pw.run
+    from pathway_tpu.engine.executor import Executor
+
+    ex = Executor(gr._nodes)
+    ex.stats.detailed = detailed
+    ex.run()
+    return ex.stats, cap
+
+
+def test_per_node_timing_collected_when_detailed():
+    stats, _ = _run_pipeline(detailed=True)
+    assert stats.rows_by_node, "per-node row counts always collected"
+    assert stats.time_by_node, "detailed mode collects per-node time"
+    # timing covers at least the row-emitting nodes (plus terminal
+    # sinks like Capture, which do work but emit nothing)
+    assert set(stats.rows_by_node) <= set(stats.time_by_node)
+    assert all(ns >= 0 for ns in stats.time_by_node.values())
+    rows = _rows(stats, MonitoringLevel.ALL)
+    per_node = [v for k, v in rows if k.startswith("  ")]
+    assert any("ms" in v for v in per_node), rows
+
+
+def test_per_node_timing_off_by_default():
+    stats, _ = _run_pipeline(detailed=False)
+    assert stats.rows_by_node
+    assert stats.time_by_node == {}
+
+
+def test_dashboard_all_level_enables_detail():
+    from pathway_tpu.engine.executor import EngineStats
+    from pathway_tpu.internals.monitoring import start_dashboard
+
+    stats = EngineStats()
+    stop = start_dashboard(stats, MonitoringLevel.ALL, refresh_s=10.0)
+    try:
+        assert stats.detailed is True
+    finally:
+        stop()
