@@ -30,6 +30,7 @@ from .internals.expression import (
     unwrap,
 )
 from .internals.json import Json
+from .internals.error_log_table import global_error_log
 from .internals.parse_graph import G, Universe
 from .internals.run import MonitoringLevel, request_stop, run, run_all
 from .internals.sql import sql
@@ -144,6 +145,7 @@ __all__ = [
     "declare_type",
     "demo",
     "fill_error",
+    "global_error_log",
     "graphs",
     "groupby",
     "if_else",

@@ -21,7 +21,12 @@ __all__ = ["Delta", "concat_deltas", "rows_to_columns", "column_of_values", "row
 
 
 def rows_equal(a: tuple | None, b: tuple | None) -> bool:
-    """Tuple equality tolerating ndarray-valued cells."""
+    """ENGINE-side tuple equality: tolerates ndarray-valued cells, and two
+    Error cells compare equal — the reference's engine ``Value::Error``
+    implements ``Eq`` so arrangements can consolidate/retract error rows
+    (value.rs); only USER-level comparisons make Error equal to nothing.
+    Without this, retracting a row whose content holds an Error never
+    matches the stored row and state bookkeeping breaks."""
     if a is None or b is None:
         return a is b
     if len(a) != len(b):
@@ -36,7 +41,10 @@ def rows_equal(a: tuple | None, b: tuple | None) -> bool:
             ):
                 return False
         elif x != y and not (x is None and y is None):
-            return False
+            from .error import Error as _Err
+
+            if not (type(x) is _Err and type(y) is _Err):
+                return False
     return True
 
 

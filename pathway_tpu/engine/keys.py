@@ -536,3 +536,8 @@ def derive_pair_scalar(left: int, right: int) -> int:
 def ref_scalar(*values: Any, salt: int = 0) -> int:
     """Hash a single row of values — python-side ``Table.pointer_from``."""
     return int(hash_values([tuple(values)], salt=salt)[0])
+
+
+def fmt_key(key: int) -> str:
+    """Render a key the way pointers print (debug ``^HEX`` form)."""
+    return "^" + format(int(key), "016X")

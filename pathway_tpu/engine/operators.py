@@ -106,20 +106,45 @@ class Filter(Node):
             # an Error condition drops the row with a log entry instead of
             # crashing the batch (reference: filter skips error rows)
             out = np.empty(len(mask), dtype=bool)
-            logged = False
             for i, x in enumerate(mask):
                 if type(x) is EngineError:
                     out[i] = False
-                    if not logged:
+                    if d.diffs[i] > 0:  # retraction of an error row: cleanup
                         ERROR_LOG.record(
-                            "Error value in filter condition; row skipped",
+                            "Error value encountered in filter condition, "
+                            "skipping the row",
                             "filter",
                         )
-                        logged = True
                 else:
                     out[i] = bool(x)
             mask = out
         return d.take(np.flatnonzero(mask))
+
+
+class RemoveErrors(Node):
+    """Drop rows in which any column holds an Error value (reference
+    ``remove_errors`` / filter_out_results_of_failed_computations)."""
+
+    def __init__(self, inp: Node):
+        super().__init__([inp], inp.column_names)
+
+    def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
+        d = ins[0]
+        if d is None or not len(d):
+            return None
+        if not errors_seen():
+            return d
+        mask = None
+        for c in self.column_names:
+            col = np.asarray(d.data[c])
+            if col.dtype == object:
+                m = np.fromiter(
+                    (type(v) is EngineError for v in col), bool, len(col)
+                )
+                mask = m if mask is None else (mask | m)
+        if mask is None or not mask.any():
+            return d
+        return d.take(np.flatnonzero(~mask))
 
 
 class Reindex(Node):
@@ -494,9 +519,15 @@ class GroupByReduce(Node):
                 mask = m if mask is None else (mask | m)
         if mask is None or not mask.any():
             return d
-        ERROR_LOG.record(
-            "Error value in grouping key; row skipped", "groupby"
-        )
+        # one log entry per skipped row with ADDITIONS only (a retraction
+        # of an error row is cleanup, not a new incident) — reference
+        # wording, test_errors.py:741
+        for _ in range(int(mask[d.diffs > 0].sum())):
+            ERROR_LOG.record(
+                "Error value encountered in grouping columns, skipping "
+                "the row",
+                "groupby",
+            )
         return d.take(np.flatnonzero(~mask))
 
     # -- dense arena path ------------------------------------------------
@@ -920,14 +951,17 @@ class Join(Node):
         self._lpad: dict[int, int] = {}
         self._rpad: dict[int, int] = {}
         # id-keyed joins (key_mode left/right) promise one output row per
-        # id-side row ("result.id == left.id"); a second match silently
-        # duplicates a row key inside a table labeled with the id side's
-        # universe, so enforce the reference's duplicate-id runtime error
-        # (ADVICE r4). out_key -> live multiplicity, maintained per tick.
-        self._idcount: dict[int, int] = {}
+        # id-side row ("result.id == left.id"). A second match would
+        # silently duplicate a row key inside a table labeled with the id
+        # side's universe (ADVICE r4), so the output is projected per id:
+        # multiplicity 1 passes through; >1 becomes ONE row with Error in
+        # the other side's columns plus a "duplicate key" log entry — the
+        # reference's behavior (test_errors.py:483 left_join_preserving_id).
+        # out_key -> {row_sig: [row_tuple, count]} of emitted rows.
+        self._idstate: dict[int, dict[int, list]] = {}
 
     STATE_FIELDS = (
-        "_cleft", "_cright", "_left", "_right", "_lpad", "_rpad", "_idcount"
+        "_cleft", "_cright", "_left", "_right", "_lpad", "_rpad", "_idstate"
     )
 
     def exchange_specs(self):
@@ -977,23 +1011,60 @@ class Join(Node):
         there because any Error it could find is alive inside this very
         delta and therefore counted."""
         if delta is None or jk_col is None or not len(delta):
-            return delta
+            return delta, None
         col = np.asarray(delta.data[jk_col])
         if col.dtype == object:
             # raw pointer key columns (optional ix / having) may hold
             # None or Error objects — drop only the Errors here; None
             # keeps its pre-existing downstream handling
             if not errors_seen():
-                return delta
+                return delta, None
             m = np.fromiter(
                 (type(v) is EngineError for v in col), bool, len(col)
             )
         else:
             m = col.astype(np.uint64, copy=False) == K.ERROR_KEY
         if not m.any():
-            return delta
-        ERROR_LOG.record("Error value in join key; row skipped", "join")
-        return delta.take(np.flatnonzero(~m))
+            return delta, None
+        # reference wording, one entry per skipped ADDITION
+        # (test_errors.py:203)
+        for _ in range(int(m[delta.diffs > 0].sum())):
+            ERROR_LOG.record(
+                "Error value encountered in join condition, skipping the row",
+                "join",
+            )
+        return delta.take(np.flatnonzero(~m)), delta.take(np.flatnonzero(m))
+
+    def _error_key_pads(self, side: int, err: Delta) -> Delta:
+        """Pad rows for error-keyed inputs on a padded side: the row keeps
+        its own values, the other side is all-None (reference left join:
+        the error row still shows, unmatched — test_errors.py:216). These
+        pads are permanent (an Error key matches nothing, ever), so their
+        multiplicity simply follows the row's diffs — no transition
+        bookkeeping."""
+        if side == 0:
+            keys = (
+                K.derive(err.keys, _PAD_SALT)
+                if self._key_mode == "pair" else err.keys
+            )
+            cols = [np.asarray(err.data[c]) for c in self._lcols]
+            none_col = np.empty(len(err), dtype=object)
+            none_col[:] = None
+            ordered = cols + [none_col] * len(self._rcols)
+        else:
+            keys = (
+                K.derive(err.keys, _PAD_SALT ^ 0xF)
+                if self._key_mode == "pair" else err.keys
+            )
+            cols = [np.asarray(err.data[c]) for c in self._rcols]
+            none_col = np.empty(len(err), dtype=object)
+            none_col[:] = None
+            ordered = [none_col] * len(self._lcols) + cols
+        return Delta(
+            keys=keys,
+            data=dict(zip(self.column_names, ordered)),
+            diffs=err.diffs,
+        )
 
     #: per-side sentinels for a None join key: a None key matches NOTHING
     #: (SQL/reference semantics) — distinct sentinels per side prevent two
@@ -1164,14 +1235,23 @@ class Join(Node):
             ))
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
-        ins = [
-            self._normalize_none_keys(
-                self._drop_error_keys(d, jk), jk, side
-            )
-            for side, (d, jk) in enumerate(zip(ins, (self._ljk, self._rjk)))
-        ]
+        clean: list[Delta | None] = []
+        pad_parts: list[Delta] = []
+        padded_sides = {
+            "left": (0,), "right": (1,), "outer": (0, 1), "inner": (),
+        }[self._mode]
+        for side, (d, jk) in enumerate(zip(ins, (self._ljk, self._rjk))):
+            kept, err = self._drop_error_keys(d, jk)
+            clean.append(self._normalize_none_keys(kept, jk, side))
+            if err is not None and len(err) and side in padded_sides:
+                pad_parts.append(self._error_key_pads(side, err))
+        ins = clean
         if self._columnar:
-            return self._check_unique_ids(self._process_columnar(ins))
+            out = self._process_columnar(ins)
+            if pad_parts:
+                parts = ([out] if out is not None and len(out) else []) + pad_parts
+                out = concat_deltas(parts, self.column_names).consolidated()
+            return self._check_unique_ids(out)
         dl = self._rows_of(ins[0], self._ljk, self._lcols)
         dr = self._rows_of(ins[1], self._rjk, self._rcols)
         out: tuple[list, list, list] = ([], [], [])
@@ -1202,42 +1282,105 @@ class Join(Node):
             self._repad(
                 out, dr, dl, self._right, self._left, self._rpad, self._pad_right
             )
-        if not out[0]:
+        if not out[0] and not pad_parts:
             return None
-        return self._check_unique_ids(Delta(
-            keys=np.array(out[0], dtype=np.uint64),
-            data=rows_to_columns(out[1], self.column_names),
-            diffs=np.array(out[2], dtype=np.int64),
-        ).consolidated())
+        parts = (
+            [Delta(
+                keys=np.array(out[0], dtype=np.uint64),
+                data=rows_to_columns(out[1], self.column_names),
+                diffs=np.array(out[2], dtype=np.int64),
+            )] if out[0] else []
+        ) + pad_parts
+        return self._check_unique_ids(
+            concat_deltas(parts, self.column_names).consolidated()
+        )
+
+    #: sentinel sig for the Error-degraded duplicate row projection
+    _DUP_SIG = object()
+
+    def _project_id_key(self, k: int) -> list[tuple[Any, tuple, int]]:
+        """Current OUTPUT rows for id key ``k`` as ``(sig, row, count)``:
+        one real row at multiplicity 1, or one Error-degraded row
+        (sig = _DUP_SIG) when several matches share the id (pads count
+        too — pad and match are exclusive). Comparisons between old/new
+        projections go through sigs only, so array-valued cells never hit
+        ambiguous ``==``."""
+        ent = self._idstate.get(k)
+        if not ent:
+            return []
+        total = sum(e[1] for e in ent.values())
+        if total <= 0:
+            return []
+        if total == 1 and len(ent) == 1:
+            sig, (row, cnt) = next(iter(ent.items()))
+            return [(sig, tuple(row), cnt)]
+        base = next(iter(ent.values()))[0]
+        n_l = len(self._lcols)
+        if self._key_mode == "left":
+            err_row = tuple(base[:n_l]) + tuple(
+                EngineError.silent("duplicate key") for _ in self._rcols
+            )
+        else:
+            err_row = tuple(
+                EngineError.silent("duplicate key") for _ in self._lcols
+            ) + tuple(base[n_l:])
+        return [(self._DUP_SIG, err_row, 1)]
 
     def _check_unique_ids(self, delta: Delta | None) -> Delta | None:
-        """key_mode left/right: every output key is an id-side row id and
-        must stay at multiplicity ≤ 1 (pads included — a pad and a match
-        for the same id are exclusive, so legal transitions net to ≤ 1).
-        Mirrors the reference's "duplicate key" runtime error for
-        id-preserving joins (value.rs key contract; joins keyed by a
-        side's id carry that side's universe)."""
+        """key_mode left/right: every output key is an id-side row id.
+        Multiplicity ≤ 1 passes through untouched; an id matched by
+        several rows degrades to ONE row with Error values in the other
+        side's columns and a "duplicate key" log entry, recovering when
+        matches drop back to one (reference id-preserving join contract,
+        test_errors.py:483)."""
         if self._key_mode == "pair" or delta is None or not len(delta):
             return delta
-        uniq, inv = np.unique(delta.keys, return_inverse=True)
-        sums = np.zeros(len(uniq), dtype=np.int64)
-        np.add.at(sums, inv, delta.diffs)
-        for k, s in zip(uniq.tolist(), sums.tolist()):
-            if s == 0:
-                continue
-            cnt = self._idcount.get(k, 0) + s
-            if cnt > 1:
-                side = self._key_mode
-                raise ValueError(
-                    f"duplicate row id in {side}-id join: {side} row "
-                    f"{k} matched multiple rows of the other side "
-                    "(join with id= requires at most one match per id row)"
-                )
-            if cnt:
-                self._idcount[k] = cnt
+        n = len(delta)
+        sigs = K.mix_columns(
+            list(delta.data.values()), n, register=False
+        ).tolist()
+        keys_l = delta.keys.tolist()
+        diffs_l = delta.diffs.tolist()
+        cols = [np.asarray(delta.data[c]) for c in self.column_names]
+        state = self._idstate
+        old_proj = {k: self._project_id_key(k) for k in set(keys_l)}
+        for i, (k, sg, df) in enumerate(zip(keys_l, sigs, diffs_l)):
+            ent = state.setdefault(k, {})
+            cur = ent.get(sg)
+            if cur is None:
+                ent[sg] = [tuple(c[i] for c in cols), df]
             else:
-                self._idcount.pop(k, None)
-        return delta
+                cur[1] += df
+                if cur[1] == 0:
+                    del ent[sg]
+            if not ent:
+                state.pop(k, None)
+        out_keys: list[int] = []
+        out_rows: list[tuple] = []
+        out_diffs: list[int] = []
+        for k, old in old_proj.items():
+            new = self._project_id_key(k)
+            if [(s, c) for s, _, c in new] == [(s, c) for s, _, c in old]:
+                continue
+            old_dup = any(s is self._DUP_SIG for s, _, _ in old)
+            new_dup = any(s is self._DUP_SIG for s, _, _ in new)
+            if new_dup and not old_dup:
+                ERROR_LOG.record(f"duplicate key: {K.fmt_key(k)}", "join")
+            for _, row, cnt in old:
+                out_keys.append(k)
+                out_rows.append(row)
+                out_diffs.append(-cnt)
+            for _, row, cnt in new:
+                out_keys.append(k)
+                out_rows.append(row)
+                out_diffs.append(cnt)
+        if not out_keys:
+            return None
+        return Delta(
+            keys=np.array(out_keys, dtype=np.uint64),
+            data=rows_to_columns(out_rows, self.column_names),
+            diffs=np.array(out_diffs, dtype=np.int64),
+        ).consolidated()
 
     def _repad(self, out, d_this, d_other, this_idx: MultiIndex, other_idx: MultiIndex, pad_state: dict[int, int], pad_fn) -> None:
         affected_jks = {jk for jk, _, _, _ in d_this} | {jk for jk, _, _, _ in d_other}

@@ -1,0 +1,76 @@
+"""``pw.global_error_log()`` — the process error log as a live table.
+
+Reference: ``pw.global_error_log()`` (``internals/errors.py``) exposes the
+engine's error-log channel as a queryable table; tests assert on
+``global_error_log().select(pw.this.message)`` alongside the pipeline
+output. Here the table is a realtime source draining
+``engine.error.ERROR_LOG`` entries recorded after the run starts: each
+sweep of the event loop picks up errors the previous tick raised, so the
+final table holds exactly this run's row errors.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..engine.delta import Delta
+from ..engine.executor import RealtimeSource
+from .parse_graph import Universe
+from .schema import schema_from_types
+from .table import Table
+
+__all__ = ["global_error_log"]
+
+
+class _ErrorLogSource(RealtimeSource):
+    """Emits (message, context) rows for log entries recorded since the
+    run began (offset captured at build time = run start)."""
+
+    def __init__(self, columns: list[str]):
+        super().__init__(columns)
+        from ..engine.error import ERROR_LOG
+
+        self._log = ERROR_LOG
+        self._seen = len(ERROR_LOG.entries())
+
+    def poll(self):
+        from ..engine import keys as K
+
+        entries = self._log.entries()
+        new = entries[self._seen :]
+        if not new:
+            return []
+        start = self._seen
+        self._seen = len(entries)
+        keys = K.hash_values(
+            [(start + i, m, c) for i, (m, c) in enumerate(new)],
+            register=False,  # sequential identity, collision-free by index
+        )
+        msg = np.empty(len(new), dtype=object)
+        ctx = np.empty(len(new), dtype=object)
+        for i, (m, c) in enumerate(new):
+            msg[i] = m
+            ctx[i] = c
+        return [Delta(keys=keys, data={"message": msg, "context": ctx})]
+
+    def is_finished(self) -> bool:
+        # nothing pending: the run ends when every OTHER source is also
+        # finished (the event loop requires all-finished AND no rounds), so
+        # errors raised by the final data tick still get drained first
+        return len(self._log.entries()) == self._seen
+
+
+def global_error_log() -> Table:
+    """The error log of the current run as a table of
+    ``(message, context)`` rows (reference ``pw.global_error_log()``)."""
+
+    def build() -> _ErrorLogSource:
+        return _ErrorLogSource(["message", "context"])
+
+    return Table(
+        "source",
+        [],
+        {"build": build},
+        schema_from_types(message=str, context=str),
+        Universe(),
+    )

@@ -284,6 +284,8 @@ class GraphRunner:
             return self._lower_rowwise(table)
         if kind == "filter":
             return self._lower_filter(table)
+        if kind == "remove_errors":
+            return self._add(ops.RemoveErrors(self.lower(table._inputs[0])))
         if kind == "reindex":
             return self._lower_reindex(table)
         if kind == "groupby_reduce":

@@ -202,6 +202,18 @@ class Table(TableLike):
             universe if universe is not None else self._universe,
         )
 
+    def remove_errors(self) -> "Table":
+        """Drop rows in which any column holds an Error value (reference
+        ``Table.remove_errors``, test_errors.py:620 — the engine's
+        filter_out_results_of_failed_computations)."""
+        return Table(
+            "remove_errors",
+            [self],
+            {},
+            self._schema,
+            Universe(parent=self._universe),
+        )
+
     def filter(self, filter_expression: Any) -> "Table":
         expr = self._sub(filter_expression)
         return Table(

@@ -89,7 +89,7 @@ def test_error_group_key_skips_row_and_logs():
     r = s.groupby(pw.this.gk).reduce(pw.this.gk, c=pw.reducers.count())
     assert rows(r) == [(5, 1)]
     assert ERROR_LOG.total > before
-    assert any("grouping key" in m for m, _ in ERROR_LOG.entries())
+    assert any("grouping columns" in m for m, _ in ERROR_LOG.entries())
 
 
 def test_error_in_min_max_reducers():
@@ -206,7 +206,7 @@ def test_error_keys_on_both_sides_never_match():
     rk = r2.select(kk=10 // pw.this.k, y=pw.this.y)
     j = lk.join(rk, lk.kk == rk.kk).select(pw.this.x, pw.this.y)
     assert rows(j) == [(10, 2)]
-    assert any("join key" in m for m, _ in ERROR_LOG.entries())
+    assert any("join condition" in m for m, _ in ERROR_LOG.entries())
 
 
 def test_error_filter_condition_skips_row():

@@ -529,15 +529,17 @@ def test_join_error_keys_dropped_even_without_live_errors():
     assert_table_equality_wo_index(j, expected)
 
 
-def test_id_join_duplicate_match_raises():
+def test_id_join_duplicate_match_degrades_to_error():
     # id=pw.left.id promises result.id == left.id; a left row matching two
-    # right rows would duplicate a row key inside a table carrying the
-    # left universe — the reference raises at runtime, so must we
+    # right rows degrades to ONE row with Error in the right columns plus
+    # a "duplicate key" log entry — the reference id-preserving join
+    # contract (test_errors.py:483), not a silent key duplication
     # (ADVICE r4: joins.py:140)
     left = T(
         """
         k | v
         1 | 10
+        2 | 20
         """
     )
     right = T(
@@ -545,15 +547,20 @@ def test_id_join_duplicate_match_raises():
         k | w
         1 | 100
         1 | 200
+        2 | 900
         """
     )
     j = left.join_left(right, left.k == right.k, id=pw.left.id).select(
-        pw.left.v, pw.right.w
+        pw.left.v, w=pw.fill_error(pw.right.w, -1)
     )
-    with pytest.raises(Exception, match="[Dd]uplicate"):
-        from pathway_tpu.internals.graph_runner import GraphRunner
+    log = pw.global_error_log().select(pw.this.message)
+    from pathway_tpu.internals.graph_runner import GraphRunner
 
-        GraphRunner().run_tables(j)
+    caps = GraphRunner().run_tables(j, log)
+    rows = sorted(r for _, r in caps[0].state.iter_items())
+    assert rows == [(10, -1), (20, 900)]
+    msgs = [r[0] for _, r in caps[1].state.iter_items()]
+    assert any(m.startswith("duplicate key") for m in msgs)
 
 
 def test_id_join_unique_matches_ok_incremental():

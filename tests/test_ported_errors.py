@@ -1,0 +1,240 @@
+"""Ported from the reference error-propagation suite
+(`/root/reference/python/pathway/tests/test_errors.py`): table data and
+expected outputs kept as the behavioral contract; harness adapted (output
+table and `pw.global_error_log()` asserted separately — our
+assert_table_equality takes one pair)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.error import ERROR_LOG
+from pathway_tpu.internals.graph_runner import GraphRunner
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T, assert_table_equality_wo_index
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    G.clear()
+    ERROR_LOG.clear()
+    yield
+    G.clear()
+    ERROR_LOG.clear()
+
+
+def _run_with_log(table):
+    log = pw.global_error_log().select(pw.this.message)
+    caps = GraphRunner().run_tables(table, log)
+    rows = sorted(tuple(r) for _, r in caps[0].state.iter_items())
+    msgs = sorted(r[0] for _, r in caps[1].state.iter_items())
+    return rows, msgs
+
+
+def test_division_by_zero():
+    # reference test_errors.py:22
+    t1 = T(
+        """
+        a | b | c
+        3 | 3 | 1
+        4 | 0 | 2
+        5 | 5 | 0
+        6 | 2 | 3
+        """
+    )
+    t2 = t1.select(x=pw.this.a // pw.this.b)
+    t3 = t1.select(y=pw.this.a // pw.this.c)
+    t4 = t1.select(pw.this.a, x=pw.fill_error(t2.x, -1), y=pw.fill_error(t3.y, -1))
+    rows, msgs = _run_with_log(t4)
+    assert rows == [(3, 1, 3), (4, -1, 2), (5, 1, -1), (6, 3, 2)]
+    assert msgs == ["division by zero", "division by zero"]
+
+
+def test_removal_of_error():
+    # reference test_errors.py:62 — the error row is retracted later; the
+    # log keeps the incident, the table does not keep the row
+    t1 = T(
+        """
+          | a | b | __time__ | __diff__
+        1 | 6 | 2 |     2    |     1
+        2 | 5 | 0 |     4    |     1
+        3 | 4 | 2 |     6    |     1
+        2 | 5 | 0 |     8    |    -1
+        """
+    )
+    t2 = t1.with_columns(c=pw.this.a // pw.this.b)
+    rows, msgs = _run_with_log(t2)
+    assert rows == [(4, 2, 2), (6, 2, 3)]
+    assert msgs.count("division by zero") == 2
+
+
+def test_filter_with_error_in_condition():
+    # reference test_errors.py:98
+    t1 = pw.debug.table_from_markdown(
+        """
+        a | b
+        6 | 2
+        5 | 5
+        4 | 0
+        3 | 3
+        """
+    )
+    t2 = t1.with_columns(x=pw.this.a // pw.this.b)
+    res = t2.filter(pw.this.x > 0)
+    rows, msgs = _run_with_log(res)
+    assert rows == [(3, 3, 1), (5, 5, 1), (6, 2, 3)]
+    assert msgs == [
+        "Error value encountered in filter condition, skipping the row",
+        "division by zero",
+    ]
+
+
+def test_inner_join_with_error_in_condition():
+    # reference test_errors.py:175
+    t1 = pw.debug.table_from_markdown(
+        """
+        a | c
+        1 | 1
+        2 | 0
+        3 | 1
+        """
+    ).with_columns(a=pw.this.a // pw.this.c)
+    t2 = pw.debug.table_from_markdown("b\n1\n1\n2")
+    res = t1.join(t2, pw.left.a == pw.right.b).select(
+        pw.left.a, pw.left.c, pw.right.b
+    )
+    rows, msgs = _run_with_log(res)
+    assert rows == [(1, 1, 1), (1, 1, 1)]
+    assert msgs == [
+        "Error value encountered in join condition, skipping the row",
+        "division by zero",
+    ]
+
+
+def test_left_join_with_error_in_condition():
+    # reference test_errors.py:216 — the error row still emits a PAD (its
+    # key matched nothing), with the Error kept in the left column
+    t1 = pw.debug.table_from_markdown(
+        """
+        a | c
+        1 | 1
+        2 | 0
+        3 | 1
+        """
+    ).with_columns(a=pw.this.a // pw.this.c)
+    t2 = pw.debug.table_from_markdown("b\n1\n1\n1\n2")
+    res = t1.join_left(t2, pw.left.a == pw.right.b).select(
+        a=pw.fill_error(pw.left.a, -1), c=pw.left.c, b=pw.right.b
+    )
+    rows, msgs = _run_with_log(res)
+    assert rows == [
+        (-1, 0, None), (1, 1, 1), (1, 1, 1), (1, 1, 1), (3, 1, None)
+    ]
+    assert "division by zero" in msgs
+
+
+def test_left_join_preserving_id_duplicate_key():
+    # reference test_errors.py:483 — two matches for one id-side row
+    # degrade to Error in the right columns + a "duplicate key" log entry
+    t1 = pw.debug.table_from_markdown("a\n1\n2\n3")
+    t2 = pw.debug.table_from_markdown("b\n1\n1\n1\n2")
+    res = (
+        t1.join_left(t2, pw.left.a == pw.right.b, id=pw.left.id)
+        .select(pw.left.a, pw.right.b)
+        .with_columns(b=pw.fill_error(pw.this.b, -1))
+    )
+    rows, msgs = _run_with_log(res)
+    assert rows == [(1, -1), (2, 2), (3, None)]
+    assert any(m.startswith("duplicate key") for m in msgs)
+
+
+def test_remove_errors():
+    # reference test_errors.py:620
+    t1 = T(
+        """
+        a | b | c
+        3 | 3 | 1
+        4 | 0 | 2
+        5 | 5 | 0
+        6 | 2 | 3
+        """
+    )
+    t2 = t1.select(x=pw.this.a // pw.this.b)
+    t3 = t1.select(y=pw.this.a // pw.this.c)
+    t4 = t1.select(pw.this.a, x=t2.x, y=t3.y)
+    res = t4.remove_errors()
+    expected = T(
+        """
+        a | x | y
+        3 | 1 | 3
+        6 | 3 | 2
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_remove_errors_identity():
+    # reference test_errors.py:651 — no errors: remove_errors is identity
+    t1 = T(
+        """
+        a | b | c
+        3 | 3 | 1
+        4 | 1 | 2
+        5 | 5 | 1
+        6 | 2 | 3
+        """
+    )
+    t2 = t1.select(pw.this.a, x=pw.this.a // pw.this.b, y=pw.this.a // pw.this.c)
+    res = t2.remove_errors()
+    expected = T(
+        """
+        a | x | y
+        3 | 1 | 3
+        4 | 4 | 2
+        5 | 1 | 5
+        6 | 3 | 2
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_groupby_with_error_in_grouping_column():
+    # reference test_errors.py:717 — error group keys skip with a log
+    t1 = T(
+        """
+        a | b | c
+        3 | 3 | 1
+        4 | 0 | 2
+        5 | 5 | 0
+        6 | 2 | 3
+        6 | 6 | 2
+        """
+    )
+    t2 = t1.select(x=pw.this.a // pw.this.b, y=pw.this.a // pw.this.c)
+    res = t2.groupby(pw.this.x, pw.this.y).reduce(
+        pw.this.x, pw.this.y, cnt=pw.reducers.count()
+    )
+    rows, msgs = _run_with_log(res)
+    assert rows == [(1, 3, 2), (3, 2, 1)]
+    assert msgs.count("division by zero") == 2
+    assert (
+        msgs.count(
+            "Error value encountered in grouping columns, skipping the row"
+        )
+        == 2
+    )
+
+
+def test_global_error_log_clear_scopes_runs():
+    # reference test_errors.py:1331 (clear) — a later run's log table only
+    # carries that run's errors
+    t = T("a | b\n1 | 0")
+    r1 = t.select(x=pw.fill_error(pw.this.a // pw.this.b, -1))
+    rows, msgs = _run_with_log(r1)
+    assert msgs == ["division by zero"]
+    G.clear()
+    t2 = T("a | b\n4 | 2")
+    r2 = t2.select(x=pw.this.a // pw.this.b)
+    rows2, msgs2 = _run_with_log(r2)
+    assert rows2 == [(2,)] and msgs2 == []
