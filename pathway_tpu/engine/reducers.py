@@ -342,32 +342,87 @@ class StatefulReducer(ReducerImpl):
 class CustomAccumulatorReducer(ReducerImpl):
     """BaseCustomAccumulator-driven reducer (reference
     ``custom_reducers.py:108`` ``udf_reducer``): ``from_row`` builds a
-    partial accumulator per row; ``update``/``retract`` fold them."""
+    partial accumulator per row; ``update``/``retract`` fold them.
+
+    Accumulators WITHOUT an overridden ``retract`` still handle
+    retractions: the group's row multiset is kept alongside the
+    accumulator and the fold is rebuilt from the remaining rows
+    (reference custom_reducers.py:332 keeps positive_updates and
+    re-folds when retract is unavailable)."""
 
     name = "custom_accumulator"
 
     def __init__(self, acc_cls):
         self._cls = acc_cls
+        from ..internals.custom_reducers import BaseCustomAccumulator
+
+        self._retractable = (
+            getattr(acc_cls, "retract", None)
+            is not BaseCustomAccumulator.retract
+        )
 
     def make(self):
         return None
 
-    def update(self, acc, values, diff, row_key, time):
-        count = abs(diff)
-        for _ in range(count):
-            other = self._cls.from_row(list(values))
-            if diff > 0:
-                if acc is None:
-                    acc = other
-                else:
-                    acc.update(other)
+    def _fold(self, rows):
+        acc = None
+        for row in rows:
+            other = self._cls.from_row(list(row))
+            if acc is None:
+                acc = other
             else:
-                if acc is None:
-                    raise ValueError("retract before any insert in custom reducer")
-                acc.retract(other)
+                acc.update(other)
         return acc
 
+    def update(self, acc, values, diff, row_key, time):
+        count = abs(diff)
+        if self._retractable:
+            for _ in range(count):
+                other = self._cls.from_row(list(values))
+                if diff > 0:
+                    if acc is None:
+                        acc = other
+                    else:
+                        acc.update(other)
+                else:
+                    if acc is None:
+                        raise ValueError(
+                            "retract before any insert in custom reducer"
+                        )
+                    acc.retract(other)
+            return acc
+        # retract-less accumulator: (accumulator, row multiset)
+        folded, rows = acc if acc is not None else (None, [])
+        row = tuple(values)
+        if diff > 0:
+            for _ in range(count):
+                rows.append(row)
+                other = self._cls.from_row(list(row))
+                if folded is None:
+                    folded = other
+                else:
+                    folded.update(other)
+            return (folded, rows)
+        from .delta import rows_equal
+
+        for _ in range(count):
+            for i, r in enumerate(rows):
+                if rows_equal(r, row):
+                    del rows[i]
+                    break
+            else:
+                raise ValueError(
+                    "retraction of a row never inserted in custom reducer"
+                )
+        if not rows:
+            return None
+        return (self._fold(rows), rows)
+
     def extract(self, acc):
+        if acc is None:
+            return None
+        if not self._retractable:
+            acc = acc[0]
         return acc.compute_result() if acc is not None else None
 
 

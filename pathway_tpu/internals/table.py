@@ -202,6 +202,17 @@ class Table(TableLike):
             universe if universe is not None else self._universe,
         )
 
+    @classmethod
+    def empty(cls, **kwargs: Any) -> "Table":
+        """An empty table with the given column types (reference
+        ``pw.Table.empty(cnt=int)``)."""
+        from .schema import schema_from_types
+        from .table_io import rows_to_table
+
+        return rows_to_table(
+            list(kwargs), [], schema=schema_from_types(**kwargs)
+        )
+
     def remove_errors(self) -> "Table":
         """Drop rows in which any column holds an Error value (reference
         ``Table.remove_errors``, test_errors.py:620 — the engine's

@@ -96,13 +96,52 @@ def latest(arg: Any) -> ReducerExpression:
     return ReducerExpression("latest", (arg,))
 
 
-def stateful_single(combine_fn, *args: Any) -> ReducerExpression:
-    """Custom accumulator reducer: combine_fn(state, values, diff) -> state."""
-    return ReducerExpression("stateful", args, combine_fn=combine_fn)
+def stateful_single(combine_fn, *args: Any):
+    """Custom stateful reducer.
+
+    Decorator form (reference ``custom_reducers.py`` stateful_single):
+    ``@pw.reducers.stateful_single`` over ``fn(state, *row_values)`` —
+    the returned factory is called with column args in ``reduce``.
+    Append-only (retractions raise, as in the reference).
+
+    Legacy direct form: ``stateful_single(fn, *cols)`` with
+    ``fn(state, values, diff)``.
+    """
+    if args:
+        return ReducerExpression("stateful", args, combine_fn=combine_fn)
+
+    def make(*cols: Any) -> ReducerExpression:
+        def adapter(state, values, diff):
+            if diff < 0:
+                raise ValueError(
+                    "stateful_single reducer cannot process retractions; "
+                    "use stateful_many or a BaseCustomAccumulator with "
+                    "retract()"
+                )
+            for _ in range(diff):
+                state = combine_fn(state, *values)
+            return state
+
+        return ReducerExpression("stateful", cols, combine_fn=adapter)
+
+    return make
 
 
-def stateful_many(combine_fn, *args: Any) -> ReducerExpression:
-    return ReducerExpression("stateful", args, combine_fn=combine_fn)
+def stateful_many(combine_fn, *args: Any):
+    """Decorator form (reference): ``fn(state, rows)`` with
+    ``rows = [(row_values_list, count)]`` — counts may be negative
+    (retractions). Legacy direct form: ``stateful_many(fn, *cols)`` with
+    ``fn(state, values, diff)``."""
+    if args:
+        return ReducerExpression("stateful", args, combine_fn=combine_fn)
+
+    def make(*cols: Any) -> ReducerExpression:
+        def adapter(state, values, diff):
+            return combine_fn(state, [(list(values), diff)])
+
+        return ReducerExpression("stateful", cols, combine_fn=adapter)
+
+    return make
 
 
 def udf_reducer(reducer_cls):

@@ -1,0 +1,280 @@
+"""Ported from `/root/reference/python/pathway/tests/test_reducers.py`:
+custom accumulator reducers (udf_reducer) and stateful_single/many in all
+arities, with the reference's table data and expected outputs."""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T, assert_table_equality, assert_table_equality_wo_index
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    G.clear()
+    yield
+    G.clear()
+
+
+class CustomCntAccumulator(pw.BaseCustomAccumulator):
+    # reference test_reducers.py:11
+    def __init__(self, cnt):
+        self.cnt = cnt
+
+    @classmethod
+    def from_row(cls, val):
+        return cls(1)
+
+    def update(self, other):
+        self.cnt += other.cnt
+
+    def compute_result(self) -> int:
+        return self.cnt
+
+
+custom_cnt = pw.reducers.udf_reducer(CustomCntAccumulator)
+
+PETS = """
+    pet  |  owner  | age
+    dog  | Alice   | 10
+    dog  | Bob     | 9
+    cat  | Alice   | 8
+    dog  | Bob     | 7
+"""
+
+PETS_DYNAMIC = """
+    pet  |  owner  | age | __time__ | __diff__
+    dog  | Alice   | 10  | 0        | 1
+    dog  | Bob     | 9   | 0        | 1
+    cat  | Alice   | 8   | 0        | 1
+    dog  | Bob     | 7   | 0        | 1
+    dog  | Bob     | 7   | 2        | -1
+    cat  | Bob     | 9   | 4        | 1
+"""
+
+
+def test_custom_count_static():
+    # reference test_reducers.py:29
+    left = T(PETS)
+    left_res = left.groupby(left.pet).reduce(left.pet, cnt=custom_cnt())
+    assert_table_equality(
+        left_res, T("pet | cnt\ndog | 3\ncat | 1", id_from=["pet"])
+    )
+
+
+def test_custom_count_dynamic():
+    # reference test_reducers.py:55
+    left = T(PETS_DYNAMIC)
+    left_res = left.groupby(left.pet).reduce(left.pet, cnt=custom_cnt())
+    assert_table_equality(
+        left_res, T("pet | cnt\ndog | 2\ncat | 2", id_from=["pet"])
+    )
+
+
+def test_custom_count_null():
+    # reference test_reducers.py:83 — fully retracted group vanishes
+    left = T(
+        """
+        pet  |  owner  | age | __time__ | __diff__
+        dog  | Alice   | 10  | 0        | 1
+        dog  | Alice   | 10  | 2        | -1
+        """
+    )
+    left_res = left.groupby(left.pet).reduce(cnt=custom_cnt())
+    assert_table_equality(left_res, pw.Table.empty(cnt=int))
+
+
+class CustomCntWithRetractAccumulator(CustomCntAccumulator):
+    # reference test_reducers.py:96
+    def retract(self, other) -> None:
+        self.cnt -= other.cnt
+
+
+custom_cnt_with_retract = pw.reducers.udf_reducer(CustomCntWithRetractAccumulator)
+
+
+def test_custom_count_retract_dynamic():
+    # reference test_reducers.py:105
+    left = T(PETS_DYNAMIC)
+    left_res = left.groupby(left.pet).reduce(
+        left.pet, cnt=custom_cnt_with_retract()
+    )
+    assert_table_equality(
+        left_res, T("pet | cnt\ndog | 2\ncat | 2", id_from=["pet"])
+    )
+
+
+def test_custom_count_retract_null():
+    # reference test_reducers.py:133
+    left = T(
+        """
+        pet  |  owner  | age | __time__ | __diff__
+        dog  | Alice   | 10  | 0        | 1
+        dog  | Alice   | 10  | 2        | -1
+        """
+    )
+    left_res = left.groupby(left.pet).reduce(cnt=custom_cnt_with_retract())
+    assert_table_equality(left_res, pw.Table.empty(cnt=int))
+
+
+class CustomMeanStdevAccumulator(pw.BaseCustomAccumulator):
+    # reference test_reducers.py:146
+    def __init__(self, sum, sum2, count):
+        self.sum = sum
+        self.sum2 = sum2
+        self.count = count
+
+    @classmethod
+    def from_row(cls, row):
+        [a] = row
+        return CustomMeanStdevAccumulator(a, a * a, 1)
+
+    def update(self, other):
+        self.sum += other.sum
+        self.sum2 += other.sum2
+        self.count += other.count
+
+    def compute_result(self) -> tuple[float, float]:
+        mean = self.sum / self.count
+        stdev = math.sqrt(self.sum2 / self.count - mean**2)
+        return mean, stdev
+
+
+custom_mean_stdev = pw.reducers.udf_reducer(CustomMeanStdevAccumulator)
+
+
+def test_custom_mean_stdev():
+    # reference test_reducers.py:172
+    left = T(
+        """
+        pet  |  owner  | age
+        cat  | Alice   | 10
+        dog  | Bob     | 9
+        cat  | Alice   | 8
+        dog  | Bob     | 7
+        """
+    )
+    left_res = left.groupby(left.pet).reduce(
+        left.pet, mean_stdev=custom_mean_stdev(pw.this.age)
+    )
+    left_res = left_res.select(
+        pw.this.pet,
+        mean=pw.apply_with_type(lambda t: t[0], float, pw.this.mean_stdev),
+        stdev=pw.apply_with_type(lambda t: t[1], float, pw.this.mean_stdev),
+    )
+    assert_table_equality_wo_index(
+        left_res,
+        T(
+            """
+            pet | mean | stdev
+            dog | 8.0  | 1.0
+            cat | 9.0  | 1.0
+            """
+        ),
+        check_types=False,
+    )
+
+
+def test_stateful_single_nullary():
+    # reference test_reducers.py:204
+    left = T(PETS)
+
+    @pw.reducers.stateful_single
+    def count(state):
+        return state + 1 if state is not None else 1
+
+    left_res = left.groupby(left.pet).reduce(left.pet, cnt=count())
+    assert_table_equality_wo_index(
+        left_res, T("pet | cnt\ndog | 3\ncat | 1"), check_types=False
+    )
+
+
+def test_stateful_many_nullary():
+    # reference test_reducers.py:234
+    left = T(PETS)
+
+    @pw.reducers.stateful_many
+    def count(state, rows):
+        new_state = state if state is not None else 0
+        for row, cnt in rows:
+            new_state += cnt
+        return new_state if new_state != 0 else None
+
+    left_res = left.groupby(left.pet).reduce(left.pet, cnt=count())
+    assert_table_equality_wo_index(
+        left_res, T("pet | cnt\ndog | 3\ncat | 1"), check_types=False
+    )
+
+
+def test_stateful_single_unary():
+    # reference test_reducers.py:267
+    left = T(PETS)
+
+    @pw.reducers.stateful_single
+    def lens(state, val):
+        if state is None:
+            return len(val)
+        return state + len(val)
+
+    left_res = left.groupby(left.pet).reduce(left.pet, lens=lens(left.owner))
+    assert_table_equality_wo_index(
+        left_res, T("pet | lens\ndog | 11\ncat | 5"), check_types=False
+    )
+
+
+def test_stateful_many_unary():
+    # reference test_reducers.py:300
+    left = T(PETS)
+
+    @pw.reducers.stateful_many
+    def lens(state, rows):
+        new_state = state if state is not None else 0
+        for [data], cnt in rows:
+            new_state += len(data) * cnt
+        return new_state if new_state != 0 else None
+
+    left_res = left.groupby(left.pet).reduce(left.pet, lens=lens(left.owner))
+    assert_table_equality_wo_index(
+        left_res, T("pet | lens\ndog | 11\ncat | 5"), check_types=False
+    )
+
+
+def test_stateful_single_binary():
+    # reference test_reducers.py:333
+    left = T(PETS)
+
+    @pw.reducers.stateful_single
+    def lens(state, s, i):
+        if state is None:
+            return len(s) * i
+        return state + len(s) * i
+
+    left_res = left.groupby(left.pet).reduce(
+        left.pet, lens=lens(left.owner, left.age)
+    )
+    assert_table_equality_wo_index(
+        left_res, T("pet | lens\ndog | 98\ncat | 40"), check_types=False
+    )
+
+
+def test_stateful_many_binary():
+    # reference test_reducers.py:366
+    left = T(PETS)
+
+    @pw.reducers.stateful_many
+    def lens(state, rows):
+        new_state = state if state is not None else 0
+        for [s, i], cnt in rows:
+            new_state += len(s) * i * cnt
+        return new_state if new_state != 0 else None
+
+    left_res = left.groupby(left.pet).reduce(
+        left.pet, lens=lens(left.owner, left.age)
+    )
+    assert_table_equality_wo_index(
+        left_res, T("pet | lens\ndog | 98\ncat | 40"), check_types=False
+    )
