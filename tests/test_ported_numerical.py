@@ -1,0 +1,118 @@
+"""Ported from
+`/root/reference/python/pathway/tests/expressions/test_numerical.py`:
+`.num` namespace (abs/round/fill_na) with the reference's data and
+expected outputs."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import assert_table_equality
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    G.clear()
+    yield
+    G.clear()
+
+
+@pytest.mark.parametrize("use_namespace", [True, False])
+def test_abs_int(use_namespace):
+    # reference test_numerical.py:11
+    table = table_from_markdown("v\n-110\n-3\n7\n-1\n12")
+    if use_namespace:
+        results = table.select(v_abs=table.v.num.abs())
+    else:
+        results = table.select(v_abs=abs(table.v))
+    assert_table_equality(results, table_from_markdown("v_abs\n110\n3\n7\n1\n12"))
+
+
+@pytest.mark.parametrize("use_namespace", [True, False])
+def test_abs_float(use_namespace):
+    # reference test_numerical.py:40
+    table = table_from_markdown("v\n-110.5\n-3.8\n7.2\n-1.6\n12.9")
+    if use_namespace:
+        results = table.select(v_abs=table.v.num.abs())
+    else:
+        results = table.select(v_abs=abs(table.v))
+    assert_table_equality(
+        results, table_from_markdown("v_abs\n110.5\n3.8\n7.2\n1.6\n12.9")
+    )
+
+
+def test_round():
+    # reference test_numerical.py:68
+    table = table_from_markdown("v\n1\n1.2\n1.23\n1.234\n1.2345")
+    results = table.select(v_round=table.v.num.round(2))
+    assert_table_equality(
+        results, table_from_markdown("v_round\n1.0\n1.20\n1.23\n1.23\n1.23")
+    )
+
+
+def test_round_column():
+    # reference test_numerical.py:93 — per-row precision column
+    table = table_from_markdown(
+        """
+        value   | precision
+        3       | 0
+        3.1     | 1
+        3.14    | 1
+        3.141   | 2
+        3.1415  | 2
+        """
+    )
+    results = table.select(v_round=table.value.num.round(pw.this.precision))
+    assert_table_equality(
+        results, table_from_markdown("v_round\n3.0\n3.1\n3.1\n3.14\n3.14")
+    )
+
+
+def test_fill_na_optional_int():
+    # reference test_numerical.py:144
+    table = table_from_markdown(
+        """
+        index | v
+        1     | 1
+        2     | None
+        3     | 3
+        4     | 4
+        5     | 5
+        """
+    )
+    results = table.select(v_filled=table.v.num.fill_na(0))
+    assert_table_equality(
+        results, table_from_markdown("v_filled\n1\n0\n3\n4\n5"),
+        check_types=False,
+    )
+
+
+def test_fill_na_nan_float():
+    # reference test_numerical.py:118 — NaN fills too, not just None
+    import math
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(v=float | None),
+        [(1.0,), (None,), (3.5,), (float("nan"),), (5.0,)],
+    )
+    results = t.select(v_filled=t.v.num.fill_na(0))
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    cap = GraphRunner().run_tables(results)[0]
+    vals = sorted(r[0] for _, r in cap.state.iter_items())
+    assert vals == [0.0, 0.0, 1.0, 3.5, 5.0]
+    assert not any(math.isnan(v) for v in vals)
+
+
+def test_fill_na_float_identity():
+    # reference test_numerical.py:169
+    table = table_from_markdown("index | v\n1|1.1\n2|2.2\n3|3.3\n4|4.4\n5|5.5")
+    results = table.select(v_filled=table.v.num.fill_na(0))
+    assert_table_equality(
+        results,
+        table_from_markdown("v_filled\n1.1\n2.2\n3.3\n4.4\n5.5"),
+        check_types=False,
+    )
