@@ -82,8 +82,19 @@ class AsyncTransformer(ABC):
     def _wrapped_invoke(self):
         names = self._input_table.column_names()
 
+        expected_cols = set(self.output_schema.column_names())
+
         async def call(*values):
-            return dict(await self.invoke(**dict(zip(names, values))))
+            result = dict(await self.invoke(**dict(zip(names, values))))
+            if set(result) != expected_cols:
+                # reference asserts the result matches output_schema
+                # (test_async_transformer.py:188) — the row lands in
+                # .failed, not in .successful with nulls
+                raise ValueError(
+                    f"AsyncTransformer.invoke returned columns "
+                    f"{sorted(result)}, expected {sorted(expected_cols)}"
+                )
+            return result
 
         # exceptions must still RAISE through cache/retry (retry fires on
         # exceptions; the cache must not memoize failures) — only the

@@ -1,0 +1,124 @@
+"""Ported from
+`/root/reference/python/pathway/tests/test_async_transformer.py`:
+AsyncTransformer contract — successful/failed split, schema mismatch,
+instance grouping, per-key instance change consistency."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Any
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T, assert_table_equality_wo_index
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    G.clear()
+    yield
+    G.clear()
+
+
+class OutputSchema(pw.Schema):
+    ret: int
+
+
+def test_simple():
+    # reference test_async_transformer.py:34
+    class TestAsyncTransformer(pw.AsyncTransformer, output_schema=OutputSchema):
+        async def invoke(self, value: int) -> dict[str, Any]:
+            await asyncio.sleep(random.uniform(0, 0.05))
+            return dict(ret=value + 1)
+
+    input_table = T("value\n1\n2\n3")
+    result = TestAsyncTransformer(input_table=input_table).successful
+    assert_table_equality_wo_index(result, T("ret\n2\n3\n4"))
+
+
+def test_idempotency():
+    # reference test_async_transformer.py:113 — state cleared between runs
+    class TestAsyncTransformer(pw.AsyncTransformer, output_schema=OutputSchema):
+        async def invoke(self, value: int) -> dict[str, Any]:
+            return dict(ret=value + 1)
+
+    input_table = T("value\n1\n2\n3")
+    result = TestAsyncTransformer(input_table=input_table).successful
+    expected = T("ret\n2\n3\n4")
+    assert_table_equality_wo_index(result, expected)
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_filter_failures():
+    # reference test_async_transformer.py:150
+    class TestAsyncTransformer(pw.AsyncTransformer, output_schema=OutputSchema):
+        async def invoke(self, value: int) -> dict[str, Any]:
+            if value == 2:
+                raise Exception
+            return dict(ret=value + 1)
+
+    input_table = T("value\n1\n2\n3")
+    result = TestAsyncTransformer(input_table=input_table).successful
+    assert_table_equality_wo_index(result, T("ret\n2\n4"))
+
+
+def test_assert_schema_error():
+    # reference test_async_transformer.py:188 — wrong keys = failed row
+    class TestAsyncTransformer(pw.AsyncTransformer, output_schema=OutputSchema):
+        async def invoke(self, value: int) -> dict[str, Any]:
+            return dict(foo=value + 1)
+
+    input_table = T("value\n1\n2")
+    result = TestAsyncTransformer(input_table=input_table).successful
+    assert_table_equality_wo_index(result, pw.Table.empty(ret=int))
+
+
+def test_failed():
+    # reference test_async_transformer.py:470
+    class OutputSchemaF(pw.Schema):
+        ret: float
+
+    class TestAsyncTransformer(pw.AsyncTransformer, output_schema=OutputSchemaF):
+        async def invoke(self, value: float) -> dict[str, Any]:
+            if value == 1.1:
+                raise ValueError("incorrect value")
+            return dict(ret=value)
+
+    input_table = T("value\n1.3\n1.1")
+    failed = TestAsyncTransformer(input_table=input_table).failed
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    cap = GraphRunner().run_tables(failed)[0]
+    rows = [r for _, r in cap.state.iter_items()]
+    assert len(rows) == 1  # exactly the raising row, ret is null
+
+
+def test_with_instance_groups_complete_together():
+    # reference test_async_transformer.py:264 — all rows of an instance
+    # land in one consistent batch
+    class OutputSchemaF(pw.Schema):
+        ret: float
+
+    class TestAsyncTransformer(pw.AsyncTransformer, output_schema=OutputSchemaF):
+        async def invoke(self, value: float, instance: int) -> dict[str, Any]:
+            await asyncio.sleep(value * 0.05)
+            return dict(ret=value)
+
+    input_table = T(
+        """
+        value | instance
+         0.3  |     1
+         0.1  |     1
+         0.0  |     2
+         0.5  |     2
+        """
+    )
+    result = TestAsyncTransformer(
+        input_table=input_table, instance=pw.this.instance
+    ).successful
+    assert_table_equality_wo_index(
+        result, T("ret\n0.3\n0.1\n0.0\n0.5"), check_types=False
+    )
