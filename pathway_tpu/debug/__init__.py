@@ -71,6 +71,11 @@ def table_from_markdown(
     sep = r"\s*\|\s*|\s+" if split_on_whitespace else r"\s*\|\s*"
 
     def split(line: str) -> list[str]:
+        if split_on_whitespace and "|" in line:
+            # pipe-delimited: EMPTY cells are meaningful (None values) —
+            # "1 |  5  |" is id=1, next=5, prev=None (reference prev/next
+            # tables); a leading empty header cell marks the id column
+            return [t.strip() for t in line.strip().split("|")]
         toks = re.split(sep, line.strip())
         if split_on_whitespace:
             return [t for t in toks if t != ""]
@@ -82,7 +87,7 @@ def table_from_markdown(
 
     names = split(lines[0])
     # leading empty / "id" header column = trusted integer ids
-    has_id_col = bool(names) and names[0] == "id"
+    has_id_col = bool(names) and names[0] in ("id", "")
     if has_id_col:
         names = names[1:]
 

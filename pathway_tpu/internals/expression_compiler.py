@@ -551,14 +551,32 @@ def _build(
         parts = [_build(a, env, xp_name) for a in expr._args]
         if expr._instance is not None:
             parts.append(_build(expr._instance, env, xp_name))
+        optional = getattr(expr, "_optional", False)
 
         def fn(cols, keys):
             n = len(keys)
             arrs = [_materialize(p[0](cols, keys), n) for p in parts]
-            return K.mix_columns(arrs, n)
+            ptrs = K.mix_columns(arrs, n)
+            if optional:
+                # pointer_from(..., optional=True): any None argument
+                # makes the pointer None (reference prev/next tables)
+                null = np.zeros(n, dtype=bool)
+                for a in arrs:
+                    aa = np.asarray(a)
+                    if aa.dtype == object:
+                        null |= np.fromiter(
+                            (v is None for v in aa), bool, n
+                        )
+                if null.any():
+                    out = np.empty(n, dtype=object)
+                    for i in range(n):
+                        out[i] = None if null[i] else ptrs[i]
+                    return out
+            return ptrs
 
         refs = set().union(*[p[3] for p in parts]) if parts else set()
-        return fn, dt.POINTER, False, refs
+        out_dt = dt.Optional(dt.POINTER) if optional else dt.POINTER
+        return fn, out_dt, False, refs
 
     if isinstance(expr, MakeTupleExpression):
         parts = [_build(a, env, xp_name) for a in expr._args]

@@ -182,7 +182,14 @@ class Table(TableLike):
         return out
 
     def pointer_from(self, *args: Any, instance: Any = None, optional: bool = False) -> PointerExpression:
-        return PointerExpression(self, *[self._sub(a) for a in args], instance=instance, optional=optional)
+        # args stay UNBOUND: ``this`` in them refers to the table the
+        # expression is used in (reference semantics — e.g. an expected
+        # table built with ``.with_columns(k=t.pointer_from(this.k))``
+        # reads ITS OWN k column and keys into t's universe)
+        return PointerExpression(
+            self, *[smart_coerce(a) for a in args],
+            instance=instance, optional=optional,
+        )
 
     # -- rowwise ops (table.py:382 select, :490 filter, :1613 with_columns) --
 
