@@ -212,13 +212,35 @@ class Callable(DType):
 
 
 class PyObjectWrapper(DType):
+    """Opaque wrapped-python-object dtype; optionally parameterized with
+    the wrapped class (``pw.PyObjectWrapper[MyClass]`` annotations)."""
+
     _name = "PyObjectWrapper"
+
+    def __init__(self, wrapped: Any = None):
+        self.wrapped = wrapped
+
+    def __repr__(self) -> str:
+        if self.wrapped is None:
+            return self._name
+        return f"PyObjectWrapper[{getattr(self.wrapped, '__name__', self.wrapped)!s}]"
 
     def __eq__(self, other: object) -> bool:
         return isinstance(other, PyObjectWrapper)
 
     def __hash__(self) -> int:
         return hash("PyObjectWrapper")
+
+    def is_value_compatible(self, value: Any) -> bool:
+        from .py_object_wrapper import PyObjectWrapper as _Wrapper
+
+        if not isinstance(value, _Wrapper):
+            return False
+        if self.wrapped is None:
+            return True
+        return type(value.value) is self.wrapped or isinstance(
+            value.value, self.wrapped
+        )
 
 
 _FROM_PY: dict[Any, DType] = {
@@ -270,6 +292,13 @@ def wrap(t: Any) -> DType:
         return List(wrap(args[0]) if args else ANY)
     if isinstance(t, type) and issubclass(t, Pointer):
         return POINTER
+    from .py_object_wrapper import PyObjectWrapper as _PyObjWrapper
+
+    if t is _PyObjWrapper:
+        return PyObjectWrapper()
+    if origin is _PyObjWrapper:  # PyObjectWrapper[MyClass]
+        args = typing.get_args(t)
+        return PyObjectWrapper(args[0] if args else None)
     if t in _FROM_PY:
         return _FROM_PY[t]
     if isinstance(t, type) and issubclass(t, np.integer):
@@ -316,6 +345,10 @@ def dtype_of_value(v: Any) -> DType:
         return Tuple(*[dtype_of_value(x) for x in v])
     if isinstance(v, (dict, Json)):
         return JSON
+    from .py_object_wrapper import PyObjectWrapper as _PyObjWrapper
+
+    if isinstance(v, _PyObjWrapper):
+        return PyObjectWrapper(type(v.value))
     return ANY
 
 

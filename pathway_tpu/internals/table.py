@@ -356,30 +356,49 @@ class Table(TableLike):
 
     # -- joins (table.py / joins.py) ----------------------------------------
 
+    @staticmethod
+    def _with_instance_cond(on: tuple, kwargs: dict) -> tuple:
+        """``left_instance=``/``right_instance=`` desugar to an extra
+        equality condition (reference join instance kwargs)."""
+        li = kwargs.pop("left_instance", None)
+        ri = kwargs.pop("right_instance", None)
+        if (li is None) != (ri is None):
+            raise ValueError(
+                "left_instance and right_instance must be given together"
+            )
+        if li is not None:
+            on = (*on, li == ri)
+        return on
+
     def join(self, other: "Table", *on: Any, id: Any = None, how: Any = None, **kwargs):
         from .joins import JoinMode, JoinResult
 
+        on = self._with_instance_cond(on, kwargs)
         mode = how if how is not None else JoinMode.INNER
         return JoinResult(self, other, on, mode=mode, id=id)
 
     def join_inner(self, other: "Table", *on: Any, id: Any = None, **kwargs):
         from .joins import JoinMode, JoinResult
 
+        on = self._with_instance_cond(on, kwargs)
         return JoinResult(self, other, on, mode=JoinMode.INNER, id=id)
 
     def join_left(self, other: "Table", *on: Any, id: Any = None, **kwargs):
         from .joins import JoinMode, JoinResult
 
+        on = self._with_instance_cond(on, kwargs)
         return JoinResult(self, other, on, mode=JoinMode.LEFT, id=id)
 
     def join_right(self, other: "Table", *on: Any, id: Any = None, **kwargs):
         from .joins import JoinMode, JoinResult
 
+        on = self._with_instance_cond(on, kwargs)
         return JoinResult(self, other, on, mode=JoinMode.RIGHT, id=id)
 
     def join_outer(self, other: "Table", *on: Any, id: Any = None, **kwargs):
         from .joins import JoinMode, JoinResult
 
+        on = self._with_instance_cond(on, kwargs)
         return JoinResult(self, other, on, mode=JoinMode.OUTER, id=id)
 
     # -- set ops ------------------------------------------------------------

@@ -1,0 +1,139 @@
+"""Ported from
+`/root/reference/python/pathway/tests/test_py_object_wrapper.py`:
+PyObjectWrapper values flow through UDFs, joins, groupby; dtype
+parameterization checks; pickle/copy round-trips."""
+
+from __future__ import annotations
+
+import copy
+import pickle
+from dataclasses import dataclass
+
+import pandas as pd
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.internals.dtype as dt
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T, assert_table_equality, assert_table_equality_wo_index
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    G.clear()
+    yield
+    G.clear()
+
+
+@dataclass
+class Simple:
+    a: int
+
+    def add(self, x: int) -> int:
+        return self.a + x
+
+
+def test_py_object_simple():
+    # reference test_py_object_wrapper.py:35
+    @pw.udf
+    def create_py_object(a: int) -> pw.PyObjectWrapper[Simple]:
+        return pw.PyObjectWrapper(Simple(a))
+
+    @pw.udf
+    def use_py_object(a: int, b: pw.PyObjectWrapper[Simple]) -> int:
+        return b.value.add(a)
+
+    t = pw.debug.table_from_markdown("a\n1\n2\n3").with_columns(
+        b=create_py_object(pw.this.a)
+    )
+    res = t.select(res=use_py_object(pw.this.a, pw.this.b))
+    assert_table_equality(res, pw.debug.table_from_markdown("res\n2\n4\n6"))
+
+
+@dataclass
+class Inc:
+    a: int
+    df: pd.DataFrame
+
+    def add(self, x: int) -> int:
+        return self.df["y"].sum() - 2 * self.a + x
+
+
+def test_py_object_through_instance_join():
+    # reference test_py_object_wrapper.py:76
+    @pw.udf
+    def create_inc(a: int) -> pw.PyObjectWrapper:
+        return pw.PyObjectWrapper(
+            Inc(a, pd.DataFrame({"x": [1, 2, 3], "y": [a, a, a]}))
+        )
+
+    t = pw.debug.table_from_markdown(
+        """
+        a | instance
+        1 |     0
+        2 |     2
+        3 |     0
+        4 |     2
+        """
+    )
+    z = t.filter(pw.this.a > 2)
+    t = t.with_columns(inc=create_inc(pw.this.a))
+
+    @pw.udf
+    def use_python_object(a: pw.PyObjectWrapper, x: int) -> int:
+        return a.value.add(x)
+
+    res = t.join(
+        z, left_instance=pw.left.instance, right_instance=pw.right.instance
+    ).select(res=use_python_object(pw.left.inc, pw.right.a))
+    assert_table_equality_wo_index(
+        res, pw.debug.table_from_markdown("res\n4\n6\n6\n8")
+    )
+
+
+def test_dtypes():
+    # reference test_py_object_wrapper.py:115
+    py_object_int = pw.PyObjectWrapper(10)
+    assert dt.wrap(pw.PyObjectWrapper[int]).is_value_compatible(py_object_int)
+    assert dt.wrap(pw.PyObjectWrapper).is_value_compatible(py_object_int)
+    assert not dt.wrap(pw.PyObjectWrapper[str]).is_value_compatible(py_object_int)
+
+    @dataclass
+    class Local:
+        b: bytes
+
+    obj = pw.PyObjectWrapper(Local(b"abc"))
+    assert dt.wrap(pw.PyObjectWrapper[Local]).is_value_compatible(obj)
+    assert dt.wrap(pw.PyObjectWrapper).is_value_compatible(obj)
+    assert not dt.wrap(pw.PyObjectWrapper[bytes]).is_value_compatible(obj)
+    assert not dt.wrap(pw.PyObjectWrapper[int]).is_value_compatible(obj)
+
+
+def test_groupby():
+    # reference test_py_object_wrapper.py:132 — group by wrapper content
+    @pw.udf
+    def create_simple(a: int) -> pw.PyObjectWrapper[Simple]:
+        return pw.PyObjectWrapper(Simple(a))
+
+    t = pw.debug.table_from_markdown("a\n1\n2\n2\n3\n1").select(
+        simple=create_simple(pw.this.a)
+    )
+    res = t.groupby(pw.this.simple).reduce(cnt=pw.reducers.count())
+    assert_table_equality_wo_index(
+        res, pw.debug.table_from_markdown("cnt\n2\n2\n1")
+    )
+
+
+def test_serialization_pickle():
+    # reference test_py_object_wrapper.py:306 (simple serialization)
+    w = pw.PyObjectWrapper(Simple(7))
+    w2 = pickle.loads(pickle.dumps(w))
+    assert w2 == w and w2.value.add(1) == 8
+
+
+def test_copy_deepcopy():
+    # reference test_py_object_wrapper.py:317/:326
+    w = pw.PyObjectWrapper(Simple(3))
+    assert copy.copy(w) == w
+    assert copy.deepcopy(w) == w
+    assert copy.deepcopy(w).value is not w.value
