@@ -377,6 +377,7 @@ class GroupByReduce(Node):
         reducers: list[tuple[str, ReducerImpl, list[str]]],
         key_salt: int = 0,
         key_from_column: str | None = None,
+        skip_errors: bool = True,
     ):
         out_cols = list(group_cols) + [name for name, _, _ in reducers]
         super().__init__([inp], out_cols)
@@ -384,6 +385,10 @@ class GroupByReduce(Node):
         self._reducers = reducers
         self._key_salt = key_salt
         self._key_from_column = key_from_column
+        #: reference groupby(_skip_errors=True) default: an Error arg cell
+        #: is EXCLUDED from its reducer (count still counts the row);
+        #: False keeps the error-multiplicity path (aggregate reads Error)
+        self._skip_errors = skip_errors
         # group_key -> [count, group_values, [accs...], last_emitted_row|None]
         self._state: dict[int, list] = {}
         # group_key -> per-reducer Error multiplicity (reference
@@ -713,9 +718,15 @@ class GroupByReduce(Node):
                 if watch_errors and any(
                     type(v) is EngineError for v in vals
                 ):
-                    # reference reduce.rs error_count: the Error row joins
-                    # the group's error multiplicity, not the accumulator —
-                    # the aggregate reads Error until it retracts
+                    if self._skip_errors:
+                        # reference groupby default: the Error cell is
+                        # simply not reduced (count has no args and still
+                        # counts the row)
+                        continue
+                    # _skip_errors=False (reference reduce.rs error_count):
+                    # the Error row joins the group's error multiplicity,
+                    # not the accumulator — the aggregate reads Error
+                    # until it retracts
                     errs = self._gerrs.setdefault(
                         gk, [0] * len(self._reducers)
                     )

@@ -544,6 +544,7 @@ class GraphRunner:
         gb = self._add(ops.GroupByReduce(
             pre_node, group_cols, engine_reducers,
             key_from_column="gk0" if by_id else None,
+            skip_errors=p.get("skip_errors", True),
         ))
         # post projection: grouping refs -> gk{i}, hidden refs resolve directly
         post_env = ColumnEnv()

@@ -33,11 +33,16 @@ class GroupedTable:
         grouping: list[ColumnExpression],
         instance: ColumnExpression | None = None,
         by_id: bool = False,
+        skip_errors: bool = True,
     ):
         self._table = table
         self._grouping = grouping
         self._instance = instance
         self._by_id = by_id
+        #: reference groupby(_skip_errors=True) default: value reducers
+        #: ignore Error cells; False = the aggregate reads Error until
+        #: the error row retracts (reduce.rs error_count)
+        self._skip_errors = skip_errors
         # map grouping expr by (reference identity) so reduce() args can refer to them
         self._group_names: dict[str, int] = {}
         for i, g in enumerate(grouping):
@@ -104,6 +109,7 @@ class GroupedTable:
                 "reducers": reducers,
                 "outputs": rewritten,
                 "group_names": dict(self._group_names),
+                "skip_errors": self._skip_errors,
             },
             _infer_reduce_schema(self._table, grouping, self._group_names, reducers, rewritten),
             Universe(),

@@ -319,7 +319,8 @@ class Table(TableLike):
 
     # -- groupby / reduce (table.py:942, :1025) -----------------------------
 
-    def groupby(self, *args: Any, id: Any = None, instance: Any = None, **kwargs: Any):
+    def groupby(self, *args: Any, id: Any = None, instance: Any = None,
+                _skip_errors: bool = True, **kwargs: Any):
         from .groupbys import GroupedTable
 
         grouping = [self._sub(a) for a in args]
@@ -332,6 +333,7 @@ class Table(TableLike):
             grouping,
             instance=self._sub(instance) if instance is not None else None,
             by_id=by_id,
+            skip_errors=_skip_errors,
         )
 
     def reduce(self, *args: Any, **kwargs: Any) -> "Table":

@@ -53,7 +53,9 @@ def test_unwrap_refuses_error():
 def test_error_in_reduced_column_makes_group_error():
     t = T("g | v\na | 1\na | 0\nb | 2")
     s = t.select(g=pw.this.g, inv=10 // pw.this.v)
-    r = s.groupby(pw.this.g).reduce(
+    # _skip_errors=False: propagate (the engine reduce.rs error_count
+    # contract); the reference groupby DEFAULT skips error cells
+    r = s.groupby(pw.this.g, _skip_errors=False).reduce(
         pw.this.g,
         s=pw.reducers.sum(pw.this.inv),
         c=pw.reducers.count(),
@@ -74,7 +76,7 @@ def test_error_retraction_recovers_group():
         """
     )
     s = t.select(g=pw.this.g, inv=10 // pw.this.v)
-    r = s.groupby(pw.this.g).reduce(
+    r = s.groupby(pw.this.g, _skip_errors=False).reduce(
         pw.this.g, s=pw.reducers.sum(pw.this.inv)
     )
     rec = r.select(pw.this.g, s=pw.fill_error(pw.this.s, -999))
@@ -95,7 +97,7 @@ def test_error_group_key_skips_row_and_logs():
 def test_error_in_min_max_reducers():
     t = T("g | v\na | 4\na | 0\nb | 3")
     s = t.select(g=pw.this.g, inv=12 // pw.this.v)
-    r = s.groupby(pw.this.g).reduce(
+    r = s.groupby(pw.this.g, _skip_errors=False).reduce(
         pw.this.g,
         lo=pw.fill_error(pw.reducers.min(pw.this.inv), -1),
         hi=pw.fill_error(pw.reducers.max(pw.this.inv), -1),
@@ -184,7 +186,7 @@ def test_stuck_error_group_does_not_spam_log():
     )
     before = ERROR_LOG.total
     s = t.select(g=pw.this.g, inv=10 // pw.this.v)
-    r = s.groupby(pw.this.g).reduce(pw.this.g, s=pw.reducers.sum(pw.this.inv))
+    r = s.groupby(pw.this.g, _skip_errors=False).reduce(pw.this.g, s=pw.reducers.sum(pw.this.inv))
     rec = r.select(pw.this.g, s=pw.fill_error(pw.this.s, -999))
     assert rows(rec) == [("a", -999)]
     # one zero-division row error (possibly re-derived once per batch

@@ -238,3 +238,61 @@ def test_global_error_log_clear_scopes_runs():
     r2 = t2.select(x=pw.this.a // pw.this.b)
     rows2, msgs2 = _run_with_log(r2)
     assert rows2 == [(2,)] and msgs2 == []
+
+
+def test_groupby_skip_errors():
+    # reference test_errors.py:794 — the groupby DEFAULT skips error cells
+    @pw.reducers.stateful_single
+    def stateful_sum(state, val):
+        if state is None:
+            return val
+        return state + val
+
+    t = T(
+        """
+        a | b |  c  | d | e
+        1 | 1 | 1.5 | 1 | 1
+        1 | 2 | 2.5 | 0 | 1
+        1 | 3 | 3.5 | 1 | 0
+        2 | 4 | 4.5 | 1 | 1
+        2 | 5 | 5.5 | 1 | 0
+        """
+    ).with_columns(b=pw.this.b // pw.this.d, c=pw.this.c / pw.this.e)
+    res = t.groupby(pw.this.a, _skip_errors=True).reduce(
+        pw.this.a,
+        i_sum=pw.reducers.sum(pw.this.b),
+        i_min=pw.reducers.min(pw.this.b),
+        f_sum=pw.reducers.sum(pw.this.c),
+        cnt=pw.reducers.count(),
+        st_sum=stateful_sum(pw.this.b),
+    )
+    rec = res.select(
+        pw.this.a, pw.this.i_sum, pw.this.i_min, pw.this.f_sum,
+        pw.this.cnt, pw.this.st_sum,
+    )
+    rows, _ = _run_with_log(rec)
+    assert rows == [(1, 4, 1, 4.0, 3, 4), (2, 9, 4, 4.5, 2, 9)]
+
+
+def test_groupby_propagate_errors():
+    # reference test_errors.py:840 — _skip_errors=False: aggregates of a
+    # group holding an error read Error (fill_error recovers them)
+    t = T(
+        """
+        a | b |  c  | d | e
+        1 | 1 | 1.5 | 1 | 1
+        1 | 2 | 2.5 | 0 | 1
+        1 | 3 | 3.5 | 1 | 0
+        2 | 4 | 4.5 | 1 | 1
+        2 | 5 | 5.5 | 1 | 0
+        """
+    ).with_columns(b=pw.this.b // pw.this.d, c=pw.this.c / pw.this.e)
+    res = t.groupby(pw.this.a, _skip_errors=False).reduce(
+        pw.this.a,
+        i_sum=pw.fill_error(pw.reducers.sum(pw.this.b), -1),
+        i_min=pw.fill_error(pw.reducers.min(pw.this.b), -1),
+        f_sum=pw.fill_error(pw.reducers.sum(pw.this.c), -1),
+        cnt=pw.reducers.count(),
+    )
+    rows, _ = _run_with_log(res)
+    assert rows == [(1, -1, -1, -1, 3), (2, 9, 4, -1, 2)]
