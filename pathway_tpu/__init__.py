@@ -30,7 +30,7 @@ from .internals.expression import (
     unwrap,
 )
 from .internals.json import Json
-from .internals.error_log_table import global_error_log
+from .internals.error_log_table import global_error_log, local_error_log
 from .internals.py_object_wrapper import PyObjectWrapper
 from .internals.parse_graph import G, Universe
 from .internals.run import MonitoringLevel, request_stop, run, run_all
@@ -147,6 +147,7 @@ __all__ = [
     "demo",
     "fill_error",
     "global_error_log",
+    "local_error_log",
     "PyObjectWrapper",
     "graphs",
     "groupby",

@@ -31,13 +31,19 @@ class _ErrorLog:
         with self._lock:
             self.total += 1
             if len(self._entries) < self._max_kept:
-                self._entries.append((message, context))
+                self._entries.append((message, context, CURRENT_SCOPE))
             if self.total <= self._max_logged:
                 logger.warning("row error in %s: %s", context, message)
             elif self.total == self._max_logged + 1:
                 logger.warning("further row errors suppressed (see error log)")
 
     def entries(self) -> list[tuple[str, str]]:
+        with self._lock:
+            return [(m, c) for m, c, _ in self._entries]
+
+    def entries_full(self) -> list[tuple[str, str, int | None]]:
+        """(message, context, scope) — scope is the local_error_log scope
+        active when the error was recorded (None = no local scope)."""
         with self._lock:
             return list(self._entries)
 
@@ -50,6 +56,11 @@ class _ErrorLog:
 
 
 ERROR_LOG = _ErrorLog()
+
+#: runtime local-error-log scope: set by the executor around each node's
+#: processing to the scope the node's table was BUILT under
+#: (``pw.local_error_log()``); errors recorded meanwhile carry it
+CURRENT_SCOPE: int | None = None
 
 #: count of Error values alive in this process — the cheap "may any Error
 #: value exist?" gate used by the engine's error-aware fast paths. Counting

@@ -94,6 +94,9 @@ class Node:
         self.node_id = next(Node._ids)
         self.inputs = list(inputs)
         self.column_names = list(column_names)
+        #: pw.local_error_log() scope of the table this node was lowered
+        #: from (set by graph_runner.lower; None = no local scope)
+        self.error_scope: int | None = None
 
     def has_state(self) -> bool:
         return bool(self.STATE_FIELDS)
@@ -647,7 +650,18 @@ class Executor:
                         self.stats.output_rows += sum(
                             len(d) for d in ins if d is not None
                         )
-                    out = node.process(time, ins)
+                    if node.error_scope is not None:
+                        # errors raised during this node's processing carry
+                        # its table's local_error_log scope
+                        from . import error as _err
+
+                        _err.CURRENT_SCOPE = node.error_scope
+                        try:
+                            out = node.process(time, ins)
+                        finally:
+                            _err.CURRENT_SCOPE = None
+                    else:
+                        out = node.process(time, ins)
                     if out is not None and len(out):
                         out_parts.append(out)
             if self.persistence is not None and node.has_state() and (

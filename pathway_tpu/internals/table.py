@@ -46,6 +46,11 @@ class Table(TableLike):
         self._schema = schema
         self._universe = universe
         self._table_seq = next(Table._id_seq)
+        from .error_log_table import current_build_scope
+
+        #: pw.local_error_log() scope active when this table was built —
+        #: its nodes' runtime row errors carry the scope
+        self._error_scope = current_build_scope()
 
     # -- schema surface -----------------------------------------------------
 

@@ -296,3 +296,39 @@ def test_groupby_propagate_errors():
     )
     rows, _ = _run_with_log(res)
     assert rows == [(1, -1, -1, -1, 3), (2, 9, 4, -1, 2)]
+
+
+def test_local_logs():
+    # reference test_errors.py:262 — errors route to the local log whose
+    # scope BUILT the failing expression, and to the global log
+    t1 = T(
+        """
+        a | b | c
+        3 | 3 | 9
+        4 | 0 | 2
+        5 | 5 | 0
+        6 | 2 | 3
+        """
+    )
+    with pw.local_error_log() as error_log_1:
+        t2 = t1.select(x=pw.this.a // pw.this.b)
+    with pw.local_error_log() as error_log_2:
+        t3 = t1.select(y=pw.this.a // pw.this.c)
+
+    t4 = t1.select(
+        pw.this.a,
+        x=pw.fill_error(t2.x, -1),
+        y=pw.fill_error(t3.y, -1),
+    )
+    g = pw.global_error_log().select(pw.this.message)
+    l1 = error_log_1.select(pw.this.message)
+    l2 = error_log_2.select(pw.this.message)
+    caps = GraphRunner().run_tables(t4, g, l1, l2)
+    rows = sorted(tuple(r) for _, r in caps[0].state.iter_items())
+    assert rows == [(3, 1, 0), (4, -1, 2), (5, 1, -1), (6, 3, 2)]
+    gmsgs = sorted(r[0] for _, r in caps[1].state.iter_items())
+    l1msgs = [r[0] for _, r in caps[2].state.iter_items()]
+    l2msgs = [r[0] for _, r in caps[3].state.iter_items()]
+    assert gmsgs == ["division by zero", "division by zero"]
+    assert l1msgs == ["division by zero"]  # t2's b==0 row
+    assert l2msgs == ["division by zero"]  # t3's c==0 row
