@@ -29,9 +29,10 @@ def generate_custom_stream(
 
 
 def range_stream(nb_rows: int = 30, offset: int = 0, **kwargs) -> Table:
-    schema = schema_from_types(value=int)
+    # reference demo/__init__.py range_stream: FLOAT values
+    schema = schema_from_types(value=float)
     return generate_custom_stream(
-        {"value": lambda i: i + offset}, schema=schema, nb_rows=nb_rows
+        {"value": lambda i: float(i + offset)}, schema=schema, nb_rows=nb_rows
     )
 
 

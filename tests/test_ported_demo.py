@@ -1,0 +1,83 @@
+"""Ported from `/root/reference/python/pathway/tests/test_demo.py`:
+pw.demo stream generators + csv replay."""
+
+from __future__ import annotations
+
+import pathlib
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T, assert_table_equality_wo_index
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    G.clear()
+    yield
+    G.clear()
+
+
+def test_generate_custom_stream():
+    # reference test_demo.py:11
+    value_functions = {
+        "number": lambda x: x + 1,
+        "name": lambda x: f"Person_{x}",
+        "age": lambda x: 20 + x,
+    }
+
+    class InputSchema(pw.Schema):
+        number: int
+        name: str
+        age: int
+
+    table = pw.demo.generate_custom_stream(
+        value_functions, schema=InputSchema, nb_rows=5, input_rate=1000
+    )
+    expected = T(
+        """
+        number | name | age
+        1 | Person_0 | 20
+        2 | Person_1 | 21
+        3 | Person_2 | 22
+        4 | Person_3 | 23
+        5 | Person_4 | 24
+        """
+    )
+    assert_table_equality_wo_index(table, expected)
+
+
+@pytest.mark.parametrize("offset", [0, 10, -10])
+def test_generate_range_stream(offset):
+    # reference test_demo.py:39/:55/:71
+    table = pw.demo.range_stream(nb_rows=5, offset=offset, input_rate=1000)
+    expected = T(
+        "value\n" + "\n".join(str(float(i + offset)) for i in range(5))
+    )
+    expected = expected.select(value=pw.cast(float, pw.this.value))
+    assert_table_equality_wo_index(table, expected)
+
+
+def test_generate_noisy_linear_stream():
+    # reference test_demo.py:87
+    table = pw.demo.noisy_linear_stream(nb_rows=5, input_rate=1000)
+    expected = T("x\n0.0\n1.0\n2.0\n3.0\n4.0")
+    expected = expected.select(x=pw.cast(float, pw.this.x))
+    assert_table_equality_wo_index(table.select(pw.this.x), expected)
+
+
+def test_demo_replay(tmp_path: pathlib.Path):
+    # reference test_demo.py:105
+    data = "number\n1\n2\n3\n4\n5\n"
+    input_path = tmp_path / "in.csv"
+    input_path.write_text(data)
+
+    class InputSchema(pw.Schema):
+        number: int
+
+    table = pw.demo.replay_csv(
+        str(input_path), schema=InputSchema, input_rate=1000
+    )
+    expected = T("number\n1\n2\n3\n4\n5")
+    assert_table_equality_wo_index(table, expected)
