@@ -75,7 +75,11 @@ def _instantiate(obj: Any, defined: dict[str, Any]) -> Any:
         return {k: _instantiate(v, defined) for k, v in obj.items()}
     if isinstance(obj, list):
         return [_instantiate(v, defined) for v in obj]
-    if isinstance(obj, str) and obj.startswith("$") and obj[1:] in defined:
+    if isinstance(obj, str) and obj.startswith("$") and len(obj) > 1:
+        # $name references a defined entry; an unknown name is an error
+        # (reference test_yaml.py:96), never a silent literal
+        if obj[1:] not in defined:
+            raise KeyError(f"undefined yaml variable {obj!r}")
         return defined[obj[1:]]
     return obj
 
@@ -87,6 +91,14 @@ def load_yaml(stream: str | IO) -> Any:
     if not isinstance(raw, dict):
         return _instantiate(raw, {})
     defined: dict[str, Any] = {}
+    out: dict[str, Any] = {}
     for key, value in raw.items():
-        defined[key] = _instantiate(value, defined)
-    return defined
+        if isinstance(key, str) and key.startswith("$"):
+            # ``$name:`` defines a variable — referenced as ``$name``,
+            # excluded from the result (reference test_yaml.py:58)
+            defined[key[1:]] = _instantiate(value, defined)
+        else:
+            v = _instantiate(value, defined)
+            defined[key] = v
+            out[key] = v
+    return out

@@ -1,0 +1,123 @@
+"""Ported from `/root/reference/python/pathway/tests/test_yaml.py`:
+the YAML pipeline loader — !dotted.path instantiation, $variables,
+error reporting, file input, lists."""
+
+from __future__ import annotations
+
+import pytest
+
+from pathway_tpu.internals.yaml_loader import load_yaml
+
+
+class Foo:
+    def __init__(self, a: int, b: int | None = None, c: str = "foo"):
+        self.a = a
+        self.b = b
+        self.c = c
+
+    def __eq__(self, other):
+        return self.__dict__ == other.__dict__
+
+
+class Bar:
+    def __init__(self, d):
+        self.d = d
+
+    def __eq__(self, other):
+        return self.__dict__ == other.__dict__
+
+
+def baz(a, b, c):
+    return Foo(a, b, c)
+
+
+_P = "tests.test_ported_yaml"
+
+
+def test_class_initialization():
+    # reference test_yaml.py:30
+    d = load_yaml(f"""
+foo: !{_P}.Foo
+  a: 1
+  b: 2
+  c: bar
+""")
+    assert list(d.keys()) == ["foo"]
+    assert d["foo"] == Foo(1, 2, "bar")
+
+
+def test_function_call():
+    # reference test_yaml.py:44
+    d = load_yaml(f"""
+foo: !{_P}.baz
+  a: 1
+  b: 2
+  c: bar
+""")
+    assert d["foo"] == Foo(1, 2, "bar")
+
+
+def test_variables():
+    # reference test_yaml.py:58
+    d = load_yaml(f"""
+$foo: !{_P}.Foo
+  a: 1
+  c: "bar"
+
+bar: !{_P}.Bar
+  d: $foo
+""")
+    assert d["bar"] == Bar(Foo(a=1, c="bar"))
+    # a plain string that HAPPENS to name a key stays a string
+    d2 = load_yaml(f"""
+foo: !{_P}.Foo
+  a: 1
+  c: "bar"
+
+bar: !{_P}.Bar
+  d: foo
+""")
+    assert d2["bar"] == Bar("foo")
+
+
+def test_typo_in_key():
+    # reference test_yaml.py:86
+    with pytest.raises(TypeError):
+        load_yaml(f"""
+foo: !{_P}.Foo
+  d: 1
+""")
+
+
+def test_typo_in_variable():
+    # reference test_yaml.py:96
+    with pytest.raises(KeyError):
+        load_yaml(f"""
+$foo: !{_P}.Foo
+  a: 1
+  c: "bar"
+
+bar: !{_P}.Bar
+  d: $fooo
+""")
+
+
+def test_read_from_file(tmp_path):
+    # reference test_yaml.py:110
+    p = tmp_path / "cfg.yaml"
+    p.write_text(f"foo: !{_P}.Foo\n  a: 7\n")
+    with open(p) as f:
+        d = load_yaml(f)
+    assert d["foo"] == Foo(7)
+
+
+def test_list():
+    # reference test_yaml.py:128
+    d = load_yaml(f"""
+foos:
+  - !{_P}.Foo
+    a: 1
+  - !{_P}.Foo
+    a: 2
+""")
+    assert d["foos"] == [Foo(1), Foo(2)]
