@@ -1943,8 +1943,30 @@ class Deduplicate(Node):
             ikeys = np.zeros(n, dtype=np.uint64)
         names = self.column_names
         arrs = [d.data[c] for c in names]
+        inst_col = (
+            np.asarray(d.data[self._instance_col])
+            if self._instance_col is not None else None
+        )
+        watch_errors = errors_seen()
         out: tuple[list, list, list] = ([], [], [])
         for i in range(n):
+            if watch_errors:
+                # reference error contract (test_errors.py:756/:979): an
+                # Error in the instance or value column skips the row
+                if (
+                    inst_col is not None
+                    and inst_col.dtype == object
+                    and type(inst_col[i]) is EngineError
+                ):
+                    if d.diffs[i] > 0:
+                        ERROR_LOG.record(
+                            "Error value encountered in deduplicate "
+                            "instance, skipping the row",
+                            "deduplicate",
+                        )
+                    continue
+                if type(vals[i]) is EngineError:
+                    continue
             ik = int(ikeys[i])
             st = self._state.get(ik)
             new_val = vals[i]
@@ -1960,8 +1982,18 @@ class Deduplicate(Node):
                 continue
             if st is None:
                 accept = True  # first value per instance is always accepted
+            elif self._acceptor is None:
+                accept = True
             else:
-                accept = self._acceptor(new_val, st[0]) if self._acceptor is not None else True
+                try:
+                    accept = self._acceptor(new_val, st[0])
+                except Exception as e:
+                    # a raising acceptor skips the row with a log entry
+                    # (reference test_errors.py:1004)
+                    ERROR_LOG.record(
+                        f"{type(e).__name__}: {e}", "deduplicate"
+                    )
+                    continue
             if not accept:
                 continue
             row = tuple(a[i] for a in arrs)

@@ -332,3 +332,73 @@ def test_local_logs():
     assert gmsgs == ["division by zero", "division by zero"]
     assert l1msgs == ["division by zero"]  # t2's b==0 row
     assert l2msgs == ["division by zero"]  # t3's c==0 row
+
+
+def test_deduplicate_with_error_in_instance():
+    # reference test_errors.py:756
+    t1 = T(
+        """
+        a | b | __time__
+        2 | 1 |     2
+        2 | 2 |     4
+        5 | 0 |     6
+        3 | 2 |     8
+        1 | 1 |    10
+        """
+    )
+
+    def acceptor(new_value, old_value) -> bool:
+        return new_value > old_value
+
+    res = t1.deduplicate(
+        value=pw.this.a, instance=2 / pw.this.b, acceptor=acceptor
+    )
+    rows, msgs = _run_with_log(res)
+    assert sorted(r[:2] for r in rows) == [(2, 1), (3, 2)]
+    assert "division by zero" in msgs
+    assert (
+        "Error value encountered in deduplicate instance, skipping the row"
+        in msgs
+    )
+
+
+def test_deduplicate_with_error_in_value():
+    # reference test_errors.py:979 — the error row neither replaces the
+    # accepted value nor reaches the acceptor
+    t1 = T(
+        """
+        a | b | __time__
+        2 | 1 |     2
+        4 | 0 |     4
+        3 | 1 |     6
+        """
+    ).select(a=pw.this.a // pw.this.b)
+
+    def acceptor(new_value, old_value) -> bool:
+        return new_value > old_value
+
+    res = t1.deduplicate(value=pw.this.a, acceptor=acceptor)
+    rows, _ = _run_with_log(res)
+    assert rows == [(3,)]
+
+
+def test_deduplicate_with_error_in_acceptor():
+    # reference test_errors.py:1004 — a raising acceptor skips the row
+    t1 = T(
+        """
+        a | __time__
+        2 |     2
+        4 |     4
+        3 |     6
+        """
+    )
+
+    def acceptor(new_value, old_value) -> bool:
+        if new_value == 4:
+            raise ValueError("encountered 4")
+        return new_value > old_value
+
+    res = t1.deduplicate(value=pw.this.a, acceptor=acceptor)
+    rows, msgs = _run_with_log(res)
+    assert rows == [(3,)]
+    assert "ValueError: encountered 4" in msgs
