@@ -53,6 +53,105 @@ class VectorStoreServer:
         )
         self._threads: list[threading.Thread] = []
 
+    @classmethod
+    def from_langchain_components(
+        cls,
+        *docs: Table,
+        embedder: Any,
+        parser: Callable | None = None,
+        splitter: Any = None,
+        **kwargs: Any,
+    ) -> "VectorStoreServer":
+        """Build from LangChain components (reference vector_store.py:92):
+        the embedder's ``embed_documents`` backs the index, a LangChain
+        document transformer becomes the splitter. Client-gated on
+        ``langchain_core`` like the reference."""
+        try:
+            from langchain_core.documents import Document  # type: ignore[import-not-found]
+        except ImportError as e:
+            raise ImportError(
+                "Please install langchain_core: `pip install langchain_core`"
+            ) from e
+
+        generic_splitter = None
+        if splitter is not None:
+            def generic_splitter(x: str) -> list[tuple[str, dict]]:
+                return [
+                    (doc.page_content, dict(doc.metadata))
+                    for doc in splitter.transform_documents(
+                        [Document(page_content=x)]
+                    )
+                ]
+
+        def generic_embedder(x: str) -> list[float]:
+            return embedder.embed_documents([x])[0]
+
+        return cls(
+            *docs,
+            embedder=generic_embedder,
+            parser=parser,
+            splitter=generic_splitter,
+            **kwargs,
+        )
+
+    @classmethod
+    def from_llamaindex_components(
+        cls,
+        *docs: Table,
+        transformations: list[Any],
+        parser: Callable | None = None,
+        **kwargs: Any,
+    ) -> "VectorStoreServer":
+        """Build from LlamaIndex TransformComponents (reference
+        vector_store.py:135): the last transformation must be an embedding
+        component; the prefix becomes the splitter pipeline. Client-gated
+        on ``llama-index-core``."""
+        try:
+            from llama_index.core.base.embeddings.base import (  # type: ignore[import-not-found]
+                BaseEmbedding,
+            )
+            from llama_index.core.ingestion.pipeline import (  # type: ignore[import-not-found]
+                run_transformations,
+            )
+            from llama_index.core.schema import (  # type: ignore[import-not-found]
+                MetadataMode,
+                TextNode,
+            )
+        except ImportError as e:
+            raise ImportError(
+                "Please install llama-index-core: "
+                "`pip install llama-index-core`"
+            ) from e
+        if not transformations:
+            raise ValueError("Transformations list cannot be None or empty.")
+        if not isinstance(transformations[-1], BaseEmbedding):
+            raise ValueError(
+                "The last transformation must be a LlamaIndex BaseEmbedding"
+            )
+        embedding = transformations[-1]
+        prefix = list(transformations[:-1])
+
+        def generic_splitter(x: str) -> list[tuple[str, dict]]:
+            nodes = run_transformations([TextNode(text=x)], prefix)
+            return [
+                (
+                    node.get_content(metadata_mode=MetadataMode.NONE),
+                    dict(node.extra_info or {}),
+                )
+                for node in nodes
+            ]
+
+        def generic_embedder(x: str) -> list[float]:
+            return embedding.get_text_embedding(x)
+
+        return cls(
+            *docs,
+            embedder=generic_embedder,
+            parser=parser,
+            splitter=generic_splitter if prefix else None,
+            **kwargs,
+        )
+
     @staticmethod
     def _embed_fn(embedder: Any) -> Callable:
         for attr in ("func", "__wrapped__"):

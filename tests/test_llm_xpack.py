@@ -307,3 +307,17 @@ def test_brute_force_bulk_add_matches_per_row():
     [fa] = a.search([q[0]], [3], ["globmatch('9.txt', path)"])
     [fb] = b.search([q[0]], [3], ["globmatch('9.txt', path)"])
     assert [k for k, _ in fa] == [k for k, _ in fb] == [9]
+
+
+def test_vector_store_adapter_constructors_gated():
+    # reference vector_store.py:92/:135 — LangChain / LlamaIndex adapter
+    # constructors exist and gate on their client libraries
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+    t = pw.debug.table_from_markdown("data\nhello")
+    with pytest.raises(ImportError, match="langchain_core"):
+        VectorStoreServer.from_langchain_components(t, embedder=object())
+    with pytest.raises(ImportError, match="llama-index-core"):
+        VectorStoreServer.from_llamaindex_components(
+            t, transformations=[object()]
+        )
