@@ -24,6 +24,8 @@ __all__ = [
     "table_from_rows",
     "table_from_pandas",
     "table_to_pandas",
+    "table_from_parquet",
+    "table_to_parquet",
     "table_from_dicts",
     "compute_and_print",
     "compute_and_print_update_stream",
@@ -361,3 +363,22 @@ def _format_snapshot(names: list[str], rows: dict[int, tuple], frontier: int) ->
         for key, row in sorted(rows.items())
     ]
     return _render_table(header, lines) + f"\n[frontier {frontier}]"
+
+
+def table_from_parquet(path, id_from=None, unsafe_trusted_ids=False):
+    """Static table from a parquet file (reference debug/__init__.py
+    table_from_parquet — pandas/pyarrow round-trip)."""
+    import pandas as pd
+
+    df = pd.read_parquet(path)
+    return table_from_pandas(
+        df, id_from=id_from, unsafe_trusted_ids=unsafe_trusted_ids
+    )
+
+
+def table_to_parquet(table, path):
+    """Write a (finite) table to a parquet file (reference
+    table_to_parquet)."""
+    df = table_to_pandas(table, include_id=False)
+    df = df.reset_index(drop=True)
+    return df.to_parquet(path)

@@ -1,0 +1,37 @@
+"""Ported from `/root/reference/python/pathway/tests/test_parquet.py`."""
+
+from __future__ import annotations
+
+import pandas as pd
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T, assert_table_equality_wo_index
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    G.clear()
+    yield
+    G.clear()
+
+
+def test_write_parquet(tmp_path):
+    # reference test_parquet.py:9
+    path = tmp_path / "t.parquet"
+    tab = T("a | b\n2 | 3\n5 | 6")
+    pw.debug.table_to_parquet(tab, path)
+    df = pd.read_parquet(path)
+    t2 = pw.debug.table_from_pandas(df, id_from=None, unsafe_trusted_ids=False)
+    assert_table_equality_wo_index(t2, tab)
+
+
+def test_read_parquet(tmp_path):
+    # reference test_parquet.py:29
+    path = tmp_path / "t.parquet"
+    tab = T("a | b\n2 | 3\n5 | 6")
+    df = pw.debug.table_to_pandas(tab, include_id=False).reset_index(drop=True)
+    df.to_parquet(path)
+    t2 = pw.debug.table_from_parquet(path, id_from=None, unsafe_trusted_ids=False)
+    assert_table_equality_wo_index(t2, tab)
