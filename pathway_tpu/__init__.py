@@ -39,6 +39,7 @@ from .internals.config import PathwayConfig, get_pathway_config
 from .internals.yaml_loader import load_yaml
 from .internals.schema import (
     Schema,
+    SchemaProperties,
     assert_table_has_schema,
     column_definition,
     schema_builder,
@@ -129,6 +130,7 @@ __all__ = [
     "MonitoringLevel",
     "Pointer",
     "Schema",
+    "SchemaProperties",
     "Table",
     "TableLike",
     "Type",

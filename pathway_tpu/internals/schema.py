@@ -15,6 +15,7 @@ from . import dtype as dt
 
 __all__ = [
     "Schema",
+    "SchemaProperties",
     "ColumnDefinition",
     "column_definition",
     "schema_from_types",
@@ -31,6 +32,17 @@ class ColumnSchema:
     primary_key: bool = False
     default_value: Any = None
     has_default: bool = False
+    #: reference column property: the column never retracts (connector
+    #: hint + optimization flag; carried as metadata here)
+    append_only: bool = False
+
+
+@dataclass(frozen=True)
+class SchemaProperties:
+    """Schema-wide properties (reference internals/schema.py
+    SchemaProperties): ``append_only`` marks every column append-only."""
+
+    append_only: bool = False
 
 
 @dataclass
@@ -40,6 +52,7 @@ class ColumnDefinition:
     dtype: Any = None
     name: str | None = None
     _has_default: bool = False
+    append_only: bool | None = None
 
 
 _NO_DEFAULT = object()
@@ -51,6 +64,7 @@ def column_definition(
     default_value: Any = _NO_DEFAULT,
     dtype: Any = None,
     name: str | None = None,
+    append_only: bool | None = None,
 ) -> Any:
     return ColumnDefinition(
         primary_key=primary_key,
@@ -58,14 +72,26 @@ def column_definition(
         dtype=dtype,
         name=name,
         _has_default=default_value is not _NO_DEFAULT,
+        append_only=append_only,
     )
 
 
 class SchemaMetaclass(type):
     __columns__: dict[str, ColumnSchema]
 
+    def __new__(mcls, name, bases, namespace, **kwargs):
+        # class keywords (append_only=...) are schema properties, not
+        # __init_subclass__ arguments
+        return super().__new__(mcls, name, bases, namespace)
+
     def __init__(cls, name, bases, namespace, **kwargs):
         super().__init__(name, bases, namespace)
+        # ``class S(pw.Schema, append_only=True)`` (reference schema
+        # class-keyword properties)
+        schema_ao = bool(kwargs.get("append_only", False)) or any(
+            getattr(base, "__append_only__", False) for base in bases
+        )
+        cls.__append_only__ = schema_ao
         columns: dict[str, ColumnSchema] = {}
         for base in reversed(bases):
             columns.update(getattr(base, "__columns__", {}))
@@ -86,9 +112,17 @@ class SchemaMetaclass(type):
                     primary_key=definition.primary_key,
                     default_value=definition.default_value,
                     has_default=definition._has_default,
+                    append_only=(
+                        schema_ao
+                        if definition.append_only is None
+                        else definition.append_only
+                    ),
                 )
             else:
-                columns[col_name] = ColumnSchema(name=col_name, dtype=dt.wrap(resolved))
+                columns[col_name] = ColumnSchema(
+                    name=col_name, dtype=dt.wrap(resolved),
+                    append_only=schema_ao,
+                )
         cls.__columns__ = columns
 
     def column_names(cls) -> list[str]:
@@ -96,6 +130,15 @@ class SchemaMetaclass(type):
 
     def columns(cls) -> dict[str, ColumnSchema]:
         return dict(cls.__columns__)
+
+    def properties(cls) -> "SchemaProperties":
+        return SchemaProperties(
+            append_only=bool(getattr(cls, "__append_only__", False))
+            or (
+                bool(cls.__columns__)
+                and all(c.append_only for c in cls.__columns__.values())
+            )
+        )
 
     def primary_key_columns(cls) -> list[str] | None:
         pks = [c.name for c in cls.__columns__.values() if c.primary_key]
@@ -173,19 +216,31 @@ def schema_from_dict(
 def schema_builder(
     columns: dict[str, Any], *, name: str = "Schema", properties: Any = None
 ) -> SchemaMetaclass:
+    schema_ao = bool(getattr(properties, "append_only", False))
     cols: dict[str, ColumnSchema] = {}
     for col, definition in columns.items():
         if isinstance(definition, ColumnDefinition):
-            cols[col] = ColumnSchema(
+            # column_definition(name=...) renames the column (reference
+            # schema_builder/class parity)
+            cols[definition.name or col] = ColumnSchema(
                 name=definition.name or col,
                 dtype=dt.wrap(definition.dtype) if definition.dtype is not None else dt.ANY,
                 primary_key=definition.primary_key,
                 default_value=definition.default_value,
                 has_default=definition._has_default,
+                append_only=(
+                    schema_ao
+                    if definition.append_only is None
+                    else definition.append_only
+                ),
             )
         else:
-            cols[col] = ColumnSchema(name=col, dtype=dt.wrap(definition))
-    return schema_from_columns(cols, name=name)
+            cols[col] = ColumnSchema(
+                name=col, dtype=dt.wrap(definition), append_only=schema_ao
+            )
+    out = schema_from_columns(cols, name=name)
+    out.__append_only__ = schema_ao
+    return out
 
 
 def assert_table_has_schema(

@@ -1,0 +1,94 @@
+"""Ported from `/root/reference/python/pathway/tests/test_schema.py`:
+schema_builder/class parity, equality semantics, properties."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pytest
+
+import pathway_tpu as pw
+
+
+def _same_schema(a, b):
+    assert a.column_names() == b.column_names()
+    for n in a.column_names():
+        ca, cb = a.columns()[n], b.columns()[n]
+        assert ca.dtype == cb.dtype, n
+        assert ca.primary_key == cb.primary_key, n
+        assert ca.default_value == cb.default_value, n
+    assert a.properties() == b.properties()
+
+
+def test_schema_builder():
+    # reference test_schema.py:57
+    schema = pw.schema_builder(
+        columns={
+            "a": pw.column_definition(dtype=int, name="aa"),
+            "b": pw.column_definition(dtype=str, default_value="default"),
+            "c": pw.column_definition(),
+        },
+        name="FooSchema",
+        properties=pw.SchemaProperties(append_only=True),
+    )
+
+    class FooSchema(pw.Schema, append_only=True):
+        a: int = pw.column_definition(dtype=int, name="aa")
+        b: str = pw.column_definition(dtype=str, default_value="default")
+        c: Any
+
+    _same_schema(schema, FooSchema)
+
+
+def test_schema_properties():
+    # reference test_schema.py:312 (append_only resolution)
+    class AO(pw.Schema, append_only=True):
+        a: int
+
+    class Plain(pw.Schema):
+        a: int
+
+    class Mixed(pw.Schema):
+        a: int = pw.column_definition(append_only=True)
+        b: str
+
+    assert AO.properties().append_only
+    assert AO.columns()["a"].append_only
+    assert not Plain.properties().append_only
+    assert Mixed.columns()["a"].append_only
+    assert not Mixed.columns()["b"].append_only
+    assert not Mixed.properties().append_only
+
+
+def test_schema_column_order_and_rename():
+    class S(pw.Schema):
+        x: int = pw.column_definition(name="renamed")
+        y: str
+
+    assert S.column_names() == ["renamed", "y"]
+    b = pw.schema_builder(
+        columns={"x": pw.column_definition(dtype=int, name="renamed"),
+                 "y": pw.column_definition(dtype=str)}
+    )
+    assert b.column_names() == ["renamed", "y"]
+
+
+def test_schema_from_dict_and_primary_keys():
+    # reference test_schema.py:163 (from dict incl. per-column options)
+    s = pw.schema_from_dict({
+        "k": {"dtype": int, "primary_key": True},
+        "v": str,
+    })
+    assert s.primary_key_columns() == ["k"]
+    assert s.columns()["v"].dtype == pw.internals.dtype.STR
+
+
+def test_append_only_inherited():
+    class Base(pw.Schema, append_only=True):
+        a: int
+
+    class Child(Base):
+        b: str
+
+    assert Child.properties().append_only
+    assert Child.columns()["b"].append_only
