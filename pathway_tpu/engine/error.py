@@ -31,7 +31,7 @@ class _ErrorLog:
         with self._lock:
             self.total += 1
             if len(self._entries) < self._max_kept:
-                self._entries.append((message, context, CURRENT_SCOPE))
+                self._entries.append((message, context, get_current_scope()))
             if self.total <= self._max_logged:
                 logger.warning("row error in %s: %s", context, message)
             elif self.total == self._max_logged + 1:
@@ -57,10 +57,20 @@ class _ErrorLog:
 
 ERROR_LOG = _ErrorLog()
 
-#: runtime local-error-log scope: set by the executor around each node's
-#: processing to the scope the node's table was BUILT under
-#: (``pw.local_error_log()``); errors recorded meanwhile carry it
-CURRENT_SCOPE: int | None = None
+#: runtime local-error-log scope, THREAD-LOCAL: set by the executor
+#: around each node's processing to the scope the node's table was BUILT
+#: under (``pw.local_error_log()``). Thread-local because sharded runs
+#: execute one worker per thread — a process-global would let worker A's
+#: scope misattribute worker B's errors (review finding).
+_scope_local = threading.local()
+
+
+def get_current_scope() -> int | None:
+    return getattr(_scope_local, "scope", None)
+
+
+def set_current_scope(scope: int | None) -> None:
+    _scope_local.scope = scope
 
 #: count of Error values alive in this process — the cheap "may any Error
 #: value exist?" gate used by the engine's error-aware fast paths. Counting

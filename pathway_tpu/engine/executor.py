@@ -346,7 +346,7 @@ class Executor:
         # costs real throughput on unique-key streams (see keys.py)
         stateless = not any(n.has_state() for n in self.nodes)
         if stateless:
-            K._registration_suspended += 1
+            K._suspend_registration(+1)  # thread-local: this executor only
         try:
             if self.tracer is not None:
                 try:
@@ -369,7 +369,7 @@ class Executor:
                 self._run_inner()
         finally:
             if stateless:
-                K._registration_suspended -= 1
+                K._suspend_registration(-1)
 
     def _run_inner(self) -> None:
         realtime = [n for n in self.nodes if isinstance(n, RealtimeSource)]
@@ -652,14 +652,15 @@ class Executor:
                         )
                     if node.error_scope is not None:
                         # errors raised during this node's processing carry
-                        # its table's local_error_log scope
-                        from . import error as _err
+                        # its table's local_error_log scope (thread-local:
+                        # one worker per thread under sharding)
+                        from .error import set_current_scope
 
-                        _err.CURRENT_SCOPE = node.error_scope
+                        set_current_scope(node.error_scope)
                         try:
                             out = node.process(time, ins)
                         finally:
-                            _err.CURRENT_SCOPE = None
+                            set_current_scope(None)
                     else:
                         out = node.process(time, ins)
                     if out is not None and len(out):

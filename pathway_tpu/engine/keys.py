@@ -326,13 +326,26 @@ class KeyCollisionError(RuntimeError):
 
 _REGISTRY = None
 _REGISTRY_WARNED = False
-#: >0 while every live executor's dataflow is stateless (no keyed
-#: operator state — nothing two conflated keys could corrupt): key
-#: creation skips the registry probe, which costs ~150ns/row of random
-#: DRAM access on unique-key streams. Executors with ANY stateful node
-#: never suspend, so the 128-bit guarantee holds exactly where key
-#: identity is load-bearing. Managed by engine/executor.py.
-_registration_suspended = 0
+#: THREAD-LOCAL suspension: while the executor running on THIS thread has
+#: a stateless dataflow (no keyed operator state — nothing two conflated
+#: keys could corrupt), key creation skips the registry probe, which
+#: costs ~150ns/row of random DRAM access on unique-key streams. Thread-
+#: local (not process-global) so a concurrent STATEFUL run on another
+#: thread — e.g. a threaded REST server's pipeline — keeps the full
+#: 128-bit fail-stop guarantee (review finding). Managed by
+#: engine/executor.py; key creation happens on the executor's own thread
+#: (source polls, ticks), so the thread is the right scope.
+import threading as _threading
+
+_suspend_local = _threading.local()
+
+
+def _registration_suspended_here() -> bool:
+    return getattr(_suspend_local, "n", 0) > 0
+
+
+def _suspend_registration(delta: int) -> None:
+    _suspend_local.n = getattr(_suspend_local, "n", 0) + delta
 
 
 class _PyKeyRegistry:
@@ -414,7 +427,7 @@ def mix_columns(
     (consolidation row sigs) pass ``register=False`` and pay one lane.
     """
     acc = np.full(n, np.uint64(0xA076_1D64_78BD_642F) ^ np.uint64(salt), dtype=np.uint64)
-    if register and _registration_suspended:
+    if register and _registration_suspended_here():
         register = False
     if register:
         acc_hi = np.full(
@@ -457,7 +470,7 @@ def hash_values(
     rows = rows if isinstance(rows, list) else list(rows)
     native = get_native()  # memoized; O(1) after first call
     salt64 = int(salt) & 0xFFFFFFFFFFFFFFFF
-    if register and _registration_suspended:
+    if register and _registration_suspended_here():
         register = False
     if not register:
         if native is None:

@@ -72,7 +72,8 @@ class ConnectorSubject:
 
     # -- emission API (reference io/python: next_json / next_str / next) --
 
-    def _emit(self, entry: tuple) -> None:
+    def _emit(self, entry: "tuple | dict") -> None:
+        # entry: bare kwargs dict (diff=+1 row) or (diff, fields, key) tuple
         # size-triggered flush only: the per-row path must stay lean, so
         # time-based flushing of a lingering buffer is the engine side's
         # job (_flush_stale, called from every poll)
@@ -101,15 +102,9 @@ class ConnectorSubject:
 
     def next(self, **kwargs: Any) -> None:
         # hot path: a bare kwargs dict means (diff=+1, no explicit key) —
-        # no wrapper tuple, no extra call; retractions/keyed rows go
-        # through _emit with the (diff, fields, key) tuple form
-        with self._buf_lock:
-            buf = self._buf
-            buf.append(kwargs)
-            if len(buf) >= self._CHUNK:
-                self._queue.put(buf)
-                self._buf = []
-                self._buf_flushed_at = _time.monotonic()
+        # no wrapper tuple; retractions/keyed rows use the
+        # (diff, fields, key) tuple entry form via the same _emit
+        self._emit(kwargs)
 
     def next_batch(self, data: dict[str, Any], diffs: Any = None) -> None:
         """Columnar fast lane: emit many rows at once as column lists/arrays

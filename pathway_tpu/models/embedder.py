@@ -306,13 +306,32 @@ class Embedder:
                 )
             toks = tokenize_batch(texts, self.cfg.vocab_size, max_len)
         toks = np.asarray(toks, dtype=np.int32)
-        longest = int((toks > 0).any(axis=0).nonzero()[0][-1]) + 1 if toks.size and (toks > 0).any() else 1
-        bucket = 16
-        while bucket < longest:
-            bucket *= 2
-        if bucket < toks.shape[1]:
-            toks = toks[:, :bucket]
-        return self._fwd(self.params, jnp.asarray(toks))
+        n, width = toks.shape
+        if n == 0:
+            return self._fwd(self.params, jnp.asarray(toks))
+        # PER-TEXT buckets: each text's embedding is a pure function of
+        # (text, its own bucket) — never of the other texts in the batch
+        # (batch-derived buckets would make a re-embedded document's
+        # vector drift with batch composition and churn the maintained
+        # index; review finding). Texts group by bucket and each group
+        # runs one forward; results reassemble device-side.
+        lengths = (toks > 0).sum(axis=1)
+        buckets = np.maximum(
+            16, 2 ** np.ceil(np.log2(np.maximum(lengths, 1))).astype(np.int64)
+        )
+        buckets = np.minimum(buckets, width)
+        uniq = np.unique(buckets)
+        if len(uniq) == 1:
+            b = int(uniq[0])
+            return self._fwd(self.params, jnp.asarray(toks[:, :b]))
+        out = None
+        for b in uniq.tolist():
+            ix = np.flatnonzero(buckets == b)
+            part = self._fwd(self.params, jnp.asarray(toks[ix, :b]))
+            if out is None:
+                out = jnp.zeros((n, part.shape[1]), part.dtype)
+            out = out.at[jnp.asarray(ix)].set(part)
+        return out
 
     def embed_texts(self, texts: list[str], max_len: int = 128) -> np.ndarray:
         return np.asarray(self.embed_texts_device(texts, max_len))

@@ -202,7 +202,16 @@ class S3Backend(PersistenceBackend):
                 Bucket=self._bucket, Key=self._obj_key(key)
             )
         except Exception as e:
-            if type(e).__name__ in ("NoSuchKey", "ClientError", "KeyError"):
+            # ONLY a genuinely-missing object maps to KeyError; auth /
+            # throttling / availability ClientErrors must surface, or
+            # recovery would silently restart from scratch on an expired
+            # credential (review finding)
+            if isinstance(e, KeyError) or type(e).__name__ == "NoSuchKey":
+                raise KeyError(key) from e
+            code = (
+                getattr(e, "response", None) or {}
+            ).get("Error", {}).get("Code")
+            if code in ("NoSuchKey", "404", "NotFound"):
                 raise KeyError(key) from e
             raise
         body = resp["Body"]
