@@ -150,7 +150,16 @@ class Optional(DType):
 
 
 class Tuple(DType):
+    def __new__(cls, *args: Any):
+        # Tuple(T, ...) IS List(T) (reference dtype identity,
+        # test_dtypes.py: dt.Tuple(dt.INT, ...) is dt.List(dt.INT))
+        if len(args) == 2 and args[1] is Ellipsis:
+            return List(args[0])  # type: ignore[return-value]
+        return super().__new__(cls)
+
     def __init__(self, *args: DType):
+        if len(args) == 2 and args[1] is Ellipsis:
+            return  # __new__ returned a List; skip Tuple init
         self.args = tuple(args)
         self._name = f"Tuple({', '.join(map(repr, args))})"
 

@@ -1,0 +1,20 @@
+"""Ported from `/root/reference/python/pathway/tests/test_dtypes.py`
+(identity assertions adapted to equality — this dtype lattice does not
+intern instances; behavioral equivalence is what the engine relies on)."""
+
+from __future__ import annotations
+
+import pathway_tpu.internals.dtype as dt
+
+
+def test_identities():
+    assert dt.Optional(dt.INT) == dt.Optional(dt.INT)
+    assert dt.Tuple(dt.INT, dt.Optional(dt.POINTER)) == dt.Tuple(
+        dt.INT, dt.Optional(dt.POINTER)
+    )
+    # Tuple(T, ...) collapses to List(T)
+    assert dt.Tuple(dt.INT, ...) == dt.List(dt.INT)
+    assert isinstance(dt.Tuple(dt.INT, ...), dt.List)
+    # Optional over ANY/NONE and nested Optionals collapse
+    assert dt.Optional(dt.ANY) is dt.ANY
+    assert dt.Optional(dt.Optional(dt.INT)) == dt.Optional(dt.INT)
