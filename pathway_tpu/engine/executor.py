@@ -554,6 +554,42 @@ class Executor:
                 src.persistent_id = f"src-{i}"
         by_pid = {src.persistent_id: src for src in realtime}
 
+        replay_mode = getattr(self.persistence, "replay_mode", None)
+        if replay_mode is not None:
+            # CLI replay (pathway-tpu replay --mode batch|speedrun):
+            # ignore operator snapshots — the point is to re-run the FULL
+            # recorded input history through the (possibly changed)
+            # program; nothing re-records and sources are not seeked
+            # (reference cli replay semantics: rows generated during a
+            # replay are not captured)
+            by_time: dict[int, list[tuple[SourceNode, Delta]]] = {}
+            for t, pid, delta in self.persistence.replay_batches(after_time=-1):
+                src = by_pid.get(pid)
+                if src is None or list(delta.columns) != list(src.column_names):
+                    raise RuntimeError(
+                        f"recorded input for source {pid!r} does not match "
+                        "this program (changed sources? give stable name= ids)"
+                    )
+                by_time.setdefault(int(t), []).append((src, delta))
+                src.observe_replay(delta)
+            times = sorted(by_time)
+            clock = 0
+            if replay_mode == "batch" and times:
+                # one tick carries the whole history
+                t_last = times[-1]
+                merged: list[tuple[SourceNode, Delta]] = []
+                for t in times:
+                    merged.extend(by_time[t])
+                self._tick(t_last, merged)
+                clock = t_last
+            else:  # speedrun: recorded tick boundaries preserved
+                for t in times:
+                    self._tick(t, by_time[t])
+                    clock = max(clock, t)
+            if not getattr(self.persistence, "continue_after_replay", True):
+                self.request_stop()
+            return clock
+
         # pick the newest operator snapshot present on EVERY worker — a crash
         # mid-commit-wave may have left some workers one version ahead; the
         # manager retains two versions so a common one always exists

@@ -175,11 +175,15 @@ class GraphRunner:
                 for w in local_worker_ids:
                     worker_runner = GraphRunner()
                     if pcfg is not None:
-                        from ..persistence import PersistenceManager
+                        from ..persistence import (
+                            PersistenceManager,
+                            apply_replay_env,
+                        )
 
                         manager = PersistenceManager(
                             pcfg, worker_id=w, n_workers=n_workers
                         )
+                        apply_replay_env(manager, cfg)
                         worker_runner.persistence = manager
                         managers.append(manager)
                     for sink in G.sinks:
@@ -235,7 +239,13 @@ class GraphRunner:
         if kind == "subscribe":
             node = self.lower(sink["table"])
             skip_until = -1
-            if self.persistence is not None and sink.get("skip_persisted_batch", True):
+            if (
+                self.persistence is not None
+                and sink.get("skip_persisted_batch", True)
+                # CLI replay re-emits the recorded history — that is the
+                # point; skip-persisted is a RECOVERY dedup mechanism
+                and getattr(self.persistence, "replay_mode", None) is None
+            ):
                 skip_until = self.persistence.last_time
             sub = ops.Subscribe(
                 node,

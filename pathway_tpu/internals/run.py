@@ -34,6 +34,21 @@ def run(
     with _lock:
         _current["runner"] = runner
     try:
+        if persistence_config is None:
+            # the CLI's record/replay env (pathway-tpu spawn --record /
+            # replay --mode ...) must work WITHOUT program changes
+            # (reference run.py reads the replay config from env)
+            from .config import get_pathway_config
+
+            cfg = get_pathway_config()
+            if cfg.replay_storage and cfg.snapshot_access in (
+                "record", "replay"
+            ):
+                from ..persistence import Backend, Config
+
+                persistence_config = Config.simple_config(
+                    Backend.filesystem(cfg.replay_storage)
+                )
         if persistence_config is not None:
             from ..persistence import run_with_persistence
 

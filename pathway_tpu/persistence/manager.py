@@ -75,6 +75,19 @@ class PersistenceManager:
         self._first_chunk = first_chunk
         self._ops = OperatorSnapshots(self.backend)
         self._recording = False
+        #: CLI replay mode (pathway-tpu replay --mode): None = normal
+        #: persistence (record + snapshot + resume); "batch" coalesces the
+        #: whole recorded history into ONE tick, "speedrun" preserves the
+        #: recorded tick boundaries. In either replay mode operator
+        #: snapshots are ignored (full input replay), nothing re-records,
+        #: and sources are not seeked (reference cli replay semantics).
+        self.replay_mode: str | None = None
+        self.continue_after_replay = True
+        #: recording FOR REPLAY (pathway-tpu spawn --record): keep the
+        #: full input history — no operator snapshots, no chunk
+        #: truncation (crash-recovery persistence truncates input once a
+        #: snapshot covers it, which would erase the replay tape)
+        self.record_replay = False
         self._sources: list[Any] = []  # RealtimeSources with persistent ids
         self._last_flush = _time.monotonic()
         self._dirty = False
@@ -201,9 +214,11 @@ class PersistenceManager:
         self.offsets = {
             s.persistent_id: s.offset_state() for s in self._sources
         }
+        if self.record_replay:
+            with_operators = False  # the input history IS the artifact
         if with_operators:
             self._snapshot_operators(self.last_time)
-        covered = self._plan_chunk_truncation()
+        covered = [] if self.record_replay else self._plan_chunk_truncation()
         self._meta.commit({
             "last_time": self.last_time,
             "n_chunks": self._writer.n_chunks,

@@ -1,0 +1,101 @@
+"""Ported from `/root/reference/python/pathway/tests/cli/test_cli.py`:
+record/replay through the CLI — record a stream, replay it in batch
+(one timestamp) and speedrun (original timestamps) modes, verify rows
+generated during a replay are NOT captured."""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+REPLAY_PROGRAM = r'''
+import pathlib
+import sys
+
+import pathway_tpu as pw
+
+rows_to_generate = int(sys.argv[1])
+timestamp_file = pathlib.Path(sys.argv[2])
+
+
+class Subject(pw.io.python.ConnectorSubject):
+    def run(self):
+        for i in range(rows_to_generate):
+            self.next(number=2 * i + 1)
+            self.commit()
+
+
+t = pw.io.python.read(
+    Subject(), schema=pw.schema_from_types(number=int),
+    autocommit_duration_ms=None, name="gen",
+)
+times = set()
+rows = []
+
+
+def on_change(key, row, time, is_addition):
+    times.add(time)
+    rows.append(row["number"])
+
+
+pw.io.subscribe(t, on_change=on_change)
+pw.run()
+timestamp_file.write_text(f"{len(times)} {len(rows)}")
+'''
+
+
+def _run_cli(tmp_path, subcmd, extra, rows_to_generate):
+    prog = tmp_path / "prog.py"
+    prog.write_text(REPLAY_PROGRAM)
+    out = tmp_path / f"out-{len(list(tmp_path.iterdir()))}.txt"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    r = subprocess.run(
+        [sys.executable, "-m", "pathway_tpu.cli", subcmd, *extra,
+         sys.executable, str(prog), str(rows_to_generate), str(out)],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    n_times, n_rows = map(int, out.read_text().split())
+    return n_times, n_rows
+
+
+def test_record_replay_through_cli(tmp_path: pathlib.Path):
+    # reference cli/test_cli.py:63
+    rec = str(tmp_path / "recdir")
+
+    # record 8 rows (one commit each -> 8 timestamps)
+    n_times, n_rows = _run_cli(
+        tmp_path, "spawn", ["--record", "--record-path", rec], 8
+    )
+    assert n_rows == 8
+
+    # batch replay: the whole history arrives in ONE timestamp
+    b_times, b_rows = _run_cli(
+        tmp_path, "replay", ["--record-path", rec, "--mode", "batch"], 0
+    )
+    assert b_rows == 8 and b_times == 1
+
+    # speedrun replay: original tick boundaries preserved
+    s_times, s_rows = _run_cli(
+        tmp_path, "replay", ["--record-path", rec, "--mode", "speedrun"], 0
+    )
+    assert s_rows == 8 and s_times == n_times
+
+    # generating rows during a replay (with --continue) must NOT record
+    g_times, g_rows = _run_cli(
+        tmp_path, "replay",
+        ["--record-path", rec, "--mode", "speedrun", "--continue"], 5,
+    )
+    assert g_rows == 13  # 8 replayed + 5 freshly generated
+
+    # ...so a later replay still sees exactly the original 8
+    a_times, a_rows = _run_cli(
+        tmp_path, "replay", ["--record-path", rec, "--mode", "speedrun"], 0
+    )
+    assert a_rows == 8
