@@ -679,6 +679,54 @@ class Table(TableLike):
         G.promise_disjoint(self._universe, other._universe)
         return self
 
+    # -- deprecated pre-1.0 aliases (reference test_backward_compatibility) --
+
+    @staticmethod
+    def _deprecated(old: str, new: str) -> None:
+        import warnings
+
+        warnings.warn(
+            f"{old} is deprecated; use {new} instead",
+            DeprecationWarning, stacklevel=3,
+        )
+
+    def unsafe_promise_same_universe_as(self, other: "Table") -> "Table":
+        self._deprecated(
+            "unsafe_promise_same_universe_as", "with_universe_of"
+        )
+        return self.promise_universes_are_equal(other).with_universe_of(other)
+
+    def unsafe_promise_universe_is_subset_of(self, other: "Table") -> "Table":
+        self._deprecated(
+            "unsafe_promise_universe_is_subset_of",
+            "promise_universe_is_subset_of",
+        )
+        return self.promise_universe_is_subset_of(other)
+
+    def unsafe_promise_universes_are_pairwise_disjoint(
+        self, *others: "Table"
+    ) -> "Table":
+        self._deprecated(
+            "unsafe_promise_universes_are_pairwise_disjoint",
+            "promise_universes_are_disjoint",
+        )
+        out = self
+        for other in others:
+            out = out.promise_universes_are_disjoint(other)
+        return out
+
+    def left_join(self, other: "Table", *on: Any, **kwargs: Any):
+        self._deprecated("left_join", "join_left")
+        return self.join_left(other, *on, **kwargs)
+
+    def right_join(self, other: "Table", *on: Any, **kwargs: Any):
+        self._deprecated("right_join", "join_right")
+        return self.join_right(other, *on, **kwargs)
+
+    def outer_join(self, other: "Table", *on: Any, **kwargs: Any):
+        self._deprecated("outer_join", "join_outer")
+        return self.join_outer(other, *on, **kwargs)
+
     def with_universe_of(self, other: TableLike) -> "Table":
         if not self._universe.is_equal(other._universe):
             raise ValueError(

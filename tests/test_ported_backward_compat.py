@@ -1,0 +1,77 @@
+"""Ported from
+`/root/reference/python/pathway/tests/test_backward_compatibility.py`:
+deprecated pre-1.0 aliases keep working and warn."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T, assert_table_equality
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    G.clear()
+    yield
+    G.clear()
+
+
+def test_unsafe_promise_same_universe_as():
+    # reference test_backward_compatibility.py:9
+    t_latin = T("  | lower | upper\n1 | a | A\n2 | b | B\n26 | z | Z")
+    t_num = T("  | num\n1 | 1\n2 | 2\n26 | 26")
+    with pytest.deprecated_call():
+        t_num = t_num.unsafe_promise_same_universe_as(t_latin)
+    joined = t_latin.select(pw.this.lower, num=t_num.num)
+    assert_table_equality(
+        joined, T("  | lower | num\n1 | a | 1\n2 | b | 2\n26 | z | 26")
+    )
+
+
+def test_unsafe_promise_universe_is_subset_of():
+    # reference test_backward_compatibility.py:33
+    t1 = T(" | col\n1 | a\n2 | b\n3 | c")
+    t2 = T(" | col\n2 | 1\n3 | 1")
+    with pytest.deprecated_call():
+        t2 = t2.unsafe_promise_universe_is_subset_of(t1)
+    res = t1.restrict(t2)
+    assert_table_equality(res, T(" | col\n2 | b\n3 | c"))
+
+
+def test_unsafe_promise_universes_are_pairwise_disjoint():
+    # reference test_backward_compatibility.py:56
+    t1 = T(" | lower | upper\n1 | a | A\n2 | b | B")
+    t2 = T(" | lower | upper\n3 | c | C")
+    with pytest.deprecated_call():
+        t2 = t2.unsafe_promise_universes_are_pairwise_disjoint(t1)
+    res = t1.concat(t2)
+    assert_table_equality(
+        res, T(" | lower | upper\n1 | a | A\n2 | b | B\n3 | c | C")
+    )
+
+
+def test_left_right_outer_join_aliases():
+    # reference test_backward_compatibility.py:77
+    t1 = T(" | lower | upper\n1 | a | A\n2 | b | B\n3 | c | C")
+    t2 = T(" | lowerr | upperr\n3 | c | C\n4 | d | D")
+    with pytest.deprecated_call():
+        legacy = t1.left_join(t2, t1.lower == t2.lowerr).select(
+            t1.lower, t2.upperr
+        )
+    modern = t1.join_left(t2, t1.lower == t2.lowerr).select(
+        t1.lower, t2.upperr
+    )
+    from pathway_tpu.testing import assert_table_equality_wo_index
+
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    caps = GraphRunner().run_tables(legacy, modern)
+    r1 = sorted(tuple(r) for _, r in caps[0].state.iter_items())
+    r2 = sorted(tuple(r) for _, r in caps[1].state.iter_items())
+    assert r1 == r2
+    with pytest.deprecated_call():
+        t1.right_join(t2, t1.lower == t2.lowerr)
+    with pytest.deprecated_call():
+        t1.outer_join(t2, t1.lower == t2.lowerr)
