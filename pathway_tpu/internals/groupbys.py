@@ -55,9 +55,17 @@ class GroupedTable:
         table = self._table
         outputs: dict[str, ColumnExpression] = {}
         for arg in args:
+            if isinstance(arg, str):
+                raise ValueError(
+                    f"Expected a ColumnReference, found a string. Did you "
+                    f"mean this.{arg} instead of {arg!r}?"
+                )
             arg = substitute(smart_coerce(arg), {this: table})
             if not isinstance(arg, ColumnReference):
-                raise ValueError("positional reduce args must be column references")
+                raise ValueError(
+                    "In reduce() all positional arguments have to be a "
+                    "ColumnReference."
+                )
             outputs[arg.name] = arg
         for name, e in kwargs.items():
             outputs[name] = substitute(smart_coerce(e), {this: table})

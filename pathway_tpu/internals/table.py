@@ -173,6 +173,13 @@ class Table(TableLike):
             else:
                 flat.append(arg)
         for arg in flat:
+            if isinstance(arg, str):
+                # reference error_messages: a bare string is the most
+                # common slip — point at the fix
+                raise ValueError(
+                    f"Expected a ColumnReference, found a string. Did you "
+                    f"mean this.{arg} instead of {arg!r}?"
+                )
             arg = self._sub(arg)
             if not isinstance(arg, ColumnReference):
                 raise ValueError(

@@ -1,0 +1,44 @@
+"""Ported from
+`/root/reference/python/pathway/tests/test_error_messages.py` (the
+build-time arg-validation messages)."""
+
+from __future__ import annotations
+
+import re
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    G.clear()
+    yield
+    G.clear()
+
+
+def test_select_args():
+    # reference test_error_messages.py:21
+    tab = T("a\n1\n2")
+    with pytest.raises(ValueError, match=re.escape(
+        "Expected a ColumnReference, found a string. "
+        "Did you mean this.a instead of 'a'?"
+    )):
+        tab.select("a")
+
+
+def test_reduce_args():
+    # reference test_error_messages.py:37
+    tab = T("a\n1\n2")
+    with pytest.raises(ValueError, match=re.escape(
+        "Expected a ColumnReference, found a string. "
+        "Did you mean this.a instead of 'a'?"
+    )):
+        tab.reduce("a")
+    with pytest.raises(ValueError, match=re.escape(
+        "In reduce() all positional arguments have to be a ColumnReference."
+    )):
+        tab.reduce(1)
