@@ -80,13 +80,23 @@ class Table(TableLike):
             f"Table has no column {name!r}; columns: {self.column_names()}"
         )
 
+    def _column_ref(self, name: str) -> ColumnReference:
+        """Schema-direct column reference — bypasses attribute lookup so
+        columns named like Table methods/properties (select, C, ...) still
+        resolve (the ``.C`` namespace and ``t["name"]`` route here)."""
+        if name == "id":
+            return IdReference(self)
+        if name in self._schema.__columns__:
+            return ColumnReference(self, name)
+        raise AttributeError(
+            f"Table has no column {name!r}; columns: {self.column_names()}"
+        )
+
     def __getitem__(self, arg):
         if isinstance(arg, str):
-            if arg == "id":
-                return IdReference(self)
-            return getattr(self, arg)
+            return self._column_ref(arg)
         if isinstance(arg, ColumnReference):
-            return getattr(self, arg.name)
+            return self._column_ref(arg.name)
         if isinstance(arg, (list, tuple)):
             return self.select(*[self[a] for a in arg])
         raise TypeError(f"cannot index Table with {arg!r}")
@@ -97,6 +107,14 @@ class Table(TableLike):
     def __repr__(self) -> str:
         inner = ", ".join(f"{n}: {c.dtype!r}" for n, c in self._schema.columns().items())
         return f"<pw.Table ({inner})>"
+
+    @property
+    def C(self):
+        """``.C`` column accessor (reference table.C.colname): columns
+        whose names collide with Table method names."""
+        from .thisclass import _ColNamespace
+
+        return _ColNamespace(self)
 
     # -- live visualization (reference table.py:96 binds stdlib.viz) --------
 

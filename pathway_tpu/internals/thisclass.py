@@ -20,9 +20,32 @@ from .expression import (
 )
 
 
+class _ColNamespace:
+    """``.C`` column accessor (reference ``table.C.colname``): reaches
+    columns whose names collide with Table/this METHOD names — ``.C`` has
+    no methods of its own, so every attribute is a column reference."""
+
+    __slots__ = ("_owner",)
+
+    def __init__(self, owner: Any):
+        self._owner = owner
+
+    def __getattr__(self, name: str):
+        if name.startswith("__"):
+            raise AttributeError(name)
+        return self._owner[name]
+
+    def __getitem__(self, name: str):
+        return self._owner[name]
+
+
 class ThisPlaceholder:
     def __init__(self, label: str):
         self._label = label
+
+    @property
+    def C(self) -> _ColNamespace:
+        return _ColNamespace(self)
 
     def __getattr__(self, name: str) -> ColumnReference:
         if name.startswith("__") or name in ("_label", "_ipython_canary_method_should_not_exist_"):

@@ -1,0 +1,56 @@
+"""Ported from
+`/root/reference/python/pathway/tests/test_colnamespace.py`: the ``.C``
+column accessor for names colliding with Table/this methods."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    G.clear()
+    yield
+    G.clear()
+
+
+def test_namespace_1():
+    tab = pw.Table.empty(select=int)
+    assert isinstance(tab.C.select, pw.ColumnReference)
+
+
+def test_namespace_2():
+    tab = pw.Table.empty(select=int)
+    assert isinstance(tab.C["select"], pw.ColumnReference)
+
+
+def test_namespace_3():
+    tab = pw.Table.empty(C=int)
+    assert isinstance(tab.C.C, pw.ColumnReference)
+
+
+def test_namespace_4():
+    tab = pw.Table.empty(select=int)
+    tab2 = tab.select(pw.this.C.select)
+    assert tab.schema.column_names() == tab2.schema.column_names()
+
+
+def test_namespace_5():
+    tab = pw.Table.empty(C=int)
+    tab2 = tab.select(pw.this.C.C)
+    assert tab.schema.column_names() == tab2.schema.column_names()
+
+
+def test_namespace_6():
+    tab = pw.Table.empty(C=int)
+    tab2 = tab.select(pw.this.C["C"])
+    assert tab.schema.column_names() == tab2.schema.column_names()
+
+
+def test_namespace_7():
+    tab = pw.Table.empty(C=int)
+    tab2 = tab.select(pw.this["C"])
+    assert tab.schema.column_names() == tab2.schema.column_names()
