@@ -78,6 +78,86 @@ class PathwayWebserver:
         for m in methods:
             self._app.router.add_route(m, route, handler)
 
+    #: dtype -> OpenAPI type (reference _ENGINE_TO_OPENAPI_TYPE)
+    _OPENAPI_TYPES = {
+        "INT": "integer", "FLOAT": "number", "STR": "string",
+        "BOOL": "boolean", "BYTES": "string",
+        "DATE_TIME_NAIVE": "string", "DATE_TIME_UTC": "string",
+        "DURATION": "string",
+    }
+
+    def openapi_description_json(self, host: str) -> dict:
+        """OpenAPI v3 document for every registered rest_connector route
+        (reference _server.py openapi_description_json): per-route JSON
+        request-body schemas built from the pw.Schema — columns without
+        defaults are required, un-typeable columns (Json/Any) turn on
+        additionalProperties."""
+        from ...internals import dtype as dt
+
+        paths: dict[str, dict] = {}
+        for route, (schema, methods) in sorted(self._routes.items()):
+            properties: dict[str, dict] = {}
+            required: list[str] = []
+            additional = False
+            for name, col in schema.columns().items():
+                base = dt.unoptionalize(col.dtype)
+                typ = self._OPENAPI_TYPES.get(repr(base))
+                if typ is None:
+                    additional = True
+                    continue
+                field: dict = {"type": typ}
+                if col.has_default:
+                    field["default"] = col.default_value
+                else:
+                    required.append(name)
+                properties[name] = field
+            body_schema: dict = {
+                "type": "object",
+                "properties": properties,
+                "additionalProperties": additional,
+            }
+            if required:
+                body_schema["required"] = required
+            responses = {
+                "200": {"description": "OK"},
+                "400": {
+                    "description": "The request is incorrect. Please check "
+                    "if it complies with the auto-generated and input "
+                    "table schemas"
+                },
+            }
+            ops: dict[str, dict] = {}
+            for m in methods:
+                if m == "GET":
+                    ops["get"] = {
+                        "parameters": [
+                            {
+                                "name": n,
+                                "in": "query",
+                                "required": n in required,
+                                "schema": {"type": p["type"]},
+                            }
+                            for n, p in properties.items()
+                        ],
+                        "responses": dict(responses),
+                    }
+                else:
+                    ops[m.lower()] = {
+                        "requestBody": {
+                            "content": {
+                                "application/json": {"schema": body_schema}
+                            },
+                        },
+                        "responses": dict(responses),
+                    }
+            paths[route] = ops
+        return {
+            "openapi": "3.0.3",
+            "info": {"title": "Pathway API", "version": "1.0.0"},
+            "servers": [{"url": f"http://{host}"}],
+            "paths": paths,
+        }
+
     def start(self) -> None:
         if self._thread is not None:
             return
@@ -229,6 +309,7 @@ def rest_connector(
     if keep_queries is not None:
         delete_completed_queries = not keep_queries
 
+    webserver._routes[route] = (schema, tuple(m.upper() for m in methods))
     subject = _RestSubject(
         webserver, route, methods, schema, delete_completed_queries,
         request_validator,

@@ -1,0 +1,109 @@
+"""Ported from
+`/root/reference/python/pathway/tests/test_openapi_schema_generation.py`
+(the openapi_spec_validator dependency is absent here; documents are
+checked structurally)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _body_schema(description, route="/"):
+    return description["paths"][route]["post"]["requestBody"]["content"][
+        "application/json"
+    ]["schema"]
+
+
+def test_one_endpoint_no_additional_props_all_fields_required():
+    # reference test_openapi_schema_generation.py:8
+    class InputSchema(pw.Schema):
+        k: int
+        v: int
+
+    webserver = pw.io.http.PathwayWebserver(host="127.0.0.1", port=28997)
+    pw.io.http.rest_connector(
+        webserver=webserver, schema=InputSchema,
+        delete_completed_queries=False,
+    )
+    d = webserver.openapi_description_json("127.0.0.1:28997")
+    assert d["openapi"].startswith("3.")
+    s = _body_schema(d)
+    assert not s["additionalProperties"]
+    assert sorted(s["required"]) == ["k", "v"]
+    assert s["properties"]["k"] == {"type": "integer"}
+
+
+def test_additional_props():
+    # reference :28 — a dict column means arbitrary additional props
+    class InputSchema(pw.Schema):
+        k: int
+        v: dict
+
+    webserver = pw.io.http.PathwayWebserver(host="127.0.0.1", port=28998)
+    pw.io.http.rest_connector(
+        webserver=webserver, schema=InputSchema,
+        delete_completed_queries=False,
+    )
+    d = webserver.openapi_description_json("127.0.0.1:28998")
+    assert _body_schema(d)["additionalProperties"]
+
+
+def test_optional_fields():
+    # reference :48 — defaulted columns are not required
+    class InputSchema(pw.Schema):
+        k: int
+        v: str = pw.column_definition(default_value="hello")
+
+    webserver = pw.io.http.PathwayWebserver(host="127.0.0.1", port=28999)
+    pw.io.http.rest_connector(
+        webserver=webserver, schema=InputSchema,
+        delete_completed_queries=False,
+    )
+    s = _body_schema(webserver.openapi_description_json("127.0.0.1:28999"))
+    assert not s["additionalProperties"]
+    assert s["required"] == ["k"]
+    assert s["properties"]["v"]["default"] == "hello"
+
+
+def test_two_endpoints():
+    # reference :72
+    class InputSchema(pw.Schema):
+        k: int
+        v: str
+
+    webserver = pw.io.http.PathwayWebserver(host="127.0.0.1", port=29000)
+    pw.io.http.rest_connector(
+        webserver=webserver, schema=InputSchema, route="/one",
+        delete_completed_queries=False,
+    )
+    pw.io.http.rest_connector(
+        webserver=webserver, schema=InputSchema, route="/two",
+        delete_completed_queries=False,
+    )
+    d = webserver.openapi_description_json("127.0.0.1:29000")
+    assert d["paths"].keys() == {"/one", "/two"}
+
+
+def test_no_required_fields():
+    # reference :108
+    class InputSchema(pw.Schema):
+        k: int = pw.column_definition(default_value=1)
+        v: str = pw.column_definition(default_value="hello")
+
+    webserver = pw.io.http.PathwayWebserver(host="127.0.0.1", port=29001)
+    pw.io.http.rest_connector(
+        webserver=webserver, schema=InputSchema,
+        delete_completed_queries=False,
+    )
+    s = _body_schema(webserver.openapi_description_json("127.0.0.1:29001"))
+    assert "required" not in s
