@@ -201,7 +201,11 @@ class DateTimeNamespace(_Namespace):
     def strftime(self, fmt):
         return self._method("dt.strftime", fmt)
 
-    def strptime(self, fmt, contains_timezone: bool = False):
+    def strptime(self, fmt, contains_timezone: bool | None = None):
+        if contains_timezone is None:
+            # a literal fmt with %z parses zone-aware values -> UTC dtype
+            # (reference infers DATE_TIME_UTC from the format string)
+            contains_timezone = isinstance(fmt, str) and "%z" in fmt
         return self._method("dt.strptime", fmt, contains_timezone=contains_timezone)
 
     def to_naive_in_timezone(self, timezone: str):

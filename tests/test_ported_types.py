@@ -1,0 +1,81 @@
+"""Ported from `/root/reference/python/pathway/tests/test_types.py`:
+dtype inference through datetime parsing and schema-typed markdown."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.internals.dtype as dt
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T, assert_table_equality_wo_index
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    G.clear()
+    yield
+    G.clear()
+
+
+def test_date_time_naive_schema():
+    # reference test_types.py:15
+    table = T(
+        """
+      |         t1          |         t2
+    0 | 2023-05-15T10:13:00 | 2023-05-15T10:13:23
+    """
+    )
+    fmt = "%Y-%m-%dT%H:%M:%S"
+    t2 = table.select(
+        t1=table.t1.dt.strptime(fmt=fmt), t2=table.t2.dt.strptime(fmt=fmt)
+    ).with_columns(diff=pw.this.t1 - pw.this.t2)
+    assert t2.schema.dtypes() == {
+        "t1": dt.DATE_TIME_NAIVE,
+        "t2": dt.DATE_TIME_NAIVE,
+        "diff": dt.DURATION,
+    }
+
+
+def test_date_time_utc_schema():
+    # reference test_types.py:36
+    table = T(
+        """
+      |            t1             |            t2
+    0 | 2023-05-15T10:13:00+01:00 | 2023-05-15T10:13:23+01:00
+    """
+    )
+    fmt = "%Y-%m-%dT%H:%M:%S%z"
+    t2 = table.select(
+        t1=table.t1.dt.strptime(fmt=fmt), t2=table.t2.dt.strptime(fmt=fmt)
+    ).with_columns(diff=pw.this.t1 - pw.this.t2)
+    assert t2.schema.dtypes() == {
+        "t1": dt.DATE_TIME_UTC,
+        "t2": dt.DATE_TIME_UTC,
+        "diff": dt.DURATION,
+    }
+
+
+def test_markdown_type_float():
+    # reference test_types.py:57 — a float-typed schema coerces int cells
+    class TestInputSchema(pw.Schema):
+        float_num: float
+        should_be_float_num: float
+
+    t = pw.debug.table_from_markdown(
+        """
+        | float_num | should_be_float_num
+    1   | 2.7       | 1
+    2   | 3.1       | 2
+    """,
+        schema=TestInputSchema,
+    )
+    t = t.with_columns(test1=2 * t.float_num, test2=2 * t.should_be_float_num)
+    expected = pw.debug.table_from_markdown(
+        """
+    float_num | should_be_float_num | test1 | test2
+    2.7       | 1.0                 | 5.4   | 2.0
+    3.1       | 2.0                 | 6.2   | 4.0
+    """
+    )
+    assert_table_equality_wo_index(t, expected, check_types=False)
