@@ -190,8 +190,25 @@ class ColumnExpression:
     def _deps(self) -> tuple["ColumnExpression", ...]:
         return ()
 
-    def __repr__(self) -> str:
+    def _collect_tables(self, order: list) -> None:
+        if isinstance(self, ColumnReference):
+            t = self._table
+            if all(t is not o for o in order):
+                order.append(t)
+        for d in self._deps:
+            if isinstance(d, ColumnExpression):
+                d._collect_tables(order)
+
+    def _fmt(self, tables: dict) -> str:
         return f"<{type(self).__name__}>"
+
+    def __repr__(self) -> str:
+        # reference ExpressionFormatter: tables number in first-appearance
+        # order within ONE repr -> stable "<table1>.col" labels
+        order: list = []
+        self._collect_tables(order)
+        tables = {id(t): i + 1 for i, t in enumerate(order)}
+        return self._fmt(tables)
 
 
 def smart_coerce(v: Any) -> ColumnExpression:
@@ -204,8 +221,9 @@ class ColumnConstExpression(ColumnExpression):
     def __init__(self, value: Any):
         self._value = value
 
-    def __repr__(self):
-        return f"Const({self._value!r})"
+    def _fmt(self, tables: dict) -> str:
+        return f"{self._value!r}"
+
 
 
 class ColumnReference(ColumnExpression):
@@ -223,8 +241,10 @@ class ColumnReference(ColumnExpression):
     def name(self) -> str:
         return self._name
 
-    def __repr__(self):
-        return f"<table {id(self._table):#x}>.{self._name}"
+    def _fmt(self, tables: dict) -> str:
+        n = tables.get(id(self._table))
+        label = f"<table{n}>" if n is not None else f"<table {id(self._table):#x}>"
+        return f"{label}.{self._name}"
 
 
 class IdReference(ColumnReference):
@@ -253,7 +273,7 @@ class HiddenRef(ColumnExpression):
     def _deps(self):
         return ()
 
-    def __repr__(self):
+    def _fmt(self, tables: dict) -> str:
         return f"<hidden {self._engine_name}>"
 
 
@@ -267,8 +287,11 @@ class ColumnBinaryOpExpression(ColumnExpression):
     def _deps(self):
         return (self._left, self._right)
 
-    def __repr__(self):
-        return f"({self._left!r} {self._op} {self._right!r})"
+    def _fmt(self, tables: dict) -> str:
+        return (
+            f"({self._left._fmt(tables)} {self._op} "
+            f"{self._right._fmt(tables)})"
+        )
 
 
 class ColumnUnaryOpExpression(ColumnExpression):
@@ -291,8 +314,9 @@ class ReducerExpression(ColumnExpression):
     def _deps(self):
         return self._args
 
-    def __repr__(self):
-        return f"reducers.{self._reducer}({', '.join(map(repr, self._args))})"
+    def _fmt(self, tables: dict) -> str:
+        inner = ", ".join(a._fmt(tables) for a in self._args)
+        return f"pathway.reducers.{self._reducer}({inner})"
 
 
 class ApplyExpression(ColumnExpression):

@@ -1,0 +1,51 @@
+"""Ported from
+`/root/reference/python/pathway/tests/test_expression_repr.py`:
+stable numbered-table expression reprs."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _t():
+    return T("pet | owner | age\n1 | Alice | 10")
+
+
+def test_column_reference():
+    # reference test_expression_repr.py:10
+    t = _t()
+    assert repr(t.pet) == "<table1>.pet"
+
+
+def test_column_binary_op():
+    # reference :20
+    t = _t()
+    for op in ("+", "-", "*", "/", "//", "**", "%",
+               "==", "!=", "<", "<=", ">", ">="):
+        expr = eval(f"t.pet {op} t.age", {"t": t})
+        assert repr(expr) == f"(<table1>.pet {op} <table1>.age)", op
+
+
+def test_2_args():
+    # reference :42 — distinct tables number in appearance order
+    t = _t()
+    tt = t.copy()
+    assert repr(t.pet + tt.age) == "(<table1>.pet + <table2>.age)"
+
+
+def test_reducers():
+    t = _t()
+    assert (
+        repr(pw.reducers.sum(t.age)) == "pathway.reducers.sum(<table1>.age)"
+    )
