@@ -34,12 +34,18 @@ class EngineStats:
     def __init__(self) -> None:
         import time as _time
 
+        from ..observability.histogram import LogHistogram
+
         self.started_at = _time.time()
         self.ticks = 0
         self.rows_total = 0
         self.input_rows = 0
         self.output_rows = 0
         self.latency_ms: float | None = None
+        #: wall-clock of the last latency_ms update — the gauge freezes at
+        #: the last commit's value, so its age is what separates "fast"
+        #: from "stalled" (pathway_output_latency_age_seconds)
+        self.latency_updated_at: float | None = None
         self.last_time: int = 0
         self.rows_by_node: dict[str, int] = {}
         #: cumulative processing nanoseconds per node (the dashboard's
@@ -49,6 +55,29 @@ class EngineStats:
         #: set by the dashboard at level >= ALL to turn on per-node timing
         self.detailed = False
         self.finished = False
+        # -- distribution-level metrics (observability/histogram.py) --
+        #: wall time of each tick sweep, ns
+        self.tick_duration = LogHistogram()
+        #: commit-to-output latency, ns (histogram companion of latency_ms)
+        self.latency_hist = LogHistogram()
+        #: per-operator processing time, ns (fed with time_by_node)
+        self.node_time_hist: dict[str, Any] = {}
+        self._hist_factory = LogHistogram
+        # -- liveness / readiness (observability/health.py) --
+        #: updated every tick AND every idle park cycle; a stale heartbeat
+        #: on an unfinished run means the executor thread is wedged
+        self.last_heartbeat = self.started_at
+        #: all sources collected/started — first half of /readyz
+        self.sources_connected = False
+        # -- exchange backpressure (Exchange nodes / comm backends) --
+        self.exchange_rows_out = 0
+        self.exchange_rows_in = 0
+        self.exchange_batches = 0
+
+    def heartbeat(self) -> None:
+        import time as _time
+
+        self.last_heartbeat = _time.time()
 
     def note_node(self, node: "Node", n_rows: int, is_source: bool) -> None:
         self.rows_total += n_rows
@@ -60,18 +89,31 @@ class EngineStats:
     def note_node_time(self, node: "Node", ns: int) -> None:
         label = f"{type(node).__name__}#{node.node_id}"
         self.time_by_node[label] = self.time_by_node.get(label, 0) + ns
+        hist = self.node_time_hist.get(label)
+        if hist is None:
+            hist = self.node_time_hist[label] = self._hist_factory()
+        hist.observe(ns)
+
+    def note_exchange(self, rows_out: int, rows_in: int) -> None:
+        self.exchange_batches += 1
+        self.exchange_rows_out += rows_out
+        self.exchange_rows_in += rows_in
 
     def note_tick(self, time: int) -> None:
         import time as _time
 
         self.ticks += 1
         self.last_time = time
-        now_ms = _time.time() * 1000.0
+        now = _time.time()
+        self.last_heartbeat = now
+        now_ms = now * 1000.0
         # only wall-clock commit timestamps are latency-comparable; small
         # logical times (scheduled test streams) would read as ~epoch ms
         if time > 1_000_000_000_000:
             # a logical clock nudged past wall-clock means we're keeping up
             self.latency_ms = max(0.0, now_ms - time)
+            self.latency_updated_at = now
+            self.latency_hist.observe(int(self.latency_ms * 1e6))
 
 
 class Node:
@@ -310,6 +352,10 @@ class Executor:
         self._last_clock = 0
         self._defer_commit = False
         self.stats = EngineStats()
+        for node in self.nodes:
+            # Exchange nodes report per-tick sent/received row counts into
+            # the worker's stats (backpressure signals on /metrics)
+            node._engine_stats = self.stats
         from ..internals.tracing import get_tracer
 
         self.tracer = get_tracer()
@@ -384,6 +430,8 @@ class Executor:
                     pending.setdefault(int(time), []).append(
                         (node, self._partition_source(delta))
                     )
+        # batch mode: every input is a finite schedule already in hand
+        self.stats.sources_connected = True
 
         for time in sorted(pending):
             self._tick(time, pending[time])
@@ -419,8 +467,10 @@ class Executor:
         for src in realtime:
             src.attach_waker(wake)
             src.start()
+        self.stats.sources_connected = True
         try:
             while not self._stop_requested:
+                self.stats.heartbeat()
                 # each commit batch of a source gets its own timestamp;
                 # batch j of every source shares round j's tick
                 rounds: list[list[tuple[SourceNode, Delta]]] = []
@@ -474,9 +524,11 @@ class Executor:
         for src in owned:
             src.attach_waker(wake)
             src.start()
+        self.stats.sources_connected = True
         cycle = 0
         try:
             while True:
+                self.stats.heartbeat()
                 rounds: list[list[tuple[SourceNode, Delta]]] = []
                 for src in owned:
                     for j, delta in enumerate(src.poll()):
@@ -648,12 +700,14 @@ class Executor:
         return clock
 
     def _tick(self, time: int, source_emissions: list[tuple[SourceNode, Delta]]) -> None:
+        import time as _wall
+
         tracer = self.tracer
         timed = tracer is not None or self.stats.detailed
-        if timed:
-            import time as _wall
-
-            tick_t0 = _wall.perf_counter_ns()
+        # tick duration is always histogrammed — two clock reads per tick
+        # against a full topological sweep is noise, and it is the one
+        # distribution that catches hot-path regressions unconditionally
+        tick_t0 = _wall.perf_counter_ns()
         inbox: dict[int, dict[int, list[Delta]]] = {}
         seeded: dict[int, list[Delta]] = {}
         for src, delta in source_emissions:
@@ -730,6 +784,7 @@ class Executor:
                     self.stats.note_node_time(
                         node, _wall.perf_counter_ns() - node_t0
                     )
+        self.stats.tick_duration.observe(_wall.perf_counter_ns() - tick_t0)
         self.stats.note_tick(time)
         for cb in self._on_time_end:
             cb(time)

@@ -4,10 +4,23 @@ Re-design of ``src/engine/http_server.rs:21-60``: serves OpenMetrics/
 Prometheus text built from the live ``EngineStats`` on port
 ``20000 + process_id`` (same convention). Pure-stdlib ``http.server`` on a
 daemon thread.
+
+Endpoints:
+
+- ``/metrics`` (also ``/`` and ``/status``) — exposition text with
+  counter + histogram families (``observability/prometheus.py``). On
+  process 0 of a multi-process run this is the cluster-merged view with
+  per-worker labels (``observability/hub.py`` scrapes the peers).
+- ``/snapshot`` — this process's raw stats as JSON; what process 0
+  scrapes from peers.
+- ``/healthz`` — 200 while no executor thread is wedged, else 503.
+- ``/readyz`` — 200 once sources are connected and the first frontier
+  advanced, else 503.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
@@ -18,63 +31,97 @@ DEFAULT_PORT_BASE = 20000
 
 
 def _render_metrics(stats: Any) -> str:
-    import time as _time
+    """Exposition text for one worker's live stats (single-process
+    format, no worker label). Label values are escaped per OpenMetrics —
+    the seed emitted raw operator names, so a ``"`` or ``\\`` in a label
+    produced unparseable text."""
+    from ..observability.hub import stats_snapshot
+    from ..observability.prometheus import render_snapshots
 
-    lines = [
-        "# TYPE pathway_engine_ticks counter",
-        f"pathway_engine_ticks {stats.ticks}",
-        "# TYPE pathway_engine_rows_total counter",
-        f"pathway_engine_rows_total {stats.rows_total}",
-        "# TYPE pathway_input_rows counter",
-        f"pathway_input_rows {stats.input_rows}",
-        "# TYPE pathway_output_rows counter",
-        f"pathway_output_rows {stats.output_rows}",
-        "# TYPE pathway_uptime_seconds gauge",
-        f"pathway_uptime_seconds {_time.time() - stats.started_at:.3f}",
-    ]
-    if stats.latency_ms is not None:
-        lines += [
-            "# TYPE pathway_output_latency_ms gauge",
-            f"pathway_output_latency_ms {stats.latency_ms:.3f}",
-        ]
-    # snapshot: the executor thread inserts node keys concurrently
-    for label, count in sorted(list(stats.rows_by_node.items())):
-        lines.append(
-            f'pathway_operator_rows_total{{operator="{label}"}} {count}'
-        )
-    return "\n".join(lines) + "\n"
+    return render_snapshots([stats_snapshot(stats)])
 
 
 def start_http_server(
     stats: Any, port: int | None = None, host: str | None = None
 ):
-    """Serve /metrics (and / as a liveness probe); returns (server, thread).
-    Call ``server.shutdown()`` to stop.
+    """Serve the monitoring endpoints; returns (server, thread). ``stats``
+    is either a single ``EngineStats`` (wrapped into a one-worker hub) or
+    an ``ObservabilityHub``. Call ``server.shutdown()`` to stop; the bound
+    port is ``server.server_address[1]`` (pass ``port=0`` for ephemeral).
 
     Binds loopback by default — the endpoint exposes operator names and row
     counts without authentication, so exposure to all interfaces is opt-in
     via ``PATHWAY_MONITORING_HTTP_HOST=0.0.0.0`` (advisor finding r1)."""
-    import os
+    from ..observability.hub import ObservabilityHub
 
-    if host is None:
-        host = os.environ.get("PATHWAY_MONITORING_HTTP_HOST", "127.0.0.1")
-    if port is None:
+    try:
         from ..internals.config import get_pathway_config
 
-        base = int(os.environ.get("PATHWAY_MONITORING_HTTP_PORT", DEFAULT_PORT_BASE))
-        port = base + get_pathway_config().process_id
+        cfg = get_pathway_config()
+        cfg_host = cfg.monitoring_http_host
+        base, pid = cfg.monitoring_http_port, cfg.process_id
+        wedge_s = cfg.health_wedge_timeout_s
+    except RuntimeError:
+        # config can refuse bad worker env vars (e.g. a mismatched
+        # PATHWAY_ADDRESSES); explicit host/port must still work, and the
+        # defaults fall back to raw env reads like the seed's
+        import os
+
+        cfg_host = os.environ.get("PATHWAY_MONITORING_HTTP_HOST", "127.0.0.1")
+        try:
+            base = int(
+                os.environ.get("PATHWAY_MONITORING_HTTP_PORT", DEFAULT_PORT_BASE)
+            )
+            pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+        except ValueError:
+            base, pid = DEFAULT_PORT_BASE, 0
+        wedge_s = 30.0
+    # base 0 = ephemeral for EVERY process (0 + pid would bind privileged
+    # ports); ephemeral ports are unknowable to peers, so the cluster
+    # roll-up skips scraping under base 0 (hub.from_config)
+    cfg_port = base + pid if base else 0
+    if host is None:
+        host = cfg_host
+    if port is None:
+        port = cfg_port
+
+    if isinstance(stats, ObservabilityHub):
+        hub = stats
+    else:
+        hub = ObservabilityHub(wedge_timeout_s=wedge_s)
+        hub.register_worker(0, stats)
 
     class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self) -> None:  # noqa: N802 - http.server API
-            if self.path.rstrip("/") in ("", "/metrics", "/status"):
-                body = _render_metrics(stats).encode()
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            path = self.path.rstrip("/")
+            if path in ("", "/metrics", "/status"):
+                self._reply(
+                    200,
+                    hub.render_metrics().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
                 )
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+            elif path == "/snapshot":
+                self._reply(
+                    200,
+                    json.dumps(hub.snapshot_document()).encode(),
+                    "application/json",
+                )
+            elif path in ("/healthz", "/readyz"):
+                ok, detail = (
+                    hub.health() if path == "/healthz" else hub.ready()
+                )
+                self._reply(
+                    200 if ok else 503,
+                    json.dumps(detail).encode(),
+                    "application/json",
+                )
             else:
                 self.send_response(404)
                 self.end_headers()

@@ -127,6 +127,19 @@ class MeshExchangeRunner:
         self.devices = list(np.asarray(mesh.devices).reshape(-1))
         self._staging: dict[tuple, tuple[np.ndarray, np.ndarray]] = {}
         self._shardings: tuple | None = None
+        # observability counters (read via Comm.comm_stats → /metrics)
+        self.collectives = 0
+        self.rows_moved = 0
+
+    def note_collective(self, rows: int) -> None:
+        self.collectives += 1
+        self.rows_moved += int(rows)
+
+    def stats(self) -> dict[str, float]:
+        return {
+            "mesh_collectives": float(self.collectives),
+            "mesh_rows_moved": float(self.rows_moved),
+        }
 
     def width(self, kinds: list[str]) -> int:
         return 2 * (2 + sum(1 for k in kinds if k != HOST))
@@ -145,8 +158,10 @@ class MeshExchangeRunner:
         import jax
 
         counts_all = [p[1] for p in payloads]
-        if sum(int(c.sum()) for c in counts_all) == 0:
+        total_rows = sum(int(c.sum()) for c in counts_all)
+        if total_rows == 0:
             return None
+        self.note_collective(total_rows)
         kinds = agree_kinds([p[0] for p in payloads], len(column_names))
         cap_in = _pow2(max(int(c.sum()) for c in counts_all))
         cap_bucket = _pow2(max(int(c.max()) for c in counts_all))

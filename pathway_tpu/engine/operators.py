@@ -301,6 +301,12 @@ class Exchange(Node):
                 self.channel, time, ctx.worker_id, buckets
             )
         received = [r for r in received if r is not None and len(r)]
+        stats = getattr(self, "_engine_stats", None)
+        if stats is not None:
+            stats.note_exchange(
+                sum(len(b) for b in buckets if b is not None),
+                sum(len(r) for r in received),
+            )
         if not received:
             return None
         return concat_deltas(received, self.column_names)

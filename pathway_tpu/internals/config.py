@@ -33,6 +33,14 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    try:
+        return float(v) if v is not None else default
+    except ValueError:
+        return default
+
+
 def _env_addresses(name: str) -> list[str] | None:
     v = os.environ.get(name)
     if not v or not v.strip():
@@ -62,6 +70,26 @@ class PathwayConfig:
     #: telemetry analog of src/engine/telemetry.rs for a no-egress world)
     trace_file: str | None = field(
         default_factory=lambda: os.environ.get("PATHWAY_TRACE_FILE"))
+    # observability (engine/http_server.py + observability/)
+    #: force the monitoring HTTP server on without a code change (the
+    #: with_http_server=True analog for spawn-style deployments)
+    monitoring_http_server: bool = field(
+        default_factory=lambda: _env_bool("PATHWAY_MONITORING_HTTP_SERVER"))
+    #: bind host for /metrics + probes; loopback unless opted into
+    monitoring_http_host: str = field(
+        default_factory=lambda: os.environ.get(
+            "PATHWAY_MONITORING_HTTP_HOST", "127.0.0.1"))
+    #: base port; process p serves on base + p (http_server.rs convention)
+    monitoring_http_port: int = field(
+        default_factory=lambda: _env_int(
+            "PATHWAY_MONITORING_HTTP_PORT", 20000))
+    #: periodic telemetry flush cadence (observability/exporter.py);
+    #: 0 disables, leaving only the end-of-run export
+    telemetry_flush_s: float = field(
+        default_factory=lambda: _env_float("PATHWAY_TELEMETRY_FLUSH_S", 60.0))
+    #: /healthz fails when an unfinished executor's heartbeat is older
+    health_wedge_timeout_s: float = field(
+        default_factory=lambda: _env_float("PATHWAY_HEALTH_WEDGE_S", 30.0))
     # worker layout (config.rs PATHWAY_THREADS/PROCESSES/PROCESS_ID/FIRST_PORT)
     #: route dense Exchange columns over the jax device mesh (ICI) instead
     #: of host memory — parallel/meshcomm.py; needs ≥ total_workers devices

@@ -42,16 +42,75 @@ class GraphRunner:
 
     # ------------------------------------------------------------------
 
+    def _want_http_server(self) -> bool:
+        if self.with_http_server:
+            return True
+        try:
+            from .config import get_pathway_config
+
+            return get_pathway_config().monitoring_http_server
+        except RuntimeError:
+            return False
+
+    def _start_observability(self, workers, comm=None):
+        """Hub + HTTP endpoints + periodic telemetry flusher for this
+        process's workers. Returns (http_server, flusher, hub); each may
+        be None. ``workers`` is [(worker_id, EngineStats), ...]."""
+        from ..observability import ObservabilityHub
+        from ..observability.exporter import start_periodic_flusher
+        from .config import get_pathway_config
+
+        http_server = None
+        hub = None
+        if self._want_http_server():
+            from ..engine.http_server import start_http_server
+
+            try:
+                hub = ObservabilityHub.from_config(get_pathway_config())
+            except RuntimeError:
+                hub = ObservabilityHub()
+            for w, stats in workers:
+                hub.register_worker(w, stats)
+                # /metrics serves per-operator latency histograms, which
+                # need per-node timing on (the dashboard's ALL level)
+                stats.detailed = True
+            if comm is not None:
+                hub.register_comm(comm)
+            try:
+                http_server, _ = start_http_server(hub)
+            except OSError as e:
+                # telemetry must not fail the run it observes: a taken
+                # port (another pipeline on this host) degrades to
+                # metrics-off, it does not abort the dataflow
+                import warnings
+
+                warnings.warn(
+                    f"monitoring HTTP server failed to start: {e}; "
+                    "continuing without /metrics",
+                    RuntimeWarning,
+                )
+                http_server = None
+        #: bound server exposed for tests/tools needing the ephemeral port
+        self._http_server_for_tests = http_server
+        flusher = start_periodic_flusher(hub)
+        return http_server, flusher, hub
+
+    @staticmethod
+    def _stop_observability(http_server, flusher) -> None:
+        if flusher is not None:
+            flusher.stop()
+        if http_server is not None:
+            http_server.shutdown()
+            http_server.server_close()
+
     def _execute(self) -> None:
         self.executor = Executor(self._nodes, persistence=self.persistence)
         if self.stop_requested:
             self.executor.request_stop()
         stop_dashboard = None
-        http_server = None
-        if self.with_http_server:
-            from ..engine.http_server import start_http_server
-
-            http_server, _ = start_http_server(self.executor.stats)
+        http_server, flusher, _hub = self._start_observability(
+            [(0, self.executor.stats)]
+        )
         if self.monitoring_level:
             from .monitoring import start_dashboard
 
@@ -63,9 +122,7 @@ class GraphRunner:
         finally:
             if stop_dashboard is not None:
                 stop_dashboard()
-            if http_server is not None:
-                http_server.shutdown()
-                http_server.server_close()
+            self._stop_observability(http_server, flusher)
             from .telemetry import export_from_env
             from .tracing import get_tracer
 
@@ -201,26 +258,38 @@ class GraphRunner:
                 for ex in executors:
                     ex.request_stop()
 
-            if len(executors) == 1:
-                work(executors[0])
-            else:
-                threads = [
-                    threading.Thread(target=work, args=(ex,), daemon=True)
-                    for ex in executors
-                ]
-                for t in threads:
-                    t.start()
-                for t in threads:
-                    t.join()
+            # cluster observability: this process serves its workers'
+            # stats on base_port + process_id; process 0's /metrics is
+            # the merged per-worker view (it scrapes peer /snapshot)
+            http_server, flusher, _hub = self._start_observability(
+                list(zip(local_worker_ids, (ex.stats for ex in executors))),
+                comm=comm,
+            )
+            try:
+                if len(executors) == 1:
+                    work(executors[0])
+                else:
+                    threads = [
+                        threading.Thread(target=work, args=(ex,), daemon=True)
+                        for ex in executors
+                    ]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+            finally:
+                self._stop_observability(http_server, flusher)
         finally:
             comm.close()
             for manager in managers:
                 manager.close()
             from .tracing import get_tracer
+            from .telemetry import export_from_env
 
             tracer = get_tracer()
             if tracer is not None:
                 tracer.flush()
+                export_from_env(tracer)
         if errors:
             primary = [
                 e for e in errors

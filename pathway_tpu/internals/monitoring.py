@@ -32,6 +32,14 @@ def _rows(stats: Any, level: int) -> list[tuple[str, str]]:
             f"{stats.latency_ms:.0f} ms" if stats.latency_ms is not None else "-",
         ),
     ]
+    tick_hist = getattr(stats, "tick_duration", None)
+    if tick_hist is not None and len(tick_hist):
+        from ..observability.histogram import quantile_from_snapshot
+
+        snap = tick_hist.snapshot()
+        p50 = quantile_from_snapshot(snap, 0.5) / 1e6
+        p95 = quantile_from_snapshot(snap, 0.95) / 1e6
+        out.append(("tick p50/p95", f"{p50:.1f}/{p95:.1f} ms"))
     if level >= MonitoringLevel.ALL:
         # snapshot: the executor thread inserts node keys concurrently.
         # per-operator row counts + cumulative processing time (the
@@ -48,6 +56,10 @@ def _rows(stats: Any, level: int) -> list[tuple[str, str]]:
 
 def start_dashboard(stats: Any, level: int, refresh_s: float = 1.0):
     """Returns a stop() callable."""
+    if level == MonitoringLevel.NONE:
+        # a NONE caller must get a no-op — without this early return a
+        # refresh thread would still spawn and spam stderr
+        return lambda: None
     if level in (MonitoringLevel.AUTO, MonitoringLevel.AUTO_ALL):
         if not sys.stderr.isatty():
             # AUTO means "dashboard only when interactive" (reference

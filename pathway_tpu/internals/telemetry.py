@@ -136,6 +136,59 @@ class OtlpExporter:
             }]
         }
 
+    def histograms_payload(
+        self,
+        points: list[tuple[str, dict, dict]],
+        time_unix_nano: int,
+    ) -> dict:
+        """ExportMetricsServiceRequest for engine histogram snapshots
+        (observability/histogram.py log2 buckets → OTLP explicit-bounds
+        histogram data points, cumulative temporality)."""
+        metrics = []
+        for name, attrs, snap in points:
+            counts = snap["counts"]
+            nonzero = [i for i, c in enumerate(counts) if c]
+            if nonzero:
+                lo, hi = nonzero[0], nonzero[-1]
+                # bounds in seconds; bucket i upper bound is 2^i ns
+                bounds = [(1 << i) / 1e9 for i in range(lo, hi + 1)]
+                bucket_counts = (
+                    [str(sum(counts[: lo]) + counts[lo])]
+                    + [str(counts[i]) for i in range(lo + 1, hi + 1)]
+                    + ["0"]  # overflow bucket beyond the occupied range
+                )
+            else:
+                bounds = []
+                bucket_counts = [str(snap["count"])]
+            point = {
+                "timeUnixNano": str(time_unix_nano),
+                "count": str(snap["count"]),
+                "sum": snap["sum"] / 1e9,
+                "bucketCounts": bucket_counts,
+                "explicitBounds": bounds,
+            }
+            if attrs:
+                point["attributes"] = [
+                    {"key": k, "value": self._attr_value(v)}
+                    for k, v in attrs.items()
+                ]
+            metrics.append({
+                "name": name,
+                "histogram": {
+                    "aggregationTemporality": 2,  # CUMULATIVE
+                    "dataPoints": [point],
+                },
+            })
+        return {
+            "resourceMetrics": [{
+                "resource": self._resource(),
+                "scopeMetrics": [{
+                    "scope": {"name": "pathway_tpu.observability"},
+                    "metrics": metrics,
+                }],
+            }]
+        }
+
     # -- transport --------------------------------------------------------
 
     def _post(self, path: str, payload: dict) -> bool:
@@ -160,6 +213,13 @@ class OtlpExporter:
         # anchor relative timestamps to the wall clock NOW minus the
         # monotonic distance to each event (close enough for telemetry)
         origin_unix_ns = time.time_ns() - (time.perf_counter_ns() - origin)
+        return self.export_events(events, origin_unix_ns)
+
+    def export_events(
+        self, events: list[dict], origin_unix_ns: int
+    ) -> dict[str, bool]:
+        """Push a specific event slice (the periodic flusher's incremental
+        path — it exports only events_since the shared cursor)."""
         out = {}
         spans = self.spans_payload(events, origin_unix_ns)
         if spans["resourceSpans"][0]["scopeSpans"][0]["spans"]:
@@ -169,11 +229,22 @@ class OtlpExporter:
             out["metrics"] = self._post("/v1/metrics", metrics)
         return out
 
+    def export_histograms(
+        self, points: list[tuple[str, dict, dict]], time_unix_nano: int
+    ) -> bool:
+        payload = self.histograms_payload(points, time_unix_nano)
+        if not payload["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]:
+            return True
+        return self._post("/v1/metrics", payload)
+
 
 def export_from_env(tracer: Any | None) -> None:
     """End-of-run hook: push to PATHWAY_TELEMETRY_SERVER and/or
     PATHWAY_MONITORING_SERVER when set. Idempotent per buffer state (the
-    hook sits at several run exits) and never raises."""
+    hook sits at several run exits) and never raises. Shares the
+    ``_otlp_mark`` cursor with the periodic flusher
+    (observability/exporter.py), so only the tail appended since the last
+    periodic push goes out here."""
     if tracer is None:
         return
     endpoints = [
@@ -183,11 +254,15 @@ def export_from_env(tracer: Any | None) -> None:
     eps = {e for e in endpoints if e}
     if not eps:
         return
-    if getattr(tracer, "_otlp_mark", None) == tracer._appended:
+    events, mark = tracer.events_since(getattr(tracer, "_otlp_mark", 0))
+    if not events:
         return
-    tracer._otlp_mark = tracer._appended
+    tracer._otlp_mark = mark
+    origin_unix_ns = time.time_ns() - (
+        time.perf_counter_ns() - tracer._origin
+    )
     for ep in eps:
         try:
-            OtlpExporter(ep).export(tracer)
+            OtlpExporter(ep).export_events(events, origin_unix_ns)
         except Exception:
             pass

@@ -153,6 +153,18 @@ class Tracer:
             }
         )
 
+    def events_since(self, mark: int) -> tuple[list[dict[str, Any]], int]:
+        """Events appended after the ``mark`` cursor (an ``_appended``
+        value), plus the new cursor — the incremental-export protocol used
+        by the periodic OTLP flusher (observability/exporter.py) and the
+        end-of-run push, which share one cursor so nothing double-exports.
+        Events already dropped by the ring buffer are simply gone."""
+        with self._lock:
+            new = self._appended - mark
+            if new <= 0:
+                return [], self._appended
+            return list(self._events[-new:]), self._appended
+
     # -- output -------------------------------------------------------
 
     def flush(self) -> str | None:

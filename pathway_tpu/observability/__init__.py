@@ -1,0 +1,44 @@
+"""End-to-end engine observability.
+
+The reference engine's telemetry pair — per-operator OTLP metrics
+(``src/engine/telemetry.rs:47-156``) and per-process metrics ports
+(``src/engine/http_server.rs:21-60``) — rebuilt as a subsystem:
+
+- :mod:`histogram` — lock-cheap log2-bucketed latency histograms;
+- :mod:`prometheus` — OpenMetrics exposition rendering (escaped labels,
+  histogram families) from JSON snapshots;
+- :mod:`hub` — per-process worker/comm registry + the cluster roll-up
+  process 0 serves as a merged per-worker-labeled ``/metrics``;
+- :mod:`health` — ``/healthz`` (executor not wedged) and ``/readyz``
+  (sources connected, first frontier advanced) probe semantics;
+- :mod:`exporter` — periodic OTLP/trace-file flusher so crashed runs
+  still leave telemetry.
+
+The HTTP surface itself lives in ``engine/http_server.py``; instrumented
+state in ``engine/executor.EngineStats``.
+"""
+
+from .exporter import PeriodicFlusher, start_periodic_flusher
+from .health import health_status, ready_status
+from .histogram import LogHistogram, merge_snapshots, quantile_from_snapshot
+from .hub import ObservabilityHub, stats_snapshot
+from .prometheus import (
+    escape_label_value,
+    parse_exposition,
+    render_snapshots,
+)
+
+__all__ = [
+    "LogHistogram",
+    "ObservabilityHub",
+    "PeriodicFlusher",
+    "escape_label_value",
+    "health_status",
+    "merge_snapshots",
+    "parse_exposition",
+    "quantile_from_snapshot",
+    "ready_status",
+    "render_snapshots",
+    "start_periodic_flusher",
+    "stats_snapshot",
+]

@@ -1,0 +1,246 @@
+"""ObservabilityHub — per-process registry + cluster-wide metrics roll-up.
+
+Re-design of the reference's ProberStats aggregation (``src/engine/
+graph.rs:521-563`` feeding per-process metrics ports,
+``src/engine/http_server.rs:21-60``): each process registers the
+``EngineStats`` of every worker it hosts plus its comm backend, and
+serves them at ``/metrics``. Under multi-process sharding
+(``parallel/cluster.py``), process 0 additionally scrapes every peer
+process's ``/snapshot`` endpoint (JSON, same host book as the TCP mesh,
+HTTP port ``base + process_id``) and serves the merged cluster view with
+per-worker labels — operators point one Prometheus target at process 0
+and see the whole fleet, including exchange-queue depth and frontier-lag
+backpressure gauges.
+
+The scrape direction (0 pulls peers) rather than push-over-collectives
+keeps telemetry off the data plane: a peer stuck in a collective still
+gets scraped, which is exactly when its frontier-lag gauge matters.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any
+
+from .health import health_status, ready_status
+
+__all__ = ["ObservabilityHub", "stats_snapshot"]
+
+_SCRAPE_TIMEOUT_S = 2.0
+
+
+def stats_snapshot(stats: Any, worker_id: int = 0) -> dict:
+    """JSON-serializable snapshot of one worker's EngineStats — the unit
+    shipped across processes and merged by process 0. Ages are computed
+    at snapshot time so remote clocks never mix."""
+    now = time.time()
+    snap = {
+        "worker": worker_id,
+        "ticks": stats.ticks,
+        "rows_total": stats.rows_total,
+        "input_rows": stats.input_rows,
+        "output_rows": stats.output_rows,
+        "latency_ms": stats.latency_ms,
+        "last_time": stats.last_time,
+        "uptime_s": now - stats.started_at,
+        "finished": stats.finished,
+        "heartbeat_age_s": now - stats.last_heartbeat,
+        "sources_connected": stats.sources_connected,
+        "rows_by_node": dict(stats.rows_by_node),
+        "exchange_rows_out": stats.exchange_rows_out,
+        "exchange_rows_in": stats.exchange_rows_in,
+        "exchange_batches": stats.exchange_batches,
+        "tick_duration": stats.tick_duration.snapshot(),
+        "latency_hist": stats.latency_hist.snapshot(),
+        "node_time_hist": {
+            label: h.snapshot()
+            for label, h in list(stats.node_time_hist.items())
+        },
+    }
+    if stats.latency_updated_at is not None:
+        snap["latency_age_s"] = max(0.0, now - stats.latency_updated_at)
+    return snap
+
+
+class ObservabilityHub:
+    def __init__(
+        self,
+        process_id: int = 0,
+        n_processes: int = 1,
+        peer_http: list[tuple[str, int]] | None = None,
+        wedge_timeout_s: float = 30.0,
+    ):
+        self.process_id = process_id
+        self.n_processes = n_processes
+        #: (host, port) of every OTHER process's metrics server — scraped
+        #: by process 0 for the merged view
+        self.peer_http = peer_http or []
+        self.wedge_timeout_s = wedge_timeout_s
+        self._workers: dict[int, Any] = {}
+        self._comms: list[Any] = []
+        self._lock = threading.Lock()
+        self.scrape_errors = 0
+
+    @classmethod
+    def from_config(cls, cfg: Any) -> "ObservabilityHub":
+        peers: list[tuple[str, int]] = []
+        base = cfg.monitoring_http_port
+        # base 0 = ephemeral ports — peers' actual ports are unknowable,
+        # so the roll-up degrades to local-only rather than scraping
+        # garbage targets
+        if cfg.processes > 1 and cfg.process_id == 0 and base:
+            hosts = (
+                [a.split(":")[0] if not a.startswith("[") else
+                 a[1:].partition("]")[0] for a in cfg.addresses]
+                if cfg.addresses
+                else ["127.0.0.1"] * cfg.processes
+            )
+            peers = [
+                (hosts[p], base + p)
+                for p in range(cfg.processes)
+                if p != cfg.process_id
+            ]
+            if (
+                any(h not in ("127.0.0.1", "localhost") for h, _ in peers)
+                and cfg.monitoring_http_host == "127.0.0.1"
+            ):
+                import warnings
+
+                warnings.warn(
+                    "cluster metrics roll-up: peers are on other hosts but "
+                    "their monitoring servers bind loopback by default — "
+                    "set PATHWAY_MONITORING_HTTP_HOST=0.0.0.0 on every "
+                    "process or process 0's merged /metrics will miss them",
+                    RuntimeWarning,
+                )
+        return cls(
+            process_id=cfg.process_id,
+            n_processes=cfg.processes,
+            peer_http=peers,
+            wedge_timeout_s=cfg.health_wedge_timeout_s,
+        )
+
+    # -- registration --------------------------------------------------
+
+    def register_worker(self, worker_id: int, stats: Any) -> None:
+        with self._lock:
+            self._workers[worker_id] = stats
+
+    def register_comm(self, comm: Any) -> None:
+        with self._lock:
+            self._comms.append(comm)
+
+    @property
+    def worker_stats(self) -> list[Any]:
+        with self._lock:
+            return [self._workers[w] for w in sorted(self._workers)]
+
+    # -- snapshots -----------------------------------------------------
+
+    def local_snapshots(self) -> list[dict]:
+        with self._lock:
+            items = sorted(self._workers.items())
+        return [stats_snapshot(s, w) for w, s in items]
+
+    def comm_snapshot(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        with self._lock:
+            comms = list(self._comms)
+        for comm in comms:
+            fn = getattr(comm, "comm_stats", None)
+            if fn is None:
+                continue
+            try:
+                for k, v in fn().items():
+                    out[k] = out.get(k, 0) + v
+            except Exception:
+                # telemetry must not fail the run it observes
+                pass
+        return out
+
+    def snapshot_document(self) -> dict:
+        """The /snapshot payload peers serve to process 0."""
+        return {
+            "process_id": self.process_id,
+            "workers": self.local_snapshots(),
+            "comm": self.comm_snapshot(),
+        }
+
+    def cluster_snapshots(self) -> tuple[list[dict], dict[str, dict]]:
+        """Local snapshots plus every reachable peer's; comm stats keyed
+        by process id. Peers are scraped concurrently so N hung peers cost
+        one timeout, not N (a partial outage is exactly when the merged
+        view must still answer inside Prometheus's scrape deadline);
+        unreachable peers count in ``scrape_errors`` and the view stays
+        partial rather than failing."""
+        snapshots = self.local_snapshots()
+        comm_stats = {str(self.process_id): self.comm_snapshot()}
+        results: list[dict | None] = [None] * len(self.peer_http)
+
+        def fetch(i: int, host: str, port: int) -> None:
+            results[i] = self._scrape_peer(host, port)
+
+        threads = [
+            threading.Thread(target=fetch, args=(i, h, p), daemon=True)
+            for i, (h, p) in enumerate(self.peer_http)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + _SCRAPE_TIMEOUT_S + 0.5
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        for doc in results:
+            if doc is None:
+                self.scrape_errors += 1
+                continue
+            snapshots.extend(doc.get("workers", []))
+            comm_stats[str(doc.get("process_id", "?"))] = doc.get("comm", {})
+        snapshots.sort(key=lambda s: s.get("worker", 0))
+        return snapshots, comm_stats
+
+    @staticmethod
+    def _scrape_peer(host: str, port: int) -> dict | None:
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/snapshot", timeout=_SCRAPE_TIMEOUT_S
+            ) as r:
+                return json.loads(r.read().decode())
+        except Exception:
+            return None
+
+    # -- rendering + probes --------------------------------------------
+
+    def render_metrics(self) -> str:
+        from .prometheus import render_snapshots
+
+        if self.peer_http:
+            snapshots, comm_stats = self.cluster_snapshots()
+        else:
+            snapshots = self.local_snapshots()
+            comm = self.comm_snapshot()
+            comm_stats = {str(self.process_id): comm} if comm else {}
+        # label by TOPOLOGY, not by how many snapshots this scrape got:
+        # in cluster mode a transient peer outage must not flip series
+        # between labeled and unlabeled (that forks Prometheus series and
+        # breaks rate() continuity)
+        cluster = (
+            self.n_processes > 1
+            or bool(self.peer_http)
+            or len(self._workers) > 1
+        )
+        return render_snapshots(
+            snapshots,
+            comm_stats,
+            scrape_errors=self.scrape_errors,
+            worker_labels=True if cluster else None,
+        )
+
+    def health(self) -> tuple[bool, dict]:
+        return health_status(self.worker_stats, self.wedge_timeout_s)
+
+    def ready(self) -> tuple[bool, dict]:
+        return ready_status(self.worker_stats)

@@ -1,0 +1,249 @@
+"""Prometheus/OpenMetrics exposition rendering for engine stats snapshots.
+
+One renderer serves every surface: the per-process ``/metrics`` endpoint
+(``engine/http_server.py``), the cluster-merged view on process 0, and
+the smoke-test validator (``scripts/obs_smoke.py``). Everything renders
+from plain snapshot dicts (``observability.hub.stats_snapshot``), never
+live objects, so remote workers' metrics — shipped as JSON over the
+cluster scrape — go through the identical code path as local ones.
+
+Label values are escaped per the OpenMetrics text format ABNF
+(``\\`` → ``\\\\``, ``"`` → ``\\"``, newline → ``\\n``); the seed emitted
+raw operator labels, which produced invalid exposition text for any
+operator name containing a quote or backslash.
+"""
+
+from __future__ import annotations
+
+from .histogram import N_BUCKETS
+
+__all__ = [
+    "escape_label_value",
+    "format_labels",
+    "render_histogram",
+    "render_snapshots",
+    "parse_exposition",
+]
+
+
+def escape_label_value(v: str) -> str:
+    """OpenMetrics label-value escaping (backslash first, then quote/NL)."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def format_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in labels.items()
+    )
+    return "{" + inner + "}"
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, float):
+        # integral floats (byte/frame counters cast through comm_stats)
+        # render exactly — %.6g would quantize past ~1e6 and make
+        # Prometheus increase() read 0-then-jump; non-integral values get
+        # 9 significant digits (sub-ms resolution on week-long uptimes)
+        if v.is_integer() and abs(v) < 1e15:
+            return str(int(v))
+        return f"{v:.9g}"
+    return str(v)
+
+
+class _Renderer:
+    """Accumulates families so each gets exactly one # TYPE line."""
+
+    def __init__(self) -> None:
+        self.lines: list[str] = []
+        self._typed: set[str] = set()
+
+    def add(self, name: str, mtype: str, value, labels: dict | None = None):
+        if name not in self._typed:
+            self._typed.add(name)
+            self.lines.append(f"# TYPE {name} {mtype}")
+        self.lines.append(f"{name}{format_labels(labels or {})} {_fmt(value)}")
+
+    def text(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def render_histogram(
+    r: _Renderer, name: str, snap: dict, labels: dict[str, str]
+) -> None:
+    """Render one log-bucketed snapshot as a Prometheus histogram family
+    (``_bucket``/``_sum``/``_count``), bounds in seconds.
+
+    Only the occupied bucket range renders (cumulative counts stay
+    monotone regardless), keeping series cardinality ~10 per histogram
+    instead of 64."""
+    counts = snap["counts"]
+    nonzero = [i for i, c in enumerate(counts) if c]
+    if name not in r._typed:
+        r._typed.add(name)
+        r.lines.append(f"# TYPE {name} histogram")
+    cum = 0
+    if nonzero:
+        lo, hi = nonzero[0], min(nonzero[-1] + 1, N_BUCKETS - 1)
+        cum = sum(counts[:lo])
+        for i in range(lo, hi + 1):
+            cum += counts[i]
+            le = (1 << i) / 1e9  # bucket i upper bound: 2^i ns, in seconds
+            ls = format_labels({**labels, "le": f"{le:.9g}"})
+            r.lines.append(f"{name}_bucket{ls} {cum}")
+    ls_inf = format_labels({**labels, "le": "+Inf"})
+    r.lines.append(f"{name}_bucket{ls_inf} {snap['count']}")
+    r.lines.append(
+        f"{name}_sum{format_labels(labels)} {snap['sum'] / 1e9:.9g}"
+    )
+    r.lines.append(f"{name}_count{format_labels(labels)} {snap['count']}")
+
+
+def render_snapshots(
+    snapshots: list[dict],
+    comm_stats: dict[str, dict[str, float]] | None = None,
+    scrape_errors: int = 0,
+    worker_labels: bool | None = None,
+) -> str:
+    """Exposition text for a set of worker stats snapshots.
+
+    ``worker_labels=None`` (auto) omits the ``worker`` label for a single
+    snapshot (the seed's single-process format, relied on by existing
+    scrapers) and labels every series ``worker="N"`` for several — the
+    cluster-merged view. Cluster callers pass an explicit ``True`` so
+    series identity is stable even when a peer scrape transiently fails.
+    ``comm_stats`` maps a process label to that process's comm-backend
+    gauges (exchange queue depth etc.).
+    """
+    r = _Renderer()
+    labeled = (
+        worker_labels if worker_labels is not None else len(snapshots) > 1
+    )
+    max_last_time = max((s.get("last_time", 0) for s in snapshots), default=0)
+    for s in snapshots:
+        lab = {"worker": str(s.get("worker", 0))} if labeled else {}
+        r.add("pathway_engine_ticks", "counter", s["ticks"], lab)
+        r.add("pathway_engine_rows_total", "counter", s["rows_total"], lab)
+        r.add("pathway_input_rows", "counter", s["input_rows"], lab)
+        r.add("pathway_output_rows", "counter", s["output_rows"], lab)
+        r.add("pathway_uptime_seconds", "gauge", s["uptime_s"], lab)
+        if s.get("latency_ms") is not None:
+            r.add("pathway_output_latency_ms", "gauge", s["latency_ms"], lab)
+            # staleness companion: the latency gauge freezes at the last
+            # commit's value; its age tells "fast" from "stalled"
+            r.add(
+                "pathway_output_latency_age_seconds",
+                "gauge",
+                s.get("latency_age_s", 0.0),
+                lab,
+            )
+        if labeled:
+            # frontier lag vs the most advanced worker: a worker whose
+            # logical time trails its peers is the backpressured one
+            r.add(
+                "pathway_frontier_lag_ms",
+                "gauge",
+                max(0, max_last_time - s.get("last_time", 0)),
+                lab,
+            )
+        r.add(
+            "pathway_exchange_rows_total", "counter",
+            s.get("exchange_rows_out", 0), {**lab, "direction": "out"},
+        )
+        r.add(
+            "pathway_exchange_rows_total", "counter",
+            s.get("exchange_rows_in", 0), {**lab, "direction": "in"},
+        )
+        r.add(
+            "pathway_exchange_batches_total", "counter",
+            s.get("exchange_batches", 0), lab,
+        )
+        for op, count in sorted(s.get("rows_by_node", {}).items()):
+            r.add(
+                "pathway_operator_rows_total", "counter", count,
+                {**lab, "operator": op},
+            )
+        if s.get("tick_duration"):
+            render_histogram(r, "pathway_tick_duration_seconds",
+                             s["tick_duration"], lab)
+        if s.get("latency_hist") and s["latency_hist"]["count"]:
+            render_histogram(r, "pathway_output_latency_seconds",
+                             s["latency_hist"], lab)
+        for op, hsnap in sorted(s.get("node_time_hist", {}).items()):
+            render_histogram(
+                r, "pathway_operator_processing_seconds", hsnap,
+                {**lab, "operator": op},
+            )
+    for proc, gauges in sorted((comm_stats or {}).items()):
+        plab = {"process": str(proc)}
+        for key, value in sorted(gauges.items()):
+            r.add(f"pathway_comm_{key}", "gauge", value, plab)
+    r.add("pathway_cluster_workers", "gauge", len(snapshots))
+    if scrape_errors:
+        r.add("pathway_cluster_scrape_errors", "counter", scrape_errors)
+    return r.text()
+
+
+def parse_exposition(text: str) -> dict[tuple[str, tuple], float]:
+    """Minimal exposition-text parser for validation (obs_smoke + tests):
+    returns {(metric_name, sorted label items): value}. Raises ValueError
+    on malformed lines — the smoke test's correctness check."""
+    out: dict[tuple[str, tuple], float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name_part, _, value_part = line.rpartition(" ")
+        if not name_part:
+            raise ValueError(f"malformed exposition line: {line!r}")
+        labels: dict[str, str] = {}
+        name = name_part
+        if "{" in name_part:
+            if not name_part.endswith("}"):
+                raise ValueError(f"malformed labels in line: {line!r}")
+            name, _, rest = name_part.partition("{")
+            body = rest[:-1]
+            try:
+                _parse_label_body(body, labels)
+            except (IndexError, ValueError) as e:
+                raise ValueError(
+                    f"malformed labels in line: {line!r}"
+                ) from e
+        try:
+            value = float(value_part)
+        except ValueError:
+            raise ValueError(f"non-numeric sample value: {line!r}") from None
+        out[(name, tuple(sorted(labels.items())))] = value
+    return out
+
+
+def _parse_label_body(body: str, labels: dict[str, str]) -> None:
+    i = 0
+    while i < len(body):
+        eq = body.index("=", i)
+        key = body[i:eq]
+        if body[eq + 1] != '"':
+            raise ValueError("unquoted label value")
+        j = eq + 2
+        val: list[str] = []
+        while True:
+            c = body[j]
+            if c == "\\":
+                nxt = body[j + 1]
+                val.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, nxt))
+                j += 2
+            elif c == '"':
+                j += 1
+                break
+            else:
+                val.append(c)
+                j += 1
+        labels[key] = "".join(val)
+        if j < len(body) and body[j] == ",":
+            j += 1
+        i = j

@@ -68,6 +68,11 @@ class ClusterComm(Comm):
         self._readers: list[threading.Thread] = []
         self._listener: socket.socket | None = None
         self._closing = False
+        # observability counters (GIL-cheap, read by comm_stats)
+        self.bytes_sent = 0
+        self.frames_sent = 0
+        self.bytes_received = 0
+        self.frames_received = 0
         self._connect_mesh()
 
     # -- mesh setup ------------------------------------------------------
@@ -137,7 +142,10 @@ class ClusterComm(Comm):
         try:
             while True:
                 header = _recv_exact(sock, 8)
-                frame = pickle.loads(_recv_exact(sock, _LEN.unpack(header)[0]))
+                n_body = _LEN.unpack(header)[0]
+                frame = pickle.loads(_recv_exact(sock, n_body))
+                self.bytes_received += 8 + n_body
+                self.frames_received += 1
                 if frame[0] == "bye":
                     # graceful: the peer finished its dataflow (all its
                     # collectives, incl. the END_TIME sweep, completed) and
@@ -166,6 +174,8 @@ class ClusterComm(Comm):
         with self._send_locks[peer]:
             try:
                 self._socks[peer].sendall(_LEN.pack(len(blob)) + blob)
+                self.bytes_sent += 8 + len(blob)
+                self.frames_sent += 1
             except OSError:
                 if not self._closing:
                     self._break(f"send to process {peer} failed")
@@ -248,6 +258,18 @@ class ClusterComm(Comm):
                         f"cluster collective timed out waiting on {key!r}"
                     )
                 self._cond.wait(timeout=min(remaining, 1.0))
+
+    def comm_stats(self) -> dict[str, float]:
+        # inbox depth = frames delivered by peers but not yet consumed by
+        # a local worker's collective — the exchange-queue backpressure
+        # signal (a worker falling behind lets its inbox grow)
+        return {
+            "cluster_bytes_sent": float(self.bytes_sent),
+            "cluster_frames_sent": float(self.frames_sent),
+            "cluster_bytes_received": float(self.bytes_received),
+            "cluster_frames_received": float(self.frames_received),
+            "cluster_inbox_depth": float(len(self._inbox)),
+        }
 
     def _break(self, reason: str) -> None:
         with self._cond:

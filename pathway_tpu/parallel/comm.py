@@ -58,6 +58,12 @@ class Comm:
     def close(self) -> None:
         pass
 
+    def comm_stats(self) -> dict[str, float]:
+        """Backpressure/throughput gauges for the /metrics endpoint
+        (rendered as ``pathway_comm_<key>``). Best-effort reads of live
+        structures — no locks the data plane would contend on."""
+        return {}
+
 
 class LocalComm(Comm):
     """In-process comm for worker threads (timely ``thread`` allocator)."""
@@ -106,6 +112,11 @@ class LocalComm(Comm):
 
     def barrier(self, worker_id: int):
         self._barrier.wait()
+
+    def comm_stats(self) -> dict[str, float]:
+        # slots outstanding = collectives some worker entered but not all
+        # left — a sustained nonzero depth means a straggler worker
+        return {"pending_collectives": float(len(self._slots))}
 
 
 class WorkerContext:

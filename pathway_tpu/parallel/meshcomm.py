@@ -97,6 +97,12 @@ class MeshComm(Comm):
             self._slots.clear()
         self.inner.close()
 
+    def comm_stats(self) -> dict[str, float]:
+        out = dict(self.inner.comm_stats())
+        out["mesh_pending_slots"] = float(len(self._slots))
+        out.update(self.runner.stats())
+        return out
+
     # the ICI data plane
 
     def exchange_deltas(
@@ -277,6 +283,12 @@ class MultiHostMeshComm(Comm):
             self._slots.clear()
         self.inner.close()
 
+    def comm_stats(self) -> dict[str, float]:
+        out = dict(self.inner.comm_stats())
+        out["mesh_pending_slots"] = float(len(self._slots))
+        out.update(self.runner.stats())
+        return out
+
     def _local_index(self, worker_id: int) -> int:
         return worker_id - self.process_id * self.threads
 
@@ -332,6 +344,18 @@ class MultiHostMeshComm(Comm):
                         del self._slots[k]
                     slot = self._slots[key]
                 try:
+                    if total:
+                        # count only THIS process's deposited rows — every
+                        # leader runs this block, so recording the global
+                        # total would inflate the fleet sum n_processes×
+                        local_rows = sum(
+                            sum(metas[w][1])
+                            for w in range(
+                                self.process_id * self.threads,
+                                (self.process_id + 1) * self.threads,
+                            )
+                        )
+                        self.runner.note_collective(local_rows)
                     slot["result"] = (
                         self._run_collective(
                             slot["payloads"], column_names, kinds,

@@ -1,0 +1,170 @@
+"""Observability smoke test: two-worker pipeline, live scrape, validation.
+
+Runs a tiny two-worker (``PATHWAY_THREADS=2``) streaming pipeline with the
+monitoring HTTP server on, scrapes the merged ``/metrics`` endpoint and
+the per-worker ``/snapshot`` document while the engine is live, and
+validates:
+
+- the exposition text parses (labels quoted/escaped, numeric samples);
+- every histogram family's ``_bucket`` series is cumulative-monotone in
+  ``le`` and consistent with its ``_count``;
+- both workers appear with distinct ``worker`` labels;
+- ``/healthz`` and ``/readyz`` report 200 in steady state.
+
+Usable standalone (``python scripts/obs_smoke.py`` → exit 0/1) and as a
+tier-1 test (``tests/test_obs_smoke.py`` imports :func:`run_smoke`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def validate_exposition(body: str) -> dict:
+    """Parse exposition text and check histogram invariants; returns the
+    parsed series dict. Raises AssertionError/ValueError on violation."""
+    from pathway_tpu.observability.prometheus import parse_exposition
+
+    series = parse_exposition(body)
+    # group histogram buckets: (family, non-le labels) -> [(le, count)]
+    buckets: dict[tuple, list[tuple[float, float]]] = {}
+    for (name, labels), value in series.items():
+        if not name.endswith("_bucket"):
+            continue
+        ldict = dict(labels)
+        le = ldict.pop("le")
+        le_v = float("inf") if le == "+Inf" else float(le)
+        key = (name[: -len("_bucket")], tuple(sorted(ldict.items())))
+        buckets.setdefault(key, []).append((le_v, value))
+    assert buckets, "no histogram series found in exposition"
+    for (family, labels), pts in buckets.items():
+        pts.sort()
+        counts = [c for _, c in pts]
+        assert counts == sorted(counts), (
+            f"{family}{dict(labels)}: bucket counts not monotone: {counts}"
+        )
+        assert pts[-1][0] == float("inf"), f"{family}: missing +Inf bucket"
+        total = series.get((family + "_count", labels))
+        assert total is not None and total == pts[-1][1], (
+            f"{family}: _count {total} != +Inf bucket {pts[-1][1]}"
+        )
+    return series
+
+
+def run_smoke(n_rows: int = 8, verbose: bool = False) -> dict:
+    """Run the pipeline + scrape; returns {"metrics", "snapshot",
+    "healthz", "readyz"}. Raises on any validation failure."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+
+    port = _free_port()
+    saved = {
+        k: os.environ.get(k)
+        for k in ("PATHWAY_THREADS", "PATHWAY_MONITORING_HTTP_PORT")
+    }
+    os.environ["PATHWAY_THREADS"] = "2"
+    os.environ["PATHWAY_MONITORING_HTTP_PORT"] = str(port)
+    G.clear()
+    release = threading.Event()
+    seen = threading.Event()
+    scraped: dict = {}
+    errors: list[BaseException] = []
+
+    class Source(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(n_rows):
+                self.next(x=i)
+                self.commit()
+            release.wait(timeout=20)
+
+    try:
+        t = pw.io.python.read(Source(), schema=pw.schema_from_types(x=int))
+        counts = t.groupby(pw.this.x % 3).reduce(
+            s=pw.reducers.sum(pw.this.x), n=pw.reducers.count()
+        )
+        pw.io.subscribe(counts, on_change=lambda **kw: seen.set())
+
+        def scrape() -> None:
+            try:
+                assert seen.wait(timeout=30), "pipeline produced no output"
+                time.sleep(0.3)  # let a few more ticks land
+                base = f"http://127.0.0.1:{port}"
+                for ep in ("/metrics", "/snapshot", "/healthz", "/readyz"):
+                    with urllib.request.urlopen(base + ep, timeout=5) as r:
+                        scraped[ep] = (r.status, r.read().decode())
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+            finally:
+                release.set()
+                pw.request_stop()
+
+        th = threading.Thread(target=scrape, daemon=True)
+        th.start()
+        pw.run(with_http_server=True)
+        th.join(timeout=30)
+    finally:
+        G.clear()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if errors:
+        raise errors[0]
+
+    status, body = scraped["/metrics"]
+    assert status == 200
+    series = validate_exposition(body)
+    workers = {
+        dict(labels).get("worker")
+        for (name, labels) in series
+        if name == "pathway_engine_ticks"
+    }
+    assert workers == {"0", "1"}, f"expected 2 workers, saw {workers}"
+
+    snap = json.loads(scraped["/snapshot"][1])
+    snap_workers = {w["worker"] for w in snap["workers"]}
+    assert snap_workers == {0, 1}, snap_workers
+    for w in snap["workers"]:
+        assert w["ticks"] > 0 and w["tick_duration"]["count"] > 0
+
+    assert scraped["/healthz"][0] == 200, scraped["/healthz"]
+    assert scraped["/readyz"][0] == 200, scraped["/readyz"]
+    if verbose:
+        print(f"scraped {len(series)} series from {len(snap_workers)} workers")
+    return {
+        "metrics": body,
+        "snapshot": snap,
+        "healthz": scraped["/healthz"],
+        "readyz": scraped["/readyz"],
+    }
+
+
+def main() -> int:
+    try:
+        run_smoke(verbose=True)
+    except BaseException as e:  # noqa: BLE001 — CLI exit-code surface
+        print(f"obs_smoke FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    print("obs_smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

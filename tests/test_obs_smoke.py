@@ -1,0 +1,40 @@
+"""Tier-1 wiring for scripts/obs_smoke.py: a two-worker pipeline is run
+live, its merged /metrics endpoint scraped and validated (exposition
+parses, histogram buckets monotone, both workers labeled, probes 200)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts")
+)
+
+from obs_smoke import run_smoke, validate_exposition  # noqa: E402
+
+
+def test_obs_smoke_two_workers():
+    result = run_smoke()
+    assert "pathway_tick_duration_seconds_bucket" in result["metrics"]
+    assert "pathway_frontier_lag_ms" in result["metrics"]
+
+
+def test_validate_exposition_rejects_broken_histogram():
+    bad = (
+        "# TYPE h histogram\n"
+        'h_bucket{le="0.001"} 5\n'
+        'h_bucket{le="0.002"} 3\n'  # not monotone
+        'h_bucket{le="+Inf"} 5\n'
+        "h_sum 1\n"
+        "h_count 5\n"
+    )
+    with pytest.raises(AssertionError, match="not monotone"):
+        validate_exposition(bad)
+
+
+def test_validate_exposition_rejects_malformed_text():
+    with pytest.raises(ValueError):
+        validate_exposition('# TYPE x counter\nx{operator="unclosed} 1\n')

@@ -1,0 +1,451 @@
+"""End-to-end engine observability (pathway_tpu/observability/): log-bucket
+histograms, OpenMetrics rendering + label escaping, /healthz and /readyz
+probe semantics (startup → steady state → wedged fault), cluster roll-up,
+latency-staleness companion gauge, and the periodic OTLP flusher.
+
+Reference being reproduced: the engine telemetry pair
+(src/engine/telemetry.rs:47-156, src/engine/http_server.rs:21-60)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.executor import EngineStats
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.observability import (
+    LogHistogram,
+    ObservabilityHub,
+    health_status,
+    merge_snapshots,
+    parse_exposition,
+    quantile_from_snapshot,
+    ready_status,
+    render_snapshots,
+    stats_snapshot,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _get(url: str):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# -- histogram primitive -----------------------------------------------------
+
+
+def test_histogram_observe_and_quantiles():
+    h = LogHistogram()
+    for v in [100, 200, 400, 800, 100_000, 1_000_000]:
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 6
+    assert snap["sum"] == 100 + 200 + 400 + 800 + 100_000 + 1_000_000
+    # p50 lands in the low-hundreds bucket, p99 near the max bucket
+    assert h.quantile(0.5) < 1000
+    assert h.quantile(0.99) > 500_000
+    pcts = h.percentiles()
+    assert pcts["p50"] <= pcts["p95"] <= pcts["p99"]
+
+
+def test_histogram_merge_is_exact():
+    a, b = LogHistogram(), LogHistogram()
+    for v in [10, 20, 30]:
+        a.observe(v)
+    for v in [40, 50]:
+        b.observe(v)
+    merged = merge_snapshots([a.snapshot(), b.snapshot()])
+    assert merged["count"] == 5
+    assert merged["sum"] == 150
+    one = LogHistogram()
+    for v in [10, 20, 30, 40, 50]:
+        one.observe(v)
+    assert merged["counts"] == one.snapshot()["counts"]
+
+
+def test_histogram_edge_values():
+    h = LogHistogram()
+    h.observe(0)
+    h.observe(-5)  # clamped, not a crash
+    h.observe(1 << 100)  # clamped into the top bucket
+    snap = h.snapshot()
+    assert snap["count"] == 3
+    assert quantile_from_snapshot(snap, 0.01) == 0.0
+
+
+# -- exposition rendering ----------------------------------------------------
+
+
+def _stats_with_activity() -> EngineStats:
+    s = EngineStats()
+    s.ticks = 4
+    s.input_rows = 10
+    s.output_rows = 7
+    s.rows_total = 17
+    s.rows_by_node = {'Rowwise#3': 10}
+    s.tick_duration.observe(2_000_000)
+    s.tick_duration.observe(4_000_000)
+    return s
+
+
+def test_label_escaping_openmetrics():
+    s = _stats_with_activity()
+    s.rows_by_node = {'Op"quote\\back\nline#9': 3}
+    from pathway_tpu.engine.http_server import _render_metrics
+
+    body = _render_metrics(s)
+    assert 'operator="Op\\"quote\\\\back\\nline#9"' in body
+    # round-trips through the parser back to the original label
+    series = parse_exposition(body)
+    ops = {
+        dict(labels).get("operator")
+        for (name, labels) in series
+        if name == "pathway_operator_rows_total"
+    }
+    assert ops == {'Op"quote\\back\nline#9'}
+
+
+def test_single_worker_renders_unlabeled():
+    # the seed's single-process format: existing scrapers match
+    # bare `pathway_input_rows N`
+    body = render_snapshots([stats_snapshot(_stats_with_activity())])
+    assert "pathway_input_rows 10" in body
+    assert 'worker="' not in body
+
+
+def test_multi_worker_renders_labels_and_frontier_lag():
+    a, b = _stats_with_activity(), _stats_with_activity()
+    a.last_time = 5000
+    b.last_time = 2000
+    body = render_snapshots(
+        [stats_snapshot(a, 0), stats_snapshot(b, 1)],
+        comm_stats={"0": {"cluster_inbox_depth": 2.0}},
+    )
+    series = parse_exposition(body)
+    assert series[("pathway_frontier_lag_ms", (("worker", "0"),))] == 0
+    assert series[("pathway_frontier_lag_ms", (("worker", "1"),))] == 3000
+    assert series[
+        ("pathway_comm_cluster_inbox_depth", (("process", "0"),))
+    ] == 2.0
+    assert series[("pathway_cluster_workers", ())] == 2
+
+
+def test_histogram_rendering_monotone_and_consistent():
+    body = render_snapshots([stats_snapshot(_stats_with_activity())])
+    series = parse_exposition(body)
+    pts = sorted(
+        (float("inf") if dict(l)["le"] == "+Inf" else float(dict(l)["le"]), v)
+        for (n, l) in series
+        if n == "pathway_tick_duration_seconds_bucket"
+        for v in [series[(n, l)]]
+    )
+    counts = [v for _, v in pts]
+    assert counts == sorted(counts)
+    assert pts[-1][1] == series[("pathway_tick_duration_seconds_count", ())]
+    assert series[("pathway_tick_duration_seconds_sum", ())] == pytest.approx(
+        0.006
+    )
+
+
+# -- latency staleness companion ---------------------------------------------
+
+
+def test_latency_age_gauge_tracks_staleness():
+    s = EngineStats()
+    wall_ms = int(time.time() * 1000)
+    s.note_tick(wall_ms + 2)  # wall-clock commit → latency gauge updates
+    assert s.latency_ms is not None
+    s.latency_updated_at -= 7.5  # simulate 7.5s with no further commits
+    snap = stats_snapshot(s)
+    assert snap["latency_age_s"] == pytest.approx(7.5, abs=0.5)
+    body = render_snapshots([snap])
+    series = parse_exposition(body)
+    assert series[
+        ("pathway_output_latency_age_seconds", ())
+    ] == pytest.approx(7.5, abs=0.5)
+    # histogram companion recorded the commit latency too
+    assert snap["latency_hist"]["count"] == 1
+
+
+# -- probe semantics ---------------------------------------------------------
+
+
+def test_probe_lifecycle_startup_steady_wedged():
+    s = EngineStats()
+    # startup: sources not yet connected, no ticks
+    ok, detail = ready_status([s])
+    assert not ok and "sources not connected" in detail["reasons"]
+    s.sources_connected = True
+    ok, detail = ready_status([s])
+    assert not ok and "first frontier not advanced" in detail["reasons"]
+    assert health_status([s], wedge_timeout_s=30)[0]  # alive while starting
+    # steady state
+    s.note_tick(10)
+    assert ready_status([s])[0]
+    assert health_status([s], wedge_timeout_s=30)[0]
+    # wedged fault: heartbeat goes stale while unfinished
+    s.last_heartbeat -= 120
+    ok, detail = health_status([s], wedge_timeout_s=30)
+    assert not ok and detail["status"] == "wedged"
+    # a finished run can never be wedged
+    s.finished = True
+    assert health_status([s], wedge_timeout_s=30)[0]
+
+
+def test_probe_endpoints_serve_status_codes():
+    from pathway_tpu.engine.http_server import start_http_server
+
+    s = EngineStats()
+    hub = ObservabilityHub(wedge_timeout_s=30)
+    hub.register_worker(0, s)
+    server, _ = start_http_server(hub, port=0)
+    port = server.server_address[1]
+    try:
+        assert _get(f"http://127.0.0.1:{port}/healthz")[0] == 200
+        code, body = _get(f"http://127.0.0.1:{port}/readyz")
+        assert code == 503 and "starting" in body
+        s.sources_connected = True
+        s.note_tick(3)
+        assert _get(f"http://127.0.0.1:{port}/readyz")[0] == 200
+        # inject the wedge fault
+        s.last_heartbeat -= 300
+        code, body = _get(f"http://127.0.0.1:{port}/healthz")
+        assert code == 503 and "wedged" in body
+        # /snapshot serves the raw JSON document
+        code, body = _get(f"http://127.0.0.1:{port}/snapshot")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["workers"][0]["ticks"] == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_probes_through_live_streaming_run(monkeypatch):
+    """startup → steady state → wedged-executor fault against a real
+    engine run. The wedge is genuine: a subscriber callback blocks inside
+    a tick, so the executor thread stops heartbeating mid-sweep and
+    /healthz must flip to 503 once the (shortened) wedge timeout lapses,
+    then recover when the callback unblocks."""
+    release = threading.Event()
+    seen = threading.Event()
+    go_poison = threading.Event()
+    wedge = threading.Event()  # set → next on_change blocks
+    unwedge = threading.Event()
+    results: dict = {}
+
+    class S(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(3):
+                self.next(x=i)
+                self.commit()
+            go_poison.wait(timeout=20)
+            self.next(x=100)
+            self.commit()
+            release.wait(timeout=20)
+
+    t = pw.io.python.read(S(), schema=pw.schema_from_types(x=int))
+    out = t.reduce(s=pw.reducers.sum(pw.this.x))
+
+    def on_change(**kw):
+        seen.set()
+        if wedge.is_set():
+            unwedge.wait(timeout=20)  # executor thread blocked mid-tick
+
+    pw.io.subscribe(out, on_change=on_change)
+
+    from pathway_tpu.internals.run import _current
+
+    def probe():
+        try:
+            assert seen.wait(timeout=15)
+            time.sleep(0.2)
+            server = _current["runner"]._http_server_for_tests
+            port = server.server_address[1]
+            results["readyz"] = _get(f"http://127.0.0.1:{port}/readyz")
+            results["healthz"] = _get(f"http://127.0.0.1:{port}/healthz")
+            results["metrics"] = _get(f"http://127.0.0.1:{port}/metrics")
+            # inject the wedge: the poison row's callback blocks the
+            # executor inside its tick, past the 0.5s wedge timeout
+            wedge.set()
+            go_poison.set()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                results["wedged"] = _get(f"http://127.0.0.1:{port}/healthz")
+                if results["wedged"][0] == 503:
+                    break
+                time.sleep(0.2)
+            wedge.clear()
+            unwedge.set()
+            time.sleep(0.3)  # executor resumes heartbeating
+            results["recovered"] = _get(f"http://127.0.0.1:{port}/healthz")
+        finally:
+            release.set()
+            unwedge.set()
+            pw.request_stop()
+
+    th = threading.Thread(target=probe, daemon=True)
+    th.start()
+
+    monkeypatch.setenv("PATHWAY_MONITORING_HTTP_PORT", "0")  # ephemeral
+    monkeypatch.setenv("PATHWAY_HEALTH_WEDGE_S", "0.5")
+    pw.run(with_http_server=True)
+    th.join(timeout=30)
+    assert results["readyz"][0] == 200
+    assert results["healthz"][0] == 200
+    assert results["wedged"][0] == 503, results["wedged"]
+    assert results["recovered"][0] == 200, results["recovered"]
+    series = parse_exposition(results["metrics"][1])
+    assert series[("pathway_input_rows", ())] == 3
+    assert any(
+        n == "pathway_tick_duration_seconds_bucket" for n, _ in series
+    )
+
+
+# -- cluster roll-up ---------------------------------------------------------
+
+
+def test_cluster_rollup_scrapes_peer_processes():
+    """Two hubs simulate two processes: process 1 serves /snapshot,
+    process 0 scrapes it and renders the merged per-worker view."""
+    from pathway_tpu.engine.http_server import start_http_server
+
+    peer_stats = _stats_with_activity()
+    peer_hub = ObservabilityHub(process_id=1, n_processes=2)
+    peer_hub.register_worker(1, peer_stats)
+    peer_server, _ = start_http_server(peer_hub, port=0)
+    peer_port = peer_server.server_address[1]
+    try:
+        hub0 = ObservabilityHub(
+            process_id=0,
+            n_processes=2,
+            peer_http=[("127.0.0.1", peer_port)],
+        )
+        hub0.register_worker(0, _stats_with_activity())
+        body = hub0.render_metrics()
+        series = parse_exposition(body)
+        workers = {
+            dict(l)["worker"]
+            for (n, l) in series
+            if n == "pathway_engine_ticks"
+        }
+        assert workers == {"0", "1"}
+        assert series[("pathway_cluster_workers", ())] == 2
+        # remote worker's histogram merged in with its label
+        assert any(
+            n == "pathway_tick_duration_seconds_bucket"
+            and dict(l).get("worker") == "1"
+            for (n, l) in series
+        )
+    finally:
+        peer_server.shutdown()
+        peer_server.server_close()
+
+
+def test_cluster_rollup_tolerates_dead_peer():
+    hub0 = ObservabilityHub(
+        process_id=0, n_processes=2, peer_http=[("127.0.0.1", 1)]
+    )
+    hub0.register_worker(0, _stats_with_activity())
+    body = hub0.render_metrics()  # must not raise
+    series = parse_exposition(body)
+    assert series[("pathway_cluster_scrape_errors", ())] >= 1
+    assert series[("pathway_cluster_workers", ())] == 1
+
+
+def test_sharded_threads_run_serves_merged_metrics():
+    """A real PATHWAY_THREADS=2 run: /metrics carries worker=0 and
+    worker=1 series including exchange backpressure counters."""
+    import os
+
+    release = threading.Event()
+    seen = threading.Event()
+    results: dict = {}
+
+    class S(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(6):
+                self.next(x=i)
+                self.commit()
+            release.wait(timeout=15)
+
+    t = pw.io.python.read(S(), schema=pw.schema_from_types(x=int))
+    # groupby forces Exchange nodes between the workers
+    out = t.groupby(pw.this.x % 2).reduce(s=pw.reducers.sum(pw.this.x))
+    pw.io.subscribe(out, on_change=lambda **kw: seen.set())
+
+    port = 29137
+    saved = {
+        k: os.environ.get(k)
+        for k in ("PATHWAY_THREADS", "PATHWAY_MONITORING_HTTP_PORT")
+    }
+    os.environ["PATHWAY_THREADS"] = "2"
+    os.environ["PATHWAY_MONITORING_HTTP_PORT"] = str(port)
+
+    def scrape():
+        try:
+            assert seen.wait(timeout=15)
+            time.sleep(0.3)
+            results["metrics"] = _get(f"http://127.0.0.1:{port}/metrics")
+            results["readyz"] = _get(f"http://127.0.0.1:{port}/readyz")
+        finally:
+            release.set()
+            pw.request_stop()
+
+    th = threading.Thread(target=scrape, daemon=True)
+    th.start()
+    try:
+        pw.run(with_http_server=True)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    th.join(timeout=15)
+    series = parse_exposition(results["metrics"][1])
+    workers = {
+        dict(l)["worker"] for (n, l) in series if n == "pathway_engine_ticks"
+    }
+    assert workers == {"0", "1"}
+    assert results["readyz"][0] == 200
+    exch = [
+        v for (n, l), v in series.items()
+        if n == "pathway_exchange_batches_total"
+    ]
+    assert exch and all(v > 0 for v in exch)
+
+
+# -- dashboard NONE regression ------------------------------------------------
+
+
+def test_dashboard_none_level_is_noop(monkeypatch):
+    import pathway_tpu.internals.monitoring as mon
+
+    spawned = []
+    monkeypatch.setattr(
+        mon.threading,
+        "Thread",
+        lambda *a, **kw: spawned.append(1) or (_ for _ in ()).throw(
+            AssertionError("NONE must not spawn a refresh thread")
+        ),
+    )
+    stop = mon.start_dashboard(EngineStats(), mon.MonitoringLevel.NONE)
+    stop()  # no-op stop returned immediately
+    assert spawned == []
