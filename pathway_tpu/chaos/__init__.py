@@ -1,0 +1,32 @@
+"""``pathway_tpu.chaos`` — deterministic fault injection.
+
+The robustness analog of ``observability/``: declarative, seeded fault
+plans (``plan.py``) armed into engine injection sites (``injector.py``)
+across the executor tick loop, the comm backends and the persistence
+backends. Paired with ``pathway-tpu spawn --supervise``
+(``parallel/supervisor.py``) it turns "SIGKILL worker 1 at tick 6 and
+recover exactly" into a one-line JSON plan — the reference's wordcount
+``run_pw_program_suddenly_terminate`` harness, made reproducible.
+"""
+
+from .injector import (
+    ActiveFaults,
+    ChaosInjected,
+    arm,
+    current,
+    disarm,
+    wrap_backend,
+)
+from .plan import Fault, FaultPlan, load_plan_from_env
+
+__all__ = [
+    "ActiveFaults",
+    "ChaosInjected",
+    "Fault",
+    "FaultPlan",
+    "arm",
+    "current",
+    "disarm",
+    "load_plan_from_env",
+    "wrap_backend",
+]

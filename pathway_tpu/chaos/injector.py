@@ -1,0 +1,294 @@
+"""Runtime side of fault injection: arming plans and firing sites.
+
+The injector is armed once per process — explicitly (``arm(plan)``) or
+from ``PATHWAY_FAULT_PLAN`` at engine-construction time (``current()``).
+Every injection site in the engine is guarded so an unarmed process pays
+exactly one attribute/None check per site visit:
+
+- the executor holds ``self._tick_fault`` (None unless a tick fault
+  targets its worker);
+- each comm backend holds ``self._chaos`` (None unless frame faults
+  target it);
+- ``wrap_backend`` returns the backend object *itself* (identity
+  preserved) unless a persistence fault targets that worker.
+
+Those site handles are resolved at construction, not per event, so the
+steady-state cost of a disarmed build is indistinguishable from a build
+with no chaos code at all.
+
+Determinism: every fire/skip decision is appended to
+``ActiveFaults.decision_log``; ``prob`` faults draw from per-fault RNGs
+seeded by ``(plan.seed, fault index)``. Same plan + same event sequence
+→ byte-identical logs.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import Any
+
+from .plan import Fault, FaultPlan, load_plan_from_env
+
+__all__ = [
+    "ChaosInjected",
+    "ActiveFaults",
+    "arm",
+    "disarm",
+    "current",
+]
+
+
+class ChaosInjected(RuntimeError):
+    """Raised by crash/fail injections — unmistakably chaos, never a bug."""
+
+
+#: the armed injector; None = chaos disabled (module-level so sites cost
+#: one global read + None check)
+ARMED: "ActiveFaults | None" = None
+
+
+def arm(plan: FaultPlan, run: int | None = None) -> "ActiveFaults":
+    """Arm ``plan`` for this process. ``run`` is the supervised restart
+    generation (default: ``PATHWAY_RESTART_COUNT``); only faults gated to
+    that generation activate."""
+    global ARMED
+    if run is None:
+        run = int(os.environ.get("PATHWAY_RESTART_COUNT", "0") or 0)
+    ARMED = ActiveFaults(plan.for_run(run), run)
+    return ARMED
+
+
+def disarm() -> None:
+    global ARMED
+    ARMED = None
+
+
+def current() -> "ActiveFaults | None":
+    """The armed injector, arming from ``PATHWAY_FAULT_PLAN`` if present.
+
+    Called from engine-construction paths only (Executor / comm backend /
+    PersistenceManager init) — never per tick/frame/put. An injector armed
+    from the environment tracks it: if ``PATHWAY_FAULT_PLAN`` changes or
+    disappears (test isolation, repeated pw.run calls), the stale arming
+    is replaced rather than leaking into the next run."""
+    global ARMED
+    if ARMED is not None and ARMED.env_spec is None:
+        return ARMED  # explicitly armed via arm() — env is ignored
+    spec = os.environ.get("PATHWAY_FAULT_PLAN")
+    spec = spec.strip() if spec else None
+    if ARMED is not None and ARMED.env_spec == spec:
+        return ARMED
+    if not spec:
+        ARMED = None
+        return None
+    armed = arm(load_plan_from_env())
+    armed.env_spec = spec
+    return armed
+
+
+class ActiveFaults:
+    def __init__(self, plan: FaultPlan, run: int = 0):
+        self.plan = plan
+        self.run = run
+        #: the raw PATHWAY_FAULT_PLAN this arming came from; None when
+        #: armed programmatically (see current())
+        self.env_spec: str | None = None
+        #: (fault index, scope, event counter, fired) — the full schedule
+        self.decision_log: list[tuple[int, str, int, bool]] = []
+        self.injections_total = 0
+        self._rngs = [
+            random.Random((plan.seed << 20) ^ i)
+            for i in range(len(plan.faults))
+        ]
+        self._counts: dict[tuple[int, str], int] = {}
+        # sites fire from concurrent worker threads (LocalComm rendezvous,
+        # per-thread ClusterComm sends); an unlocked read-modify-write on
+        # the event counters could double-fire or skip an nth fault
+        self._lock = threading.Lock()
+
+    # -- decision core ---------------------------------------------------
+
+    def _decide(self, idx: int, fault: Fault, scope: str) -> bool:
+        """One matching event for fault ``idx`` in ``scope``: count it,
+        decide deterministically, log the decision."""
+        key = (idx, scope)
+        with self._lock:
+            n = self._counts.get(key, 0) + 1
+            self._counts[key] = n
+            if fault.nth is not None:
+                fired = n == fault.nth
+            elif fault.prob is not None:
+                fired = self._rngs[idx].random() < fault.prob
+            else:
+                fired = True
+            self.decision_log.append((idx, scope, n, fired))
+            if fired:
+                self.injections_total += 1
+        return fired
+
+    # -- site resolution (construction-time) -----------------------------
+
+    def tick_fault(self, worker_id: int) -> "TickFault | None":
+        matches = [
+            (i, f) for i, f in enumerate(self.plan.faults)
+            if f.site == "tick" and f.worker in (None, worker_id)
+        ]
+        return TickFault(self, worker_id, matches) if matches else None
+
+    def send_faults(self, process_id: int) -> "SendFaults | None":
+        matches = [
+            (i, f) for i, f in enumerate(self.plan.faults)
+            if f.site == "comm.send" and f.process in (None, process_id)
+        ]
+        return SendFaults(self, process_id, matches) if matches else None
+
+    def local_faults(self) -> "LocalFaults | None":
+        matches = [
+            (i, f) for i, f in enumerate(self.plan.faults)
+            if f.site == "comm.local"
+        ]
+        return LocalFaults(self, matches) if matches else None
+
+    def wrap_backend(self, backend: Any, worker_id: int) -> Any:
+        matches = [
+            (i, f) for i, f in enumerate(self.plan.faults)
+            if f.site == "persistence.put" and f.worker in (None, worker_id)
+        ]
+        if not matches:
+            return backend
+        return ChaosBackend(backend, self, worker_id, matches)
+
+
+def wrap_backend(backend: Any, worker_id: int) -> Any:
+    """Module-level convenience: wrap iff armed AND a fault targets this
+    worker; otherwise the argument is returned unchanged (identity)."""
+    armed = current()
+    if armed is None:
+        return backend
+    return armed.wrap_backend(backend, worker_id)
+
+
+class TickFault:
+    """Bound tick-site handle for one worker's executor."""
+
+    def __init__(self, owner: ActiveFaults, worker_id: int,
+                 matches: list[tuple[int, Fault]]):
+        self._owner = owner
+        self._scope = f"tick/w{worker_id}"
+        self._matches = matches
+
+    def fire(self, tick_seq: int) -> None:
+        for idx, f in self._matches:
+            if f.tick != tick_seq:
+                continue
+            if not self._owner._decide(idx, f, self._scope):
+                continue
+            if f.action == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif f.action == "exit":
+                os._exit(17)
+            elif f.action == "hang":
+                time.sleep(f.delay_s if f.delay_s is not None else 3600.0)
+            else:  # crash
+                raise ChaosInjected(
+                    f"chaos: injected crash at tick {tick_seq} "
+                    f"({self._scope})"
+                )
+
+
+class SendFaults:
+    """Bound comm.send-site handle for one process's ClusterComm."""
+
+    def __init__(self, owner: ActiveFaults, process_id: int,
+                 matches: list[tuple[int, Fault]]):
+        self._owner = owner
+        self._process_id = process_id
+        self._matches = matches
+
+    def op_for(self, peer: int) -> tuple[str, float] | None:
+        """The (action, delay_s) to apply to the next frame headed to
+        ``peer``, or None. First firing fault wins."""
+        for idx, f in self._matches:
+            if f.peer not in (None, peer):
+                continue
+            scope = f"send/p{self._process_id}->p{peer}"
+            if self._owner._decide(idx, f, scope):
+                return f.action, (f.delay_s if f.delay_s is not None else 0.05)
+        return None
+
+
+class LocalFaults:
+    """Bound comm.local-site handle for a LocalComm."""
+
+    def __init__(self, owner: ActiveFaults, matches: list[tuple[int, Fault]]):
+        self._owner = owner
+        self._matches = matches
+
+    def apply(self, worker_id: int, key: Any, payload: Any) -> Any:
+        is_exchange = isinstance(key, tuple) and key and key[0] == "x"
+        for idx, f in self._matches:
+            if f.worker not in (None, worker_id):
+                continue
+            # 'drop' means "this worker's rows for the tick vanish" — it
+            # only matches DATA-plane exchanges; a dropped control-plane
+            # allgather (cycle coordination, recovery) would not simulate a
+            # lost frame, it would crash every worker on a None tuple
+            if f.action == "drop" and not is_exchange:
+                continue
+            if not self._owner._decide(idx, f, f"local/w{worker_id}"):
+                continue
+            if f.action == "drop":
+                return None
+            time.sleep(f.delay_s if f.delay_s is not None else 0.05)
+        return payload
+
+
+class ChaosBackend:
+    """Persistence-backend wrapper failing selected ``put_value`` calls.
+
+    ``fail`` raises before anything lands; ``torn`` writes a truncated
+    blob as the key's final content and then raises — simulating a torn
+    write that slipped past the backend's atomic-rename discipline (the
+    recovery path must survive both: metadata versions are tried newest
+    first, unparseable ones skipped)."""
+
+    def __init__(self, inner: Any, owner: ActiveFaults, worker_id: int,
+                 matches: list[tuple[int, Fault]]):
+        self._inner = inner
+        self._owner = owner
+        self._scope = f"put/w{worker_id}"
+        self._matches = matches
+
+    def put_value(self, key: str, value: bytes) -> None:
+        for idx, f in self._matches:
+            if f.key_prefix is not None and not key.startswith(f.key_prefix):
+                continue
+            if not self._owner._decide(idx, f, self._scope):
+                continue
+            if f.action == "torn":
+                self._inner.put_value(key, value[: max(1, len(value) // 2)])
+            raise ChaosInjected(
+                f"chaos: injected put_value {f.action} on {key!r}"
+            )
+        self._inner.put_value(key, value)
+
+    # pure delegation for the rest of the backend surface
+    def get_value(self, key: str) -> bytes:
+        return self._inner.get_value(key)
+
+    def list_keys(self) -> list[str]:
+        return self._inner.list_keys()
+
+    def remove_key(self, key: str) -> None:
+        self._inner.remove_key(key)
+
+    def close(self) -> None:
+        self._inner.close()
+
+    def describe(self) -> str:
+        desc = getattr(self._inner, "describe", None)
+        return f"chaos({desc()})" if desc else "chaos(?)"

@@ -1,0 +1,168 @@
+"""Declarative fault plans — deterministic, seeded chaos schedules.
+
+A fault plan is a small JSON document (env ``PATHWAY_FAULT_PLAN`` holds
+either the JSON text itself or a path to a file containing it) naming
+*where* and *when* to inject faults into a run:
+
+.. code-block:: json
+
+    {"seed": 7, "faults": [
+        {"site": "tick",        "worker": 1, "tick": 6, "action": "kill"},
+        {"site": "comm.send",   "process": 0, "peer": 1, "nth": 3,
+         "action": "drop"},
+        {"site": "comm.local",  "worker": 0, "nth": 2, "action": "delay",
+         "delay_s": 0.05},
+        {"site": "persistence.put", "worker": 0, "nth": 4,
+         "key_prefix": "meta/", "action": "fail"}
+    ]}
+
+Sites and actions:
+
+- ``tick`` — the executor's per-worker tick loop. ``action`` is ``crash``
+  (raise), ``exit`` (``os._exit``), ``kill`` (SIGKILL self — the hard
+  mid-tick death the wordcount recovery harness exercises) or ``hang``
+  (sleep ``delay_s``, default forever-ish). Selected by ``worker`` and
+  ``tick`` (the worker's 0-based tick sequence number).
+- ``comm.send`` — ClusterComm outbound frames. ``action`` is ``drop``,
+  ``delay``, ``duplicate`` or ``sever`` (shut the peer socket down, as a
+  network partition would). Selected by ``process``/``peer`` and either
+  ``nth`` (1-based matching-frame counter) or ``prob``. ``duplicate`` is
+  wire-level: it exercises the framing/reader path with a repeated frame,
+  which the inbox then absorbs idempotently (per-(collective, src)
+  slots) — it does NOT duplicate rows in the dataflow.
+- ``comm.local`` — LocalComm collective contributions (thread workers).
+  ``action`` is ``drop`` (contribute None) or ``delay``.
+- ``persistence.put`` — backend ``put_value``. ``action`` is ``fail``
+  (raise before writing) or ``torn`` (write a truncated blob, then raise —
+  a torn write landing despite the backends' atomic-rename discipline).
+  Selected by ``worker``, ``nth`` and optional ``key_prefix``.
+
+Determinism contract: a plan plus its ``seed`` fully determines the
+injection schedule. ``nth``/``tick`` faults are trivially deterministic;
+``prob`` faults draw from a per-fault ``random.Random`` seeded from
+``(seed, fault index)``, so the decision for the K-th matching event is a
+pure function of (seed, plan, K). Every decision is appended to the
+armed injector's ``decision_log`` — two runs of the same plan over the
+same event sequence produce byte-identical logs (unit-tested).
+
+Restart gating: ``run`` (default 0) scopes a fault to one supervised
+restart generation (``PATHWAY_RESTART_COUNT``); ``run = -1`` fires on
+every generation. This is what makes "crash at tick 6, then recover
+cleanly" a single declarative plan under ``spawn --supervise``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+__all__ = ["Fault", "FaultPlan", "load_plan_from_env"]
+
+_SITES = ("tick", "comm.send", "comm.local", "persistence.put")
+_ACTIONS = {
+    "tick": ("crash", "exit", "kill", "hang"),
+    "comm.send": ("drop", "delay", "duplicate", "sever"),
+    "comm.local": ("drop", "delay"),
+    "persistence.put": ("fail", "torn"),
+}
+
+
+@dataclass(frozen=True)
+class Fault:
+    site: str
+    action: str
+    #: tick / comm.local / persistence.put: worker id; None = any
+    worker: int | None = None
+    #: comm.send: originating process id; None = any
+    process: int | None = None
+    #: comm.send: destination process id; None = any
+    peer: int | None = None
+    #: tick site: fire at this 0-based tick sequence number
+    tick: int | None = None
+    #: 1-based matching-event counter (comm/persistence sites)
+    nth: int | None = None
+    #: seeded per-event probability (alternative to nth)
+    prob: float | None = None
+    #: persistence.put: only count puts whose key starts with this
+    key_prefix: str | None = None
+    #: delay/hang duration; None = the action's default (delay 0.05s,
+    #: hang effectively-forever)
+    delay_s: float | None = None
+    #: supervised restart generation this fault belongs to (-1 = all)
+    run: int = 0
+
+    def validate(self) -> None:
+        if self.site not in _SITES:
+            raise ValueError(
+                f"fault plan: unknown site {self.site!r} (one of {_SITES})"
+            )
+        if self.action not in _ACTIONS[self.site]:
+            raise ValueError(
+                f"fault plan: site {self.site!r} has no action "
+                f"{self.action!r} (one of {_ACTIONS[self.site]})"
+            )
+        if self.site == "tick" and self.tick is None:
+            raise ValueError("fault plan: tick faults need a 'tick' number")
+        if self.prob is not None and not 0.0 <= self.prob <= 1.0:
+            raise ValueError(f"fault plan: prob {self.prob} not in [0, 1]")
+
+
+@dataclass
+class FaultPlan:
+    seed: int = 0
+    faults: list[Fault] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, doc: dict[str, Any]) -> "FaultPlan":
+        known = {f.name for f in Fault.__dataclass_fields__.values()}
+        faults = []
+        for i, fd in enumerate(doc.get("faults", [])):
+            extra = set(fd) - known
+            if extra:
+                raise ValueError(
+                    f"fault plan: fault #{i} has unknown fields {sorted(extra)}"
+                )
+            f = Fault(**fd)
+            f.validate()
+            faults.append(f)
+        return cls(seed=int(doc.get("seed", 0)), faults=faults)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        return cls.from_dict(json.loads(text))
+
+    def for_run(self, run: int) -> "FaultPlan":
+        """The sub-plan applicable to supervised restart generation
+        ``run`` (faults with run = -1 apply to every generation)."""
+        return FaultPlan(
+            seed=self.seed,
+            faults=[f for f in self.faults if f.run in (-1, run)],
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "seed": self.seed,
+            "faults": [
+                {
+                    k: v
+                    for k, v in vars(f).items()
+                    if v is not None and not (k == "run" and v == 0)
+                }
+                for f in self.faults
+            ],
+        }
+
+
+def load_plan_from_env() -> FaultPlan | None:
+    """Parse ``PATHWAY_FAULT_PLAN`` (inline JSON or a file path). Returns
+    None when unset/empty — the common case, costing one env read."""
+    spec = os.environ.get("PATHWAY_FAULT_PLAN")
+    if not spec or not spec.strip():
+        return None
+    spec = spec.strip()
+    if not spec.startswith("{"):
+        with open(spec) as f:
+            spec = f.read()
+    return FaultPlan.from_json(spec)

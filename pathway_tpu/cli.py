@@ -32,6 +32,7 @@ def _spawn_processes(
     args: tuple[str, ...],
     addresses: str | None = None,
     local_ids: tuple[int, ...] = (),
+    supervise: bool = False,
 ) -> int:
     if threads * processes > MAX_WORKERS:
         raise click.ClickException(
@@ -71,6 +72,25 @@ def _spawn_processes(
         )
     if len(set(pids)) != len(pids):
         raise click.ClickException("--process ids must be distinct")
+    if supervise:
+        # the supervisor's contract is "restart the WHOLE ensemble from the
+        # last common snapshot"; a partial ensemble (multi-host book, or a
+        # -p subset of the ids) would restart only its local slice, restart
+        # generations would diverge across machines, and run-gated fault
+        # plans / PATHWAY_RESTART_COUNT metrics would lie
+        if addresses:
+            raise click.ClickException(
+                "--supervise cannot coordinate a multi-host ensemble "
+                "(--addresses): each machine would restart only its own "
+                "processes and restart generations would diverge — "
+                "supervise externally (e.g. your orchestrator) instead"
+            )
+        if local_ids and set(pids) != set(range(processes)):
+            raise click.ClickException(
+                "--supervise needs the full ensemble on this machine; "
+                f"-p selects only {sorted(pids)} of {processes} processes"
+            )
+        return _run_supervised(base_env, program, pids)
     if processes <= 1:
         env = {**base_env, "PATHWAY_PROCESS_ID": "0"}
         return subprocess.call(program, env=env)
@@ -82,6 +102,51 @@ def _spawn_processes(
     for p in procs:
         code = p.wait() or code
     return code
+
+
+def _run_supervised(
+    base_env: dict, program: list[str], pids: list[int]
+) -> int:
+    """Run the ensemble under a Supervisor: on any child death, tear the
+    survivors down cooperatively and relaunch the WHOLE generation (the
+    engine recovers from the last snapshot common to every worker). See
+    parallel/supervisor.py for the backoff/circuit-breaker contract."""
+    from .parallel.supervisor import Supervisor
+
+    def launch(generation: int, reason: str | None):
+        env = {
+            **base_env,
+            "PATHWAY_SUPERVISED": "1",
+            "PATHWAY_RESTART_COUNT": str(generation),
+        }
+        if reason is not None:
+            env["PATHWAY_LAST_RESTART_REASON"] = reason
+        return [
+            subprocess.Popen(
+                program, env={**env, "PATHWAY_PROCESS_ID": str(pid)}
+            )
+            for pid in pids
+        ]
+
+    health_ports: list[int] = []
+    if base_env.get("PATHWAY_MONITORING_HTTP_SERVER", "").strip().lower() in (
+        "1", "true", "yes", "on"
+    ):
+        try:
+            base = int(
+                base_env.get("PATHWAY_MONITORING_HTTP_PORT", "20000") or 0
+            )
+        except ValueError:
+            # same tolerance as config._env_int/http_server: a malformed
+            # port degrades to exit-code-only supervision, never a crash
+            base = 0
+        if base:
+            health_ports = [base + pid for pid in pids]
+    return Supervisor(
+        launch,
+        health_ports=health_ports,
+        labels=[f"process {pid}" for pid in pids],
+    ).run()
 
 
 @main.command(context_settings={"ignore_unknown_options": True})
@@ -99,9 +164,15 @@ def _spawn_processes(
               help="launch only these process ids on this machine "
                    "(repeatable; default: all — use with --addresses when "
                    "the ensemble spans machines)")
+@click.option("--supervise", is_flag=True, default=False,
+              help="self-healing mode: on any worker death, tear down the "
+                   "survivors cooperatively and restart the ensemble from "
+                   "the last common snapshot (jittered exponential backoff, "
+                   "crash-loop circuit breaker — see "
+                   "PATHWAY_SUPERVISE_MAX_RESTARTS and friends)")
 @click.argument("program", nargs=-1, type=click.UNPROCESSED)
 def spawn(threads, processes, first_port, record, record_path, addresses,
-          local_ids, program):
+          local_ids, supervise, program):
     """Launch PROGRAM with the worker environment set (reference cli.py:53).
 
     Multi-host: run once per machine with the same ``--addresses`` book and
@@ -113,7 +184,7 @@ def spawn(threads, processes, first_port, record, record_path, addresses,
         env_extra["PATHWAY_SNAPSHOT_ACCESS"] = "record"
     sys.exit(_spawn_processes(threads, processes, first_port, env_extra,
                               program, addresses=addresses,
-                              local_ids=local_ids))
+                              local_ids=local_ids, supervise=supervise))
 
 
 @main.command(context_settings={"ignore_unknown_options": True})

@@ -352,6 +352,16 @@ class Executor:
         self._last_clock = 0
         self._defer_commit = False
         self.stats = EngineStats()
+        # chaos injection site: resolved once at construction; None unless a
+        # fault plan targets this worker's tick loop, so a disarmed run pays
+        # one None check per tick (chaos/injector.py)
+        from ..chaos import injector as _chaos
+
+        armed = _chaos.current()
+        self._tick_fault = (
+            armed.tick_fault(self.ctx.worker_id) if armed is not None else None
+        )
+        self._tick_seq = 0
         for node in self.nodes:
             # Exchange nodes report per-tick sent/received row counts into
             # the worker's stats (backpressure signals on /metrics)
@@ -702,6 +712,9 @@ class Executor:
     def _tick(self, time: int, source_emissions: list[tuple[SourceNode, Delta]]) -> None:
         import time as _wall
 
+        if self._tick_fault is not None:
+            self._tick_fault.fire(self._tick_seq)
+        self._tick_seq += 1
         tracer = self.tracer
         timed = tracer is not None or self.stats.detailed
         # tick duration is always histogrammed — two clock reads per tick

@@ -90,6 +90,31 @@ class PathwayConfig:
     #: /healthz fails when an unfinished executor's heartbeat is older
     health_wedge_timeout_s: float = field(
         default_factory=lambda: _env_float("PATHWAY_HEALTH_WEDGE_S", 30.0))
+    # robustness / self-healing (chaos/ + parallel/supervisor.py)
+    #: declarative fault plan: inline JSON or a path to one (chaos/plan.py);
+    #: unset = every injection site disarmed (one None check each)
+    fault_plan: str | None = field(
+        default_factory=lambda: os.environ.get("PATHWAY_FAULT_PLAN"))
+    #: how long a blocked cluster collective waits before declaring the
+    #: mesh dead (peer-death propagation normally fires in milliseconds —
+    #: this is the backstop for silent stalls)
+    collective_timeout_s: float = field(
+        default_factory=lambda: _env_float(
+            "PATHWAY_COLLECTIVE_TIMEOUT_S", 600.0))
+    #: per-peer mesh-establishment budget (jittered-backoff retries within)
+    connect_timeout_s: float = field(
+        default_factory=lambda: _env_float("PATHWAY_CONNECT_TIMEOUT_S", 30.0))
+    #: set by `spawn --supervise` on children: enables cooperative SIGTERM
+    #: wind-down so the supervisor's teardown flushes the persistence tail
+    supervised: bool = field(
+        default_factory=lambda: _env_bool("PATHWAY_SUPERVISED"))
+    #: restart generation (0 = first boot), stamped by the supervisor;
+    #: gates fault-plan entries and feeds pathway_restarts_total
+    restart_count: int = field(
+        default_factory=lambda: _env_int("PATHWAY_RESTART_COUNT", 0))
+    #: why the supervisor last restarted the ensemble (metrics label)
+    last_restart_reason: str | None = field(
+        default_factory=lambda: os.environ.get("PATHWAY_LAST_RESTART_REASON"))
     # worker layout (config.rs PATHWAY_THREADS/PROCESSES/PROCESS_ID/FIRST_PORT)
     #: route dense Exchange columns over the jax device mesh (ICI) instead
     #: of host memory — parallel/meshcomm.py; needs ≥ total_workers devices

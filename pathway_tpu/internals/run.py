@@ -33,6 +33,7 @@ def run(
     runner.with_http_server = with_http_server
     with _lock:
         _current["runner"] = runner
+    restore_sigterm = _install_supervised_sigterm()
     try:
         if persistence_config is None:
             # the CLI's record/replay env (pathway-tpu spawn --record /
@@ -56,8 +57,31 @@ def run(
         else:
             runner.run()
     finally:
+        restore_sigterm()
         with _lock:
             _current["runner"] = None
+
+
+def _install_supervised_sigterm():
+    """Under ``spawn --supervise`` (PATHWAY_SUPERVISED=1) a SIGTERM is the
+    supervisor's cooperative teardown request: translate it into
+    ``request_stop()`` so the streaming loop winds down and the persistence
+    manager's ``close()`` flushes the recorded input tail before exit.
+    Returns a restore callback; a no-op off the main thread or when not
+    supervised."""
+    import os
+
+    if not os.environ.get("PATHWAY_SUPERVISED"):
+        return lambda: None
+    import signal
+
+    try:
+        prev = signal.signal(
+            signal.SIGTERM, lambda signum, frame: request_stop()
+        )
+    except ValueError:  # not the main thread — supervisor falls back to kill
+        return lambda: None
+    return lambda: signal.signal(signal.SIGTERM, prev)
 
 
 def request_stop() -> None:

@@ -237,7 +237,31 @@ class ObservabilityHub:
             comm_stats,
             scrape_errors=self.scrape_errors,
             worker_labels=True if cluster else None,
+            supervisor=self._supervisor_snapshot(),
         )
+
+    @staticmethod
+    def _supervisor_snapshot() -> dict | None:
+        """Self-healing metrics: restart generation + reason, stamped into
+        the child environment by ``spawn --supervise``, plus the armed
+        fault plan's injection count. None when neither applies (keeps the
+        single-process exposition identical to the seed's)."""
+        import os
+
+        restarts = os.environ.get("PATHWAY_RESTART_COUNT")
+        supervised = os.environ.get("PATHWAY_SUPERVISED")
+        from ..chaos import injector as _chaos
+
+        armed = _chaos.ARMED
+        if not supervised and restarts is None and armed is None:
+            return None
+        doc: dict = {
+            "restarts": int(restarts or 0),
+            "reason": os.environ.get("PATHWAY_LAST_RESTART_REASON"),
+        }
+        if armed is not None:
+            doc["chaos_injections"] = armed.injections_total
+        return doc
 
     def health(self) -> tuple[bool, dict]:
         return health_status(self.worker_stats, self.wedge_timeout_s)

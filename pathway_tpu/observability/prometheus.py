@@ -110,6 +110,7 @@ def render_snapshots(
     comm_stats: dict[str, dict[str, float]] | None = None,
     scrape_errors: int = 0,
     worker_labels: bool | None = None,
+    supervisor: dict | None = None,
 ) -> str:
     """Exposition text for a set of worker stats snapshots.
 
@@ -187,6 +188,25 @@ def render_snapshots(
     r.add("pathway_cluster_workers", "gauge", len(snapshots))
     if scrape_errors:
         r.add("pathway_cluster_scrape_errors", "counter", scrape_errors)
+    if supervisor is not None:
+        # self-healing surface (spawn --supervise): restart generation +
+        # why the supervisor last bounced the ensemble (info-style series,
+        # value always 1, reason as a label) + armed-chaos fire count
+        r.add(
+            "pathway_restarts_total", "counter",
+            int(supervisor.get("restarts", 0)),
+        )
+        reason = supervisor.get("reason")
+        if reason:
+            r.add(
+                "pathway_last_restart_reason", "gauge", 1,
+                {"reason": str(reason)},
+            )
+        if supervisor.get("chaos_injections") is not None:
+            r.add(
+                "pathway_chaos_injections_total", "counter",
+                int(supervisor["chaos_injections"]),
+            )
     return r.text()
 
 

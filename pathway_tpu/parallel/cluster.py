@@ -19,6 +19,7 @@ the key shards of its workers (internals/graph_runner._run_sharded).
 from __future__ import annotations
 
 import pickle
+import random
 import socket
 import struct
 import threading
@@ -30,6 +31,9 @@ from .comm import Comm
 __all__ = ["ClusterComm"]
 
 _LEN = struct.Struct(">Q")
+#: defaults; per-instance values come from PATHWAY_CONNECT_TIMEOUT_S /
+#: PATHWAY_COLLECTIVE_TIMEOUT_S (internals/config.py) so deployments can
+#: tune how long a worker waits before declaring its peers gone
 CONNECT_TIMEOUT_S = 30.0
 COLLECTIVE_TIMEOUT_S = 600.0
 
@@ -43,7 +47,23 @@ class ClusterComm(Comm):
         first_port: int,
         host: str = "127.0.0.1",
         addresses: list[str] | None = None,
+        connect_timeout_s: float | None = None,
+        collective_timeout_s: float | None = None,
     ):
+        from ..internals.config import _env_float
+
+        self.connect_timeout_s = (
+            connect_timeout_s
+            if connect_timeout_s is not None
+            else _env_float("PATHWAY_CONNECT_TIMEOUT_S", CONNECT_TIMEOUT_S)
+        )
+        self.collective_timeout_s = (
+            collective_timeout_s
+            if collective_timeout_s is not None
+            else _env_float(
+                "PATHWAY_COLLECTIVE_TIMEOUT_S", COLLECTIVE_TIMEOUT_S
+            )
+        )
         self.process_id = process_id
         self.n_processes = n_processes
         self.threads = threads_per_process
@@ -73,6 +93,14 @@ class ClusterComm(Comm):
         self.frames_sent = 0
         self.bytes_received = 0
         self.frames_received = 0
+        # chaos site (comm.send): None unless a fault plan targets this
+        # process's outbound frames — one None check per send when disarmed
+        from ..chaos import injector as _chaos
+
+        armed = _chaos.current()
+        self._chaos = (
+            armed.send_faults(process_id) if armed is not None else None
+        )
         self._connect_mesh()
 
     # -- mesh setup ------------------------------------------------------
@@ -100,30 +128,43 @@ class ClusterComm(Comm):
         acceptor = threading.Thread(target=accept_loop, daemon=True)
         acceptor.start()
 
-        # dial every lower pid (they accept from us)
+        # dial every lower pid (they accept from us); unreachable peers are
+        # retried with jittered exponential backoff until the connect
+        # timeout — a restarting peer (supervised ensemble, rolling deploy)
+        # needs a window to come back without synchronized reconnect storms
         for peer in range(self.process_id):
             peer_host, peer_port = self._addrs[peer]
-            deadline = time.monotonic() + CONNECT_TIMEOUT_S
+            deadline = time.monotonic() + self.connect_timeout_s
+            delay, last_err = 0.05, None
             while True:
                 try:
                     s = socket.create_connection(
                         (peer_host, peer_port), timeout=2.0
                     )
                     break
-                except OSError:
+                except OSError as e:
+                    last_err = e
                     if time.monotonic() > deadline:
                         raise RuntimeError(
-                            f"process {self.process_id}: peer {peer} not "
-                            f"reachable on {peer_host}:{peer_port}"
-                        )
-                    time.sleep(0.05)
+                            f"process {self.process_id}: peer process {peer} "
+                            f"not reachable on {peer_host}:{peer_port} after "
+                            f"{self.connect_timeout_s:.0f}s ({last_err})"
+                        ) from e
+                    time.sleep(delay * (0.5 + random.random()))
+                    delay = min(delay * 2, 1.0)
             s.sendall(_LEN.pack(self.process_id))
             self._register_peer(peer, s)
-        acceptor.join(CONNECT_TIMEOUT_S)
+        acceptor.join(self.connect_timeout_s)
         if len(self._socks) != self.n_processes - 1:
+            missing = sorted(
+                set(range(self.n_processes))
+                - set(self._socks)
+                - {self.process_id}
+            )
             raise RuntimeError(
                 f"process {self.process_id}: cluster mesh incomplete "
-                f"({len(self._socks)}/{self.n_processes - 1} peers)"
+                f"({len(self._socks)}/{self.n_processes - 1} peers; "
+                f"missing processes {missing})"
             )
 
     def _register_peer(self, peer: int, sock: socket.socket) -> None:
@@ -153,9 +194,19 @@ class ClusterComm(Comm):
                     # delivered in order before this frame
                     return
                 self._deliver(frame)
-        except (OSError, EOFError, pickle.UnpicklingError):
+        except (OSError, EOFError) as e:
+            # peer socket death: the fast-propagation path — flip _broken
+            # and wake every blocked collective NOW, not at the timeout
             if not self._closing:
-                self._break(f"connection to process {peer} lost")
+                self._break(
+                    f"connection to process {peer} lost ({e or 'EOF'})"
+                )
+        except BaseException as e:  # noqa: BLE001 — reader must not die mute
+            # ANY reader-thread failure (bad pickle, memory pressure, a bug)
+            # would otherwise strand this process's workers in collectives
+            # until the timeout with no record of why
+            if not self._closing:
+                self._break(f"reader thread for process {peer} failed: {e!r}")
 
     def _deliver(self, frame: tuple) -> None:
         kind = frame[0]
@@ -170,15 +221,39 @@ class ClusterComm(Comm):
             self._cond.notify_all()
 
     def _send(self, peer: int, frame: tuple) -> None:
+        if self._chaos is not None and frame[0] != "bye":
+            op = self._chaos.op_for(peer)
+            if op is not None:
+                action, delay_s = op
+                if action == "drop":
+                    return
+                if action == "delay":
+                    time.sleep(delay_s)
+                elif action == "sever":
+                    # partition: hard-close the link and send NOTHING —
+                    # both sides' read loops see EOF and flip _broken (a
+                    # fall-through send would fail synchronously and
+                    # mislabel the chaos as a sender crash)
+                    try:
+                        self._socks[peer].shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    self._socks[peer].close()
+                    return
+                elif action == "duplicate":
+                    self._send_raw(peer, frame)
+        self._send_raw(peer, frame)
+
+    def _send_raw(self, peer: int, frame: tuple) -> None:
         blob = pickle.dumps(frame, protocol=pickle.HIGHEST_PROTOCOL)
         with self._send_locks[peer]:
             try:
                 self._socks[peer].sendall(_LEN.pack(len(blob)) + blob)
                 self.bytes_sent += 8 + len(blob)
                 self.frames_sent += 1
-            except OSError:
+            except OSError as e:
                 if not self._closing:
-                    self._break(f"send to process {peer} failed")
+                    self._break(f"send to process {peer} failed ({e})")
                 raise RuntimeError(self._broken or "cluster send failed")
 
     def _process_of(self, worker: int) -> int:
@@ -241,21 +316,32 @@ class ClusterComm(Comm):
         self.allgather(("b", seq), worker_id, None)
 
     def _wait(self, key: Any, n: int) -> dict[int, Any]:
-        deadline = time.monotonic() + COLLECTIVE_TIMEOUT_S
+        deadline = time.monotonic() + self.collective_timeout_s
         with self._cond:
             while True:
                 if self._broken:
+                    # _break() notify_all'd this condition, so every blocked
+                    # collective in the process unwinds in milliseconds —
+                    # never waiting out the collective timeout
                     raise RuntimeError(
-                        f"a peer worker failed: {self._broken} (reference "
-                        "cross-worker panic propagation, dataflow.rs:5674)"
+                        f"process {self.process_id}: a peer worker failed: "
+                        f"{self._broken} (reference cross-worker panic "
+                        "propagation, dataflow.rs:5674)"
                     )
                 got = self._inbox.get(key)
                 if got is not None and len(got) >= n:
                     return dict(got)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
+                    missing = sorted(
+                        set(range(self.n_workers)) - set(got or ())
+                    )
                     raise RuntimeError(
-                        f"cluster collective timed out waiting on {key!r}"
+                        f"process {self.process_id}: cluster collective "
+                        f"timed out after {self.collective_timeout_s:.0f}s "
+                        f"waiting on {key!r} (no contribution from workers "
+                        f"{missing}; set PATHWAY_COLLECTIVE_TIMEOUT_S to "
+                        "tune)"
                     )
                 self._cond.wait(timeout=min(remaining, 1.0))
 
@@ -269,16 +355,20 @@ class ClusterComm(Comm):
             "cluster_bytes_received": float(self.bytes_received),
             "cluster_frames_received": float(self.frames_received),
             "cluster_inbox_depth": float(len(self._inbox)),
+            "cluster_broken": float(self._broken is not None),
         }
 
     def _break(self, reason: str) -> None:
+        """Mark the mesh dead and wake EVERY waiter on the shared condition
+        — the one notify_all that turns a 10-minute collective timeout into
+        millisecond failure propagation."""
         with self._cond:
             if self._broken is None:
                 self._broken = reason
             self._cond.notify_all()
 
     def abort(self) -> None:
-        self._break("local worker failed")
+        self._break(f"worker on process {self.process_id} failed")
         # peers unblock when their read loops see the closed sockets
         self._shutdown_sockets()
 
@@ -294,6 +384,14 @@ class ClusterComm(Comm):
     def _shutdown_sockets(self) -> None:
         self._closing = True
         for s in self._socks.values():
+            # shutdown() before close(): close() alone neither interrupts a
+            # recv in flight on this socket nor sends the FIN that would
+            # wake the PEER's reader — shutdown does both, which is what
+            # makes failure propagation immediate instead of timeout-bound
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 s.close()
             except OSError:

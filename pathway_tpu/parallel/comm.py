@@ -73,8 +73,16 @@ class LocalComm(Comm):
         self._barrier = threading.Barrier(n_workers)
         self._lock = threading.Lock()
         self._slots: dict[Any, list] = {}
+        # chaos site (comm.local): None unless a plan targets in-process
+        # collectives — one None check per rendezvous when disarmed
+        from ..chaos import injector as _chaos
+
+        armed = _chaos.current()
+        self._chaos = armed.local_faults() if armed is not None else None
 
     def _rendezvous(self, key: Any, worker_id: int, payload: Any) -> list[Any]:
+        if self._chaos is not None:
+            payload = self._chaos.apply(worker_id, key, payload)
         try:
             with self._lock:
                 slot = self._slots.setdefault(key, [None] * self.n_workers)
@@ -104,7 +112,11 @@ class LocalComm(Comm):
         return [
             all_buckets[src][worker_id]
             for src in range(self.n_workers)
-            if all_buckets[src][worker_id] is not None
+            # a whole-slot None is a chaos-dropped contribution (the
+            # in-process analog of a lost frame): that worker's rows for
+            # this tick silently vanish, exactly what the plan asked for
+            if all_buckets[src] is not None
+            and all_buckets[src][worker_id] is not None
         ]
 
     def allgather(self, tag, worker_id, obj):

@@ -1,0 +1,231 @@
+"""Supervised cluster runtime — restart-from-snapshot on worker death.
+
+``pathway-tpu spawn --supervise`` wraps the process ensemble in a
+:class:`Supervisor`: it watches every child for death (exit code) and
+wedge (the PR 1 ``/healthz`` heartbeat probe), and on any failure
+
+1. tears the surviving peers down **cooperatively** — SIGTERM first, which
+   the children translate into ``request_stop()`` (``internals/run.py``)
+   so their persistence managers flush the recorded input tail via
+   ``close()`` before exiting; SIGKILL only after a grace period;
+2. restarts the WHOLE ensemble (the engine recovers from the last
+   snapshot common to every worker — ``Executor._recover``) after a
+   jittered exponential backoff, stamping each generation's environment
+   with ``PATHWAY_RESTART_COUNT`` / ``PATHWAY_LAST_RESTART_REASON`` so
+   fault plans gate per generation and ``/metrics`` exports
+   ``pathway_restarts_total`` + ``pathway_last_restart_reason``;
+3. gives up when the crash-loop circuit breaker trips: more than
+   ``max_restarts`` restarts inside a ``window_s`` sliding window means
+   the program dies deterministically (a poisoned input, a broken
+   deploy) and restarting is harm, not healing.
+
+The reference treats restart-with-recovery as the fault-tolerance
+contract (wordcount's ``run_pw_program_suddenly_terminate`` SIGKILLs the
+engine and reruns it in a loop, demanding exact final output); this
+module is that loop, productized.
+
+Env knobs (CLI flags override): ``PATHWAY_SUPERVISE_MAX_RESTARTS`` (5),
+``PATHWAY_SUPERVISE_WINDOW_S`` (60), ``PATHWAY_SUPERVISE_BACKOFF_S``
+(0.5 initial, doubling), ``PATHWAY_SUPERVISE_BACKOFF_MAX_S`` (30),
+``PATHWAY_SUPERVISE_GRACE_S`` (5).
+"""
+
+from __future__ import annotations
+
+import signal
+import subprocess
+import sys
+import time
+from collections import deque
+from typing import Any, Callable, Sequence
+
+__all__ = ["Supervisor", "RestartBudgetExceeded"]
+
+#: circuit breaker opened — the ensemble is crash-looping
+EXIT_CIRCUIT_OPEN = 75  # EX_TEMPFAIL
+
+
+class RestartBudgetExceeded(RuntimeError):
+    pass
+
+
+class Supervisor:
+    """Run ``launch`` generations of a process ensemble until clean exit,
+    restarting on failure with backoff + a sliding-window circuit breaker.
+
+    ``launch(generation, reason)`` must return the ensemble's
+    ``subprocess.Popen`` handles; ``reason`` is None for generation 0 and
+    the previous generation's failure description afterwards.
+    """
+
+    def __init__(
+        self,
+        launch: Callable[[int, str | None], Sequence[subprocess.Popen]],
+        *,
+        max_restarts: int | None = None,
+        window_s: float | None = None,
+        backoff_s: float | None = None,
+        backoff_max_s: float | None = None,
+        grace_s: float | None = None,
+        health_ports: Sequence[int] | None = None,
+        health_interval_s: float = 1.0,
+        poll_interval_s: float = 0.05,
+        rng: Callable[[], float] | None = None,
+        log: Callable[[str], Any] | None = None,
+        labels: Sequence[str] | None = None,
+    ):
+        from ..internals.config import _env_float, _env_int
+
+        self.launch = launch
+        self.max_restarts = (
+            max_restarts
+            if max_restarts is not None
+            else _env_int("PATHWAY_SUPERVISE_MAX_RESTARTS", 5)
+        )
+        self.window_s = (
+            window_s
+            if window_s is not None
+            else _env_float("PATHWAY_SUPERVISE_WINDOW_S", 60.0)
+        )
+        self.backoff_s = (
+            backoff_s
+            if backoff_s is not None
+            else _env_float("PATHWAY_SUPERVISE_BACKOFF_S", 0.5)
+        )
+        self.backoff_max_s = (
+            backoff_max_s
+            if backoff_max_s is not None
+            else _env_float("PATHWAY_SUPERVISE_BACKOFF_MAX_S", 30.0)
+        )
+        self.grace_s = (
+            grace_s
+            if grace_s is not None
+            else _env_float("PATHWAY_SUPERVISE_GRACE_S", 5.0)
+        )
+        #: per-process /healthz ports (monitoring base + pid); empty =
+        #: exit-code supervision only
+        self.health_ports = list(health_ports or [])
+        self.health_interval_s = health_interval_s
+        self.poll_interval_s = poll_interval_s
+        #: display names aligned with launch()'s Popen order — the CLI
+        #: passes real process ids so failure reasons name the right
+        #: worker even under a -p id subset
+        self.labels = list(labels or [])
+        self._rng = rng if rng is not None else __import__("random").random
+        self._log = log if log is not None else (
+            lambda msg: print(f"[supervisor] {msg}", file=sys.stderr)
+        )
+        self.restarts_total = 0
+        self.last_restart_reason: str | None = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def run(self) -> int:
+        restart_times: deque[float] = deque()
+        generation = 0
+        reason: str | None = None
+        while True:
+            procs = list(self.launch(generation, reason))
+            reason = self._watch(procs)
+            if reason is None:
+                return 0  # every process exited 0 — the run completed
+            self._log(f"generation {generation} failed: {reason}")
+            self._teardown(procs)
+            now = time.monotonic()
+            restart_times.append(now)
+            while restart_times and now - restart_times[0] > self.window_s:
+                restart_times.popleft()
+            if len(restart_times) > self.max_restarts:
+                self._log(
+                    f"circuit breaker open: {len(restart_times)} restarts "
+                    f"inside {self.window_s:.0f}s (max {self.max_restarts}) "
+                    "— giving up"
+                )
+                return EXIT_CIRCUIT_OPEN
+            self.restarts_total += 1
+            self.last_restart_reason = reason
+            delay = min(
+                self.backoff_max_s,
+                self.backoff_s * (2 ** (self.restarts_total - 1)),
+            ) * (0.5 + self._rng())  # jitter in [0.5, 1.5): no thundering herd
+            self._log(
+                f"restarting from last common snapshot in {delay:.2f}s "
+                f"(restart #{self.restarts_total})"
+            )
+            time.sleep(delay)
+            generation += 1
+
+    def _label(self, i: int) -> str:
+        return self.labels[i] if i < len(self.labels) else f"process {i}"
+
+    def _watch(self, procs: Sequence[subprocess.Popen]) -> str | None:
+        """Block until the generation resolves: None = all exited cleanly,
+        else the failure reason."""
+        next_health = time.monotonic() + self.health_interval_s
+        while True:
+            codes = [p.poll() for p in procs]
+            for i, c in enumerate(codes):
+                if c is not None and c != 0:
+                    return (
+                        f"{self._label(i)} (pid {procs[i].pid}) "
+                        f"exited with {c}"
+                    )
+            if all(c == 0 for c in codes):
+                return None
+            if self.health_ports and time.monotonic() >= next_health:
+                wedged = self._check_health()
+                if wedged is not None:
+                    return wedged
+                next_health = time.monotonic() + self.health_interval_s
+            time.sleep(self.poll_interval_s)
+
+    def _check_health(self) -> str | None:
+        """Probe each child's /healthz. Only a *served, failing* probe is
+        fatal (a wedged executor thread); an unreachable port is not — the
+        server may be disabled, still booting, or already shut down."""
+        import urllib.error
+        import urllib.request
+
+        for i, port in enumerate(self.health_ports):
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=2.0
+                ) as r:
+                    if r.status != 200:  # pragma: no cover — urllib raises
+                        return f"{self._label(i)} wedged (healthz {r.status})"
+            except urllib.error.HTTPError as e:
+                if e.code == 503:
+                    return (
+                        f"{self._label(i)} wedged (healthz 503: "
+                        f"{e.read(200).decode(errors='replace')})"
+                    )
+            except Exception:
+                pass  # unreachable — not evidence of a wedge
+        return None
+
+    def _teardown(self, procs: Sequence[subprocess.Popen]) -> None:
+        """Cooperative stop of the survivors: SIGTERM (children flush their
+        persistence input tail on the way out), grace, then SIGKILL."""
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + self.grace_s
+        for p in procs:
+            remaining = deadline - time.monotonic()
+            if remaining > 0 and p.poll() is None:
+                try:
+                    p.wait(timeout=remaining)
+                except subprocess.TimeoutExpired:
+                    pass
+        for p in procs:
+            if p.poll() is None:
+                self._log(f"pid {p.pid} ignored SIGTERM for "
+                          f"{self.grace_s:.0f}s — SIGKILL")
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+                p.wait()

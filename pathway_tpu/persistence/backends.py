@@ -26,6 +26,11 @@ __all__ = [
 class PersistenceBackend:
     """Flat key-value store of byte blobs (backends/mod.rs:47)."""
 
+    def describe(self) -> str:
+        """Human-readable location (path/URI) for error messages — which
+        store a mismatched cluster marker or torn snapshot lives in."""
+        return type(self).__name__
+
     def get_value(self, key: str) -> bytes:
         raise NotImplementedError
 
@@ -51,6 +56,7 @@ class MemoryBackend(PersistenceBackend):
     _lock = threading.Lock()
 
     def __init__(self, name: str | None = None):
+        self._name = name
         if name is None:
             self._store: dict[str, bytes] = {}
         else:
@@ -61,6 +67,9 @@ class MemoryBackend(PersistenceBackend):
     def drop(cls, name: str) -> None:
         with cls._lock:
             cls._registry.pop(name, None)
+
+    def describe(self) -> str:
+        return f"memory://{self._name}" if self._name else "memory://(anonymous)"
 
     def get_value(self, key: str) -> bytes:
         return self._store[key]
@@ -82,6 +91,9 @@ class FilesystemBackend(PersistenceBackend):
     def __init__(self, root: str | os.PathLike):
         self.root = os.fspath(root)
         os.makedirs(self.root, exist_ok=True)
+
+    def describe(self) -> str:
+        return self.root
 
     def _path(self, key: str) -> str:
         # keys may contain '/' segments — map to subdirectories
@@ -129,6 +141,9 @@ class PrefixBackend(PersistenceBackend):
         self._inner = inner
         self._prefix = prefix
 
+    def describe(self) -> str:
+        return f"{self._inner.describe()}/{self._prefix}"
+
     def get_value(self, key: str) -> bytes:
         return self._inner.get_value(self._prefix + key)
 
@@ -170,6 +185,7 @@ class S3Backend(PersistenceBackend):
         self._prefix = prefix.strip("/")
         if self._prefix:
             self._prefix += "/"
+        self._uri = f"s3://{self._bucket}/{self._prefix}"
         if client is None:
             try:
                 import boto3  # type: ignore[import-not-found]
@@ -192,6 +208,9 @@ class S3Backend(PersistenceBackend):
                         kwargs[kw] = v
             client = boto3.client("s3", **kwargs)
         self._client = client
+
+    def describe(self) -> str:
+        return self._uri
 
     def _obj_key(self, key: str) -> str:
         return self._prefix + key

@@ -56,6 +56,14 @@ class PersistenceManager:
         self.backend: PersistenceBackend = (
             PrefixBackend(root, f"worker-{worker_id}/") if n_workers > 1 else root
         )
+        # chaos site (persistence.put): identity pass-through unless a
+        # fault plan targets this worker's puts. Wraps the WORKER's view
+        # (inside the worker-{id}/ prefix), so plan key_prefix values like
+        # "meta/" or "chunks/" match identically in single- and
+        # multi-worker runs (chaos/injector.py)
+        from ..chaos import wrap_backend as _chaos_wrap
+
+        self.backend = _chaos_wrap(self.backend, worker_id)
         self.snapshot_interval_s = (config.snapshot_interval_ms or 0) / 1000.0
         self._meta = MetadataAccessor(self.backend)
         meta = self._meta.current or {}
@@ -107,12 +115,27 @@ class PersistenceManager:
             existing = None
         if existing is not None:
             if int(existing.get("n_workers", 1)) != n_workers:
+                # a marker with ZERO committed metadata versions behind it is
+                # the residue of a first boot that crashed between writing
+                # the marker and the first commit — there is no state to
+                # reshard, so adopt the new layout instead of refusing to
+                # ever start again under a different worker count
+                has_meta = any(
+                    "meta/" in k for k in root.list_keys()
+                )
+                if not has_meta:
+                    root.put_value(
+                        key, json.dumps({"n_workers": n_workers}).encode()
+                    )
+                    return
+                where = root.describe()
                 raise RuntimeError(
-                    f"persisted state was written by {existing['n_workers']} "
-                    f"worker(s) but this run has {n_workers}: operator state "
-                    "is hash-sharded by worker count and cannot be resharded "
-                    "on recovery — restart with the original worker count or "
-                    "clear the persistence backend"
+                    f"persisted state at {where} was written by "
+                    f"{existing['n_workers']} worker(s) but this run has "
+                    f"{n_workers}: operator state is hash-sharded by worker "
+                    "count and cannot be resharded on recovery — restart "
+                    "with the original worker count or clear the "
+                    "persistence backend"
                 )
         else:
             root.put_value(key, json.dumps({"n_workers": n_workers}).encode())

@@ -13,6 +13,14 @@ guard_cpu_platform(force_device_count=8)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-second suites (supervised-restart integration etc.) "
+        "excluded from tier-1 runs via -m 'not slow'",
+    )
+
+
 @pytest.fixture(autouse=True)
 def clear_parse_graph():
     from pathway_tpu.internals.parse_graph import G

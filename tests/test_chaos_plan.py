@@ -1,0 +1,276 @@
+"""Fault plans: parsing, deterministic schedules, zero-cost disarmed sites.
+
+Satellite coverage for the chaos subsystem (ISSUE 2):
+- the same seed + plan yields byte-identical injection schedules;
+- an unarmed plan adds zero injection sites (guard-object identity);
+- site behavior: tick crash, persistence fail/torn, comm.local drop.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+
+import pytest
+
+from pathway_tpu import chaos
+from pathway_tpu.chaos.injector import ChaosBackend
+from pathway_tpu.persistence.backends import MemoryBackend
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    chaos.disarm()
+    yield
+    chaos.disarm()
+
+
+# -- parsing ---------------------------------------------------------------
+
+
+def test_plan_from_json_and_env_file(tmp_path, monkeypatch):
+    doc = {
+        "seed": 9,
+        "faults": [
+            {"site": "tick", "worker": 1, "tick": 3, "action": "crash"},
+            {"site": "comm.send", "process": 0, "nth": 2, "action": "drop"},
+        ],
+    }
+    plan = chaos.FaultPlan.from_json(json.dumps(doc))
+    assert plan.seed == 9 and len(plan.faults) == 2
+
+    # inline env
+    monkeypatch.setenv("PATHWAY_FAULT_PLAN", json.dumps(doc))
+    assert len(chaos.load_plan_from_env().faults) == 2
+    # file env
+    p = tmp_path / "plan.json"
+    p.write_text(json.dumps(doc))
+    monkeypatch.setenv("PATHWAY_FAULT_PLAN", str(p))
+    assert chaos.load_plan_from_env().seed == 9
+    # unset / empty
+    monkeypatch.setenv("PATHWAY_FAULT_PLAN", "  ")
+    assert chaos.load_plan_from_env() is None
+
+
+def test_plan_validation_rejects_nonsense():
+    with pytest.raises(ValueError, match="unknown site"):
+        chaos.FaultPlan.from_dict(
+            {"faults": [{"site": "warp", "action": "drop"}]}
+        )
+    with pytest.raises(ValueError, match="no action"):
+        chaos.FaultPlan.from_dict(
+            {"faults": [{"site": "tick", "tick": 1, "action": "drop"}]}
+        )
+    with pytest.raises(ValueError, match="need a 'tick'"):
+        chaos.FaultPlan.from_dict(
+            {"faults": [{"site": "tick", "action": "crash"}]}
+        )
+    with pytest.raises(ValueError, match="unknown fields"):
+        chaos.FaultPlan.from_dict(
+            {"faults": [{"site": "tick", "tick": 1, "action": "crash",
+                         "wat": 1}]}
+        )
+
+
+def test_run_gating():
+    plan = chaos.FaultPlan.from_dict({
+        "faults": [
+            {"site": "tick", "tick": 1, "action": "crash", "run": 0},
+            {"site": "tick", "tick": 2, "action": "crash", "run": 1},
+            {"site": "tick", "tick": 3, "action": "crash", "run": -1},
+        ],
+    })
+    assert [f.tick for f in plan.for_run(0).faults] == [1, 3]
+    assert [f.tick for f in plan.for_run(1).faults] == [2, 3]
+    assert [f.tick for f in plan.for_run(5).faults] == [3]
+
+
+# -- determinism -----------------------------------------------------------
+
+
+def _drive(armed: chaos.ActiveFaults, n_events: int = 200) -> bytes:
+    """Replay a fixed synthetic event sequence through every site kind and
+    serialize the resulting decision log."""
+    send = armed.send_faults(0)
+    local = armed.local_faults()
+    for i in range(n_events):
+        send.op_for(peer=1 + (i % 2))
+        local.apply(i % 4, ("x", 0, i), payload=[i])
+    return pickle.dumps(armed.decision_log)
+
+
+def test_same_seed_same_plan_byte_identical_schedule():
+    doc = {
+        "seed": 1234,
+        "faults": [
+            {"site": "comm.send", "process": 0, "prob": 0.2,
+             "action": "drop"},
+            {"site": "comm.send", "process": 0, "peer": 1, "prob": 0.05,
+             "action": "delay", "delay_s": 0.0},
+            {"site": "comm.local", "prob": 0.1, "action": "drop"},
+        ],
+    }
+    log_a = _drive(chaos.ActiveFaults(chaos.FaultPlan.from_dict(doc)))
+    log_b = _drive(chaos.ActiveFaults(chaos.FaultPlan.from_dict(doc)))
+    assert log_a == log_b
+    # and the schedule is non-trivial (some fired, some skipped)
+    decisions = pickle.loads(log_a)
+    assert any(d[3] for d in decisions) and not all(d[3] for d in decisions)
+
+    # a different seed reshuffles the probabilistic schedule
+    doc2 = {**doc, "seed": 4321}
+    log_c = _drive(chaos.ActiveFaults(chaos.FaultPlan.from_dict(doc2)))
+    assert log_c != log_a
+
+
+# -- disarmed = zero sites (identity checks) -------------------------------
+
+
+def test_unarmed_plan_adds_zero_injection_sites(monkeypatch):
+    monkeypatch.delenv("PATHWAY_FAULT_PLAN", raising=False)
+    assert chaos.current() is None
+
+    # executor: the tick guard is literal None
+    from pathway_tpu.engine.executor import Executor
+    from pathway_tpu.engine.operators import StaticSource
+
+    import numpy as np
+
+    ex = Executor([StaticSource(np.array([1], dtype=np.uint64), {"a": [1]})])
+    assert ex._tick_fault is None
+
+    # local comm: the rendezvous guard is literal None
+    from pathway_tpu.parallel.comm import LocalComm
+
+    assert LocalComm(2)._chaos is None
+
+    # persistence: wrap_backend returns the SAME object (identity)
+    b = MemoryBackend()
+    assert chaos.wrap_backend(b, worker_id=0) is b
+
+
+def test_armed_but_untargeted_worker_keeps_identity():
+    chaos.arm(chaos.FaultPlan.from_dict({
+        "faults": [{"site": "persistence.put", "worker": 3, "nth": 1,
+                    "action": "fail"}],
+    }), run=0)
+    b = MemoryBackend()
+    # worker 0 is not targeted: identity preserved
+    assert chaos.wrap_backend(b, worker_id=0) is b
+    # worker 3 is: wrapped
+    assert isinstance(chaos.wrap_backend(b, worker_id=3), ChaosBackend)
+
+
+# -- site behavior ---------------------------------------------------------
+
+
+def test_tick_crash_fires_at_exact_tick():
+    import pathway_tpu as pw
+    from pathway_tpu.testing import T
+
+    chaos.arm(chaos.FaultPlan.from_dict({
+        "faults": [{"site": "tick", "worker": 0, "tick": 0,
+                    "action": "crash"}],
+    }), run=0)
+    t = T("a\n1")
+    with pytest.raises(chaos.ChaosInjected, match="tick 0"):
+        pw.debug.table_to_pandas(t)
+    chaos.disarm()
+    t2 = T("a\n2")
+    assert len(pw.debug.table_to_pandas(t2)) == 1
+
+
+def test_chaos_backend_fail_and_torn():
+    armed = chaos.arm(chaos.FaultPlan.from_dict({
+        "faults": [
+            {"site": "persistence.put", "nth": 2, "key_prefix": "meta/",
+             "action": "fail"},
+        ],
+    }), run=0)
+    inner = MemoryBackend()
+    wrapped = armed.wrap_backend(inner, worker_id=0)
+    wrapped.put_value("chunks/c1", b"xx")  # prefix mismatch: not counted
+    wrapped.put_value("meta/meta-0", b"version-0")
+    with pytest.raises(chaos.ChaosInjected, match="fail"):
+        wrapped.put_value("meta/meta-1", b"version-1")
+    # the failed put landed nothing
+    assert inner.list_keys() == ["chunks/c1", "meta/meta-0"]
+
+    # torn: a truncated blob IS left behind, then the put raises
+    armed = chaos.arm(chaos.FaultPlan.from_dict({
+        "faults": [{"site": "persistence.put", "nth": 1, "action": "torn"}],
+    }), run=0)
+    inner = MemoryBackend()
+    wrapped = armed.wrap_backend(inner, worker_id=0)
+    with pytest.raises(chaos.ChaosInjected, match="torn"):
+        wrapped.put_value("meta/meta-0", b"0123456789")
+    assert inner.get_value("meta/meta-0") == b"01234"
+
+
+def test_torn_metadata_commit_is_survivable():
+    """A torn metadata blob (chaos 'torn' on a meta/ key) must not poison
+    recovery: MetadataAccessor skips unparseable versions."""
+    from pathway_tpu.persistence.snapshots import MetadataAccessor
+
+    inner = MemoryBackend()
+    acc = MetadataAccessor(inner)
+    acc.commit({"last_time": 4, "offsets": {}})
+    # torn second commit: half a JSON document
+    blob = json.dumps({"last_time": 9, "offsets": {}}).encode()
+    inner.put_value("meta/meta-00000001", blob[: len(blob) // 2])
+    reloaded = MetadataAccessor(inner)
+    assert reloaded.current == {"last_time": 4, "offsets": {}}
+
+
+def test_local_comm_drop_loses_exchange_contribution_only():
+    import threading
+
+    from pathway_tpu.parallel.comm import LocalComm
+
+    chaos.arm(chaos.FaultPlan.from_dict({
+        "faults": [{"site": "comm.local", "worker": 1, "nth": 1,
+                    "action": "drop"}],
+    }), run=0)
+    comm = LocalComm(2)
+    assert comm._chaos is not None
+    gathers: dict[int, list] = {}
+    exchanges: dict[int, list] = {}
+
+    def work(wid: int) -> None:
+        # control-plane allgathers are exempt from 'drop' (a lost cycle
+        # tuple is a crash, not a simulated lost frame) ...
+        gathers[wid] = comm.allgather("t", wid, f"from-{wid}")
+        # ... the data-plane exchange is where the drop lands
+        exchanges[wid] = comm.exchange(0, 2, wid, [f"{wid}->0", f"{wid}->1"])
+
+    ts = [threading.Thread(target=work, args=(w,)) for w in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(10)
+    assert gathers[0] == gathers[1] == ["from-0", "from-1"]
+    # worker 1's whole exchange contribution vanished; worker 0's arrived
+    assert exchanges[0] == ["0->0"]
+    assert exchanges[1] == ["0->1"]
+
+
+def test_persistence_faults_match_inside_worker_namespace(tmp_path):
+    """key_prefix 'meta/' must fire identically in sharded runs: the chaos
+    wrapper sits INSIDE the worker-{id}/ prefix, so plans are spelled the
+    same for 1 and N workers."""
+    from pathway_tpu.persistence import Backend, Config, PersistenceManager
+
+    chaos.arm(chaos.FaultPlan.from_dict({
+        "faults": [{"site": "persistence.put", "worker": 0, "nth": 1,
+                    "key_prefix": "meta/", "action": "fail"}],
+    }), run=0)
+    cfg = Config.simple_config(Backend.filesystem(str(tmp_path / "p")))
+    m = PersistenceManager(cfg, worker_id=0, n_workers=2)
+    assert isinstance(m.backend, ChaosBackend)
+    m.backend.put_value("chunks/chunk-00000000", b"rows")  # not counted
+    with pytest.raises(chaos.ChaosInjected, match="fail"):
+        m.backend.put_value("meta/meta-00000000", b"{}")
+    # the untargeted worker's backend is untouched (identity through the
+    # prefix view, no ChaosBackend layer)
+    m2 = PersistenceManager(cfg, worker_id=1, n_workers=2)
+    assert not isinstance(m2.backend, ChaosBackend)

@@ -1,0 +1,22 @@
+"""Tier-1 wrapper around scripts/chaos_smoke.py (like test_obs_smoke):
+the supervised crash-recovery loop — fault plan SIGKILLs worker 1
+mid-run, `spawn --supervise` restarts from the last common snapshot, and
+the final groupby counts are exact."""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    ),
+)
+
+
+def test_chaos_smoke(tmp_path):
+    from chaos_smoke import EXPECTED, run_smoke
+
+    result = run_smoke(workdir=str(tmp_path))
+    assert result["final"] == EXPECTED
+    assert result["generations"] == [0, 1]

@@ -1,0 +1,181 @@
+"""Fast failure propagation in the TCP cluster mesh.
+
+Satellite coverage for ISSUE 2: a dead peer must fail every blocked
+collective in milliseconds (notify_all on the `_broken` mark), never
+wait out the collective timeout; timeouts are env-tunable; mesh
+establishment names the unreachable peer.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import pytest
+
+from pathway_tpu.parallel.cluster import ClusterComm
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _mesh(n: int, threads_per_process: int = 1) -> dict[int, ClusterComm]:
+    port = _free_port()
+    comms: dict[int, ClusterComm] = {}
+
+    def make(pid: int) -> None:
+        comms[pid] = ClusterComm(
+            process_id=pid, n_processes=n,
+            threads_per_process=threads_per_process, first_port=port,
+        )
+
+    makers = [threading.Thread(target=make, args=(p,)) for p in range(n)]
+    for m in makers:
+        m.start()
+    for m in makers:
+        m.join(30)
+    assert set(comms) == set(range(n))
+    return comms
+
+
+def test_peer_death_unblocks_collectives_within_a_second():
+    """Worker 0 blocks in an allgather; process 1 dies (sockets torn).
+    The blocked collective must raise in < 1s — not at the 600s timeout —
+    and the error must name the failed peer."""
+    comms = _mesh(2)
+    outcome: dict = {}
+    entered = threading.Event()
+
+    def blocked() -> None:
+        t0 = time.monotonic()
+        entered.set()
+        try:
+            comms[0].allgather("never-completes", 0, "x")
+            outcome["result"] = "completed"
+        except RuntimeError as e:
+            outcome["error"] = str(e)
+            outcome["elapsed"] = time.monotonic() - t0
+
+    th = threading.Thread(target=blocked, daemon=True)
+    th.start()
+    assert entered.wait(5)
+    time.sleep(0.1)  # let the allgather actually block
+    # simulate process 1 dying: its sockets close, comm0's reader sees EOF
+    comms[1]._shutdown_sockets()
+    th.join(5)
+    assert not th.is_alive(), "collective still blocked after peer death"
+    assert "error" in outcome, outcome
+    assert outcome["elapsed"] < 1.0, (
+        f"propagation took {outcome['elapsed']:.2f}s (acceptance: < 1s)"
+    )
+    assert "peer worker failed" in outcome["error"]
+    assert "process 1" in outcome["error"], outcome["error"]
+    comms[0].close()
+
+
+def test_break_wakes_all_blocked_collectives_at_once():
+    """Several workers blocked in distinct collectives all unwind on one
+    `_broken` mark (the notify_all contract), each within the deadline."""
+    comms = _mesh(2, threads_per_process=2)
+    errors: list[tuple[int, float]] = []
+    lock = threading.Lock()
+
+    def blocked(wid: int) -> None:
+        t0 = time.monotonic()
+        try:
+            comms[0].allgather(("tag", wid), wid, wid)
+        except RuntimeError:
+            with lock:
+                errors.append((wid, time.monotonic() - t0))
+
+    ts = [
+        threading.Thread(target=blocked, args=(w,), daemon=True)
+        for w in (0, 1)  # both local workers of process 0
+    ]
+    for t in ts:
+        t.start()
+    time.sleep(0.15)
+    comms[1]._shutdown_sockets()
+    for t in ts:
+        t.join(5)
+    assert not any(t.is_alive() for t in ts), "a collective stayed blocked"
+    assert sorted(w for w, _ in errors) == [0, 1]
+    assert all(dt < 1.0 for _, dt in errors), errors
+    comms[0].close()
+
+
+def test_collective_timeout_env_knob(monkeypatch):
+    """PATHWAY_COLLECTIVE_TIMEOUT_S bounds a silent stall (no peer death,
+    just a missing contribution) and the error names the missing workers."""
+    monkeypatch.setenv("PATHWAY_COLLECTIVE_TIMEOUT_S", "0.3")
+    comms = _mesh(2)
+    assert comms[0].collective_timeout_s == 0.3
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="timed out") as ei:
+        # process 1 never contributes: a stall, not a death
+        comms[0].allgather("lonely", 0, "x")
+    assert time.monotonic() - t0 < 5.0
+    assert "workers [1]" in str(ei.value)
+    for c in comms.values():
+        c.close()
+
+
+def test_connect_timeout_env_knob_names_unreachable_peer(monkeypatch):
+    """Mesh establishment: an unreachable peer fails fast (tunable) and
+    the error names the peer process and its address."""
+    monkeypatch.setenv("PATHWAY_CONNECT_TIMEOUT_S", "0.5")
+    port = _free_port()  # nothing listens here
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="peer process 0") as ei:
+        ClusterComm(
+            process_id=1, n_processes=2, threads_per_process=1,
+            first_port=port,
+        )
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10.0, f"connect retry ran {elapsed:.1f}s past its budget"
+    assert f"127.0.0.1:{port}" in str(ei.value)
+
+
+def test_sever_fault_partitions_the_mesh():
+    """A chaos 'sever' on the link tears the socket; both sides propagate
+    the failure instead of hanging."""
+    from pathway_tpu import chaos
+
+    chaos.arm(chaos.FaultPlan.from_dict({
+        "faults": [{"site": "comm.send", "process": 0, "peer": 1,
+                    "nth": 1, "action": "sever"}],
+    }), run=0)
+    try:
+        comms = _mesh(2)
+        results: dict[int, str] = {}
+
+        def gather(pid: int) -> None:
+            try:
+                comms[pid].allgather("t", pid, pid)
+                results[pid] = "ok"
+            except RuntimeError:
+                results[pid] = "failed"
+
+        ts = [
+            threading.Thread(target=gather, args=(p,), daemon=True)
+            for p in (0, 1)
+        ]
+        t0 = time.monotonic()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        assert not any(t.is_alive() for t in ts)
+        # process 0's first frame to 1 severed the link: both sides fail
+        assert results[0] == "failed"
+        assert time.monotonic() - t0 < 5.0
+        for c in comms.values():
+            c.close()
+    finally:
+        chaos.disarm()

@@ -382,3 +382,70 @@ def test_s3_backend_sharded_worker_namespaces():
     assert w1.get_value("snap") == b"one"
     assert w0.list_keys() == ["snap"]
     assert shared.list_keys() == ["worker-0/snap", "worker-1/snap"]
+
+
+# -- cluster marker (resharding guard) — ISSUE 2 satellite ------------------
+
+
+def test_cluster_marker_mismatch_names_backend_location(tmp_path):
+    """Resharding refusal must say WHERE the offending state lives and
+    keep the original worker count in the message."""
+    from pathway_tpu.persistence import PersistenceManager
+    from pathway_tpu.persistence.backends import FilesystemBackend
+
+    path = str(tmp_path / "pstate")
+    cfg = Config.simple_config(Backend.filesystem(path))
+    m = PersistenceManager(cfg, worker_id=0, n_workers=2)
+    # commit real metadata so the marker is backed by state
+    root = FilesystemBackend(path)
+    root.put_value("worker-0/meta/meta-00000000", b'{"last_time": 4}')
+    m.close()
+
+    with pytest.raises(RuntimeError) as ei:
+        PersistenceManager(cfg, worker_id=0, n_workers=3)
+    msg = str(ei.value)
+    assert path in msg, msg
+    assert "2 worker(s)" in msg and "has 3" in msg
+
+
+def test_cluster_marker_tolerates_crashed_first_boot(tmp_path):
+    """A marker with ZERO committed metadata versions behind it (first boot
+    crashed between marker write and first commit) is rewritten, not
+    refused — there is no state to reshard."""
+    from pathway_tpu.persistence import PersistenceManager
+    from pathway_tpu.persistence.backends import FilesystemBackend
+
+    path = str(tmp_path / "pstate")
+    cfg = Config.simple_config(Backend.filesystem(path))
+    # the crashed boot: marker says 4 workers, nothing else persisted
+    FilesystemBackend(path).put_value("cluster", b'{"n_workers": 4}')
+
+    m = PersistenceManager(cfg, worker_id=0, n_workers=2)  # no raise
+    m.close()
+    import json as _json
+
+    marker = _json.loads(FilesystemBackend(path).get_value("cluster"))
+    assert marker == {"n_workers": 2}  # adopted the new layout
+
+    # and now that metadata exists, a THIRD layout is refused again
+    root = FilesystemBackend(path)
+    root.put_value("worker-0/meta/meta-00000000", b'{"last_time": 0}')
+    with pytest.raises(RuntimeError, match="2 worker"):
+        PersistenceManager(cfg, worker_id=0, n_workers=4)
+
+
+def test_backend_describe_locations(tmp_path):
+    from pathway_tpu.persistence.backends import (
+        FilesystemBackend,
+        MemoryBackend as _MB,
+        PrefixBackend,
+        S3Backend,
+    )
+
+    fs = FilesystemBackend(tmp_path / "x")
+    assert str(tmp_path / "x") == fs.describe()
+    assert PrefixBackend(fs, "worker-1/").describe().endswith("worker-1/")
+    assert _MB("named").describe() == "memory://named"
+    assert S3Backend(
+        "s3://bucket/pre", client=object()
+    ).describe() == "s3://bucket/pre/"

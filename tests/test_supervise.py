@@ -1,0 +1,278 @@
+"""Supervisor runtime: restart policy, circuit breaker, teardown — plus
+the slow end-to-end sharded SIGKILL-recovery suite (satellite of ISSUE 2,
+the 2-process variant of test_recovery_sigkill.py).
+
+The fast tests drive :class:`Supervisor` with trivial non-engine children
+(no jax import), so the restart/backoff/breaker logic is tier-1 cheap;
+the multi-second supervised-restart integration runs are marked ``slow``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from pathway_tpu.parallel.supervisor import EXIT_CIRCUIT_OPEN, Supervisor
+
+
+def _child(code: str) -> subprocess.Popen:
+    return subprocess.Popen([sys.executable, "-c", code])
+
+
+def _quiet(_msg: str) -> None:
+    pass
+
+
+def test_clean_exit_no_restart():
+    launches: list[int] = []
+
+    def launch(gen, reason):
+        launches.append(gen)
+        return [_child("pass"), _child("pass")]
+
+    sup = Supervisor(launch, backoff_s=0.01, log=_quiet)
+    assert sup.run() == 0
+    assert launches == [0]
+    assert sup.restarts_total == 0
+
+
+def test_restart_then_success(tmp_path):
+    marker = tmp_path / "second_try"
+
+    def launch(gen, reason):
+        if gen == 0:
+            assert reason is None
+            return [_child("pass"), _child("import sys; sys.exit(3)")]
+        assert "exited with 3" in reason
+        marker.write_text(reason)
+        return [_child("pass"), _child("pass")]
+
+    sup = Supervisor(launch, backoff_s=0.01, backoff_max_s=0.05, log=_quiet)
+    assert sup.run() == 0
+    assert sup.restarts_total == 1
+    assert "exited with 3" in marker.read_text()
+    # the restart environment contract (what cli.py stamps from these)
+    assert sup.last_restart_reason and "process 1" in sup.last_restart_reason
+
+
+def test_circuit_breaker_opens_on_crash_loop():
+    launches: list[int] = []
+
+    def launch(gen, reason):
+        launches.append(gen)
+        return [_child("import sys; sys.exit(1)")]
+
+    sup = Supervisor(
+        launch, max_restarts=2, window_s=60.0, backoff_s=0.01,
+        backoff_max_s=0.02, log=_quiet,
+    )
+    assert sup.run() == EXIT_CIRCUIT_OPEN
+    # gen 0..2 fail; the third failure inside the window opens the breaker
+    assert launches == [0, 1, 2]
+    assert sup.restarts_total == 2
+
+
+def test_window_slides_old_failures_out():
+    """Failures spaced wider than the window never accumulate to the
+    breaker limit; the run ends via eventual success, not EXIT 75."""
+    calls: list[int] = []
+
+    def launch(gen, reason):
+        calls.append(gen)
+        if gen < 3:
+            return [_child("import sys; sys.exit(9)")]
+        return [_child("pass")]
+
+    sup = Supervisor(
+        launch, max_restarts=1, window_s=0.05, backoff_s=0.08,
+        backoff_max_s=0.08, log=_quiet,
+    )
+    # each backoff (≥ 0.04s jittered) outlasts the 0.05s window often
+    # enough; with rng pinned to max jitter it always does
+    sup._rng = lambda: 0.999
+    assert sup.run() == 0
+    assert calls == [0, 1, 2, 3]
+
+
+def test_teardown_sigterm_then_sigkill(tmp_path):
+    """A survivor that honors SIGTERM exits in the grace window; one that
+    ignores it is SIGKILLed."""
+    ready_p, ready_s = tmp_path / "p", tmp_path / "s"
+    polite = _child(
+        "import pathlib, signal, time\n"
+        "signal.signal(signal.SIGTERM, lambda *a: exit(0))\n"
+        f"pathlib.Path({str(ready_p)!r}).touch()\n"
+        "time.sleep(60)"
+    )
+    stubborn = _child(
+        "import pathlib, signal, time\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        f"pathlib.Path({str(ready_s)!r}).touch()\n"
+        "time.sleep(60)"
+    )
+    deadline = time.monotonic() + 20
+    while not (ready_p.exists() and ready_s.exists()):
+        assert time.monotonic() < deadline, "children never signalled ready"
+        time.sleep(0.02)
+    sup = Supervisor(lambda g, r: [], grace_s=1.0, log=_quiet)
+    t0 = time.monotonic()
+    sup._teardown([polite, stubborn])
+    assert polite.returncode == 0
+    assert stubborn.returncode == -signal.SIGKILL
+    assert time.monotonic() - t0 < 10.0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: 2-process sharded wordcount, one worker SIGKILLed per
+# generation, supervised restart from the last common snapshot. The
+# wordcount program + event parsing are shared with scripts/chaos_smoke.py
+# (one harness, two suites — this one adds a second kill and is `slow`).
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    ),
+)
+from chaos_smoke import (  # noqa: E402
+    EXPECTED as _EXPECTED,
+    _PROGRAM,
+    _events,
+    _free_port,
+)
+
+
+@pytest.mark.slow
+def test_sharded_sigkill_supervised_recovery(tmp_path):
+    """SIGKILL a different worker in each of two generations; the third
+    generation finishes. Final counts are exact — recovered from the last
+    operator snapshot COMMON to both workers, with the recorded input
+    tail replayed (at-least-once callbacks, exactly-once final state)."""
+    prog = tmp_path / "prog.py"
+    prog.write_text(textwrap.dedent(_PROGRAM))
+    out = tmp_path / "events.jsonl"
+    pstate = tmp_path / "pstate"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    plan = {
+        "seed": 3,
+        "faults": [
+            {"site": "tick", "worker": 1, "tick": 8, "action": "kill",
+             "run": 0},
+            {"site": "tick", "worker": 0, "tick": 14, "action": "kill",
+             "run": 1},
+        ],
+    }
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo_root,
+        "PATHWAY_FAULT_PLAN": json.dumps(plan),
+        "PATHWAY_SUPERVISE_BACKOFF_S": "0.05",
+        "PATHWAY_SUPERVISE_BACKOFF_MAX_S": "0.2",
+    }
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pathway_tpu.cli", "spawn",
+            "--supervise", "-n", "2", "-t", "1",
+            "--first-port", str(_free_port()),
+            sys.executable, str(prog), str(out), str(pstate),
+        ],
+        env=env, timeout=300, capture_output=True, text=True,
+    )
+    events = _events(out)
+    assert proc.returncode == 0, (
+        f"exit {proc.returncode}\nstderr:\n{proc.stderr[-4000:]}\n"
+        f"events tail: {events[-15:]}"
+    )
+    generations = sorted({e[1] for e in events if e[0] == "gen"})
+    assert generations == [0, 1, 2], (generations, proc.stderr[-2000:])
+
+    # both kills landed mid-stream: no generation before the last saw the
+    # complete final counts
+    expected = _EXPECTED
+    gen_starts = [
+        i for i, e in enumerate(events) if e[0] == "gen" and e[2] == 0
+    ]
+    for upto in gen_starts[1:]:
+        partial = {
+            e[0]: e[1] for e in events[:upto] if e[0] != "gen" and e[2]
+        }
+        assert partial != expected, "a kill landed after stream completion"
+
+    final = {e[0]: e[1] for e in events if e[0] != "gen" and e[2]}
+    assert final == expected, (final, proc.stderr[-2000:])
+
+    # the state both generations recovered from really is shared: one
+    # cluster marker, per-worker namespaces, committed metadata for both
+    keys = [
+        os.path.relpath(os.path.join(dp, fn), pstate)
+        for dp, _, fs in os.walk(pstate) for fn in fs
+    ]
+    assert any(k.startswith("worker-0/meta/") for k in keys), keys
+    assert any(k.startswith("worker-1/meta/") for k in keys), keys
+
+
+# ---------------------------------------------------------------------------
+# self-healing observability surface
+
+
+def test_restart_metrics_exported(monkeypatch):
+    """The supervisor's restart stamps (PATHWAY_RESTART_COUNT /
+    PATHWAY_LAST_RESTART_REASON) surface on /metrics through the hub,
+    with the reason as an escaped label."""
+    from pathway_tpu.observability import ObservabilityHub
+    from pathway_tpu.observability.prometheus import parse_exposition
+
+    monkeypatch.setenv("PATHWAY_SUPERVISED", "1")
+    monkeypatch.setenv("PATHWAY_RESTART_COUNT", "2")
+    monkeypatch.setenv(
+        "PATHWAY_LAST_RESTART_REASON", 'process 1 (pid 7) exited with "-9"'
+    )
+    hub = ObservabilityHub()
+    series = parse_exposition(hub.render_metrics())
+    assert series[("pathway_restarts_total", ())] == 2
+    reasons = {
+        dict(labels)["reason"]: v
+        for (name, labels), v in series.items()
+        if name == "pathway_last_restart_reason"
+    }
+    assert reasons == {'process 1 (pid 7) exited with "-9"': 1.0}
+
+
+def test_no_restart_metrics_outside_supervision(monkeypatch):
+    from pathway_tpu import chaos
+    from pathway_tpu.observability import ObservabilityHub
+
+    chaos.disarm()
+    for k in ("PATHWAY_SUPERVISED", "PATHWAY_RESTART_COUNT",
+              "PATHWAY_LAST_RESTART_REASON"):
+        monkeypatch.delenv(k, raising=False)
+    body = ObservabilityHub().render_metrics()
+    assert "pathway_restarts_total" not in body
+
+
+def test_chaos_injections_metric(monkeypatch):
+    from pathway_tpu import chaos
+    from pathway_tpu.observability import ObservabilityHub
+    from pathway_tpu.observability.prometheus import parse_exposition
+
+    for k in ("PATHWAY_SUPERVISED", "PATHWAY_RESTART_COUNT",
+              "PATHWAY_LAST_RESTART_REASON"):
+        monkeypatch.delenv(k, raising=False)
+    armed = chaos.arm(chaos.FaultPlan.from_dict({
+        "faults": [{"site": "comm.local", "nth": 1, "action": "drop"}],
+    }), run=0)
+    try:
+        # fires the nth=1 drop (exchange key — drops are data-plane only)
+        armed.local_faults().apply(0, ("x", 0, 2), ["payload"])
+        series = parse_exposition(ObservabilityHub().render_metrics())
+        assert series[("pathway_chaos_injections_total", ())] == 1
+    finally:
+        chaos.disarm()
