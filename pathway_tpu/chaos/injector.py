@@ -128,6 +128,20 @@ class ActiveFaults:
             self.decision_log.append((idx, scope, n, fired))
             if fired:
                 self.injections_total += 1
+        if fired:
+            # black-box note BEFORE the fault executes: a chaos SIGKILL's
+            # flight-recorder tail then documents its own cause
+            from ..observability.flightrecorder import get_recorder
+
+            recorder = get_recorder()
+            if recorder is not None:
+                recorder.record(
+                    "chaos.fired",
+                    site=fault.site,
+                    action=fault.action,
+                    scope=scope,
+                    event=n,
+                )
         return fired
 
     # -- site resolution (construction-time) -----------------------------

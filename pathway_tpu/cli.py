@@ -1,7 +1,9 @@
 """``pathway`` CLI (reference ``python/pathway/cli.py:53-280``):
 ``spawn`` launches a program over N processes × T threads with the worker
 environment set; ``replay`` re-runs a program against recorded input
-(``--record`` under spawn captures it).
+(``--record`` under spawn captures it); ``trace merge`` assembles the
+per-process ``PATHWAY_TRACE_FILE`` parts of a cluster run into one
+clock-aligned Perfetto timeline.
 
 Run as ``python -m pathway_tpu.cli`` or the ``pathway-tpu`` entry point.
 """
@@ -9,6 +11,7 @@ Run as ``python -m pathway_tpu.cli`` or the ``pathway-tpu`` entry point.
 from __future__ import annotations
 
 import os
+import secrets
 import subprocess
 import sys
 
@@ -16,7 +19,7 @@ import click
 
 from .internals.config import MAX_WORKERS
 
-__all__ = ["main", "spawn", "replay"]
+__all__ = ["main", "spawn", "replay", "trace"]
 
 
 @click.group()
@@ -48,6 +51,23 @@ def _spawn_processes(
         "PATHWAY_FIRST_PORT": str(first_port),
         **env_extra,
     }
+    # one run identity for the whole ensemble: tracers mint cross-process
+    # flow ids under it and `trace merge` refuses to mix different runs.
+    # A multi-host ensemble runs spawn once per machine, so the generated
+    # default cannot agree across machines — tell the operator to pin one.
+    if (
+        addresses
+        and "PATHWAY_RUN_ID" not in os.environ
+        and os.environ.get("PATHWAY_TRACE_FILE")
+    ):
+        click.echo(
+            "warning: multi-host traced run without PATHWAY_RUN_ID — each "
+            "machine's spawn will mint its own run id and `trace merge` "
+            "will refuse to join the parts; export the same "
+            "PATHWAY_RUN_ID on every machine",
+            err=True,
+        )
+    base_env.setdefault("PATHWAY_RUN_ID", secrets.token_hex(8))
     if addresses:
         entries = [a.strip() for a in addresses.split(",") if a.strip()]
         if len(entries) != processes:
@@ -113,11 +133,22 @@ def _run_supervised(
     parallel/supervisor.py for the backoff/circuit-breaker contract."""
     from .parallel.supervisor import Supervisor
 
+    # always-on black box under supervision: each child keeps an mmap ring
+    # of its last ticks (observability/flightrecorder.py) which the
+    # supervisor harvests into crash-<gen>-<proc>.json bundles on failure
+    base_env.setdefault(
+        "PATHWAY_FLIGHT_DIR", os.path.join(os.getcwd(), "pathway-flight")
+    )
+
     def launch(generation: int, reason: str | None):
+        # late-binds `sup` below; Supervisor.run() only calls launch()
+        # after construction completes
         env = {
             **base_env,
             "PATHWAY_SUPERVISED": "1",
             "PATHWAY_RESTART_COUNT": str(generation),
+            # forensic-bundle count so far → pathway_flight_recorder_dumps_total
+            "PATHWAY_FLIGHT_DUMPS": str(sup.flight_dumps_total),
         }
         if reason is not None:
             env["PATHWAY_LAST_RESTART_REASON"] = reason
@@ -142,11 +173,15 @@ def _run_supervised(
             base = 0
         if base:
             health_ports = [base + pid for pid in pids]
-    return Supervisor(
+    sup = Supervisor(
         launch,
         health_ports=health_ports,
         labels=[f"process {pid}" for pid in pids],
-    ).run()
+        flight_dir=base_env.get("PATHWAY_FLIGHT_DIR"),
+        process_ids=pids,
+        run_id=base_env.get("PATHWAY_RUN_ID"),
+    )
+    return sup.run()
 
 
 @main.command(context_settings={"ignore_unknown_options": True})
@@ -206,6 +241,39 @@ def replay(threads, processes, record_path, mode, continue_after_replay, program
     if continue_after_replay:
         env_extra["PATHWAY_CONTINUE_AFTER_REPLAY"] = "1"
     sys.exit(_spawn_processes(threads, processes, 10000, env_extra, program))
+
+
+@main.group()
+def trace() -> None:
+    """Distributed-trace tooling (PATHWAY_TRACE_FILE)."""
+
+
+@trace.command()
+@click.argument("base")
+@click.option("-o", "--output", type=str, default=None,
+              help="merged timeline path (default: <base>.merged.json)")
+@click.option("--allow-mixed-runs", is_flag=True, default=False,
+              help="merge parts with different run ids anyway")
+def merge(base, output, allow_mixed_runs):
+    """Merge per-process trace parts into one cluster timeline.
+
+    BASE is the PATHWAY_TRACE_FILE value of the run; the per-process
+    ``BASE.p<N>`` parts (or BASE itself for a single-process run) are
+    assembled into one clock-aligned Chrome/Perfetto JSON, using the
+    per-peer clock offsets estimated during mesh establishment and
+    cross-linking workers via the comm flow events."""
+    from .observability.trace_merge import merge_trace
+
+    try:
+        out_path, report = merge_trace(
+            base, output=output, allow_mixed_runs=allow_mixed_runs
+        )
+    except (OSError, ValueError) as e:
+        raise click.ClickException(str(e))
+    click.echo(
+        f"merged {report['n_parts']} part(s), {report['n_events']} events "
+        f"({report['n_flows']} flow events) -> {out_path}"
+    )
 
 
 if __name__ == "__main__":

@@ -369,6 +369,18 @@ class Executor:
         from ..internals.tracing import get_tracer
 
         self.tracer = get_tracer()
+        # black box (observability/flightrecorder.py): None unless a flight
+        # dir is configured — one None check per tick when disarmed
+        from ..observability.flightrecorder import get_recorder
+
+        self.flight = get_recorder()
+        if self.flight is not None and armed is not None:
+            self.flight.record(
+                "chaos.armed",
+                worker=self.ctx.worker_id,
+                run=armed.run,
+                faults=len(armed.plan.faults),
+            )
         if persistence is not None:
             # sharded mode: commits are a coordinated collective decided in
             # _stream_loop_sharded, never a per-worker wall-clock whim — all
@@ -403,6 +415,13 @@ class Executor:
         stateless = not any(n.has_state() for n in self.nodes)
         if stateless:
             K._suspend_registration(+1)  # thread-local: this executor only
+        if self.flight is not None:
+            self.flight.record(
+                "run.start",
+                worker=self.ctx.worker_id,
+                n_workers=self.ctx.n_workers,
+                n_nodes=len(self.nodes),
+            )
         try:
             if self.tracer is not None:
                 try:
@@ -423,6 +442,21 @@ class Executor:
                         self.tracer.flush()
             else:
                 self._run_inner()
+            if self.flight is not None:
+                self.flight.record(
+                    "run.end",
+                    worker=self.ctx.worker_id,
+                    ticks=self.stats.ticks,
+                    rows=self.stats.rows_total,
+                )
+        except BaseException as e:
+            if self.flight is not None:
+                # the ring is the only record a crashed worker leaves —
+                # name the failure before it propagates
+                self.flight.record(
+                    "run.error", worker=self.ctx.worker_id, error=repr(e)
+                )
+            raise
         finally:
             if stateless:
                 K._suspend_registration(-1)
@@ -809,15 +843,31 @@ class Executor:
             self.persistence.on_time_end(time)
         if tracer is not None:
             # after the callbacks and the persistence commit: both can
-            # dominate a tick and must show inside its span
-            tracer.complete("tick", tick_t0, {"time": time})
-            # worker id in the name: counter tracks merge by (pid, name)
-            tracer.counter(
-                f"engine_rows.w{self.ctx.worker_id}",
-                {
-                    "input": self.stats.input_rows,
-                    "output": self.stats.output_rows,
-                },
+            # dominate a tick and must show inside its span. Span + counter
+            # go in ONE append (worker id in the counter name: counter
+            # tracks merge by (pid, name)) so the ring-buffer drop can
+            # never orphan the sample from its tick.
+            tracer.complete(
+                "tick",
+                tick_t0,
+                {"time": time},
+                counter=(
+                    f"engine_rows.w{self.ctx.worker_id}",
+                    {
+                        "input": self.stats.input_rows,
+                        "output": self.stats.output_rows,
+                    },
+                ),
+            )
+        if self.flight is not None:
+            self.flight.record(
+                "tick",
+                worker=self.ctx.worker_id,
+                time=time if time != END_TIME else -1,
+                seq=self._tick_seq - 1,
+                dur_ms=round((_wall.perf_counter_ns() - tick_t0) / 1e6, 3),
+                rows=self.stats.rows_total,
+                out=self.stats.output_rows,
             )
 
     def _route(

@@ -130,6 +130,11 @@ class MeshExchangeRunner:
         # observability counters (read via Comm.comm_stats → /metrics)
         self.collectives = 0
         self.rows_moved = 0
+        # cached like every other instrumented site — the per-tick hot
+        # path must not pay module lookups when tracing is off
+        from ..internals.tracing import get_tracer
+
+        self._tracer = get_tracer()
 
     def note_collective(self, rows: int) -> None:
         self.collectives += 1
@@ -157,11 +162,15 @@ class MeshExchangeRunner:
         or None when the tick moves no rows."""
         import jax
 
+        import time as _time
+
         counts_all = [p[1] for p in payloads]
         total_rows = sum(int(c.sum()) for c in counts_all)
         if total_rows == 0:
             return None
         self.note_collective(total_rows)
+        tracer = self._tracer
+        t0 = _time.perf_counter_ns() if tracer is not None else 0
         kinds = agree_kinds([p[0] for p in payloads], len(column_names))
         cap_in = _pow2(max(int(c.sum()) for c in counts_all))
         cap_bucket = _pow2(max(int(c.max()) for c in counts_all))
@@ -177,6 +186,15 @@ class MeshExchangeRunner:
         out_vals, out_valid = self._kernel(cap_in, cap_bucket, width)(
             gvals, gdest
         )
+        if tracer is not None:
+            # the driver-side pack+ship+collective — the one span that
+            # shows where an ICI tick's time actually went
+            tracer.complete(
+                "mesh.collective",
+                t0,
+                {"rows": total_rows, "cap_in": cap_in,
+                 "cap_bucket": cap_bucket},
+            )
         return (kinds, cap_bucket, out_vals, out_valid)
 
     def _mesh_shardings(self):

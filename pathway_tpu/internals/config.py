@@ -70,6 +70,12 @@ class PathwayConfig:
     #: telemetry analog of src/engine/telemetry.rs for a no-egress world)
     trace_file: str | None = field(
         default_factory=lambda: os.environ.get("PATHWAY_TRACE_FILE"))
+    # NOTE: PATHWAY_RUN_ID / PATHWAY_FLIGHT_DIR / PATHWAY_FLIGHT_RING_KB are
+    # deliberately NOT snapshotted here — the tracer must initialize even
+    # when config validation refuses the worker layout, and the flight
+    # recorder re-reads its env per restart generation; both read the
+    # environment directly (internals/tracing.py,
+    # observability/flightrecorder.py), like the PATHWAY_SUPERVISE_* knobs.
     # observability (engine/http_server.py + observability/)
     #: force the monitoring HTTP server on without a code change (the
     #: with_http_server=True analog for spawn-style deployments)

@@ -206,7 +206,12 @@ class OtlpExporter:
             return False
 
     def export(self, tracer: Any) -> dict[str, bool]:
-        """Push the tracer's current buffer; returns per-signal success."""
+        """Push the tracer's current buffer; returns per-signal success.
+
+        One-shot/diagnostic surface only: it ignores the ``_otlp_mark``
+        cursor, so mixing it with the periodic flusher would double-export
+        — incremental callers go through ``export_events`` +
+        ``Tracer.events_since`` instead."""
         with tracer._lock:
             events = list(tracer._events)
             origin = tracer._origin
@@ -254,7 +259,7 @@ def export_from_env(tracer: Any | None) -> None:
     eps = {e for e in endpoints if e}
     if not eps:
         return
-    events, mark = tracer.events_since(getattr(tracer, "_otlp_mark", 0))
+    events, mark = tracer.events_since(tracer._otlp_mark)
     if not events:
         return
     tracer._otlp_mark = mark

@@ -28,13 +28,22 @@ Span taxonomy (mirrors the reference's span names where it has them):
 
 Multi-process runs write one file per process (``<path>.p<process_id>``,
 like the per-process metrics ports of ``engine/http_server.rs:21``);
-worker threads separate naturally by ``tid``.
+worker threads separate naturally by ``tid``. Cross-process linkage is
+Dapper-style: every tracer carries a cluster-wide ``run_id``
+(``PATHWAY_RUN_ID``, stamped by ``pathway-tpu spawn``), comm frames ship a
+``(run_id, flow_id)`` trace context, and both ends emit Chrome flow
+events (``ph: s``/``f``) bound by that id — ``pathway-tpu trace merge``
+assembles the per-process files into one clock-aligned cluster timeline
+(``observability/trace_merge.py``), using the per-peer clock offsets the
+cluster handshake estimates (``parallel/cluster.py``) and records here via
+:meth:`Tracer.set_clock_offsets`.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import secrets
 import threading
 import time
 from typing import Any
@@ -45,8 +54,29 @@ __all__ = [
     "deactivate",
     "get_tracer",
     "init_from_env",
+    "mint_flow_tag",
     "span",
 ]
+
+
+def mint_flow_tag() -> str:
+    """Per-comm-instance disambiguator for deterministic flow ids (ids are
+    ``<run_id>/<tag>/...``): several comm backends — or repeated ``pw.run``
+    calls under ``activate()`` — share one tracer, and two instances
+    minting ids from the same (channel, tick) coordinates must not
+    collide. One shared definition so every comm layer's ids stay
+    mergeable by the same scheme."""
+    return secrets.token_hex(2)
+
+
+def make_flow_id(tracer: "Tracer", tag: str, *coords: Any) -> str:
+    """THE flow-id scheme: ``<run_id>/<tag>/<coord>/...``. Every comm
+    backend builds its ids here — the run id scopes them cluster-wide,
+    ``tag`` (a :func:`mint_flow_tag`) scopes them per comm instance, and
+    the coordinates make them deterministic so sender and receiver can
+    mint the same id without shipping context (LocalComm/MeshComm) or
+    ship it once per frame (ClusterComm)."""
+    return "/".join([tracer.run_id, tag, *map(str, coords)])
 
 
 class _Span:
@@ -77,8 +107,21 @@ class Tracer:
         self._events: list[dict[str, Any]] = []
         self._lock = threading.Lock()
         self._pid = os.getpid()
+        #: cluster-wide run identity: every process of one spawn shares it
+        #: (the CLI stamps PATHWAY_RUN_ID), so flow ids minted here are
+        #: unique AND recognizable across the whole ensemble's trace files
+        self.run_id = os.environ.get("PATHWAY_RUN_ID") or secrets.token_hex(4)
+        #: wall-clock anchor of the perf_counter origin — what lets the
+        #: merge CLI (and the OTLP exporters) place this process's relative
+        #: timestamps on a shared unix timeline
+        unix_now = time.time_ns()
         #: perf_counter origin so timestamps start near zero in the viewer
         self._origin = time.perf_counter_ns()
+        self.origin_unix_ns = unix_now
+        #: peer process id -> (unix-clock offset ns, rtt ns), estimated by
+        #: the cluster handshake ping (ClusterComm); written to the trace
+        #: file so `trace merge` can align per-host clocks
+        self._clock_offsets: dict[int, tuple[float, float]] = {}
         #: streaming pipelines run forever (run.py) — bound the buffer so
         #: tracing a long-lived run keeps the most recent window instead of
         #: growing without limit; oldest half is dropped on overflow
@@ -90,6 +133,9 @@ class Tracer:
         self._dropped = 0
         self._appended = 0
         self._flush_mark = -1  # _appended value at the last write
+        #: incremental-export cursor shared by the periodic OTLP flusher
+        #: and the end-of-run push (internals/telemetry.py)
+        self._otlp_mark = 0
 
     # -- recording ----------------------------------------------------
 
@@ -101,9 +147,17 @@ class Tracer:
         return _Span(self, name, args)
 
     def complete(
-        self, name: str, t0_ns: int, args: dict[str, Any] | None = None
+        self,
+        name: str,
+        t0_ns: int,
+        args: dict[str, Any] | None = None,
+        counter: tuple[str, dict[str, float]] | None = None,
     ) -> None:
-        """A finished duration event that began at ``t0_ns``."""
+        """A finished duration event that began at ``t0_ns``. With
+        ``counter=(name, values)`` a counter sample is appended in the SAME
+        lock acquisition, so the pair is adjacent in the buffer and the
+        overflow drop can never orphan the sample from its span (the
+        executor's per-tick row counters use this)."""
         ev = {
             "name": name,
             "ph": "X",
@@ -114,14 +168,34 @@ class Tracer:
         }
         if args:
             ev["args"] = args
-        self._append(ev)
+        if counter is None:
+            self._append(ev)
+            return
+        cname, values = counter
+        cev = {
+            "name": cname,
+            "ph": "C",
+            "ts": ev["ts"] + ev["dur"],
+            "pid": self._pid,
+            "args": values,
+        }
+        self._append(ev, cev)
 
-    def _append(self, ev: dict[str, Any]) -> None:
+    def _append(self, *evs: dict[str, Any]) -> None:
         with self._lock:
-            self._events.append(ev)
-            self._appended += 1
+            self._events.extend(evs)
+            self._appended += len(evs)
             if len(self._events) > self._max_events:
-                drop = len(self._events) // 2
+                n = len(self._events)
+                drop = n // 2
+                # span-boundary-consistent chunking: never let the kept
+                # window BEGIN with a counter sample whose owning span was
+                # just dropped (complete(..., counter=...) appends the pair
+                # adjacently, so skipping leading "C" events preserves it)
+                while drop < n and self._events[drop].get("ph") == "C":
+                    drop += 1
+                if drop >= n:  # pathological all-counter buffer
+                    drop = n // 2
                 self._dropped += drop
                 del self._events[:drop]
 
@@ -153,12 +227,65 @@ class Tracer:
             }
         )
 
+    # -- cross-worker flow linkage ------------------------------------
+
+    def flow_start(self, name: str, flow_id: str, **args: Any) -> None:
+        """Begin a Chrome flow (``ph: s``) — the sending half of a
+        cross-worker arrow. The event must fall inside a duration slice on
+        this thread (comm call sites sit inside the tick span); the
+        receiving side closes the flow with :meth:`flow_end` using the
+        SAME id, which travels in the comm frame's trace context."""
+        ev = {
+            "name": name,
+            "cat": "comm",
+            "ph": "s",
+            "id": str(flow_id),
+            "ts": self._ts(time.perf_counter_ns()),
+            "pid": self._pid,
+            "tid": threading.get_ident() % 2**31,
+        }
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def flow_end(self, name: str, flow_id: str, **args: Any) -> None:
+        """Close a flow (``ph: f``) at the receiving worker; ``bp: e``
+        binds the arrow to the enclosing slice."""
+        ev = {
+            "name": name,
+            "cat": "comm",
+            "ph": "f",
+            "bp": "e",
+            "id": str(flow_id),
+            "ts": self._ts(time.perf_counter_ns()),
+            "pid": self._pid,
+            "tid": threading.get_ident() % 2**31,
+        }
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    # -- merge/alignment metadata -------------------------------------
+
+    def set_clock_offsets(self, offsets: dict[int, tuple[float, float]]) -> None:
+        """Record per-peer unix-clock offset estimates (peer process id ->
+        (offset ns, rtt ns), offset = peer clock minus ours) from the
+        cluster handshake ping — flushed as ``trace.clock_sync`` metadata
+        for ``pathway-tpu trace merge``."""
+        with self._lock:
+            self._clock_offsets = dict(offsets)
+
     def events_since(self, mark: int) -> tuple[list[dict[str, Any]], int]:
         """Events appended after the ``mark`` cursor (an ``_appended``
         value), plus the new cursor — the incremental-export protocol used
         by the periodic OTLP flusher (observability/exporter.py) and the
         end-of-run push, which share one cursor so nothing double-exports.
-        Events already dropped by the ring buffer are simply gone."""
+        Events already dropped by the ring buffer are simply gone: when
+        more than ``new`` events were appended but the buffer holds fewer,
+        the negative slice caps at the buffer — every returned event is
+        still strictly after ``mark`` (the buffer always holds the newest
+        ``len(_events)`` appends), so a drop can neither skip live events
+        nor re-export old ones (tests/test_tracing.py drop-cursor cases)."""
         with self._lock:
             new = self._appended - mark
             if new <= 0:
@@ -197,7 +324,29 @@ class Tracer:
                 "ph": "M",
                 "pid": self._pid,
                 "args": {"name": "pathway_tpu"},
-            }
+            },
+            # merge/alignment anchor: run identity, this process's place in
+            # the ensemble, its unix-clock origin, and the handshake's
+            # per-peer clock-offset estimates (trace_merge.py consumes it)
+            {
+                "name": "trace.clock_sync",
+                "ph": "i",
+                "s": "g",
+                "ts": 0.0,
+                "pid": self._pid,
+                "tid": 0,
+                "args": {
+                    "run_id": self.run_id,
+                    "process_id": process_id,
+                    "origin_unix_ns": self.origin_unix_ns,
+                    "clock_offsets": {
+                        str(p): [off, rtt]
+                        for p, (off, rtt) in sorted(
+                            self._clock_offsets.items()
+                        )
+                    },
+                },
+            },
         ]
         if self._dropped:
             meta.append(

@@ -12,13 +12,20 @@ The reference engine's telemetry pair — per-operator OTLP metrics
 - :mod:`health` — ``/healthz`` (executor not wedged) and ``/readyz``
   (sources connected, first frontier advanced) probe semantics;
 - :mod:`exporter` — periodic OTLP/trace-file flusher so crashed runs
-  still leave telemetry.
+  still leave telemetry;
+- :mod:`flightrecorder` — always-on mmap ring per process (the black
+  box): the last K ticks survive SIGKILL, harvested by the supervisor
+  into ``crash-<gen>-<proc>.json`` forensic bundles;
+- :mod:`trace_merge` — assembles per-process ``PATHWAY_TRACE_FILE``
+  parts into one clock-aligned cluster timeline
+  (``pathway-tpu trace merge``).
 
 The HTTP surface itself lives in ``engine/http_server.py``; instrumented
 state in ``engine/executor.EngineStats``.
 """
 
 from .exporter import PeriodicFlusher, start_periodic_flusher
+from .flightrecorder import FlightRecorder, get_recorder, harvest
 from .health import health_status, ready_status
 from .histogram import LogHistogram, merge_snapshots, quantile_from_snapshot
 from .hub import ObservabilityHub, stats_snapshot
@@ -29,9 +36,12 @@ from .prometheus import (
 )
 
 __all__ = [
+    "FlightRecorder",
     "LogHistogram",
     "ObservabilityHub",
     "PeriodicFlusher",
+    "get_recorder",
+    "harvest",
     "escape_label_value",
     "health_status",
     "merge_snapshots",

@@ -69,9 +69,7 @@ class PeriodicFlusher:
         if tracer is not None:
             tracer.flush()  # crash-durable local trace file
             if exporters:
-                events, mark = tracer.events_since(
-                    getattr(tracer, "_otlp_mark", 0)
-                )
+                events, mark = tracer.events_since(tracer._otlp_mark)
                 if events:
                     origin_unix_ns = time.time_ns() - (
                         time.perf_counter_ns() - tracer._origin

@@ -160,23 +160,43 @@ class ObservabilityHub:
                 pass
         return out
 
+    @staticmethod
+    def _local_trace_dropped() -> int | None:
+        """This process's tracer ring-buffer drop count (None = tracing
+        off) — shipped in /snapshot so the cluster roll-up can report a
+        PEER's truncated timeline, not just its own."""
+        from ..internals.tracing import get_tracer
+
+        tracer = get_tracer()
+        return tracer._dropped if tracer is not None else None
+
     def snapshot_document(self) -> dict:
         """The /snapshot payload peers serve to process 0."""
         return {
             "process_id": self.process_id,
             "workers": self.local_snapshots(),
             "comm": self.comm_snapshot(),
+            "trace_dropped": self._local_trace_dropped(),
         }
 
-    def cluster_snapshots(self) -> tuple[list[dict], dict[str, dict]]:
+    def cluster_snapshots(
+        self,
+    ) -> tuple[list[dict], dict[str, dict], dict[str, int]]:
         """Local snapshots plus every reachable peer's; comm stats keyed
-        by process id. Peers are scraped concurrently so N hung peers cost
+        by process id; tracer drops per reporting process (a transiently
+        unreachable peer is MISSING from the dict, so its metrics series
+        disappears for a scrape instead of decreasing a summed counter).
+        Peers are scraped concurrently so N hung peers cost
         one timeout, not N (a partial outage is exactly when the merged
         view must still answer inside Prometheus's scrape deadline);
         unreachable peers count in ``scrape_errors`` and the view stays
         partial rather than failing."""
         snapshots = self.local_snapshots()
         comm_stats = {str(self.process_id): self.comm_snapshot()}
+        trace_dropped: dict[str, int] = {}
+        local_dropped = self._local_trace_dropped()
+        if local_dropped is not None:
+            trace_dropped[str(self.process_id)] = local_dropped
         results: list[dict | None] = [None] * len(self.peer_http)
 
         def fetch(i: int, host: str, port: int) -> None:
@@ -197,8 +217,13 @@ class ObservabilityHub:
                 continue
             snapshots.extend(doc.get("workers", []))
             comm_stats[str(doc.get("process_id", "?"))] = doc.get("comm", {})
+            peer_dropped = doc.get("trace_dropped")
+            if peer_dropped is not None:
+                trace_dropped[str(doc.get("process_id", "?"))] = int(
+                    peer_dropped
+                )
         snapshots.sort(key=lambda s: s.get("worker", 0))
-        return snapshots, comm_stats
+        return snapshots, comm_stats, trace_dropped
 
     @staticmethod
     def _scrape_peer(host: str, port: int) -> dict | None:
@@ -217,12 +242,17 @@ class ObservabilityHub:
     def render_metrics(self) -> str:
         from .prometheus import render_snapshots
 
+        trace_dropped: int | dict[str, int] | None
         if self.peer_http:
-            snapshots, comm_stats = self.cluster_snapshots()
+            snapshots, comm_stats, dropped_by_proc = self.cluster_snapshots()
+            # per-process labels, like the comm gauges: series identity
+            # stays stable when a peer scrape transiently fails
+            trace_dropped = dropped_by_proc or None
         else:
             snapshots = self.local_snapshots()
             comm = self.comm_snapshot()
             comm_stats = {str(self.process_id): comm} if comm else {}
+            trace_dropped = self._local_trace_dropped()
         # label by TOPOLOGY, not by how many snapshots this scrape got:
         # in cluster mode a transient peer outage must not flip series
         # between labeled and unlabeled (that forks Prometheus series and
@@ -232,12 +262,17 @@ class ObservabilityHub:
             or bool(self.peer_http)
             or len(self._workers) > 1
         )
+        # tracer drop visibility: a truncated trace window — local OR on a
+        # scraped peer — must be distinguishable from a quiet one (0
+        # renders too, as the explicit "nothing dropped" signal); None
+        # only when no process traces
         return render_snapshots(
             snapshots,
             comm_stats,
             scrape_errors=self.scrape_errors,
             worker_labels=True if cluster else None,
             supervisor=self._supervisor_snapshot(),
+            trace_dropped=trace_dropped,
         )
 
     @staticmethod
@@ -250,10 +285,16 @@ class ObservabilityHub:
 
         restarts = os.environ.get("PATHWAY_RESTART_COUNT")
         supervised = os.environ.get("PATHWAY_SUPERVISED")
+        flight_dumps = os.environ.get("PATHWAY_FLIGHT_DUMPS")
         from ..chaos import injector as _chaos
 
         armed = _chaos.ARMED
-        if not supervised and restarts is None and armed is None:
+        if (
+            not supervised
+            and restarts is None
+            and armed is None
+            and flight_dumps is None
+        ):
             return None
         doc: dict = {
             "restarts": int(restarts or 0),
@@ -261,6 +302,11 @@ class ObservabilityHub:
         }
         if armed is not None:
             doc["chaos_injections"] = armed.injections_total
+        if flight_dumps is not None:
+            try:
+                doc["flight_dumps"] = int(flight_dumps)
+            except ValueError:
+                pass
         return doc
 
     def health(self) -> tuple[bool, dict]:

@@ -111,6 +111,7 @@ def render_snapshots(
     scrape_errors: int = 0,
     worker_labels: bool | None = None,
     supervisor: dict | None = None,
+    trace_dropped: int | dict[str, int] | None = None,
 ) -> str:
     """Exposition text for a set of worker stats snapshots.
 
@@ -188,6 +189,23 @@ def render_snapshots(
     r.add("pathway_cluster_workers", "gauge", len(snapshots))
     if scrape_errors:
         r.add("pathway_cluster_scrape_errors", "counter", scrape_errors)
+    if trace_dropped is not None:
+        # tracer ring-buffer overflow: a timeline missing its head is
+        # distinguishable from one that was simply quiet. Cluster callers
+        # pass a per-process dict — like the comm gauges, a transiently
+        # unreachable peer must DROP its series, not decrease a summed
+        # counter (which Prometheus would read as a reset)
+        if isinstance(trace_dropped, dict):
+            for proc, v in sorted(trace_dropped.items()):
+                r.add(
+                    "pathway_trace_dropped_events_total", "counter",
+                    int(v), {"process": str(proc)},
+                )
+        else:
+            r.add(
+                "pathway_trace_dropped_events_total", "counter",
+                int(trace_dropped),
+            )
     if supervisor is not None:
         # self-healing surface (spawn --supervise): restart generation +
         # why the supervisor last bounced the ensemble (info-style series,
@@ -206,6 +224,13 @@ def render_snapshots(
             r.add(
                 "pathway_chaos_injections_total", "counter",
                 int(supervisor["chaos_injections"]),
+            )
+        if supervisor.get("flight_dumps") is not None:
+            # crash-forensic bundles harvested by the supervisor so far
+            # (flight recorder, stamped as PATHWAY_FLIGHT_DUMPS)
+            r.add(
+                "pathway_flight_recorder_dumps_total", "counter",
+                int(supervisor["flight_dumps"]),
             )
     return r.text()
 

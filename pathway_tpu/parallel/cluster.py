@@ -101,7 +101,25 @@ class ClusterComm(Comm):
         self._chaos = (
             armed.send_faults(process_id) if armed is not None else None
         )
+        # tracing site: frames carry a (run_id, flow_id) context so both
+        # ends of every cross-process frame emit linked flow events
+        from ..internals.tracing import get_tracer, mint_flow_tag
+
+        self._tracer = get_tracer()
+        import itertools as _itertools
+
+        self._flow_seq = _itertools.count()
+        self._flow_tag = mint_flow_tag()
+        #: peer process id -> (unix-clock offset ns, rtt ns), offset = peer
+        #: clock minus ours; min-rtt sample of the handshake ping burst
+        self.clock_offsets: dict[int, tuple[float, float]] = {}
+        self._pongs_seen: dict[int, int] = {}
         self._connect_mesh()
+        # only a tracer consumes the offsets — an untraced run must not pay
+        # the ping burst (or its cond-wait) at every mesh establishment
+        if self.n_processes > 1 and self._tracer is not None:
+            self._measure_clock_offsets()
+            self._tracer.set_clock_offsets(self.clock_offsets)
 
     # -- mesh setup ------------------------------------------------------
 
@@ -187,13 +205,38 @@ class ClusterComm(Comm):
                 frame = pickle.loads(_recv_exact(sock, n_body))
                 self.bytes_received += 8 + n_body
                 self.frames_received += 1
-                if frame[0] == "bye":
+                kind = frame[0]
+                if kind == "bye":
                     # graceful: the peer finished its dataflow (all its
                     # collectives, incl. the END_TIME sweep, completed) and
                     # is shutting down — everything it owed us was already
                     # delivered in order before this frame
                     return
-                self._deliver(frame)
+                if kind == "ping":
+                    # clock-sync probe: echo (seq, t0) back with our recv
+                    # time, straight from the reader thread so the sample
+                    # measures the wire, not a collective's queueing
+                    self._send_raw(
+                        peer, ("pong", frame[1], frame[2], time.time_ns())
+                    )
+                    continue
+                if kind == "pong":
+                    self._note_pong(
+                        peer, frame[2], frame[3], time.time_ns()
+                    )
+                    continue
+                tracer = self._tracer
+                t0 = time.perf_counter_ns() if tracer is not None else 0
+                ctx = self._deliver(frame)
+                if tracer is not None and ctx is not None:
+                    # f before complete: the flow's binding point must fall
+                    # inside the comm.recv slice on this reader thread
+                    tracer.flow_end("comm.frame", ctx[1], from_process=peer)
+                    tracer.complete(
+                        "comm.recv",
+                        t0,
+                        {"from_process": peer, "bytes": 8 + n_body},
+                    )
         except (OSError, EOFError) as e:
             # peer socket death: the fast-propagation path — flip _broken
             # and wake every blocked collective NOW, not at the timeout
@@ -208,17 +251,65 @@ class ClusterComm(Comm):
             if not self._closing:
                 self._break(f"reader thread for process {peer} failed: {e!r}")
 
-    def _deliver(self, frame: tuple) -> None:
+    def _deliver(self, frame: tuple) -> tuple | None:
+        """File a data/control frame into the inbox; returns the frame's
+        trace context (run_id, flow_id) when the sender shipped one."""
         kind = frame[0]
+        ctx = None
         with self._cond:
             if kind == "x":
-                _, channel, tick, src, per_dst = frame
+                _, channel, tick, src, per_dst = frame[:5]
+                ctx = frame[5] if len(frame) > 5 else None
                 for dst, payload in per_dst.items():
                     self._inbox.setdefault(("x", channel, tick, dst), {})[src] = payload
             else:
-                _, tag, src, obj = frame
+                _, tag, src, obj = frame[:4]
+                ctx = frame[4] if len(frame) > 4 else None
                 self._inbox.setdefault(("g", tag), {})[src] = obj
             self._cond.notify_all()
+        return ctx
+
+    # -- clock-offset estimation (mesh establishment) --------------------
+
+    def _note_pong(self, peer: int, t0_ns: int, t1_ns: int, t2_ns: int) -> None:
+        """One ping round trip: we sent at ``t0``, the peer stamped ``t1``
+        on receipt, the pong landed here at ``t2``. NTP-style estimate:
+        offset = t1 - (t0+t2)/2 (peer clock minus ours), error bounded by
+        rtt/2 — the min-rtt sample of the burst wins."""
+        rtt = t2_ns - t0_ns
+        offset = t1_ns - (t0_ns + t2_ns) / 2
+        with self._cond:
+            best = self.clock_offsets.get(peer)
+            if best is None or rtt < best[1]:
+                self.clock_offsets[peer] = (float(offset), float(rtt))
+            self._pongs_seen[peer] = self._pongs_seen.get(peer, 0) + 1
+            self._cond.notify_all()
+
+    def _measure_clock_offsets(
+        self, n_pings: int = 4, timeout_s: float = 2.0
+    ) -> None:
+        """Ping every peer during mesh establishment so the per-process
+        trace files can be merged onto one timeline even across hosts with
+        skewed clocks (`pathway-tpu trace merge`). Best-effort: a peer that
+        never answers simply has no offset estimate (merge falls back to
+        raw unix origins)."""
+        peers = list(self._socks)
+        for _ in range(n_pings):
+            for peer in peers:
+                try:
+                    self._send_raw(peer, ("ping", 0, time.time_ns()))
+                except (RuntimeError, OSError, KeyError):
+                    pass
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while (
+                any(self._pongs_seen.get(p, 0) < n_pings for p in peers)
+                and self._broken is None
+            ):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=min(remaining, 0.1))
 
     def _send(self, peer: int, frame: tuple) -> None:
         if self._chaos is not None and frame[0] != "bye":
@@ -261,6 +352,22 @@ class ClusterComm(Comm):
 
     # -- collectives -----------------------------------------------------
 
+    def _frame_ctx(self, peer: int, **args: Any) -> tuple | None:
+        """Mint a per-frame trace context (run_id, flow_id) and emit the
+        sending half of the flow; None when tracing is off (frames stay
+        one element longer either way — both ends run the same version)."""
+        tracer = self._tracer
+        if tracer is None:
+            return None
+        from ..internals.tracing import make_flow_id
+
+        flow_id = make_flow_id(
+            tracer, self._flow_tag,
+            f"p{self.process_id}", next(self._flow_seq),
+        )
+        tracer.flow_start("comm.frame", flow_id, peer_process=peer, **args)
+        return (tracer.run_id, flow_id)
+
     def exchange(self, channel, tick, worker_id, buckets):
         per_process: dict[int, dict[int, Any]] = {}
         with self._cond:
@@ -274,7 +381,8 @@ class ClusterComm(Comm):
                     per_process.setdefault(p, {})[dst] = payload
             self._cond.notify_all()
         for p, per_dst in per_process.items():
-            self._send(p, ("x", channel, tick, worker_id, per_dst))
+            ctx = self._frame_ctx(p, channel=channel, tick=tick)
+            self._send(p, ("x", channel, tick, worker_id, per_dst, ctx))
         # remote processes always send a frame (even all-None buckets), so
         # completion = contributions from every worker id
         key = ("x", channel, tick, worker_id)
@@ -295,7 +403,8 @@ class ClusterComm(Comm):
         # one frame per remote process, sent by each local worker for itself
         for p in range(self.n_processes):
             if p != self.process_id:
-                self._send(p, ("g", tag, worker_id, obj))
+                ctx = self._frame_ctx(p, worker=worker_id)
+                self._send(p, ("g", tag, worker_id, obj, ctx))
         payloads = self._wait(key, self.n_workers)
         out = [payloads[src] for src in range(self.n_workers)]
         with self._cond:
@@ -362,10 +471,22 @@ class ClusterComm(Comm):
         """Mark the mesh dead and wake EVERY waiter on the shared condition
         — the one notify_all that turns a 10-minute collective timeout into
         millisecond failure propagation."""
+        first = False
         with self._cond:
             if self._broken is None:
                 self._broken = reason
+                first = True
             self._cond.notify_all()
+        if first:
+            # black-box evidence: the crash bundle of a worker that died
+            # *because a peer died* should name the peer, not look idle
+            from ..observability.flightrecorder import get_recorder
+
+            recorder = get_recorder()
+            if recorder is not None:
+                recorder.record(
+                    "comm.broken", process=self.process_id, reason=reason
+                )
 
     def abort(self) -> None:
         self._break(f"worker on process {self.process_id} failed")

@@ -79,6 +79,21 @@ class LocalComm(Comm):
 
         armed = _chaos.current()
         self._chaos = armed.local_faults() if armed is not None else None
+        # tracing site: None unless span tracing is on — worker threads
+        # share one tracer, so exchange flows link sender/receiver tick
+        # spans across tids with deterministic ids (no context to ship)
+        from ..internals.tracing import get_tracer, mint_flow_tag
+
+        self._tracer = get_tracer()
+        self._flow_tag = mint_flow_tag()
+
+    def _flow_id(self, channel: int, tick: int, src: int, dst: int) -> str:
+        from ..internals.tracing import make_flow_id
+
+        return make_flow_id(
+            self._tracer, self._flow_tag,
+            f"x{channel}", f"t{tick}", f"{src}>{dst}",
+        )
 
     def _rendezvous(self, key: Any, worker_id: int, payload: Any) -> list[Any]:
         if self._chaos is not None:
@@ -106,9 +121,32 @@ class LocalComm(Comm):
         self._barrier.abort()
 
     def exchange(self, channel, tick, worker_id, buckets):
-        all_buckets = self._rendezvous(
-            ("x", channel, tick), worker_id, list(buckets)
-        )
+        buckets = list(buckets)
+        tracer = self._tracer
+        if tracer is not None:
+            # both ends compute the same deterministic id, so each sender's
+            # tick span links to every receiver's — the in-process analog of
+            # the frame trace context ClusterComm ships over TCP
+            for dst, payload in enumerate(buckets):
+                if dst != worker_id and payload is not None:
+                    tracer.flow_start(
+                        "comm.local",
+                        self._flow_id(channel, tick, worker_id, dst),
+                        channel=channel,
+                        tick=tick,
+                    )
+        all_buckets = self._rendezvous(("x", channel, tick), worker_id, buckets)
+        if tracer is not None:
+            for src in range(self.n_workers):
+                if (
+                    src != worker_id
+                    and all_buckets[src] is not None
+                    and all_buckets[src][worker_id] is not None
+                ):
+                    tracer.flow_end(
+                        "comm.local",
+                        self._flow_id(channel, tick, src, worker_id),
+                    )
         return [
             all_buckets[src][worker_id]
             for src in range(self.n_workers)

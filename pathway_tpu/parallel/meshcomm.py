@@ -76,6 +76,22 @@ class MeshComm(Comm):
         # phase, when the post-deposit barrier proves no reader remains
         self._slots: dict[tuple, dict] = {}
         self._slot_lock = threading.Lock()
+        # tracing: link every worker's deposit to the driver's collective
+        # and the collective back to each worker's readback (flow events
+        # with deterministic ids — one shared tracer, no context to ship)
+        from ..internals.tracing import get_tracer, mint_flow_tag
+
+        self._tracer = get_tracer()
+        self._flow_tag = mint_flow_tag()
+
+    def _flow_id(self, channel: int, tick: int, worker: int,
+                 phase: str) -> str:
+        from ..internals.tracing import make_flow_id
+
+        return make_flow_id(
+            self._tracer, self._flow_tag,
+            f"mx{channel}", f"t{tick}", f"{phase}{worker}",
+        )
 
     # host-comm delegation (control plane + non-delta payloads)
 
@@ -133,6 +149,14 @@ class MeshComm(Comm):
         sig = local_signature(local, column_names)
 
         key = (channel, tick)
+        tracer = self._tracer
+        if tracer is not None:
+            tracer.flow_start(
+                "mesh.deposit",
+                self._flow_id(channel, tick, worker_id, "in"),
+                channel=channel,
+                tick=tick,
+            )
         with self._slot_lock:
             slot = self._slots.setdefault(key, {"payloads": [None] * n})
             slot["payloads"][worker_id] = (sig, counts, local, dest)
@@ -151,6 +175,19 @@ class MeshComm(Comm):
                 slot["result"] = self.runner.run_tick(
                     slot["payloads"], column_names
                 )
+                if tracer is not None:
+                    # the driver's collective consumed every deposit and
+                    # fans the result back out — close/open the flows here,
+                    # inside the driver's tick slice
+                    for w in range(n):
+                        tracer.flow_end(
+                            "mesh.deposit",
+                            self._flow_id(channel, tick, w, "in"),
+                        )
+                        tracer.flow_start(
+                            "mesh.result",
+                            self._flow_id(channel, tick, w, "out"),
+                        )
             except BaseException as e:  # noqa: BLE001 — re-raised on peers
                 slot["result"] = _DriverError(e)
                 self.inner.barrier(worker_id)
@@ -165,6 +202,10 @@ class MeshComm(Comm):
             raise RuntimeError(
                 "mesh exchange failed on the driver worker"
             ) from result.error
+        if tracer is not None:
+            tracer.flow_end(
+                "mesh.result", self._flow_id(channel, tick, worker_id, "out")
+            )
         if result is None:
             return []
         kinds, cap_bucket, gvals, gvalid = result
@@ -262,6 +303,21 @@ class MultiHostMeshComm(Comm):
         self._local_barrier = threading.Barrier(threads)
         self._slot_lock = threading.Lock()
         self._slots: dict[tuple, dict] = {}
+        # tracing: local deposit→leader flows (cross-process linkage rides
+        # the inner ClusterComm's frame contexts)
+        from ..internals.tracing import get_tracer, mint_flow_tag
+
+        self._tracer = get_tracer()
+        self._flow_tag = mint_flow_tag()
+
+    def _flow_id(self, channel: int, tick: int, worker: int,
+                 phase: str) -> str:
+        from ..internals.tracing import make_flow_id
+
+        return make_flow_id(
+            self._tracer, self._flow_tag,
+            f"mxh{channel}", f"t{tick}", f"{phase}{worker}",
+        )
 
     # host-comm delegation
 
@@ -320,6 +376,14 @@ class MultiHostMeshComm(Comm):
         sig = local_signature(local, column_names)
 
         key = (channel, tick)
+        tracer = self._tracer
+        if tracer is not None:
+            tracer.flow_start(
+                "mesh.deposit",
+                self._flow_id(channel, tick, worker_id, "in"),
+                channel=channel,
+                tick=tick,
+            )
         with self._slot_lock:
             slot = self._slots.setdefault(
                 key, {"payloads": [None] * self.threads}
@@ -364,6 +428,17 @@ class MultiHostMeshComm(Comm):
                         if total
                         else None
                     )
+                    if tracer is not None:
+                        base = self.process_id * self.threads
+                        for w in range(base, base + self.threads):
+                            tracer.flow_end(
+                                "mesh.deposit",
+                                self._flow_id(channel, tick, w, "in"),
+                            )
+                            tracer.flow_start(
+                                "mesh.result",
+                                self._flow_id(channel, tick, w, "out"),
+                            )
                 except BaseException as e:  # noqa: BLE001
                     slot["result"] = _DriverError(e)
                     self._local_barrier.wait()
@@ -382,6 +457,10 @@ class MultiHostMeshComm(Comm):
             raise RuntimeError(
                 "mesh exchange failed on the process leader"
             ) from result.error
+        if tracer is not None:
+            tracer.flow_end(
+                "mesh.result", self._flow_id(channel, tick, worker_id, "out")
+            )
 
         host_names = [c for c, k in zip(column_names, kinds) if k == HOST]
         host_cols: dict[int, dict[str, np.ndarray]] = {}
@@ -422,8 +501,12 @@ class MultiHostMeshComm(Comm):
         """Leader thread: pack this PROCESS's workers, form the process-local
         slice of the global array, run the collective with every other
         process's leader."""
+        import time as _time
+
         import jax
 
+        tracer = self._tracer
+        t0 = _time.perf_counter_ns() if tracer is not None else 0
         vals, dst = self.runner.pack_blocks(
             list(payloads), kinds, column_names, cap_in
         )
@@ -431,4 +514,12 @@ class MultiHostMeshComm(Comm):
         gvals = jax.make_array_from_process_local_data(sh_v, vals)
         gdest = jax.make_array_from_process_local_data(sh_d, dst)
         width = self.runner.width(kinds)
-        return self.runner._kernel(cap_in, cap_bucket, width)(gvals, gdest)
+        out = self.runner._kernel(cap_in, cap_bucket, width)(gvals, gdest)
+        if tracer is not None:
+            tracer.complete(
+                "mesh.collective",
+                t0,
+                {"cap_in": cap_in, "cap_bucket": cap_bucket,
+                 "process": self.process_id},
+            )
+        return out

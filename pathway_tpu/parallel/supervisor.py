@@ -8,13 +8,22 @@ wedge (the PR 1 ``/healthz`` heartbeat probe), and on any failure
    the children translate into ``request_stop()`` (``internals/run.py``)
    so their persistence managers flush the recorded input tail via
    ``close()`` before exiting; SIGKILL only after a grace period;
-2. restarts the WHOLE ensemble (the engine recovers from the last
+2. **harvests the dead workers' flight-recorder rings**
+   (``observability/flightrecorder.py``, ``PATHWAY_FLIGHT_DIR``) into
+   ``crash-<generation>-<process>.json`` forensic bundles — the crashed
+   worker's final ticks, chaos injections fired, comm-break reasons and
+   exit reason — and stamps the bundle path into the restart reason (so
+   it reaches ``PATHWAY_LAST_RESTART_REASON`` and the
+   ``pathway_last_restart_reason`` metric label); harvested bundle count
+   is exported as ``pathway_flight_recorder_dumps_total`` via
+   ``PATHWAY_FLIGHT_DUMPS``;
+3. restarts the WHOLE ensemble (the engine recovers from the last
    snapshot common to every worker — ``Executor._recover``) after a
    jittered exponential backoff, stamping each generation's environment
    with ``PATHWAY_RESTART_COUNT`` / ``PATHWAY_LAST_RESTART_REASON`` so
    fault plans gate per generation and ``/metrics`` exports
    ``pathway_restarts_total`` + ``pathway_last_restart_reason``;
-3. gives up when the crash-loop circuit breaker trips: more than
+4. gives up when the crash-loop circuit breaker trips: more than
    ``max_restarts`` restarts inside a ``window_s`` sliding window means
    the program dies deterministically (a poisoned input, a broken
    deploy) and restarting is harm, not healing.
@@ -32,6 +41,8 @@ Env knobs (CLI flags override): ``PATHWAY_SUPERVISE_MAX_RESTARTS`` (5),
 
 from __future__ import annotations
 
+import json
+import os
 import signal
 import subprocess
 import sys
@@ -73,6 +84,9 @@ class Supervisor:
         rng: Callable[[], float] | None = None,
         log: Callable[[str], Any] | None = None,
         labels: Sequence[str] | None = None,
+        flight_dir: str | None = None,
+        process_ids: Sequence[int] | None = None,
+        run_id: str | None = None,
     ):
         from ..internals.config import _env_float, _env_int
 
@@ -115,8 +129,32 @@ class Supervisor:
         self._log = log if log is not None else (
             lambda msg: print(f"[supervisor] {msg}", file=sys.stderr)
         )
+        #: where the children's flight-recorder rings (and the crash
+        #: bundles harvested from them) live; None/empty = no forensics
+        self.flight_dir = (
+            flight_dir
+            if flight_dir is not None
+            else os.environ.get("PATHWAY_FLIGHT_DIR")
+        )
+        #: real process ids aligned with launch()'s Popen order (ring files
+        #: are named flight-p<process_id>.ring); default 0..N-1 by index
+        self.process_ids = list(process_ids or [])
+        #: the ensemble's PATHWAY_RUN_ID: a harvested ring must carry it,
+        #: or it is a stale leftover of a PREVIOUS run in the same
+        #: flight dir (a child that dies before arming its recorder never
+        #: overwrites the old ring) and bundling it would misattribute
+        #: another run's forensics to this one
+        self.run_id = (
+            run_id
+            if run_id is not None
+            else os.environ.get("PATHWAY_RUN_ID")
+        )
         self.restarts_total = 0
         self.last_restart_reason: str | None = None
+        self.flight_dumps_total = 0
+        #: Popen indices implicated in the current generation's failure
+        #: (dead exit code or served-503 wedge) — the rings worth harvesting
+        self._failed_indices: list[int] = []
 
     # -- lifecycle -------------------------------------------------------
 
@@ -129,8 +167,13 @@ class Supervisor:
             reason = self._watch(procs)
             if reason is None:
                 return 0  # every process exited 0 — the run completed
-            self._log(f"generation {generation} failed: {reason}")
             self._teardown(procs)
+            # harvest after teardown (every ring is final) and before the
+            # relaunch truncates them for the next generation
+            bundles = self._harvest_flight(generation, reason)
+            if bundles:
+                reason = f"{reason} [flight recorder: {', '.join(bundles)}]"
+            self._log(f"generation {generation} failed: {reason}")
             now = time.monotonic()
             restart_times.append(now)
             while restart_times and now - restart_times[0] > self.window_s:
@@ -160,16 +203,44 @@ class Supervisor:
 
     def _watch(self, procs: Sequence[subprocess.Popen]) -> str | None:
         """Block until the generation resolves: None = all exited cleanly,
-        else the failure reason."""
+        else the failure reason (``_failed_indices`` names the culprits)."""
+        self._failed_indices = []
         next_health = time.monotonic() + self.health_interval_s
         while True:
             codes = [p.poll() for p in procs]
-            for i, c in enumerate(codes):
-                if c is not None and c != 0:
-                    return (
-                        f"{self._label(i)} (pid {procs[i].pid}) "
-                        f"exited with {c}"
-                    )
+            failed = [
+                i for i, c in enumerate(codes) if c is not None and c != 0
+            ]
+            if failed:
+                # settle pass: fast failure propagation can take down peers
+                # within milliseconds of the first death — catch them now so
+                # the ACTUAL crash victim's flight ring gets harvested, not
+                # just the lowest-index casualty
+                time.sleep(self.poll_interval_s)
+                codes = [p.poll() for p in procs]
+                self._failed_indices = [
+                    i for i, c in enumerate(codes)
+                    if c is not None and c != 0
+                ]
+                # headline the likeliest root cause: a signal death
+                # (negative code, e.g. SIGKILL) over a peer that exited
+                # nonzero because the mesh broke under it
+                i = min(
+                    self._failed_indices,
+                    key=lambda j: (codes[j] >= 0, j),
+                )
+                reason = (
+                    f"{self._label(i)} (pid {procs[i].pid}) "
+                    f"exited with {codes[i]}"
+                )
+                others = [
+                    f"{self._label(j)} exited with {codes[j]}"
+                    for j in self._failed_indices
+                    if j != i
+                ]
+                if others:
+                    reason += f" (also: {'; '.join(others)})"
+                return reason
             if all(c == 0 for c in codes):
                 return None
             if self.health_ports and time.monotonic() >= next_health:
@@ -195,6 +266,7 @@ class Supervisor:
                         return f"{self._label(i)} wedged (healthz {r.status})"
             except urllib.error.HTTPError as e:
                 if e.code == 503:
+                    self._failed_indices = [i]
                     return (
                         f"{self._label(i)} wedged (healthz 503: "
                         f"{e.read(200).decode(errors='replace')})"
@@ -229,3 +301,88 @@ class Supervisor:
                 except OSError:
                     pass
                 p.wait()
+
+    # -- crash forensics (flight-recorder harvest) -----------------------
+
+    def _harvest_flight(self, generation: int, reason: str) -> list[str]:
+        """Read the failed workers' flight-recorder rings into
+        ``crash-<generation>-<process>.json`` bundles; returns the bundle
+        paths. Never raises — forensics must not block the restart loop."""
+        if not self.flight_dir:
+            return []
+        from ..observability import flightrecorder
+
+        if self._failed_indices:
+            targets = [
+                self.process_ids[i] if i < len(self.process_ids) else i
+                for i in self._failed_indices
+            ]
+        else:
+            # failure without a named culprit (e.g. an external teardown):
+            # every ring present is evidence
+            targets = self.process_ids or self._discover_rings()
+        bundles: list[str] = []
+        for proc in targets:
+            ring = flightrecorder.ring_path(self.flight_dir, proc)
+            try:
+                doc = flightrecorder.harvest(ring)
+            except (OSError, ValueError):
+                continue  # no ring (flight recorder off in the child)
+            if self.run_id and doc["run_id"] != self.run_id[:16]:
+                # ring header stores 16 run-id bytes; a mismatch means the
+                # ring predates this run (the child died before arming its
+                # recorder) — not this run's evidence
+                continue
+            records = doc["records"]
+            bundle = {
+                "generation": generation,
+                "process": proc,
+                "exit_reason": reason,
+                "harvested_at": time.time(),
+                "run_id": doc["run_id"],
+                "ring_wrapped": doc["wrapped"],
+                "chaos_armed": bool(os.environ.get("PATHWAY_FAULT_PLAN")),
+                "chaos_fired": [
+                    r for r in records if r.get("kind") == "chaos.fired"
+                ],
+                "last_ticks": [
+                    r for r in records if r.get("kind") == "tick"
+                ][-50:],
+                "records": records[-400:],
+            }
+            path = os.path.join(
+                self.flight_dir, f"crash-{generation}-{proc}.json"
+            )
+            try:
+                with open(path, "w") as f:
+                    json.dump(bundle, f)
+            except OSError as e:
+                self._log(f"could not write crash bundle {path}: {e}")
+                continue
+            self.flight_dumps_total += 1
+            bundles.append(path)
+        # consume every ring (harvested or not): a child of the NEXT
+        # generation that dies before reaching Executor init never
+        # re-creates its ring, and a later harvest would otherwise read
+        # THIS generation's records and misattribute them (the bundle
+        # preserves the evidence that matters)
+        for proc in self._discover_rings():
+            try:
+                os.remove(flightrecorder.ring_path(self.flight_dir, proc))
+            except OSError:
+                pass
+        return bundles
+
+    def _discover_rings(self) -> list[int]:
+        try:
+            names = os.listdir(self.flight_dir)
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            if n.startswith("flight-p") and n.endswith(".ring"):
+                try:
+                    out.append(int(n[len("flight-p"):-len(".ring")]))
+                except ValueError:
+                    pass
+        return sorted(out)

@@ -125,6 +125,9 @@ def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
         "JAX_PLATFORMS": "cpu",
         "PYTHONPATH": repo_root,
         "PATHWAY_FAULT_PLAN": json.dumps(FAULT_PLAN),
+        # keep the flight-recorder rings/bundles inside the workdir
+        # (--supervise would otherwise default them to ./pathway-flight)
+        "PATHWAY_FLIGHT_DIR": os.path.join(tmp, "flight"),
         # keep the smoke snappy: near-immediate restart, fast teardown
         "PATHWAY_SUPERVISE_BACKOFF_S": "0.05",
         "PATHWAY_SUPERVISE_BACKOFF_MAX_S": "0.2",

@@ -1,0 +1,80 @@
+"""Static check: every ``PATHWAY_*`` env knob the engine reads is
+documented in README.md.
+
+Scans ``pathway_tpu/`` for environment *reads* — ``os.environ.get(...)``,
+``os.environ[...]``, and the ``_env_bool/_env_int/_env_float/
+_env_addresses`` helpers of ``internals/config.py`` — and fails when a
+knob name does not appear anywhere in README.md. Write-only sites (the
+CLI stamping ``PATHWAY_PROCESS_ID`` into child environments) do not
+register a knob; reading one does, because a read is a behavior an
+operator can change.
+
+Usable standalone (``python scripts/check_knobs.py`` → exit 0/1) and as
+a tier-1 test (``tests/test_check_knobs.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: read sites; \s* spans newlines so black-wrapped calls still match
+_READ = re.compile(
+    r"(?:os\.environ\.get\(|os\.environ\[|environ\.get\(|getenv\(|"
+    r"_env_(?:bool|int|float|addresses)\()\s*[\"'](PATHWAY_[A-Z0-9_]+)[\"']",
+    re.S,
+)
+
+
+def collect_knobs(package_dir: str | None = None) -> dict[str, list[str]]:
+    """knob name -> files reading it, across the whole package."""
+    package_dir = package_dir or os.path.join(ROOT, "pathway_tpu")
+    knobs: dict[str, list[str]] = {}
+    for dirpath, _dirnames, filenames in os.walk(package_dir):
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            for m in _READ.finditer(text):
+                knobs.setdefault(m.group(1), []).append(
+                    os.path.relpath(path, ROOT)
+                )
+    return knobs
+
+
+def undocumented(readme_path: str | None = None) -> dict[str, list[str]]:
+    """Knobs read by the engine but absent from README.md. Matching is
+    whole-name (a documented ``PATHWAY_TRACE_FILE`` must not vouch for an
+    undocumented ``PATHWAY_TRACE`` substring-knob, or vice versa)."""
+    readme_path = readme_path or os.path.join(ROOT, "README.md")
+    with open(readme_path, encoding="utf-8") as f:
+        readme = f.read()
+    return {
+        k: sorted(set(files))
+        for k, files in collect_knobs().items()
+        if not re.search(rf"(?<![A-Z0-9_]){re.escape(k)}(?![A-Z0-9_])", readme)
+    }
+
+
+def main() -> int:
+    missing = undocumented()
+    if missing:
+        print("check_knobs FAILED: undocumented PATHWAY_* knobs:",
+              file=sys.stderr)
+        for k, files in sorted(missing.items()):
+            print(f"  {k}  (read in {', '.join(files)})", file=sys.stderr)
+        print("document them in README.md (the knob index or a section "
+              "table)", file=sys.stderr)
+        return 1
+    n = len(collect_knobs())
+    print(f"check_knobs OK ({n} knobs, all documented)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,324 @@
+"""Distributed-tracing + flight-recorder smoke test.
+
+The observability analog of ``chaos_smoke.py``, validating both halves of
+the cluster-forensics loop end to end:
+
+**Phase 1 — cluster timeline.** A two-process sharded wordcount runs with
+``PATHWAY_TRACE_FILE`` set; the smoke asserts both per-process
+``.p<N>`` parts are valid Chrome Trace JSON with ``engine.run``/``tick``
+spans, then runs ``pathway-tpu trace merge`` and validates the merged
+timeline: one file, both processes' tracks, clock-sync metadata from the
+handshake ping, cross-process flow events whose ids match across pids,
+and concurrent (clock-aligned) engine.run spans.
+
+**Phase 2 — crash forensics.** The same pipeline runs under
+``spawn --supervise`` with a fault plan that SIGKILLs worker 1 mid-run
+and ``PATHWAY_FLIGHT_DIR`` set. The smoke asserts the supervisor
+harvested the dead worker's mmap ring into a ``crash-<gen>-<proc>.json``
+bundle containing that worker's final ticks and the self-documented
+chaos injection, that the bundle path is stamped into the restart reason,
+and that generation 1's ``/metrics`` reports
+``pathway_flight_recorder_dumps_total`` >= 1.
+
+Usable standalone (``python scripts/trace_smoke.py`` → exit 0/1) and as
+a tier-1 test (``tests/test_trace_smoke.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_TRACED_PROGRAM = """
+import time
+
+import pathway_tpu as pw
+
+WORDS = ["foo", "bar", "foo", "baz"] * 3
+
+
+class S(pw.io.python.ConnectorSubject):
+    def run(self):
+        for w in WORDS:
+            self.next(word=w)
+            self.commit()
+            time.sleep(0.01)
+
+
+t = pw.io.python.read(
+    S(), schema=pw.schema_from_types(word=str), name="words",
+    autocommit_ms=None,
+)
+counts = t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+pw.io.subscribe(counts, on_change=lambda **kw: None)
+pw.run()
+"""
+
+_CHAOS_PROGRAM = """
+import json, os, sys, time
+
+import pathway_tpu as pw
+
+out_path = sys.argv[1]
+WORDS = ["foo", "bar", "foo", "baz"] * 5
+
+
+class S(pw.io.python.ConnectorSubject):
+    def run(self):
+        for w in WORDS:
+            self.next(word=w)
+            self.commit()
+            time.sleep(0.02)
+
+
+t = pw.io.python.read(
+    S(), schema=pw.schema_from_types(word=str), name="words",
+    autocommit_ms=None,
+)
+counts = t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+
+
+def on_end():
+    # scrape our own /metrics while the server is still up: generation 1
+    # carries the supervisor-stamped flight-dump counter
+    import urllib.request
+    try:
+        base = int(os.environ.get("PATHWAY_MONITORING_HTTP_PORT", "0"))
+        pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{base + pid}/metrics", timeout=5
+        ) as r:
+            text = r.read().decode()
+        with open(out_path, "a") as f:
+            f.write(json.dumps(["metrics", text]) + "\\n")
+    except Exception as e:  # noqa: BLE001 — smoke diagnostics
+        with open(out_path, "a") as f:
+            f.write(json.dumps(["metrics_error", repr(e)]) + "\\n")
+
+
+pw.io.subscribe(counts, on_change=lambda **kw: None, on_end=on_end)
+pw.run()
+"""
+
+#: SIGKILL worker 1 (process 1 at -n 2 -t 1) at its 6th tick, generation
+#: 0 only — the restarted generation runs fault-free and must finish
+FAULT_PLAN = {
+    "seed": 7,
+    "faults": [
+        {"site": "tick", "worker": 1, "tick": 6, "action": "kill", "run": 0},
+    ],
+}
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _base_env(repo_root: str) -> dict:
+    return {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo_root,
+    }
+
+
+def _run_traced(tmp: str, repo_root: str) -> dict:
+    prog = os.path.join(tmp, "traced.py")
+    with open(prog, "w") as f:
+        f.write(textwrap.dedent(_TRACED_PROGRAM))
+    trace_base = os.path.join(tmp, "trace.json")
+    env = {**_base_env(repo_root), "PATHWAY_TRACE_FILE": trace_base}
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pathway_tpu.cli", "spawn",
+            "-n", "2", "-t", "1", "--first-port", str(_free_port()),
+            sys.executable, prog,
+        ],
+        env=env, timeout=180, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, (
+        f"traced spawn exited {proc.returncode}\nstderr:\n{proc.stderr[-4000:]}"
+    )
+
+    # each per-process part is valid Chrome Trace JSON with the core spans
+    parts = [f"{trace_base}.p{p}" for p in (0, 1)]
+    for path in parts:
+        assert os.path.exists(path), f"missing trace part {path}"
+        with open(path) as f:
+            doc = json.load(f)
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "engine.run" in names and "tick" in names, sorted(names)
+
+    merged_path = os.path.join(tmp, "merged.json")
+    mproc = subprocess.run(
+        [
+            sys.executable, "-m", "pathway_tpu.cli", "trace", "merge",
+            trace_base, "-o", merged_path,
+        ],
+        env=_base_env(repo_root), timeout=60, capture_output=True, text=True,
+    )
+    assert mproc.returncode == 0, (
+        f"trace merge exited {mproc.returncode}\nstderr:\n{mproc.stderr}"
+    )
+    with open(merged_path) as f:
+        merged = json.load(f)
+    evs = merged["traceEvents"]
+    pids = {e.get("pid") for e in evs}
+    assert pids >= {0, 1}, f"merged timeline misses a process: pids={pids}"
+
+    # clock-sync metadata from the handshake ping, both directions
+    sync = {
+        e["pid"]: e["args"]
+        for e in evs
+        if e.get("name") == "trace.clock_sync"
+    }
+    assert set(sync) >= {0, 1}, sync
+    assert "1" in sync[0]["clock_offsets"], sync[0]
+    assert "0" in sync[1]["clock_offsets"], sync[1]
+    run_ids = {a["run_id"] for a in sync.values()}
+    assert len(run_ids) == 1, f"run ids diverge: {run_ids}"
+
+    # cross-process flow events: the same flow id starts on one process
+    # and finishes on the other
+    starts = {e["id"]: e["pid"] for e in evs if e.get("ph") == "s"}
+    ends = {e["id"]: e["pid"] for e in evs if e.get("ph") == "f"}
+    cross = [i for i in starts if i in ends and starts[i] != ends[i]]
+    assert cross, (
+        f"no cross-process flow pairs ({len(starts)} starts, "
+        f"{len(ends)} finishes)"
+    )
+
+    # clock-aligned: both engine.run spans must overlap in merged time
+    runs = [e for e in evs if e["name"] == "engine.run"]
+    assert len(runs) == 2, runs
+    (a, b) = sorted(runs, key=lambda e: e["ts"])
+    assert b["ts"] < a["ts"] + a["dur"], (
+        "merged engine.run spans do not overlap — clocks misaligned"
+    )
+    return {
+        "parts": parts,
+        "merged": merged_path,
+        "cross_flows": len(cross),
+        "events": len(evs),
+    }
+
+
+def _run_chaos(tmp: str, repo_root: str) -> dict:
+    prog = os.path.join(tmp, "chaos.py")
+    with open(prog, "w") as f:
+        f.write(textwrap.dedent(_CHAOS_PROGRAM))
+    out = os.path.join(tmp, "events.jsonl")
+    flight = os.path.join(tmp, "flight")
+    http_base = _free_port()
+    env = {
+        **_base_env(repo_root),
+        "PATHWAY_FAULT_PLAN": json.dumps(FAULT_PLAN),
+        "PATHWAY_FLIGHT_DIR": flight,
+        "PATHWAY_MONITORING_HTTP_SERVER": "1",
+        "PATHWAY_MONITORING_HTTP_PORT": str(http_base),
+        "PATHWAY_SUPERVISE_BACKOFF_S": "0.05",
+        "PATHWAY_SUPERVISE_BACKOFF_MAX_S": "0.2",
+        "PATHWAY_SUPERVISE_GRACE_S": "5",
+    }
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pathway_tpu.cli", "spawn",
+            "--supervise", "-n", "2", "-t", "1",
+            "--first-port", str(_free_port()),
+            sys.executable, prog, out,
+        ],
+        env=env, timeout=240, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, (
+        f"supervised spawn exited {proc.returncode}\n"
+        f"stderr:\n{proc.stderr[-4000:]}"
+    )
+
+    # the dead worker's ring was harvested into a crash bundle ...
+    bundle_path = os.path.join(flight, "crash-0-1.json")
+    assert os.path.exists(bundle_path), os.listdir(flight)
+    with open(bundle_path) as f:
+        bundle = json.load(f)
+    assert bundle["process"] == 1 and bundle["generation"] == 0
+    # ... containing the worker's final ticks (killed at its 6th tick)
+    ticks = [r for r in bundle["last_ticks"] if r.get("worker") == 1]
+    assert ticks, bundle["last_ticks"]
+    assert max(r["seq"] for r in ticks) >= 3, ticks
+    # ... and the self-documented chaos injection that killed it
+    assert any(
+        c.get("action") == "kill" for c in bundle["chaos_fired"]
+    ), bundle["chaos_fired"]
+    # bundle path stamped into the restart reason
+    assert bundle_path in proc.stderr, proc.stderr[-2000:]
+
+    # generation 1's /metrics carries the harvested-dump counter
+    metrics = None
+    with open(out) as f:
+        for line in f:
+            try:
+                e = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if e and e[0] == "metrics":
+                metrics = e[1]
+    assert metrics is not None, "generation 1 never scraped its /metrics"
+    from pathway_tpu.observability.prometheus import parse_exposition
+
+    values = parse_exposition(metrics)
+    dumps = values.get(("pathway_flight_recorder_dumps_total", ()))
+    assert dumps is not None and dumps >= 1, (
+        f"pathway_flight_recorder_dumps_total={dumps}"
+    )
+    reasons = [
+        labels
+        for (name, labels) in values
+        if name == "pathway_last_restart_reason"
+    ]
+    assert any(
+        "crash-0-1.json" in v for labels in reasons for _, v in labels
+    ), reasons
+    return {"bundle": bundle_path, "dumps": dumps, "ticks": len(ticks)}
+
+
+def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
+    """Run both phases; raises AssertionError on any violation."""
+    tmp = workdir or tempfile.mkdtemp(prefix="trace_smoke_")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    traced = _run_traced(tmp, repo_root)
+    if verbose:
+        print(
+            f"trace_smoke phase 1: {traced['events']} merged events, "
+            f"{traced['cross_flows']} cross-process flows"
+        )
+    chaos = _run_chaos(tmp, repo_root)
+    if verbose:
+        print(
+            f"trace_smoke phase 2: bundle {chaos['bundle']} "
+            f"({chaos['ticks']} final ticks), dumps={chaos['dumps']}"
+        )
+    return {"traced": traced, "chaos": chaos}
+
+
+def main() -> int:
+    try:
+        run_smoke(verbose=True)
+    except BaseException as e:  # noqa: BLE001 — CLI exit-code surface
+        print(f"trace_smoke FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    print("trace_smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+"""Tier-1 wrapper around scripts/check_knobs.py: every PATHWAY_* env
+knob the engine reads must be documented in README.md, so a knob cannot
+ship without an operator-facing description."""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    ),
+)
+
+
+def test_all_knobs_documented():
+    from check_knobs import collect_knobs, undocumented
+
+    knobs = collect_knobs()
+    # sanity: the scan actually sees the core knob surface
+    assert "PATHWAY_TRACE_FILE" in knobs
+    assert "PATHWAY_FLIGHT_DIR" in knobs
+    assert "PATHWAY_THREADS" in knobs
+    missing = undocumented()
+    assert not missing, (
+        f"undocumented PATHWAY_* knobs: {sorted(missing)} — add them to "
+        "README.md (knob index or a section table)"
+    )
+
+
+def test_documented_match_is_whole_name(tmp_path):
+    # a documented PATHWAY_TRACE_FILE must not vouch for a hypothetical
+    # undocumented PATHWAY_TRACE substring-knob
+    import re
+
+    from check_knobs import undocumented
+
+    readme = tmp_path / "README.md"
+    readme.write_text("only `PATHWAY_TRACE_FILE` is documented here")
+    missing = undocumented(readme_path=str(readme))
+    assert "PATHWAY_TRACE_FILE" not in missing
+    # every other real knob correctly reports missing against this README
+    assert "PATHWAY_THREADS" in missing
+    # substring containment alone must not count as documented
+    assert not re.search(r"(?<![A-Z0-9_])PATHWAY_TRACE(?![A-Z0-9_])",
+                         readme.read_text())
+
+
+def test_scan_matches_wrapped_calls(tmp_path):
+    # the read-site regex must span black-style line wrapping
+    from check_knobs import _READ
+
+    text = 'x = int(\n    os.environ.get(\n        "PATHWAY_WRAPPED_KNOB", "1"\n    )\n)'
+    assert _READ.search(text).group(1) == "PATHWAY_WRAPPED_KNOB"
+    # env WRITES must not register as knobs
+    assert _READ.search('env["PATHWAY_SET_ONLY"] = "1"') is None

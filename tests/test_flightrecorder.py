@@ -1,0 +1,246 @@
+"""Flight recorder (observability/flightrecorder.py) — the mmap black box
+and the supervisor's crash-bundle harvest."""
+
+import json
+import os
+
+import pytest
+
+from pathway_tpu.observability import flightrecorder as fr
+
+
+@pytest.fixture(autouse=True)
+def _reset_recorder_env(monkeypatch):
+    monkeypatch.delenv("PATHWAY_FLIGHT_DIR", raising=False)
+    yield
+    # drop the module singleton so other tests never inherit a stale ring
+    if fr._active is not None:
+        fr._active.close()
+    fr._active = None
+    fr._env_sig = None
+
+
+def test_ring_roundtrip(tmp_path):
+    path = str(tmp_path / "flight-p0.ring")
+    rec = fr.FlightRecorder(path, capacity_bytes=8192, process_id=3,
+                            run_id="abc123")
+    for i in range(10):
+        rec.record("tick", worker=0, seq=i)
+    rec.close()
+    doc = fr.harvest(path)
+    assert doc["process_id"] == 3
+    assert doc["run_id"] == "abc123"
+    assert not doc["wrapped"]
+    ticks = [r for r in doc["records"] if r["kind"] == "tick"]
+    assert [r["seq"] for r in ticks] == list(range(10))
+    assert all("t" in r for r in ticks)
+
+
+def test_ring_wraps_keeping_newest(tmp_path):
+    path = str(tmp_path / "flight-p0.ring")
+    rec = fr.FlightRecorder(path, capacity_bytes=4096, process_id=0)
+    for i in range(500):  # far more than 4KB of records
+        rec.record("tick", seq=i, pad="x" * 40)
+    rec.close()
+    doc = fr.harvest(path)
+    assert doc["wrapped"]
+    seqs = [r["seq"] for r in doc["records"] if r["kind"] == "tick"]
+    # the newest record survives, the oldest is gone, order is preserved
+    assert seqs[-1] == 499
+    assert seqs[0] > 0
+    assert seqs == sorted(seqs)
+
+
+def test_write_landing_exactly_at_capacity_sets_wrap(tmp_path, monkeypatch):
+    monkeypatch.setattr(fr.time, "time", lambda: 1000.5)  # fixed-size "t"
+    path = str(tmp_path / "flight-p0.ring")
+    rec = fr.FlightRecorder(path, capacity_bytes=4096, process_id=0)
+    # fill the ring so one record's last byte lands EXACTLY at capacity:
+    # head returns to 0 and the wrap flag must be set, else a harvest
+    # would read data[:0] and lose the full ring
+    rec.record("pad", fill=".")
+    base = rec._head - 1  # record length with an empty fill
+    n_pads = 1
+    while True:
+        remaining = 4096 - rec._head
+        if base + 1 <= remaining <= base + 2000:
+            rec.record("pad", fill="." * (remaining - base))
+            n_pads += 1
+            break
+        rec.record("pad", fill=".")
+        n_pads += 1
+    assert rec._head == 0 and rec._wrapped == 1
+    rec.record("after", n=1)
+    rec.close()
+    doc = fr.harvest(path)
+    kinds = [r["kind"] for r in doc["records"]]
+    assert kinds.count("pad") >= n_pads - 1  # pre-boundary ring survives
+    assert kinds[-1] == "after"
+
+
+def test_harvest_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / "flight-p0.ring")
+    rec = fr.FlightRecorder(path, capacity_bytes=4096, process_id=0)
+    for i in range(5):
+        rec.record("tick", seq=i)
+    # simulate a SIGKILL mid-write: half a record at the head, header
+    # already pointing past it
+    torn = b'{"t": 1, "kind": "tick", "se'
+    head = rec._head
+    rec._mm[fr._HDR_SIZE + head : fr._HDR_SIZE + head + len(torn)] = torn
+    rec._head = head + len(torn)
+    rec._write_header()
+    rec.close()
+    doc = fr.harvest(path)
+    seqs = [r.get("seq") for r in doc["records"] if r["kind"] == "tick"]
+    assert seqs == [0, 1, 2, 3, 4]  # the torn line is skipped, not fatal
+
+
+def test_harvest_rejects_non_ring(tmp_path):
+    p = tmp_path / "not_a_ring"
+    p.write_bytes(b"hello world")
+    with pytest.raises(ValueError):
+        fr.harvest(str(p))
+
+
+def test_oversized_and_unserializable_records_dropped(tmp_path):
+    path = str(tmp_path / "flight-p0.ring")
+    rec = fr.FlightRecorder(path, capacity_bytes=4096, process_id=0)
+    rec.record("huge", pad="x" * 10000)  # larger than the whole ring
+    rec.record("ok", n=1)
+    rec.close()
+    kinds = [r["kind"] for r in fr.harvest(path)["records"]]
+    assert kinds == ["ok"]
+
+
+def test_get_recorder_env_gated(tmp_path, monkeypatch):
+    assert fr.get_recorder() is None
+    monkeypatch.setenv("PATHWAY_FLIGHT_DIR", str(tmp_path / "fd"))
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "2")
+    rec = fr.get_recorder()
+    assert rec is not None
+    assert rec.path.endswith("flight-p2.ring")
+    assert fr.get_recorder() is rec  # cached while env unchanged
+    rec.record("x")
+    monkeypatch.delenv("PATHWAY_FLIGHT_DIR")
+    assert fr.get_recorder() is None  # env change disarms + closes
+    # the ring file stays on disk as evidence, with a recorder.start record
+    doc = fr.harvest(str(tmp_path / "fd" / "flight-p2.ring"))
+    assert doc["records"][0]["kind"] == "recorder.start"
+
+
+def test_executor_writes_tick_records(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_FLIGHT_DIR", str(tmp_path / "fd"))
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    t = pw.debug.table_from_markdown("a\n1\n2\n3")
+    out = t.select(b=pw.this.a + 1)
+    pw.debug.compute_and_print(out)
+    G.clear()
+    doc = fr.harvest(str(tmp_path / "fd" / "flight-p0.ring"))
+    kinds = [r["kind"] for r in doc["records"]]
+    assert "run.start" in kinds
+    assert "tick" in kinds
+    assert "run.end" in kinds
+    tick = next(r for r in doc["records"] if r["kind"] == "tick")
+    assert {"worker", "time", "seq", "dur_ms", "rows"} <= set(tick)
+
+
+def test_supervisor_harvests_crash_bundle(tmp_path):
+    # build a ring the way a crashed worker would leave it
+    flight = tmp_path / "flight"
+    flight.mkdir()
+    rec = fr.FlightRecorder(
+        fr.ring_path(str(flight), 1), capacity_bytes=8192, process_id=1,
+        run_id="deadbeef",
+    )
+    rec.record("run.start", worker=1)
+    for i in range(7):
+        rec.record("tick", worker=1, seq=i, time=1000 + 2 * i)
+    rec.record("chaos.fired", site="tick", action="kill", scope="tick/w1",
+               event=1)
+    rec.close()
+
+    from pathway_tpu.parallel.supervisor import Supervisor
+
+    sup = Supervisor(
+        lambda g, r: [], flight_dir=str(flight), process_ids=[0, 1],
+        log=lambda m: None,
+    )
+    sup._failed_indices = [1]
+    bundles = sup._harvest_flight(0, "process 1 exited with -9")
+    assert bundles == [str(flight / "crash-0-1.json")]
+    assert sup.flight_dumps_total == 1
+    bundle = json.loads((flight / "crash-0-1.json").read_text())
+    assert bundle["process"] == 1
+    assert bundle["run_id"] == "deadbeef"
+    assert bundle["exit_reason"] == "process 1 exited with -9"
+    assert [r["seq"] for r in bundle["last_ticks"]] == list(range(7))
+    assert bundle["chaos_fired"][0]["action"] == "kill"
+    # the ring is consumed by the harvest: a next-generation child that
+    # dies before re-creating it must not get this generation's records
+    # misattributed to it
+    assert not os.path.exists(fr.ring_path(str(flight), 1))
+
+
+def test_supervisor_skips_stale_ring_from_previous_run(tmp_path):
+    # a child that dies before arming its recorder leaves the PREVIOUS
+    # run's ring in place; harvesting it would present another run's
+    # forensics as this one's
+    flight = tmp_path / "flight"
+    flight.mkdir()
+    rec = fr.FlightRecorder(
+        fr.ring_path(str(flight), 1), capacity_bytes=8192, process_id=1,
+        run_id="oldrun",
+    )
+    rec.record("tick", worker=1, seq=0)
+    rec.close()
+
+    from pathway_tpu.parallel.supervisor import Supervisor
+
+    sup = Supervisor(
+        lambda g, r: [], flight_dir=str(flight), process_ids=[0, 1],
+        run_id="newrun", log=lambda m: None,
+    )
+    sup._failed_indices = [1]
+    assert sup._harvest_flight(0, "boom") == []
+    assert sup.flight_dumps_total == 0
+    # matching run id harvests normally
+    rec = fr.FlightRecorder(
+        fr.ring_path(str(flight), 1), capacity_bytes=8192, process_id=1,
+        run_id="newrun",
+    )
+    rec.record("tick", worker=1, seq=0)
+    rec.close()
+    assert sup._harvest_flight(1, "boom again") == [
+        str(flight / "crash-1-1.json")
+    ]
+
+
+def test_supervisor_harvest_missing_ring_is_quiet(tmp_path):
+    from pathway_tpu.parallel.supervisor import Supervisor
+
+    sup = Supervisor(
+        lambda g, r: [], flight_dir=str(tmp_path), process_ids=[0],
+        log=lambda m: None,
+    )
+    sup._failed_indices = [0]
+    assert sup._harvest_flight(0, "boom") == []
+    assert sup.flight_dumps_total == 0
+
+
+def test_render_metrics_flight_dumps(monkeypatch):
+    from pathway_tpu.observability.prometheus import (
+        parse_exposition,
+        render_snapshots,
+    )
+
+    text = render_snapshots(
+        [], supervisor={"restarts": 1, "reason": "x", "flight_dumps": 2},
+        trace_dropped=5,
+    )
+    values = parse_exposition(text)
+    assert values[("pathway_flight_recorder_dumps_total", ())] == 2
+    assert values[("pathway_trace_dropped_events_total", ())] == 5

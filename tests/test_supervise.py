@@ -174,6 +174,9 @@ def test_sharded_sigkill_supervised_recovery(tmp_path):
         "JAX_PLATFORMS": "cpu",
         "PYTHONPATH": repo_root,
         "PATHWAY_FAULT_PLAN": json.dumps(plan),
+        # keep flight-recorder rings/bundles inside the test dir (the
+        # --supervise default would land them in the test runner's cwd)
+        "PATHWAY_FLIGHT_DIR": str(tmp_path / "flight"),
         "PATHWAY_SUPERVISE_BACKOFF_S": "0.05",
         "PATHWAY_SUPERVISE_BACKOFF_MAX_S": "0.2",
     }

@@ -1,0 +1,25 @@
+"""Tier-1 wrapper around scripts/trace_smoke.py (like test_chaos_smoke):
+the cluster-forensics loop — a two-process traced run whose per-process
+parts `pathway-tpu trace merge` assembles into one clock-aligned timeline
+with cross-worker flow events, and a supervised chaos run whose planned
+SIGKILL yields a flight-recorder crash bundle with the dead worker's
+final ticks, the bundle path in the restart reason, and
+pathway_flight_recorder_dumps_total >= 1 on /metrics."""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    ),
+)
+
+
+def test_trace_smoke(tmp_path):
+    from trace_smoke import run_smoke
+
+    result = run_smoke(workdir=str(tmp_path))
+    assert result["traced"]["cross_flows"] > 0
+    assert result["chaos"]["dumps"] >= 1

@@ -163,6 +163,219 @@ def test_programmatic_activation(tmp_path):
     assert tracer.flush() is None
 
 
+def test_overflow_drop_never_orphans_counter(tmp_path):
+    # spans and their counter samples are appended as one atomic pair; the
+    # overflow drop must never keep a counter whose tick span was dropped
+    tracer = tracing.Tracer(str(tmp_path / "t.json"), max_events=8)
+    import time as _time
+
+    for i in range(50):
+        tracer.complete(
+            "tick", _time.perf_counter_ns(), {"time": i},
+            counter=("rows", {"n": float(i)}),
+        )
+    # the first surviving event is never an orphaned counter sample
+    assert tracer._events[0]["ph"] != "C"
+    # and every surviving counter is directly preceded by its span
+    for j, ev in enumerate(tracer._events):
+        if ev["ph"] == "C":
+            assert tracer._events[j - 1]["ph"] == "X"
+    assert tracer._dropped > 0
+
+
+def test_events_since_cursor_correct_across_drop(tmp_path):
+    tracer = tracing.Tracer(str(tmp_path / "t.json"), max_events=10)
+    for i in range(5):
+        tracer.instant(f"a{i}")
+    events, mark = tracer.events_since(0)
+    assert [e["name"] for e in events] == [f"a{i}" for i in range(5)]
+    # overflow between exports: more events appended than the buffer holds
+    for i in range(40):
+        tracer.instant(f"b{i}")
+    events, mark2 = tracer.events_since(mark)
+    names = [e["name"] for e in events]
+    # nothing before the cursor is re-exported (no double export) ...
+    assert not any(n.startswith("a") for n in names)
+    # ... the tail is contiguous and ends at the newest event (no skips
+    # within the surviving window) ...
+    tail = [f"b{i}" for i in range(40)][-len(names):]
+    assert names == tail
+    # ... and a drained cursor exports nothing
+    assert tracer.events_since(mark2) == ([], mark2)
+
+
+def test_local_comm_flow_events_link_workers(tmp_path, monkeypatch):
+    # threads in one process: exchange flows must cross-link sender and
+    # receiver tick spans via deterministic ids (s on one tid, f on others)
+    path = tmp_path / "flows.json"
+    monkeypatch.setenv("PATHWAY_TRACE_FILE", str(path))
+    monkeypatch.setenv("PATHWAY_THREADS", "2")
+    out = _small_pipeline()
+    pw.io.subscribe(out, on_change=lambda **kw: None)
+    pw.run()
+    monkeypatch.delenv("PATHWAY_THREADS")
+    doc = json.loads(path.read_text())
+    starts = {e["id"]: e for e in doc["traceEvents"] if e.get("ph") == "s"}
+    ends = {e["id"]: e for e in doc["traceEvents"] if e.get("ph") == "f"}
+    linked = [i for i in starts if i in ends]
+    assert linked, (len(starts), len(ends))
+    # the two halves of at least one flow live on different worker threads
+    assert any(starts[i]["tid"] != ends[i]["tid"] for i in linked)
+    # clock-sync metadata always present (merge anchor, even single-process)
+    sync = [
+        e for e in doc["traceEvents"] if e["name"] == "trace.clock_sync"
+    ]
+    assert sync and "origin_unix_ns" in sync[0]["args"]
+    assert sync[0]["args"]["run_id"]
+
+
+def test_multiprocess_trace_files_cross_link(tmp_path):
+    # satellite: spawn 2 real processes with PATHWAY_TRACE_FILE; both .p<N>
+    # parts must be valid Chrome Trace JSON with engine.run/tick spans and
+    # flow-event ids that cross-link the files
+    import os
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    prog = tmp_path / "prog.py"
+    prog.write_text(textwrap.dedent(
+        """
+        import pathway_tpu as pw
+
+        t = pw.debug.table_from_markdown(
+            \"\"\"
+            a | b
+            1 | x
+            2 | x
+            3 | y
+            4 | y
+            \"\"\"
+        )
+        out = t.groupby(pw.this.b).reduce(
+            pw.this.b, s=pw.reducers.sum(pw.this.a)
+        )
+        pw.io.subscribe(out, on_change=lambda **kw: None)
+        pw.run()
+        """
+    ))
+    base = tmp_path / "trace.json"
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PATHWAY_TRACE_FILE": str(base),
+    }
+    env.pop("PATHWAY_THREADS", None)
+    env.pop("PATHWAY_PROCESSES", None)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pathway_tpu.cli", "spawn",
+            "-n", "2", "-t", "1", "--first-port", str(port),
+            sys.executable, str(prog),
+        ],
+        env=env, timeout=180, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    docs = {}
+    for p in (0, 1):
+        part = tmp_path / f"trace.json.p{p}"
+        assert part.exists()
+        docs[p] = json.loads(part.read_text())  # valid Chrome Trace JSON
+        names = {e["name"] for e in docs[p]["traceEvents"]}
+        assert "engine.run" in names and "tick" in names, sorted(names)
+    # cross-link: a flow id started in one process finishes in the other
+    starts = {
+        (e["id"], p)
+        for p in docs
+        for e in docs[p]["traceEvents"]
+        if e.get("ph") == "s"
+    }
+    ends = {
+        (e["id"], p)
+        for p in docs
+        for e in docs[p]["traceEvents"]
+        if e.get("ph") == "f"
+    }
+    cross = {
+        i for (i, p) in starts for (j, q) in ends if i == j and p != q
+    }
+    assert cross, (len(starts), len(ends))
+    # both parts agree on the spawn-stamped run id
+    run_ids = {
+        e["args"]["run_id"]
+        for p in docs
+        for e in docs[p]["traceEvents"]
+        if e["name"] == "trace.clock_sync"
+    }
+    assert len(run_ids) == 1
+
+
+def test_metrics_expose_trace_drops(tmp_path):
+    # a truncated trace window must be visible on /metrics — 0 when the
+    # tracer is healthy, the drop count after overflow, absent when off
+    from pathway_tpu.observability import ObservabilityHub
+    from pathway_tpu.observability.prometheus import parse_exposition
+
+    hub = ObservabilityHub()
+    tracer = tracing.activate(str(tmp_path / "d.json"))
+    try:
+        key = ("pathway_trace_dropped_events_total", ())
+        assert parse_exposition(hub.render_metrics())[key] == 0
+        tracer._max_events = 4
+        for i in range(20):
+            tracer.instant(f"e{i}")
+        assert parse_exposition(hub.render_metrics())[key] > 0
+    finally:
+        tracing.deactivate()
+    assert key not in parse_exposition(hub.render_metrics())
+
+
+def test_cluster_rollup_reports_peer_trace_drops(monkeypatch, tmp_path):
+    # a PEER's truncated timeline must surface on the merged /metrics as a
+    # per-process-labeled series (a transiently unreachable peer then
+    # drops its series instead of decreasing a summed counter, which
+    # Prometheus would misread as a reset)
+    from pathway_tpu.observability import ObservabilityHub
+    from pathway_tpu.observability.prometheus import parse_exposition
+
+    hub = ObservabilityHub(
+        process_id=0, n_processes=2, peer_http=[("127.0.0.1", 1)]
+    )
+    peer_doc: dict = {
+        "process_id": 1,
+        "workers": [],
+        "comm": {},
+        "trace_dropped": 11,
+    }
+    monkeypatch.setattr(
+        ObservabilityHub, "_scrape_peer",
+        staticmethod(lambda host, port: peer_doc),
+    )
+    tracer = tracing.activate(str(tmp_path / "r.json"))
+    tracer._dropped = 3
+    try:
+        values = parse_exposition(hub.render_metrics())
+        key = "pathway_trace_dropped_events_total"
+        assert values[(key, (("process", "1"),))] == 11
+        assert values[(key, (("process", "0"),))] == 3
+        # peer outage: its series disappears, process 0's is unchanged
+        monkeypatch.setattr(
+            ObservabilityHub, "_scrape_peer",
+            staticmethod(lambda host, port: None),
+        )
+        values = parse_exposition(hub.render_metrics())
+        assert (key, (("process", "1"),)) not in values
+        assert values[(key, (("process", "0"),))] == 3
+    finally:
+        tracing.deactivate()
+
+
 # -- OTLP push (reference telemetry.rs:63-156) -------------------------------
 
 
