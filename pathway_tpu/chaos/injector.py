@@ -167,6 +167,13 @@ class ActiveFaults:
         ]
         return LocalFaults(self, matches) if matches else None
 
+    def rescale_faults(self) -> "RescaleFaults | None":
+        matches = [
+            (i, f) for i, f in enumerate(self.plan.faults)
+            if f.site == "rescale"
+        ]
+        return RescaleFaults(self, matches) if matches else None
+
     def wrap_backend(self, backend: Any, worker_id: int) -> Any:
         matches = [
             (i, f) for i, f in enumerate(self.plan.faults)
@@ -211,6 +218,32 @@ class TickFault:
                 raise ChaosInjected(
                     f"chaos: injected crash at tick {tick_seq} "
                     f"({self._scope})"
+                )
+
+
+class RescaleFaults:
+    """Bound rescale-site handle for the offline resharder: fires at the
+    resharder's phase boundaries (plan/stage/copy/promote/cleanup) — a
+    ``kill`` here is the crash-mid-rescale the atomicity protocol must
+    survive."""
+
+    def __init__(self, owner: ActiveFaults, matches: list[tuple[int, Fault]]):
+        self._owner = owner
+        self._matches = matches
+
+    def fire(self, phase: str) -> None:
+        for idx, f in self._matches:
+            if f.phase not in (None, phase):
+                continue
+            if not self._owner._decide(idx, f, f"rescale/{phase}"):
+                continue
+            if f.action == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif f.action == "exit":
+                os._exit(19)
+            else:  # crash
+                raise ChaosInjected(
+                    f"chaos: injected crash at rescale phase {phase!r}"
                 )
 
 

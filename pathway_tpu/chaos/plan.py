@@ -36,6 +36,11 @@ Sites and actions:
   (raise before writing) or ``torn`` (write a truncated blob, then raise —
   a torn write landing despite the backends' atomic-rename discipline).
   Selected by ``worker``, ``nth`` and optional ``key_prefix``.
+- ``rescale`` — the offline state resharder's phase boundaries
+  (``rescale/resharder.py``: plan, stage, copy, promote, cleanup).
+  ``action`` is ``crash``, ``exit`` or ``kill``; selected by ``phase``
+  and ``nth``. A kill before ``promote`` must leave the OLD layout
+  bootable; at/after ``cleanup`` the NEW one — the atomicity proof.
 
 Determinism contract: a plan plus its ``seed`` fully determines the
 injection schedule. ``nth``/``tick`` faults are trivially deterministic;
@@ -60,13 +65,16 @@ from typing import Any
 
 __all__ = ["Fault", "FaultPlan", "load_plan_from_env"]
 
-_SITES = ("tick", "comm.send", "comm.local", "persistence.put")
+_SITES = ("tick", "comm.send", "comm.local", "persistence.put", "rescale")
 _ACTIONS = {
     "tick": ("crash", "exit", "kill", "hang"),
     "comm.send": ("drop", "delay", "duplicate", "sever"),
     "comm.local": ("drop", "delay"),
     "persistence.put": ("fail", "torn"),
+    "rescale": ("crash", "exit", "kill"),
 }
+#: rescale-site phase boundaries, in execution order (resharder.py)
+RESCALE_PHASES = ("plan", "stage", "copy", "promote", "cleanup")
 
 
 @dataclass(frozen=True)
@@ -87,6 +95,9 @@ class Fault:
     prob: float | None = None
     #: persistence.put: only count puts whose key starts with this
     key_prefix: str | None = None
+    #: rescale site: fire at this phase boundary of the resharder
+    #: (one of RESCALE_PHASES); None = any phase
+    phase: str | None = None
     #: delay/hang duration; None = the action's default (delay 0.05s,
     #: hang effectively-forever)
     delay_s: float | None = None
@@ -105,6 +116,11 @@ class Fault:
             )
         if self.site == "tick" and self.tick is None:
             raise ValueError("fault plan: tick faults need a 'tick' number")
+        if self.phase is not None and self.phase not in RESCALE_PHASES:
+            raise ValueError(
+                f"fault plan: unknown rescale phase {self.phase!r} "
+                f"(one of {RESCALE_PHASES})"
+            )
         if self.prob is not None and not 0.0 <= self.prob <= 1.0:
             raise ValueError(f"fault plan: prob {self.prob} not in [0, 1]")
 

@@ -1,7 +1,9 @@
 """``pathway`` CLI (reference ``python/pathway/cli.py:53-280``):
 ``spawn`` launches a program over N processes × T threads with the worker
 environment set; ``replay`` re-runs a program against recorded input
-(``--record`` under spawn captures it); ``trace merge`` assembles the
+(``--record`` under spawn captures it); ``rescale`` repartitions a
+persisted cluster's state to a new worker count (``spawn --elastic``
+does the same in-process at boot); ``trace merge`` assembles the
 per-process ``PATHWAY_TRACE_FILE`` parts of a cluster run into one
 clock-aligned Perfetto timeline.
 
@@ -19,7 +21,7 @@ import click
 
 from .internals.config import MAX_WORKERS
 
-__all__ = ["main", "spawn", "replay", "trace"]
+__all__ = ["main", "spawn", "replay", "rescale", "trace"]
 
 
 @click.group()
@@ -205,9 +207,14 @@ def _run_supervised(
                    "the last common snapshot (jittered exponential backoff, "
                    "crash-loop circuit breaker — see "
                    "PATHWAY_SUPERVISE_MAX_RESTARTS and friends)")
+@click.option("--elastic", is_flag=True, default=False,
+              help="elastic boot: if the persisted state was written by a "
+                   "different worker count, worker 0 runs the state "
+                   "resharder (pathway-tpu rescale) in-process before the "
+                   "engine mounts it (sets PATHWAY_ELASTIC=1)")
 @click.argument("program", nargs=-1, type=click.UNPROCESSED)
 def spawn(threads, processes, first_port, record, record_path, addresses,
-          local_ids, supervise, program):
+          local_ids, supervise, elastic, program):
     """Launch PROGRAM with the worker environment set (reference cli.py:53).
 
     Multi-host: run once per machine with the same ``--addresses`` book and
@@ -217,9 +224,47 @@ def spawn(threads, processes, first_port, record, record_path, addresses,
     if record:
         env_extra["PATHWAY_REPLAY_STORAGE"] = record_path
         env_extra["PATHWAY_SNAPSHOT_ACCESS"] = "record"
+    if elastic:
+        env_extra["PATHWAY_ELASTIC"] = "1"
     sys.exit(_spawn_processes(threads, processes, first_port, env_extra,
                               program, addresses=addresses,
                               local_ids=local_ids, supervise=supervise))
+
+
+@main.command()
+@click.option("--to", "to_workers", type=int, required=True,
+              help="target worker count")
+@click.option("--backend", "backend_kind",
+              type=click.Choice(["filesystem", "s3"]), default="filesystem",
+              help="persistence backend kind holding the state")
+@click.argument("store")
+def rescale(to_workers, backend_kind, store):
+    """Repartition persisted cluster state to --to workers.
+
+    STORE is the persistence root (the path given to
+    ``pw.persistence.Backend.filesystem``, or an ``s3://bucket/prefix``
+    URI). The resharder splits every stateful operator's snapshot and
+    every live input chunk by row key, writes a complete layout for the
+    new worker count, and promotes it with one atomic cluster-marker
+    rewrite — a crash mid-rescale leaves the old layout bootable."""
+    import json as _json
+
+    from .persistence import Backend
+    from .rescale import RescaleError, rescale as _rescale
+
+    spec = (
+        Backend.filesystem(store)
+        if backend_kind == "filesystem"
+        else Backend.s3(store)
+    )
+    try:
+        report = _rescale(spec, to_workers, log=lambda m: click.echo(m, err=True))
+    except RescaleError as e:
+        raise click.ClickException(str(e))
+    if report.get("noop"):
+        click.echo(f"already at {to_workers} worker(s) — nothing to do")
+    else:
+        click.echo(_json.dumps(report))
 
 
 @main.command(context_settings={"ignore_unknown_options": True})

@@ -132,6 +132,64 @@ class Node:
     #: class can name mode-dependent fields.
     STATE_FIELDS: tuple[str, ...] = ()
 
+    #: how this operator's persisted state repartitions when the cluster is
+    #: resharded from N to M workers (rescale/resharder.py):
+    #:
+    #: - ``"keyed"``  — state containers are keyed by the same uint64
+    #:   routing keys the operator's exchange spec uses; ``split_state``
+    #:   filters by destination key-shard, ``merge_states`` unions disjoint
+    #:   pieces.
+    #: - ``"pinned"`` — the whole state lives on worker 0 (gather-routed
+    #:   operators: Capture, Iterate, GradualBroadcast, external index).
+    #:   ``split_state`` hands every destination the piece unchanged and
+    #:   ``merge_states`` keeps source worker 0's piece — gather semantics
+    #:   guarantee the other source workers' copies are pristine, and a
+    #:   replicated copy on destination workers > 0 is inert (they never
+    #:   receive gathered rows).
+    #: - ``"replicate"`` — per-source scanner state (RealtimeSource): only
+    #:   the owner worker ever advanced it; every destination receives the
+    #:   field-wise union so the post-rescale owner (source index mod M)
+    #:   finds it wherever it lands.
+    RESHARD: str = "keyed"
+
+    @classmethod
+    def split_state(cls, state: dict, key_mask) -> dict:
+        """The sub-state of one persisted ``snapshot_state()`` dict owned by
+        a destination worker. ``key_mask(uint64[n]) -> bool[n]`` answers
+        "does this routing key belong to the destination's shard". The
+        generic implementation splits int-keyed dicts, ``RowState`` tables
+        and lists/tuples of those by their top-level keys — operators whose
+        state is shaped differently override (GroupByReduce arenas, Join
+        arrangements, temporal buffers)."""
+        if cls.RESHARD != "keyed":
+            return state
+        return {
+            f: _split_keyed_value(cls, f, v, key_mask)
+            for f, v in state.items()
+        }
+
+    @classmethod
+    def merge_states(cls, states: list[dict]) -> dict:
+        """Combine split pieces (one per SOURCE worker, in worker order)
+        into one destination state. Keyed pieces are key-disjoint by the
+        routing invariant and union; pinned state keeps source worker 0's
+        piece; replicated source state unions field-wise."""
+        if not states:
+            raise ValueError(f"{cls.__name__}.merge_states: no pieces")
+        if cls.RESHARD == "pinned":
+            return states[0]
+        if cls.RESHARD == "replicate":
+            fields = states[0].keys()
+            return {
+                f: _merge_replicated_value(cls, f, [s[f] for s in states])
+                for f in fields
+            }
+        fields = states[0].keys()
+        return {
+            f: _merge_keyed_value(cls, f, [s[f] for s in states])
+            for f in fields
+        }
+
     def __init__(self, inputs: list["Node"], column_names: list[str]):
         self.node_id = next(Node._ids)
         self.inputs = list(inputs)
@@ -184,6 +242,125 @@ class Node:
         return f"<{type(self).__name__} #{self.node_id} cols={self.column_names}>"
 
 
+def _mask_keys(key_mask, keys) -> np.ndarray:
+    """Apply a shard mask to an iterable of python-int keys."""
+    arr = np.fromiter((int(k) & 0xFFFFFFFFFFFFFFFF for k in keys),
+                      dtype=np.uint64, count=len(keys))
+    return key_mask(arr)
+
+
+def _split_keyed_value(cls, field: str, value, key_mask):
+    from .state import RowState
+
+    if value is None:
+        return None
+    if isinstance(value, RowState):
+        out = RowState(value.columns)
+        items = list(value.iter_items())
+        if items:
+            keep = _mask_keys(key_mask, [k for k, _ in items])
+            for (k, row), m in zip(items, keep.tolist()):
+                if m:
+                    out._rows[k] = row
+                    out._counts[k] = 1
+        return out
+    if isinstance(value, dict):
+        if not value:
+            return {}
+        if all(isinstance(k, (int, np.integer)) for k in value):
+            ks = list(value)
+            keep = _mask_keys(key_mask, ks)
+            return {k: value[k] for k, m in zip(ks, keep.tolist()) if m}
+    if isinstance(value, (list, tuple)):
+        parts = [_split_keyed_value(cls, field, v, key_mask) for v in value]
+        return type(value)(parts)
+    raise TypeError(
+        f"{cls.__name__}.{field} holds a {type(value).__name__} that the "
+        "generic keyed resharder cannot split — the operator must override "
+        "split_state/merge_states"
+    )
+
+
+def _merge_keyed_value(cls, field: str, values: list):
+    from .state import RowState
+
+    if all(v is None for v in values):
+        return None
+    if isinstance(values[0], RowState):
+        out = RowState(values[0].columns)
+        for piece in values:
+            for k, row in piece.iter_items():
+                if k in out._rows:
+                    raise ValueError(
+                        f"{cls.__name__}.{field}: key {k:#x} present in two "
+                        "source workers' state — routing invariant violated"
+                    )
+                out._rows[k] = row
+                out._counts[k] = 1
+        return out
+    if isinstance(values[0], dict):
+        out: dict = {}
+        for piece in values:
+            for k, v in piece.items():
+                if k in out and out[k] != v:
+                    raise ValueError(
+                        f"{cls.__name__}.{field}: key {k!r} present in two "
+                        "source workers' state — routing invariant violated"
+                    )
+                out[k] = v
+        return out
+    if isinstance(values[0], (list, tuple)):
+        merged = [
+            _merge_keyed_value(cls, field, [v[i] for v in values])
+            for i in range(len(values[0]))
+        ]
+        return type(values[0])(merged)
+    raise TypeError(
+        f"{cls.__name__}.{field}: cannot merge {type(values[0]).__name__} "
+        "generically — the operator must override merge_states"
+    )
+
+
+def _merge_replicated_value(cls, field: str, values: list):
+    """Union of per-source scanner state: only the owner worker ever
+    advanced it, the peers hold the initial value, so sets/dicts union,
+    numbers take their max and None loses to anything."""
+    present = [v for v in values if v is not None]
+    if not present:
+        return None
+    first = present[0]
+    try:
+        if all(v == first for v in present[1:]):
+            return first
+    except Exception:
+        pass  # unorderable / ambiguous equality — fall through to merging
+    if isinstance(first, set):
+        out_set: set = set()
+        for v in present:
+            out_set |= v
+        return out_set
+    if isinstance(first, dict):
+        # key-union with RECURSIVE conflict resolution: progress markers
+        # (e.g. per-file row counts) must merge numerically (max), never
+        # by repr ordering — '999' > '1500' as strings
+        out: dict = dict(first)
+        for v in present[1:]:
+            for k, val in v.items():
+                if k not in out:
+                    out[k] = val
+                elif out[k] != val:
+                    out[k] = _merge_replicated_value(
+                        cls, f"{field}[{k!r}]", [out[k], val]
+                    )
+        return out
+    if isinstance(first, (int, float)) and not isinstance(first, bool):
+        return max(present)
+    raise TypeError(
+        f"{cls.__name__}.{field}: conflicting source-state values of type "
+        f"{type(first).__name__} cannot be merged — override merge_states"
+    )
+
+
 class SourceNode(Node):
     """A source: provides a schedule of (time, delta) batches.
 
@@ -220,6 +397,11 @@ class RealtimeSource(SourceNode):
     #: stable id used by persistence to snapshot/replay this source's input
     #: (reference `persistent_id` / unique_name, src/connectors/mod.rs)
     persistent_id: str | None = None
+
+    #: scanner state (seen-file sets, CDC cursors) is per-source, not
+    #: keyed by row shard: a rescale replicates the owner's state to every
+    #: destination so the new owner (source index mod M) finds it
+    RESHARD = "replicate"
 
     def schedule(self) -> list[tuple[int, Delta]]:
         return []
@@ -259,6 +441,21 @@ class RealtimeSource(SourceNode):
         scanners) rebuild their internal last-seen state here so the first
         live poll only emits genuinely new changes instead of re-emitting
         every pre-existing row."""
+
+
+def owned_sources(realtime: list["RealtimeSource"], ctx) -> list["RealtimeSource"]:
+    """The realtime sources THIS worker polls (round-robin by source
+    index). The single owner per source is also the correctness anchor of
+    persisted offsets: only the owner's offset ever advances, so only the
+    owner records it — which is what lets a rescale union per-pid offsets
+    across workers exactly (rescale/resharder.py). Polling and recording
+    MUST use this same assignment."""
+    if not ctx.is_sharded:
+        return list(realtime)
+    return [
+        s for i, s in enumerate(realtime)
+        if i % ctx.n_workers == ctx.worker_id
+    ]
 
 
 def _topological(nodes: list[Node]) -> list[Node]:
@@ -536,6 +733,10 @@ class Executor:
                         self._defer_commit = j < len(rounds) - 1
                         self._tick(clock, emissions)
                     self._defer_commit = False
+                    if self.persistence is not None:
+                        # every drained round has now ticked: live source
+                        # offsets exactly cover the recorded input again
+                        self.persistence.note_delivery_boundary()
                 elif all(src.is_finished() for src in realtime):
                     break
                 else:
@@ -560,10 +761,7 @@ class Executor:
         import threading
 
         ctx = self.ctx
-        owned = [
-            s for i, s in enumerate(realtime)
-            if i % ctx.n_workers == ctx.worker_id
-        ]
+        owned = owned_sources(realtime, ctx)
         wake = threading.Event()
         for src in owned:
             src.attach_waker(wake)
@@ -600,6 +798,10 @@ class Executor:
                     # gathered payload and the shared tick history
                     clock = max(clock + 2, agreed_wall + 2 * j)
                     self._tick(clock, rounds[j] if j < len(rounds) else [])
+                if n_rounds and self.persistence is not None:
+                    # every drained round has now ticked: live source
+                    # offsets exactly cover the recorded input again
+                    self.persistence.note_delivery_boundary()
                 # coordinated checkpoint: every worker snapshots operator
                 # state at the SAME agreed tick (reference: workers agree on
                 # the last complete snapshot, worker-architecture doc :57-61)
@@ -740,7 +942,11 @@ class Executor:
             state = self.persistence.offset_for(src.persistent_id)
             if state is not None:
                 src.seek(state)
-        self.persistence.begin_recording(realtime)
+        # record offsets for OWNED sources only (the owner is the one
+        # worker whose offset ever advances): each pid then appears in
+        # exactly one worker's metadata, so a rescale can union per-pid
+        # offsets across workers without conflicts
+        self.persistence.begin_recording(owned_sources(realtime, self.ctx))
         return clock
 
     def _tick(self, time: int, source_emissions: list[tuple[SourceNode, Delta]]) -> None:

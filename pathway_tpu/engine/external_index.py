@@ -63,6 +63,9 @@ class ExternalIndexNode(Node):
     # __getstate__) snapshots alongside the standing queries
     STATE_FIELDS = ("engine", "_queries", "_answered")
 
+    # gather-routed: the whole index lives on worker 0 under any layout
+    RESHARD = "pinned"
+
     def restore_state(self, state: dict) -> None:
         fresh = self.engine
         super().restore_state(state)

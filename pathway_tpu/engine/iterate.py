@@ -75,6 +75,8 @@ class Iterate(Node):
     # only the input mirror and last-emitted outputs are durable
     STATE_FIELDS = ("_in_state", "_out_last")
 
+    RESHARD = "pinned"  # gather-routed composite: state lives on worker 0
+
     def exchange_specs(self):
         # the inner fixpoint is a single-worker composite: gather inputs to
         # worker 0 (downstream stateful ops re-shard its outputs)

@@ -477,6 +477,86 @@ class GroupByReduce(Node):
         self._gvals = a["_gvals"]
         self._slots = SlotMap.rebuild(self._gkey_by_slot)
 
+    # -- elastic rescale (rescale/resharder.py) ---------------------------
+
+    @classmethod
+    def split_state(cls, state: dict, key_mask) -> dict:
+        from .executor import _split_keyed_value
+
+        out = {
+            "_state": _split_keyed_value(cls, "_state", state["_state"], key_mask),
+            "dense": state["dense"],
+            "gerrs": _split_keyed_value(
+                cls, "gerrs", state.get("gerrs", {}), key_mask
+            ),
+        }
+        if state["dense"]:
+            a = state["arena"]
+            gk = np.asarray(a["_gkey_by_slot"], dtype=np.uint64)
+            keep = key_mask(gk) if len(gk) else np.zeros(0, dtype=bool)
+            out["arena"] = {
+                "_counts": a["_counts"][keep],
+                "_gkey_by_slot": gk[keep],
+                "_emitted": a["_emitted"][keep],
+                "_accs": [None if x is None else x[keep] for x in a["_accs"]],
+                "_prev": [p[keep] for p in a["_prev"]],
+                "_gvals": [None if g is None else g[keep] for g in a["_gvals"]],
+            }
+        return out
+
+    @classmethod
+    def merge_states(cls, states: list[dict]) -> dict:
+        from .executor import _merge_keyed_value
+
+        if all(s["dense"] for s in states):
+            arenas = [s["arena"] for s in states]
+            slots = [len(a["_counts"]) for a in arenas]
+            return {
+                "_state": _merge_keyed_value(
+                    cls, "_state", [s["_state"] for s in states]
+                ),
+                "dense": True,
+                "gerrs": _merge_keyed_value(
+                    cls, "gerrs", [s.get("gerrs", {}) for s in states]
+                ),
+                "arena": {
+                    "_counts": _concat_arena([a["_counts"] for a in arenas]),
+                    "_gkey_by_slot": _concat_arena(
+                        [a["_gkey_by_slot"] for a in arenas]
+                    ),
+                    "_emitted": _concat_arena([a["_emitted"] for a in arenas]),
+                    "_accs": _merge_arena_columns(
+                        [a["_accs"] for a in arenas], slots
+                    ),
+                    "_prev": _merge_arena_columns(
+                        [a["_prev"] for a in arenas], slots
+                    ),
+                    "_gvals": _merge_arena_columns(
+                        [a["_gvals"] for a in arenas], slots
+                    ),
+                },
+            }
+        # mixed dense/general across source workers (one worker saw the
+        # demoting column, another saw no rows at all): demote every dense
+        # piece offline and merge in the general representation
+        general: dict = {}
+        for s in states:
+            piece = _arena_to_general(s["arena"]) if s["dense"] else s["_state"]
+            for gk, entry in piece.items():
+                if gk in general:
+                    raise ValueError(
+                        f"GroupByReduce: group {gk:#x} present in two source "
+                        "workers' state — routing invariant violated"
+                    )
+                general[gk] = entry
+        return {
+            "_state": general,
+            "dense": False,
+            "gerrs": _merge_keyed_value(
+                cls, "gerrs", [s.get("gerrs", {}) for s in states]
+            ),
+        }
+
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d = ins[0]
         if d is None or not len(d):
@@ -788,6 +868,71 @@ class GroupByReduce(Node):
         )
 
 
+def _concat_arena(pieces: list[np.ndarray]) -> np.ndarray:
+    """Concatenate per-worker arena columns, promoting dtypes the same way
+    the live operator does (int accumulators promote to float64 when any
+    worker's did; any-object gvals make the merged column object)."""
+    nonempty = [p for p in pieces if len(p)]
+    if not nonempty:
+        return pieces[0]
+    if any(p.dtype == object for p in nonempty):
+        return np.concatenate([p.astype(object) for p in nonempty])
+    target = np.result_type(*[p.dtype for p in nonempty])
+    return np.concatenate([p.astype(target, copy=False) for p in nonempty])
+
+
+def _merge_arena_columns(per_piece: list[list], slots: list[int]) -> list:
+    """Merge parallel lists of arena columns (one list per source worker,
+    ``slots[i]`` = that worker's allocated slot count): column j of the
+    result is the concatenation of every worker's column j. A ``None``
+    column (count reducer's acc, or gvals never materialized) may sit
+    next to arrays only when its piece holds ZERO slots — otherwise the
+    concatenated column would silently fall out of alignment with the
+    slot order at restore."""
+    n_cols = len(per_piece[0])
+    out: list = []
+    for j in range(n_cols):
+        cols = [p[j] for p in per_piece]
+        if all(c is None for c in cols):
+            out.append(None)
+            continue
+        for c, n_slots in zip(cols, slots):
+            if c is None and n_slots:
+                raise ValueError(
+                    "GroupByReduce arena merge: a worker's snapshot holds "
+                    f"{n_slots} slot(s) but no array for column {j} — "
+                    "inconsistent snapshots (reducer config mismatch?)"
+                )
+        out.append(_concat_arena([c for c in cols if c is not None]))
+    return out
+
+
+def _arena_to_general(arena: dict) -> dict:
+    """Offline analog of ``GroupByReduce._demote``: convert a snapshotted
+    dense arena into general-path ``_state`` entries. A ``None`` slot in
+    ``_accs`` marks a count reducer (its value IS the multiplicity)."""
+    out: dict = {}
+    counts = arena["_counts"]
+    for slot in np.flatnonzero(counts != 0):
+        gk = int(arena["_gkey_by_slot"][slot])
+        gvals = tuple(g[slot] for g in arena["_gvals"])
+        accs: list = []
+        for acc in arena["_accs"]:
+            if acc is None:
+                accs.append(int(counts[slot]))
+            else:
+                v = acc[slot]
+                accs.append(v.item() if isinstance(v, np.generic) else v)
+        last = None
+        if arena["_emitted"][slot]:
+            last = gvals + tuple(
+                p[slot].item() if isinstance(p[slot], np.generic) else p[slot]
+                for p in arena["_prev"]
+            )
+        out[gk] = [int(counts[slot]), gvals, accs, last]
+    return out
+
+
 def _resize(arr: np.ndarray, total: int) -> np.ndarray:
     out = np.zeros(total, dtype=arr.dtype)
     out[: len(arr)] = arr
@@ -980,6 +1125,116 @@ class Join(Node):
     STATE_FIELDS = (
         "_cleft", "_cright", "_left", "_right", "_lpad", "_rpad", "_idstate"
     )
+
+    # -- elastic rescale (rescale/resharder.py) ---------------------------
+    #
+    # Join state routes by JOIN key: arrangements split directly on their
+    # jk arrays; pads and the id-uniqueness ledger are keyed by ROW key, so
+    # their destination is the shard of the jk their row lives under — a
+    # rk→jk map rebuilt from the arrangements decides, falling back to the
+    # row key's own shard for entries whose row is no longer arranged.
+
+    @classmethod
+    def _row_jk_map(cls, state: dict) -> dict[int, int]:
+        out: dict[int, int] = {}
+        for f in ("_cleft", "_cright"):
+            side = state.get(f)
+            if side is not None:
+                for run in side._runs:
+                    for jk, rk in zip(run[0].tolist(), run[1].tolist()):
+                        out.setdefault(int(rk), int(jk))
+        for f in ("_left", "_right"):
+            idx = state.get(f)
+            if idx is not None:
+                for jk, grp in idx._index.items():
+                    for rk in grp:
+                        out.setdefault(int(rk), int(jk))
+        return out
+
+    @staticmethod
+    def _split_rk_dict(d: dict, rk2jk: dict[int, int], key_mask) -> dict:
+        if not d:
+            return {}
+        route = np.fromiter(
+            (rk2jk.get(int(k), int(k)) & 0xFFFFFFFFFFFFFFFF for k in d),
+            dtype=np.uint64, count=len(d),
+        )
+        keep = key_mask(route)
+        return {k: v for k, m in zip(d, keep.tolist()) if m}
+
+    #: memoization slot for the rk→jk map: the resharder calls split_state
+    #: once per destination on the SAME piece, and the map depends only on
+    #: the piece — rebuilding the O(rows) scan per destination would make
+    #: a rescale O(M × rows) per source worker
+    _RK2JK_CACHE = "__rescale_rk2jk__"
+
+    @classmethod
+    def split_state(cls, state: dict, key_mask) -> dict:
+        out: dict = {}
+        rk2jk = state.get(cls._RK2JK_CACHE)
+        if rk2jk is None:
+            rk2jk = cls._row_jk_map(state)
+            state[cls._RK2JK_CACHE] = rk2jk
+        for f, v in state.items():
+            if f == cls._RK2JK_CACHE:
+                continue
+            if f in ("_cleft", "_cright"):
+                side = _SortedSide(v._n_cols)
+                for run in v._runs:
+                    keep = key_mask(run[0])
+                    if keep.any():
+                        side._runs.append(_SortedSide._make_run(
+                            run[0][keep], run[1][keep],
+                            [np.asarray(c)[keep] for c in run[2]],
+                            run[3][keep],
+                        ))
+                out[f] = side
+            elif f in ("_left", "_right"):
+                idx = MultiIndex(v.columns)
+                jks = list(v._index)
+                if jks:
+                    arr = np.fromiter(
+                        (int(j) & 0xFFFFFFFFFFFFFFFF for j in jks),
+                        dtype=np.uint64, count=len(jks),
+                    )
+                    keep = key_mask(arr)
+                    idx._index = {
+                        j: v._index[j] for j, m in zip(jks, keep.tolist()) if m
+                    }
+                out[f] = idx
+            else:  # _lpad / _rpad / _idstate — row-keyed ledgers
+                out[f] = cls._split_rk_dict(v, rk2jk, key_mask)
+        return out
+
+    @classmethod
+    def merge_states(cls, states: list[dict]) -> dict:
+        out: dict = {}
+        for f in states[0]:
+            vals = [s[f] for s in states]
+            if f in ("_cleft", "_cright"):
+                side = _SortedSide(vals[0]._n_cols)
+                for v in vals:
+                    side._runs.extend(v._runs)
+                if len(side._runs) > _SortedSide.MAX_RUNS:
+                    side._compact()
+                out[f] = side
+            elif f in ("_left", "_right"):
+                idx = MultiIndex(vals[0].columns)
+                for v in vals:
+                    for jk, grp in v._index.items():
+                        if jk in idx._index:
+                            raise ValueError(
+                                f"Join.{f}: join key {jk:#x} present in two "
+                                "source workers' state"
+                            )
+                        idx._index[jk] = grp
+                out[f] = idx
+            else:
+                merged: dict = {}
+                for v in vals:
+                    merged.update(v)
+                out[f] = merged
+        return out
 
     def exchange_specs(self):
         # both sides route by join key -> matching rows co-locate
@@ -1696,6 +1951,55 @@ class Flatten(Node):
         )
 
 
+def _split_temporal_state(cls, state: dict, key_mask) -> dict:
+    """BufferUntil/ForgetAfter rescale split: their stores are keyed by
+    THRESHOLD (an event-time value, not a routing key) with row entries
+    inside — split the entry lists by each entry's row key, keep the
+    per-worker watermark as-is (it replicates; merge takes the max)."""
+    out: dict = {}
+    for f, store in state.items():
+        if f == "_watermark":
+            out[f] = store
+            continue
+        nb: dict = {}
+        for thr, entries in store.items():
+            if not entries:
+                continue
+            keys = np.fromiter(
+                (int(e[0]) & 0xFFFFFFFFFFFFFFFF for e in entries),
+                dtype=np.uint64, count=len(entries),
+            )
+            keep = key_mask(keys)
+            kept = [e for e, m in zip(entries, keep.tolist()) if m]
+            if kept:
+                nb[thr] = kept
+        out[f] = nb
+    return out
+
+
+def _merge_temporal_states(cls, states: list[dict]) -> dict:
+    out: dict = {}
+    for f in states[0]:
+        vals = [s[f] for s in states]
+        if f == "_watermark":
+            # the MIN of the per-worker watermarks (None = least knowledge
+            # wins): every buffered entry satisfies thr > its own worker's
+            # watermark, so min preserves the invariant — a max would
+            # strand entries below it, which only release on a FURTHER
+            # advance (never, on a plateaued stream). Understating the
+            # watermark merely delays releases/retractions until the next
+            # data-driven advance, which is within the per-shard-view
+            # semantics the live operator already has.
+            out[f] = None if any(v is None for v in vals) else min(vals)
+            continue
+        merged: dict = {}
+        for v in vals:
+            for thr, entries in v.items():
+                merged.setdefault(thr, []).extend(entries)
+        out[f] = merged
+    return out
+
+
 def _pop_due(store: dict, watermark, strict: bool = False) -> list:
     """Pop all (key, row, diff) entries whose threshold <= watermark
     (``strict``: < watermark). Thresholds may be ints, floats or
@@ -1762,6 +2066,9 @@ class BufferUntil(Node):
     exactly-once window outputs."""
 
     STATE_FIELDS = ("_buffer", "_watermark")
+
+    split_state = classmethod(_split_temporal_state)
+    merge_states = classmethod(_merge_temporal_states)
 
     def __init__(self, inp: Node, threshold_col: str, watermark_col: str | None = None):
         super().__init__([inp], inp.column_names)
@@ -1841,6 +2148,9 @@ class ForgetAfter(Node):
     watermark BEFORE the arriving batch — a row never makes itself late."""
 
     STATE_FIELDS = ("_live", "_watermark")
+
+    split_state = classmethod(_split_temporal_state)
+    merge_states = classmethod(_merge_temporal_states)
 
     def __init__(
         self,
@@ -2040,6 +2350,8 @@ class GradualBroadcast(Node):
 
     STATE_FIELDS = ("_keys", "_fracs", "_thr")
 
+    RESHARD = "pinned"  # single-owner composite (gathered to worker 0)
+
     def __init__(self, main: Node, thr: Node, cols: tuple[str, str, str]):
         super().__init__([main, thr], ["apx_value"])
         self._cols = cols  # (lower, value, upper) column names on thr input
@@ -2159,6 +2471,8 @@ class Capture(Node):
     # debug update log — snapshotting it would make every checkpoint
     # O(history), exactly what operator snapshots exist to avoid
     STATE_FIELDS = ("state",)
+
+    RESHARD = "pinned"  # gathered to worker 0; the full table lives there
 
     def exchange_specs(self):
         return [("gather",)]

@@ -289,13 +289,27 @@ class ObservabilityHub:
         from ..chaos import injector as _chaos
 
         armed = _chaos.ARMED
+        try:  # elastic boots reshard in-process before the engine mounts
+            from ..rescale import stats as _rescale_stats
+
+            rescales = _rescale_stats()
+        except Exception:  # pragma: no cover — import cycle safety net
+            rescales = {"total": 0}
         if (
             not supervised
             and restarts is None
             and armed is None
             and flight_dumps is None
         ):
-            return None
+            if not rescales["total"]:
+                return None
+            # an elastic rescale happened but nothing is supervised —
+            # surface ONLY the rescale counters (no pathway_restarts_total
+            # outside supervision)
+            return {
+                "rescales": int(rescales["total"]),
+                "rescale_duration_s": float(rescales["duration_s"]),
+            }
         doc: dict = {
             "restarts": int(restarts or 0),
             "reason": os.environ.get("PATHWAY_LAST_RESTART_REASON"),
@@ -307,6 +321,9 @@ class ObservabilityHub:
                 doc["flight_dumps"] = int(flight_dumps)
             except ValueError:
                 pass
+        if rescales["total"]:
+            doc["rescales"] = int(rescales["total"])
+            doc["rescale_duration_s"] = float(rescales["duration_s"])
         return doc
 
     def health(self) -> tuple[bool, dict]:

@@ -209,11 +209,14 @@ def render_snapshots(
     if supervisor is not None:
         # self-healing surface (spawn --supervise): restart generation +
         # why the supervisor last bounced the ensemble (info-style series,
-        # value always 1, reason as a label) + armed-chaos fire count
-        r.add(
-            "pathway_restarts_total", "counter",
-            int(supervisor.get("restarts", 0)),
-        )
+        # value always 1, reason as a label) + armed-chaos fire count.
+        # A rescale-only snapshot carries no "restarts" key — an elastic
+        # boot outside supervision must not mint pathway_restarts_total
+        if supervisor.get("restarts") is not None:
+            r.add(
+                "pathway_restarts_total", "counter",
+                int(supervisor["restarts"]),
+            )
         reason = supervisor.get("reason")
         if reason:
             r.add(
@@ -231,6 +234,17 @@ def render_snapshots(
             r.add(
                 "pathway_flight_recorder_dumps_total", "counter",
                 int(supervisor["flight_dumps"]),
+            )
+        if supervisor.get("rescales") is not None:
+            # elastic rescaling: state resharder runs completed in this
+            # process (spawn --elastic boot) + cumulative wall time
+            r.add(
+                "pathway_rescale_total", "counter",
+                int(supervisor["rescales"]),
+            )
+            r.add(
+                "pathway_rescale_duration_seconds", "gauge",
+                float(supervisor.get("rescale_duration_s", 0.0)),
             )
     return r.text()
 

@@ -88,9 +88,33 @@ class FilesystemBackend(PersistenceBackend):
     """Local-filesystem backend (``backends/file.rs:19``). Writes are
     atomic-by-rename so a crash mid-write never leaves a torn blob."""
 
+    #: staging files older than this are crash leftovers (no live writer
+    #: holds an open rename this long) and are swept at open
+    _STALE_TMP_S = 60.0
+
     def __init__(self, root: str | os.PathLike):
         self.root = os.fspath(root)
         os.makedirs(self.root, exist_ok=True)
+        self._sweep_stale_tmp()
+
+    def _sweep_stale_tmp(self) -> None:
+        """Delete staging files orphaned by a crash mid-put. Age-gated:
+        a CONCURRENTLY booting peer may be inside its own write→rename
+        window right now, and sweeping its fresh .tmp would reintroduce
+        the vanished-staging-file crash the per-pid names fixed."""
+        import time as _t
+
+        cutoff = _t.time() - self._STALE_TMP_S
+        for dirpath, _, files in os.walk(self.root):
+            for fn in files:
+                if not (fn.endswith(".tmp") or ".tmp." in fn):
+                    continue
+                path = os.path.join(dirpath, fn)
+                try:
+                    if os.path.getmtime(path) < cutoff:
+                        os.remove(path)
+                except OSError:
+                    pass  # raced with another sweeper / writer
 
     def describe(self) -> str:
         return self.root
@@ -107,7 +131,12 @@ class FilesystemBackend(PersistenceBackend):
     def put_value(self, key: str, value: bytes) -> None:
         path = self._path(key)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
+        # per-process staging name: two workers first-booting the same
+        # store write the cluster marker concurrently — a SHARED .tmp
+        # would make one os.replace steal the other's staging file and
+        # crash it with FileNotFoundError (last-writer-wins is fine; a
+        # vanished staging file is not)
+        tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
             f.write(value)
             f.flush()
@@ -119,7 +148,7 @@ class FilesystemBackend(PersistenceBackend):
         for dirpath, _, files in os.walk(self.root):
             rel = os.path.relpath(dirpath, self.root)
             for fn in files:
-                if fn.endswith(".tmp"):
+                if fn.endswith(".tmp") or ".tmp." in fn:
                     continue
                 key = fn if rel == "." else "/".join(rel.split(os.sep) + [fn])
                 out.append(key)

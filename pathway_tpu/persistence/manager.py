@@ -20,16 +20,19 @@ operator snapshot machinery (``src/persistence/operator_snapshot.rs``):
 
 Sharded runs give each worker its own ``worker-{id}/`` namespace in the
 shared backend (``PrefixBackend``); a root-level ``cluster`` marker pins
-the worker count — resharding against existing state is refused.
+the worker count and layout epoch (see ``layout.py``). A worker-count
+mismatch against real state is either repartitioned in place (elastic
+mode — ``rescale/``) or refused with a pointer at ``pathway-tpu
+rescale``.
 """
 
 from __future__ import annotations
 
-import json
 import time as _time
-from typing import Any
+from typing import Any, Iterator
 
 from ..engine.delta import Delta
+from . import layout as _layout
 from .backends import PersistenceBackend, PrefixBackend, open_backend
 from .snapshots import (
     MetadataAccessor,
@@ -52,9 +55,10 @@ class PersistenceManager:
         self.n_workers = n_workers
         root: PersistenceBackend = open_backend(config.backend)
         self._root = root
-        self._check_cluster_marker(root, n_workers)
+        self.epoch = self._resolve_layout(root, n_workers, worker_id)
+        ns = _layout.worker_namespace(self.epoch, n_workers, worker_id)
         self.backend: PersistenceBackend = (
-            PrefixBackend(root, f"worker-{worker_id}/") if n_workers > 1 else root
+            PrefixBackend(root, ns) if ns else root
         )
         # chaos site (persistence.put): identity pass-through unless a
         # fault plan targets this worker's puts. Wraps the WORKER's view
@@ -100,6 +104,18 @@ class PersistenceManager:
         self._last_flush = _time.monotonic()
         self._dirty = False
         self._last_recorded_time = self.last_time
+        #: newest tick whose topological sweep COMPLETED (on_time_end).
+        #: May lag _last_recorded_time: record() runs at tick start, so a
+        #: worker dying mid-sweep holds rows recorded at a tick that never
+        #: emitted downstream — the close() flush must not stamp that tick
+        #: into last_time, or skip_until would suppress the replayed rows'
+        #: output on recovery (a lost, never-delivered callback)
+        self._last_completed_time = self.last_time
+        # delivery-boundary snapshot backing the close() flush (see
+        # note_delivery_boundary)
+        self._safe_offsets: dict[str, Any] = dict(self.offsets)
+        self._safe_recorded = 0
+        self._safe_time = self.last_time
         #: single-worker mode commits on its own wall-clock interval;
         #: sharded mode commits only when the workers collectively agree
         self.auto_commit = True
@@ -107,38 +123,65 @@ class PersistenceManager:
         self._dirty_ranks: set[int] = set()
 
     @staticmethod
-    def _check_cluster_marker(root: PersistenceBackend, n_workers: int) -> None:
-        key = "cluster"
-        try:
-            existing = json.loads(root.get_value(key))
-        except Exception:
-            existing = None
-        if existing is not None:
-            if int(existing.get("n_workers", 1)) != n_workers:
-                # a marker with ZERO committed metadata versions behind it is
-                # the residue of a first boot that crashed between writing
-                # the marker and the first commit — there is no state to
-                # reshard, so adopt the new layout instead of refusing to
-                # ever start again under a different worker count
-                has_meta = any(
-                    "meta/" in k for k in root.list_keys()
-                )
-                if not has_meta:
-                    root.put_value(
-                        key, json.dumps({"n_workers": n_workers}).encode()
-                    )
-                    return
-                where = root.describe()
-                raise RuntimeError(
-                    f"persisted state at {where} was written by "
-                    f"{existing['n_workers']} worker(s) but this run has "
-                    f"{n_workers}: operator state is hash-sharded by worker "
-                    "count and cannot be resharded on recovery — restart "
-                    "with the original worker count or clear the "
-                    "persistence backend"
-                )
-        else:
-            root.put_value(key, json.dumps({"n_workers": n_workers}).encode())
+    def _resolve_layout(
+        root: PersistenceBackend, n_workers: int, worker_id: int
+    ) -> int:
+        """Reconcile this run's worker count with the persisted layout
+        marker; returns the layout epoch to mount. A mismatch against real
+        state either triggers an in-process rescale (elastic mode, worker
+        0), waits for worker 0's rescale to promote (elastic mode, other
+        workers), or refuses with the classic error."""
+        marker = _layout.read_marker(root)
+        if marker is None:
+            _layout.write_marker(root, n_workers, 0)
+            return 0
+        cur_n, epoch = marker
+        if cur_n == n_workers:
+            return epoch
+        # a marker with ZERO committed metadata versions behind it is the
+        # residue of a first boot that crashed between writing the marker
+        # and the first commit — there is no state to reshard, so adopt
+        # the new layout instead of refusing to ever start again under a
+        # different worker count
+        if not _layout.has_layout_meta(root, epoch, cur_n):
+            _layout.write_marker(root, n_workers, epoch)
+            return epoch
+        from ..internals.config import _env_bool, _env_float
+
+        if _env_bool("PATHWAY_ELASTIC"):
+            if worker_id == 0:
+                # elastic boot: worker 0 repartitions the persisted state
+                # to this run's worker count before mounting it
+                from ..rescale import rescale as _rescale
+
+                _rescale(root, n_workers)
+                marker = _layout.read_marker(root)
+                assert marker is not None and marker[0] == n_workers
+                return marker[1]
+            # peers wait for worker 0's rescale to promote the new marker
+            deadline = _time.monotonic() + _env_float(
+                "PATHWAY_RESCALE_WAIT_S", 120.0
+            )
+            while _time.monotonic() < deadline:
+                marker = _layout.read_marker(root)
+                if marker is not None and marker[0] == n_workers:
+                    return marker[1]
+                _time.sleep(0.1)
+            raise RuntimeError(
+                f"elastic rescale to {n_workers} workers did not complete "
+                f"within PATHWAY_RESCALE_WAIT_S (worker {worker_id} waited "
+                "for worker 0's resharder to promote the new layout)"
+            )
+        where = root.describe()
+        raise RuntimeError(
+            f"persisted state at {where} was written by "
+            f"{cur_n} worker(s) but this run has "
+            f"{n_workers}: operator state is hash-sharded by worker "
+            "count and cannot be resharded on recovery — restart "
+            "with the original worker count, run `pathway-tpu rescale "
+            f"--to {n_workers}` (or boot with --elastic / "
+            "PATHWAY_ELASTIC=1), or clear the persistence backend"
+        )
 
     # -- recovery side ----------------------------------------------------
 
@@ -186,7 +229,11 @@ class PersistenceManager:
                 self._ops.read(rank, int(desc["at"]), int(desc["chunks"]))
             )
 
-    def replay_batches(self, after_time: int = -1) -> list[tuple[int, str, Delta]]:
+    def replay_batches(
+        self, after_time: int = -1
+    ) -> Iterator[tuple[int, str, Delta]]:
+        """Recorded input entries after ``after_time`` — a generator
+        (memory stays O(chunk), never O(history))."""
         return self._reader.batches(after_time)
 
     def offset_for(self, pid: str) -> Any | None:
@@ -199,6 +246,29 @@ class PersistenceManager:
         realtime source nodes whose offsets go into each metadata commit."""
         self._sources = [s for s in sources if s.persistent_id is not None]
         self._recording = True
+        self.note_delivery_boundary()
+
+    def note_delivery_boundary(self) -> None:
+        """Every row the sources have handed out so far has been DELIVERED
+        to the dataflow (its tick ran and recorded it). Snapshot per-source
+        offsets + the writer position here: connector offsets advance when
+        rows are drained from the producer queue, which can be several
+        not-yet-ticked rounds ahead of what was recorded — a crash then
+        makes the live offset cover input that exists nowhere. The close()
+        flush commits exactly this snapshot's prefix, keeping offsets ==
+        recorded input (persisting a live offset would silently SKIP the
+        undelivered rows on resume; persisting an old offset with a longer
+        tail would replay rows the resumed source re-emits — duplicates).
+        Called by the streaming loops after each poll cycle's rounds all
+        ticked, and by commit() itself (commits only happen at delivery
+        boundaries)."""
+        if not self._recording:
+            return
+        self._safe_offsets = {
+            s.persistent_id: s.offset_state() for s in self._sources
+        }
+        self._safe_recorded = self._writer.buffered_count
+        self._safe_time = self._last_completed_time
 
     def record(self, time: int, pid: str, delta: Delta) -> None:
         if not self._recording:
@@ -215,10 +285,19 @@ class PersistenceManager:
         )
 
     def on_time_end(self, time: int) -> None:
+        self._last_completed_time = max(
+            self._last_completed_time, int(time)
+        )
         if self.auto_commit and self.should_commit():
             self.commit(time)
 
-    def commit(self, time: int, *, with_operators: bool = True) -> None:
+    def commit(
+        self,
+        time: int,
+        *,
+        with_operators: bool = True,
+        offsets: dict[str, Any] | None = None,
+    ) -> None:
         """Flush the pending input chunk, snapshot dirty operator state, and
         finalize metadata (the consistency point — reference `finalize`,
         tracker.rs). In sharded runs this is called collectively at one
@@ -226,7 +305,9 @@ class PersistenceManager:
 
         ``with_operators=False`` persists only the input tail + offsets —
         used by ``close()`` after abnormal exits, where operator state may
-        be torn mid-tick and must NOT be snapshotted."""
+        be torn mid-tick and must NOT be snapshotted. ``offsets`` overrides
+        the live source offsets (close() passes its delivery-boundary
+        snapshot; normal commits run AT a boundary, where live is exact)."""
         if not self._recording:
             return
         written = self._writer.flush()
@@ -234,9 +315,11 @@ class PersistenceManager:
             seq, max_t = written
             self.chunk_spans[seq] = max_t
         self.last_time = max(self.last_time, int(time))
-        self.offsets = {
-            s.persistent_id: s.offset_state() for s in self._sources
-        }
+        self.offsets = (
+            dict(offsets)
+            if offsets is not None
+            else {s.persistent_id: s.offset_state() for s in self._sources}
+        )
         if self.record_replay:
             with_operators = False  # the input history IS the artifact
         if with_operators:
@@ -261,6 +344,11 @@ class PersistenceManager:
         self._prune_op_blobs()
         self._dirty = False
         self._last_flush = _time.monotonic()
+        # a commit IS a delivery boundary: refresh the close-path snapshot
+        # (buffer just flushed; the offsets just persisted are exact)
+        self._safe_offsets = dict(self.offsets)
+        self._safe_recorded = 0
+        self._safe_time = self.last_time
 
     def _snapshot_operators(self, time: int) -> None:
         if self.op_snapshots and int(self.op_snapshots[-1]["time"]) == time:
@@ -333,7 +421,24 @@ class PersistenceManager:
         Operator state is NOT snapshotted here: after an exception the
         executor may have died mid-tick, with some operators having applied
         the tick's deltas and others not — recovery instead restores the
-        last complete snapshot and replays the flushed tail through it."""
+        last complete snapshot and replays the flushed tail through it.
+
+        The flush is pinned to the last DELIVERY BOUNDARY (see
+        note_delivery_boundary): only input recorded up to that point is
+        flushed, with the offsets snapshotted there — rows the sources
+        handed out afterwards (recorded-at-a-died-tick, or drained into
+        rounds whose tick never ran) are dropped from the tail and
+        re-read live on resume. Offsets == recorded input, always:
+        neither silent input loss (live offsets covering unrecorded rows)
+        nor duplicates (stale offsets under a longer tail). The commit
+        time is likewise the boundary's last COMPLETED tick, so replayed
+        rows sit above skip_until and re-emit (at-least-once output,
+        exactly-once state)."""
         if self._dirty:
-            self.commit(self._last_recorded_time, with_operators=False)
+            self._writer.truncate(self._safe_recorded)
+            self.commit(
+                self._safe_time,
+                with_operators=False,
+                offsets=self._safe_offsets,
+            )
         self.backend.close()

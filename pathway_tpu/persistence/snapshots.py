@@ -55,24 +55,53 @@ def _delta_from_parts(parts: tuple) -> Delta:
 
 class MetadataAccessor:
     """Versioned metadata blobs; highest parseable version is current
-    (``state.rs:35``)."""
+    (``state.rs:35``). A truncated/corrupt NEWEST blob (a torn write that
+    slipped past the backend's atomic-rename discipline) is not silently
+    papered over: the accessor falls back to the previous readable version
+    with a logged warning and remembers the skipped version in
+    ``fell_back_from``, and the next commit rewrites (heals) the torn
+    version number."""
 
     def __init__(self, backend: PersistenceBackend):
         self._backend = backend
         self._version = -1
         self._swept = False
         self.current: dict[str, Any] | None = None
+        #: newest metadata version that existed but failed to parse while a
+        #: usable older version was adopted instead; None = clean store
+        self.fell_back_from: int | None = None
+        corrupt: list[int] = []
         for key in backend.list_keys():
             if not key.startswith(_META_PREFIX):
                 continue
             try:
                 version = int(key[len(_META_PREFIX):])
+            except ValueError:
+                continue
+            try:
                 meta = json.loads(backend.get_value(key))
-            except (ValueError, json.JSONDecodeError):
+            except (KeyError, ValueError, UnicodeDecodeError):
+                # parse-shaped failures only (JSONDecodeError is a
+                # ValueError; KeyError = version pruned between list and
+                # read): a transient I/O error (OSError, S3 throttling)
+                # must PROPAGATE — falling back there would silently roll
+                # state back and re-deliver recorded input
+                corrupt.append(version)
                 continue
             if version > self._version:
                 self._version = version
                 self.current = meta
+        newer_corrupt = [v for v in corrupt if v > self._version]
+        if newer_corrupt:
+            self.fell_back_from = max(newer_corrupt)
+            import logging
+
+            logging.getLogger("pathway_tpu.persistence").warning(
+                "metadata version %d is truncated/corrupt (torn write); "
+                "falling back to version %d",
+                self.fell_back_from,
+                self._version,
+            )
 
     def commit(self, meta: dict[str, Any]) -> None:
         self._version += 1
@@ -118,6 +147,15 @@ class SnapshotWriter:
         self._buffer.append((time, pid, _delta_parts(delta)))
 
     @property
+    def buffered_count(self) -> int:
+        return len(self._buffer)
+
+    def truncate(self, n: int) -> None:
+        """Drop buffered entries beyond position ``n`` (the close() path
+        flushes only the prefix consistent with its offset snapshot)."""
+        del self._buffer[n:]
+
+    @property
     def n_chunks(self) -> int:
         return self._seq
 
@@ -147,18 +185,18 @@ class SnapshotReader:
         self._n_chunks = n_chunks
         self._first_chunk = first_chunk
 
-    def batches(self, after_time: int = -1) -> list[tuple[int, str, Delta]]:
-        """Persisted (time, pid, delta) entries with time > after_time, in
-        commit order (nondecreasing in time by construction). Chunks below
-        ``first_chunk`` were truncated — their content is covered by an
-        operator snapshot and never read again (O(state) restart)."""
-        out: list[tuple[int, str, Delta]] = []
+    def batches(self, after_time: int = -1):
+        """Yield persisted (time, pid, delta) entries with time >
+        after_time, in commit order (nondecreasing in time by
+        construction). A generator: replay/recovery memory stays O(chunk)
+        — one chunk blob decoded at a time — never O(history). Chunks
+        below ``first_chunk`` were truncated — their content is covered by
+        an operator snapshot and never read again (O(state) restart)."""
         for seq in range(self._first_chunk, self._n_chunks):
             blob = self._backend.get_value(f"{_CHUNK_PREFIX}{seq:08d}")
             for time, pid, parts in pickle.loads(blob):
                 if int(time) > after_time:
-                    out.append((int(time), pid, _delta_from_parts(parts)))
-        return out
+                    yield int(time), pid, _delta_from_parts(parts)
 
 
 class OperatorSnapshots:

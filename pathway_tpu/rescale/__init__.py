@@ -1,0 +1,16 @@
+"""Elastic rescaling — repartition persisted cluster state N → M workers.
+
+``pathway-tpu rescale --to M <store>`` (or an elastic boot via
+``spawn --supervise --elastic -n M`` / ``PATHWAY_ELASTIC=1``) runs the
+offline resharder in :mod:`resharder`: it opens every ``worker-{i}/``
+namespace of the persisted layout, picks the newest operator-snapshot
+time common to all workers, splits each stateful operator's state and
+each live input chunk by row key with the engine's own ``shard_rows``
+hash, merges the per-destination pieces, and writes a complete layout
+for M workers under the next epoch's namespaces — staged under
+``rescale-tmp/`` and promoted by one atomic ``cluster``-marker rewrite.
+"""
+
+from .resharder import RescaleError, rescale, stats
+
+__all__ = ["rescale", "stats", "RescaleError"]

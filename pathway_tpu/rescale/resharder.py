@@ -1,0 +1,443 @@
+"""The offline state resharder behind ``pathway-tpu rescale``.
+
+Checkpoint resharding for a streaming engine — the analog of resharding
+model checkpoints across device meshes in JAX training stacks: persisted
+operator state is hash-sharded by worker count (``keys.shard_of``, the
+reference SHARD_MASK routing), so changing the cluster size means
+repartitioning every keyed container and every live input chunk.
+
+Protocol (all phases traced as ``rescale.*`` spans, each boundary a
+``rescale`` chaos site):
+
+1. **plan** — read the ``cluster`` marker, every worker's newest
+   metadata, and pick the snapshot time ``T``: the newest
+   operator-snapshot time present on EVERY worker (the same choice
+   recovery makes). Falls back to full-tail replay (``T = -1``) only
+   when no input chunk was ever truncated.
+2. **stage** — for each stateful-operator rank, read the N per-worker
+   state pieces, ``split_state`` each by destination key-shard,
+   ``merge_states`` per destination, and write M complete
+   ``worker-{j}/`` namespaces (operator blobs at time ``T``, one input
+   chunk holding the post-``T`` tail rows routed by ``shard_rows``, a
+   single metadata version) under ``rescale-tmp/``.
+3. **promote** — copy the staged keys to the next epoch's namespaces
+   (fresh keys: the old layout is never touched), then rewrite the
+   ``cluster`` marker in ONE put. The marker write is the commit point:
+   a crash at any earlier moment leaves the old marker pointing at the
+   old, intact layout.
+4. **cleanup** — delete the staging keys and the superseded layout.
+
+Offset carry-over: per-source offsets are unioned across workers and the
+union is replicated into every destination's metadata (the post-rescale
+owner — source index mod M — is not derivable from a pid name offline,
+so every candidate owner must find the offset). The union is exact for
+state written by this engine: each source's offset is recorded only by
+its owner worker (``Executor._recover`` hands ``begin_recording`` the
+owned subset) and — via the delivery-boundary close protocol — never
+covers input that was not recorded. When copies conflict (legacy layouts
+that recorded every source everywhere, or replicas left by a previous
+rescale that a worker never overwrote with a commit), the LARGEST offset
+under a structural numeric-aware order wins: offsets advance
+monotonically and only on the owner, so the max copy IS the owner's and
+exactly covers the recorded input — a smaller stale copy would
+re-deliver rows already incorporated into the snapshot/tail.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import sys
+import time as _time
+from typing import Any, Callable
+
+import numpy as np
+
+from ..internals.tracing import span as _span
+from ..persistence import layout as _layout
+from ..persistence.backends import (
+    PersistenceBackend,
+    PrefixBackend,
+    open_backend,
+)
+from ..persistence.snapshots import (
+    MetadataAccessor,
+    OperatorSnapshots,
+    SnapshotReader,
+    _delta_parts,
+)
+
+__all__ = ["rescale", "stats", "RescaleError"]
+
+
+class RescaleError(RuntimeError):
+    pass
+
+
+#: process-local counters surfaced as ``pathway_rescale_total`` /
+#: ``pathway_rescale_duration_seconds`` on /metrics (observability/hub.py)
+_STATS: dict[str, Any] = {"total": 0, "duration_s": 0.0, "last": None}
+
+
+def stats() -> dict[str, Any]:
+    return dict(_STATS)
+
+
+def _default_log(msg: str) -> None:
+    print(f"[rescale] {msg}", file=sys.stderr)
+
+
+def rescale(
+    backend: Any, to_workers: int, *, log: Callable[[str], Any] | None = None
+) -> dict:
+    """Repartition the persisted state in ``backend`` to ``to_workers``
+    workers. ``backend`` is a ``PersistenceBackend`` instance or a
+    ``pw.persistence.Backend`` descriptor. Returns a report dict."""
+    log = log or _default_log
+    t0 = _time.monotonic()
+    close_after = False
+    if isinstance(backend, PersistenceBackend):
+        root = backend
+    else:
+        root = open_backend(backend)
+        close_after = True
+    try:
+        report = _rescale_root(root, int(to_workers), log)
+    finally:
+        if close_after:
+            root.close()
+    dt = _time.monotonic() - t0
+    report["duration_s"] = round(dt, 6)
+    if not report.get("noop"):
+        _STATS["total"] += 1
+        _STATS["duration_s"] += dt
+        _STATS["last"] = report
+    return report
+
+
+def _worker_view(root: PersistenceBackend, ns: str) -> PersistenceBackend:
+    return PrefixBackend(root, ns) if ns else root
+
+
+def _node_class(name: str):
+    """Resolve a snapshot descriptor's operator class name against every
+    loaded ``Node`` subclass (engine operators, iterate/external-index
+    composites, stateful io scanners)."""
+    from ..engine import external_index as _ei  # noqa: F401
+    from ..engine import iterate as _it  # noqa: F401
+    from ..engine import operators as _ops  # noqa: F401
+    from ..engine.executor import Node
+
+    for mod in ("deltalake", "_object_scanner", "sqlite", "airbyte"):
+        try:  # stateful scanner sources; dep-gated modules may be absent
+            __import__(f"pathway_tpu.io.{mod}")
+        except Exception:
+            pass
+    stack = [Node]
+    while stack:
+        c = stack.pop()
+        if c.__name__ == name:
+            return c
+        stack.extend(c.__subclasses__())
+    raise RescaleError(
+        f"persisted snapshot names stateful operator class {name!r}, which "
+        "this build does not define — cannot reshard its state"
+    )
+
+
+def _offset_sort_key(off: Any):
+    """Deterministic structural total order over offset states, with
+    NUMBERS compared numerically — a lexicographic JSON comparison would
+    rank {"rows": 1000} below {"rows": 999}. Larger key = later resume
+    position."""
+    if isinstance(off, bool):
+        return ("b", off)
+    if isinstance(off, (int, float)):
+        return ("n", off)
+    if isinstance(off, str):
+        return ("s", off)
+    if isinstance(off, (list, tuple)):
+        return ("l", tuple(_offset_sort_key(v) for v in off))
+    if isinstance(off, dict):
+        return (
+            "d",
+            tuple(
+                (k, _offset_sort_key(v)) for k, v in sorted(off.items())
+            ),
+        )
+    return ("x", repr(off))
+
+
+def _merge_offsets(metas: list[dict], log: Callable[[str], Any]) -> dict:
+    merged: dict = {}
+    conflicts: set[str] = set()
+    for m in metas:
+        for pid, off in (m.get("offsets") or {}).items():
+            if off is None:
+                continue
+            if pid not in merged:
+                merged[pid] = off
+            elif merged[pid] != off:
+                conflicts.add(pid)
+                if _offset_sort_key(off) > _offset_sort_key(merged[pid]):
+                    merged[pid] = off
+    if conflicts:
+        log(
+            f"offset conflict for source(s) {sorted(conflicts)}: kept the "
+            "LARGEST offset — a source's offset advances monotonically and "
+            "only on its owner worker, so the max copy is the owner's, "
+            "which exactly covers the recorded input (a smaller stale copy "
+            "would re-deliver rows already in the snapshot/tail)"
+        )
+    return merged
+
+
+def _pick_snapshot_time(metas: list[dict]) -> int:
+    snap_sets = [
+        {int(e["time"]) for e in (m.get("op_snapshots") or [])} for m in metas
+    ]
+    if all(not s for s in snap_sets):
+        return -1
+    common = set.intersection(*snap_sets)
+    if common:
+        return max(common)
+    # no common snapshot (a crash mid-commit-wave with retention 1):
+    # full-tail replay is sound only while no chunk was ever truncated
+    if any(int(m.get("first_chunk", 0)) > 0 for m in metas):
+        raise RescaleError(
+            "no operator-snapshot time is common to every worker and the "
+            "input history was already truncated — boot once with the "
+            "original worker count (recovery will re-establish a common "
+            "snapshot), then rescale"
+        )
+    return -1
+
+
+def _rescale_root(
+    root: PersistenceBackend, to_workers: int, log: Callable[[str], Any]
+) -> dict:
+    from ..chaos import injector as _chaos
+
+    try:
+        # the canonical routing hash (identical to the live exchange's)
+        from ..parallel.exchange import shard_rows
+    except ImportError:
+        # parallel.exchange needs jax.shard_map; shard_rows is a pure
+        # delegation to the key shard — fall back on hosts without it
+        from ..engine.keys import shard_of as shard_rows
+
+    if to_workers < 1:
+        raise RescaleError(f"cannot rescale to {to_workers} workers")
+    armed = _chaos.current()
+    fault = armed.rescale_faults() if armed is not None else None
+
+    def fire(phase: str) -> None:
+        if fault is not None:
+            fault.fire(phase)
+
+    marker = _layout.read_marker(root)
+    if marker is None:
+        raise RescaleError(
+            f"no cluster marker at {root.describe()}: nothing to rescale"
+        )
+    n_from, epoch = marker
+    report: dict[str, Any] = {
+        "from": n_from, "to": to_workers, "snapshot_time": None,
+        "ranks": 0, "tail_entries": 0, "epoch": epoch,
+    }
+    if n_from == to_workers:
+        report["noop"] = True
+        return report
+
+    with _span("rescale.plan", from_workers=n_from, to_workers=to_workers):
+        views: list[PersistenceBackend] = []
+        metas: list[dict] = []
+        missing: list[int] = []
+        for i in range(n_from):
+            ns = _layout.worker_namespace(epoch, n_from, i)
+            view = _worker_view(root, ns)
+            views.append(view)
+            cur = MetadataAccessor(view).current
+            if cur is None:
+                missing.append(i)
+            metas.append(cur or {})
+        if len(missing) == n_from:
+            # marker without any committed state: adopt the new count
+            _layout.write_marker(root, to_workers, epoch)
+            report["noop"] = True
+            return report
+        if missing:
+            raise RescaleError(
+                f"worker(s) {missing} have no committed metadata while "
+                "others do — the store is torn mid-first-commit; boot with "
+                f"the original count ({n_from}) once, then rescale"
+            )
+        snap_time = _pick_snapshot_time(metas)
+        report["snapshot_time"] = snap_time
+    fire("plan")
+
+    # stale staging from a previously crashed attempt is garbage — clear it
+    for key in root.list_keys():
+        if key.startswith(_layout.STAGING_PREFIX):
+            root.remove_key(key)
+
+    new_epoch = epoch + 1
+    staged = [
+        _worker_view(
+            root,
+            _layout.STAGING_PREFIX
+            + _layout.worker_namespace(new_epoch, to_workers, j),
+        )
+        for j in range(to_workers)
+    ]
+
+    def mask_for(j: int):
+        def mask(keys: np.ndarray) -> np.ndarray:
+            return shard_rows(np.asarray(keys, dtype=np.uint64), to_workers) == j
+
+        return mask
+
+    fire("stage")
+    ops_per_dest: list[dict] = [{} for _ in range(to_workers)]
+    if snap_time >= 0:
+        entries = []
+        for i, m in enumerate(metas):
+            entry = next(
+                (
+                    e for e in m["op_snapshots"]
+                    if int(e["time"]) == snap_time
+                ),
+                None,
+            )
+            assert entry is not None  # snap_time came from the intersection
+            entries.append(entry["ops"])
+        n_ranks = {len(e) for e in entries}
+        if len(n_ranks) != 1:
+            raise RescaleError(
+                f"workers disagree on the stateful-operator count at "
+                f"snapshot time {snap_time}: {sorted(n_ranks)} — the "
+                "dataflow changed between workers?"
+            )
+        report["ranks"] = n_ranks = n_ranks.pop()
+        with _span("rescale.operators", ranks=n_ranks, at=snap_time):
+            for rank in range(n_ranks):
+                descs = [
+                    e.get(str(rank)) or e.get(rank) for e in entries
+                ]
+                if any(d is None for d in descs):
+                    raise RescaleError(
+                        f"operator snapshot is missing rank {rank} on some "
+                        "worker"
+                    )
+                cls_names = {d["cls"] for d in descs}
+                if len(cls_names) != 1:
+                    raise RescaleError(
+                        f"rank {rank} names different operator classes "
+                        f"across workers: {sorted(cls_names)}"
+                    )
+                cls = _node_class(descs[0]["cls"])
+                pieces = [
+                    OperatorSnapshots(view).read(
+                        rank, int(d["at"]), int(d["chunks"])
+                    )
+                    for view, d in zip(views, descs)
+                ]
+                for j in range(to_workers):
+                    mask = mask_for(j)
+                    merged = cls.merge_states(
+                        [cls.split_state(p, mask) for p in pieces]
+                    )
+                    n_chunks = OperatorSnapshots(staged[j]).write(
+                        rank, snap_time, merged
+                    )
+                    ops_per_dest[j][str(rank)] = {
+                        "cls": descs[0]["cls"],
+                        "at": snap_time,
+                        "chunks": n_chunks,
+                    }
+
+    # live input tail: rows recorded after the chosen snapshot, re-routed
+    # to their destination shard by row key (the same hash the exchange
+    # uses, so replay re-enters the dataflow exactly as live rows would)
+    tails: list[list] = [[] for _ in range(to_workers)]
+    with _span("rescale.chunks", after=snap_time):
+        for view, m in zip(views, metas):
+            reader = SnapshotReader(
+                view, int(m.get("n_chunks", 0)), int(m.get("first_chunk", 0))
+            )
+            for t, pid, delta in reader.batches(after_time=snap_time):
+                shards = shard_rows(delta.keys, to_workers)
+                for j in range(to_workers):
+                    ix = np.flatnonzero(shards == j)
+                    if len(ix):
+                        tails[j].append(
+                            (t, pid, _delta_parts(delta.take(ix)))
+                        )
+        for j in range(to_workers):
+            tails[j].sort(key=lambda e: e[0])  # stable: commit order kept
+        report["tail_entries"] = sum(len(t) for t in tails)
+
+    offsets = _merge_offsets(metas, log)
+    last_time = max(int(m.get("last_time", -1)) for m in metas)
+    for j in range(to_workers):
+        if tails[j]:
+            staged[j].put_value(
+                "chunks/chunk-00000000",
+                pickle.dumps(tails[j], protocol=pickle.HIGHEST_PROTOCOL),
+            )
+        meta = {
+            "last_time": last_time,
+            "n_chunks": 1 if tails[j] else 0,
+            "first_chunk": 0,
+            "chunk_spans": (
+                {"0": max(int(e[0]) for e in tails[j])} if tails[j] else {}
+            ),
+            "offsets": offsets,
+            "n_workers": to_workers,
+            "op_snapshots": (
+                [{"time": snap_time, "ops": ops_per_dest[j]}]
+                if snap_time >= 0
+                else []
+            ),
+        }
+        staged[j].put_value("meta/meta-00000000", json.dumps(meta).encode())
+
+    fire("copy")
+    staged_keys = [
+        k for k in root.list_keys() if k.startswith(_layout.STAGING_PREFIX)
+    ]
+    with _span("rescale.promote", staged_keys=len(staged_keys)):
+        # leftovers of a crashed attempt under the target epoch would
+        # otherwise survive next to the fresh copy as unreferenced orphans
+        tgt = _layout.epoch_prefix(new_epoch)
+        for key in root.list_keys():
+            if tgt and key.startswith(tgt):
+                root.remove_key(key)
+        for key in staged_keys:
+            root.put_value(
+                key[len(_layout.STAGING_PREFIX):], root.get_value(key)
+            )
+        fire("promote")
+        # THE commit point: one atomic marker rewrite flips the cluster to
+        # the new layout; everything before this line left the old layout
+        # untouched
+        _layout.write_marker(root, to_workers, new_epoch)
+    fire("cleanup")
+    # sweep staging plus EVERY superseded layout — including orphans a
+    # previously crashed cleanup left behind (epochs older than the one
+    # just promoted)
+    tgt = _layout.epoch_prefix(new_epoch)
+    for key in root.list_keys():
+        if key == _layout.MARKER_KEY or key.startswith(tgt):
+            continue
+        if key.startswith(_layout.STAGING_PREFIX) or key.startswith(
+            ("epoch-", "meta/", "chunks/", "ops/", "worker-")
+        ):
+            root.remove_key(key)
+    report["epoch"] = new_epoch
+    log(
+        f"rescaled {n_from} -> {to_workers} workers at {root.describe()} "
+        f"(snapshot time {snap_time}, {report['ranks']} stateful operator"
+        f"(s), {report['tail_entries']} tail entries, epoch {new_epoch})"
+    )
+    return report

@@ -1,0 +1,251 @@
+"""Elastic-rescaling smoke test: kill a persisted cluster, reshard its
+state to a different worker count, and resume exactly.
+
+The elasticity analog of ``chaos_smoke.py``, exercising the whole
+``pathway_tpu/rescale`` surface end to end with real processes:
+
+1. a two-process sharded wordcount runs persisted and is SIGKILLed
+   mid-stream by a fault plan (hard death, state left mid-flight);
+2. ``pathway-tpu rescale --to 3`` repartitions the persisted state
+   offline (operator snapshots split/merged by key shard, input tail
+   re-routed, offsets unioned, atomic marker promotion);
+3. ``spawn --supervise -n 3`` resumes the SAME pipeline on THREE
+   workers and the final groupby counts are EXACT;
+4. on a pristine copy of the crashed state, a chaos plan SIGKILLs the
+   resharder right before the marker promotion — the old 2-worker
+   layout must be untouched — and ``spawn --supervise --elastic -n 3``
+   then reshards in-process at boot and still finishes with exact
+   counts.
+
+Usable standalone (``python scripts/rescale_smoke.py`` → exit 0/1) and
+as a tier-1 test (``tests/test_rescale_smoke.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXPECTED = {"foo": 10, "bar": 5, "baz": 5}
+
+_PROGRAM = """
+import json, os, sys, time
+
+import pathway_tpu as pw
+from pathway_tpu.persistence import Backend, Config
+
+out_path, pstate = sys.argv[1], sys.argv[2]
+
+WORDS = ["foo", "bar", "foo", "baz"] * 5
+
+
+class S(pw.io.python.ConnectorSubject):
+    def run(self):
+        for w in WORDS:
+            self.next(word=w)
+            self.commit()
+            time.sleep(0.02)
+
+
+t = pw.io.python.read(
+    S(), schema=pw.schema_from_types(word=str), name="words",
+    autocommit_ms=None,
+)
+counts = t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+f = open(out_path, "a")
+
+
+def on_change(key, row, time, is_addition):
+    f.write(json.dumps([row["word"], int(row["c"]), bool(is_addition)]) + "\\n")
+    f.flush()
+
+
+pw.io.subscribe(counts, on_change=on_change)
+cfg = Config.simple_config(Backend.filesystem(pstate), snapshot_interval_ms=10)
+pw.run(persistence_config=cfg)
+"""
+
+#: SIGKILL worker 1 at its 8th tick — a hard mid-stream death of the
+#: 2-process generation 0
+KILL_PLAN = {
+    "seed": 7,
+    "faults": [
+        {"site": "tick", "worker": 1, "tick": 8, "action": "kill", "run": 0},
+    ],
+}
+
+#: SIGKILL the resharder immediately BEFORE the cluster-marker promotion:
+#: the atomicity proof — the old layout must remain the bootable one
+RESCALE_KILL_PLAN = {
+    "seed": 7,
+    "faults": [
+        {"site": "rescale", "phase": "promote", "action": "kill"},
+    ],
+}
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _events(path: str) -> list:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:  # a SIGKILL may tear the last line mid-write
+                out.append(json.loads(line))
+            except (json.JSONDecodeError, ValueError):
+                pass
+    return out
+
+
+def _finals(events: list) -> dict:
+    final: dict = {}
+    for e in events:
+        if len(e) == 3 and e[2]:
+            final[e[0]] = e[1]
+    return final
+
+
+def _marker(pstate: str) -> dict:
+    with open(os.path.join(pstate, "cluster")) as f:
+        return json.load(f)
+
+
+def _spawn(args, env, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "pathway_tpu.cli", *args],
+        env=env, timeout=timeout, capture_output=True, text=True,
+    )
+
+
+def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
+    tmp = workdir or tempfile.mkdtemp(prefix="rescale_smoke_")
+    prog = os.path.join(tmp, "prog.py")
+    with open(prog, "w") as f:
+        f.write(textwrap.dedent(_PROGRAM))
+    pstate = os.path.join(tmp, "pstate")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo_root,
+        "PATHWAY_FLIGHT_DIR": os.path.join(tmp, "flight"),
+        "PATHWAY_SUPERVISE_BACKOFF_S": "0.05",
+        "PATHWAY_SUPERVISE_BACKOFF_MAX_S": "0.2",
+    }
+    base_env.pop("PATHWAY_FAULT_PLAN", None)
+
+    # -- 1. two-process persisted run, SIGKILLed mid-stream ---------------
+    out_a = os.path.join(tmp, "events_a.jsonl")
+    proc = _spawn(
+        ["spawn", "-n", "2", "-t", "1", "--first-port", str(_free_port()),
+         sys.executable, prog, out_a, pstate],
+        {**base_env, "PATHWAY_FAULT_PLAN": json.dumps(KILL_PLAN)},
+    )
+    assert proc.returncode != 0, (
+        "the fault plan should have killed generation 0\n"
+        f"stderr:\n{proc.stderr[-2000:]}"
+    )
+    killed_finals = _finals(_events(out_a))
+    assert killed_finals != EXPECTED, (
+        "the killed run finished the whole stream before the planned kill"
+    )
+    assert _marker(pstate)["n_workers"] == 2
+
+    # keep a pristine copy of the crashed state for the chaos variant
+    pstate_crash = os.path.join(tmp, "pstate_crash")
+    shutil.copytree(pstate, pstate_crash)
+
+    # -- 2. offline rescale 2 -> 3 ---------------------------------------
+    proc = _spawn(["rescale", "--to", "3", pstate], base_env)
+    assert proc.returncode == 0, (
+        f"rescale failed ({proc.returncode})\nstderr:\n{proc.stderr[-3000:]}"
+    )
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["from"] == 2 and report["to"] == 3, report
+    assert _marker(pstate)["n_workers"] == 3
+
+    # -- 3. supervised resume on THREE workers, exact final counts --------
+    out_b = os.path.join(tmp, "events_b.jsonl")
+    proc = _spawn(
+        ["spawn", "--supervise", "-n", "3", "-t", "1",
+         "--first-port", str(_free_port()),
+         sys.executable, prog, out_b, pstate],
+        base_env,
+    )
+    assert proc.returncode == 0, (
+        f"resumed 3-worker run exited {proc.returncode}\n"
+        f"stderr:\n{proc.stderr[-3000:]}"
+    )
+    final = dict(killed_finals)
+    final.update(_finals(_events(out_b)))
+    assert final == EXPECTED, (
+        f"final counts after rescale {final} != {EXPECTED}\n"
+        f"stderr:\n{proc.stderr[-2000:]}"
+    )
+
+    # -- 4. chaos: SIGKILL the resharder mid-promotion --------------------
+    proc = _spawn(
+        ["rescale", "--to", "3", pstate_crash],
+        {**base_env, "PATHWAY_FAULT_PLAN": json.dumps(RESCALE_KILL_PLAN)},
+    )
+    assert proc.returncode != 0, "the rescale chaos kill did not fire"
+    assert _marker(pstate_crash)["n_workers"] == 2, (
+        "a crash before promotion must leave the OLD layout's marker"
+    )
+
+    # -- 5. elastic supervised boot on the crashed-rescale state ----------
+    out_c = os.path.join(tmp, "events_c.jsonl")
+    proc = _spawn(
+        ["spawn", "--supervise", "--elastic", "-n", "3", "-t", "1",
+         "--first-port", str(_free_port()),
+         sys.executable, prog, out_c, pstate_crash],
+        base_env,
+    )
+    assert proc.returncode == 0, (
+        f"elastic boot exited {proc.returncode}\n"
+        f"stderr:\n{proc.stderr[-3000:]}"
+    )
+    assert _marker(pstate_crash)["n_workers"] == 3
+    final_c = dict(killed_finals)
+    final_c.update(_finals(_events(out_c)))
+    assert final_c == EXPECTED, (
+        f"elastic final counts {final_c} != {EXPECTED}\n"
+        f"stderr:\n{proc.stderr[-2000:]}"
+    )
+
+    if verbose:
+        print(
+            f"rescale_smoke: killed at {killed_finals}, resumed on 3 "
+            f"workers -> {final}, elastic recovery -> {final_c}"
+        )
+    return {"final": final, "elastic_final": final_c, "report": report}
+
+
+def main() -> int:
+    try:
+        run_smoke(verbose=True)
+    except BaseException as e:  # noqa: BLE001 — CLI exit-code surface
+        print(f"rescale_smoke FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    print("rescale_smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -155,7 +155,7 @@ def test_operator_snapshots_make_restart_o_of_state():
     times = m.available_op_times()
     assert times, "commit must write an operator snapshot catalog"
     # everything recorded is covered by the newest snapshot: zero tail
-    assert m.replay_batches(after_time=max(times)) == []
+    assert list(m.replay_batches(after_time=max(times))) == []
     # input chunks below the oldest retained snapshot were truncated
     store = MemoryBackend("opsnap")._store
     chunk_keys = [k for k in store if k.startswith("chunks/")]
@@ -382,6 +382,57 @@ def test_s3_backend_sharded_worker_namespaces():
     assert w1.get_value("snap") == b"one"
     assert w0.list_keys() == ["snap"]
     assert shared.list_keys() == ["worker-0/snap", "worker-1/snap"]
+
+
+def test_close_flush_pins_offsets_to_delivery_boundary():
+    """Connector offsets advance when rows are DRAINED from the producer
+    queue — potentially rounds ahead of what was ticked and recorded. A
+    crash mid-cycle then must not persist the live offset (it would cover
+    input that exists nowhere → silent loss on resume): close() flushes
+    exactly the last delivery-boundary prefix with the offsets
+    snapshotted there."""
+    import numpy as np
+
+    from pathway_tpu.engine.delta import Delta
+    from pathway_tpu.persistence import PersistenceManager
+
+    MemoryBackend.drop("boundary")
+    cfg = Config.simple_config(
+        Backend.memory("boundary"), snapshot_interval_ms=3_600_000
+    )
+    m = PersistenceManager(cfg)
+
+    class FakeSource:
+        persistent_id = "s"
+        rows = 0
+
+        def offset_state(self):
+            return {"rows": self.rows}
+
+    def row_delta():
+        return Delta(
+            keys=np.array([1], dtype=np.uint64),
+            data={"w": np.array(["x"], dtype=object)},
+        )
+
+    src = FakeSource()
+    m.begin_recording([src])
+    # cycle 1: one row drained and fully delivered (ticked + recorded)
+    src.rows = 1
+    m.record(10, "s", row_delta())
+    m.on_time_end(10)
+    m.note_delivery_boundary()
+    # cycle 2: the source hands out two more rows; the first is recorded
+    # at a tick that dies mid-sweep, the second's round never runs — the
+    # live offset (3) now covers a row that was never recorded
+    src.rows = 3
+    m.record(12, "s", row_delta())
+    m.close()
+
+    m2 = PersistenceManager(cfg)
+    assert m2.offset_for("s") == {"rows": 1}  # not the live 3
+    assert [t for t, _pid, _d in m2.replay_batches()] == [10]
+    m2.close()
 
 
 # -- cluster marker (resharding guard) — ISSUE 2 satellite ------------------

@@ -1,0 +1,386 @@
+"""Elastic rescaling unit + integration tests (rescale/resharder.py).
+
+Covers the layout/epoch marker protocol, the operator split/merge API,
+rescale atomicity under injected crashes at every phase boundary, the
+O(chunk) generator replay satellite, and the torn-metadata fallback
+satellite (direct + via the persistence.put chaos site).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.persistence import Backend, Config
+from pathway_tpu.persistence.backends import MemoryBackend
+from pathway_tpu.rescale import RescaleError, rescale, stats
+
+
+# -- harness ----------------------------------------------------------------
+
+WORDS = ["a", "b", "a", "c"] * 3 + ["a", "c", "d"] * 4 + ["d", "b"] * 2
+
+
+def _run_wordcount(upto: int, threads: int, cfg, monkeypatch) -> dict:
+    """Run the flagship wordcount over WORDS[:upto] (a replayable source:
+    each run re-emits from the start, recovery seeks past the persisted
+    offset) and return {word: last emitted count}."""
+    G.clear()
+    monkeypatch.setenv("PATHWAY_THREADS", str(threads))
+    final: dict = {}
+
+    class S(pw.io.python.ConnectorSubject):
+        def run(self):
+            for w in WORDS[:upto]:
+                self.next(word=w)
+                self.commit()
+                time.sleep(0.002)
+
+    t = pw.io.python.read(
+        S(), schema=pw.schema_from_types(word=str), name="words",
+        autocommit_ms=None,
+    )
+    counts = t.groupby(pw.this.word).reduce(
+        pw.this.word, c=pw.reducers.count()
+    )
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            final[row["word"]] = int(row["c"])
+
+    pw.io.subscribe(counts, on_change=on_change)
+    try:
+        pw.run(persistence_config=cfg)
+    finally:
+        monkeypatch.setenv("PATHWAY_THREADS", "1")
+        G.clear()
+    return final
+
+
+def _mem_cfg(name: str):
+    MemoryBackend.drop(name)
+    return Config.simple_config(Backend.memory(name), snapshot_interval_ms=5)
+
+
+# -- layout marker / epochs -------------------------------------------------
+
+
+def test_layout_namespaces():
+    from pathway_tpu.persistence.layout import epoch_prefix, worker_namespace
+
+    assert worker_namespace(0, 1, 0) == ""
+    assert worker_namespace(0, 4, 2) == "worker-2/"
+    assert worker_namespace(3, 1, 0) == "epoch-3/"
+    assert worker_namespace(3, 4, 2) == "epoch-3/worker-2/"
+    assert epoch_prefix(0) == ""
+
+
+def test_rescale_refuses_empty_store():
+    with pytest.raises(RescaleError, match="no cluster marker"):
+        rescale(MemoryBackend(), 3)
+
+
+def test_rescale_noop_same_count(monkeypatch):
+    cfg = _mem_cfg("resc-noop")
+    _run_wordcount(12, 1, cfg, monkeypatch)
+    report = rescale(MemoryBackend("resc-noop"), 1)
+    assert report["noop"] is True
+
+
+# -- the core resharding round trip ----------------------------------------
+
+
+def test_rescale_1_to_3_to_1_exact_counts(monkeypatch):
+    cfg = _mem_cfg("resc-core")
+    root = MemoryBackend("resc-core")
+
+    seg1 = _run_wordcount(12, 1, cfg, monkeypatch)
+    assert seg1 == {"a": 6, "b": 3, "c": 3}
+
+    report = rescale(root, 3)
+    assert report["from"] == 1 and report["to"] == 3
+    marker = json.loads(root.get_value("cluster"))
+    assert marker == {"n_workers": 3, "epoch": report["epoch"]}
+    # a complete worker-{j} layout exists for every destination
+    for j in range(3):
+        assert any(
+            k.startswith(f"epoch-{report['epoch']}/worker-{j}/meta/")
+            for k in root.list_keys()
+        )
+
+    seg2 = _run_wordcount(24, 3, cfg, monkeypatch)
+    assert seg2 == {"a": 10, "c": 7, "d": 4}  # new words only (skip_until)
+
+    rescale(root, 1)
+    seg3 = _run_wordcount(28, 1, cfg, monkeypatch)
+    expected = Counter(WORDS)
+    merged = {**seg1, **seg2, **seg3}
+    assert merged == dict(expected)
+
+
+def test_elastic_boot_reshards_in_process(monkeypatch):
+    """PATHWAY_ELASTIC=1 + a worker-count mismatch runs the resharder
+    inside worker 0's PersistenceManager construction instead of
+    refusing; without it the classic refusal (now naming the remedies)
+    stays."""
+    cfg = _mem_cfg("resc-elastic")
+    _run_wordcount(12, 2, cfg, monkeypatch)
+
+    with pytest.raises(RuntimeError, match="pathway-tpu rescale"):
+        _run_wordcount(24, 4, cfg, monkeypatch)
+
+    monkeypatch.setenv("PATHWAY_ELASTIC", "1")
+    before = stats()["total"]
+    seg2 = _run_wordcount(24, 4, cfg, monkeypatch)
+    assert stats()["total"] == before + 1
+    assert seg2 == {"a": 10, "c": 7, "d": 4}
+    marker = json.loads(MemoryBackend("resc-elastic").get_value("cluster"))
+    assert marker["n_workers"] == 4
+
+
+# -- crash-mid-rescale atomicity (the `rescale` chaos site) -----------------
+
+
+def test_rescale_crash_at_any_phase_leaves_bootable_layout(monkeypatch):
+    from pathway_tpu.chaos import injector as chaos
+    from pathway_tpu.chaos.plan import FaultPlan
+
+    cfg = _mem_cfg("resc-chaos")
+    root = MemoryBackend("resc-chaos")
+    _run_wordcount(12, 1, cfg, monkeypatch)
+    marker_before = root.get_value("cluster")
+
+    # a crash at every pre-promotion boundary leaves the OLD layout
+    # untouched (marker byte-identical)
+    for phase in ("plan", "stage", "copy", "promote"):
+        chaos.arm(FaultPlan.from_dict({"faults": [
+            {"site": "rescale", "phase": phase, "action": "crash"},
+        ]}))
+        try:
+            with pytest.raises(chaos.ChaosInjected):
+                rescale(root, 3)
+        finally:
+            chaos.disarm()
+        assert root.get_value("cluster") == marker_before, phase
+        # the old layout still boots and finishes the stream exactly
+    seg = _run_wordcount(16, 1, cfg, monkeypatch)
+    assert seg == {"a": 8, "c": 4, "d": 1}  # WORDS[12:16] == a,c,d,a
+
+    # a crash AFTER the marker flip (cleanup) leaves the NEW layout live
+    chaos.arm(FaultPlan.from_dict({"faults": [
+        {"site": "rescale", "phase": "cleanup", "action": "crash"},
+    ]}))
+    try:
+        with pytest.raises(chaos.ChaosInjected):
+            rescale(root, 3)
+    finally:
+        chaos.disarm()
+    assert json.loads(root.get_value("cluster"))["n_workers"] == 3
+    seg = _run_wordcount(24, 3, cfg, monkeypatch)
+    assert seg == {"a": 10, "c": 7, "d": 4}
+
+    # the next clean rescale sweeps the crashed attempt's leftovers
+    rescale(root, 2)
+    leftovers = [
+        k for k in root.list_keys()
+        if k.startswith(("rescale-tmp/", "meta/", "chunks/", "ops/"))
+    ]
+    assert leftovers == []
+
+
+# -- operator split/merge API ----------------------------------------------
+
+
+def test_split_merge_preserves_groupby_state_multiset(monkeypatch):
+    """split_state over M shards followed by merge_states reconstitutes
+    the exact operator state (general + dense paths both ride the dense
+    arena here: count/sum over numerics)."""
+    from pathway_tpu.engine import keys as K
+    from pathway_tpu.engine.operators import GroupByReduce
+
+    rng = np.random.default_rng(0)
+    gks = K.mix_columns([np.arange(50, dtype=np.int64)], 50)
+    state = {
+        "_state": {
+            int(gk): [2, (int(i),), [2, int(i) * 10], None]
+            for i, gk in enumerate(gks)
+        },
+        "dense": False,
+        "gerrs": {},
+    }
+    masks = [
+        (lambda keys, j=j: K.shard_of(np.asarray(keys, np.uint64), 4) == j)
+        for j in range(4)
+    ]
+    pieces = [GroupByReduce.split_state(state, m) for m in masks]
+    sizes = [len(p["_state"]) for p in pieces]
+    assert sum(sizes) == 50 and all(s > 0 for s in sizes)
+    merged = GroupByReduce.merge_states(pieces)
+    assert merged["_state"] == state["_state"]
+
+
+def test_split_merge_pinned_state_keeps_worker0_piece():
+    from pathway_tpu.engine.operators import Capture
+
+    assert Capture.RESHARD == "pinned"
+    real, pristine = {"state": "full"}, {"state": "empty"}
+    mask = lambda keys: np.ones(len(keys), dtype=bool)  # noqa: E731
+    assert Capture.split_state(real, mask) is real
+    assert Capture.merge_states([real, pristine]) is real
+
+
+def test_replicated_source_state_unions():
+    from pathway_tpu.engine.executor import RealtimeSource
+
+    owner = {"_seen": {"a.txt", "b.txt"}, "_last": {"k": 4}}
+    fresh = {"_seen": set(), "_last": {}}
+    merged = RealtimeSource.merge_states([fresh, owner])
+    assert merged == owner
+    # dict-valued progress markers resolve conflicts NUMERICALLY (a prior
+    # rescale replicates the owner's state everywhere; only the new
+    # owner's copy advances afterwards) — repr ordering would keep 999
+    stale = {"_seen": {"a.txt"}, "_last": {}, "_file_rows": {"f": 999}}
+    advanced = {"_seen": {"a.txt"}, "_last": {}, "_file_rows": {"f": 1500}}
+    merged = RealtimeSource.merge_states([stale, advanced])
+    assert merged["_file_rows"] == {"f": 1500}
+
+
+# -- satellite: generator replay (O(chunk) memory) --------------------------
+
+
+def test_snapshot_reader_batches_is_a_generator(monkeypatch):
+    import types
+
+    from pathway_tpu.persistence import PersistenceManager
+
+    cfg = _mem_cfg("resc-gen")
+    _run_wordcount(8, 1, cfg, monkeypatch)
+    m = PersistenceManager(cfg)
+    out = m.replay_batches(after_time=-1)
+    assert isinstance(out, types.GeneratorType)
+    for t, pid, delta in out:
+        assert pid == "words" and len(delta) >= 1
+        break  # lazily consumable
+    m.close()
+
+
+# -- satellite: torn-metadata fallback --------------------------------------
+
+
+def test_metadata_accessor_falls_back_from_torn_newest():
+    from pathway_tpu.persistence.snapshots import MetadataAccessor
+
+    b = MemoryBackend()
+    b.put_value("meta/meta-00000000", json.dumps({"last_time": 4}).encode())
+    b.put_value("meta/meta-00000001", b'{"last_time": 9')  # torn mid-write
+    acc = MetadataAccessor(b)
+    assert acc.current == {"last_time": 4}
+    assert acc.fell_back_from == 1
+    # healing: the next commit rewrites the torn version number
+    acc.commit({"last_time": 12})
+    acc2 = MetadataAccessor(b)
+    assert acc2.current == {"last_time": 12}
+    assert acc2.fell_back_from is None
+
+
+def test_torn_meta_write_via_chaos_site_recovers(monkeypatch):
+    """persistence.put `torn` on the 2nd metadata commit, then `fail` on
+    the next one (the close()-flush commit; a firing fault short-circuits
+    the later faults' counters, so both select nth=2): the run dies with
+    the torn blob as the NEWEST version; recovery falls back one version
+    with a warning and the resumed run finishes the stream with exact
+    counts."""
+    from pathway_tpu.chaos import injector as chaos
+    from pathway_tpu.persistence.snapshots import MetadataAccessor
+
+    cfg = _mem_cfg("resc-torn")
+    monkeypatch.setenv("PATHWAY_FAULT_PLAN", json.dumps({"faults": [
+        {"site": "persistence.put", "key_prefix": "meta/", "nth": 2,
+         "action": "torn"},
+        {"site": "persistence.put", "key_prefix": "meta/", "nth": 2,
+         "action": "fail"},
+    ]}))
+    try:
+        with pytest.raises(chaos.ChaosInjected):
+            _run_wordcount(12, 1, cfg, monkeypatch)
+    finally:
+        monkeypatch.delenv("PATHWAY_FAULT_PLAN", raising=False)
+        chaos.disarm()
+
+    acc = MetadataAccessor(MemoryBackend("resc-torn"))
+    assert acc.fell_back_from is not None
+
+    final = _run_wordcount(12, 1, cfg, monkeypatch)
+    assert final == {"a": 6, "b": 3, "c": 3}
+
+
+# -- observability ----------------------------------------------------------
+
+
+def test_rescale_metrics_without_restart_series(monkeypatch):
+    """A completed rescale surfaces pathway_rescale_total on /metrics —
+    without minting pathway_restarts_total outside supervision."""
+    from pathway_tpu import chaos
+    from pathway_tpu.observability import ObservabilityHub
+    from pathway_tpu.observability.prometheus import parse_exposition
+
+    chaos.disarm()
+    for k in ("PATHWAY_SUPERVISED", "PATHWAY_RESTART_COUNT",
+              "PATHWAY_LAST_RESTART_REASON", "PATHWAY_FLIGHT_DUMPS"):
+        monkeypatch.delenv(k, raising=False)
+    cfg = _mem_cfg("resc-metrics")
+    _run_wordcount(8, 1, cfg, monkeypatch)
+    rescale(MemoryBackend("resc-metrics"), 2)
+
+    body = ObservabilityHub().render_metrics()
+    series = parse_exposition(body)
+    totals = {k[0]: v for k, v in series.items()}
+    assert totals.get("pathway_rescale_total", 0) >= 1
+    assert "pathway_rescale_duration_seconds" in totals
+    assert "pathway_restarts_total" not in totals
+
+
+# -- offsets ----------------------------------------------------------------
+
+
+def test_offset_union_prefers_replay_more_on_legacy_conflict():
+    from pathway_tpu.rescale.resharder import _merge_offsets
+
+    logs: list[str] = []
+    merged = _merge_offsets(
+        [
+            {"offsets": {"s": {"rows": 12}, "t": {"rows": 3}}},
+            # the LARGEST copy is the owner's (offsets advance only on the
+            # owner) and exactly covers the recorded input; comparison is
+            # NUMERIC, not lexicographic JSON ("999" > "1000" as strings)
+            {"offsets": {"s": {"rows": 40}, "u": {"rows": 999}}},
+            {"offsets": {"u": {"rows": 1000}}},
+        ],
+        logs.append,
+    )
+    assert merged == {
+        "s": {"rows": 40}, "t": {"rows": 3}, "u": {"rows": 1000},
+    }
+    assert logs and "conflict" in logs[0]
+
+
+def test_marker_io_errors_propagate():
+    """A transient read error on the cluster marker must FAIL the boot,
+    never be mistaken for an empty store (which would mount blank
+    namespaces over a live layout)."""
+    from pathway_tpu.persistence.layout import read_marker
+
+    class FlakyBackend(MemoryBackend):
+        def get_value(self, key):
+            raise OSError("connection reset")
+
+    with pytest.raises(OSError):
+        read_marker(FlakyBackend())
+    assert read_marker(MemoryBackend()) is None  # genuinely missing -> None

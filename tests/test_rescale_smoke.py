@@ -1,0 +1,25 @@
+"""Tier-1 wrapper around scripts/rescale_smoke.py (like test_chaos_smoke):
+a 2-process persisted wordcount is SIGKILLed mid-stream, its state is
+resharded to 3 workers (`pathway-tpu rescale`), a supervised 3-worker run
+resumes to EXACT final counts — and a chaos SIGKILL during the rescale's
+promotion leaves the old layout bootable, which `spawn --supervise
+--elastic` then reshards in-process and still finishes exactly."""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    ),
+)
+
+
+def test_rescale_smoke(tmp_path):
+    from rescale_smoke import EXPECTED, run_smoke
+
+    result = run_smoke(workdir=str(tmp_path))
+    assert result["final"] == EXPECTED
+    assert result["elastic_final"] == EXPECTED
+    assert result["report"]["from"] == 2 and result["report"]["to"] == 3
