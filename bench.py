@@ -190,6 +190,7 @@ def main() -> None:
     wc_sharded_t4 = _wordcount_throughput(threads=4)
     mesh_rows_per_sec = _mesh_exchange_throughput()
     cluster_n2 = _cluster_throughput()
+    codec_enc_mb, codec_dec_mb, codec_bytes_row = _comm_codec_throughput()
     import os as _os
 
     n_cores = _os.cpu_count() or 1
@@ -241,6 +242,13 @@ def main() -> None:
             "cluster_n2_rows_per_sec": (
                 round(cluster_n2, 1) if cluster_n2 else None
             ),
+            # zero-copy columnar wire codec (parallel/frames.py): encode /
+            # decode bandwidth over a representative exchange Delta and its
+            # on-wire footprint — the data-plane cost the pipelined
+            # ClusterComm pays per frame (pickle was the old codec)
+            "comm_encode_mb_per_sec": round(codec_enc_mb, 1),
+            "comm_decode_mb_per_sec": round(codec_dec_mb, 1),
+            "comm_codec_bytes_per_row": round(codec_bytes_row, 2),
             # north-star metrics (BASELINE.json): embed throughput + MFU,
             # RAG ingest rate, end-to-end REST serve latency vs 50 ms
             "embed_tokens_per_sec": round(embed["tok_per_sec"], 1),
@@ -295,8 +303,11 @@ def _diff_vs_previous_round(result: dict) -> None:
     if prev is None:
         return
     name, prev_res = prev
-    higher_is_better = lambda k: "_ms" not in k and "latency" not in k
+    higher_is_better = lambda k: (
+        "_ms" not in k and "latency" not in k and "bytes_per_row" not in k
+    )
     regressions = []
+    improvements = []
     for key, new in result["extra"].items():
         old = prev_res.get("extra", {}).get(key)
         if not isinstance(new, (int, float)) or not isinstance(old, (int, float)):
@@ -311,10 +322,19 @@ def _diff_vs_previous_round(result: dict) -> None:
             file=sys.stderr,
         )
         worse = ratio < 0.9 if higher_is_better(key) else ratio > 1.1
+        better = ratio > 1.1 if higher_is_better(key) else ratio < 0.9
         if worse:
             regressions.append(f"{key}: {old:g} -> {new:g}")
+        elif better:
+            # record wins too (join/apply/cluster deltas): the next
+            # round's trajectory should carry the gain, not rediscover it
+            improvements.append(
+                f"{key}: {old:g} -> {new:g} ({arrow}{abs(ratio - 1) * 100:.0f}%)"
+            )
     if regressions:
         result["extra"]["perf_regressions_vs_prev_round"] = regressions
+    if improvements:
+        result["extra"]["perf_improvements_vs_prev_round"] = improvements
 
 
 def _record_capture(result: dict, platform: str) -> None:
@@ -753,6 +773,47 @@ def _cluster_throughput(n_rows: int = 500_000, batch: int = 10_000) -> float | N
         except (OSError, ValueError, KeyError) as e:
             print(f"bench: cluster -n2 output unreadable: {e}", file=sys.stderr)
             return None
+
+
+def _comm_codec_throughput(
+    n_rows: int = 200_000,
+) -> tuple[float, float, float]:
+    """Wire-codec micro-bench → (encode MB/s, decode MB/s, bytes/row)
+    over a representative exchange Delta: uint64 keys, int64 + float64
+    dense columns and a short-string object column (the wordcount/join
+    frame mix). Encode counts the chunk assembly the sender pays before
+    enqueue; decode counts ``frombuffer`` reconstruction from one recv
+    buffer — the two halves of ``parallel/frames.py``."""
+    from pathway_tpu.engine.delta import Delta
+    from pathway_tpu.parallel import frames
+
+    rng = np.random.default_rng(5)
+    delta = Delta(
+        keys=rng.integers(0, 1 << 62, n_rows).astype(np.uint64),
+        data={
+            "a": rng.integers(0, 1000, n_rows).astype(np.int64),
+            "b": rng.standard_normal(n_rows),
+            "w": np.array(
+                [f"w{i % 997}" for i in range(n_rows)], dtype=object
+            ),
+        },
+        diffs=np.ones(n_rows, dtype=np.int64),
+    )
+    per = {1: delta}
+    chunks, nbytes = frames.encode_frame(0, 2, 0, per, None)  # warm caches
+    body = bytearray(b"".join(bytes(c) for c in chunks))
+    frames.decode_frame(body)
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        chunks, nbytes = frames.encode_frame(0, 2, 0, per, None)
+    enc_s = max(time.perf_counter() - t0, 1e-9)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        frames.decode_frame(body)
+    dec_s = max(time.perf_counter() - t0, 1e-9)
+    mb = nbytes * iters / 1e6
+    return mb / enc_s, mb / dec_s, nbytes / n_rows
 
 
 def _wordcount_throughput(

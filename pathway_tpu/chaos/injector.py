@@ -248,7 +248,14 @@ class RescaleFaults:
 
 
 class SendFaults:
-    """Bound comm.send-site handle for one process's ClusterComm."""
+    """Bound comm.send-site handle for one process's ClusterComm.
+
+    Fires at frame-enqueue time on the pipelined data plane (the frame
+    never reaches the peer writer queue for ``drop``/``sever``;
+    ``corrupt`` mangles the encoded body so the peer's reader exercises
+    its torn-frame refusal path). ``op_for`` is called from worker
+    threads concurrently — the owner's decision lock keeps nth counters
+    exact."""
 
     def __init__(self, owner: ActiveFaults, process_id: int,
                  matches: list[tuple[int, Fault]]):

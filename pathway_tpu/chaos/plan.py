@@ -24,12 +24,16 @@ Sites and actions:
   (sleep ``delay_s``, default forever-ish). Selected by ``worker`` and
   ``tick`` (the worker's 0-based tick sequence number).
 - ``comm.send`` — ClusterComm outbound frames. ``action`` is ``drop``,
-  ``delay``, ``duplicate`` or ``sever`` (shut the peer socket down, as a
-  network partition would). Selected by ``process``/``peer`` and either
-  ``nth`` (1-based matching-frame counter) or ``prob``. ``duplicate`` is
-  wire-level: it exercises the framing/reader path with a repeated frame,
-  which the inbox then absorbs idempotently (per-(collective, src)
-  slots) — it does NOT duplicate rows in the dataflow.
+  ``delay``, ``duplicate``, ``sever`` (shut the peer socket down, as a
+  network partition would) or ``corrupt`` (flip bytes in the frame body
+  on the wire — the peer's reader must refuse the torn frame and flip
+  ``_broken`` with a named origin, never deserialize garbage). Selected
+  by ``process``/``peer`` and either ``nth`` (1-based matching-frame
+  counter) or ``prob``. ``duplicate`` is wire-level: it exercises the
+  framing/reader path with a repeated frame, which the inbox then
+  absorbs idempotently (per-(collective, src) slots) — it does NOT
+  duplicate rows in the dataflow. All comm.send actions fire on the
+  pipelined send path, before the frame enters its peer writer queue.
 - ``comm.local`` — LocalComm collective contributions (thread workers).
   ``action`` is ``drop`` (contribute None) or ``delay``.
 - ``persistence.put`` — backend ``put_value``. ``action`` is ``fail``
@@ -68,7 +72,7 @@ __all__ = ["Fault", "FaultPlan", "load_plan_from_env"]
 _SITES = ("tick", "comm.send", "comm.local", "persistence.put", "rescale")
 _ACTIONS = {
     "tick": ("crash", "exit", "kill", "hang"),
-    "comm.send": ("drop", "delay", "duplicate", "sever"),
+    "comm.send": ("drop", "delay", "duplicate", "sever", "corrupt"),
     "comm.local": ("drop", "delay"),
     "persistence.put": ("fail", "torn"),
     "rescale": ("crash", "exit", "kill"),

@@ -18,11 +18,23 @@ logger = logging.getLogger("pathway_tpu.errors")
 
 
 class _ErrorLog:
-    """Process-wide error collector (reference global error log)."""
+    """Process-wide error collector (reference global error log).
+
+    Retention is a **ring buffer with a monotonic base index**: the
+    newest ``max_kept`` entries are retained and every entry keeps its
+    lifetime index (``base + position``), so live error-log tables
+    (``pw.global_error_log()``) keep receiving rows after 1000 lifetime
+    entries instead of silently freezing at the cap — pollers address
+    entries by lifetime index via :meth:`entries_since`, which also
+    reports how many fell off the ring between polls."""
 
     def __init__(self, max_kept: int = 1000, max_logged: int = 20):
+        from collections import deque
+
         self._lock = threading.Lock()
-        self._entries: list[tuple[str, str]] = []
+        self._entries: "deque[tuple[str, str, int | None]]" = deque()
+        #: lifetime index of the oldest retained entry
+        self._base = 0
         self.total = 0
         self._max_kept = max_kept
         self._max_logged = max_logged
@@ -30,8 +42,10 @@ class _ErrorLog:
     def record(self, message: str, context: str) -> None:
         with self._lock:
             self.total += 1
-            if len(self._entries) < self._max_kept:
-                self._entries.append((message, context, get_current_scope()))
+            self._entries.append((message, context, get_current_scope()))
+            if len(self._entries) > self._max_kept:
+                self._entries.popleft()
+                self._base += 1
             if self.total <= self._max_logged:
                 logger.warning("row error in %s: %s", context, message)
             elif self.total == self._max_logged + 1:
@@ -43,15 +57,41 @@ class _ErrorLog:
 
     def entries_full(self) -> list[tuple[str, str, int | None]]:
         """(message, context, scope) — scope is the local_error_log scope
-        active when the error was recorded (None = no local scope)."""
+        active when the error was recorded (None = no local scope).
+        Retained window only (newest ``max_kept``)."""
         with self._lock:
             return list(self._entries)
+
+    @property
+    def next_index(self) -> int:
+        """Lifetime index the NEXT recorded entry will get."""
+        with self._lock:
+            return self._base + len(self._entries)
+
+    def entries_since(self, index: int) -> tuple[int, list, int]:
+        """Entries with lifetime index >= ``index`` that are still in the
+        ring → ``(first_index, entries, next_index)``. ``first_index`` may
+        exceed ``index`` when older entries already fell off the ring (a
+        poller that lagged more than ``max_kept`` entries)."""
+        with self._lock:
+            end = self._base + len(self._entries)
+            start = min(max(index, self._base), end)
+            if start == end:
+                return end, [], end
+            from itertools import islice
+
+            return (
+                start,
+                list(islice(self._entries, start - self._base, None)),
+                end,
+            )
 
     def clear(self) -> None:
         # clears the LOG, not the errors-seen latch: live Error values may
         # still sit in operator state, so error-aware paths must stay on
         with self._lock:
             self._entries.clear()
+            self._base = 0
             self.total = 0
 
 

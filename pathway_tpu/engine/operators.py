@@ -960,13 +960,43 @@ class _SortedSide:
     runs — the differential *arrangement* analog (sort-merge join on key
     shards, SURVEY §7 step 3). Probes are vectorized ``searchsorted`` range
     expansions; retractions ride as negative counts in newer runs and cancel
-    at compaction, so ``d ⋈ state`` stays a linear operator over runs."""
+    at compaction, so ``d ⋈ state`` stays a linear operator over runs.
+
+    Two maintenance optimizations keep per-tick cost amortized-log
+    (BENCH ``join_stream_rows_per_sec``):
+
+    - **size-tiered run merging**: ``apply`` merge-sorts tail runs whose
+      sizes are within 2×, so a long stream holds O(log n) runs instead
+      of hitting the periodic full-sort compaction wall every MAX_RUNS
+      ticks;
+    - **probe range memo**: the ``searchsorted`` (lo, hi) pair for a
+      (run, query) array pair is cached by identity — ``totals`` and
+      ``probe`` over the same affected-jk set in one tick (the pre/post
+      pad snapshots of an unchanged arrangement) pay the binary search
+      once. Runs are immutable after construction, which is what makes
+      identity a sound cache key.
+    """
 
     MAX_RUNS = 8
+    _RANGE_CACHE_MAX = 16
 
     def __init__(self, n_cols: int):
         self._n_cols = n_cols
         self._runs: list[list] = []  # [jks_sorted, row_keys, cols, counts]
+        #: (id(run_jks), id(qjks)) -> (run_jks, qjks, lo, hi); strong refs
+        #: make ids valid, the size bound makes the pinning harmless
+        self._range_cache: dict = {}
+
+    def __getstate__(self) -> dict:
+        # the memo must not ride into operator snapshots (it pins query
+        # arrays and is identity-keyed — meaningless after unpickling)
+        d = dict(self.__dict__)
+        d.pop("_range_cache", None)
+        return d
+
+    def __setstate__(self, d: dict) -> None:
+        self.__dict__.update(d)
+        self._range_cache = {}
 
     def __len__(self) -> int:
         return sum(len(r[0]) for r in self._runs)
@@ -978,6 +1008,21 @@ class _SortedSide:
         return [jks, keys, cols, counts,
                 np.concatenate([[0], np.cumsum(counts)])]
 
+    def _ranges(self, run: list, qjks: np.ndarray) -> tuple:
+        """Memoized ``(searchsorted left, right)`` of ``qjks`` in a run."""
+        jks_s = run[0]
+        cache = self._range_cache
+        key = (id(jks_s), id(qjks))
+        hit = cache.get(key)
+        if hit is not None and hit[0] is jks_s and hit[1] is qjks:
+            return hit[2], hit[3]
+        lo = np.searchsorted(jks_s, qjks, "left")
+        hi = np.searchsorted(jks_s, qjks, "right")
+        if len(cache) >= self._RANGE_CACHE_MAX:
+            cache.clear()
+        cache[key] = (jks_s, qjks, lo, hi)
+        return lo, hi
+
     def apply(self, jks, keys, cols, diffs) -> None:
         if not len(jks):
             return
@@ -988,8 +1033,59 @@ class _SortedSide:
             [np.asarray(c)[order] for c in cols],
             diffs[order].astype(np.int64),
         ))
-        if len(self._runs) > self.MAX_RUNS:
+        # size-tiered maintenance: merge the tail while neighbors are
+        # within 2x, keeping the run count logarithmic in total rows with
+        # amortized O(n log n) total merge work — no periodic full-sort
+        # spike, and probes touch far fewer runs
+        runs = self._runs
+        while len(runs) > 1 and 2 * len(runs[-1][0]) >= len(runs[-2][0]):
+            b = runs.pop()
+            a = runs.pop()
+            merged = self._merge_runs(a, b)
+            if merged is not None:
+                runs.append(merged)
+        if len(runs) > self.MAX_RUNS:
             self._compact()
+
+    def _merge_runs(self, a: list, b: list) -> list | None:
+        """Merge two sorted runs into one (stable: a's rows precede b's
+        within equal jks — b is the newer run). Pure-insert merges (the
+        common streaming case) skip consolidation entirely; once a
+        retraction is present the merge consolidates, so cancelled pairs
+        are reclaimed incrementally rather than at a compaction wall.
+        Returns None when everything cancelled."""
+        from .delta import _concat_cols
+
+        jks = np.concatenate([a[0], b[0]])
+        keys = np.concatenate([a[1], b[1]])
+        cols = [
+            _concat_cols([a[2][i], b[2][i]]) for i in range(self._n_cols)
+        ]
+        counts = np.concatenate([a[3], b[3]])
+        if len(counts) and counts.min() < 0:
+            jks, keys, cols, counts = self._consolidate(jks, keys, cols, counts)
+            if not len(jks):
+                return None
+        order = np.argsort(jks, kind="stable")
+        return self._make_run(
+            jks[order], keys[order], [c[order] for c in cols], counts[order]
+        )
+
+    @staticmethod
+    def _consolidate(jks, keys, cols, counts):
+        """Sum multiplicities of identical (jk, row_key, values) rows and
+        drop the zeros — differential consolidation over a row batch."""
+        sig = K.derive_pair(
+            K.derive_pair(jks, keys),
+            K.mix_columns(cols, len(jks), register=False),
+        )
+        order = np.argsort(sig, kind="stable")
+        ss = sig[order]
+        starts = np.concatenate([[0], np.flatnonzero(np.diff(ss) != 0) + 1])
+        sums = np.add.reduceat(counts[order], starts)
+        keep = sums != 0
+        reps = order[starts[keep]]
+        return jks[reps], keys[reps], [c[reps] for c in cols], sums[keep]
 
     def _compact(self) -> None:
         from .delta import _concat_cols
@@ -1001,19 +1097,8 @@ class _SortedSide:
             for i in range(self._n_cols)
         ]
         counts = np.concatenate([r[3] for r in self._runs])
-        n = len(jks)
         # row identity = (jk, row_key, values); multiplicities sum, zeros drop
-        sig = K.derive_pair(
-            K.derive_pair(jks, keys), K.mix_columns(cols, n, register=False)
-        )
-        order = np.argsort(sig, kind="stable")
-        ss = sig[order]
-        starts = np.concatenate([[0], np.flatnonzero(np.diff(ss) != 0) + 1])
-        sums = np.add.reduceat(counts[order], starts)
-        keep = sums != 0
-        reps = order[starts[keep]]
-        jks, keys, counts = jks[reps], keys[reps], sums[keep]
-        cols = [c[reps] for c in cols]
+        jks, keys, cols, counts = self._consolidate(jks, keys, cols, counts)
         order2 = np.argsort(jks, kind="stable")
         self._runs = (
             [self._make_run(
@@ -1029,9 +1114,9 @@ class _SortedSide:
     def probe(self, qjks: np.ndarray):
         """Yield (q_idx, row_keys, col_arrays, counts) for every state row
         matching each query jk, per run — the vectorized pair enumeration."""
-        for jks_s, keys, cols, counts, _csum in self._runs:
-            lo = np.searchsorted(jks_s, qjks, "left")
-            hi = np.searchsorted(jks_s, qjks, "right")
+        for run in self._runs:
+            _jks_s, keys, cols, counts, _csum = run
+            lo, hi = self._ranges(run, qjks)
             m = hi - lo
             total = int(m.sum())
             if not total:
@@ -1044,12 +1129,12 @@ class _SortedSide:
 
     def totals(self, qjks: np.ndarray) -> np.ndarray:
         """Total row multiplicity per query jk (the match-count vector the
-        pad bookkeeping needs) — searchsorted over a per-run prefix sum,
-        cached on the (immutable-between-compactions) run."""
+        pad bookkeeping needs) — memoized searchsorted over a per-run
+        prefix sum (shared with ``probe`` on the same query array)."""
         out = np.zeros(len(qjks), dtype=np.int64)
-        for jks_s, _keys, _cols, _counts, csum in self._runs:
-            lo = np.searchsorted(jks_s, qjks, "left")
-            hi = np.searchsorted(jks_s, qjks, "right")
+        for run in self._runs:
+            lo, hi = self._ranges(run, qjks)
+            csum = run[4]
             out += csum[hi] - csum[lo]
         return out
 

@@ -44,17 +44,17 @@ class _ErrorLogSource(RealtimeSource):
 
         self._log = ERROR_LOG
         self._scope = scope
-        self._seen = len(ERROR_LOG.entries_full())
+        #: lifetime index of the next entry to surface — stays valid past
+        #: the retention cap because the log is a ring with a monotonic
+        #: base, not a frozen prefix (advisor-medium error_log_table.py)
+        self._seen = ERROR_LOG.next_index
 
     def poll(self):
         from ..engine import keys as K
 
-        entries = self._log.entries_full()
-        new = entries[self._seen :]
+        start, new, self._seen = self._log.entries_since(self._seen)
         if not new:
             return []
-        start = self._seen
-        self._seen = len(entries)
         if self._scope is not None:
             new = [
                 (start + i, m, c)
@@ -80,7 +80,7 @@ class _ErrorLogSource(RealtimeSource):
         # nothing pending: the run ends when every OTHER source is also
         # finished (the event loop requires all-finished AND no rounds), so
         # errors raised by the final data tick still get drained first
-        return len(self._log.entries_full()) == self._seen
+        return self._log.next_index == self._seen
 
 
 def _log_table(scope: int | None) -> Table:

@@ -209,6 +209,7 @@ class PythonSubjectSource(RealtimeSource):
         defaults: dict[str, Any],
         pk_indices: list[int] | None,
         autocommit_ms: int | None,
+        dtypes: dict[str, Any] | None = None,
     ):
         super().__init__(names)
         self.subject = subject
@@ -216,6 +217,21 @@ class PythonSubjectSource(RealtimeSource):
         self.defaults = defaults
         self.pk_indices = pk_indices
         self.autocommit_ms = autocommit_ms
+        # columns whose DECLARED schema dtype is float: values are
+        # normalized to float64 before key hashing, so a row's key is a
+        # function of the row alone — never of which flush batch it rode
+        # in (a mixed int/float batch promotes the whole column to
+        # float64 while an all-int batch stays int64, and int 1 and
+        # float 1.0 hash differently; a retraction landing in a
+        # differently-typed batch then misses its row → ghost rows /
+        # negative multiplicities; advisor-high python.py:261)
+        from ..internals import dtype as dt
+
+        self._float_cols = frozenset(
+            name
+            for name, dtc in (dtypes or {}).items()
+            if dt.unoptionalize(dtc) == dt.FLOAT
+        )
         self._partial: list[tuple[int, tuple, int | None]] = []  # (diff, row, key)
         #: deltas built within the current commit window (columnar batches +
         #: flushed row runs), concatenated into ONE delta per commit
@@ -258,9 +274,9 @@ class PythonSubjectSource(RealtimeSource):
         data: dict[str, np.ndarray] = {}
         for name in self.names:
             dflt = self.defaults.get(name)
-            data[name] = column_of_values(
+            data[name] = self._normalize(name, column_of_values(
                 [f.get(name, dflt) for f in fields_list]
-            )
+            ))
         if plain:
             diffs = np.ones(n, dtype=np.int64)
         else:
@@ -268,17 +284,68 @@ class PythonSubjectSource(RealtimeSource):
                 (1 if type(e) is dict else e[0] for e in entries),
                 np.int64, count=n,
             )
-        if self.pk_indices is not None:
-            keys = K.mix_columns(
-                [data[self.names[i]] for i in self.pk_indices], n
-            )
+        key_cols = (
+            [data[self.names[i]] for i in self.pk_indices]
+            if self.pk_indices is not None
+            else list(data.values())
+        )
+        explicit = (
+            []
+            if plain
+            else [
+                i for i, e in enumerate(entries)
+                if type(e) is not dict and e[2] is not None
+            ]
+        )
+        if not explicit:
+            keys = K.mix_columns(key_cols, n)
         else:
-            keys = K.mix_columns(list(data.values()), n)
-        if not plain:
-            for i, e in enumerate(entries):
-                if type(e) is not dict and e[2] is not None:
-                    keys[i] = e[2]
+            # rows carrying an explicit key never USE their derived key —
+            # registering it would poison the 128-bit conflation registry
+            # with dead entries (and a later legitimate use of the same
+            # content key would false-collide). Derive + register only
+            # the surviving rows (advisor-low python.py:279).
+            keys = np.empty(n, dtype=np.uint64)
+            keep = np.ones(n, dtype=bool)
+            keep[explicit] = False
+            if keep.any():
+                keys[keep] = K.mix_columns(
+                    [np.asarray(c)[keep] for c in key_cols], int(keep.sum())
+                )
+            for i in explicit:
+                keys[i] = entries[i][2]
         return Delta(keys=keys, data=data, diffs=diffs)
+
+    def _normalize(self, name: str, arr: np.ndarray) -> np.ndarray:
+        """Coerce a column's values to the DECLARED schema dtype before
+        key hashing. Only float declarations need this: ``column_of_values``
+        picks the densest dtype of whatever one flush batch happens to
+        hold, so the same logical row could hash as int64 in one batch
+        and float64 in another — its key would depend on its batch
+        neighbors (ghost rows on retraction). Normalizing against the
+        schema makes the key a pure function of the row."""
+        if name not in self._float_cols or arr.dtype == np.float64:
+            return arr
+        if arr.dtype.kind in "iubf":
+            return arr.astype(np.float64)
+        if arr.dtype == object:
+            # optional float columns: coerce numeric cells, keep None &co
+            from ..engine.delta import column_of_values
+
+            out = np.empty(len(arr), dtype=object)
+            changed = False
+            for i, v in enumerate(arr):
+                if isinstance(v, float):
+                    out[i] = v
+                elif isinstance(v, (int, np.integer, np.floating)):
+                    out[i] = float(v)
+                    changed = True
+                else:
+                    out[i] = v
+            if not changed:
+                return arr
+            return column_of_values(list(out))
+        return arr
 
     def _make_batch_delta(self, batch: _Batch) -> Delta | None:
         """Columnar batch → Delta with vectorized key hashing.
@@ -311,7 +378,9 @@ class PythonSubjectSource(RealtimeSource):
             if name not in data:
                 fill = self.defaults.get(name)
                 data[name] = column_of_values([fill] * n)
-        data = {name: data[name] for name in self.names}  # schema order
+        # schema order + declared-dtype normalization (same key-stability
+        # contract as the row path: keys must not depend on the batch)
+        data = {name: self._normalize(name, data[name]) for name in self.names}
         # recovery seek already counted skipped rows into _emitted
         if self._skip >= n:
             self._skip -= n
@@ -452,7 +521,8 @@ def read(
 
     def build():
         src = PythonSubjectSource(
-            subject, names, defaults, pk_indices, autocommit_duration_ms
+            subject, names, defaults, pk_indices, autocommit_duration_ms,
+            dtypes=schema.dtypes(),
         )
         src.persistent_id = name
         return src

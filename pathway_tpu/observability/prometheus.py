@@ -185,7 +185,13 @@ def render_snapshots(
     for proc, gauges in sorted((comm_stats or {}).items()):
         plab = {"process": str(proc)}
         for key, value in sorted(gauges.items()):
-            r.add(f"pathway_comm_{key}", "gauge", value, plab)
+            # OpenMetrics convention: a `_total` suffix names a counter
+            # (pathway_comm_bytes_total / frames_coalesced_total /
+            # encode_seconds_total from the pipelined data plane);
+            # everything else in comm_stats is a point-in-time gauge
+            # (queue depths, broken flag)
+            kind = "counter" if key.endswith("_total") else "gauge"
+            r.add(f"pathway_comm_{key}", kind, value, plab)
     r.add("pathway_cluster_workers", "gauge", len(snapshots))
     if scrape_errors:
         r.add("pathway_cluster_scrape_errors", "counter", scrape_errors)

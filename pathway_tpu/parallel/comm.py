@@ -121,6 +121,11 @@ class LocalComm(Comm):
         self._barrier.abort()
 
     def exchange(self, channel, tick, worker_id, buckets):
+        """In-process all-to-all. Frames pass **by reference** — the
+        returned payloads are the very objects peers deposited (asserted
+        below): the thread allocator's contract is zero serialization,
+        zero copies, so the columnar wire codec is only ever paid at a
+        process boundary (ClusterComm)."""
         buckets = list(buckets)
         tracer = self._tracer
         if tracer is not None:
@@ -136,6 +141,12 @@ class LocalComm(Comm):
                         tick=tick,
                     )
         all_buckets = self._rendezvous(("x", channel, tick), worker_id, buckets)
+        # no-serialization invariant: our own deposit must come back as
+        # the identical list object (debug builds only; chaos 'drop' may
+        # null the whole slot, which is the one lawful substitution)
+        assert (
+            all_buckets[worker_id] is None or all_buckets[worker_id] is buckets
+        ), "LocalComm must pass frames by reference, never serialize"
         if tracer is not None:
             for src in range(self.n_workers):
                 if (

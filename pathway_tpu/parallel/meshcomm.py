@@ -30,6 +30,14 @@ runs bootstrap ``jax.distributed`` (``parallel/distributed.py``) and use
 :class:`MultiHostMeshComm`, whose collective spans every process's
 devices — ICI within a pod, DCN across pods.
 
+Host-boundary frames (the object-column swap of
+:meth:`MultiHostMeshComm.exchange_deltas` and any control payloads that
+ride the inner ClusterComm) reuse the columnar wire codec
+(``parallel/frames.py``): the ``(src, {name: column})`` payload shape is
+recognized by the encoder and ships through the same
+directory-plus-buffers frame layout as Delta exchanges, so no host
+boundary ever pays ``pickle.dumps`` on a dense column.
+
 Reference being replaced: timely's ``zero_copy`` allocator
 (``external/timely-dataflow/communication/src/allocator/zero_copy/``).
 """

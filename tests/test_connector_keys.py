@@ -1,0 +1,116 @@
+"""Row-key stability of the python connector (ISSUE 5 satellites).
+
+A row's engine key must be a pure function of the row against its
+DECLARED schema — never of which flush batch the row happened to ride
+in. The advisor-high case: a float-declared column whose values are
+python ints in one batch (column stays int64) and mixed int/float in
+another (column promotes to float64) used to hash differently, so a
+retraction could miss its row — ghost rows / negative multiplicities.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io.python import ConnectorSubject, PythonSubjectSource
+from pathway_tpu.internals import dtype as dt
+
+
+def _source(**dtypes):
+    names = list(dtypes)
+    return PythonSubjectSource(
+        ConnectorSubject(), names, {}, None, autocommit_ms=None,
+        dtypes={n: dt.wrap(t) for n, t in dtypes.items()},
+    )
+
+
+def test_key_independent_of_flush_batch_for_float_columns():
+    src = _source(x=float)
+    # batch A: the row {x: 1} flushed among ints -> int64 column
+    d_int = src._make_delta([{"x": 1}, {"x": 2}])
+    # batch B: the same row flushed next to a float -> float64 column
+    d_mixed = src._make_delta([{"x": 1}, {"x": 2.5}])
+    assert d_int.keys[0] == d_mixed.keys[0], (
+        "row key depends on its flush batch"
+    )
+    # data itself is normalized to the declared dtype
+    assert d_int.data["x"].dtype == np.float64
+    assert d_mixed.data["x"].dtype == np.float64
+
+
+def test_optional_float_object_column_normalized():
+    from typing import Optional
+
+    src = _source(x=Optional[float])
+    d_a = src._make_delta([{"x": 1}, {"x": None}])
+    d_b = src._make_delta([{"x": 1.0}, {"x": None}])
+    assert d_a.keys[0] == d_b.keys[0]
+    assert d_a.data["x"][0] == 1.0 and type(d_a.data["x"][0]) is not int
+
+
+def test_batch_lane_matches_row_lane_keys():
+    src = _source(x=float)
+    d_rows = src._make_delta([{"x": 1}, {"x": 2}])
+    from pathway_tpu.io.python import _Batch
+
+    d_batch = src._make_batch_delta(_Batch({"x": [1, 2]}, None))
+    assert np.array_equal(d_rows.keys, d_batch.keys)
+
+
+def test_retraction_cancels_across_differently_typed_batches():
+    """End-to-end regression: insert in an all-int batch, retract in a
+    mixed batch — the multiset must come out empty (no ghost row, no
+    negative multiplicity)."""
+    G.clear()
+
+    class Feed(ConnectorSubject):
+        def run(self) -> None:
+            self.next(x=1)
+            self.next(x=2)
+            self.commit()
+            self._remove(x=1)
+            self.next(x=2.5)  # forces float64 promotion of this batch
+            self._remove(x=2)
+            self.commit()
+
+    t = pw.io.python.read(
+        Feed(), schema=pw.schema_from_types(x=float),
+        autocommit_duration_ms=None,
+    )
+    state: dict = {}
+
+    def on_change(key, row, time, is_addition):
+        state[key] = state.get(key, 0) + (1 if is_addition else -1)
+
+    pw.io.subscribe(t, on_change=on_change)
+    pw.run()
+    G.clear()
+    live = {k: v for k, v in state.items() if v != 0}
+    assert all(v > 0 for v in state.values() if v), (
+        f"negative multiplicity: {state}"
+    )
+    assert len(live) == 1, f"expected only x=2.5 to survive, got {live}"
+
+
+def test_explicit_keys_do_not_register_derived_keys():
+    """Entries carrying an explicit key must not register their unused
+    derived key in the 128-bit conflation registry: a later legitimate
+    derivation of the same content must still pass (advisor-low)."""
+    from pathway_tpu.engine import keys as K
+
+    src = _source(x=int)
+    # explicit-keyed entry whose content would derive some 128-bit key
+    d = src._make_delta([(1, {"x": 777_123}, 42)])
+    assert d.keys[0] == 42
+    # deriving the same content legitimately must neither collide nor
+    # produce the explicit key
+    derived = src._make_delta([{"x": 777_123}])
+    assert derived.keys[0] != 42
+    # mixed batch: explicit + derived — derived row keys registered and
+    # stable vs an all-derived batch
+    mixed = src._make_delta([(1, {"x": 5}, 99), {"x": 6}])
+    pure = src._make_delta([{"x": 5}, {"x": 6}])
+    assert mixed.keys[0] == 99
+    assert mixed.keys[1] == pure.keys[1]

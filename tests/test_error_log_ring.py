@@ -1,0 +1,85 @@
+"""Error-log retention (ISSUE 5 satellite): the process error log is a
+ring buffer with a monotonic base index — live ``pw.global_error_log()``
+tables keep receiving rows past 1000 lifetime entries instead of
+freezing at the cap."""
+
+from __future__ import annotations
+
+import pytest
+
+from pathway_tpu.engine.error import _ErrorLog
+
+
+def test_ring_retains_newest_past_cap():
+    log = _ErrorLog(max_kept=10, max_logged=0)
+    for i in range(25):
+        log.record(f"e{i}", "ctx")
+    assert log.total == 25
+    kept = [m for m, _ in log.entries()]
+    assert kept == [f"e{i}" for i in range(15, 25)]
+    assert log.next_index == 25
+
+
+def test_entries_since_tracks_lifetime_indices():
+    log = _ErrorLog(max_kept=5, max_logged=0)
+    for i in range(3):
+        log.record(f"e{i}", "c")
+    start, new, nxt = log.entries_since(0)
+    assert (start, nxt) == (0, 3)
+    assert [m for m, _, _ in new] == ["e0", "e1", "e2"]
+    # poll again: nothing new
+    start, new, nxt = log.entries_since(nxt)
+    assert new == [] and nxt == 3
+    # fall behind more than the cap: the window reports the gap honestly
+    for i in range(3, 20):
+        log.record(f"e{i}", "c")
+    start, new, nxt = log.entries_since(3)
+    assert start == 15  # e3..e14 fell off the ring
+    assert [m for m, _, _ in new] == [f"e{i}" for i in range(15, 20)]
+    assert nxt == 20
+
+
+def test_error_log_table_polls_past_the_cap():
+    """The live error-log source keeps emitting after 1000+ lifetime
+    entries (used to freeze: entries stopped being appended at the cap)."""
+    from pathway_tpu.engine.error import ERROR_LOG
+    from pathway_tpu.internals.error_log_table import _ErrorLogSource
+
+    ERROR_LOG.clear()
+    try:
+        src = _ErrorLogSource(["message", "context"])
+        total_seen = 0
+        # three waves, far past the 1000-entry retention cap
+        for wave in range(3):
+            for i in range(600):
+                ERROR_LOG.record(f"w{wave}-{i}", "t")
+            deltas = src.poll()
+            rows = sum(len(d) for d in deltas)
+            total_seen += rows
+            assert rows == 600, (
+                f"wave {wave}: poll returned {rows} of 600 entries"
+            )
+            assert src.is_finished()
+        assert total_seen == 1800
+        # keys are collision-free across the whole lifetime
+    finally:
+        ERROR_LOG.clear()
+
+
+def test_lagging_poller_skips_evicted_entries_without_crashing():
+    from pathway_tpu.engine.error import ERROR_LOG
+    from pathway_tpu.internals.error_log_table import _ErrorLogSource
+
+    ERROR_LOG.clear()
+    try:
+        src = _ErrorLogSource(["message", "context"])
+        for i in range(2500):  # cap is 1000: oldest 1500 evicted
+            ERROR_LOG.record(f"m{i}", "t")
+        deltas = src.poll()
+        rows = sum(len(d) for d in deltas)
+        assert rows == 1000  # the retained window, newest entries
+        msgs = [m for d in deltas for m in d.data["message"].tolist()]
+        assert msgs[0] == "m1500" and msgs[-1] == "m2499"
+        assert src.is_finished()
+    finally:
+        ERROR_LOG.clear()
