@@ -21,7 +21,7 @@ import click
 
 from .internals.config import MAX_WORKERS
 
-__all__ = ["main", "spawn", "replay", "rescale", "trace"]
+__all__ = ["main", "spawn", "replay", "rescale", "top", "trace"]
 
 
 @click.group()
@@ -286,6 +286,43 @@ def replay(threads, processes, record_path, mode, continue_after_replay, program
     if continue_after_replay:
         env_extra["PATHWAY_CONTINUE_AFTER_REPLAY"] = "1"
     sys.exit(_spawn_processes(threads, processes, 10000, env_extra, program))
+
+
+@main.command()
+@click.option("--url", type=str, default=None,
+              help="full /query URL (overrides --host/--port)")
+@click.option("--host", type=str, default="127.0.0.1",
+              help="monitoring host of process 0")
+@click.option("--port", type=int, default=None,
+              help="monitoring port of process 0 (default "
+                   "PATHWAY_MONITORING_HTTP_PORT or 20000)")
+@click.option("-i", "--interval", type=float, default=1.0,
+              help="refresh interval in seconds")
+@click.option("--frames", type=int, default=0,
+              help="render N frames then exit (0 = run until ^C; "
+                   "used by tests/smokes)")
+@click.option("--no-clear", is_flag=True, default=False,
+              help="append frames instead of repainting (for logs/pipes)")
+def top(url, host, port, interval, frames, no_clear):
+    """Live cluster dashboard over the /query signals endpoint.
+
+    Shows per-worker tick rate, frontier lag, latency percentiles, comm
+    queue depth + send MB/s, the current bottleneck operator, and firing
+    SLO alerts. Point it at process 0 of a running pipeline (the merged
+    view): ``pathway-tpu top --port 20000``."""
+    from .observability.top import run_top
+
+    if url is None:
+        if port is None:
+            try:
+                port = int(
+                    os.environ.get("PATHWAY_MONITORING_HTTP_PORT", "20000")
+                )
+            except ValueError:
+                port = 20000
+        url = f"http://{host}:{port}/query"
+    sys.exit(run_top(url, interval_s=interval, frames=frames,
+                     clear=not no_clear))
 
 
 @main.group()

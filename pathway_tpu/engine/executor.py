@@ -60,6 +60,13 @@ class EngineStats:
         self.tick_duration = LogHistogram()
         #: commit-to-output latency, ns (histogram companion of latency_ms)
         self.latency_hist = LogHistogram()
+        #: end-to-end ingest→emit latency, ns: connector ingest stamp
+        #: (ConnectorSubject._emit wall time) to the tick that delivered
+        #: rows to a terminal output node — the signals plane's
+        #: user-visible latency distribution
+        self.e2e_latency_hist = LogHistogram()
+        #: last observed ingest→emit latency (gauge companion)
+        self.e2e_ms: float | None = None
         #: per-operator processing time, ns (fed with time_by_node)
         self.node_time_hist: dict[str, Any] = {}
         self._hist_factory = LogHistogram
@@ -93,6 +100,17 @@ class EngineStats:
         if hist is None:
             hist = self.node_time_hist[label] = self._hist_factory()
         hist.observe(ns)
+
+    def note_e2e(self, ingest_ns: int) -> None:
+        """Record one ingest→emit observation: rows stamped at connector
+        ingest time ``ingest_ns`` just reached a terminal output node."""
+        import time as _time
+
+        lat_ns = _time.time_ns() - int(ingest_ns)
+        if lat_ns < 0:  # clock skew guard (stamps come from this host)
+            lat_ns = 0
+        self.e2e_latency_hist.observe(lat_ns)
+        self.e2e_ms = lat_ns / 1e6
 
     def note_exchange(self, rows_out: int, rows_in: int) -> None:
         self.exchange_batches += 1
@@ -240,6 +258,15 @@ class Node:
 
     def __repr__(self) -> str:
         return f"<{type(self).__name__} #{self.node_id} cols={self.column_names}>"
+
+
+def _min_stamp(a: "int | None", b: "int | None") -> "int | None":
+    """Oldest of two optional ingest stamps (ns)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return min(a, b)
 
 
 def _mask_keys(key_mask, keys) -> np.ndarray:
@@ -419,6 +446,14 @@ class RealtimeSource(SourceNode):
         delta is committed at its own fresh timestamp (a commit tick)."""
         return []
 
+    def take_ingest_stamps(self) -> list["int | None"]:
+        """Ingest wall-time stamps (ns) aligned 1:1 with the deltas the
+        last ``poll()`` returned — when the connector actually received
+        each batch's oldest row. Feeds the ingest→emit latency histogram
+        (EngineStats.e2e_latency_hist); sources without stamping return
+        ``[]`` and their ticks simply don't observe."""
+        return []
+
     def is_finished(self) -> bool:
         return False
 
@@ -559,6 +594,10 @@ class Executor:
             armed.tick_fault(self.ctx.worker_id) if armed is not None else None
         )
         self._tick_seq = 0
+        #: ingest wall-time (ns) of the oldest row feeding the NEXT tick
+        #: (set by the streaming loops from connector stamps); consumed
+        #: and cleared by _tick to observe ingest→emit latency
+        self._next_tick_ingest_ns: int | None = None
         for node in self.nodes:
             # Exchange nodes report per-tick sent/received row counts into
             # the worker's stats (backpressure signals on /metrics)
@@ -715,13 +754,21 @@ class Executor:
                 # each commit batch of a source gets its own timestamp;
                 # batch j of every source shares round j's tick
                 rounds: list[list[tuple[SourceNode, Delta]]] = []
+                ingest: list[int | None] = []
                 for src in realtime:
-                    for j, delta in enumerate(src.poll()):
+                    deltas = src.poll()
+                    stamps = src.take_ingest_stamps()
+                    for j, delta in enumerate(deltas):
                         if delta is None or not len(delta):
                             continue
                         while len(rounds) <= j:
                             rounds.append([])
+                            ingest.append(None)
                         rounds[j].append((src, delta))
+                        ingest[j] = _min_stamp(
+                            ingest[j],
+                            stamps[j] if j < len(stamps) else None,
+                        )
                 if rounds:
                     for j, emissions in enumerate(rounds):
                         # even wall-clock ms, strictly increasing (timestamp.rs)
@@ -731,6 +778,7 @@ class Executor:
                         # persist offsets covering rounds not yet recorded —
                         # only the cycle's last tick may commit
                         self._defer_commit = j < len(rounds) - 1
+                        self._next_tick_ingest_ns = ingest[j]
                         self._tick(clock, emissions)
                     self._defer_commit = False
                     if self.persistence is not None:
@@ -772,13 +820,20 @@ class Executor:
             while True:
                 self.stats.heartbeat()
                 rounds: list[list[tuple[SourceNode, Delta]]] = []
+                cycle_ingest: int | None = None
                 for src in owned:
-                    for j, delta in enumerate(src.poll()):
+                    deltas = src.poll()
+                    stamps = src.take_ingest_stamps()
+                    for j, delta in enumerate(deltas):
                         if delta is None or not len(delta):
                             continue
                         while len(rounds) <= j:
                             rounds.append([])
                         rounds[j].append((src, delta))
+                        cycle_ingest = _min_stamp(
+                            cycle_ingest,
+                            stamps[j] if j < len(stamps) else None,
+                        )
                 finished = all(src.is_finished() for src in owned)
                 wall = int(_time.time() * 1000) & ~1
                 want_commit = (
@@ -788,15 +843,23 @@ class Executor:
                 gathered = ctx.comm.allgather(
                     ("cycle", cycle), ctx.worker_id,
                     (len(rounds), finished, self._stop_requested, wall,
-                     want_commit),
+                     want_commit, cycle_ingest),
                 )
                 cycle += 1
                 n_rounds = max(p[0] for p in gathered)
                 agreed_wall = max(p[3] for p in gathered)
+                # oldest ingest stamp anywhere in the cluster this cycle:
+                # gathered rows cross workers inside the tick (BSP), so
+                # the sink worker needs the ORIGIN's stamp, not its own
+                agreed_ingest: int | None = None
+                for p in gathered:
+                    if len(p) > 5:  # mixed-version tolerance
+                        agreed_ingest = _min_stamp(agreed_ingest, p[5])
                 for j in range(n_rounds):
                     # identical on every worker: deterministic fn of the
                     # gathered payload and the shared tick history
                     clock = max(clock + 2, agreed_wall + 2 * j)
+                    self._next_tick_ingest_ns = agreed_ingest
                     self._tick(clock, rounds[j] if j < len(rounds) else [])
                 if n_rounds and self.persistence is not None:
                     # every drained round has now ticked: live source
@@ -961,6 +1024,9 @@ class Executor:
         # against a full topological sweep is noise, and it is the one
         # distribution that catches hot-path regressions unconditionally
         tick_t0 = _wall.perf_counter_ns()
+        ingest_ns = self._next_tick_ingest_ns
+        self._next_tick_ingest_ns = None
+        out_rows_before = self.stats.output_rows
         inbox: dict[int, dict[int, list[Delta]]] = {}
         seeded: dict[int, list[Delta]] = {}
         for src, delta in source_emissions:
@@ -1038,6 +1104,10 @@ class Executor:
                         node, _wall.perf_counter_ns() - node_t0
                     )
         self.stats.tick_duration.observe(_wall.perf_counter_ns() - tick_t0)
+        if ingest_ns is not None and self.stats.output_rows > out_rows_before:
+            # rows stamped at connector ingest reached a terminal output
+            # node within this sweep — one ingest→emit observation
+            self.stats.note_e2e(ingest_ns)
         self.stats.note_tick(time)
         for cb in self._on_time_end:
             cb(time)

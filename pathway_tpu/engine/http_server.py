@@ -13,6 +13,13 @@ Endpoints:
   per-worker labels (``observability/hub.py`` scrapes the peers).
 - ``/snapshot`` — this process's raw stats as JSON; what process 0
   scrapes from peers.
+- ``/query`` — windowed derived signals (rates, latency percentiles,
+  frontier lag, comm backpressure) from the in-process time-series
+  store (``observability/timeseries.py``); cluster-merged on process 0.
+  With params (``?expr=rate(engine_ticks)&window=10`` or
+  ``?metric=...&op=p95``) evaluates one expression.
+- ``/attribution`` — ranked per-operator bottleneck attribution.
+- ``/alerts`` — active + recent SLO alerts (``PATHWAY_SLO_RULES``).
 - ``/healthz`` — 200 while no executor thread is wedged, else 503.
 - ``/readyz`` — 200 once sources are connected and the first frontier
   advanced, else 503.
@@ -99,8 +106,16 @@ def start_http_server(
             self.end_headers()
             self.wfile.write(body)
 
+        def _reply_json(self, code: int, doc: Any) -> None:
+            self._reply(
+                code, json.dumps(doc).encode(), "application/json"
+            )
+
         def do_GET(self) -> None:  # noqa: N802 - http.server API
-            path = self.path.rstrip("/")
+            from urllib.parse import parse_qsl, urlparse
+
+            parsed = urlparse(self.path)
+            path = parsed.path.rstrip("/")
             if path in ("", "/metrics", "/status"):
                 self._reply(
                     200,
@@ -108,11 +123,36 @@ def start_http_server(
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
             elif path == "/snapshot":
-                self._reply(
-                    200,
-                    json.dumps(hub.snapshot_document()).encode(),
-                    "application/json",
-                )
+                self._reply_json(200, hub.snapshot_document())
+            elif path == "/query":
+                # windowed signals (observability/timeseries.py): the
+                # full derived document, or a targeted expr evaluation
+                # when query params are present
+                if hub.signals_plane is None:
+                    self._reply_json(
+                        503, {"error": "signals plane is not running"}
+                    )
+                    return
+                params = dict(parse_qsl(parsed.query))
+                try:
+                    doc = (
+                        hub.query_eval(params)
+                        if params
+                        else hub.query_document()
+                    )
+                except ValueError as e:
+                    self._reply_json(400, {"error": str(e)})
+                    return
+                self._reply_json(200, doc)
+            elif path == "/attribution":
+                if hub.signals_plane is None:
+                    self._reply_json(
+                        503, {"error": "signals plane is not running"}
+                    )
+                    return
+                self._reply_json(200, hub.attribution_view())
+            elif path == "/alerts":
+                self._reply_json(200, hub.alerts_view())
             elif path in ("/healthz", "/readyz"):
                 ok, detail = (
                     hub.health() if path == "/healthz" else hub.ready()

@@ -76,6 +76,11 @@ class GraphRunner:
                 stats.detailed = True
             if comm is not None:
                 hub.register_comm(comm)
+            # signals plane: windowed time-series sampling of every
+            # registered worker + comm backend, SLO rule evaluation, and
+            # the /query‖/attribution‖/alerts surface (observability/
+            # timeseries.py, slo.py) — lives and dies with the hub
+            hub.start_signals()
             try:
                 http_server, _ = start_http_server(hub)
             except OSError as e:
@@ -96,9 +101,11 @@ class GraphRunner:
         return http_server, flusher, hub
 
     @staticmethod
-    def _stop_observability(http_server, flusher) -> None:
+    def _stop_observability(http_server, flusher, hub=None) -> None:
         if flusher is not None:
             flusher.stop()
+        if hub is not None:
+            hub.close()  # signals sampler thread
         if http_server is not None:
             http_server.shutdown()
             http_server.server_close()
@@ -122,7 +129,7 @@ class GraphRunner:
         finally:
             if stop_dashboard is not None:
                 stop_dashboard()
-            self._stop_observability(http_server, flusher)
+            self._stop_observability(http_server, flusher, _hub)
             from .telemetry import export_from_env
             from .tracing import get_tracer
 
@@ -278,7 +285,7 @@ class GraphRunner:
                     for t in threads:
                         t.join()
             finally:
-                self._stop_observability(http_server, flusher)
+                self._stop_observability(http_server, flusher, _hub)
         finally:
             comm.close()
             for manager in managers:

@@ -361,10 +361,16 @@ class Tracer:
                 }
             )
         try:
-            with open(path, "w") as f:
+            # atomic rewrite: the periodic flusher rewrites this file every
+            # interval, and a SIGKILL mid-write must leave the PREVIOUS
+            # complete flush on disk, not a torn JSON — crashed runs are
+            # exactly the ones whose trace gets read
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
                 json.dump(
                     {"traceEvents": meta + events, "displayTimeUnit": "ms"}, f
                 )
+            os.replace(tmp, path)
         except (OSError, TypeError, ValueError) as e:
             import warnings
 

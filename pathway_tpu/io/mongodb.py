@@ -1,5 +1,13 @@
 """``pw.io.mongodb`` — MongoDB sink (reference Rust ``MongoWriter``,
-``src/connectors/data_storage.rs:2187``). Gated on ``pymongo``."""
+``src/connectors/data_storage.rs:2187``). Gated on ``pymongo``.
+
+Writes ride the columnar ``on_batch`` sink lane: each tick's
+consolidated delta becomes ONE ``insert_many`` (chunked to
+``max_batch_size`` when set) instead of a per-row ``insert_one`` — the
+reference writer batches by ``max_batch_size`` exactly this way, and a
+round-trip per row is the difference between a sink that keeps up with
+the engine and one that backpressures it.
+"""
 
 from __future__ import annotations
 
@@ -19,12 +27,18 @@ def write(table: Table, connection_string: str, database: str, collection: str,
     coll = client[database][collection]
     from . import subscribe
 
-    names = table.column_names()
+    def on_batch(time, delta):
+        names = list(delta.columns)
+        docs = []
+        for _key, row, diff in delta.iter_rows():
+            doc = dict(zip(names, row))
+            doc["time"] = time
+            doc["diff"] = 1 if diff > 0 else -1
+            docs.append(doc)
+        if not docs:
+            return
+        step = max_batch_size if max_batch_size and max_batch_size > 0 else len(docs)
+        for i in range(0, len(docs), step):
+            coll.insert_many(docs[i : i + step])
 
-    def on_change(key, row, time, is_addition):
-        doc = {n: row[n] for n in names}
-        doc["time"] = time
-        doc["diff"] = 1 if is_addition else -1
-        coll.insert_one(doc)
-
-    subscribe(table, on_change=on_change)
+    subscribe(table, on_batch=on_batch)

@@ -32,11 +32,15 @@ _DONE = object()
 
 
 class _Batch:
-    __slots__ = ("data", "diffs")
+    __slots__ = ("data", "diffs", "ingest_ns")
 
     def __init__(self, data: dict[str, Any], diffs: Any):
         self.data = data
         self.diffs = diffs
+        #: ingest wall-time stamp: when the connector handed these rows
+        #: to the engine — the ingest→emit latency anchor
+        #: (observability signals plane, EngineStats.e2e_latency_hist)
+        self.ingest_ns = _time.time_ns()
 
 
 class _SourceError:
@@ -63,6 +67,7 @@ class ConnectorSubject:
         self._buf: list = []
         self._buf_lock = threading.Lock()
         self._buf_flushed_at = 0.0
+        self._buf_t0_ns = 0
         #: set when the engine requests shutdown; long-running ``run`` loops
         #: must check ``self.stopped`` (the reference reader threads exit
         #: when the main loop drops the channel, src/connectors/mod.rs:427)
@@ -79,16 +84,20 @@ class ConnectorSubject:
         # job (_flush_stale, called from every poll)
         with self._buf_lock:
             buf = self._buf
+            if not buf:
+                # ingest stamp = when the chunk's FIRST row arrived (the
+                # oldest row bounds the batch's end-to-end latency)
+                self._buf_t0_ns = _time.time_ns()
             buf.append(entry)
             if len(buf) >= self._CHUNK:
-                self._queue.put(buf)
+                self._queue.put((self._buf_t0_ns, buf))
                 self._buf = []
                 self._buf_flushed_at = _time.monotonic()
 
     def _flush_rows(self) -> None:
         with self._buf_lock:
             if self._buf:
-                self._queue.put(self._buf)
+                self._queue.put((self._buf_t0_ns, self._buf))
                 self._buf = []
                 self._buf_flushed_at = _time.monotonic()
 
@@ -236,6 +245,11 @@ class PythonSubjectSource(RealtimeSource):
         #: deltas built within the current commit window (columnar batches +
         #: flushed row runs), concatenated into ONE delta per commit
         self._pending: list[Delta] = []
+        #: oldest ingest wall-time (ns) among rows in the open commit
+        #: window; per emitted delta it lands in _out_ingest, aligned
+        #: with poll()'s return (take_ingest_stamps drains it)
+        self._window_ingest_ns: int | None = None
+        self._out_ingest: list[int | None] = []
         self._last_flush = _time.monotonic()
         self._done = False
         self._thread: threading.Thread | None = None
@@ -410,6 +424,14 @@ class PythonSubjectSource(RealtimeSource):
             self._pending.append(self._make_delta(self._partial))
             self._partial = []
 
+    def _note_ingest(self, t0_ns: int | None) -> None:
+        if t0_ns:
+            if (
+                self._window_ingest_ns is None
+                or t0_ns < self._window_ingest_ns
+            ):
+                self._window_ingest_ns = t0_ns
+
     def _close_commit(self, out: list[Delta]) -> None:
         self._flush_partial()
         if self._pending:
@@ -421,6 +443,12 @@ class PythonSubjectSource(RealtimeSource):
                 else concat_deltas(self._pending, self.names)
             )
             self._pending = []
+            self._out_ingest.append(self._window_ingest_ns)
+        self._window_ingest_ns = None
+
+    def take_ingest_stamps(self) -> list[int | None]:
+        stamps, self._out_ingest = self._out_ingest, []
+        return stamps
 
     def poll(self) -> list[Delta]:
         # commitless sources (pure autocommit): rows the subject buffered
@@ -451,10 +479,13 @@ class PythonSubjectSource(RealtimeSource):
                 d = self._make_batch_delta(item)
                 if d is not None and len(d):
                     self._pending.append(d)
+                    self._note_ingest(item.ingest_ns)
                 continue
             # a chunk of buffered rows (ConnectorSubject._emit): one queue
-            # item per ~256 rows instead of one per row; entries keep their
-            # kwargs dicts — _make_delta extracts columns in bulk
+            # item per ~256 rows instead of one per row, stamped with the
+            # wall time its first row arrived; entries keep their kwargs
+            # dicts — _make_delta extracts columns in bulk
+            t0_ns, item = item
             if self._skip > 0:
                 # already persisted before restart; the restarted subject
                 # re-emits its deterministic prefix (reference
@@ -465,6 +496,7 @@ class PythonSubjectSource(RealtimeSource):
                 if not item:
                     continue
             self._partial.extend(item)
+            self._note_ingest(t0_ns)
         now = _time.monotonic()
         flush_due = (
             self.autocommit_ms is not None

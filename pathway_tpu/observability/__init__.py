@@ -18,12 +18,23 @@ The reference engine's telemetry pair — per-operator OTLP metrics
   into ``crash-<gen>-<proc>.json`` forensic bundles;
 - :mod:`trace_merge` — assembles per-process ``PATHWAY_TRACE_FILE``
   parts into one clock-aligned cluster timeline
-  (``pathway-tpu trace merge``).
+  (``pathway-tpu trace merge``);
+- :mod:`timeseries` — the signals plane: windowed in-process
+  time-series store over every EngineStats gauge/counter/histogram +
+  comm counters, with rate/delta/percentile/sustained queries
+  (``/query``, merged on process 0);
+- :mod:`attribution` — per-operator bottleneck attribution over the
+  signals window (``/attribution``, ``pathway_bottleneck_operator``);
+- :mod:`slo` — declarative SLO rules (``PATHWAY_SLO_RULES``) evaluated
+  against the store; alerts fan out to ``/alerts``, the trace stream
+  and the flight recorder;
+- :mod:`top` — the ``pathway-tpu top`` live terminal dashboard.
 
 The HTTP surface itself lives in ``engine/http_server.py``; instrumented
 state in ``engine/executor.EngineStats``.
 """
 
+from .attribution import attribution_document, bottleneck_operator
 from .exporter import PeriodicFlusher, start_periodic_flusher
 from .flightrecorder import FlightRecorder, get_recorder, harvest
 from .health import health_status, ready_status
@@ -34,16 +45,27 @@ from .prometheus import (
     parse_exposition,
     render_snapshots,
 )
+from .slo import AlertLog, Rule, SloEngine, load_rules
+from .timeseries import Signals, SignalsPlane, TimeSeriesStore
 
 __all__ = [
+    "AlertLog",
     "FlightRecorder",
     "LogHistogram",
     "ObservabilityHub",
     "PeriodicFlusher",
+    "Rule",
+    "Signals",
+    "SignalsPlane",
+    "SloEngine",
+    "TimeSeriesStore",
+    "attribution_document",
+    "bottleneck_operator",
     "get_recorder",
     "harvest",
     "escape_label_value",
     "health_status",
+    "load_rules",
     "merge_snapshots",
     "parse_exposition",
     "quantile_from_snapshot",

@@ -54,6 +54,10 @@ def stats_snapshot(stats: Any, worker_id: int = 0) -> dict:
         "exchange_batches": stats.exchange_batches,
         "tick_duration": stats.tick_duration.snapshot(),
         "latency_hist": stats.latency_hist.snapshot(),
+        "e2e_latency_hist": stats.e2e_latency_hist.snapshot()
+        if getattr(stats, "e2e_latency_hist", None) is not None
+        else None,
+        "e2e_ms": getattr(stats, "e2e_ms", None),
         "node_time_hist": {
             label: h.snapshot()
             for label, h in list(stats.node_time_hist.items())
@@ -82,6 +86,16 @@ class ObservabilityHub:
         self._comms: list[Any] = []
         self._lock = threading.Lock()
         self.scrape_errors = 0
+        #: windowed signal plane (observability/timeseries.py) — started
+        #: by start_signals() alongside the metrics endpoint; None until
+        #: then (tests building bare hubs pay nothing)
+        self.signals_plane: Any = None
+        #: last successful peer scrape per peer index: (unix time, doc).
+        #: A peer that stops answering is reported as STALE (last-seen
+        #: age per worker) instead of silently vanishing from the merged
+        #: view — the difference between "fleet shrank" and "fleet lost
+        #: a member" on one scrape.
+        self._peer_cache: dict[int, tuple[float, dict]] = {}
 
     @classmethod
     def from_config(cls, cfg: Any) -> "ObservabilityHub":
@@ -132,6 +146,67 @@ class ObservabilityHub:
         with self._lock:
             self._comms.append(comm)
 
+    # -- signals plane (windowed time-series + SLO rules) --------------
+
+    def start_signals(
+        self,
+        sample_s: float | None = None,
+        window_s: float | None = None,
+        slo_rules: str | None = None,
+    ) -> Any:
+        """Start the sampler thread + SLO engine over this hub's workers
+        (``PATHWAY_SIGNALS_SAMPLE_S`` / ``PATHWAY_SIGNALS_WINDOW_S`` /
+        ``PATHWAY_SLO_RULES`` fill unset arguments). Idempotent."""
+        if self.signals_plane is not None:
+            return self.signals_plane
+        import os
+
+        from .slo import SloEngine, load_rules
+        from .timeseries import (
+            DEFAULT_SAMPLE_S,
+            DEFAULT_WINDOW_S,
+            SignalsPlane,
+        )
+
+        if sample_s is None:
+            try:
+                sample_s = float(
+                    os.environ.get("PATHWAY_SIGNALS_SAMPLE_S", "")
+                    or DEFAULT_SAMPLE_S
+                )
+            except ValueError:
+                sample_s = DEFAULT_SAMPLE_S
+        if window_s is None:
+            try:
+                window_s = float(
+                    os.environ.get("PATHWAY_SIGNALS_WINDOW_S", "")
+                    or DEFAULT_WINDOW_S
+                )
+            except ValueError:
+                window_s = DEFAULT_WINDOW_S
+        if slo_rules is None:
+            slo_rules = os.environ.get("PATHWAY_SLO_RULES")
+        try:
+            rules = load_rules(slo_rules)
+        except ValueError as e:
+            import warnings
+
+            # a typo'd rules file must be loud — but telemetry still must
+            # not abort the run it observes
+            warnings.warn(str(e), RuntimeWarning)
+            rules = []
+        engine = SloEngine(
+            rules, default_window_s=window_s, process_id=self.process_id
+        )
+        self.signals_plane = SignalsPlane(
+            self, sample_s=sample_s, window_s=window_s, slo_engine=engine
+        ).start()
+        return self.signals_plane
+
+    def close(self) -> None:
+        if self.signals_plane is not None:
+            self.signals_plane.stop()
+
     @property
     def worker_stats(self) -> list[Any]:
         with self._lock:
@@ -181,7 +256,7 @@ class ObservabilityHub:
 
     def cluster_snapshots(
         self,
-    ) -> tuple[list[dict], dict[str, dict], dict[str, int]]:
+    ) -> tuple[list[dict], dict[str, dict], dict[str, int], dict[str, float]]:
         """Local snapshots plus every reachable peer's; comm stats keyed
         by process id; tracer drops per reporting process (a transiently
         unreachable peer is MISSING from the dict, so its metrics series
@@ -190,10 +265,14 @@ class ObservabilityHub:
         one timeout, not N (a partial outage is exactly when the merged
         view must still answer inside Prometheus's scrape deadline);
         unreachable peers count in ``scrape_errors`` and the view stays
-        partial rather than failing."""
+        partial rather than failing. The fourth element maps worker id →
+        last-seen age (s) for workers whose peer stopped answering but
+        answered before — rendered as ``pathway_worker_last_seen_seconds``
+        so a dead peer reads as STALE, not as a smaller fleet."""
         snapshots = self.local_snapshots()
         comm_stats = {str(self.process_id): self.comm_snapshot()}
         trace_dropped: dict[str, int] = {}
+        stale: dict[str, float] = {}
         local_dropped = self._local_trace_dropped()
         if local_dropped is not None:
             trace_dropped[str(self.process_id)] = local_dropped
@@ -211,10 +290,17 @@ class ObservabilityHub:
         deadline = time.monotonic() + _SCRAPE_TIMEOUT_S + 0.5
         for t in threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
-        for doc in results:
+        now = time.time()
+        for i, doc in enumerate(results):
             if doc is None:
                 self.scrape_errors += 1
+                cached = self._peer_cache.get(i)
+                if cached is not None:
+                    seen_at, seen_doc = cached
+                    for w in seen_doc.get("workers", []):
+                        stale[str(w.get("worker", "?"))] = now - seen_at
                 continue
+            self._peer_cache[i] = (now, doc)
             snapshots.extend(doc.get("workers", []))
             comm_stats[str(doc.get("process_id", "?"))] = doc.get("comm", {})
             peer_dropped = doc.get("trace_dropped")
@@ -223,7 +309,7 @@ class ObservabilityHub:
                     peer_dropped
                 )
         snapshots.sort(key=lambda s: s.get("worker", 0))
-        return snapshots, comm_stats, trace_dropped
+        return snapshots, comm_stats, trace_dropped, stale
 
     @staticmethod
     def _scrape_peer(host: str, port: int) -> dict | None:
@@ -237,14 +323,250 @@ class ObservabilityHub:
         except Exception:
             return None
 
+    @staticmethod
+    def _scrape_peer_path(host: str, port: int, path: str) -> dict | None:
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                f"http://{host}:{port}{path}", timeout=_SCRAPE_TIMEOUT_S
+            ) as r:
+                return json.loads(r.read().decode())
+        except Exception:
+            return None
+
+    def _scrape_peers_path(self, path: str) -> list[dict]:
+        """Concurrently fetch ``path`` from every peer (same discipline
+        as cluster_snapshots: N hung peers cost one timeout)."""
+        results: list[dict | None] = [None] * len(self.peer_http)
+
+        def fetch(i: int, host: str, port: int) -> None:
+            results[i] = self._scrape_peer_path(host, port, path)
+
+        threads = [
+            threading.Thread(target=fetch, args=(i, h, p), daemon=True)
+            for i, (h, p) in enumerate(self.peer_http)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + _SCRAPE_TIMEOUT_S + 0.5
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        return [doc for doc in results if doc is not None]
+
+    # -- windowed signal queries (/query, /attribution, /alerts) -------
+
+    def local_query_document(self) -> dict:
+        """This process's windowed-signals view: per-worker rates +
+        latency percentiles over the window, comm derivations, operator
+        attribution, and the alert log — the ``/query`` payload a peer
+        serves, and the exact document the autoscaler will consume."""
+        plane = self.signals_plane
+        doc: dict = {
+            "process_id": self.process_id,
+            "t": time.time(),
+            "signals": plane is not None,
+        }
+        if plane is None:
+            return doc
+        sig, w = plane.signals, plane.window_s
+        doc["sample_s"] = plane.sample_s
+        doc["window_s"] = w
+        doc["samples"] = plane.samples_taken
+        workers: dict[str, dict] = {}
+        for wid in sig.store.workers():
+            entry: dict = {
+                "tick_rate": sig.rate("engine_ticks", w, wid),
+                "row_rate": sig.rate("rows_total", w, wid),
+                "input_rate": sig.rate("input_rows", w, wid),
+                "output_rate": sig.rate("output_rows", w, wid),
+                "last_time": sig.last("last_time", wid),
+                "latency_ms": sig.last("latency_ms", wid),
+                "frontier_lag_ms": sig.last("frontier_lag_ms", wid),
+            }
+            for q in ("p50", "p95", "p99"):
+                entry[f"tick_{q}_ms"] = sig.eval(
+                    f"{q}(tick_duration)", w, wid
+                )
+                entry[f"e2e_{q}_ms"] = sig.eval(f"{q}(e2e_latency)", w, wid)
+            # the headline windowed series, raw points included so `top`
+            # and the autoscaler see trends, not just scalars
+            entry["series"] = {
+                "frontier_lag_ms": sig.store.points(
+                    "frontier_lag_ms", wid, w
+                ),
+            }
+            workers[str(wid)] = entry
+        doc["workers"] = workers
+        comm: dict[str, float | None] = {}
+        for metric in sig.store.metrics(None):
+            if not metric.startswith("comm."):
+                continue
+            key = metric[len("comm."):]
+            comm[key] = sig.last(metric, None)
+            if key.endswith(("_total", "_sent", "_received")):
+                comm[key + "_rate"] = sig.rate(metric, w, None)
+        sent_rate = sig.rate("comm.cluster_bytes_sent", w, None)
+        if sent_rate is not None:
+            comm["send_mb_per_sec"] = round(sent_rate / 1e6, 3)
+        if "send_queue_depth" in comm:
+            comm["send_queue_depth_series"] = sig.store.points(
+                "comm.send_queue_depth", None, w
+            )
+        doc["comm"] = comm
+        from .attribution import attribution_document
+
+        doc["attribution"] = attribution_document(sig, w)
+        doc["alerts"] = (
+            plane.slo.alerts.document()
+            if plane.slo is not None
+            else {"active": [], "history": [], "fired_total": {}}
+        )
+        return doc
+
+    def query_document(self) -> dict:
+        """The merged ``/query`` view: process 0 scrapes every peer's
+        ``/query`` and merges — same pull direction as the /snapshot
+        roll-up, so a peer stuck in a collective still gets queried.
+        Adds cross-worker frontier lag (worker's logical time vs the most
+        advanced worker's) which no single process can compute alone."""
+        local = self.local_query_document()
+        if not self.peer_http:
+            merged = dict(local)
+            merged["processes"] = [self.process_id]
+            self._add_cluster_lag(merged)
+            return merged
+        peer_docs = self._scrape_peers_path("/query")
+        merged = dict(local)
+        merged["workers"] = dict(local.get("workers", {}))
+        merged["comm"] = {str(self.process_id): local.get("comm", {})}
+        merged["alerts"] = {
+            "active": list(local.get("alerts", {}).get("active", [])),
+            "history": list(local.get("alerts", {}).get("history", [])),
+            "fired_total": dict(
+                local.get("alerts", {}).get("fired_total", {})
+            ),
+        }
+        processes = [self.process_id]
+        attributions = [local.get("attribution")]
+        for doc in peer_docs:
+            pid = doc.get("process_id", "?")
+            processes.append(pid)
+            merged["workers"].update(doc.get("workers", {}))
+            merged["comm"][str(pid)] = doc.get("comm", {})
+            alerts = doc.get("alerts", {})
+            merged["alerts"]["active"].extend(alerts.get("active", []))
+            merged["alerts"]["history"].extend(alerts.get("history", []))
+            for sev, n in alerts.get("fired_total", {}).items():
+                merged["alerts"]["fired_total"][sev] = (
+                    merged["alerts"]["fired_total"].get(sev, 0) + int(n)
+                )
+            attributions.append(doc.get("attribution"))
+        merged["alerts"]["active"].sort(key=lambda e: e.get("t", 0))
+        merged["alerts"]["history"].sort(key=lambda e: e.get("t", 0))
+        from .attribution import merge_attribution_documents
+
+        merged["processes"] = processes
+        merged["attribution"] = merge_attribution_documents(attributions)
+        self._add_cluster_lag(merged)
+        return merged
+
+    @staticmethod
+    def _add_cluster_lag(doc: dict) -> None:
+        """Per-worker frontier lag vs the most advanced worker in the
+        (merged) view — the PR-1 backpressure gauge, windowed."""
+        workers = doc.get("workers", {})
+        times = [
+            w.get("last_time")
+            for w in workers.values()
+            if w.get("last_time")
+        ]
+        if not times:
+            return
+        frontier = max(times)
+        for w in workers.values():
+            lt = w.get("last_time")
+            w["frontier_lag_vs_max_ms"] = (
+                max(0.0, frontier - lt) if lt else None
+            )
+
+    def query_eval(self, params: dict) -> dict:
+        """Targeted query: ``/query?expr=rate(engine_ticks)&window=10``
+        or ``?metric=tick_duration&op=p95[&worker=0]``. Returns the
+        scalar plus the raw windowed points behind it."""
+        plane = self.signals_plane
+        if plane is None:
+            raise ValueError("signals plane is not running")
+        sig = plane.signals
+        expr = params.get("expr")
+        if not expr:
+            metric = params.get("metric")
+            if not metric:
+                raise ValueError("pass expr=op(metric) or metric=...&op=...")
+            expr = f"{params.get('op', 'last')}({metric})"
+        try:
+            window = float(params.get("window", plane.window_s))
+        except ValueError:
+            raise ValueError(f"bad window {params.get('window')!r}")
+        worker_s = params.get("worker")
+        metric_name = expr
+        if expr.endswith(")") and "(" in expr:
+            metric_name = expr.partition("(")[2][:-1].strip()
+        if worker_s is None:
+            value, worker = sig.eval_worst(expr, window)
+        else:
+            worker = int(worker_s)
+            value = sig.eval(expr, window, worker)
+        points = sig.store.points(metric_name, worker, window)
+        if not points and worker is not None:
+            points = sig.store.points(metric_name, None, window)
+        return {
+            "expr": expr,
+            "window_s": window,
+            "worker": worker,
+            "value": value,
+            "points": points,
+        }
+
+    def attribution_view(self) -> dict:
+        """The ``/attribution`` payload (cluster-merged on process 0)."""
+        doc = self.query_document()
+        att = doc.get("attribution") or {"ranked": [], "bottleneck": None}
+        att["processes"] = doc.get("processes", [self.process_id])
+        return att
+
+    def alerts_view(self) -> dict:
+        """The ``/alerts`` payload (cluster-merged on process 0)."""
+        plane = self.signals_plane
+        local = (
+            plane.slo.alerts.document()
+            if plane is not None and plane.slo is not None
+            else {"active": [], "history": [], "fired_total": {}}
+        )
+        if not self.peer_http:
+            return local
+        for doc in self._scrape_peers_path("/alerts"):
+            local["active"] = local["active"] + doc.get("active", [])
+            local["history"] = local["history"] + doc.get("history", [])
+            for sev, n in doc.get("fired_total", {}).items():
+                local["fired_total"][sev] = (
+                    local["fired_total"].get(sev, 0) + int(n)
+                )
+        local["active"].sort(key=lambda e: e.get("t", 0))
+        local["history"].sort(key=lambda e: e.get("t", 0))
+        return local
+
     # -- rendering + probes --------------------------------------------
 
     def render_metrics(self) -> str:
         from .prometheus import render_snapshots
 
         trace_dropped: int | dict[str, int] | None
+        stale: dict[str, float] | None = None
         if self.peer_http:
-            snapshots, comm_stats, dropped_by_proc = self.cluster_snapshots()
+            snapshots, comm_stats, dropped_by_proc, stale = (
+                self.cluster_snapshots()
+            )
             # per-process labels, like the comm gauges: series identity
             # stays stable when a peer scrape transiently fails
             trace_dropped = dropped_by_proc or None
@@ -266,6 +588,23 @@ class ObservabilityHub:
         # scraped peer — must be distinguishable from a quiet one (0
         # renders too, as the explicit "nothing dropped" signal); None
         # only when no process traces
+        bottleneck = None
+        alerts_fired = None
+        alerts_active = None
+        plane = self.signals_plane
+        if plane is not None:
+            from .attribution import bottleneck_operator
+
+            try:
+                bottleneck = bottleneck_operator(
+                    plane.signals, plane.window_s
+                )
+            except Exception:
+                bottleneck = None
+            if plane.slo is not None and plane.slo.rules:
+                alert_doc = plane.slo.alerts.document()
+                alerts_fired = alert_doc["fired_total"] or None
+                alerts_active = len(alert_doc["active"])
         return render_snapshots(
             snapshots,
             comm_stats,
@@ -273,6 +612,10 @@ class ObservabilityHub:
             worker_labels=True if cluster else None,
             supervisor=self._supervisor_snapshot(),
             trace_dropped=trace_dropped,
+            stale_workers=stale or None,
+            bottleneck=bottleneck,
+            alerts_fired=alerts_fired,
+            alerts_active=alerts_active,
         )
 
     @staticmethod

@@ -112,6 +112,10 @@ def render_snapshots(
     worker_labels: bool | None = None,
     supervisor: dict | None = None,
     trace_dropped: int | dict[str, int] | None = None,
+    stale_workers: dict[str, float] | None = None,
+    bottleneck: str | None = None,
+    alerts_fired: dict[str, int] | None = None,
+    alerts_active: int | None = None,
 ) -> str:
     """Exposition text for a set of worker stats snapshots.
 
@@ -177,6 +181,11 @@ def render_snapshots(
         if s.get("latency_hist") and s["latency_hist"]["count"]:
             render_histogram(r, "pathway_output_latency_seconds",
                              s["latency_hist"], lab)
+        if s.get("e2e_latency_hist") and s["e2e_latency_hist"]["count"]:
+            # connector-ingest → output-emit latency (end-to-end through
+            # the dataflow, stamped by the connectors)
+            render_histogram(r, "pathway_ingest_to_emit_seconds",
+                             s["e2e_latency_hist"], lab)
         for op, hsnap in sorted(s.get("node_time_hist", {}).items()):
             render_histogram(
                 r, "pathway_operator_processing_seconds", hsnap,
@@ -193,6 +202,31 @@ def render_snapshots(
             kind = "counter" if key.endswith("_total") else "gauge"
             r.add(f"pathway_comm_{key}", kind, value, plab)
     r.add("pathway_cluster_workers", "gauge", len(snapshots))
+    if stale_workers:
+        # a peer whose /snapshot scrape failed: its workers are reported
+        # as STALE (last-seen age from the roll-up's cache) instead of
+        # silently vanishing from the merged view
+        for wid, age in sorted(stale_workers.items()):
+            r.add(
+                "pathway_worker_last_seen_seconds", "gauge",
+                round(float(age), 3), {"worker": str(wid)},
+            )
+        r.add("pathway_cluster_stale_workers", "gauge", len(stale_workers))
+    if bottleneck:
+        # info-style gauge: which operator currently owns the largest
+        # share of windowed tick processing time (signals plane)
+        r.add(
+            "pathway_bottleneck_operator", "gauge", 1,
+            {"operator": str(bottleneck)},
+        )
+    if alerts_fired:
+        for sev, n in sorted(alerts_fired.items()):
+            r.add(
+                "pathway_alerts_fired_total", "counter", int(n),
+                {"severity": str(sev)},
+            )
+    if alerts_active is not None:
+        r.add("pathway_alerts_active", "gauge", int(alerts_active))
     if scrape_errors:
         r.add("pathway_cluster_scrape_errors", "counter", scrape_errors)
     if trace_dropped is not None:

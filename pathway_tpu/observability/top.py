@@ -1,0 +1,151 @@
+"""``pathway-tpu top`` — live terminal dashboard over ``/query``.
+
+Polls the hub's merged windowed-signals endpoint (process 0 under
+``spawn -n M``) and redraws a plain-text dashboard: per-worker tick
+rate, row rates, frontier lag, tick/e2e latency percentiles, comm queue
+depth + send MB/s, the current bottleneck operator, and firing alerts.
+Plain ANSI redraw (no curses dependency): each frame repaints from the
+home position, so it works in every terminal the test rig has — and
+:func:`render_frame` is a pure function of the ``/query`` document, so
+tests and the signals smoke assert rendering without a TTY.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.request
+from typing import Any
+
+__all__ = ["fetch_query", "render_frame", "run_top"]
+
+_CLEAR = "\x1b[H\x1b[2J"
+
+
+def fetch_query(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _fmt(v: Any, unit: str = "", nd: int = 1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}{unit}"
+    return f"{v}{unit}"
+
+
+def render_frame(doc: dict, now: float | None = None) -> str:
+    """One dashboard frame from a ``/query`` document."""
+    if now is None:
+        now = time.time()
+    lines: list[str] = []
+    procs = doc.get("processes", [doc.get("process_id", 0)])
+    lines.append(
+        f"pathway-tpu top — {len(doc.get('workers', {}))} worker(s), "
+        f"{len(procs)} process(es), window {_fmt(doc.get('window_s'), 's')}"
+        f", sampled every {_fmt(doc.get('sample_s'), 's')}"
+    )
+    lines.append("")
+    header = (
+        f"{'WORKER':>6} {'TICK/S':>8} {'ROWS/S':>10} {'OUT/S':>10} "
+        f"{'LAG MS':>9} {'TICK P95':>9} {'E2E P95':>9}"
+    )
+    lines.append(header)
+    workers = doc.get("workers", {})
+    for wid in sorted(workers, key=lambda w: int(w) if w.isdigit() else 0):
+        w = workers[wid]
+        lag = w.get("frontier_lag_vs_max_ms")
+        if lag is None:
+            lag = w.get("frontier_lag_ms")
+        lines.append(
+            f"{wid:>6} {_fmt(w.get('tick_rate')):>8} "
+            f"{_fmt(w.get('row_rate')):>10} "
+            f"{_fmt(w.get('output_rate')):>10} "
+            f"{_fmt(lag):>9} "
+            f"{_fmt(w.get('tick_p95_ms'), nd=2):>9} "
+            f"{_fmt(w.get('e2e_p95_ms'), nd=2):>9}"
+        )
+    if not workers:
+        lines.append("  (no worker series yet — sampler warming up)")
+    lines.append("")
+    comm = doc.get("comm", {})
+    # merged docs key comm by process; single-process docs are flat
+    comm_by_proc = (
+        comm
+        if comm and all(isinstance(v, dict) for v in comm.values())
+        else {str(doc.get("process_id", 0)): comm}
+    )
+    for proc in sorted(comm_by_proc):
+        c = comm_by_proc[proc] or {}
+        if not c:
+            continue
+        lines.append(
+            f"comm p{proc}: send queue {_fmt(c.get('send_queue_depth'), nd=0)}"
+            f" frames, {_fmt(c.get('send_mb_per_sec'), ' MB/s', 2)}, "
+            f"inbox {_fmt(c.get('cluster_inbox_depth'), nd=0)}"
+        )
+    att = doc.get("attribution") or {}
+    bottleneck = att.get("bottleneck")
+    if bottleneck:
+        ranked = att.get("ranked", [])
+        share = ranked[0].get("share") if ranked else None
+        lines.append(
+            f"bottleneck: {bottleneck}"
+            + (f" ({share * 100:.0f}% of busy time)" if share else "")
+        )
+    alerts = doc.get("alerts", {}) or {}
+    active = alerts.get("active", [])
+    if active:
+        lines.append("")
+        lines.append(f"ALERTS ({len(active)} firing):")
+        for ev in active[-8:]:
+            age = max(0.0, now - ev.get("t", now))
+            lines.append(
+                f"  [{ev.get('severity', '?'):>8}] {ev.get('rule')}: "
+                f"{ev.get('expr')} {ev.get('op')} {ev.get('threshold')} "
+                f"(value {_fmt(ev.get('value'), nd=3)}, {age:.0f}s ago)"
+            )
+    else:
+        lines.append("alerts: none firing")
+    return "\n".join(lines) + "\n"
+
+
+def run_top(
+    url: str,
+    interval_s: float = 1.0,
+    frames: int = 0,
+    clear: bool = True,
+    out=None,
+) -> int:
+    """Poll ``url`` and redraw; ``frames=0`` runs until interrupted.
+    Returns a process exit code (0 on success, 1 when the endpoint never
+    answered)."""
+    out = out or sys.stdout
+    drawn = 0
+    ok = False
+    while True:
+        try:
+            doc = fetch_query(url)
+        except Exception as e:
+            out.write(f"pathway-tpu top: {url} unreachable ({e})\n")
+            out.flush()
+            if frames and drawn + 1 >= frames:
+                return 0 if ok else 1
+            drawn += 1
+            time.sleep(interval_s)
+            continue
+        ok = True
+        frame = render_frame(doc)
+        if clear:
+            out.write(_CLEAR)
+        out.write(frame)
+        out.flush()
+        drawn += 1
+        if frames and drawn >= frames:
+            return 0
+        try:
+            time.sleep(interval_s)
+        except KeyboardInterrupt:  # pragma: no cover — interactive exit
+            return 0

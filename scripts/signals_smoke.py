@@ -1,0 +1,378 @@
+"""Signals-plane smoke test: 2-process run, slow operator, live queries.
+
+Runs a two-process sharded pipeline with a deliberately slow operator (a
+per-row UDF that sleeps — the AST-lifter refuses impure callables, so it
+stays on the per-row path and dominates tick time) and validates the
+whole signals plane end to end against process 0's merged endpoints:
+
+- ``/query`` serves windowed derived series: tick rate + tick-latency
+  percentiles, ingest→emit percentiles, frontier lag (with raw points),
+  and comm send-queue depth for both processes;
+- a targeted ``/query?metric=tick_duration&op=p95`` evaluation answers
+  with the scalar and the points behind it;
+- ``/attribution`` ranks the slow operator first;
+- a seeded sustained-threshold SLO rule (``PATHWAY_SLO_RULES``) fires
+  EXACTLY once on each process — visible on ``/alerts``, in the trace
+  stream, and (after a SIGKILL) in the crash bundle harvested from the
+  dead process's flight-recorder ring;
+- ``pathway-tpu top`` renders a live frame without errors.
+
+Usable standalone (``python scripts/signals_smoke.py`` → exit 0/1) and
+as a tier-1 test (``tests/test_signals_smoke.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_PROGRAM = """
+import time
+
+import pathway_tpu as pw
+
+
+class S(pw.io.python.ConnectorSubject):
+    def run(self):
+        i = 0
+        # emit until the engine tears the run down (the smoke decides
+        # when by killing the processes)
+        while not self.stopped and i < 100_000:
+            self.next(x=i)
+            self.commit()
+            i += 1
+            time.sleep(0.01)
+
+
+def crawl(x):
+    # deliberately slow AND impure: the lifter refuses it, so every row
+    # pays the sleep on the per-row path — the seeded bottleneck
+    time.sleep(0.004)
+    return x + 1
+
+
+t = pw.io.python.read(
+    S(), schema=pw.schema_from_types(x=int), name="rows",
+    autocommit_ms=None,
+)
+slow = t.select(y=pw.apply(crawl, pw.this.x))
+counts = slow.groupby(pw.this.y % 5).reduce(
+    s=pw.reducers.sum(pw.this.y), n=pw.reducers.count()
+)
+pw.io.subscribe(counts, on_change=lambda **kw: None)
+pw.run(with_http_server=True)
+"""
+
+#: sustained-threshold rule the run must trip: the slow operator pushes
+#: worker ticks way past 2 ms p95, continuously, for over for_s seconds
+SLO_RULES = {
+    "rules": [
+        {
+            "name": "slow-tick",
+            "expr": "p95(tick_duration_ms)",
+            "op": ">",
+            "threshold": 2.0,
+            "for_s": 0.6,
+            "severity": "critical",
+        }
+    ]
+}
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get_json(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _poll(predicate, timeout_s: float, what: str, interval: float = 0.3):
+    """Poll until predicate() returns a truthy value (returned) or raise."""
+    deadline = time.monotonic() + timeout_s
+    last_exc: BaseException | None = None
+    while time.monotonic() < deadline:
+        try:
+            value = predicate()
+            if value:
+                return value
+        except BaseException as e:  # noqa: BLE001 — endpoint warming up
+            last_exc = e
+        time.sleep(interval)
+    raise AssertionError(
+        f"timed out after {timeout_s}s waiting for {what}"
+        + (f" (last error: {last_exc!r})" if last_exc else "")
+    )
+
+
+def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
+    tmp = workdir or tempfile.mkdtemp(prefix="signals_smoke_")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prog = os.path.join(tmp, "slowprog.py")
+    with open(prog, "w") as f:
+        f.write(textwrap.dedent(_PROGRAM))
+    http_base = _free_port()
+    flight = os.path.join(tmp, "flight")
+    trace_base = os.path.join(tmp, "trace.json")
+    run_id = "signalsmoke01"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo_root,
+        "PATHWAY_THREADS": "1",
+        "PATHWAY_PROCESSES": "2",
+        "PATHWAY_FIRST_PORT": str(_free_port()),
+        "PATHWAY_MONITORING_HTTP_PORT": str(http_base),
+        "PATHWAY_SIGNALS_SAMPLE_S": "0.1",
+        "PATHWAY_SIGNALS_WINDOW_S": "30",
+        "PATHWAY_SLO_RULES": json.dumps(SLO_RULES),
+        "PATHWAY_FLIGHT_DIR": flight,
+        "PATHWAY_RUN_ID": run_id,
+        "PATHWAY_TRACE_FILE": trace_base,
+        # the periodic flusher rewrites the trace file every 0.3 s, so
+        # the SIGKILL'd process still leaves its alert span on disk
+        "PATHWAY_TELEMETRY_FLUSH_S": "0.3",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, prog],
+            env={**env, "PATHWAY_PROCESS_ID": str(pid)},
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    base = f"http://127.0.0.1:{http_base}"
+    report: dict = {}
+    try:
+        # -- /query: windowed series for tick latency, frontier lag, comm
+        def query_ready():
+            doc = _get_json(base + "/query")
+            workers = doc.get("workers", {})
+            if set(workers) != {"0", "1"}:
+                return None
+            w0 = workers["0"]
+            if w0.get("tick_p95_ms") is None:
+                return None
+            if w0.get("tick_rate") is None:  # needs >= 2 samples
+                return None
+            if w0.get("frontier_lag_ms") is None:
+                return None
+            if len(w0.get("series", {}).get("frontier_lag_ms", [])) < 2:
+                return None
+            comm = doc.get("comm", {})
+            c0 = comm.get("0", comm)
+            if c0.get("send_queue_depth") is None:
+                return None
+            return doc
+
+        doc = _poll(query_ready, 60, "merged /query with both workers")
+        w0 = doc["workers"]["0"]
+        assert w0["tick_rate"] and w0["tick_rate"] > 0, w0
+        # the slow operator sleeps 4 ms per row: worker 0's tick p95 must
+        # sit well above it
+        assert w0["tick_p95_ms"] > 2.0, w0["tick_p95_ms"]
+        assert w0["e2e_p95_ms"] is not None and w0["e2e_p95_ms"] > 0, w0
+        assert len(w0["series"]["frontier_lag_ms"]) >= 2, (
+            "frontier lag series has no window"
+        )
+        assert "frontier_lag_vs_max_ms" in w0
+        report["query"] = {
+            "tick_rate": w0["tick_rate"],
+            "tick_p95_ms": w0["tick_p95_ms"],
+            "e2e_p95_ms": w0["e2e_p95_ms"],
+        }
+
+        # -- targeted evaluation
+        targeted = _get_json(
+            base + "/query?metric=tick_duration&op=p95&window=10&worker=0"
+        )
+        assert targeted["value"] is not None and targeted["value"] > 2.0, (
+            targeted
+        )
+        assert len(targeted["points"]) >= 2, targeted
+
+        # -- /attribution ranks the slow operator first
+        def attribution_ready():
+            att = _get_json(base + "/attribution")
+            ranked = att.get("ranked", [])
+            return att if ranked and att.get("bottleneck") else None
+
+        att = _poll(attribution_ready, 30, "attribution ranking")
+        top_op = att["ranked"][0]["operator"]
+        assert top_op.startswith("Rowwise"), (
+            f"expected the slow Rowwise UDF ranked first, got {top_op!r} "
+            f"(ranked: {[d['operator'] for d in att['ranked'][:4]]})"
+        )
+        assert att["bottleneck"] == top_op
+        assert att["ranked"][0]["share"] > 0.5, att["ranked"][0]
+        report["attribution"] = {
+            "bottleneck": top_op, "share": att["ranked"][0]["share"],
+        }
+
+        # -- the SLO rule fires (sustained p95 breach), exactly once per
+        # process engine
+        def alert_firing():
+            alerts = _get_json(base + "/alerts")
+            active = [
+                e for e in alerts.get("active", [])
+                if e["rule"] == "slow-tick"
+            ]
+            return alerts if active else None
+
+        alerts = _poll(alert_firing, 30, "slow-tick SLO alert firing")
+        p0_firing = [
+            e for e in alerts["history"]
+            if e["rule"] == "slow-tick" and e["state"] == "firing"
+            and e.get("process") == 0
+        ]
+        assert len(p0_firing) == 1, (
+            f"rule must fire exactly once while breaching, fired "
+            f"{len(p0_firing)}x: {p0_firing}"
+        )
+        assert p0_firing[0]["severity"] == "critical"
+        # still exactly once after more sustained breach time
+        time.sleep(1.5)
+        alerts2 = _get_json(base + "/alerts")
+        p0_firing2 = [
+            e for e in alerts2["history"]
+            if e["rule"] == "slow-tick" and e["state"] == "firing"
+            and e.get("process") == 0
+        ]
+        assert len(p0_firing2) == 1, "alert re-fired while still active"
+        # the alert also rides /metrics
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+        assert "pathway_alerts_fired_total" in metrics
+        assert "pathway_bottleneck_operator" in metrics
+        report["alerts"] = {"fired": 1}
+
+        # -- pathway-tpu top renders a live frame without errors
+        top = subprocess.run(
+            [
+                sys.executable, "-m", "pathway_tpu.cli", "top",
+                "--url", base + "/query", "--frames", "1", "--no-clear",
+                "-i", "0.1",
+            ],
+            env={**env, "PATHWAY_PROCESSES": "1"},
+            timeout=60, capture_output=True, text=True,
+        )
+        assert top.returncode == 0, (
+            f"top exited {top.returncode}\nstderr:\n{top.stderr[-2000:]}"
+        )
+        assert "pathway-tpu top" in top.stdout and "WORKER" in top.stdout
+        assert "bottleneck: Rowwise" in top.stdout, top.stdout
+        assert "slow-tick" in top.stdout, top.stdout
+        report["top"] = {"lines": top.stdout.count("\n")}
+
+        # wait for the periodic flusher to land the slo.alert instant in
+        # the on-disk trace part (flushes are atomic: the file is always
+        # one complete flush), then SIGKILL process 0
+        trace_part = f"{trace_base}.p0"
+
+        def trace_alert_flushed():
+            with open(trace_part) as f:
+                doc = json.load(f)
+            return [
+                e for e in doc["traceEvents"]
+                if e.get("name") == "slo.alert"
+                and e.get("args", {}).get("rule") == "slow-tick"
+            ] or None
+
+        _poll(trace_alert_flushed, 30, "slo.alert flushed to trace part")
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=30)
+    finally:
+        stderr_tails = []
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            try:
+                _out, err = p.communicate(timeout=10)
+                stderr_tails.append((err or "")[-2000:])
+            except Exception:  # noqa: BLE001 — diagnostics only
+                stderr_tails.append("<no stderr>")
+
+    # -- crash forensics: the supervisor's harvest turns the dead
+    # process's ring into a crash bundle that carries the alert
+    from pathway_tpu.parallel.supervisor import Supervisor
+
+    sup = Supervisor(
+        lambda generation, reason: [],
+        flight_dir=flight,
+        process_ids=[0],
+        run_id=run_id,
+        log=lambda msg: None,
+    )
+    sup._failed_indices = [0]
+    bundles = sup._harvest_flight(0, "signals_smoke SIGKILL")
+    assert bundles, f"no crash bundle harvested from {flight}"
+    with open(bundles[0]) as f:
+        bundle = json.load(f)
+    assert bundle["process"] == 0 and bundle["run_id"] == run_id[:16]
+    bundle_alerts = [
+        r for r in bundle["records"]
+        if r.get("kind") == "slo.alert" and r.get("rule") == "slow-tick"
+    ]
+    assert bundle_alerts, (
+        "crash bundle carries no slo.alert record — alerts did not reach "
+        "the flight recorder"
+    )
+    assert bundle_alerts[0]["severity"] == "critical"
+    report["bundle"] = {
+        "path": bundles[0], "alerts": len(bundle_alerts),
+        "ticks": len(bundle["last_ticks"]),
+    }
+
+    # -- the trace stream carries the alert too: the file survives the
+    # SIGKILL as one complete (atomically replaced) flush
+    trace_part = f"{trace_base}.p0"
+    assert os.path.exists(trace_part), (
+        f"no trace part at {trace_part} (stderr: {stderr_tails})"
+    )
+    with open(trace_part) as f:
+        trace_doc = json.load(f)
+    trace_alerts = [
+        e for e in trace_doc["traceEvents"]
+        if e.get("name") == "slo.alert"
+        and e.get("args", {}).get("rule") == "slow-tick"
+    ]
+    assert trace_alerts, "slo.alert instant missing from the trace stream"
+    report["trace"] = {"alert_events": len(trace_alerts)}
+
+    if verbose:
+        print(f"signals_smoke: {json.dumps(report)}")
+    return report
+
+
+def main() -> int:
+    try:
+        run_smoke(verbose=True)
+    except BaseException as e:  # noqa: BLE001 — CLI exit-code surface
+        print(
+            f"signals_smoke FAILED: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    print("signals_smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

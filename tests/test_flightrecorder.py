@@ -244,3 +244,89 @@ def test_render_metrics_flight_dumps(monkeypatch):
     values = parse_exposition(text)
     assert values[("pathway_flight_recorder_dumps_total", ())] == 2
     assert values[("pathway_trace_dropped_events_total", ())] == 5
+
+
+# -- alert storms (observability/slo.py fan-out) -----------------------------
+
+
+def _storm_engine(n_alerts: int):
+    """An SloEngine + signals store rigged so every evaluate() fires a
+    fresh rule — the alert-storm generator."""
+    from pathway_tpu.observability.slo import Rule, SloEngine
+    from pathway_tpu.observability.timeseries import Signals, TimeSeriesStore
+
+    store = TimeSeriesStore(capacity=16)
+    for dt in (0.0, 1.0, 2.0):
+        store.record("engine_ticks", dt * 10, worker=0, t=1000.0 + dt)
+    rules = [
+        Rule(name=f"storm-{i}", expr="last(engine_ticks)", op=">",
+             threshold=-1.0, for_s=0.0, severity="warning")
+        for i in range(n_alerts)
+    ]
+    return SloEngine(rules, default_window_s=10.0), Signals(store)
+
+
+def test_alert_storm_respects_ring_size(tmp_path, monkeypatch):
+    """Thousands of slo.alert records must stay inside the fixed ring:
+    the file never grows, the newest alerts survive, harvest parses."""
+    monkeypatch.setenv("PATHWAY_FLIGHT_DIR", str(tmp_path / "fd"))
+    rec = fr.get_recorder()
+    assert rec is not None
+    size_before = os.path.getsize(rec.path)
+    engine, signals = _storm_engine(n_alerts=2000)
+    engine.evaluate(signals, now=2000.0)
+    assert os.path.getsize(rec.path) == size_before  # fixed-size ring
+    rec.close()
+    doc = fr.harvest(rec.path)
+    alerts = [r for r in doc["records"] if r["kind"] == "slo.alert"]
+    assert alerts, "no alert records survived the storm"
+    assert doc["wrapped"]  # the storm overflowed the ring...
+    names = [r["rule"] for r in alerts]
+    assert names[-1] == "storm-1999"  # ...keeping the NEWEST alerts
+    assert names == sorted(names, key=lambda n: int(n.split("-")[1]))
+    # every surviving record is a complete, well-formed alert event
+    for r in alerts:
+        assert {"rule", "severity", "state", "expr", "threshold"} <= set(r)
+
+
+def test_alert_storm_never_splits_span_consistent_chunk(tmp_path):
+    """Tracer overflow under an alert storm: dropping the oldest half
+    must never leave the kept window starting with a counter sample
+    whose owning tick span was dropped — alert instants interleaved
+    between span+counter pairs must not break that invariant."""
+    from pathway_tpu.internals import tracing
+
+    tracer = tracing.Tracer(str(tmp_path / "t.json"), max_events=64)
+    engine, signals = _storm_engine(n_alerts=300)
+    tracing._active = tracer
+    tracing._env_checked = True
+    tracing._programmatic = True
+    try:
+        import time as _time
+
+        for i in range(200):
+            t0 = _time.perf_counter_ns()
+            tracer.complete(
+                "tick", t0, {"time": i},
+                counter=("engine_rows.w0", {"input": i, "output": i}),
+            )
+            if i % 3 == 0:
+                # a burst of alerts lands between span+counter pairs
+                engine.rules[i % len(engine.rules)].active = False
+                engine.rules[i % len(engine.rules)].breach_since = None
+                engine.evaluate(signals, now=3000.0 + i)
+        assert tracer._dropped > 0  # the storm actually overflowed
+        with tracer._lock:
+            events = list(tracer._events)
+        # the kept window must not BEGIN with an orphaned counter sample
+        assert events[0].get("ph") != "C"
+        # and every counter sample still directly follows its tick span
+        for i, ev in enumerate(events):
+            if ev.get("ph") == "C":
+                assert i > 0 and events[i - 1]["name"] == "tick", (
+                    f"counter at {i} orphaned from its tick span"
+                )
+        alert_events = [e for e in events if e["name"] == "slo.alert"]
+        assert alert_events, "alert instants were lost entirely"
+    finally:
+        tracing.deactivate()

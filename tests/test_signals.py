@@ -1,0 +1,568 @@
+"""Signals plane (observability/timeseries.py, slo.py, attribution.py,
+top.py): windowed store semantics, SLO rule lifecycle, bottleneck
+ranking, the /query surface, and the stale-peer roll-up gauge."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from pathway_tpu.observability.attribution import attribution_document
+from pathway_tpu.observability.slo import Rule, SloEngine, load_rules
+from pathway_tpu.observability.timeseries import (
+    Signals,
+    SignalsPlane,
+    TimeSeriesStore,
+)
+
+T0 = 1000.0
+
+
+def _counter_store(values, dt=1.0, metric="c", worker=0):
+    store = TimeSeriesStore(capacity=64)
+    for i, v in enumerate(values):
+        store.record(metric, v, worker, T0 + i * dt)
+    return store
+
+
+# -- store + windowed queries ------------------------------------------------
+
+
+def test_store_ring_evicts_oldest():
+    store = TimeSeriesStore(capacity=4)
+    for i in range(10):
+        store.record("m", float(i), 0, T0 + i)
+    pts = store.points("m", 0)
+    assert [v for _t, v in pts] == [6.0, 7.0, 8.0, 9.0]
+
+
+def test_window_keeps_left_edge_sample():
+    # the sample at-or-before the cutoff must be kept: a counter delta
+    # needs the value at the window's LEFT edge
+    store = _counter_store([0, 10, 20, 30, 40])
+    sig = Signals(store)
+    assert sig.delta("c", 2.0, 0) == 20.0
+    assert sig.rate("c", 2.0, 0) == pytest.approx(10.0)
+
+
+def test_rate_and_delta_clamp_resets():
+    sig = Signals(_counter_store([100, 150, 5]))  # restart reset mid-window
+    assert sig.delta("c", 10.0, 0) == 0.0
+    assert sig.rate("c", 10.0, 0) == 0.0
+
+
+def test_agg_and_last():
+    sig = Signals(_counter_store([3, 1, 5]))
+    assert sig.last("c", 0) == 5.0
+    assert sig.agg("c", 10.0, min, 0) == 1.0
+    assert sig.agg("c", 10.0, max, 0) == 5.0
+    assert sig.eval("avg(c)", 10.0, 0) == pytest.approx(3.0)
+    assert sig.last("missing", 0) is None
+
+
+def test_percentile_diffs_cumulative_histograms():
+    from pathway_tpu.observability.histogram import LogHistogram
+
+    store = TimeSeriesStore(capacity=8)
+    h = LogHistogram()
+    store.record("tick_duration", h.snapshot()["counts"], 0, T0)
+    for _ in range(100):
+        h.observe(1000)  # 1 µs
+    store.record("tick_duration", h.snapshot()["counts"], 0, T0 + 1)
+    for _ in range(100):
+        h.observe(1_000_000)  # 1 ms — only this lands in the last window
+    store.record("tick_duration", h.snapshot()["counts"], 0, T0 + 2)
+    sig = Signals(store)
+    # full window sees both populations; p50 sits between them
+    p50_full = sig.percentile("tick_duration", 0.5, 10.0, 0)
+    # a window covering only the last sample-pair sees only the 1 ms pop
+    p50_tail = sig.percentile("tick_duration", 0.5, 1.0, 0)
+    assert p50_tail > p50_full
+    assert 2**19 <= p50_tail <= 2**21  # ~1 ms in log2-bucket resolution
+    # ms conversion through the expression surface
+    assert sig.eval("p50(tick_duration)", 1.0, 0) == pytest.approx(
+        p50_tail / 1e6
+    )
+
+
+def test_sustained_above_needs_full_coverage():
+    sig = Signals(_counter_store([5, 5, 5, 5, 5]))
+    assert sig.sustained_above("c", 1.0, 3.0, 0)
+    assert not sig.sustained_above("c", 9.0, 3.0, 0)
+    # a store younger than the horizon cannot claim "sustained"
+    young = Signals(_counter_store([5, 5]))
+    assert not young.sustained_above("c", 1.0, 30.0, 0)
+    assert sig.sustained_below("c", 9.0, 3.0, 0)
+
+
+def test_eval_worst_across_workers():
+    store = TimeSeriesStore(capacity=8)
+    for w, v in ((0, 10.0), (1, 50.0), (2, 20.0)):
+        store.record("lag", v, w, T0)
+    sig = Signals(store)
+    value, worker = sig.eval_worst("last(lag)", 10.0)
+    assert (value, worker) == (50.0, 1)
+    value, worker = sig.eval_worst("last(lag)", 10.0, higher_is_worse=False)
+    assert (value, worker) == (10.0, 0)
+
+
+def test_eval_rejects_unknown_op():
+    sig = Signals(_counter_store([1]))
+    with pytest.raises(ValueError, match="unknown signal op"):
+        sig.eval("median(c)", 1.0, 0)
+
+
+# -- SLO rules ---------------------------------------------------------------
+
+
+def test_load_rules_inline_and_file(tmp_path):
+    spec = {"rules": [{"name": "r1", "expr": "rate(engine_ticks)",
+                       "op": "<", "threshold": 1, "for_s": 2,
+                       "severity": "critical"}]}
+    rules = load_rules(json.dumps(spec))
+    assert rules[0].name == "r1" and rules[0].severity == "critical"
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps(spec))
+    assert load_rules(str(p))[0].name == "r1"
+    assert load_rules(None) == []
+    assert load_rules("  ") == []
+
+
+@pytest.mark.parametrize("bad,match", [
+    ("{nope", "not valid JSON"),
+    ('{"rules": [{"name": "x"}]}', "rule #0"),
+    ('{"rules": [{"name": "x", "expr": "c", "threshold": 1, "op": "!"}]}',
+     "op must be"),
+    ('{"rules": [{"name": "x", "expr": "c", "threshold": 1, '
+     '"severity": "fatal"}]}', "severity"),
+    ('{"rules": [{"name": "x", "expr": "c", "threshold": 1, "bogus": 2}]}',
+     "unknown keys"),
+    ('{"rules": [{"name": "x", "expr": "c", "threshold": 1}, '
+     '{"name": "x", "expr": "c", "threshold": 1}]}', "duplicate"),
+    ("/nonexistent/rules.json", "cannot be read"),
+])
+def test_load_rules_rejects_malformed(bad, match):
+    with pytest.raises(ValueError, match=match):
+        load_rules(bad)
+
+
+def test_rule_ms_alias_rewrites_to_ns_series():
+    r = Rule(name="x", expr="p99(tick_duration_ms)", threshold=5)
+    assert r.expr == "p99(tick_duration)"
+
+
+def _lag_store(values, dt=1.0):
+    return _counter_store(values, dt=dt, metric="lag")
+
+
+def test_slo_fires_once_after_sustained_then_resolves():
+    rule = Rule(name="lag", expr="last(lag)", op=">", threshold=10.0,
+                for_s=2.0, severity="critical")
+    engine = SloEngine([rule], default_window_s=10.0)
+    sig = Signals(_lag_store([50.0]))
+    engine.evaluate(sig, now=T0)  # breach starts; not sustained yet
+    assert engine.alerts.document()["active"] == []
+    engine.evaluate(sig, now=T0 + 1.0)
+    assert engine.alerts.document()["active"] == []
+    engine.evaluate(sig, now=T0 + 2.1)  # sustained past for_s -> fires
+    doc = engine.alerts.document()
+    assert [e["rule"] for e in doc["active"]] == ["lag"]
+    assert doc["active"][0]["severity"] == "critical"
+    assert doc["fired_total"] == {"critical": 1}
+    # still breaching: fires exactly once, no re-fire storm
+    engine.evaluate(sig, now=T0 + 3.0)
+    engine.evaluate(sig, now=T0 + 4.0)
+    assert engine.alerts.document()["fired_total"] == {"critical": 1}
+    assert len(engine.alerts.document()["history"]) == 1
+    # breach clears -> resolved event, active empties
+    sig2 = Signals(_lag_store([1.0]))
+    engine.evaluate(sig2, now=T0 + 5.0)
+    doc = engine.alerts.document()
+    assert doc["active"] == []
+    assert [e["state"] for e in doc["history"]] == ["firing", "resolved"]
+    # a NEW sustained breach may fire again (it is a new incident)
+    engine.evaluate(sig, now=T0 + 6.0)
+    engine.evaluate(sig, now=T0 + 8.1)
+    assert engine.alerts.document()["fired_total"] == {"critical": 2}
+
+
+def test_slo_interrupted_breach_never_fires():
+    rule = Rule(name="lag", expr="last(lag)", op=">", threshold=10.0,
+                for_s=3.0)
+    engine = SloEngine([rule], default_window_s=10.0)
+    hot, cold = Signals(_lag_store([50.0])), Signals(_lag_store([1.0]))
+    engine.evaluate(hot, now=T0)
+    engine.evaluate(cold, now=T0 + 2.0)  # dips below before for_s
+    engine.evaluate(hot, now=T0 + 4.0)
+    engine.evaluate(hot, now=T0 + 5.0)  # only 1s into the NEW breach
+    assert engine.alerts.document()["active"] == []
+
+
+def test_slo_rule_over_missing_metric_is_inert():
+    rule = Rule(name="ghost", expr="rate(never_sampled)", threshold=1.0)
+    engine = SloEngine([rule], default_window_s=10.0)
+    engine.evaluate(Signals(TimeSeriesStore(8)), now=T0)
+    assert engine.alerts.document()["active"] == []
+
+
+# -- attribution -------------------------------------------------------------
+
+
+def _attribution_store():
+    store = TimeSeriesStore(capacity=16)
+    # worker 0: SlowOp burns 9x the time of FastOp over the window
+    for i, t in enumerate((T0, T0 + 1, T0 + 2)):
+        store.record("op_time_ns:SlowOp#1", 9e9 * i, 0, t)
+        store.record("op_time_ns:FastOp#2", 1e9 * i, 0, t)
+        store.record("op_rows:SlowOp#1", 100.0 * i, 0, t)
+        store.record("op_rows:FastOp#2", 1000.0 * i, 0, t)
+        store.record("frontier_lag_ms", 100.0 * i, 0, t)  # growing lag
+    return store
+
+
+def test_attribution_ranks_by_windowed_time_share():
+    doc = attribution_document(Signals(_attribution_store()), 10.0)
+    assert doc["bottleneck"] == "SlowOp#1"
+    ranked = doc["ranked"]
+    assert [d["operator"] for d in ranked] == ["SlowOp#1", "FastOp#2"]
+    assert ranked[0]["share"] == pytest.approx(0.9, abs=0.01)
+    assert ranked[1]["share"] == pytest.approx(0.1, abs=0.01)
+    assert doc["backlogged_workers"] == [0]
+    assert ranked[0]["rows_per_sec"] == pytest.approx(100.0, rel=0.05)
+
+
+def test_attribution_empty_store():
+    doc = attribution_document(Signals(TimeSeriesStore(8)), 10.0)
+    assert doc["bottleneck"] is None and doc["ranked"] == []
+
+
+# -- sampler + hub /query surface --------------------------------------------
+
+
+class _FakeComm:
+    def comm_stats(self):
+        return {"send_queue_depth": 3.0, "cluster_bytes_sent": 1e6}
+
+
+def _hub_with_plane():
+    from pathway_tpu.engine.executor import EngineStats
+    from pathway_tpu.observability.hub import ObservabilityHub
+
+    hub = ObservabilityHub()
+    stats = EngineStats()
+    stats.detailed = True
+    hub.register_worker(0, stats)
+    hub.register_comm(_FakeComm())
+    plane = SignalsPlane(hub, sample_s=0.05, window_s=5.0)
+    hub.signals_plane = plane  # not started: tests drive sample_once()
+    return hub, stats, plane
+
+
+def test_sampler_records_engine_and_comm_series():
+    hub, stats, plane = _hub_with_plane()
+    stats.ticks = 10
+    stats.rows_total = 100
+    stats.tick_duration.observe(1_000_000)
+    stats.note_node_time(type("N", (), {"node_id": 7})(), 5_000_000)
+    plane.sample_once(t=T0)
+    stats.ticks = 20
+    plane.sample_once(t=T0 + 1)
+    sig = plane.signals
+    assert sig.rate("engine_ticks", 10.0, 0) == pytest.approx(10.0)
+    assert sig.last("comm.send_queue_depth") == 3.0
+    assert sig.percentile("tick_duration", 0.5, 10.0, 0) is not None
+    assert any(
+        m.startswith("op_time_ns:N#7") for m in plane.store.metrics(0)
+    )
+    assert plane.samples_taken == 2
+
+
+def test_query_document_and_eval():
+    hub, stats, plane = _hub_with_plane()
+    stats.ticks = 5
+    plane.sample_once(t=T0)
+    stats.ticks = 25
+    plane.sample_once(t=T0 + 1)
+    doc = hub.query_document()
+    assert doc["signals"] and "0" in doc["workers"]
+    assert doc["workers"]["0"]["tick_rate"] == pytest.approx(20.0)
+    assert doc["processes"] == [0]
+    assert doc["comm"]["send_queue_depth"] == 3.0
+    assert "attribution" in doc and "alerts" in doc
+    out = hub.query_eval({"metric": "engine_ticks", "op": "rate"})
+    assert out["value"] == pytest.approx(20.0)
+    assert len(out["points"]) == 2
+    out = hub.query_eval({"expr": "last(engine_ticks)", "worker": "0"})
+    assert out["value"] == 25.0
+    with pytest.raises(ValueError, match="expr"):
+        hub.query_eval({"op": "rate"})
+    with pytest.raises(ValueError, match="bad window"):
+        hub.query_eval({"metric": "engine_ticks", "window": "soon"})
+
+
+def test_query_merges_peer_documents(monkeypatch):
+    from pathway_tpu.observability.hub import ObservabilityHub
+
+    hub, stats, plane = _hub_with_plane()
+    hub.peer_http = [("127.0.0.1", 1)]
+    stats.ticks = 5
+    stats.last_time = 2_000_000_000_000
+    plane.sample_once(t=T0)
+    stats.ticks = 25
+    plane.sample_once(t=T0 + 1)
+    peer_doc = {
+        "process_id": 1,
+        "workers": {"1": {"tick_rate": 3.0,
+                          "last_time": 2_000_000_005_000}},
+        "comm": {"send_queue_depth": 9.0},
+        "alerts": {"active": [{"rule": "peer-rule", "t": 1.0}],
+                   "history": [{"rule": "peer-rule", "t": 1.0}],
+                   "fired_total": {"warning": 1}},
+        "attribution": {"window_s": 5.0, "ranked": [
+            {"operator": "PeerOp#9", "busy_ms": 1e6, "rows_per_sec": 1.0,
+             "workers": {"1": 1e6}},
+        ], "bottleneck": "PeerOp#9", "backlogged_workers": [1]},
+    }
+    monkeypatch.setattr(
+        ObservabilityHub, "_scrape_peer_path",
+        staticmethod(
+            lambda host, port, path: peer_doc["alerts"]
+            if path == "/alerts"
+            else peer_doc
+        ),
+    )
+    doc = hub.query_document()
+    assert set(doc["workers"]) == {"0", "1"}
+    assert doc["comm"]["1"]["send_queue_depth"] == 9.0
+    assert [e["rule"] for e in doc["alerts"]["active"]] == ["peer-rule"]
+    # cross-worker frontier lag: worker 0 trails the peer by 5000 ms
+    assert doc["workers"]["0"]["frontier_lag_vs_max_ms"] == 5000
+    assert doc["workers"]["1"]["frontier_lag_vs_max_ms"] == 0
+    # peer's heavy operator wins the merged attribution
+    assert doc["attribution"]["bottleneck"] == "PeerOp#9"
+    assert hub.alerts_view()["fired_total"] == {"warning": 1}
+
+
+# -- stale-peer roll-up (killed peer keeps a last-seen gauge) ----------------
+
+
+def test_killed_peer_reports_stale_worker_gauge():
+    from pathway_tpu.engine.executor import EngineStats
+    from pathway_tpu.engine.http_server import start_http_server
+    from pathway_tpu.observability.hub import ObservabilityHub
+    from pathway_tpu.observability.prometheus import parse_exposition
+
+    peer_hub = ObservabilityHub(process_id=1, n_processes=2)
+    peer_stats = EngineStats()
+    peer_stats.ticks = 7
+    peer_hub.register_worker(1, peer_stats)
+    server, _ = start_http_server(peer_hub, port=0)
+    port = server.server_address[1]
+    hub0 = ObservabilityHub(
+        process_id=0, n_processes=2, peer_http=[("127.0.0.1", port)]
+    )
+    stats0 = EngineStats()
+    hub0.register_worker(0, stats0)
+    try:
+        values = parse_exposition(hub0.render_metrics())
+        key = ("pathway_engine_ticks", (("worker", "1"),))
+        assert values[key] == 7  # peer alive: merged normally
+        assert ("pathway_cluster_stale_workers", ()) not in values
+    finally:
+        server.shutdown()
+        server.server_close()
+    time.sleep(0.05)
+    # peer killed: its workers surface as STALE with a last-seen age
+    # instead of silently vanishing from the merged view
+    values = parse_exposition(hub0.render_metrics())
+    assert ("pathway_engine_ticks", (("worker", "1"),)) not in values
+    age = values[("pathway_worker_last_seen_seconds", (("worker", "1"),))]
+    assert 0.0 <= age < 30.0
+    assert values[("pathway_cluster_stale_workers", ())] == 1
+    assert values[("pathway_cluster_scrape_errors", ())] >= 1
+
+
+# -- top rendering -----------------------------------------------------------
+
+
+def _top_doc():
+    return {
+        "process_id": 0,
+        "processes": [0, 1],
+        "window_s": 30.0,
+        "sample_s": 0.5,
+        "workers": {
+            "0": {"tick_rate": 12.3, "row_rate": 456.0, "output_rate": 78.0,
+                  "frontier_lag_ms": 2.0, "frontier_lag_vs_max_ms": 0.0,
+                  "tick_p95_ms": 4.2, "e2e_p95_ms": 9.9},
+            "1": {"tick_rate": 1.0, "row_rate": 2.0, "output_rate": None,
+                  "frontier_lag_ms": None, "frontier_lag_vs_max_ms": 120.0,
+                  "tick_p95_ms": None, "e2e_p95_ms": None},
+        },
+        "comm": {"0": {"send_queue_depth": 5.0, "send_mb_per_sec": 1.25,
+                       "cluster_inbox_depth": 2.0}},
+        "attribution": {"bottleneck": "SlowOp#3",
+                        "ranked": [{"operator": "SlowOp#3", "share": 0.87}]},
+        "alerts": {"active": [
+            {"t": time.time() - 5, "rule": "tick-p95", "severity": "critical",
+             "expr": "p95(tick_duration)", "op": ">", "threshold": 1,
+             "value": 42.5},
+        ]},
+    }
+
+
+def test_top_renders_workers_bottleneck_and_alerts():
+    from pathway_tpu.observability.top import render_frame
+
+    frame = render_frame(_top_doc())
+    assert "WORKER" in frame and "TICK/S" in frame
+    assert "12.3" in frame and "120.0" in frame
+    assert "bottleneck: SlowOp#3 (87% of busy time)" in frame
+    assert "ALERTS (1 firing)" in frame and "tick-p95" in frame
+    assert "send queue 5" in frame and "1.25 MB/s" in frame
+    # None-valued cells render as "-" rather than crashing
+    assert " - " in frame or " -\n" in frame or "- " in frame
+
+
+def test_top_renders_empty_doc_without_errors():
+    from pathway_tpu.observability.top import render_frame
+
+    frame = render_frame({"process_id": 0, "workers": {}, "alerts": {}})
+    assert "sampler warming up" in frame
+    assert "alerts: none firing" in frame
+
+
+def test_run_top_frames_against_live_server():
+    import io
+
+    from pathway_tpu.engine.executor import EngineStats
+    from pathway_tpu.engine.http_server import start_http_server
+    from pathway_tpu.observability.hub import ObservabilityHub
+    from pathway_tpu.observability.top import run_top
+
+    hub = ObservabilityHub()
+    stats = EngineStats()
+    hub.register_worker(0, stats)
+    plane = SignalsPlane(hub, sample_s=0.05, window_s=5.0)
+    hub.signals_plane = plane
+    stats.ticks = 1
+    plane.sample_once(t=T0)
+    stats.ticks = 11
+    plane.sample_once(t=T0 + 1)
+    server, _ = start_http_server(hub, port=0)
+    port = server.server_address[1]
+    out = io.StringIO()
+    try:
+        rc = run_top(
+            f"http://127.0.0.1:{port}/query", interval_s=0.01,
+            frames=2, clear=False, out=out,
+        )
+    finally:
+        server.shutdown()
+        server.server_close()
+    assert rc == 0
+    assert out.getvalue().count("pathway-tpu top") == 2
+    # unreachable endpoint: bounded frames exit nonzero
+    out2 = io.StringIO()
+    rc = run_top("http://127.0.0.1:9/query", interval_s=0.01,
+                 frames=1, clear=False, out=out2)
+    assert rc == 1 and "unreachable" in out2.getvalue()
+
+
+# -- ingest→emit latency (connector stamp through the dataflow) --------------
+
+
+def test_streaming_pipeline_observes_ingest_to_emit_latency():
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    done = threading.Event()
+
+    class S(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(6):
+                self.next(x=i)
+                self.commit()
+
+    t = pw.io.python.read(S(), schema=pw.schema_from_types(x=int), name="s")
+    out = t.select(y=pw.this.x + 1)
+    pw.io.subscribe(out, on_change=lambda **kw: done.set())
+    runner = None
+    try:
+        from pathway_tpu.internals.graph_runner import GraphRunner
+
+        runner = GraphRunner()
+        runner.run()
+    finally:
+        G.clear()
+    stats = runner.executor.stats
+    assert done.is_set()
+    snap = stats.e2e_latency_hist.snapshot()
+    assert snap["count"] > 0, "no ingest→emit observations recorded"
+    assert stats.e2e_ms is not None and stats.e2e_ms < 60_000
+
+
+def test_window_keeps_straddling_sample_under_jittered_cadence():
+    # no sample lands exactly on the cutoff: the straddling sample is
+    # the left edge, so deltas baseline correctly and sustained-for
+    # coverage spans the full horizon (code-review regression)
+    store = TimeSeriesStore(capacity=64)
+    for i, v in enumerate((0.0, 100.0, 200.0, 300.0)):
+        store.record("c", v, 0, T0 + i * 5.0)  # t = 0, 5, 10, 15
+    sig = Signals(store)
+    pts = store.points("c", 0, 8.0)  # cutoff at t=7 — between samples
+    assert [t - T0 for t, _v in pts] == [5.0, 10.0, 15.0]
+    assert sig.delta("c", 8.0, 0) == 200.0
+    # sustained over a horizon shorter than the sampled span must not
+    # starve on coverage just because samples are sparse
+    lag = TimeSeriesStore(capacity=64)
+    for i in range(5):
+        lag.record("lag", 50.0, 0, T0 + i * 0.51)  # jittered ~0.5s
+    assert Signals(lag).sustained_above("lag", 10.0, 2.0, 0)
+
+
+def test_scalar_ops_on_histogram_series_raise_value_error():
+    from pathway_tpu.observability.histogram import LogHistogram
+
+    store = TimeSeriesStore(capacity=8)
+    h = LogHistogram()
+    h.observe(1000)
+    store.record("tick_duration", h.snapshot()["counts"], 0, T0)
+    h.observe(2000)
+    store.record("tick_duration", h.snapshot()["counts"], 0, T0 + 1)
+    sig = Signals(store)
+    for expr in ("avg(tick_duration)", "rate(tick_duration)",
+                 "last(tick_duration)"):
+        with pytest.raises(ValueError, match="histogram series"):
+            sig.eval(expr, 10.0, 0)
+
+
+def test_query_endpoint_rejects_scalar_op_on_histogram_with_400():
+    from pathway_tpu.engine.http_server import start_http_server
+    from pathway_tpu.observability.hub import ObservabilityHub
+
+    hub, stats, plane = _hub_with_plane()
+    stats.tick_duration.observe(1000)
+    plane.sample_once(t=T0)
+    stats.tick_duration.observe(2000)
+    plane.sample_once(t=T0 + 1)
+    server, _ = start_http_server(hub, port=0)
+    port = server.server_address[1]
+    import urllib.error
+    import urllib.request
+
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/query?expr=avg(tick_duration)",
+                timeout=5,
+            )
+        assert exc.value.code == 400
+        assert "histogram series" in exc.value.read().decode()
+    finally:
+        server.shutdown()
+        server.server_close()

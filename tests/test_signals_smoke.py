@@ -1,0 +1,28 @@
+"""Tier-1 wrapper around scripts/signals_smoke.py: a two-process run
+with a deliberately slow operator must serve windowed rate/percentile
+series on /query (tick latency, ingest→emit, frontier lag, comm queue
+depth), rank the slow operator first on /attribution, fire a seeded
+sustained-threshold SLO rule exactly once (visible on /alerts, in the
+trace stream, and in the crash bundle harvested after a SIGKILL), and
+render a live `pathway-tpu top` frame without errors."""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    ),
+)
+
+
+def test_signals_smoke(tmp_path):
+    from signals_smoke import run_smoke
+
+    result = run_smoke(workdir=str(tmp_path))
+    assert result["attribution"]["bottleneck"].startswith("Rowwise")
+    assert result["attribution"]["share"] > 0.5
+    assert result["alerts"]["fired"] == 1
+    assert result["bundle"]["alerts"] >= 1
+    assert result["trace"]["alert_events"] >= 1
