@@ -212,6 +212,7 @@ def main() -> None:
     wc_sharded_t4 = _wordcount_throughput(threads=4)
     mesh_rows_per_sec = _mesh_exchange_throughput()
     cluster_n2 = _cluster_throughput()
+    autoscale_pauses = _autoscale_pause_bench()
     codec_enc_mb, codec_dec_mb, codec_bytes_row = _comm_codec_throughput()
     import os as _os
 
@@ -291,12 +292,27 @@ def main() -> None:
             "rest_rag_p50_ms_excl_tunnel": round(
                 max(rest_p50 - 2 * roundtrip_ms, 0.0), 2
             ),
+            # closed-loop autoscaler: pause of one live 1->2 scale event
+            # (drain to the delivery boundary + reshard + relaunch), best
+            # of N deterministic scripted events; rows lost is asserted
+            # = 0 by the autoscale smoke's multiset comparison
+            "autoscale_pause_ms": (
+                round(min(autoscale_pauses), 1) if autoscale_pauses else None
+            ),
+            "autoscale_scale_events": (
+                len(autoscale_pauses) if autoscale_pauses else 0
+            ),
             # per-lane run-to-run spread over the N reps above: the noise
             # floor a cross-round delta must clear before it reads as a
             # real regression/improvement (VERDICT #9)
             "lane_variance": {
                 "wordcount_stream_rows_per_sec": _rep_stats(wc_reps),
                 "join_stream_rows_per_sec": _rep_stats(join_reps),
+                **(
+                    {"autoscale_pause_ms": _rep_stats(autoscale_pauses)}
+                    if autoscale_pauses and len(autoscale_pauses) > 1
+                    else {}
+                ),
             },
             "baseline_note": "reference publishes no in-repo numbers (BASELINE.md); 50ms north-star serve target used",
         },
@@ -802,6 +818,48 @@ def _cluster_throughput(n_rows: int = 500_000, batch: int = 10_000) -> float | N
         except (OSError, ValueError, KeyError) as e:
             print(f"bench: cluster -n2 output unreadable: {e}", file=sys.stderr)
             return None
+
+
+def _autoscale_pause_bench(reps: int = 3) -> list[float] | None:
+    """``autoscale_pause_ms`` lane: the end-to-end pause of one live
+    1→2 scale event under ``spawn --autoscale`` — SIGTERM drain of the
+    old generation to its delivery boundary, offline state reshard, and
+    relaunch — measured by the controller itself and read back from its
+    event log. Runs the deterministic scripted scenario the autoscale
+    smoke uses (exact final counts are asserted there; this lane only
+    times it), ``reps`` times for the variance block."""
+    import os
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    scripts = os.path.join(here, "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    try:
+        from autoscale_smoke import run_scripted
+    except ImportError as e:
+        print(f"bench: autoscale lane unavailable: {e}", file=sys.stderr)
+        return None
+    import tempfile
+
+    pauses: list[float] = []
+    with tempfile.TemporaryDirectory(prefix="bench_autoscale_") as td:
+        for i in range(reps):
+            # fresh workdir per rep: the scripted scenario persists a
+            # store, and a second rep over the same layout would no-op
+            workdir = os.path.join(td, f"rep{i}")
+            os.makedirs(workdir)
+            try:
+                result = run_scripted(workdir=workdir)
+            except Exception as e:  # lane must not kill bench; ^C may
+                print(
+                    f"bench: autoscale rep {i} failed: "
+                    f"{type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
+                return pauses or None
+            pauses.append(float(result["event"]["pause_ms"]))
+    return pauses
 
 
 def _comm_codec_throughput(

@@ -1,0 +1,25 @@
+"""Closed-loop autoscaling — traffic-driven live rescaling.
+
+``pathway-tpu spawn --autoscale MIN..MAX --store <root>`` wraps the
+process ensemble in an :class:`AutoscaleController`: it watches the
+signals plane's merged ``/query`` document on process 0, decides a
+target worker count (``decider.py`` — sustained frontier lag or
+send-queue saturation scales up, sustained idleness scales down, with
+hysteresis, cooldown and a stale-scrape refusal), and executes the live
+rescale (``controller.py``): cooperative drain to the delivery
+boundary, offline reshard (``rescale/``), supervised resume — zero
+dropped rows, pause measured per event.
+"""
+
+from .controller import AutoscaleController, AutoscaleError, parse_range
+from .decider import Decider, DeciderConfig, Decision, load_scripted_plan
+
+__all__ = [
+    "AutoscaleController",
+    "AutoscaleError",
+    "Decider",
+    "DeciderConfig",
+    "Decision",
+    "load_scripted_plan",
+    "parse_range",
+]

@@ -1,0 +1,464 @@
+"""The closed-loop autoscale controller behind ``spawn --autoscale``.
+
+Composes five existing subsystems into "load changes, the cluster
+follows, exactly-once holds":
+
+- the **signals plane** (``observability/timeseries.py`` served as the
+  merged ``/query`` document on process 0) is the sensor;
+- the :class:`~pathway_tpu.autoscale.decider.Decider` is the pure
+  policy — sustained frontier lag / send-queue saturation scales up,
+  sustained idleness scales down, hysteresis + cooldown + a staleness
+  guard keep it from flapping;
+- the **supervisor** (``parallel/supervisor.py``) is the actuator's
+  safety net: the controller rides its ``poll_hook``/``planned_stop``
+  seam, so worker death during or between scale events falls into the
+  ordinary restart-from-snapshot path (children boot with
+  ``PATHWAY_ELASTIC=1``, so even a marker left mid-sequence by a killed
+  controller converges at the next supervised boot);
+- the **drain** is the cooperative SIGTERM teardown the supervisor
+  already performs: supervised children translate SIGTERM into
+  ``request_stop()`` and their persistence managers flush exactly to
+  the last delivery boundary — offsets never outrun recorded input, so
+  a rescale sees a consistent prefix and rows lost is zero;
+- the **resharder** (``rescale/``) repartitions that prefix N→M under
+  its atomic-marker protocol, which is what makes a SIGKILL of the
+  controller itself at ANY phase survivable.
+
+The scale sequence, each boundary an ``autoscale`` chaos-site phase::
+
+    decide -> [teardown = drain] -> reshard -> [relaunch] -> resume
+
+The pause — SIGTERM of the old generation to launch of the new — is
+measured per event (``pause_ms`` with drain/reshard parts) and appended
+to the ``PATHWAY_AUTOSCALE_LOG`` JSONL event log; the latest values are
+stamped into child environments so ``/metrics`` exports
+``pathway_autoscale_*`` and ``pathway-tpu top`` shows the loop working.
+
+Children are launched with ``PR_SET_PDEATHSIG=SIGTERM`` (Linux): a
+controller killed mid-scale takes its ensemble down *cooperatively*
+instead of leaking an orphaned cluster that would fight the next boot
+for ports and the persisted store.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Callable, Sequence
+
+from ..internals.tracing import span as _span
+from .decider import Decider, DeciderConfig, Decision, load_scripted_plan
+
+__all__ = ["AutoscaleController", "AutoscaleError", "parse_range"]
+
+
+class AutoscaleError(RuntimeError):
+    pass
+
+
+def parse_range(spec: str) -> tuple[int, int]:
+    """``"MIN..MAX"`` → (min, max); a bare ``"N"`` means N..N."""
+    s = spec.strip()
+    lo, sep, hi = s.partition("..")
+    try:
+        mn = int(lo)
+        mx = int(hi) if sep else mn
+    except ValueError:
+        raise AutoscaleError(
+            f"--autoscale expects MIN..MAX worker counts, got {spec!r}"
+        ) from None
+    if mn < 1 or mx < mn:
+        raise AutoscaleError(
+            f"--autoscale range {spec!r} needs 1 <= MIN <= MAX"
+        )
+    return mn, mx
+
+
+def _set_pdeathsig() -> None:  # pragma: no cover — runs post-fork
+    """Child-side: die (SIGTERM → cooperative flush) when the parent
+    controller disappears, so a SIGKILLed controller never leaks a live
+    ensemble into the next boot's ports and store."""
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        libc.prctl(1, signal.SIGTERM)  # PR_SET_PDEATHSIG
+    except Exception:
+        pass  # non-Linux: orphans are the operator's problem, as before
+
+
+class AutoscaleController:
+    """Owns the scale loop: builds the Supervisor, polls ``/query`` on
+    process 0, and executes decide → drain → reshard → resume."""
+
+    def __init__(
+        self,
+        *,
+        program: Sequence[str],
+        min_workers: int,
+        max_workers: int,
+        store: str,
+        backend_kind: str = "filesystem",
+        base_env: dict[str, str],
+        monitor_base: int,
+        cfg: DeciderConfig | None = None,
+        poll_s: float | None = None,
+        warmup_s: float | None = None,
+        log: Callable[[str], Any] | None = None,
+        plan: list[dict] | None = None,
+    ):
+        from ..internals.config import _env_float
+
+        self.program = list(program)
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.store = store
+        self.backend_kind = backend_kind
+        self.base_env = dict(base_env)
+        self.monitor_base = monitor_base
+        self.cfg = cfg or DeciderConfig.from_env(min_workers, max_workers)
+        self.decider = Decider(self.cfg)
+        self.poll_s = (
+            poll_s
+            if poll_s is not None
+            else _env_float("PATHWAY_AUTOSCALE_POLL_S", 1.0)
+        )
+        # a freshly launched generation replays + re-establishes rates;
+        # its signals are boot noise, not traffic
+        self.warmup_s = (
+            warmup_s
+            if warmup_s is not None
+            else _env_float("PATHWAY_AUTOSCALE_WARMUP_S", 3.0)
+        )
+        self._log = log or (
+            lambda m: print(f"[autoscale] {m}", file=sys.stderr)
+        )
+        self.plan = plan if plan is not None else load_scripted_plan()
+        self._plan_ix = 0
+        self.workers = self._initial_workers()
+        self.events: list[dict] = []
+        #: /query fetch failures (dead-sensor visibility, logged in run())
+        self.fetch_failures = 0
+        self._fetch_fail_streak = 0
+        self.log_path = self.base_env.get("PATHWAY_AUTOSCALE_LOG") or None
+        self._pending: dict | None = None
+        self._last_poll = 0.0
+        self._started = time.monotonic()
+        self._gen_started: float | None = None
+        self._sup: Any = None
+        from ..chaos import injector as _chaos
+
+        armed = _chaos.current()
+        self._fault = (
+            armed.autoscale_faults() if armed is not None else None
+        )
+
+    # -- setup ----------------------------------------------------------
+
+    def _initial_workers(self) -> int:
+        """Persisted marker count clamped into [min, max]; min for a
+        fresh store (scale up only when traffic proves the need).
+
+        A marker READ error is NOT a fresh store: guessing min_workers
+        on a transient IO hiccup would elastic-reshard a live N-worker
+        layout down to MIN at the next boot. Same bug class
+        tests/test_rescale.py::test_marker_io_errors_propagate pins for
+        the engine — refuse loudly instead."""
+        from ..persistence import layout as _layout
+        from ..persistence.backends import open_backend
+
+        try:
+            root = open_backend(self._backend_spec())
+        except Exception as e:
+            raise AutoscaleError(
+                f"cannot open the autoscale store {self.store!r}: {e}"
+            ) from e
+        try:
+            marker = _layout.read_marker(root)
+        except Exception as e:
+            raise AutoscaleError(
+                f"cannot read the cluster marker at {self.store!r}: {e} "
+                "— refusing to guess a worker count (a wrong guess "
+                "reshards the store)"
+            ) from e
+        finally:
+            root.close()
+        if marker is None:
+            return self.min_workers
+        return max(self.min_workers, min(self.max_workers, marker[0]))
+
+    def _backend_spec(self) -> Any:
+        from ..persistence import Backend
+
+        return (
+            Backend.filesystem(self.store)
+            if self.backend_kind == "filesystem"
+            else Backend.s3(self.store)
+        )
+
+    def _fire(self, phase: str) -> None:
+        if self._fault is not None:
+            self._fault.fire(phase)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def run(self) -> int:
+        from ..parallel.supervisor import Supervisor
+
+        sup = Supervisor(
+            self._launch,
+            poll_hook=self._poll,
+            planned_stop=self._planned_stop,
+            flight_dir=self.base_env.get("PATHWAY_FLIGHT_DIR"),
+            run_id=self.base_env.get("PATHWAY_RUN_ID"),
+            log=lambda m: print(f"[autoscale] {m}", file=sys.stderr),
+        )
+        self._sup = sup
+        self._refresh_sup()
+        self._log(
+            f"controller up: {self.workers} worker(s) in "
+            f"[{self.min_workers}..{self.max_workers}], watching "
+            f"http://127.0.0.1:{self.monitor_base}/query"
+        )
+        rc = sup.run()
+        if self.events:
+            pauses = [e["pause_ms"] for e in self.events]
+            self._log(
+                f"{len(self.events)} scale event(s), pause "
+                f"min/max {min(pauses):.0f}/{max(pauses):.0f} ms"
+            )
+        if self.fetch_failures:
+            self._log(
+                f"sensor trouble: {self.fetch_failures} /query fetch "
+                "failure(s) over the run"
+            )
+        return rc
+
+    def _refresh_sup(self) -> None:
+        """(Re)derive the per-generation supervision inputs from the
+        current worker count — health ports, labels, flight-ring ids."""
+        pids = list(range(self.workers))
+        self._sup.process_ids = pids
+        self._sup.labels = [f"process {p}" for p in pids]
+        ports: list[int] = []
+        if self.monitor_base:
+            ports = [self.monitor_base + p for p in pids]
+        self._sup.health_ports = ports
+
+    # -- sensing + deciding (supervisor poll_hook) ----------------------
+
+    def _poll(self) -> str | None:
+        now = time.monotonic()
+        if now - self._last_poll < self.poll_s:
+            return None
+        self._last_poll = now
+        decision = self._scripted(now)
+        if decision is None and not self.plan:
+            decision = self._signal_decision(now)
+        if decision is None:
+            return None
+        self._log(
+            f"decision: {self.workers} -> {decision.target} "
+            f"({decision.reason})"
+        )
+        from ..internals.tracing import get_tracer
+
+        tracer = get_tracer()
+        if tracer is not None:
+            tracer.instant(
+                "autoscale.decide",
+                from_workers=self.workers,
+                to_workers=decision.target,
+                reason=decision.reason,
+            )
+        # fire the decide fault BEFORE arming _pending: a crash/exit here
+        # must not leave a pending decision behind for a later budgeted
+        # relaunch to record as a phantom scale event
+        self._fire("decide")
+        self._pending = {
+            "decision": decision,
+            "from": self.workers,
+            "t0": time.monotonic(),
+        }
+        return (
+            f"autoscale {self.workers}->{decision.target}: "
+            f"{decision.reason}"
+        )
+
+    def _scripted(self, now: float) -> Decision | None:
+        while self._plan_ix < len(self.plan):
+            step = self.plan[self._plan_ix]
+            if now - self._started < step["after_s"]:
+                return None
+            self._plan_ix += 1
+            target = max(
+                self.min_workers, min(self.max_workers, step["to"])
+            )
+            if target != self.workers:
+                return Decision(
+                    target,
+                    "up" if target > self.workers else "down",
+                    f"scripted (after {step['after_s']:.1f}s)",
+                )
+        return None
+
+    def _signal_decision(self, now: float) -> Decision | None:
+        if (
+            self._gen_started is not None
+            and now - self._gen_started < self.warmup_s
+        ):
+            return None
+        try:
+            doc = self._fetch_query()
+        except Exception as e:
+            # a dead sensor must be VISIBLE: an autoscaler that silently
+            # never scales is worse than none. Log the first failure of
+            # a streak and every 10th after (the poll cadence would spam
+            # otherwise); the count surfaces in the shutdown summary.
+            self.fetch_failures += 1
+            self._fetch_fail_streak += 1
+            if self._fetch_fail_streak == 1 or (
+                self._fetch_fail_streak % 10 == 0
+            ):
+                self._log(
+                    f"cannot read /query "
+                    f"(failure #{self._fetch_fail_streak} in a row): "
+                    f"{type(e).__name__}: {e}"
+                )
+            self.decider.note_gap(now)
+            return None
+        self._fetch_fail_streak = 0
+        return self.decider.observe(doc, self.workers, time.time())
+
+    def _fetch_query(self) -> dict:
+        import urllib.request
+
+        url = f"http://127.0.0.1:{self.monitor_base}/query"
+        with urllib.request.urlopen(url, timeout=2.0) as r:
+            return json.loads(r.read().decode())
+
+    # -- acting (supervisor planned_stop + launch) ----------------------
+
+    def _planned_stop(self, token: str) -> None:
+        """Between the supervisor's cooperative teardown (= the drain:
+        every worker flushed to its delivery boundary) and the next
+        launch: reshard the persisted state to the target count.
+
+        On ANY failure the pending decision is dropped before the error
+        propagates: the supervisor falls through to its budgeted restart
+        path, and that relaunch must not record a scale event that never
+        happened (nor fire the ``resume`` chaos phase for it)."""
+        try:
+            self._planned_stop_inner()
+        except BaseException:
+            self._pending = None
+            raise
+
+    def _planned_stop_inner(self) -> None:
+        p = self._pending
+        assert p is not None, "planned stop without a pending decision"
+        p["drain_ms"] = (time.monotonic() - p["t0"]) * 1000.0
+        self._fire("drain")
+        target = p["decision"].target
+        t1 = time.monotonic()
+        with _span(
+            "autoscale.reshard", from_workers=self.workers,
+            to_workers=target,
+        ):
+            from ..rescale import NoClusterMarker
+            from ..rescale import rescale as _rescale
+
+            try:
+                report = _rescale(
+                    self._backend_spec(), target, log=self._log
+                )
+            except NoClusterMarker:
+                # the program never committed state yet: there is
+                # nothing to reshard — the new generation simply
+                # boots at the target count and writes the marker
+                report = {"noop": True, "reason": "no persisted state"}
+        p["reshard_ms"] = (time.monotonic() - t1) * 1000.0
+        p["report"] = {
+            k: report.get(k) for k in ("from", "to", "snapshot_time", "noop")
+        }
+        self._fire("reshard")
+        self.workers = target
+        self.decider.note_event(time.time())
+        self._refresh_sup()
+
+    def _launch(self, generation: int, reason: str | None):
+        event = None
+        if self._pending is not None:
+            p, self._pending = self._pending, None
+            d: Decision = p["decision"]
+            event = {
+                "kind": "scale",
+                "t": round(time.time(), 3),
+                "generation": generation,
+                "from": p["from"],
+                "to": self.workers,
+                "direction": d.direction,
+                "reason": d.reason,
+                "signals": d.signals,
+                "drain_ms": round(p.get("drain_ms", 0.0), 1),
+                "reshard_ms": round(p.get("reshard_ms", 0.0), 1),
+                "pause_ms": round(
+                    (time.monotonic() - p["t0"]) * 1000.0, 1
+                ),
+                "report": p.get("report"),
+            }
+            self.events.append(event)
+        env = {
+            **self.base_env,
+            **self._sup.child_env(generation, reason),
+            "PATHWAY_PROCESSES": str(self.workers),
+            # self-heal any marker/worker-count mismatch a killed
+            # controller could leave behind
+            "PATHWAY_ELASTIC": "1",
+            "PATHWAY_AUTOSCALE": (
+                f"{self.min_workers}..{self.max_workers}"
+            ),
+            "PATHWAY_AUTOSCALE_EVENTS": str(len(self.events)),
+        }
+        if self.events:
+            last = self.events[-1]
+            env["PATHWAY_AUTOSCALE_LAST_PAUSE_MS"] = str(last["pause_ms"])
+            env["PATHWAY_AUTOSCALE_LAST_DECISION"] = (
+                f"{last['from']}->{last['to']}: {last['reason']}"
+            )
+        preexec = _set_pdeathsig if os.name == "posix" else None
+        procs = [
+            subprocess.Popen(
+                self.program,
+                env={**env, "PATHWAY_PROCESS_ID": str(pid)},
+                preexec_fn=preexec,
+            )
+            for pid in range(self.workers)
+        ]
+        self._gen_started = time.monotonic()
+        self.decider.reset()
+        self._append_log({
+            "kind": "launch",
+            "t": round(time.time(), 3),
+            "generation": generation,
+            "workers": self.workers,
+            "pids": [pr.pid for pr in procs],
+            "reason": reason,
+        })
+        if event is not None:
+            self._append_log(event)
+            self._fire("resume")
+        return procs
+
+    def _append_log(self, entry: dict) -> None:
+        if not self.log_path:
+            return
+        try:
+            with open(self.log_path, "a") as f:
+                f.write(json.dumps(entry) + "\n")
+        except OSError as e:  # observability must not stop the loop
+            self._log(f"could not append {self.log_path}: {e}")

@@ -1,0 +1,282 @@
+"""Traffic-driven scaling decisions — the autoscaler's pure half.
+
+The :class:`Decider` consumes the merged ``/query`` document the
+observability hub already serves (``observability/hub.py``) and answers
+one question per poll: *should the cluster change size, and to what?*
+It is deliberately free of processes, sockets and clocks-it-didn't-get
+— every input arrives as an argument — so the flapping-resistance
+properties the controller depends on are unit-testable with synthetic
+documents.
+
+Decision rules (knobs in :class:`DeciderConfig`, env-filled by
+``from_env``):
+
+- **scale up** when the worst worker's wall-anchored frontier lag stays
+  above ``up_lag_ms`` for ``up_for_s`` *while input is flowing* (a lag
+  that grows because the stream ended is idleness, not pressure), or
+  when the comm send queues stay at ``up_queue_frac`` of their bound
+  for as long — the PATHWAY_COMM_QUEUE_FRAMES backpressure about to
+  block the tick loop;
+- **scale down** when total ingest+emit falls below ``down_rows_per_s``
+  for ``down_for_s``;
+- **hysteresis**: a breach streak is a run of *consecutive* breaching
+  samples — one non-breaching or missing sample resets it, so a
+  single-sample spike can never trigger;
+- **cooldown**: after any event, no decision for ``cooldown_s`` (the
+  pipeline needs time to redistribute state and re-establish rates);
+- **staleness**: a document older than ``stale_s``, or one whose
+  roll-up marks any worker as served from a cached peer scrape
+  (``stale_workers``), is *refused* — it also resets the streaks,
+  because deciding from frozen numbers is how autoscalers kill
+  clusters; refusals are counted, not silently dropped.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+__all__ = ["Decision", "Decider", "DeciderConfig", "load_scripted_plan"]
+
+
+@dataclass(frozen=True)
+class Decision:
+    target: int
+    direction: str  # "up" | "down"
+    reason: str
+    #: the signal values the decision was made from (event-log payload)
+    signals: dict = field(default_factory=dict)
+
+
+@dataclass
+class DeciderConfig:
+    min_workers: int
+    max_workers: int
+    #: sustained wall-anchored frontier lag that means "falling behind"
+    up_lag_ms: float = 1000.0
+    #: sustained send-queue occupancy (fraction of the queue bound)
+    up_queue_frac: float = 0.5
+    #: total input+output rows/s below which the cluster counts as idle
+    down_rows_per_s: float = 1.0
+    up_for_s: float = 3.0
+    down_for_s: float = 10.0
+    cooldown_s: float = 30.0
+    #: refuse documents older than this, or with stale-marked workers
+    stale_s: float = 10.0
+    #: a hole between valid samples longer than this resets the streaks
+    gap_s: float = 5.0
+    #: workers added/removed per event
+    step: int = 1
+
+    @classmethod
+    def from_env(cls, min_workers: int, max_workers: int) -> "DeciderConfig":
+        from ..internals.config import _env_float, _env_int
+
+        return cls(
+            min_workers=min_workers,
+            max_workers=max_workers,
+            up_lag_ms=_env_float("PATHWAY_AUTOSCALE_UP_LAG_MS", 1000.0),
+            up_queue_frac=_env_float("PATHWAY_AUTOSCALE_UP_QUEUE_FRAC", 0.5),
+            down_rows_per_s=_env_float(
+                "PATHWAY_AUTOSCALE_DOWN_ROWS_PER_S", 1.0
+            ),
+            up_for_s=_env_float("PATHWAY_AUTOSCALE_UP_FOR_S", 3.0),
+            down_for_s=_env_float("PATHWAY_AUTOSCALE_DOWN_FOR_S", 10.0),
+            cooldown_s=_env_float("PATHWAY_AUTOSCALE_COOLDOWN_S", 30.0),
+            stale_s=_env_float("PATHWAY_AUTOSCALE_STALE_S", 10.0),
+            gap_s=_env_float("PATHWAY_AUTOSCALE_GAP_S", 5.0),
+            step=max(1, _env_int("PATHWAY_AUTOSCALE_STEP", 1)),
+        )
+
+
+def _doc_signals(doc: dict) -> dict | None:
+    """Extract the decision inputs from a merged ``/query`` document, or
+    None when the document cannot support a decision (no worker series
+    yet, signals plane off)."""
+    if not doc or not doc.get("signals", True):
+        return None
+    workers = doc.get("workers") or {}
+    if not workers:
+        return None
+    lags = [
+        w.get("frontier_lag_ms")
+        for w in workers.values()
+        if w.get("frontier_lag_ms") is not None
+    ]
+    rate = 0.0
+    saw_rate = False
+    for w in workers.values():
+        for key in ("input_rate", "output_rate"):
+            v = w.get(key)
+            if v is not None:
+                rate += float(v)
+                saw_rate = True
+    # comm section: merged docs key by process, single-process docs are flat
+    comm = doc.get("comm") or {}
+    comm_by_proc = (
+        comm
+        if comm and all(isinstance(v, dict) for v in comm.values())
+        else {"0": comm}
+    )
+    queue_frac = None
+    for c in comm_by_proc.values():
+        depth = (c or {}).get("send_queue_depth")
+        cap = (c or {}).get("send_queue_capacity")
+        if depth is None or not cap:
+            continue
+        frac = float(depth) / float(cap)
+        if queue_frac is None or frac > queue_frac:
+            queue_frac = frac
+    return {
+        "lag_ms": max(lags) if lags else None,
+        "rows_per_s": rate if saw_rate else None,
+        "queue_frac": queue_frac,
+        "n_workers_reporting": len(workers),
+    }
+
+
+class Decider:
+    def __init__(self, cfg: DeciderConfig):
+        self.cfg = cfg
+        self._up_since: float | None = None
+        self._down_since: float | None = None
+        self._last_event_t: float | None = None
+        self._last_sample_t: float | None = None
+        #: documents refused for staleness (observability, not control)
+        self.refusals = 0
+
+    # -- streak management --------------------------------------------
+
+    def note_gap(self, now: float) -> None:
+        """A poll produced no usable sample (endpoint unreachable, doc
+        refused): the streaks lose their continuity evidence."""
+        self._up_since = None
+        self._down_since = None
+
+    def note_event(self, now: float) -> None:
+        """A scale event executed (or a generation [re]launched): start
+        the cooldown and drop streaks built on the old topology."""
+        self._last_event_t = now
+        self._up_since = None
+        self._down_since = None
+        self._last_sample_t = None
+
+    def reset(self) -> None:
+        self._up_since = None
+        self._down_since = None
+        self._last_sample_t = None
+
+    # -- the decision --------------------------------------------------
+
+    def observe(
+        self, doc: dict, current: int, now: float
+    ) -> Decision | None:
+        """Feed one merged ``/query`` document; returns a
+        :class:`Decision` when a sustained condition crosses its
+        hysteresis horizon outside the cooldown, else None."""
+        cfg = self.cfg
+        # staleness guard: refuse to decide from cached peer scrapes or
+        # an old document — and treat the refusal as a gap
+        stale = doc.get("stale_workers") or {}
+        doc_age = now - float(doc.get("t", now))
+        if stale or doc_age > cfg.stale_s:
+            self.refusals += 1
+            self.note_gap(now)
+            return None
+        sig = _doc_signals(doc)
+        if sig is None:
+            self.note_gap(now)
+            return None
+        if (
+            self._last_sample_t is not None
+            and now - self._last_sample_t > cfg.gap_s
+        ):
+            self.note_gap(now)  # sampler hole: streak continuity is gone
+        self._last_sample_t = now
+
+        lag, rows, queue = (
+            sig["lag_ms"], sig["rows_per_s"], sig["queue_frac"]
+        )
+        flowing = rows is not None and rows >= cfg.down_rows_per_s
+        lag_hot = lag is not None and lag > cfg.up_lag_ms and flowing
+        queue_hot = queue is not None and queue >= cfg.up_queue_frac
+        up = lag_hot or queue_hot
+        down = rows is not None and rows < cfg.down_rows_per_s and not up
+        if up:
+            self._down_since = None
+            if self._up_since is None:
+                self._up_since = now
+        elif down:
+            self._up_since = None
+            if self._down_since is None:
+                self._down_since = now
+        else:
+            self._up_since = None
+            self._down_since = None
+
+        if (
+            self._last_event_t is not None
+            and now - self._last_event_t < cfg.cooldown_s
+        ):
+            return None  # cooling down; streaks keep accruing above
+        if (
+            self._up_since is not None
+            and now - self._up_since >= cfg.up_for_s
+            and current < cfg.max_workers
+        ):
+            target = min(cfg.max_workers, current + cfg.step)
+            why = (
+                f"frontier lag {lag:.0f}ms > {cfg.up_lag_ms:.0f}ms"
+                if lag_hot
+                else f"send queue {queue:.2f} >= {cfg.up_queue_frac:.2f}"
+            )
+            return Decision(
+                target, "up", f"{why} for {cfg.up_for_s:.1f}s", sig
+            )
+        if (
+            self._down_since is not None
+            and now - self._down_since >= cfg.down_for_s
+            and current > cfg.min_workers
+        ):
+            target = max(cfg.min_workers, current - cfg.step)
+            return Decision(
+                target,
+                "down",
+                f"idle ({rows:.1f} rows/s < {cfg.down_rows_per_s:.1f}) "
+                f"for {cfg.down_for_s:.1f}s",
+                sig,
+            )
+        return None
+
+
+def load_scripted_plan(spec: str | None = None) -> list[dict]:
+    """Parse ``PATHWAY_AUTOSCALE_PLAN`` — a scripted decision schedule
+    (``[{"after_s": 2.0, "to": 3}, ...]``, inline JSON or a file path)
+    that REPLACES the signal-driven decisions. The determinism hook the
+    chaos suite and the pause bench stand on: a scale event at a known
+    time, independent of load thresholds."""
+    import json
+
+    if spec is None:
+        spec = os.environ.get("PATHWAY_AUTOSCALE_PLAN")
+    if not spec or not spec.strip():
+        return []
+    spec = spec.strip()
+    if not spec.startswith(("[", "{")):
+        # anything not inline JSON is a file path; a "{...}" object is
+        # inline-but-wrong and must get the expected-a-list error below,
+        # not a FileNotFoundError for a file named like JSON
+        with open(spec) as f:
+            spec = f.read()
+    steps = json.loads(spec)
+    if not isinstance(steps, list):
+        raise ValueError("PATHWAY_AUTOSCALE_PLAN: expected a JSON list")
+    out = []
+    for i, s in enumerate(steps):
+        if not isinstance(s, dict) or "after_s" not in s or "to" not in s:
+            raise ValueError(
+                f"PATHWAY_AUTOSCALE_PLAN step #{i}: need after_s and to"
+            )
+        out.append({"after_s": float(s["after_s"]), "to": int(s["to"])})
+    out.sort(key=lambda s: s["after_s"])
+    return out

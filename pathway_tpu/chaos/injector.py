@@ -174,6 +174,13 @@ class ActiveFaults:
         ]
         return RescaleFaults(self, matches) if matches else None
 
+    def autoscale_faults(self) -> "AutoscaleFaults | None":
+        matches = [
+            (i, f) for i, f in enumerate(self.plan.faults)
+            if f.site == "autoscale"
+        ]
+        return AutoscaleFaults(self, matches) if matches else None
+
     def wrap_backend(self, backend: Any, worker_id: int) -> Any:
         matches = [
             (i, f) for i, f in enumerate(self.plan.faults)
@@ -244,6 +251,32 @@ class RescaleFaults:
             else:  # crash
                 raise ChaosInjected(
                     f"chaos: injected crash at rescale phase {phase!r}"
+                )
+
+
+class AutoscaleFaults:
+    """Bound autoscale-site handle for the closed-loop controller: fires
+    at the controller's phase boundaries (decide/drain/reshard/resume) —
+    a ``kill`` here takes down the controller process itself mid-scale,
+    the failure mode the persisted layout must survive at every point."""
+
+    def __init__(self, owner: ActiveFaults, matches: list[tuple[int, Fault]]):
+        self._owner = owner
+        self._matches = matches
+
+    def fire(self, phase: str) -> None:
+        for idx, f in self._matches:
+            if f.phase not in (None, phase):
+                continue
+            if not self._owner._decide(idx, f, f"autoscale/{phase}"):
+                continue
+            if f.action == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif f.action == "exit":
+                os._exit(23)
+            else:  # crash
+                raise ChaosInjected(
+                    f"chaos: injected crash at autoscale phase {phase!r}"
                 )
 
 

@@ -45,6 +45,13 @@ Sites and actions:
   ``action`` is ``crash``, ``exit`` or ``kill``; selected by ``phase``
   and ``nth``. A kill before ``promote`` must leave the OLD layout
   bootable; at/after ``cleanup`` the NEW one — the atomicity proof.
+- ``autoscale`` — the closed-loop autoscale controller's phase
+  boundaries (``autoscale/controller.py``: decide, drain, reshard,
+  resume). ``action`` is ``crash``, ``exit`` or ``kill``; selected by
+  ``phase`` and ``nth``. A kill at ANY phase must leave a bootable
+  persisted layout: the controller only mutates state through the
+  resharder's atomic-marker protocol, so a supervised elastic boot
+  afterwards converges back to a healthy cluster.
 
 Determinism contract: a plan plus its ``seed`` fully determines the
 injection schedule. ``nth``/``tick`` faults are trivially deterministic;
@@ -69,16 +76,24 @@ from typing import Any
 
 __all__ = ["Fault", "FaultPlan", "load_plan_from_env"]
 
-_SITES = ("tick", "comm.send", "comm.local", "persistence.put", "rescale")
+_SITES = (
+    "tick", "comm.send", "comm.local", "persistence.put", "rescale",
+    "autoscale",
+)
 _ACTIONS = {
     "tick": ("crash", "exit", "kill", "hang"),
     "comm.send": ("drop", "delay", "duplicate", "sever", "corrupt"),
     "comm.local": ("drop", "delay"),
     "persistence.put": ("fail", "torn"),
     "rescale": ("crash", "exit", "kill"),
+    "autoscale": ("crash", "exit", "kill"),
 }
 #: rescale-site phase boundaries, in execution order (resharder.py)
 RESCALE_PHASES = ("plan", "stage", "copy", "promote", "cleanup")
+#: autoscale-site phase boundaries, in execution order (controller.py)
+AUTOSCALE_PHASES = ("decide", "drain", "reshard", "resume")
+#: which phase vocabulary each phased site validates against
+_PHASES_BY_SITE = {"rescale": RESCALE_PHASES, "autoscale": AUTOSCALE_PHASES}
 
 
 @dataclass(frozen=True)
@@ -120,11 +135,18 @@ class Fault:
             )
         if self.site == "tick" and self.tick is None:
             raise ValueError("fault plan: tick faults need a 'tick' number")
-        if self.phase is not None and self.phase not in RESCALE_PHASES:
-            raise ValueError(
-                f"fault plan: unknown rescale phase {self.phase!r} "
-                f"(one of {RESCALE_PHASES})"
-            )
+        if self.phase is not None:
+            allowed = _PHASES_BY_SITE.get(self.site)
+            if allowed is None:
+                raise ValueError(
+                    f"fault plan: site {self.site!r} takes no 'phase' "
+                    f"(phased sites: {sorted(_PHASES_BY_SITE)})"
+                )
+            if self.phase not in allowed:
+                raise ValueError(
+                    f"fault plan: unknown {self.site} phase {self.phase!r} "
+                    f"(one of {allowed})"
+                )
         if self.prob is not None and not 0.0 <= self.prob <= 1.0:
             raise ValueError(f"fault plan: prob {self.prob} not in [0, 1]")
 

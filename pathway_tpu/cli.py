@@ -145,15 +145,7 @@ def _run_supervised(
     def launch(generation: int, reason: str | None):
         # late-binds `sup` below; Supervisor.run() only calls launch()
         # after construction completes
-        env = {
-            **base_env,
-            "PATHWAY_SUPERVISED": "1",
-            "PATHWAY_RESTART_COUNT": str(generation),
-            # forensic-bundle count so far → pathway_flight_recorder_dumps_total
-            "PATHWAY_FLIGHT_DUMPS": str(sup.flight_dumps_total),
-        }
-        if reason is not None:
-            env["PATHWAY_LAST_RESTART_REASON"] = reason
+        env = {**base_env, **sup.child_env(generation, reason)}
         return [
             subprocess.Popen(
                 program, env={**env, "PATHWAY_PROCESS_ID": str(pid)}
@@ -212,9 +204,23 @@ def _run_supervised(
                    "different worker count, worker 0 runs the state "
                    "resharder (pathway-tpu rescale) in-process before the "
                    "engine mounts it (sets PATHWAY_ELASTIC=1)")
+@click.option("--autoscale", "autoscale_range", type=str, default=None,
+              metavar="MIN..MAX",
+              help="closed-loop autoscaling: supervise the ensemble AND "
+                   "watch the signals plane (/query on process 0), live-"
+                   "rescaling the cluster between MIN and MAX workers — "
+                   "drain to a delivery boundary, reshard the persisted "
+                   "state, resume. Requires --store; implies --supervise "
+                   "and --elastic; -n is derived from the persisted "
+                   "layout (clamped into the range)")
+@click.option("--store", "autoscale_store", type=str, default=None,
+              help="persistence root the program writes (the path given "
+                   "to pw.persistence.Backend.filesystem) — the state the "
+                   "autoscaler reshards between worker counts")
 @click.argument("program", nargs=-1, type=click.UNPROCESSED)
 def spawn(threads, processes, first_port, record, record_path, addresses,
-          local_ids, supervise, elastic, program):
+          local_ids, supervise, elastic, autoscale_range, autoscale_store,
+          program):
     """Launch PROGRAM with the worker environment set (reference cli.py:53).
 
     Multi-host: run once per machine with the same ``--addresses`` book and
@@ -226,9 +232,101 @@ def spawn(threads, processes, first_port, record, record_path, addresses,
         env_extra["PATHWAY_SNAPSHOT_ACCESS"] = "record"
     if elastic:
         env_extra["PATHWAY_ELASTIC"] = "1"
+    if autoscale_range is not None:
+        sys.exit(_run_autoscaled(threads, autoscale_range, autoscale_store,
+                                 first_port, env_extra, program,
+                                 addresses=addresses, local_ids=local_ids,
+                                 supervise=supervise, processes=processes))
     sys.exit(_spawn_processes(threads, processes, first_port, env_extra,
                               program, addresses=addresses,
                               local_ids=local_ids, supervise=supervise))
+
+
+def _run_autoscaled(threads, autoscale_range, store, first_port, env_extra,
+                    program, *, addresses, local_ids, supervise, processes):
+    """Wire ``spawn --autoscale MIN..MAX`` into an AutoscaleController
+    (autoscale/controller.py): supervision plus the scale loop."""
+    from .autoscale import AutoscaleError, parse_range
+
+    try:
+        mn, mx = parse_range(autoscale_range)
+    except AutoscaleError as e:
+        raise click.ClickException(str(e))
+    if not store:
+        raise click.ClickException(
+            "--autoscale needs --store <persistence root>: live rescaling "
+            "repartitions the program's persisted state between worker "
+            "counts — without persistence there is no state to carry over"
+        )
+    if addresses or local_ids:
+        raise click.ClickException(
+            "--autoscale coordinates drain/reshard/resume for the whole "
+            "ensemble on this machine — it cannot drive a multi-host "
+            "address book or a -p process subset"
+        )
+    if supervise:
+        raise click.ClickException(
+            "--autoscale already supervises the ensemble; drop --supervise"
+        )
+    if processes > 1:
+        raise click.ClickException(
+            "-n conflicts with --autoscale: the worker count is derived "
+            "from the persisted layout (clamped into MIN..MAX)"
+        )
+    if threads * mx > MAX_WORKERS:
+        raise click.ClickException(
+            f"{threads}×{mx} workers at the top of the autoscale range "
+            f"exceed the {MAX_WORKERS}-worker limit"
+        )
+    if not program:
+        raise click.ClickException("pass the program to run, e.g. python app.py")
+    base_env = {
+        **os.environ,
+        "PATHWAY_THREADS": str(threads),
+        "PATHWAY_FIRST_PORT": str(first_port),
+        **env_extra,
+    }
+    base_env.setdefault("PATHWAY_RUN_ID", secrets.token_hex(8))
+    base_env.setdefault(
+        "PATHWAY_FLIGHT_DIR", os.path.join(os.getcwd(), "pathway-flight")
+    )
+    # the controller's sensor is the merged /query document — the
+    # monitoring server is not optional under --autoscale
+    base_env.setdefault("PATHWAY_MONITORING_HTTP_SERVER", "1")
+    if base_env["PATHWAY_MONITORING_HTTP_SERVER"].strip().lower() not in (
+        "1", "true", "yes", "on"
+    ):
+        raise click.ClickException(
+            "--autoscale needs the monitoring server: the controller's "
+            "sensor is the merged /query document on process 0 — unset "
+            "PATHWAY_MONITORING_HTTP_SERVER or set it to 1"
+        )
+    try:
+        monitor_base = int(
+            base_env.get("PATHWAY_MONITORING_HTTP_PORT", "20000") or 20000
+        )
+    except ValueError:
+        monitor_base = 20000
+    if monitor_base <= 0:
+        raise click.ClickException(
+            f"--autoscale cannot watch /query on port {monitor_base}: set "
+            "PATHWAY_MONITORING_HTTP_PORT to a real port"
+        )
+    base_env["PATHWAY_MONITORING_HTTP_PORT"] = str(monitor_base)
+    from .autoscale import AutoscaleController
+
+    try:
+        controller = AutoscaleController(
+            program=list(program),
+            min_workers=mn,
+            max_workers=mx,
+            store=store,
+            base_env=base_env,
+            monitor_base=monitor_base,
+        )
+    except AutoscaleError as e:
+        raise click.ClickException(str(e))
+    return controller.run()
 
 
 @main.command()
@@ -237,8 +335,12 @@ def spawn(threads, processes, first_port, record, record_path, addresses,
 @click.option("--backend", "backend_kind",
               type=click.Choice(["filesystem", "s3"]), default="filesystem",
               help="persistence backend kind holding the state")
+@click.option("--dry-run", is_flag=True, default=False,
+              help="plan only: print the split/merge each stateful "
+                   "operator would undergo and the input tail to re-route, "
+                   "without staging or promoting anything")
 @click.argument("store")
-def rescale(to_workers, backend_kind, store):
+def rescale(to_workers, backend_kind, dry_run, store):
     """Repartition persisted cluster state to --to workers.
 
     STORE is the persistence root (the path given to
@@ -252,17 +354,51 @@ def rescale(to_workers, backend_kind, store):
     from .persistence import Backend
     from .rescale import RescaleError, rescale as _rescale
 
+    if to_workers <= 0:
+        # refuse before touching the store: a nonsensical target must not
+        # depend on what (if anything) is persisted at STORE
+        raise click.ClickException(
+            f"refusing --to {to_workers}: the target worker count must be "
+            ">= 1 (state is hash-sharded across workers; zero shards hold "
+            "nothing)"
+        )
     spec = (
         Backend.filesystem(store)
         if backend_kind == "filesystem"
         else Backend.s3(store)
     )
     try:
-        report = _rescale(spec, to_workers, log=lambda m: click.echo(m, err=True))
+        report = _rescale(
+            spec, to_workers,
+            log=lambda m: click.echo(m, err=True), dry_run=dry_run,
+        )
     except RescaleError as e:
         raise click.ClickException(str(e))
     if report.get("noop"):
-        click.echo(f"already at {to_workers} worker(s) — nothing to do")
+        click.echo(
+            f"store is already laid out for {to_workers} worker(s) — "
+            "nothing to do"
+            + (" (dry run)" if dry_run else "")
+        )
+    elif dry_run:
+        click.echo(
+            f"dry run: would rescale {report['from']} -> {report['to']} "
+            f"worker(s) at snapshot time {report['snapshot_time']} "
+            f"(epoch {report['epoch']} -> {report['epoch'] + 1}):"
+        )
+        for op in report.get("operators", []):
+            click.echo(
+                f"  rank {op['rank']} {op['cls']} [{op['mode']}]: "
+                f"{op['action']} "
+                f"(source snapshot chunks: {op['chunks_per_source']})"
+            )
+        if not report.get("operators"):
+            click.echo("  (no stateful operator snapshots at that time)")
+        click.echo(
+            "  input tail chunks to re-route per source worker: "
+            f"{report.get('tail_chunks_per_source')}"
+        )
+        click.echo(_json.dumps(report))
     else:
         click.echo(_json.dumps(report))
 

@@ -96,6 +96,12 @@ class ObservabilityHub:
         #: view — the difference between "fleet shrank" and "fleet lost
         #: a member" on one scrape.
         self._peer_cache: dict[int, tuple[float, dict]] = {}
+        #: same discipline for the windowed /query roll-up: a peer whose
+        #: /query scrape fails is served from this cache WITH its workers
+        #: named in the merged document's ``stale_workers`` — consumers
+        #: that act on the numbers (the autoscaler's decider) refuse
+        #: stale-marked documents rather than deciding from frozen values
+        self._query_cache: dict[int, tuple[float, dict]] = {}
 
     @classmethod
     def from_config(cls, cfg: Any) -> "ObservabilityHub":
@@ -335,9 +341,10 @@ class ObservabilityHub:
         except Exception:
             return None
 
-    def _scrape_peers_path(self, path: str) -> list[dict]:
+    def _scrape_peers_raw(self, path: str) -> list[dict | None]:
         """Concurrently fetch ``path`` from every peer (same discipline
-        as cluster_snapshots: N hung peers cost one timeout)."""
+        as cluster_snapshots: N hung peers cost one timeout). The result
+        is indexed like ``peer_http`` — None marks a failed scrape."""
         results: list[dict | None] = [None] * len(self.peer_http)
 
         def fetch(i: int, host: str, port: int) -> None:
@@ -352,7 +359,10 @@ class ObservabilityHub:
         deadline = time.monotonic() + _SCRAPE_TIMEOUT_S + 0.5
         for t in threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
-        return [doc for doc in results if doc is not None]
+        return results
+
+    def _scrape_peers_path(self, path: str) -> list[dict]:
+        return [d for d in self._scrape_peers_raw(path) if d is not None]
 
     # -- windowed signal queries (/query, /attribution, /alerts) -------
 
@@ -422,6 +432,12 @@ class ObservabilityHub:
             if plane.slo is not None
             else {"active": [], "history": [], "fired_total": {}}
         )
+        sup = self._supervisor_snapshot()
+        if sup is not None:
+            doc["supervisor"] = sup
+        auto = self._autoscale_snapshot()
+        if auto is not None:
+            doc["autoscale"] = auto
         return doc
 
     def query_document(self) -> dict:
@@ -434,10 +450,46 @@ class ObservabilityHub:
         if not self.peer_http:
             merged = dict(local)
             merged["processes"] = [self.process_id]
+            merged["stale_workers"] = {}
             self._add_cluster_lag(merged)
             return merged
-        peer_docs = self._scrape_peers_path("/query")
+        results = self._scrape_peers_raw("/query")
+        now = time.time()
+        stale_workers: dict[str, float | None] = {}
+        peer_ids = [
+            p for p in range(self.n_processes) if p != self.process_id
+        ]
+        peer_docs: list[dict] = []
+        for i, doc in enumerate(results):
+            if doc is None:
+                self.scrape_errors += 1
+                cached = self._query_cache.get(i)
+                if cached is None:
+                    # never scraped successfully: we cannot serve its
+                    # workers, but the peer must still be VISIBLE as
+                    # missing — an empty stale_workers here would let the
+                    # decider act on a partial view of the cluster
+                    pid = peer_ids[i] if i < len(peer_ids) else i
+                    stale_workers[f"process-{pid}"] = None
+                    continue
+                # serve the last good scrape, but MARK every worker it
+                # carries: a consumer acting on the merged numbers (the
+                # autoscale decider) must see "this value is frozen",
+                # not a plausible-looking live reading
+                seen_at, cached_doc = cached
+                age = now - seen_at
+                doc = dict(cached_doc)
+                doc["workers"] = {
+                    wid: {**w, "stale_s": round(age, 3)}
+                    for wid, w in (cached_doc.get("workers") or {}).items()
+                }
+                for wid in doc["workers"]:
+                    stale_workers[str(wid)] = round(age, 3)
+            else:
+                self._query_cache[i] = (now, doc)
+            peer_docs.append(doc)
         merged = dict(local)
+        merged["stale_workers"] = stale_workers
         merged["workers"] = dict(local.get("workers", {}))
         merged["comm"] = {str(self.process_id): local.get("comm", {})}
         merged["alerts"] = {
@@ -616,6 +668,7 @@ class ObservabilityHub:
             bottleneck=bottleneck,
             alerts_fired=alerts_fired,
             alerts_active=alerts_active,
+            autoscale=self._autoscale_snapshot(),
         )
 
     @staticmethod
@@ -657,6 +710,27 @@ class ObservabilityHub:
             "restarts": int(restarts or 0),
             "reason": os.environ.get("PATHWAY_LAST_RESTART_REASON"),
         }
+        window_failures = os.environ.get("PATHWAY_SUPERVISE_WINDOW_FAILURES")
+        if window_failures is not None:
+            # circuit-breaker window position at this generation's launch:
+            # a restart storm is visible BEFORE the breaker trips. The
+            # budget comes from the same knob the supervisor reads, so
+            # /metrics shows failures/budget and open = exhausted.
+            from ..internals.config import _env_int
+
+            try:
+                failures = int(window_failures)
+            except ValueError:
+                failures = 0
+            budget = _env_int("PATHWAY_SUPERVISE_MAX_RESTARTS", 5)
+            doc["window_failures"] = failures
+            doc["window_budget"] = budget
+            # the supervisor trips at failures > budget and then exits
+            # WITHOUT launching, so no child can ever see a stamp above
+            # the budget — failures == budget is the last-chance
+            # generation (the next failure is terminal) and must read as
+            # open, or the gauge could never fire from a real run
+            doc["circuit_open"] = failures >= budget
         if armed is not None:
             doc["chaos_injections"] = armed.injections_total
         if flight_dumps is not None:
@@ -667,6 +741,34 @@ class ObservabilityHub:
         if rescales["total"]:
             doc["rescales"] = int(rescales["total"])
             doc["rescale_duration_s"] = float(rescales["duration_s"])
+        return doc
+
+    @staticmethod
+    def _autoscale_snapshot() -> dict | None:
+        """Closed-loop autoscaler surface: the controller stamps its
+        range, event count, and last scale decision/pause into every
+        child's environment (autoscale/controller.py), so /metrics and
+        /query on any worker show the loop working. None outside
+        ``spawn --autoscale`` (exposition unchanged elsewhere)."""
+        import os
+
+        rng = os.environ.get("PATHWAY_AUTOSCALE")
+        if not rng:
+            return None
+        doc: dict = {"range": rng}
+        try:
+            doc["events"] = int(os.environ.get("PATHWAY_AUTOSCALE_EVENTS", "0"))
+        except ValueError:
+            doc["events"] = 0
+        pause = os.environ.get("PATHWAY_AUTOSCALE_LAST_PAUSE_MS")
+        if pause is not None:
+            try:
+                doc["last_pause_ms"] = float(pause)
+            except ValueError:
+                pass
+        decision = os.environ.get("PATHWAY_AUTOSCALE_LAST_DECISION")
+        if decision:
+            doc["last_decision"] = decision
         return doc
 
     def health(self) -> tuple[bool, dict]:

@@ -116,6 +116,7 @@ def render_snapshots(
     bottleneck: str | None = None,
     alerts_fired: dict[str, int] | None = None,
     alerts_active: int | None = None,
+    autoscale: dict | None = None,
 ) -> str:
     """Exposition text for a set of worker stats snapshots.
 
@@ -285,6 +286,52 @@ def render_snapshots(
             r.add(
                 "pathway_rescale_duration_seconds", "gauge",
                 float(supervisor.get("rescale_duration_s", 0.0)),
+            )
+        if supervisor.get("window_failures") is not None:
+            # circuit-breaker window position: failures inside the
+            # sliding window at this generation's launch vs the restart
+            # budget — a restart storm building reads as the failure
+            # count climbing toward the budget BEFORE the breaker trips;
+            # open=1 means the LAST-CHANCE generation is running (one
+            # more failure and the supervisor gives up, exit 75)
+            r.add(
+                "pathway_restart_window_failures", "gauge",
+                int(supervisor["window_failures"]),
+            )
+            if supervisor.get("window_budget") is not None:
+                r.add(
+                    "pathway_restart_window_budget", "gauge",
+                    int(supervisor["window_budget"]),
+                )
+            r.add(
+                "pathway_circuit_open", "gauge",
+                1 if supervisor.get("circuit_open") else 0,
+            )
+    if autoscale is not None:
+        # closed-loop autoscaler (spawn --autoscale MIN..MAX): scale
+        # events executed so far and the latest event's pause — stamped
+        # into child environments by the controller per generation
+        r.add(
+            "pathway_autoscale_events_total", "counter",
+            int(autoscale.get("events", 0)),
+            {"range": str(autoscale.get("range", ""))},
+        )
+        if autoscale.get("last_pause_ms") is not None:
+            r.add(
+                "pathway_autoscale_last_pause_ms", "gauge",
+                float(autoscale["last_pause_ms"]),
+            )
+        if autoscale.get("last_decision"):
+            # label only the bounded "from->to" head: the full reason
+            # string embeds measured values (unique per event = unbounded
+            # series cardinality) and already lives in the event log,
+            # /query document and `top` line
+            r.add(
+                "pathway_autoscale_last_decision", "gauge", 1,
+                {
+                    "decision": str(autoscale["last_decision"])
+                    .partition(":")[0].strip()
+                },
             )
     return r.text()
 

@@ -219,8 +219,14 @@ class SloEngine:
 
     def _evaluate_rule(self, rule: Rule, signals: Any, now: float) -> None:
         window = rule.window_s or self.default_window_s
+        # staleness guard: a worker whose series froze (dead worker,
+        # cached peer scrape) must not win the worst-worker comparison
+        # and hold a rule breaching (or block its resolve) forever —
+        # generous bound so scheduler jitter never drops a live worker
+        sample_s = getattr(signals, "sample_s", None)
         value, worker = signals.eval_worst(
-            rule.expr, window, higher_is_worse=rule.higher_is_worse
+            rule.expr, window, higher_is_worse=rule.higher_is_worse,
+            max_age_s=sample_s * 8 if sample_s else None, now=now,
         )
         if value is None or not rule.breaches(value):
             rule.breach_since = None

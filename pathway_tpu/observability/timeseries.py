@@ -175,14 +175,22 @@ def _scalar(metric: str, v: Any) -> float:
 
 class Signals:
     """Windowed queries over a :class:`TimeSeriesStore` — the
-    programmatic input for SLO rules, ``/query``, and the autoscaler."""
+    programmatic input for SLO rules, ``/query``, and the autoscaler.
+
+    ``sample_s`` is the sampler cadence feeding the store, when known
+    (the :class:`SignalsPlane` passes its own). It arms the sampler-gap
+    guard on the sustained predicates: a hole in the samples is a hole
+    in the evidence, not sustained coverage."""
 
     #: expression ops accepted by :meth:`eval` (``op(metric)`` strings)
     OPS = ("rate", "delta", "avg", "min", "max", "last",
            "p50", "p95", "p99")
 
-    def __init__(self, store: TimeSeriesStore):
+    def __init__(
+        self, store: TimeSeriesStore, sample_s: float | None = None
+    ):
         self.store = store
+        self.sample_s = sample_s
 
     # -- scalar queries -----------------------------------------------
 
@@ -249,12 +257,25 @@ class Signals:
     ) -> bool:
         """True when every sample in the last ``for_s`` seconds breaches
         the threshold AND the samples actually cover ``for_s`` (a store
-        younger than the horizon cannot claim a sustained breach)."""
+        younger than the horizon cannot claim a sustained breach; a
+        sampler gap inside the horizon is missing evidence, not
+        coverage)."""
         pts = self.store.points(metric, worker, for_s)
         if len(pts) < 2:
             return False
         if pts[-1][0] - pts[0][0] < for_s * 0.95:
             return False
+        if self.sample_s:
+            # two breaching samples with a dead sampler in between do not
+            # prove the signal breached throughout — the metric may have
+            # recovered and re-breached inside the hole. Tolerate a few
+            # missed samples (scheduler jitter), refuse a real gap.
+            gap_limit = self.sample_s * 4
+            if any(
+                t1 - t0 > gap_limit
+                for (t0, _a), (t1, _b) in zip(pts, pts[1:])
+            ):
+                return False
         if above:
             return all(_scalar(metric, v) > threshold for _t, v in pts)
         return all(_scalar(metric, v) < threshold for _t, v in pts)
@@ -310,10 +331,17 @@ class Signals:
 
     def eval_worst(
         self, expr: str, window_s: float, higher_is_worse: bool = True,
+        max_age_s: float | None = None, now: float | None = None,
     ) -> tuple[float | None, int | None]:
         """Evaluate across every worker (falling back to the
         process-level series when no worker has the metric) and return
-        (worst value, worker) — what a threshold rule compares."""
+        (worst value, worker) — what a threshold rule compares.
+
+        ``max_age_s`` is the staleness guard: a worker whose NEWEST
+        sample for the metric is older than that is excluded entirely —
+        its series froze (the worker died, or its peer scrape is being
+        served from a cache), and a frozen value must not win a
+        worst-worker comparison and drive a decision."""
         metric = expr
         if expr.endswith(")") and "(" in expr:
             metric = expr.partition("(")[2][:-1].strip()
@@ -323,6 +351,13 @@ class Signals:
         ]
         if not candidates:
             candidates = [None]
+        if max_age_s is not None:
+            cutoff = (time.time() if now is None else now) - max_age_s
+            candidates = [
+                w for w in candidates
+                if (pts := self.store.points(metric, w))
+                and pts[-1][0] >= cutoff
+            ]
         worst: float | None = None
         worst_w: int | None = None
         for w in candidates:
@@ -363,7 +398,7 @@ class SignalsPlane:
         self.store = TimeSeriesStore(
             int(self.window_s / self.sample_s) + 8
         )
-        self.signals = Signals(self.store)
+        self.signals = Signals(self.store, sample_s=self.sample_s)
         self.slo = slo_engine
         self.samples_taken = 0
         self._stop = threading.Event()

@@ -59,6 +59,7 @@ def render_frame(doc: dict, now: float | None = None) -> str:
         lag = w.get("frontier_lag_vs_max_ms")
         if lag is None:
             lag = w.get("frontier_lag_ms")
+        stale = w.get("stale_s")
         lines.append(
             f"{wid:>6} {_fmt(w.get('tick_rate')):>8} "
             f"{_fmt(w.get('row_rate')):>10} "
@@ -66,6 +67,7 @@ def render_frame(doc: dict, now: float | None = None) -> str:
             f"{_fmt(lag):>9} "
             f"{_fmt(w.get('tick_p95_ms'), nd=2):>9} "
             f"{_fmt(w.get('e2e_p95_ms'), nd=2):>9}"
+            + (f"  STALE {stale:.0f}s" if stale is not None else "")
         )
     if not workers:
         lines.append("  (no worker series yet — sampler warming up)")
@@ -86,6 +88,29 @@ def render_frame(doc: dict, now: float | None = None) -> str:
             f" frames, {_fmt(c.get('send_mb_per_sec'), ' MB/s', 2)}, "
             f"inbox {_fmt(c.get('cluster_inbox_depth'), nd=0)}"
         )
+    sup = doc.get("supervisor")
+    if sup is not None and sup.get("window_failures") is not None:
+        budget = sup.get("window_budget")
+        breaker = (
+            "OPEN" if sup.get("circuit_open")
+            else f"{sup['window_failures']}/{_fmt(budget, nd=0)} window"
+        )
+        lines.append(
+            f"supervisor: {_fmt(sup.get('restarts'), nd=0)} restart(s), "
+            f"breaker {breaker}"
+            + (f" — last: {sup['reason']}" if sup.get("reason") else "")
+        )
+    auto = doc.get("autoscale")
+    if auto is not None:
+        line = (
+            f"autoscale [{auto.get('range')}]: "
+            f"{_fmt(auto.get('events'), nd=0)} scale event(s)"
+        )
+        if auto.get("last_decision"):
+            line += f", last {auto['last_decision']}"
+        if auto.get("last_pause_ms") is not None:
+            line += f" (pause {auto['last_pause_ms']:.0f} ms)"
+        lines.append(line)
     att = doc.get("attribution") or {}
     bottleneck = att.get("bottleneck")
     if bottleneck:

@@ -744,6 +744,12 @@ class ClusterComm(Comm):
             "send_queue_depth": float(
                 sum(w.queue_depth() for w in self._writers.values())
             ),
+            # the depth's denominator: PATHWAY_COMM_QUEUE_FRAMES per
+            # outbound pipeline — depth/capacity is the saturation
+            # fraction the autoscaler's scale-up rule watches
+            "send_queue_capacity": float(
+                self._queue_frames * max(1, len(self._writers))
+            ),
             "encode_seconds_total": self.encode_ns / 1e9,
             "cluster_inbox_depth": float(len(self._inbox)),
             "cluster_broken": float(self._broken is not None),

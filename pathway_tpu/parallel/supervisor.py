@@ -87,6 +87,8 @@ class Supervisor:
         flight_dir: str | None = None,
         process_ids: Sequence[int] | None = None,
         run_id: str | None = None,
+        poll_hook: Callable[[], str | None] | None = None,
+        planned_stop: Callable[[str], Any] | None = None,
     ):
         from ..internals.config import _env_float, _env_int
 
@@ -149,22 +151,93 @@ class Supervisor:
             if run_id is not None
             else os.environ.get("PATHWAY_RUN_ID")
         )
+        #: called every watch poll while the generation is healthy; a
+        #: non-None token requests a PLANNED stop: cooperative teardown
+        #: (the drain-to-delivery-boundary the persistence close protocol
+        #: guarantees), then ``planned_stop(token)``, then relaunch —
+        #: without burning restart budget. The autoscale controller's
+        #: seam into the supervision loop.
+        self.poll_hook = poll_hook
+        self.planned_stop = planned_stop
+        self._planned: str | None = None
         self.restarts_total = 0
         self.last_restart_reason: str | None = None
         self.flight_dumps_total = 0
+        #: failures inside the current circuit-breaker window at the
+        #: moment the current generation launched — the CLI stamps it
+        #: into child environments (PATHWAY_SUPERVISE_WINDOW_FAILURES) so
+        #: /metrics shows a restart storm building BEFORE the breaker
+        #: trips (pathway_circuit_open / pathway_restart_window_failures)
+        self.window_failures = 0
         #: Popen indices implicated in the current generation's failure
         #: (dead exit code or served-503 wedge) — the rings worth harvesting
         self._failed_indices: list[int] = []
 
     # -- lifecycle -------------------------------------------------------
 
+    def child_env(self, generation: int, reason: str | None) -> dict[str, str]:
+        """The supervision stamps every launched child must carry — the
+        observability hub reads exactly these keys for /metrics
+        (pathway_restarts_total, pathway_flight_recorder_dumps_total,
+        pathway_restart_window_failures / pathway_circuit_open,
+        pathway_last_restart_reason). One source of truth for every
+        launcher (cli ``spawn --supervise`` and the autoscale
+        controller), so supervised and autoscaled runs cannot drift
+        apart in what they export."""
+        env = {
+            "PATHWAY_SUPERVISED": "1",
+            "PATHWAY_RESTART_COUNT": str(generation),
+            # forensic-bundle count so far
+            "PATHWAY_FLIGHT_DUMPS": str(self.flight_dumps_total),
+            # circuit-breaker window position at launch: a restart storm
+            # is visible on the children's /metrics BEFORE it trips
+            "PATHWAY_SUPERVISE_WINDOW_FAILURES": str(self.window_failures),
+        }
+        if reason is not None:
+            env["PATHWAY_LAST_RESTART_REASON"] = reason
+        return env
+
     def run(self) -> int:
         restart_times: deque[float] = deque()
         generation = 0
         reason: str | None = None
         while True:
+            now = time.monotonic()
+            while restart_times and now - restart_times[0] > self.window_s:
+                restart_times.popleft()
+            self.window_failures = len(restart_times)
             procs = list(self.launch(generation, reason))
             reason = self._watch(procs)
+            if self._planned is not None:
+                # a PLANNED stop (autoscale rescale): cooperative teardown
+                # drains every worker to its delivery boundary, then the
+                # planned_stop hook runs (state resharding) and the next
+                # generation launches immediately — no backoff, and no
+                # restart-budget burn (a scale event is not a failure)
+                token, self._planned = self._planned, None
+                self._teardown(procs)
+                try:
+                    if self.planned_stop is not None:
+                        self.planned_stop(token)
+                except Exception as e:
+                    from ..chaos.injector import ChaosInjected
+
+                    if isinstance(e, ChaosInjected):
+                        # same carve-out as the poll-hook guard: an
+                        # injected crash at a drain/reshard phase must
+                        # CRASH the controller, not become a budgeted
+                        # restart that leaves the run exiting 0
+                        raise
+                    # a failed planned stop (resharder refused, store
+                    # gone) IS a failure: fall through to the budgeted
+                    # restart path so a broken rescale loop trips the
+                    # breaker instead of spinning forever
+                    reason = f"planned stop failed ({token}): {e}"
+                else:
+                    self._log(f"planned restart: {token}")
+                    generation += 1
+                    reason = token
+                    continue
             if reason is None:
                 return 0  # every process exited 0 — the run completed
             self._teardown(procs)
@@ -248,6 +321,25 @@ class Supervisor:
                 if wedged is not None:
                     return wedged
                 next_health = time.monotonic() + self.health_interval_s
+            if self.poll_hook is not None:
+                try:
+                    token = self.poll_hook()
+                except Exception as e:
+                    from ..chaos.injector import ChaosInjected
+
+                    if isinstance(e, ChaosInjected):
+                        # an injected controller crash must CRASH the
+                        # controller — absorbing it would make the
+                        # autoscale chaos site's "crash" action a no-op
+                        # that re-fires on every poll
+                        raise
+                    # an ordinary hook failure (signal fetch + decision)
+                    # must never take the supervision loop down with it
+                    self._log(f"poll hook failed: {e}")
+                    token = None
+                if token:
+                    self._planned = token
+                    return None
             time.sleep(self.poll_interval_s)
 
     def _check_health(self) -> str | None:

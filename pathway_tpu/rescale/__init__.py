@@ -11,6 +11,6 @@ for M workers under the next epoch's namespaces — staged under
 ``rescale-tmp/`` and promoted by one atomic ``cluster``-marker rewrite.
 """
 
-from .resharder import RescaleError, rescale, stats
+from .resharder import NoClusterMarker, RescaleError, rescale, stats
 
-__all__ = ["rescale", "stats", "RescaleError"]
+__all__ = ["rescale", "stats", "RescaleError", "NoClusterMarker"]

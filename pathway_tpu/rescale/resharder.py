@@ -67,11 +67,19 @@ from ..persistence.snapshots import (
     _delta_parts,
 )
 
-__all__ = ["rescale", "stats", "RescaleError"]
+__all__ = ["rescale", "stats", "RescaleError", "NoClusterMarker"]
 
 
 class RescaleError(RuntimeError):
     pass
+
+
+class NoClusterMarker(RescaleError):
+    """The store has no cluster marker: nothing was ever persisted, so
+    there is nothing to rescale. Consumers that can proceed without
+    state (the autoscale controller: the next generation simply boots at
+    the target count and writes the marker) catch THIS, not a message
+    substring."""
 
 
 #: process-local counters surfaced as ``pathway_rescale_total`` /
@@ -88,11 +96,16 @@ def _default_log(msg: str) -> None:
 
 
 def rescale(
-    backend: Any, to_workers: int, *, log: Callable[[str], Any] | None = None
+    backend: Any, to_workers: int, *,
+    log: Callable[[str], Any] | None = None, dry_run: bool = False,
 ) -> dict:
     """Repartition the persisted state in ``backend`` to ``to_workers``
     workers. ``backend`` is a ``PersistenceBackend`` instance or a
-    ``pw.persistence.Backend`` descriptor. Returns a report dict."""
+    ``pw.persistence.Backend`` descriptor. Returns a report dict.
+
+    ``dry_run`` stops after the plan phase: the report carries the
+    per-operator split/merge plan (rank, class, reshard mode, source
+    chunk counts) and nothing — not even staging keys — is written."""
     log = log or _default_log
     t0 = _time.monotonic()
     close_after = False
@@ -102,13 +115,13 @@ def rescale(
         root = open_backend(backend)
         close_after = True
     try:
-        report = _rescale_root(root, int(to_workers), log)
+        report = _rescale_root(root, int(to_workers), log, dry_run=dry_run)
     finally:
         if close_after:
             root.close()
     dt = _time.monotonic() - t0
     report["duration_s"] = round(dt, 6)
-    if not report.get("noop"):
+    if not report.get("noop") and not dry_run:
         _STATS["total"] += 1
         _STATS["duration_s"] += dt
         _STATS["last"] = report
@@ -213,8 +226,75 @@ def _pick_snapshot_time(metas: list[dict]) -> int:
     return -1
 
 
+def _dry_run_report(
+    report: dict, metas: list[dict], snap_time: int,
+    n_from: int, to_workers: int,
+) -> dict:
+    """Fill the plan-only report: per-operator split/merge actions by
+    reshard mode, plus the input-tail chunks each worker would replay.
+
+    Refuses exactly what the real run refuses (per-worker operator-count
+    mismatch): a dry run that prints a confident plan for a store the
+    real rescale would reject defeats its preview purpose."""
+    ops_plan: list[dict] = []
+    if snap_time >= 0:
+        entries = [
+            next(
+                e["ops"] for e in (m.get("op_snapshots") or [])
+                if int(e["time"]) == snap_time
+            )
+            for m in metas
+        ]
+        rank_counts = {len(e) for e in entries}
+        if len(rank_counts) > 1:
+            raise RescaleError(
+                f"workers disagree on the stateful-operator count at "
+                f"snapshot time {snap_time}: {sorted(rank_counts)} — the "
+                "dataflow changed between workers?"
+            )
+        n_ranks = max(len(e) for e in entries)
+        for rank in range(n_ranks):
+            descs = [e.get(str(rank)) or e.get(rank) for e in entries]
+            present = [d for d in descs if d is not None]
+            cls_name = present[0]["cls"] if present else "?"
+            try:
+                mode = getattr(_node_class(cls_name), "RESHARD", "keyed")
+            except RescaleError:
+                mode = "unresolved"
+            action = {
+                "keyed": (
+                    f"split {n_from} piece(s) by key shard, merge into "
+                    f"{to_workers} worker(s)"
+                ),
+                "pinned": "keep worker-0 piece (single-owner composite)",
+                "replicate": (
+                    f"field-wise union replicated to all {to_workers} "
+                    "worker(s)"
+                ),
+            }.get(mode, f"cannot plan (mode {mode})")
+            ops_plan.append({
+                "rank": rank,
+                "cls": cls_name,
+                "mode": mode,
+                "action": action,
+                "chunks_per_source": [
+                    int(d["chunks"]) if d is not None else None
+                    for d in descs
+                ],
+            })
+    report["ranks"] = len(ops_plan)
+    report["operators"] = ops_plan
+    report["tail_chunks_per_source"] = [
+        max(0, int(m.get("n_chunks", 0)) - int(m.get("first_chunk", 0)))
+        for m in metas
+    ]
+    report["dry_run"] = True
+    return report
+
+
 def _rescale_root(
-    root: PersistenceBackend, to_workers: int, log: Callable[[str], Any]
+    root: PersistenceBackend, to_workers: int, log: Callable[[str], Any],
+    dry_run: bool = False,
 ) -> dict:
     from ..chaos import injector as _chaos
 
@@ -237,7 +317,7 @@ def _rescale_root(
 
     marker = _layout.read_marker(root)
     if marker is None:
-        raise RescaleError(
+        raise NoClusterMarker(
             f"no cluster marker at {root.describe()}: nothing to rescale"
         )
     n_from, epoch = marker
@@ -263,7 +343,9 @@ def _rescale_root(
             metas.append(cur or {})
         if len(missing) == n_from:
             # marker without any committed state: adopt the new count
-            _layout.write_marker(root, to_workers, epoch)
+            # (a dry run must not write even this)
+            if not dry_run:
+                _layout.write_marker(root, to_workers, epoch)
             report["noop"] = True
             return report
         if missing:
@@ -274,6 +356,10 @@ def _rescale_root(
             )
         snap_time = _pick_snapshot_time(metas)
         report["snapshot_time"] = snap_time
+    if dry_run:
+        # plan only: name what the real run WOULD do per operator, write
+        # nothing (no staging keys, no marker, no chaos protocol)
+        return _dry_run_report(report, metas, snap_time, n_from, to_workers)
     fire("plan")
 
     # stale staging from a previously crashed attempt is garbage — clear it

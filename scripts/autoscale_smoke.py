@@ -1,0 +1,410 @@
+"""Closed-loop autoscaler smoke test: traffic-driven live rescaling
+with zero dropped rows.
+
+Exercises ``pathway-tpu spawn --autoscale MIN..MAX`` end to end with
+real processes, composing the signals plane (sensor), the decider
+(policy), the supervisor (actuator), and the state resharder (the
+atomic N→M repartition):
+
+1. **scripted scale event** (``run_scripted``): a persisted streaming
+   wordcount runs under ``--autoscale 1..2`` with a scripted decision
+   schedule (``PATHWAY_AUTOSCALE_PLAN``) — mid-stream the controller
+   drains the generation to its delivery boundary, reshards 1→2, and
+   resumes on two workers; the final counts are EXACT and the event log
+   records the measured ``pause_ms``;
+2. **chaos at every phase** (``run_chaos``): the controller process is
+   SIGKILLed at an ``autoscale`` chaos-site phase boundary
+   (decide/drain/reshard/resume) mid-scale — the persisted layout must
+   stay bootable (the resharder's atomic-marker protocol) and a fresh
+   ``spawn --autoscale`` run converges to the exact expected counts;
+3. **signal-driven ramp** (``run_ramp``, slow): a load ramp through a
+   deliberately slow per-row UDF grows the frontier lag the decider
+   watches → scale UP within MIN..MAX; the quiet period after the ramp
+   starves the windowed row rates → scale DOWN; the final output is
+   multiset-equal to an unsharded baseline run of the same program
+   (rows lost = 0) and every event carries its pause.
+
+Usable standalone (``python scripts/autoscale_smoke.py [--slow]`` →
+exit 0/1) and as tier-1/slow tests (``tests/test_autoscale_smoke.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: scripted/chaos stream: 32 rows at 80 ms — still mid-stream when the
+#: scripted decision fires at 1.2 s
+EXPECTED = {"foo": 16, "bar": 8, "baz": 8}
+#: ramp stream: 152 fast rows through a slow UDF, a quiet gap, 3 tail rows
+EXPECTED_RAMP = {"alpha": 77, "beta": 39, "gamma": 39}
+
+_PROGRAM = """
+import json, os, sys, time
+
+import pathway_tpu as pw
+from pathway_tpu.persistence import Backend, Config
+
+out_path, pstate = sys.argv[1], sys.argv[2]
+ramp = os.environ.get("SMOKE_RAMP") == "1"
+
+if ramp:
+    WORDS = ["alpha", "beta", "alpha", "gamma"] * 38  # 152 rows at 20 ms
+    TAIL = ["alpha", "beta", "gamma"]
+    EMIT_SLEEP, QUIET_S = 0.02, 8.0
+else:
+    WORDS = ["foo", "bar", "foo", "baz"] * 8  # 32 rows at 80 ms
+    TAIL = []
+    EMIT_SLEEP, QUIET_S = 0.08, 0.0
+
+
+class S(pw.io.python.ConnectorSubject):
+    def run(self):
+        for w in WORDS:
+            self.next(word=w)
+            self.commit()
+            time.sleep(EMIT_SLEEP)
+        if QUIET_S:
+            time.sleep(QUIET_S)
+        for w in TAIL:
+            self.next(word=w)
+            self.commit()
+            time.sleep(0.05)
+
+
+t = pw.io.python.read(
+    S(), schema=pw.schema_from_types(word=str), name="words",
+    autocommit_ms=None,
+)
+if ramp:
+    def crawl(w):
+        # deliberately slow AND impure: the lifter refuses it, so every
+        # row pays the sleep on the per-row path — ingest (20 ms/row)
+        # outruns processing (30 ms/row) and the frontier lag the
+        # autoscaler watches grows for real
+        time.sleep(0.03)
+        return w
+
+    t = t.select(word=pw.apply(crawl, pw.this.word))
+counts = t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+f = open(out_path, "a")
+
+
+def on_change(key, row, time, is_addition):
+    f.write(json.dumps([row["word"], int(row["c"]), bool(is_addition)]) + "\\n")
+    f.flush()
+
+
+pw.io.subscribe(counts, on_change=on_change)
+cfg = Config.simple_config(Backend.filesystem(pstate), snapshot_interval_ms=10)
+pw.run(persistence_config=cfg)
+"""
+
+#: the four autoscale chaos-site phase boundaries (chaos/plan.py)
+PHASES = ("decide", "drain", "reshard", "resume")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _events_out(path: str) -> list:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:  # a SIGKILL may tear the last line mid-write
+                out.append(json.loads(line))
+            except (json.JSONDecodeError, ValueError):
+                pass
+    return out
+
+
+def _finals(events: list) -> dict:
+    final: dict = {}
+    for e in events:
+        if len(e) == 3 and e[2]:
+            final[e[0]] = e[1]
+    return final
+
+
+def _scale_events(log_path: str) -> list[dict]:
+    return [e for e in _events_out(log_path) if e.get("kind") == "scale"]
+
+
+def _marker(pstate: str) -> dict:
+    with open(os.path.join(pstate, "cluster")) as f:
+        return json.load(f)
+
+
+def _marker_or_none(pstate: str) -> dict | None:
+    try:
+        return _marker(pstate)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _spawn(args, env, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "pathway_tpu.cli", *args],
+        env=env, timeout=timeout, capture_output=True, text=True,
+    )
+
+
+def _base_env(tmp: str) -> dict:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo_root,
+        "PATHWAY_FLIGHT_DIR": os.path.join(tmp, "flight"),
+        "PATHWAY_MONITORING_HTTP_PORT": str(_free_port()),
+        "PATHWAY_SUPERVISE_BACKOFF_S": "0.05",
+        "PATHWAY_SUPERVISE_BACKOFF_MAX_S": "0.2",
+        "PATHWAY_AUTOSCALE_POLL_S": "0.3",
+    }
+    for k in ("PATHWAY_FAULT_PLAN", "PATHWAY_AUTOSCALE_PLAN"):
+        env.pop(k, None)
+    return env
+
+
+def _write_program(tmp: str) -> str:
+    prog = os.path.join(tmp, "prog.py")
+    with open(prog, "w") as f:
+        f.write(textwrap.dedent(_PROGRAM))
+    return prog
+
+
+def run_scripted(verbose: bool = False, workdir: str | None = None) -> dict:
+    """One scripted 1→2 scale event mid-stream: exact final counts, the
+    promoted 2-worker layout, and a recorded pause."""
+    tmp = workdir or tempfile.mkdtemp(prefix="autoscale_smoke_")
+    prog = _write_program(tmp)
+    pstate = os.path.join(tmp, "pstate")
+    out = os.path.join(tmp, "events.jsonl")
+    log = os.path.join(tmp, "autoscale.jsonl")
+    env = {
+        **_base_env(tmp),
+        "PATHWAY_AUTOSCALE_PLAN": json.dumps([{"after_s": 1.2, "to": 2}]),
+        "PATHWAY_AUTOSCALE_LOG": log,
+    }
+    proc = _spawn(
+        ["spawn", "--autoscale", "1..2", "--store", pstate,
+         "--first-port", str(_free_port()), sys.executable, prog, out,
+         pstate],
+        env,
+    )
+    assert proc.returncode == 0, (
+        f"autoscaled run exited {proc.returncode}\n"
+        f"stderr:\n{proc.stderr[-3000:]}"
+    )
+    finals = _finals(_events_out(out))
+    assert finals == EXPECTED, (
+        f"final counts {finals} != {EXPECTED} — rows were lost or "
+        f"double-counted across the scale event\nstderr:\n"
+        f"{proc.stderr[-2000:]}"
+    )
+    scales = _scale_events(log)
+    assert len(scales) == 1, f"expected exactly one scale event: {scales}"
+    ev = scales[0]
+    assert ev["from"] == 1 and ev["to"] == 2 and ev["direction"] == "up", ev
+    assert ev["pause_ms"] > 0, f"pause not measured: {ev}"
+    assert ev["pause_ms"] < 60_000, f"pause unbounded: {ev}"
+    assert ev["drain_ms"] >= 0 and ev["reshard_ms"] >= 0, ev
+    assert _marker(pstate)["n_workers"] == 2
+    if verbose:
+        print(
+            f"autoscale_smoke scripted: 1->2 mid-stream, pause "
+            f"{ev['pause_ms']:.0f} ms (drain {ev['drain_ms']:.0f}, "
+            f"reshard {ev['reshard_ms']:.0f}), finals exact"
+        )
+    return {"finals": finals, "event": ev}
+
+
+def run_chaos(
+    phases=PHASES, verbose: bool = False, workdir: str | None = None,
+) -> dict:
+    """SIGKILL the controller at each autoscale phase boundary mid-scale;
+    the layout must stay bootable and a fresh autoscaled run must finish
+    with exact counts."""
+    tmp = workdir or tempfile.mkdtemp(prefix="autoscale_chaos_")
+    prog = _write_program(tmp)
+    results: dict = {}
+    for phase in phases:
+        pstate = os.path.join(tmp, f"pstate_{phase}")
+        out = os.path.join(tmp, f"events_{phase}.jsonl")
+        env = _base_env(tmp)
+        kill_env = {
+            **env,
+            # later than the scripted case's 1.2 s: give the generation
+            # time to boot and commit state, so the kill lands on a
+            # store that actually has a layout to corrupt
+            "PATHWAY_AUTOSCALE_PLAN": json.dumps(
+                [{"after_s": 2.5, "to": 2}]
+            ),
+            "PATHWAY_FAULT_PLAN": json.dumps({
+                "seed": 7,
+                "faults": [
+                    {"site": "autoscale", "phase": phase, "action": "kill"},
+                ],
+            }),
+        }
+        proc = _spawn(
+            ["spawn", "--autoscale", "1..2", "--store", pstate,
+             "--first-port", str(_free_port()), sys.executable, prog, out,
+             pstate],
+            kill_env,
+        )
+        assert proc.returncode != 0, (
+            f"[{phase}] the chaos kill did not fire\n"
+            f"stderr:\n{proc.stderr[-2000:]}"
+        )
+        # bootability invariant: whichever side of the commit point the
+        # kill landed on, the marker (if any state was committed at all)
+        # names a COMPLETE layout — a kill before the first commit
+        # leaves a fresh store, which is trivially bootable too
+        marker = _marker_or_none(pstate)
+        assert marker is None or marker["n_workers"] in (1, 2), marker
+        partial = _finals(_events_out(out))
+        assert partial != EXPECTED, (
+            f"[{phase}] the stream finished before the kill — the chaos "
+            "case proved nothing"
+        )
+        # resume: a fresh controller (no plan, no faults) boots whatever
+        # the marker says, under supervision, and finishes the stream
+        proc = _spawn(
+            ["spawn", "--autoscale", "1..2", "--store", pstate,
+             "--first-port", str(_free_port()), sys.executable, prog, out,
+             pstate],
+            env,
+        )
+        assert proc.returncode == 0, (
+            f"[{phase}] resume after controller SIGKILL exited "
+            f"{proc.returncode}\nstderr:\n{proc.stderr[-3000:]}"
+        )
+        finals = _finals(_events_out(out))
+        assert finals == EXPECTED, (
+            f"[{phase}] resumed counts {finals} != {EXPECTED} (marker "
+            f"after kill: {marker})\nstderr:\n{proc.stderr[-2000:]}"
+        )
+        results[phase] = {
+            "marker_after_kill": marker, "finals": finals,
+        }
+        if verbose:
+            print(
+                f"autoscale_smoke chaos[{phase}]: killed mid-scale with "
+                f"marker {marker}, resumed to exact counts"
+            )
+    return results
+
+
+def run_ramp(verbose: bool = False, workdir: str | None = None) -> dict:
+    """Signal-driven loop: a load ramp scales 1→2 up on sustained
+    frontier lag, the quiet period after it scales 2→1 down on starved
+    row rates, and the final output is multiset-equal to an unsharded
+    baseline run of the same program."""
+    tmp = workdir or tempfile.mkdtemp(prefix="autoscale_ramp_")
+    prog = _write_program(tmp)
+
+    # -- unsharded baseline: same program, plain 1-process spawn ---------
+    base_out = os.path.join(tmp, "baseline.jsonl")
+    base_state = os.path.join(tmp, "pstate_baseline")
+    env = {**_base_env(tmp), "SMOKE_RAMP": "1"}
+    proc = _spawn(
+        ["spawn", "-n", "1", "--first-port", str(_free_port()),
+         sys.executable, prog, base_out, base_state],
+        env,
+    )
+    assert proc.returncode == 0, (
+        f"baseline run exited {proc.returncode}\n"
+        f"stderr:\n{proc.stderr[-3000:]}"
+    )
+    baseline = _finals(_events_out(base_out))
+    assert baseline == EXPECTED_RAMP, (
+        f"baseline counts {baseline} != {EXPECTED_RAMP}"
+    )
+
+    # -- autoscaled run under the same load profile ----------------------
+    pstate = os.path.join(tmp, "pstate")
+    out = os.path.join(tmp, "events.jsonl")
+    log = os.path.join(tmp, "autoscale.jsonl")
+    auto_env = {
+        **env,
+        "PATHWAY_AUTOSCALE_LOG": log,
+        # aggressive-but-hysteretic policy so the ~15 s profile exercises
+        # both directions: lag > 250 ms sustained 0.75 s scales up,
+        # windowed rows/s < 0.5 sustained 1.5 s scales down
+        "PATHWAY_SIGNALS_SAMPLE_S": "0.1",
+        "PATHWAY_SIGNALS_WINDOW_S": "4",
+        "PATHWAY_AUTOSCALE_UP_LAG_MS": "250",
+        "PATHWAY_AUTOSCALE_UP_FOR_S": "0.75",
+        "PATHWAY_AUTOSCALE_DOWN_ROWS_PER_S": "0.5",
+        "PATHWAY_AUTOSCALE_DOWN_FOR_S": "1.5",
+        "PATHWAY_AUTOSCALE_COOLDOWN_S": "6",
+        "PATHWAY_AUTOSCALE_WARMUP_S": "1.0",
+    }
+    proc = _spawn(
+        ["spawn", "--autoscale", "1..2", "--store", pstate,
+         "--first-port", str(_free_port()), sys.executable, prog, out,
+         pstate],
+        auto_env, timeout=600,
+    )
+    assert proc.returncode == 0, (
+        f"ramp run exited {proc.returncode}\nstderr:\n{proc.stderr[-4000:]}"
+    )
+    finals = _finals(_events_out(out))
+    assert finals == baseline, (
+        f"autoscaled counts {finals} != unsharded baseline {baseline} — "
+        f"rows lost across scale events\nstderr:\n{proc.stderr[-2000:]}"
+    )
+    scales = _scale_events(log)
+    ups = [e for e in scales if e["direction"] == "up"]
+    downs = [e for e in scales if e["direction"] == "down"]
+    assert ups, f"the load ramp never scaled up: {scales}"
+    assert downs, f"the quiet period never scaled down: {scales}"
+    assert all(1 <= e["to"] <= 2 for e in scales), scales
+    assert all(e["pause_ms"] > 0 for e in scales), scales
+    # the up decision must come from the ramp's lag/traffic, not from a
+    # stale scrape (the decider refuses those) — its signals are recorded
+    assert ups[0]["reason"], ups[0]
+    if verbose:
+        pauses = ", ".join(f"{e['pause_ms']:.0f}" for e in scales)
+        print(
+            f"autoscale_smoke ramp: {len(ups)} up / {len(downs)} down, "
+            f"pauses [{pauses}] ms, finals match unsharded baseline"
+        )
+    return {"finals": finals, "events": scales}
+
+
+def main() -> int:
+    slow = "--slow" in sys.argv[1:]
+    try:
+        run_scripted(verbose=True)
+        run_chaos(("reshard",), verbose=True)
+        if slow:
+            run_chaos(("decide", "drain", "resume"), verbose=True)
+            run_ramp(verbose=True)
+    except BaseException as e:  # noqa: BLE001 — CLI exit-code surface
+        print(
+            f"autoscale_smoke FAILED: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    print("autoscale_smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,383 @@
+"""Closed-loop autoscaler units (pathway_tpu/autoscale/): the Decider's
+flapping resistance — hysteresis streaks, cooldown, staleness refusal,
+sampler-gap resets — plus range parsing, the scripted-plan loader, and
+the autoscale chaos site's plan validation. Everything here is pure
+(synthetic /query documents, explicit clocks): the end-to-end loop is
+covered by scripts/autoscale_smoke.py."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from pathway_tpu.autoscale import (
+    Decider,
+    DeciderConfig,
+    load_scripted_plan,
+    parse_range,
+)
+from pathway_tpu.autoscale.controller import AutoscaleError
+from pathway_tpu.autoscale.decider import _doc_signals
+
+T0 = 10_000.0
+
+
+def _cfg(**kw) -> DeciderConfig:
+    base = dict(
+        min_workers=1, max_workers=4,
+        up_lag_ms=100.0, up_queue_frac=0.5, down_rows_per_s=1.0,
+        up_for_s=2.0, down_for_s=5.0, cooldown_s=0.0,
+        stale_s=10.0, gap_s=5.0, step=1,
+    )
+    base.update(kw)
+    return DeciderConfig(**base)
+
+
+def _doc(
+    t: float, lag: float | None = None, rate: float | None = None,
+    queue_frac: float | None = None, stale: dict | None = None,
+) -> dict:
+    doc: dict = {
+        "t": t,
+        "workers": {
+            "0": {
+                "frontier_lag_ms": lag,
+                "input_rate": rate,
+                "output_rate": 0.0 if rate is not None else None,
+            }
+        },
+        "comm": {},
+    }
+    if queue_frac is not None:
+        doc["comm"] = {
+            "0": {
+                "send_queue_depth": queue_frac * 200.0,
+                "send_queue_capacity": 200.0,
+            }
+        }
+    if stale is not None:
+        doc["stale_workers"] = stale
+    return doc
+
+
+# -- range parsing -----------------------------------------------------------
+
+
+def test_parse_range():
+    assert parse_range("2..4") == (2, 4)
+    assert parse_range(" 1..1 ") == (1, 1)
+    assert parse_range("3") == (3, 3)
+    for bad in ("0..2", "4..2", "a..b", "", "-1..3"):
+        with pytest.raises(AutoscaleError):
+            parse_range(bad)
+
+
+# -- document signal extraction ----------------------------------------------
+
+
+def test_doc_signals_merged_and_flat_comm():
+    sig = _doc_signals(_doc(T0, lag=50.0, rate=10.0, queue_frac=0.25))
+    assert sig["lag_ms"] == 50.0
+    assert sig["rows_per_s"] == 10.0
+    assert sig["queue_frac"] == pytest.approx(0.25)
+    # single-process /query serves a FLAT comm section
+    flat = _doc(T0, rate=1.0)
+    flat["comm"] = {"send_queue_depth": 30.0, "send_queue_capacity": 100.0}
+    assert _doc_signals(flat)["queue_frac"] == pytest.approx(0.3)
+    assert _doc_signals({}) is None
+    assert _doc_signals({"t": T0, "workers": {}}) is None
+
+
+# -- hysteresis: no decision from a single-sample spike ----------------------
+
+
+def test_single_sample_spike_never_scales():
+    d = Decider(_cfg())
+    # lag spikes on exactly one sample in an otherwise healthy stream
+    assert d.observe(_doc(T0, lag=10.0, rate=10.0), 1, T0) is None
+    assert d.observe(_doc(T0 + 1, lag=900.0, rate=10.0), 1, T0 + 1) is None
+    for i in range(2, 8):
+        assert (
+            d.observe(_doc(T0 + i, lag=10.0, rate=10.0), 1, T0 + i) is None
+        ), "a one-sample spike must never produce a scale event"
+
+
+def test_sustained_lag_scales_up():
+    d = Decider(_cfg())
+    assert d.observe(_doc(T0, lag=500.0, rate=10.0), 1, T0) is None
+    assert d.observe(_doc(T0 + 1, lag=600.0, rate=10.0), 1, T0 + 1) is None
+    decision = d.observe(_doc(T0 + 2, lag=700.0, rate=10.0), 1, T0 + 2)
+    assert decision is not None and decision.direction == "up"
+    assert decision.target == 2
+    assert "frontier lag" in decision.reason
+    assert decision.signals["lag_ms"] == 700.0
+
+
+def test_breach_interrupted_by_healthy_sample_resets_streak():
+    d = Decider(_cfg())
+    d.observe(_doc(T0, lag=500.0, rate=10.0), 1, T0)
+    d.observe(_doc(T0 + 1, lag=10.0, rate=10.0), 1, T0 + 1)  # recovers
+    d.observe(_doc(T0 + 2, lag=500.0, rate=10.0), 1, T0 + 2)
+    # only 1 s of the NEW streak has elapsed — far from up_for_s
+    assert d.observe(_doc(T0 + 3, lag=500.0, rate=10.0), 1, T0 + 3) is None
+    decision = d.observe(_doc(T0 + 4, lag=500.0, rate=10.0), 1, T0 + 4)
+    assert decision is not None and decision.direction == "up"
+
+
+def test_lag_without_input_flow_is_idleness_not_pressure():
+    d = Decider(_cfg())
+    # a huge lag over a DEAD stream (rate ~0) means the stream ended,
+    # not that the cluster is falling behind — after sustained idleness
+    # it must scale DOWN, never up
+    for i in range(5):
+        assert (
+            d.observe(_doc(T0 + i, lag=9000.0, rate=0.0), 2, T0 + i) is None
+        )
+    decision = d.observe(_doc(T0 + 5, lag=9000.0, rate=0.0), 2, T0 + 5)
+    assert decision is not None and decision.direction == "down"
+    assert decision.target == 1
+
+
+def test_queue_saturation_scales_up():
+    d = Decider(_cfg())
+    for i in range(2):
+        assert (
+            d.observe(
+                _doc(T0 + i, rate=10.0, queue_frac=0.9), 2, T0 + i
+            )
+            is None
+        )
+    decision = d.observe(_doc(T0 + 2, rate=10.0, queue_frac=0.9), 2, T0 + 2)
+    assert decision is not None and decision.direction == "up"
+    assert decision.target == 3
+    assert "send queue" in decision.reason
+
+
+def test_idle_scales_down_and_respects_min():
+    d = Decider(_cfg())
+    for i in range(5):
+        assert d.observe(_doc(T0 + i, rate=0.1), 2, T0 + i) is None
+    decision = d.observe(_doc(T0 + 5, rate=0.1), 2, T0 + 5)
+    assert decision is not None and decision.direction == "down"
+    # already at min: the same sustained idleness must NOT decide
+    d2 = Decider(_cfg())
+    for i in range(8):
+        assert d2.observe(_doc(T0 + i, rate=0.1), 1, T0 + i) is None
+
+
+def test_up_respects_max():
+    d = Decider(_cfg())
+    for i in range(8):
+        assert (
+            d.observe(_doc(T0 + i, lag=500.0, rate=10.0), 4, T0 + i) is None
+        ), "at max_workers no up decision may fire"
+
+
+def test_cooldown_suppresses_but_streaks_accrue():
+    d = Decider(_cfg(cooldown_s=10.0))
+    d.note_event(T0)
+    # breaching throughout the cooldown: no decision inside it...
+    for i in range(1, 10):
+        assert (
+            d.observe(_doc(T0 + i, lag=500.0, rate=10.0), 1, T0 + i) is None
+        )
+    # ...but the streak kept accruing, so the first post-cooldown
+    # observation may decide immediately
+    decision = d.observe(_doc(T0 + 11, lag=500.0, rate=10.0), 1, T0 + 11)
+    assert decision is not None and decision.direction == "up"
+
+
+# -- staleness guard ---------------------------------------------------------
+
+
+def test_stale_marked_document_is_refused_and_resets_streaks():
+    d = Decider(_cfg())
+    d.observe(_doc(T0, lag=500.0, rate=10.0), 1, T0)
+    d.observe(_doc(T0 + 1, lag=500.0, rate=10.0), 1, T0 + 1)
+    # one poll's merge served worker 1 from a cached peer scrape —
+    # deciding from frozen numbers is refused, and the refusal voids
+    # the streak's continuity evidence
+    assert (
+        d.observe(
+            _doc(T0 + 2, lag=500.0, rate=10.0, stale={"1": 4.0}),
+            1, T0 + 2,
+        )
+        is None
+    )
+    assert d.refusals == 1
+    assert d.observe(_doc(T0 + 3, lag=500.0, rate=10.0), 1, T0 + 3) is None
+    assert d.observe(_doc(T0 + 4, lag=500.0, rate=10.0), 1, T0 + 4) is None
+    decision = d.observe(_doc(T0 + 5, lag=500.0, rate=10.0), 1, T0 + 5)
+    assert decision is not None, "streak must rebuild after the refusal"
+
+
+def test_old_document_is_refused():
+    d = Decider(_cfg(stale_s=10.0))
+    assert (
+        d.observe(_doc(T0 - 30, lag=500.0, rate=10.0), 1, T0) is None
+    )
+    assert d.refusals == 1
+
+
+def test_sampler_gap_resets_streak():
+    d = Decider(_cfg(gap_s=5.0))
+    d.observe(_doc(T0, lag=500.0, rate=10.0), 1, T0)
+    d.observe(_doc(T0 + 1, lag=500.0, rate=10.0), 1, T0 + 1)
+    # the poller went dark for 9 s (> gap_s): two breaching samples
+    # around a hole do not prove the breach was sustained through it
+    assert d.observe(_doc(T0 + 10, lag=500.0, rate=10.0), 1, T0 + 10) is None
+    assert d.observe(_doc(T0 + 11, lag=500.0, rate=10.0), 1, T0 + 11) is None
+    decision = d.observe(_doc(T0 + 12, lag=500.0, rate=10.0), 1, T0 + 12)
+    assert decision is not None and decision.direction == "up"
+
+
+# -- scripted plan loader ----------------------------------------------------
+
+
+def test_load_scripted_plan_inline_file_and_sorting(tmp_path):
+    steps = [{"after_s": 5, "to": 1}, {"after_s": 2, "to": 3}]
+    plan = load_scripted_plan(json.dumps(steps))
+    assert [s["after_s"] for s in plan] == [2.0, 5.0]
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps(steps))
+    assert load_scripted_plan(str(path)) == plan
+    assert load_scripted_plan("") == []
+    assert load_scripted_plan(None) == [] or True  # env-driven default
+    with pytest.raises(ValueError, match="expected a JSON list"):
+        load_scripted_plan(json.dumps({"after_s": 1}))
+    with pytest.raises(ValueError, match="need after_s and to"):
+        load_scripted_plan(json.dumps([{"after_s": 1}]))
+
+
+# -- controller planned-stop failure hygiene ---------------------------------
+
+
+def _controller(tmp_path, monkeypatch):
+    from pathway_tpu.autoscale import AutoscaleController
+
+    monkeypatch.delenv("PATHWAY_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("PATHWAY_AUTOSCALE_PLAN", raising=False)
+    return AutoscaleController(
+        program=["true"], min_workers=1, max_workers=4,
+        store=str(tmp_path / "pstate"), base_env={}, monitor_base=0,
+        log=lambda m: None,
+    )
+
+
+def test_failed_planned_stop_drops_the_pending_decision(
+    tmp_path, monkeypatch
+):
+    """A planned stop that fails (resharder error) must DROP the pending
+    decision before the error reaches the supervisor: the budgeted
+    relaunch that follows must not record a scale event that never
+    happened (nor fire the `resume` chaos phase for it)."""
+    import pathway_tpu.rescale as rescale_mod
+    from pathway_tpu.autoscale.decider import Decision
+
+    c = _controller(tmp_path, monkeypatch)
+
+    def boom(*a, **k):
+        raise rescale_mod.RescaleError("store corrupt")
+
+    monkeypatch.setattr(rescale_mod, "rescale", boom)
+    c._pending = {
+        "decision": Decision(2, "up", "test"), "from": 1, "t0": 0.0,
+    }
+    with pytest.raises(rescale_mod.RescaleError):
+        c._planned_stop("autoscale 1->2: test")
+    assert c._pending is None, (
+        "a failed planned stop must not leave a pending event behind"
+    )
+    assert c.workers == 1 and c.events == []
+
+
+def test_planned_stop_tolerates_fresh_store_via_typed_error(
+    tmp_path, monkeypatch
+):
+    """NoClusterMarker (nothing ever persisted) is NOT a failure: the
+    next generation simply boots at the target count — matched by type,
+    not by error-message substring."""
+    import pathway_tpu.rescale as rescale_mod
+    from pathway_tpu.autoscale.decider import Decision
+
+    c = _controller(tmp_path, monkeypatch)
+    c._sup = type(
+        "S", (), {"process_ids": [], "labels": [], "health_ports": []}
+    )()
+
+    def no_marker(*a, **k):
+        raise rescale_mod.NoClusterMarker("no cluster marker at mem")
+
+    monkeypatch.setattr(rescale_mod, "rescale", no_marker)
+    c._pending = {
+        "decision": Decision(2, "up", "test"), "from": 1, "t0": 0.0,
+    }
+    c._planned_stop("autoscale 1->2: test")
+    assert c.workers == 2
+    assert c._pending is not None and c._pending["report"]["noop"] is True
+
+
+def test_marker_read_error_refuses_instead_of_guessing_min(
+    tmp_path, monkeypatch
+):
+    """A transient marker READ error at controller startup must refuse
+    loudly — silently assuming min_workers would elastic-reshard a live
+    N-worker layout down to MIN at the next boot."""
+    import pathway_tpu.persistence.layout as layout_mod
+
+    def flaky(root):
+        raise OSError("connection reset")
+
+    monkeypatch.setattr(layout_mod, "read_marker", flaky)
+    with pytest.raises(AutoscaleError, match="cannot read the cluster"):
+        _controller(tmp_path, monkeypatch)
+
+
+# -- /metrics exposition -----------------------------------------------------
+
+
+def test_autoscale_metrics_export_with_bounded_decision_label(monkeypatch):
+    """The controller's env stamps surface as pathway_autoscale_* — with
+    the decision label trimmed to the bounded "from->to" head (the full
+    reason string embeds measured values: one Prometheus series per
+    scale event is the classic cardinality leak)."""
+    from pathway_tpu.observability import ObservabilityHub
+    from pathway_tpu.observability.prometheus import parse_exposition
+
+    monkeypatch.setenv("PATHWAY_AUTOSCALE", "1..4")
+    monkeypatch.setenv("PATHWAY_AUTOSCALE_EVENTS", "3")
+    monkeypatch.setenv("PATHWAY_AUTOSCALE_LAST_PAUSE_MS", "812.5")
+    monkeypatch.setenv(
+        "PATHWAY_AUTOSCALE_LAST_DECISION",
+        "1->2: frontier lag 1234ms > 1000ms for 3.0s",
+    )
+    series = parse_exposition(ObservabilityHub().render_metrics())
+    assert series[
+        ("pathway_autoscale_events_total", (("range", "1..4"),))
+    ] == 3
+    assert series[("pathway_autoscale_last_pause_ms", ())] == 812.5
+    assert series[
+        ("pathway_autoscale_last_decision", (("decision", "1->2"),))
+    ] == 1
+
+
+# -- chaos plan: the autoscale site ------------------------------------------
+
+
+def test_fault_plan_autoscale_site_validation():
+    from pathway_tpu.chaos.plan import Fault
+
+    for phase in ("decide", "drain", "reshard", "resume"):
+        Fault(site="autoscale", action="kill", phase=phase).validate()
+    Fault(site="autoscale", action="crash").validate()  # phase optional
+    with pytest.raises(ValueError, match="unknown autoscale phase"):
+        Fault(site="autoscale", action="kill", phase="promote").validate()
+    # rescale keeps ITS phase vocabulary — the two sites do not bleed
+    Fault(site="rescale", action="kill", phase="promote").validate()
+    with pytest.raises(ValueError, match="unknown rescale phase"):
+        Fault(site="rescale", action="kill", phase="drain").validate()
+    with pytest.raises(ValueError, match="takes no 'phase'"):
+        Fault(site="tick", action="kill", tick=1, phase="decide").validate()
+    with pytest.raises(ValueError, match="no action"):
+        Fault(site="autoscale", action="hang").validate()

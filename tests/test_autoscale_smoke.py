@@ -1,0 +1,56 @@
+"""Tier-1 + slow wrappers around scripts/autoscale_smoke.py: the
+closed-loop autoscaler (`spawn --autoscale MIN..MAX`) executes a
+scripted mid-stream scale event with exact final counts and a measured
+pause; a controller SIGKILL at the reshard phase boundary leaves a
+bootable layout (tier-1). The slow suite covers the remaining chaos
+phases and the signal-driven ramp (scale up on sustained frontier lag,
+down on starved rates, multiset-equal to an unsharded baseline)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    ),
+)
+
+
+def test_autoscale_scripted_scale_event(tmp_path):
+    from autoscale_smoke import EXPECTED, run_scripted
+
+    result = run_scripted(workdir=str(tmp_path))
+    assert result["finals"] == EXPECTED
+    assert result["event"]["from"] == 1 and result["event"]["to"] == 2
+    assert result["event"]["pause_ms"] > 0
+
+
+def test_autoscale_chaos_kill_at_reshard(tmp_path):
+    from autoscale_smoke import EXPECTED, run_chaos
+
+    results = run_chaos(("reshard",), workdir=str(tmp_path))
+    assert results["reshard"]["finals"] == EXPECTED
+
+
+@pytest.mark.slow
+def test_autoscale_chaos_kill_every_phase(tmp_path):
+    from autoscale_smoke import EXPECTED, run_chaos
+
+    results = run_chaos(
+        ("decide", "drain", "resume"), workdir=str(tmp_path)
+    )
+    for phase, r in results.items():
+        assert r["finals"] == EXPECTED, phase
+
+
+@pytest.mark.slow
+def test_autoscale_signal_driven_ramp(tmp_path):
+    from autoscale_smoke import EXPECTED_RAMP, run_ramp
+
+    result = run_ramp(workdir=str(tmp_path))
+    assert result["finals"] == EXPECTED_RAMP
+    directions = {e["direction"] for e in result["events"]}
+    assert directions == {"up", "down"}

@@ -1,0 +1,110 @@
+"""Supervisor + rescale interaction: worker 0 SIGKILLed while resharding
+IN-PROCESS during a `spawn --elastic` boot (the rescale chaos site, stage
+phase). The old epoch must stay the bootable one, and the waiting peers
+must exit within PATHWAY_RESCALE_WAIT_S instead of wedging — then a boot
+with the ORIGINAL worker count resumes to exact final counts."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    ),
+)
+
+
+def test_elastic_boot_killed_mid_reshard_peers_do_not_wedge(tmp_path):
+    import textwrap
+
+    from rescale_smoke import (
+        _PROGRAM,
+        EXPECTED,
+        KILL_PLAN,
+        _events,
+        _finals,
+        _free_port,
+        _marker,
+        _spawn,
+    )
+
+    tmp = str(tmp_path)
+    prog = os.path.join(tmp, "prog.py")
+    with open(prog, "w") as f:
+        f.write(textwrap.dedent(_PROGRAM))
+    pstate = os.path.join(tmp, "pstate")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo_root,
+        "PATHWAY_FLIGHT_DIR": os.path.join(tmp, "flight"),
+        "PATHWAY_SUPERVISE_BACKOFF_S": "0.05",
+        "PATHWAY_SUPERVISE_BACKOFF_MAX_S": "0.2",
+    }
+    for k in ("PATHWAY_FAULT_PLAN", "PATHWAY_ELASTIC"):
+        base_env.pop(k, None)
+
+    # -- 1. two-process persisted run, SIGKILLed mid-stream --------------
+    out_a = os.path.join(tmp, "events_a.jsonl")
+    proc = _spawn(
+        ["spawn", "-n", "2", "-t", "1", "--first-port", str(_free_port()),
+         sys.executable, prog, out_a, pstate],
+        {**base_env, "PATHWAY_FAULT_PLAN": json.dumps(KILL_PLAN)},
+    )
+    assert proc.returncode != 0, proc.stderr[-2000:]
+    killed_finals = _finals(_events(out_a))
+    assert killed_finals != EXPECTED
+    assert _marker(pstate)["n_workers"] == 2
+
+    # -- 2. elastic boot to 3 workers; worker 0's IN-PROCESS reshard is
+    # SIGKILLed at the stage phase; peers wait PATHWAY_RESCALE_WAIT_S for
+    # the promoted marker and must then FAIL, not wedge ------------------
+    out_b = os.path.join(tmp, "events_b.jsonl")
+    t0 = time.monotonic()
+    proc = _spawn(
+        ["spawn", "--elastic", "-n", "3", "-t", "1",
+         "--first-port", str(_free_port()),
+         sys.executable, prog, out_b, pstate],
+        {
+            **base_env,
+            "PATHWAY_RESCALE_WAIT_S": "3",
+            "PATHWAY_FAULT_PLAN": json.dumps({
+                "seed": 7,
+                "faults": [
+                    {"site": "rescale", "phase": "stage", "action": "kill"},
+                ],
+            }),
+        },
+        timeout=120,
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode != 0, (
+        "the mid-reshard kill did not fail the elastic boot"
+    )
+    # peers respected the wait bound: boot + 3 s wait + teardown, with
+    # generous slack for process startup — nowhere near the 120 s default
+    assert elapsed < 60, (
+        f"peers wedged for {elapsed:.0f}s past PATHWAY_RESCALE_WAIT_S=3"
+    )
+    assert "PATHWAY_RESCALE_WAIT_S" in proc.stderr, proc.stderr[-2000:]
+    # the kill hit BEFORE promotion: the old 2-worker epoch is untouched
+    assert _marker(pstate)["n_workers"] == 2, (
+        "a kill during staging must leave the OLD layout's marker"
+    )
+
+    # -- 3. the old epoch is bootable: resume with the ORIGINAL count ----
+    out_c = os.path.join(tmp, "events_c.jsonl")
+    proc = _spawn(
+        ["spawn", "--supervise", "-n", "2", "-t", "1",
+         "--first-port", str(_free_port()),
+         sys.executable, prog, out_c, pstate],
+        base_env,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    final = dict(killed_finals)
+    final.update(_finals(_events(out_c)))
+    assert final == EXPECTED, f"resumed counts {final} != {EXPECTED}"

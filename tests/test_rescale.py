@@ -371,6 +371,90 @@ def test_offset_union_prefers_replay_more_on_legacy_conflict():
     assert logs and "conflict" in logs[0]
 
 
+# -- CLI hardening: refuse nonsense targets, clear no-op, --dry-run ---------
+
+
+def test_rescale_cli_refuses_nonpositive_target(tmp_path):
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import main as cli_main
+
+    runner = CliRunner()
+    for bad in ("0", "-2"):
+        res = runner.invoke(
+            cli_main, ["rescale", "--to", bad, str(tmp_path / "nowhere")]
+        )
+        assert res.exit_code != 0
+        assert f"refusing --to {bad}" in res.output
+        assert "must be >= 1" in res.output
+        # refused BEFORE touching the store: no marker/backend complaint
+        assert "no cluster marker" not in res.output
+
+
+def test_rescale_cli_noop_and_dry_run(tmp_path, monkeypatch):
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import main as cli_main
+    from pathway_tpu.rescale import stats as rescale_stats
+
+    store = str(tmp_path / "pstate")
+    cfg = Config.simple_config(
+        Backend.filesystem(store), snapshot_interval_ms=5
+    )
+    _run_wordcount(12, 1, cfg, monkeypatch)
+    runner = CliRunner()
+
+    # M == current: a clear no-op, not an error and not a rewrite
+    res = runner.invoke(cli_main, ["rescale", "--to", "1", store])
+    assert res.exit_code == 0, res.output
+    assert "already laid out for 1 worker(s)" in res.output
+
+    def snap(d: str) -> dict:
+        out = {}
+        for dirpath, _dirs, files in os.walk(d):
+            for fn in files:
+                p = os.path.join(dirpath, fn)
+                st = os.stat(p)
+                out[p] = (st.st_mtime_ns, st.st_size)
+        return out
+
+    before = snap(store)
+    totals_before = rescale_stats()["total"]
+    res = runner.invoke(cli_main, ["rescale", "--to", "3", "--dry-run", store])
+    assert res.exit_code == 0, res.output
+    assert "dry run: would rescale 1 -> 3 worker(s)" in res.output
+    # the plan names each stateful operator's split/merge action
+    assert "split 1 piece(s) by key shard, merge into 3 worker(s)" in res.output
+    assert "input tail chunks to re-route" in res.output
+    assert snap(store) == before, "--dry-run must write NOTHING"
+    assert rescale_stats()["total"] == totals_before, (
+        "a dry run is not a rescale: the /metrics counter must not move"
+    )
+    # ...and the store still rescales for real afterwards
+    res = runner.invoke(cli_main, ["rescale", "--to", "3", store])
+    assert res.exit_code == 0, res.output
+    with open(os.path.join(store, "cluster")) as f:
+        assert json.load(f)["n_workers"] == 3
+
+
+def test_rescale_dry_run_library_reports_plan(monkeypatch):
+    cfg = _mem_cfg("resc-dry")
+    _run_wordcount(12, 1, cfg, monkeypatch)
+    root = MemoryBackend("resc-dry")
+    keys_before = set(root.list_keys())
+    report = rescale(root, 2, dry_run=True)
+    assert report["dry_run"] is True
+    assert report["from"] == 1 and report["to"] == 2
+    assert set(root.list_keys()) == keys_before, "no staging keys on dry run"
+    assert report["operators"], "the plan must name the stateful operators"
+    for op in report["operators"]:
+        assert op["mode"] in ("keyed", "pinned", "replicate", "unresolved")
+        assert op["action"]
+        assert len(op["chunks_per_source"]) == 1
+    modes = {op["mode"] for op in report["operators"]}
+    assert "keyed" in modes  # the groupby arena splits by key shard
+
+
 def test_marker_io_errors_propagate():
     """A transient read error on the cluster marker must FAIL the boot,
     never be mistaken for an empty store (which would mount blank

@@ -201,6 +201,41 @@ def test_slo_interrupted_breach_never_fires():
     assert engine.alerts.document()["active"] == []
 
 
+def test_slo_frozen_worker_series_cannot_hold_a_rule_breaching():
+    """A worker whose series froze holding an extreme value (dead
+    worker, cached scrape) is excluded from the rule's worst-worker
+    comparison once its newest sample ages past 8x the cadence — the
+    rule resolves instead of breaching forever on a ghost."""
+    store = TimeSeriesStore(capacity=64)
+    store.record("lag", 500.0, 1, T0)  # worker 1 froze at a huge lag
+    for i in range(30):
+        store.record("lag", 1.0, 0, T0 + i)  # worker 0 stays live + low
+    sig = Signals(store, sample_s=1.0)
+    rule = Rule(name="lag", expr="last(lag)", op=">", threshold=10.0,
+                for_s=1.0)
+    engine = SloEngine([rule], default_window_s=60.0)
+    # inside the staleness horizon the frozen 500 legitimately fires...
+    engine.evaluate(sig, now=T0 + 2.0)
+    engine.evaluate(sig, now=T0 + 4.0)
+    assert [e["rule"] for e in engine.alerts.document()["active"]] == ["lag"]
+    # ...but once worker 1's newest sample is > 8x cadence old, only the
+    # live worker's value counts and the rule RESOLVES
+    engine.evaluate(sig, now=T0 + 20.0)
+    doc = engine.alerts.document()
+    assert doc["active"] == []
+    assert [e["state"] for e in doc["history"]] == ["firing", "resolved"]
+    # without a known cadence the guard stays off (old semantics)
+    unguarded = SloEngine(
+        [Rule(name="lag2", expr="last(lag)", op=">", threshold=10.0,
+              for_s=0.0)],
+        default_window_s=60.0,
+    )
+    unguarded.evaluate(Signals(store), now=T0 + 20.0)
+    assert [e["rule"] for e in unguarded.alerts.document()["active"]] == [
+        "lag2"
+    ]
+
+
 def test_slo_rule_over_missing_metric_is_inert():
     rule = Rule(name="ghost", expr="rate(never_sampled)", threshold=1.0)
     engine = SloEngine([rule], default_window_s=10.0)
@@ -566,3 +601,130 @@ def test_query_endpoint_rejects_scalar_op_on_histogram_with_400():
     finally:
         server.shutdown()
         server.server_close()
+
+
+# -- staleness regressions (autoscale satellite): frozen values must not
+# drive decisions --------------------------------------------------------
+
+
+def test_eval_worst_excludes_frozen_worker_series():
+    """A worker whose newest sample is older than max_age_s is excluded
+    from the worst-worker comparison entirely: its series froze (dead
+    worker / cached peer scrape) and a frozen extreme must not win."""
+    store = TimeSeriesStore(capacity=8)
+    # worker 1 froze 60 s ago holding the worst value; worker 0 is live
+    store.record("lag", 10.0, 0, T0 + 59)
+    store.record("lag", 12.0, 0, T0 + 60)
+    store.record("lag", 500.0, 1, T0)
+    sig = Signals(store)
+    # without the guard the frozen 500 wins — the pre-fix behavior
+    assert sig.eval_worst("last(lag)", 120.0) == (500.0, 1)
+    value, worker = sig.eval_worst(
+        "last(lag)", 120.0, max_age_s=10.0, now=T0 + 60
+    )
+    assert (value, worker) == (12.0, 0)
+    # every candidate frozen -> no value at all, not a stale one
+    value, worker = sig.eval_worst(
+        "last(lag)", 120.0, max_age_s=10.0, now=T0 + 600
+    )
+    assert value is None and worker is None
+
+
+def test_sustained_above_refuses_sampler_gaps():
+    """Two breaching samples around a dead-sampler hole do not prove the
+    signal breached throughout — sustained_above must not count the gap
+    as coverage (only when the cadence is known via sample_s)."""
+    store = TimeSeriesStore(capacity=16)
+    for t in (0.0, 1.0, 2.0, 9.0, 10.0):  # 7 s hole, all samples breach
+        store.record("c", 5.0, 0, T0 + t)
+    gappy = Signals(store, sample_s=1.0)
+    assert not gappy.sustained_above("c", 1.0, 8.0, 0)
+    # the same points WITHOUT a known cadence keep the old semantics
+    assert Signals(store).sustained_above("c", 1.0, 8.0, 0)
+    # a contiguous run at the same cadence still sustains
+    dense = TimeSeriesStore(capacity=16)
+    for i in range(11):
+        dense.record("c", 5.0, 0, T0 + i)
+    assert Signals(dense, sample_s=1.0).sustained_above("c", 1.0, 8.0, 0)
+    # jitter within 4 samples' worth of cadence is tolerated
+    jitter = TimeSeriesStore(capacity=16)
+    for t in (0.0, 1.0, 4.0, 5.0, 6.0, 7.0, 8.0, 9.0):
+        jitter.record("c", 5.0, 0, T0 + t)
+    assert Signals(jitter, sample_s=1.0).sustained_above("c", 1.0, 8.0, 0)
+
+
+def test_query_merge_marks_cached_peer_scrape_as_stale(monkeypatch):
+    """A peer whose /query scrape fails is served from the last good
+    scrape WITH its workers named in stale_workers — and the autoscale
+    decider refuses such a document instead of deciding from it."""
+    from pathway_tpu.observability.hub import ObservabilityHub
+
+    hub, stats, plane = _hub_with_plane()
+    hub.peer_http = [("127.0.0.1", 1)]
+    stats.ticks = 5
+    plane.sample_once(t=T0)
+    stats.ticks = 25
+    plane.sample_once(t=T0 + 1)
+    peer_doc = {
+        "process_id": 1,
+        "workers": {"1": {"tick_rate": 3.0, "frontier_lag_ms": 9000.0,
+                          "input_rate": 50.0, "output_rate": 50.0}},
+        "comm": {"send_queue_depth": 9.0},
+        "alerts": {"active": [], "history": [], "fired_total": {}},
+    }
+    alive = {"up": True}
+    monkeypatch.setattr(
+        ObservabilityHub, "_scrape_peer_path",
+        staticmethod(
+            lambda host, port, path: peer_doc if alive["up"] else None
+        ),
+    )
+    doc = hub.query_document()
+    assert doc["stale_workers"] == {}
+    assert "1" in doc["workers"] and "stale_s" not in doc["workers"]["1"]
+
+    # the peer dies: the merge keeps its last-good workers but marks them
+    alive["up"] = False
+    doc = hub.query_document()
+    assert "1" in doc["workers"], "cached peer must not vanish"
+    assert doc["workers"]["1"]["stale_s"] >= 0
+    assert set(doc["stale_workers"]) == {"1"}
+
+    # the decider REFUSES the stale-marked document — the frozen 9 s lag
+    # on the cached worker must not drive a scale-up
+    from pathway_tpu.autoscale import Decider, DeciderConfig
+
+    cfg = DeciderConfig(
+        min_workers=1, max_workers=4, up_lag_ms=100.0, up_for_s=0.0,
+    )
+    d = Decider(cfg)
+    assert d.observe(doc, 1, doc["t"]) is None
+    assert d.refusals == 1
+
+
+def test_query_merge_flags_never_scraped_peer(monkeypatch):
+    """A peer that dies BEFORE its first successful /query scrape has no
+    cache to serve from — but it must still appear in stale_workers, or
+    the decider would act on a partial view of the cluster (e.g. scale
+    DOWN on an undercounted row rate while the invisible worker holds
+    the backlog)."""
+    from pathway_tpu.observability.hub import ObservabilityHub
+
+    hub, stats, plane = _hub_with_plane()
+    hub.peer_http = [("127.0.0.1", 1)]
+    hub.n_processes = 2
+    stats.ticks = 5
+    plane.sample_once(t=T0)
+    monkeypatch.setattr(
+        ObservabilityHub, "_scrape_peer_path",
+        staticmethod(lambda host, port, path: None),
+    )
+    doc = hub.query_document()
+    assert doc["stale_workers"] == {"process-1": None}
+    assert "1" not in doc["workers"]  # nothing to serve, nothing invented
+
+    from pathway_tpu.autoscale import Decider, DeciderConfig
+
+    d = Decider(DeciderConfig(min_workers=1, max_workers=4))
+    assert d.observe(doc, 2, doc["t"]) is None
+    assert d.refusals == 1

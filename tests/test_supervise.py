@@ -279,3 +279,209 @@ def test_chaos_injections_metric(monkeypatch):
         assert series[("pathway_chaos_injections_total", ())] == 1
     finally:
         chaos.disarm()
+
+
+# ---------------------------------------------------------------------------
+# planned stops (the autoscale controller's seam into the supervision loop)
+
+
+def test_poll_hook_planned_stop_relaunches_without_budget_burn():
+    """A poll_hook token means a PLANNED generation change: cooperative
+    teardown, planned_stop(token), immediate relaunch — no backoff and
+    no restart-budget burn (a scale event is not a failure)."""
+    calls: list[str] = []
+    launches: list[tuple[int, str | None]] = []
+    hook_fired = {"done": False}
+
+    def poll_hook():
+        if launches and launches[-1][0] == 0 and not hook_fired["done"]:
+            hook_fired["done"] = True
+            return "autoscale 1->2: test"
+        return None
+
+    def planned_stop(token):
+        calls.append(token)
+
+    def launch(gen, reason):
+        launches.append((gen, reason))
+        if gen == 0:
+            # long-lived generation: only the planned stop ends it
+            return [_child("import time; time.sleep(30)")]
+        return [_child("pass")]
+
+    sup = Supervisor(
+        launch, backoff_s=5.0, log=_quiet,
+        poll_hook=poll_hook, planned_stop=planned_stop,
+        poll_interval_s=0.02,
+    )
+    t0 = time.monotonic()
+    assert sup.run() == 0
+    # no backoff_s sleep happened: the planned path relaunches immediately
+    assert time.monotonic() - t0 < 5.0
+    assert calls == ["autoscale 1->2: test"]
+    assert [g for g, _ in launches] == [0, 1]
+    assert launches[1][1] == "autoscale 1->2: test"
+    assert sup.restarts_total == 0, "a planned stop must not burn budget"
+
+
+def test_planned_stop_failure_falls_through_to_budgeted_restart():
+    """A planned_stop that raises (resharder refused, store gone) IS a
+    failure: the budgeted restart path runs, so a broken rescale loop
+    trips the breaker instead of spinning forever."""
+    launches: list[tuple[int, str | None]] = []
+    hook_fired = {"done": False}
+
+    def poll_hook():
+        if not hook_fired["done"]:
+            hook_fired["done"] = True
+            return "autoscale 1->2: test"
+        return None
+
+    def planned_stop(token):
+        raise RuntimeError("no cluster marker")
+
+    def launch(gen, reason):
+        launches.append((gen, reason))
+        if gen == 0:
+            return [_child("import time; time.sleep(30)")]
+        return [_child("pass")]
+
+    sup = Supervisor(
+        launch, backoff_s=0.01, backoff_max_s=0.02, log=_quiet,
+        poll_hook=poll_hook, planned_stop=planned_stop,
+        poll_interval_s=0.02,
+    )
+    assert sup.run() == 0
+    assert sup.restarts_total == 1
+    assert "planned stop failed" in (launches[1][1] or "")
+    assert "no cluster marker" in launches[1][1]
+
+
+def test_poll_hook_exception_does_not_kill_supervision():
+    def poll_hook():
+        raise RuntimeError("scrape failed")
+
+    def launch(gen, reason):
+        return [_child("import time; time.sleep(0.2)")]
+
+    sup = Supervisor(
+        launch, backoff_s=0.01, log=_quiet,
+        poll_hook=poll_hook, poll_interval_s=0.02,
+    )
+    assert sup.run() == 0
+
+
+def test_planned_stop_chaos_crash_propagates():
+    """Same carve-out on the planned-stop path: an injected crash at a
+    drain/reshard phase boundary must crash the controller, not become
+    a budgeted restart that leaves the run exiting 0."""
+    from pathway_tpu.chaos.injector import ChaosInjected
+
+    fired = {"done": False}
+
+    def poll_hook():
+        if not fired["done"]:
+            fired["done"] = True
+            return "autoscale 1->2: test"
+        return None
+
+    def planned_stop(token):
+        raise ChaosInjected("chaos: injected crash at autoscale 'reshard'")
+
+    def launch(gen, reason):
+        return [_child("import time; time.sleep(30)")]
+
+    sup = Supervisor(
+        launch, backoff_s=0.01, log=_quiet,
+        poll_hook=poll_hook, planned_stop=planned_stop,
+        poll_interval_s=0.02,
+    )
+    with pytest.raises(ChaosInjected):
+        sup.run()
+
+
+def test_poll_hook_chaos_crash_propagates():
+    """A ChaosInjected from the poll hook (autoscale `decide` crash
+    action) must CRASH the supervision loop, not be absorbed as an
+    ordinary hook failure — absorbing it makes the chaos site's crash
+    action a no-op that re-fires on every poll."""
+    from pathway_tpu.chaos.injector import ChaosInjected
+
+    procs: list = []
+
+    def poll_hook():
+        raise ChaosInjected("chaos: injected crash at autoscale 'decide'")
+
+    def launch(gen, reason):
+        p = _child("import time; time.sleep(30)")
+        procs.append(p)
+        return [p]
+
+    sup = Supervisor(
+        launch, backoff_s=0.01, log=_quiet,
+        poll_hook=poll_hook, poll_interval_s=0.02,
+    )
+    try:
+        with pytest.raises(ChaosInjected):
+            sup.run()
+    finally:
+        for p in procs:
+            p.kill()
+            p.wait()
+
+
+def test_window_failures_counts_restarts_inside_window():
+    """window_failures at each launch mirrors the circuit-breaker window
+    (what the CLI stamps as PATHWAY_SUPERVISE_WINDOW_FAILURES)."""
+    seen: list[int] = []
+
+    def launch(gen, reason):
+        seen.append(sup.window_failures)
+        if gen < 2:
+            return [_child("import sys; sys.exit(1)")]
+        return [_child("pass")]
+
+    sup = Supervisor(
+        launch, max_restarts=5, window_s=60.0, backoff_s=0.01,
+        backoff_max_s=0.02, log=_quiet,
+    )
+    assert sup.run() == 0
+    assert seen == [0, 1, 2]
+
+
+def test_circuit_breaker_state_exported(monkeypatch):
+    """pathway_circuit_open + pathway_restart_window_failures surface on
+    /metrics from the PATHWAY_SUPERVISE_WINDOW_FAILURES stamp — the
+    restart storm is visible BEFORE the breaker trips."""
+    from pathway_tpu.observability import ObservabilityHub
+    from pathway_tpu.observability.prometheus import parse_exposition
+
+    monkeypatch.setenv("PATHWAY_SUPERVISED", "1")
+    monkeypatch.setenv("PATHWAY_RESTART_COUNT", "3")
+    monkeypatch.setenv("PATHWAY_SUPERVISE_WINDOW_FAILURES", "3")
+    monkeypatch.setenv("PATHWAY_SUPERVISE_MAX_RESTARTS", "5")
+    hub = ObservabilityHub()
+    series = parse_exposition(hub.render_metrics())
+    assert series[("pathway_restart_window_failures", ())] == 3
+    assert series[("pathway_restart_window_budget", ())] == 5
+    assert series[("pathway_circuit_open", ())] == 0
+    # budget exhausted -> the gauge flips. The stamp can never exceed
+    # the budget (the supervisor trips and exits WITHOUT launching), so
+    # failures == budget — the last-chance generation — must read open
+    monkeypatch.setenv("PATHWAY_SUPERVISE_WINDOW_FAILURES", "5")
+    series = parse_exposition(hub.render_metrics())
+    assert series[("pathway_circuit_open", ())] == 1
+    # the `top` dashboard shows the same state
+    from pathway_tpu.observability.top import render_frame
+
+    frame = render_frame({
+        "workers": {}, "processes": [0],
+        "supervisor": {"restarts": 3, "window_failures": 3,
+                       "window_budget": 5, "circuit_open": False},
+        "autoscale": {"range": "1..4", "events": 2,
+                      "last_pause_ms": 812.0,
+                      "last_decision": "1->2: frontier lag"},
+    })
+    assert "supervisor: 3 restart(s), breaker 3/5 window" in frame
+    assert "autoscale [1..4]: 2 scale event(s)" in frame
+    assert "pause 812 ms" in frame
