@@ -181,6 +181,13 @@ class ActiveFaults:
         ]
         return AutoscaleFaults(self, matches) if matches else None
 
+    def spill_faults(self, worker_id: int) -> "SpillFaults | None":
+        matches = [
+            (i, f) for i, f in enumerate(self.plan.faults)
+            if f.site == "state.spill" and f.worker in (None, worker_id)
+        ]
+        return SpillFaults(self, worker_id, matches) if matches else None
+
     def wrap_backend(self, backend: Any, worker_id: int) -> Any:
         matches = [
             (i, f) for i, f in enumerate(self.plan.faults)
@@ -334,6 +341,31 @@ class LocalFaults:
         return payload
 
 
+class SpillFaults:
+    """Bound state.spill-site handle for one worker's spill stores.
+
+    ``op_for(key)`` returns the action to apply to the NEXT spill blob
+    write of a matching key ("fail" | "torn" | "kill") or None. The
+    spill store implements the action itself — it owns the versioned-key
+    write protocol the torn action must exercise."""
+
+    def __init__(self, owner: ActiveFaults, worker_id: int,
+                 matches: list[tuple[int, Fault]]):
+        self._owner = owner
+        self._scope = f"spill/w{worker_id}"
+        self._matches = matches
+
+    def op_for(self, key: str) -> str | None:
+        for idx, f in self._matches:
+            if f.key_prefix is not None and not key.startswith(f.key_prefix):
+                continue
+            if self._owner._decide(idx, f, self._scope):
+                if f.action == "kill":
+                    os.kill(os.getpid(), signal.SIGKILL)
+                return f.action
+        return None
+
+
 class ChaosBackend:
     """Persistence-backend wrapper failing selected ``put_value`` calls.
 
@@ -366,6 +398,9 @@ class ChaosBackend:
     # pure delegation for the rest of the backend surface
     def get_value(self, key: str) -> bytes:
         return self._inner.get_value(key)
+
+    def size_of(self, key: str) -> int:
+        return self._inner.size_of(key)
 
     def list_keys(self) -> list[str]:
         return self._inner.list_keys()

@@ -52,6 +52,16 @@ Sites and actions:
   persisted layout: the controller only mutates state through the
   resharder's atomic-marker protocol, so a supervised elastic boot
   afterwards converges back to a healthy cluster.
+- ``state.spill`` — the memory-budget spill tier's blob writes
+  (``engine/spill.py``: join-run payloads, groupby cold buckets, key-
+  registry cold buckets). ``action`` is ``fail`` (raise before writing),
+  ``torn`` (write a truncated blob to the NEW versioned key, then raise
+  — the versioned-key protocol must keep the previous generation
+  readable) or ``kill`` (SIGKILL mid-spill — recovery must restore from
+  operator snapshots, never from the scratch spill dir). Selected by
+  ``worker``, ``nth``/``prob`` and optional ``key_prefix``. Fail/torn
+  must never corrupt resident state: the spiller keeps entries resident
+  until the write succeeds.
 
 Determinism contract: a plan plus its ``seed`` fully determines the
 injection schedule. ``nth``/``tick`` faults are trivially deterministic;
@@ -78,7 +88,7 @@ __all__ = ["Fault", "FaultPlan", "load_plan_from_env"]
 
 _SITES = (
     "tick", "comm.send", "comm.local", "persistence.put", "rescale",
-    "autoscale",
+    "autoscale", "state.spill",
 )
 _ACTIONS = {
     "tick": ("crash", "exit", "kill", "hang"),
@@ -87,6 +97,7 @@ _ACTIONS = {
     "persistence.put": ("fail", "torn"),
     "rescale": ("crash", "exit", "kill"),
     "autoscale": ("crash", "exit", "kill"),
+    "state.spill": ("fail", "torn", "kill"),
 }
 #: rescale-site phase boundaries, in execution order (resharder.py)
 RESCALE_PHASES = ("plan", "stage", "copy", "promote", "cleanup")

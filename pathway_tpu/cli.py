@@ -387,13 +387,22 @@ def rescale(to_workers, backend_kind, dry_run, store):
             f"(epoch {report['epoch']} -> {report['epoch'] + 1}):"
         )
         for op in report.get("operators", []):
+            mb = op.get("state_bytes", 0) / 1e6
             click.echo(
                 f"  rank {op['rank']} {op['cls']} [{op['mode']}]: "
                 f"{op['action']} "
-                f"(source snapshot chunks: {op['chunks_per_source']})"
+                f"(source snapshot chunks: {op['chunks_per_source']}, "
+                f"state {mb:.2f} MB = {op.get('state_bytes_per_source')} B "
+                "per source, incl. spilled)"
             )
         if not report.get("operators"):
             click.echo("  (no stateful operator snapshots at that time)")
+        total_mb = report.get("state_bytes_total", 0) / 1e6
+        click.echo(
+            f"  total stateful-operator bytes to redistribute: "
+            f"{total_mb:.2f} MB across {report['to']} target worker(s) "
+            f"(~{total_mb / max(1, report['to']):.2f} MB/worker)"
+        )
         click.echo(
             "  input tail chunks to re-route per source worker: "
             f"{report.get('tail_chunks_per_source')}"

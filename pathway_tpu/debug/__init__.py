@@ -318,13 +318,31 @@ def table_from_dicts(data: dict[str, dict], schema: SchemaMetaclass | None = Non
 def table_to_pandas(table: Table, *, include_id: bool = True):
     import pandas as pd
 
+    import datetime as _datetime
+
     cap = _run_capture(table)
     names = table.column_names()
     items = sorted(cap.state.iter_items(), key=lambda kv: kv[0])
-    data = {n: [row[i] for _, row in items] for i, n in enumerate(names)}
+    data: dict[str, Any] = {}
+    for i, n in enumerate(names):
+        col = [row[i] for _, row in items]
+        # keep datetime cells as python objects: pandas would coerce them
+        # to datetime64[ns], and numpy 2 renders ns-precision items back
+        # as raw integer nanoseconds under .values.tolist() — the
+        # reference hands out Timestamp-like objects here, so tests (and
+        # users) call .hour/.year on the cells
+        if any(isinstance(v, _datetime.datetime) for v in col):
+            data[n] = pd.Series(col, dtype=object, index=[k for k, _ in items])
+        else:
+            data[n] = col
     if include_id:
         return pd.DataFrame(data, index=[k for k, _ in items])
-    return pd.DataFrame(data)
+    return pd.DataFrame(
+        {
+            n: (c.reset_index(drop=True) if isinstance(c, pd.Series) else c)
+            for n, c in data.items()
+        }
+    )
 
 
 class StreamGenerator:

@@ -605,6 +605,11 @@ class Executor:
         from ..internals.tracing import get_tracer
 
         self.tracer = get_tracer()
+        # spill-to-disk state budget (engine/spill.py): None unless
+        # PATHWAY_STATE_MEMORY_BUDGET_MB is set — one None check per tick
+        from . import spill as _spill
+
+        self._state_budget = _spill.get_budget()
         # black box (observability/flightrecorder.py): None unless a flight
         # dir is configured — one None check per tick when disarmed
         from ..observability.flightrecorder import get_recorder
@@ -1145,6 +1150,16 @@ class Executor:
                 rows=self.stats.rows_total,
                 out=self.stats.output_rows,
             )
+        if self._state_budget is not None:
+            # after the persistence commit: spilled segments materialize
+            # into snapshots, so shedding right after one avoids paying an
+            # immediate reload for state the commit just serialized. Only
+            # THIS executor's stores: workers must never spill (and race)
+            # a sibling thread's live arrangement — the budget is
+            # per-worker
+            from .spill import collect_spillable
+
+            self._state_budget.maybe_spill(collect_spillable(self.nodes))
 
     def _route(
         self, node: Node, delta: Delta, inbox: dict[int, dict[int, list[Delta]]]

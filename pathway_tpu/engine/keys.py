@@ -325,7 +325,6 @@ class KeyCollisionError(RuntimeError):
 
 
 _REGISTRY = None
-_REGISTRY_WARNED = False
 #: THREAD-LOCAL suspension: while the executor running on THIS thread has
 #: a stateless dataflow (no keyed operator state — nothing two conflated
 #: keys could corrupt), key creation skips the registry probe, which
@@ -369,8 +368,327 @@ class _PyKeyRegistry:
                 return i
         return -1
 
+    def register_overflow(
+        self, lo: np.ndarray, hi: np.ndarray, miss: np.ndarray
+    ) -> int:
+        """Native ``KeyRegistry.register_overflow`` parity: frozen-table
+        misses flag ``miss[i] = 1`` for the cold tier instead of passing
+        unchecked."""
+        m = self._map
+        for i, (l, h) in enumerate(zip(lo.tolist(), hi.tolist())):
+            cur = m.get(l)
+            if cur is None:
+                if not self.frozen:
+                    m[l] = h
+                    if len(m) >= self._cap:
+                        self.frozen = True
+                else:
+                    miss[i] = 1
+            elif cur != h:
+                return i
+        return -1
+
     def stats(self):
         return len(self._map), int(self.frozen)
+
+
+class KeyRegistryOverflowError(RuntimeError):
+    """The key registry hit ``PATHWAY_KEY_REGISTRY_CAP`` with no spill
+    path configured. Silently downgrading to 64-bit collision safety at
+    exactly the scale where 128-bit detection matters is the one thing
+    this error exists to prevent: either point
+    ``PATHWAY_KEY_REGISTRY_SPILL_DIR`` (or ``PATHWAY_STATE_SPILL_DIR``)
+    at scratch disk to keep full detection past the cap, raise the cap,
+    or set ``PATHWAY_KEY_REGISTRY_OVERFLOW=allow`` to accept the old
+    freeze-open behavior explicitly."""
+
+
+class _ColdKeyTier:
+    """Disk-backed LO→HI map for keys past the hot-table cap.
+
+    Hash-bucketed (top 8 bits of the LO lane → 256 buckets) pickled
+    dicts written through :class:`engine.spill.SpillStore` (the
+    persistence-backend interface + the ``state.spill`` chaos site) with
+    write-behind batching: probes check the in-memory pending tier, then
+    a small loaded-bucket LRU, then the bucket file. Only keys the hot
+    tier MISSES ever reach here, so the common case past the cap is one
+    numpy mask check per batch."""
+
+    N_BUCKETS = 256
+    _FLUSH_TOTAL = 65536  # pending entries across buckets → write-behind
+    _CACHE_BUCKETS = 4
+
+    def __init__(self, store):
+        self._store = store  # engine.spill.SpillStore
+        self._pending: dict[int, dict[int, int]] = {}
+        self._pending_n = 0
+        #: bucket id -> blob handles, oldest first: one base blob plus a
+        #: tail of per-flush delta blobs (folded by :meth:`_compact`)
+        self._handles: dict[int, list[dict]] = {}
+        #: tiny LRU of loaded (merged) bucket dicts
+        self._cache: dict[int, dict[int, int]] = {}
+        self.total = 0  # entries owned by the cold tier (pending + disk)
+
+    @staticmethod
+    def _bucket(lo: int) -> int:
+        return (lo >> 56) & 0xFF
+
+    def _load_bucket(self, b: int) -> dict[int, int]:
+        cached = self._cache.get(b)
+        if cached is not None:
+            self._cache[b] = self._cache.pop(b)  # refresh LRU recency
+            return cached
+        loaded: dict[int, int] = {}
+        for handle in self._handles.get(b, ()):
+            loaded.update(self._store.get_blob(handle))
+        if len(self._cache) >= self._CACHE_BUCKETS:
+            self._cache.pop(next(iter(self._cache)))
+        self._cache[b] = loaded
+        return loaded
+
+    def register(self, lo: list[int], hi: list[int]) -> int:
+        """Probe/insert (lo, hi) pairs; returns a conflicting index (the
+        smallest found) or -1. The batch is grouped by bucket so each
+        bucket's blobs load at most once per batch — per-key loads would
+        make cold-tier ingest quadratic with only a 4-bucket cache.
+        Insertions are write-behind — they live in the pending tier
+        until the next flush."""
+        by_bucket: dict[int, list[int]] = {}
+        for i, l in enumerate(lo):
+            by_bucket.setdefault(self._bucket(l), []).append(i)
+        conflict = -1
+        for b in sorted(by_bucket):
+            pend = self._pending.get(b)
+            disk = None  # loaded lazily, once per bucket per batch
+            for i in by_bucket[b]:
+                l, h = lo[i], hi[i]
+                cur = pend.get(l) if pend is not None else None
+                if cur is None:
+                    if disk is None:
+                        disk = self._load_bucket(b)
+                    cur = disk.get(l)
+                if cur is None:
+                    if pend is None:
+                        pend = self._pending[b] = {}
+                    pend[l] = h
+                    self._pending_n += 1
+                    self.total += 1
+                elif cur != h:
+                    # the run dies on any conflict; keys inserted after
+                    # it in other buckets are moot, so the rest of THIS
+                    # bucket is simply skipped
+                    if conflict < 0 or i < conflict:
+                        conflict = i
+                    break
+        if conflict >= 0:
+            return conflict
+        if self._pending_n >= self._FLUSH_TOTAL:
+            self.flush()
+        return -1
+
+    def flush(self) -> None:
+        """Write-behind flush: each dirty bucket's pending entries go to
+        disk as one DELTA blob (LSM-style — rewriting the whole bucket
+        per flush would make ingest I/O quadratic in cold-tier size);
+        :meth:`_compact` folds a bucket when its delta tail outweighs the
+        base, so every entry is rewritten O(log n) times total. A failed
+        write keeps that bucket's entries pending — resident state stays
+        authoritative, nothing is lost."""
+        for b in sorted(self._pending):
+            pend = self._pending[b]
+            if not pend:
+                continue
+            try:
+                handle = self._store.put_blob(f"kreg/b{b:02x}", pend)
+            except Exception:
+                from .spill import _count, log as _slog
+
+                _count("spill_errors_total")
+                _slog.warning(
+                    "key-registry cold bucket %02x flush failed; "
+                    "%d entr(ies) stay pending in memory",
+                    b, len(pend), exc_info=True,
+                )
+                continue
+            handles = self._handles.setdefault(b, [])
+            handles.append(handle)
+            cached = self._cache.get(b)
+            if cached is not None:
+                cached.update(pend)
+            self._pending_n -= len(pend)
+            self._pending[b] = {}
+            self._compact(b)
+
+    def _compact(self, b: int) -> None:
+        """Fold a bucket's base + deltas into one blob once the delta
+        tail has grown to the base's size (geometric trigger) or the
+        handle list is long enough to tax probes. Failure keeps the
+        delta handles — the merged view is unchanged either way."""
+        handles = self._handles.get(b, [])
+        if len(handles) < 2:
+            return
+        delta_bytes = sum(h["bytes"] for h in handles[1:])
+        if delta_bytes < handles[0]["bytes"] and len(handles) < 16:
+            return
+        merged = self._load_bucket(b)
+        try:
+            base = self._store.put_blob(f"kreg/b{b:02x}", merged)
+        except Exception:
+            from .spill import _count, log as _slog
+
+            _count("spill_errors_total")
+            _slog.warning(
+                "key-registry cold bucket %02x compaction failed; "
+                "keeping %d delta blob(s)", b, len(handles) - 1,
+                exc_info=True,
+            )
+            return
+        for h in handles:
+            self._store.drop_blob(h)
+        self._handles[b] = [base]
+
+
+class _TwoTierRegistry:
+    """The process-wide registry: hot in-memory table (native C or pure
+    python) + optional spilled cold tier. Overflow behavior at cap-hit:
+
+    - spill path configured → keys past the cap keep FULL 128-bit
+      conflation detection through the cold tier;
+    - ``PATHWAY_KEY_REGISTRY_OVERFLOW=allow`` → the old freeze-open
+      (new keys pass unchecked), loudly: log + flight-recorder event +
+      ``pathway_key_registry_frozen`` gauge;
+    - otherwise → :class:`KeyRegistryOverflowError`, a hard error.
+    """
+
+    def __init__(self, hot, cap: int, spill_dir: str | None, mode: str):
+        self._hot = hot
+        self._cap = cap
+        self._spill_dir = spill_dir
+        self._mode = mode  # "spill" | "allow" | "error"
+        self._cold: _ColdKeyTier | None = None
+        self._cold_lock = _threading.Lock()
+        self._cap_hit_announced = False
+        self.spilled_total = 0  # keys ever routed to the cold tier
+
+    # -- cap-hit event ---------------------------------------------------
+
+    def _announce_cap_hit(self) -> None:
+        if self._cap_hit_announced:
+            return
+        self._cap_hit_announced = True
+        import logging
+
+        what = {
+            "spill": (
+                "spilling cold entries to %r — 128-bit conflation "
+                "detection continues past the cap" % self._spill_dir
+            ),
+            "allow": (
+                "PATHWAY_KEY_REGISTRY_OVERFLOW=allow: detection is "
+                "FROZEN to the first %d keys; new keys pass unchecked "
+                "(64-bit collision safety only)" % self._hot.stats()[0]
+            ),
+            "error": "no spill path configured — refusing new keys",
+        }[self._mode]
+        logging.getLogger("pathway_tpu.keys").warning(
+            "key registry reached PATHWAY_KEY_REGISTRY_CAP (%d): %s",
+            self._cap, what,
+        )
+        from ..observability.flightrecorder import get_recorder
+
+        rec = get_recorder()
+        if rec is not None:
+            rec.record(
+                "keyreg.cap_hit",
+                cap=self._cap,
+                mode=self._mode,
+                entries=self._hot.stats()[0],
+            )
+
+    # -- registration ----------------------------------------------------
+
+    def register(self, lo: np.ndarray, hi: np.ndarray) -> int:
+        n = len(lo)
+        miss = np.zeros(n, dtype=np.uint8)
+        idx = self._hot.register_overflow(lo, hi, miss)
+        if idx >= 0:
+            return int(idx)
+        if not miss.any():
+            return -1
+        # hot tier is frozen and this batch carries unknown keys
+        self._announce_cap_hit()
+        if self._mode == "allow":
+            return -1  # explicit freeze-open: pass unchecked, loudly
+        if self._mode == "error":
+            raise KeyRegistryOverflowError(
+                f"key registry is full ({self._cap} keys, "
+                "PATHWAY_KEY_REGISTRY_CAP) and no spill path is "
+                "configured: refusing to silently degrade 128-bit "
+                "conflation detection. Set PATHWAY_KEY_REGISTRY_SPILL_DIR "
+                "(or PATHWAY_STATE_SPILL_DIR) to spill cold entries to "
+                "disk, raise the cap, or set "
+                "PATHWAY_KEY_REGISTRY_OVERFLOW=allow to accept "
+                "freeze-open explicitly."
+            )
+        mix = np.flatnonzero(miss)
+        with self._cold_lock:
+            if self._cold is None:
+                from .spill import SpillStore
+                from ..persistence.backends import FilesystemBackend
+
+                self._cold = _ColdKeyTier(
+                    SpillStore(FilesystemBackend(self._spill_dir))
+                )
+            before = self._cold.total
+            cold_idx = self._cold.register(
+                lo[mix].tolist(), hi[mix].tolist()
+            )
+            # count keys newly owned by the cold tier, not probe traffic:
+            # re-verifications of already-cold keys must not inflate the
+            # pathway_key_registry_spilled_total gauge
+            self.spilled_total += self._cold.total - before
+        if cold_idx >= 0:
+            return int(mix[cold_idx])
+        return -1
+
+    # -- stats (hot-registry tuple compat + detailed dict) ---------------
+
+    def stats(self):
+        size, frozen = self._hot.stats()
+        cold = self._cold.total if self._cold is not None else 0
+        return size + cold, int(frozen)
+
+    def detailed_stats(self) -> dict:
+        size, frozen = self._hot.stats()
+        cold = self._cold.total if self._cold is not None else 0
+        return {
+            "entries": size + cold,
+            "hot_entries": size,
+            "cold_entries": cold,
+            "frozen": int(frozen and self._mode == "allow"),
+            "spilled_total": self.spilled_total,
+            "cap": self._cap,
+            "mode": self._mode,
+        }
+
+
+def _registry_spill_dir() -> str | None:
+    import os
+
+    configured = os.environ.get("PATHWAY_KEY_REGISTRY_SPILL_DIR")
+    if configured:
+        # per-pid like every other spill root: sharded workers pointed
+        # at one dir must not clobber each other's bucket generations
+        from .spill import per_pid_scratch
+
+        return per_pid_scratch(configured)
+    state_dir = os.environ.get("PATHWAY_STATE_SPILL_DIR")
+    if state_dir:
+        # ride the state spill tier's scratch root, per-pid like it does
+        from .spill import per_pid_scratch
+
+        return os.path.join(per_pid_scratch(state_dir), "keyreg")
+    return None
 
 
 def _get_registry():
@@ -382,15 +700,46 @@ def _get_registry():
 
         cap = int(os.environ.get("PATHWAY_KEY_REGISTRY_CAP", 1 << 22))
         native = get_native()
-        _REGISTRY = (
+        hot = (
             native.KeyRegistry(cap) if native is not None
             else _PyKeyRegistry(cap)
         )
+        overflow = (
+            os.environ.get("PATHWAY_KEY_REGISTRY_OVERFLOW", "").strip().lower()
+        )
+        spill_dir = _registry_spill_dir()
+        if overflow == "allow":
+            mode = "allow"
+        elif overflow == "error":
+            mode = "error"
+        else:
+            if overflow not in ("", "spill"):
+                import logging
+
+                logging.getLogger("pathway_tpu.keys").warning(
+                    "unknown PATHWAY_KEY_REGISTRY_OVERFLOW=%r (valid: "
+                    "allow | error | spill); using the default cap-hit "
+                    "behavior (spill when a spill dir is configured, "
+                    "hard error otherwise)", overflow,
+                )
+            mode = "spill" if spill_dir is not None else "error"
+        _REGISTRY = _TwoTierRegistry(hot, cap, spill_dir, mode)
     return _REGISTRY
 
 
+def registry_stats() -> dict:
+    """Key-registry gauges for /metrics + the signals plane; cheap, and
+    does NOT instantiate the registry on an idle process."""
+    reg = _REGISTRY
+    if reg is None or not isinstance(reg, _TwoTierRegistry):
+        return {
+            "entries": 0, "hot_entries": 0, "cold_entries": 0,
+            "frozen": 0, "spilled_total": 0, "cap": 0, "mode": "unarmed",
+        }
+    return reg.detailed_stats()
+
+
 def _register_keys(lo: np.ndarray, hi: np.ndarray) -> None:
-    global _REGISTRY_WARNED
     reg = _get_registry()
     idx = reg.register(
         np.ascontiguousarray(lo, dtype=np.uint64),
@@ -402,15 +751,6 @@ def _register_keys(lo: np.ndarray, hi: np.ndarray) -> None:
             f"(lane value {int(lo[idx]):#x}). Two different rows would have "
             "been silently conflated; rerun with distinct key columns or "
             "raise PATHWAY_KEY_REGISTRY_CAP if this is a re-keyed replay."
-        )
-    if not _REGISTRY_WARNED and reg.stats()[1]:
-        _REGISTRY_WARNED = True
-        import logging
-
-        logging.getLogger("pathway_tpu.keys").warning(
-            "key registry reached PATHWAY_KEY_REGISTRY_CAP; 128-bit "
-            "conflation detection is frozen to the first %d keys",
-            reg.stats()[0],
         )
 
 

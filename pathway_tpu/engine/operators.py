@@ -403,6 +403,30 @@ class GroupByReduce(Node):
         self._gerrs: dict[int, list[int]] = {}
         from .reducers import CountReducer, SumReducer
         from .slotmap import SlotMap
+        from . import spill as _spill
+
+        # spill tier (PATHWAY_STATE_MEMORY_BUDGET_MB, engine/spill.py):
+        # dense arenas shed a cold PREFIX block of slots (old groups get
+        # low slot ids; any touch below the boundary faults the whole
+        # block back in); the general path sheds cold groups into hashed
+        # buckets faulted back per-batch. Both materialize into snapshots.
+        self._budget = _spill.get_budget()
+        if self._budget is not None:
+            self._budget.register(self)
+        self._arena_base = 0  # slots [0, base) live in the cold blocks
+        #: spill-store handles, oldest first — each holds one contiguous
+        #: slot range; spills APPEND a block (never rewrite the whole
+        #: cold prefix: that would be quadratic I/O and a 2x RAM spike
+        #: at exactly the over-budget moment)
+        self._arena_cold: list[dict] = []
+        from collections import deque
+
+        self._hot_slot_mins: Any = deque(maxlen=4)
+        self._recent_hist: Any = deque(maxlen=2)
+        self._recent_gks: set[int] = set()
+        self._cold_set: set[int] = set()  # general groups now on disk
+        self._cold_buckets: dict[int, dict] = {}  # bucket id -> handle
+        self._entry_bytes_est = 512  # refined from real pickles at spill
 
         self._dense = all(
             type(r) in (CountReducer, SumReducer) for _, r, _ in reducers
@@ -437,16 +461,41 @@ class GroupByReduce(Node):
         return True
 
     def snapshot_state(self) -> dict:
+        # snapshots are the truth: spilled state (cold arena block, cold
+        # general groups) MATERIALIZES into the snapshot, so recovery and
+        # the resharder never depend on the scratch spill dir
         st: dict = {
-            "_state": self._state,
+            "_state": self._general_materialized(),
             "dense": self._dense,
             "gerrs": self._gerrs,
         }
         if self._dense:
             # trim arenas to allocated slots; the SlotMap is reconstructed
             # from _gkey_by_slot on restore (SlotMap.rebuild)
-            n = len(self._slots)
-            st["arena"] = {
+            st["arena"] = self._arena_full_trimmed()
+        return st
+
+    def _general_materialized(self) -> dict:
+        """The general-path state with every cold group faulted into a
+        COPY (the live dict and the cold tier stay as they are)."""
+        if not self._cold_set:
+            return self._state
+        merged = dict(self._state)
+        store = self._budget.spill_store()
+        for b, handle in self._cold_buckets.items():
+            for gk, entry in store.get_blob(handle).items():
+                if gk in self._cold_set:
+                    merged[gk] = entry
+        return merged
+
+    def _arena_full_trimmed(self) -> dict:
+        """Snapshot-format arena covering slots [0, n): the cold block
+        (if spilled) concatenated with the resident tail, copies only."""
+        n = len(self._slots)
+        base = self._arena_base
+        r = n - base  # resident slot count
+        if not base:
+            return {
                 "_counts": self._counts[:n].copy(),
                 "_gkey_by_slot": self._gkey_by_slot[:n].copy(),
                 "_emitted": self._emitted[:n].copy(),
@@ -454,13 +503,45 @@ class GroupByReduce(Node):
                 "_prev": [p[:n].copy() for p in self._prev],
                 "_gvals": [None if g is None else g[:n].copy() for g in self._gvals],
             }
-        return st
+        cold = self._load_cold_blocks()
+
+        def cat(c, res):
+            if c is None and res is None:
+                return None
+            if c is None:
+                return res.copy()
+            if res is None or not len(res):
+                return c.copy()
+            return _concat_arena([c, res])
+
+        return {
+            "_counts": cat(cold["_counts"], self._counts[:r]),
+            "_gkey_by_slot": cat(cold["_gkey_by_slot"], self._gkey_by_slot[:r]),
+            "_emitted": cat(cold["_emitted"], self._emitted[:r]),
+            "_accs": [
+                cat(c, None if a is None else a[:r])
+                for c, a in zip(cold["_accs"], self._accs)
+            ],
+            "_prev": [
+                cat(c, p[:r]) for c, p in zip(cold["_prev"], self._prev)
+            ],
+            "_gvals": [
+                cat(c, None if g is None else g[:r])
+                for c, g in zip(cold["_gvals"], self._gvals)
+            ],
+        }
 
     def restore_state(self, state: dict) -> None:
         from .slotmap import SlotMap
 
         self._state = state["_state"]
         self._gerrs = state.get("gerrs", {})
+        # restored state is fully resident; any previous spill handles
+        # belong to a dead generation of this operator
+        self._arena_base = 0
+        self._arena_cold = []
+        self._cold_set = set()
+        self._cold_buckets = {}
         if not state["dense"]:
             if self._dense:
                 # snapshot was taken after a demotion — mirror it
@@ -476,6 +557,208 @@ class GroupByReduce(Node):
         self._prev = a["_prev"]
         self._gvals = a["_gvals"]
         self._slots = SlotMap.rebuild(self._gkey_by_slot)
+
+    # -- spill tier (engine/spill.py spillable protocol) -------------------
+
+    _ARENA_KEYS = ("_counts", "_gkey_by_slot", "_emitted")
+
+    def spillable_bytes(self) -> int:
+        if self._dense:
+            total = self._counts.nbytes + self._gkey_by_slot.nbytes
+            total += self._emitted.nbytes
+            for group in (self._accs, self._prev, self._gvals):
+                for a in group:
+                    if a is not None:
+                        total += (
+                            len(a) * 64 if a.dtype == object else a.nbytes
+                        )
+            return total
+        return len(self._state) * self._entry_bytes_est
+
+    def spilled_bytes(self) -> int:
+        total = sum(h["bytes"] for h in self._cold_buckets.values())
+        total += sum(h["bytes"] for h in self._arena_cold)
+        return total
+
+    def spill(self, want_bytes: int) -> int:
+        if self._budget is None:
+            return 0
+        if self._dense:
+            return self._spill_dense(want_bytes)
+        return self._spill_general(want_bytes)
+
+    @staticmethod
+    def _bucket_of(gk: int) -> int:
+        return (gk >> 56) & 0xFF
+
+    def _spill_dense(self, want_bytes: int) -> int:
+        """Extend the cold prefix: every slot below the recent hot-slot
+        watermark moves to ONE new delta block appended after the
+        existing cold blocks (spills never reload or rewrite earlier
+        blocks). Resident arrays re-slice only after the write lands."""
+        n = len(self._slots)
+        base = self._arena_base
+        if n - base == 0:
+            return 0
+        hot_min = min(self._hot_slot_mins) if self._hot_slot_mins else n
+        boundary = min(hot_min, n)
+        k = boundary - base  # newly-cold resident slots
+        if k <= 0:
+            return 0
+        store = self._budget.spill_store()
+        payload = {
+            "_counts": self._counts[:k].copy(),
+            "_gkey_by_slot": self._gkey_by_slot[:k].copy(),
+            "_emitted": self._emitted[:k].copy(),
+            "_accs": [
+                None if a is None else a[:k].copy() for a in self._accs
+            ],
+            "_prev": [p[:k].copy() for p in self._prev],
+            "_gvals": [
+                None if g is None else g[:k].copy() for g in self._gvals
+            ],
+        }
+        freed = 0
+        for group in ((self._counts, self._gkey_by_slot, self._emitted),
+                      self._accs, self._prev, self._gvals):
+            for a in group:
+                if a is not None:
+                    freed += (
+                        k * 64 if a.dtype == object else k * a.itemsize
+                    )
+        handle = store.put_blob("gb/arena", payload)
+        self._arena_cold.append(handle)
+        self._arena_base = boundary
+        self._counts = self._counts[k:].copy()
+        self._gkey_by_slot = self._gkey_by_slot[k:].copy()
+        self._emitted = self._emitted[k:].copy()
+        self._accs = [None if a is None else a[k:].copy() for a in self._accs]
+        self._prev = [p[k:].copy() for p in self._prev]
+        self._gvals = [
+            None if g is None else g[k:].copy() for g in self._gvals
+        ]
+        return freed
+
+    def _unspill_arena(self) -> None:
+        """Fault the cold blocks back in front of the resident arrays."""
+        store = self._budget.spill_store()
+        cold = self._load_cold_blocks()
+
+        def cat(c, res):
+            if c is None:
+                return res
+            if res is None or not len(res):
+                return c
+            return _concat_arena([c, res])
+
+        self._counts = cat(cold["_counts"], self._counts)
+        self._gkey_by_slot = cat(cold["_gkey_by_slot"], self._gkey_by_slot)
+        self._emitted = cat(cold["_emitted"], self._emitted)
+        self._accs = [
+            cat(c, a) for c, a in zip(cold["_accs"], self._accs)
+        ]
+        self._prev = [cat(c, p) for c, p in zip(cold["_prev"], self._prev)]
+        self._gvals = [
+            cat(c, g) for c, g in zip(cold["_gvals"], self._gvals)
+        ]
+        for h in self._arena_cold:
+            store.drop_blob(h)
+        self._arena_cold = []
+        self._arena_base = 0
+
+    def _load_cold_blocks(self) -> dict:
+        """The full cold prefix as one arena dict: every delta block
+        loaded and concatenated in spill (= slot) order. Columns absent
+        (None) in a block are absent in all of them — ``_gvals``/``_accs``
+        None-ness is decided before the first slot exists."""
+        store = self._budget.spill_store()
+        blocks = [store.get_blob(h) for h in self._arena_cold]
+        if len(blocks) == 1:
+            return blocks[0]
+
+        def cat(cols):
+            present = [c for c in cols if c is not None]
+            if not present:
+                return None
+            return _concat_arena(present)
+
+        return {
+            "_counts": cat([b["_counts"] for b in blocks]),
+            "_gkey_by_slot": cat([b["_gkey_by_slot"] for b in blocks]),
+            "_emitted": cat([b["_emitted"] for b in blocks]),
+            "_accs": [
+                cat([b["_accs"][j] for b in blocks])
+                for j in range(len(self._accs))
+            ],
+            "_prev": [
+                cat([b["_prev"][j] for b in blocks])
+                for j in range(len(self._prev))
+            ],
+            "_gvals": [
+                cat([b["_gvals"][ci] for b in blocks])
+                for ci in range(len(self._gvals))
+            ],
+        }
+
+    def _spill_general(self, want_bytes: int) -> int:
+        """Move cold groups (untouched in the recent batches) into hashed
+        disk buckets. A bucket whose write fails keeps its groups resident
+        — nothing is dropped before its bytes are durable."""
+        if not self._state:
+            return 0
+        store = self._budget.spill_store()
+        if self._state and self._entry_bytes_est == 512:
+            import itertools, pickle as _pickle
+
+            sample = list(itertools.islice(self._state.items(), 8))
+            self._entry_bytes_est = max(
+                64, len(_pickle.dumps(sample)) // len(sample)
+            )
+        moved: dict[int, dict[int, list]] = {}
+        budgeted = 0
+        for gk, entry in self._state.items():
+            if gk in self._recent_gks:
+                continue
+            moved.setdefault(self._bucket_of(gk), {})[gk] = entry
+            budgeted += self._entry_bytes_est
+            if budgeted >= want_bytes:
+                break
+        freed = 0
+        for b, entries in moved.items():
+            prev = self._cold_buckets.get(b)
+            existing = store.get_blob(prev) if prev is not None else {}
+            # prune entries faulted back in since the last write — the
+            # cold set is the single source of which keys disk owns
+            merged = {
+                k: v for k, v in existing.items() if k in self._cold_set
+            }
+            merged.update(entries)
+            handle = store.put_blob(f"gb/bucket/{b:02x}", merged, prev=prev)
+            self._cold_buckets[b] = handle
+            for gk in entries:
+                del self._state[gk]
+                self._cold_set.add(gk)
+            freed += len(entries) * self._entry_bytes_est
+        return freed
+
+    def _fault_in_groups(self, gkeys: np.ndarray) -> None:
+        """Move any of this batch's groups that live in cold buckets back
+        into the resident dict (called before the per-row loop)."""
+        need: dict[int, list[int]] = {}
+        for gk in set(gkeys.tolist()):
+            gk = int(gk)
+            if gk in self._cold_set:
+                need.setdefault(self._bucket_of(gk), []).append(gk)
+        if not need:
+            return
+        store = self._budget.spill_store()
+        for b, gks in need.items():
+            data = store.get_blob(self._cold_buckets[b])
+            for gk in gks:
+                entry = data.get(gk)
+                if entry is not None:
+                    self._state[gk] = entry
+                self._cold_set.discard(gk)
 
     # -- elastic rescale (rescale/resharder.py) ---------------------------
 
@@ -649,6 +932,11 @@ class GroupByReduce(Node):
         of the general path's ``del self._state[gk]``."""
         from .slotmap import SlotMap
 
+        if self._arena_base:
+            # cold slots are on disk and SlotMap.rebuild would renumber
+            # resident slots over the cold block's ids — reclaim resumes
+            # after the next fault-in
+            return
         n_alloc = len(self._slots)
         live = np.flatnonzero(
             (self._counts[:n_alloc] != 0) | self._emitted[:n_alloc]
@@ -670,16 +958,25 @@ class GroupByReduce(Node):
     def _process_dense(self, d, n, gcols, gkeys, arg_arrays) -> Delta | None:
         self._reclaim_arena()
         slots, n_new = self._slots.lookup_or_insert(gkeys)
+        if self._arena_base and int(slots.min()) < self._arena_base:
+            # the batch touches a group inside the spilled cold block —
+            # fault the whole block back in (O(cold) once, then the
+            # resident fast path below runs unchanged)
+            self._unspill_arena()
+        base = self._arena_base
+        self._hot_slot_mins.append(int(slots.min()))
         old_n = len(self._slots) - n_new
-        self._grow(len(self._slots))
+        self._grow(len(self._slots) - base)
         order = np.argsort(slots, kind="stable")
         ss = slots[order]
         boundaries = np.flatnonzero(np.diff(ss) != 0) + 1
         starts = np.concatenate([[0], boundaries])
-        u_slots = ss[starts]
+        u_slots_abs = ss[starts]
+        # arena arrays cover slots [base, n) — index them relative
+        u_slots = u_slots_abs - base
         if n_new:
             first_ix = order[starts]  # first batch occurrence of each u_slot
-            fresh = u_slots >= old_n
+            fresh = u_slots_abs >= old_n
             self._gkey_by_slot[u_slots[fresh]] = gkeys[first_ix[fresh]]
             for ci, col in enumerate(gcols):
                 stored = self._gvals[ci]
@@ -760,6 +1057,8 @@ class GroupByReduce(Node):
     def _demote(self) -> None:
         """Migrate arena state into the general dict state (a non-numeric
         argument column arrived); one-way, per-operator."""
+        if self._arena_base:
+            self._unspill_arena()
         self._dense = False
         live = np.flatnonzero(self._counts != 0)
         for slot in live:
@@ -784,6 +1083,12 @@ class GroupByReduce(Node):
     # -- general path ----------------------------------------------------
 
     def _process_general(self, d, n, gcols, gkeys, time) -> Delta | None:
+        if self._cold_set:
+            self._fault_in_groups(gkeys)
+        if self._budget is not None:
+            batch = set(map(int, gkeys.tolist()))
+            self._recent_hist.append(batch)
+            self._recent_gks = set().union(*self._recent_hist)
         arg_cols = [[d.data[a] for a in args] for _, _, args in self._reducers]
         # Error-aware only when errors exist at all (the errors_seen latch
         # trips on every Error construction/unpickle — zero-cost guard on
@@ -975,6 +1280,18 @@ class _SortedSide:
       pad snapshots of an unchanged arrangement) pay the binary search
       once. Runs are immutable after construction, which is what makes
       identity a sound cache key.
+
+    Under ``PATHWAY_STATE_MEMORY_BUDGET_MB`` (engine/spill.py) the
+    arrangement participates in the spill tier: cold runs (oldest first —
+    size-tiering makes them the largest and the last to merge) shed their
+    payload (row keys, value columns, counts) to the spill store, keeping
+    only the sorted jk array and the count prefix-sum resident. ``totals``
+    stays a pure in-memory operation; ``probe`` loads a spilled payload
+    transiently ONLY when its jk range actually matches — the hot-key
+    working set never touches disk. Snapshots are the truth: pickling
+    (``__getstate__``) materializes every spilled run back into the
+    resident representation, so recovery, ``split_state``/``merge_states``
+    and the resharder never see a spill handle.
     """
 
     MAX_RUNS = 8
@@ -986,20 +1303,103 @@ class _SortedSide:
         #: (id(run_jks), id(qjks)) -> (run_jks, qjks, lo, hi); strong refs
         #: make ids valid, the size bound makes the pinning harmless
         self._range_cache: dict = {}
+        #: spilled cold runs, oldest first: [jks_sorted, csum, handle] —
+        #: payload (row_keys, cols, counts) lives in the spill store
+        self._spilled: list[list] = []
+        from . import spill as _spill
+
+        self._budget = _spill.get_budget()
+        if self._budget is not None:
+            self._budget.register(self)
 
     def __getstate__(self) -> dict:
         # the memo must not ride into operator snapshots (it pins query
-        # arrays and is identity-keyed — meaningless after unpickling)
+        # arrays and is identity-keyed — meaningless after unpickling);
+        # spilled runs MATERIALIZE into the snapshot — the scratch spill
+        # dir is a cache, never part of durable or resharded state
         d = dict(self.__dict__)
         d.pop("_range_cache", None)
+        d.pop("_budget", None)
+        spilled = d.pop("_spilled", None)
+        if spilled:
+            d["_runs"] = [self._load_spilled(rec) for rec in spilled] + list(
+                d["_runs"]
+            )
         return d
 
     def __setstate__(self, d: dict) -> None:
         self.__dict__.update(d)
         self._range_cache = {}
+        self._spilled = []
+        from . import spill as _spill
+
+        self._budget = _spill.get_budget()
+        if self._budget is not None:
+            self._budget.register(self)
 
     def __len__(self) -> int:
-        return sum(len(r[0]) for r in self._runs)
+        return sum(len(r[0]) for r in self._runs) + sum(
+            len(rec[0]) for rec in self._spilled
+        )
+
+    # -- spill tier (engine/spill.py spillable protocol) -----------------
+
+    @staticmethod
+    def _col_bytes(col) -> int:
+        arr = np.asarray(col)
+        if arr.dtype == object:
+            # pointer + a modest boxed-object estimate per cell
+            return len(arr) * 64
+        return arr.nbytes
+
+    def _payload_bytes(self, run: list) -> int:
+        # run[0] (jks) and run[4] (csum) stay resident after a spill, so
+        # only keys + value columns + counts count as spillable
+        return (
+            run[1].nbytes
+            + run[3].nbytes
+            + sum(self._col_bytes(c) for c in run[2])
+        )
+
+    def spillable_bytes(self) -> int:
+        return sum(self._payload_bytes(r) for r in self._runs)
+
+    def spilled_bytes(self) -> int:
+        return sum(rec[2]["bytes"] for rec in self._spilled)
+
+    def spill(self, want_bytes: int) -> int:
+        """Shed the oldest resident runs' payloads to the spill store
+        until ~want_bytes moved. A failed blob write propagates with the
+        run still resident (the budget logs and keeps going)."""
+        if self._budget is None:
+            return 0
+        store = self._budget.spill_store()
+        freed = 0
+        while self._runs and freed < want_bytes:
+            run = self._runs[0]
+            nbytes = self._payload_bytes(run)
+            handle = store.put_blob("join/run", (run[1], run[2], run[3]))
+            self._runs.pop(0)
+            self._spilled.append([run[0], run[4], handle])
+            self._range_cache.clear()
+            freed += nbytes
+        return freed
+
+    def _load_spilled(self, rec: list) -> list:
+        keys, cols, counts = self._budget.spill_store().get_blob(rec[2])
+        return [rec[0], keys, cols, counts, rec[1]]
+
+    def _unspill_all(self) -> None:
+        """Materialize every spilled run back in front of the resident
+        list (compaction needs the whole arrangement)."""
+        if not self._spilled:
+            return
+        store = self._budget.spill_store()
+        loaded = [self._load_spilled(rec) for rec in self._spilled]
+        for rec in self._spilled:
+            store.drop_blob(rec[2])
+        self._spilled = []
+        self._runs[:0] = loaded
 
     @staticmethod
     def _make_run(jks, keys, cols, counts) -> list:
@@ -1090,6 +1490,7 @@ class _SortedSide:
     def _compact(self) -> None:
         from .delta import _concat_cols
 
+        self._unspill_all()
         jks = np.concatenate([r[0] for r in self._runs])
         keys = np.concatenate([r[1] for r in self._runs])
         cols = [
@@ -1113,7 +1514,22 @@ class _SortedSide:
 
     def probe(self, qjks: np.ndarray):
         """Yield (q_idx, row_keys, col_arrays, counts) for every state row
-        matching each query jk, per run — the vectorized pair enumeration."""
+        matching each query jk, per run — the vectorized pair enumeration.
+        Spilled runs (oldest, probed first to keep run order) decide the
+        match from their RESIDENT jk array and load the payload from disk
+        only on an actual hit — the working set stays in memory."""
+        for rec in self._spilled:
+            lo, hi = self._ranges(rec, qjks)
+            m = hi - lo
+            total = int(m.sum())
+            if not total:
+                continue
+            _jks_s, keys, cols, counts, _csum = self._load_spilled(rec)
+            q_idx = np.repeat(np.arange(len(qjks)), m)
+            side_idx = np.repeat(lo, m) + (
+                np.arange(total) - np.repeat(np.cumsum(m) - m, m)
+            )
+            yield q_idx, keys[side_idx], [c[side_idx] for c in cols], counts[side_idx]
         for run in self._runs:
             _jks_s, keys, cols, counts, _csum = run
             lo, hi = self._ranges(run, qjks)
@@ -1130,8 +1546,14 @@ class _SortedSide:
     def totals(self, qjks: np.ndarray) -> np.ndarray:
         """Total row multiplicity per query jk (the match-count vector the
         pad bookkeeping needs) — memoized searchsorted over a per-run
-        prefix sum (shared with ``probe`` on the same query array)."""
+        prefix sum (shared with ``probe`` on the same query array). Pure
+        in-memory even for spilled runs: their jks + prefix sums never
+        leave RAM."""
         out = np.zeros(len(qjks), dtype=np.int64)
+        for rec in self._spilled:
+            lo, hi = self._ranges(rec, qjks)
+            csum = rec[1]
+            out += csum[hi] - csum[lo]
         for run in self._runs:
             lo, hi = self._ranges(run, qjks)
             csum = run[4]

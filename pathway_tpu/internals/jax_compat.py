@@ -1,0 +1,106 @@
+"""Version-portable JAX surface.
+
+The accelerator modules (``parallel/exchange``, ``ops/knn``,
+``models/ring_attention``) were written against the modern top-level
+``jax.shard_map`` API (``check_vma=`` keyword). Older JAX releases (the
+0.4.x line baked into some environments) only ship
+``jax.experimental.shard_map.shard_map`` with the keyword spelled
+``check_rep=``. This shim resolves whichever exists at import time and
+translates the keyword, so one call site works on both — and capability
+probing (``shard_map_available()``) is a function of this module, not a
+scattered try/except per caller.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+__all__ = [
+    "shard_map",
+    "shard_map_available",
+    "shard_map_unavailable_reason",
+    "enable_cpu_collectives",
+    "multihost_cpu_supported",
+]
+
+_IMPL: Any = None
+_NEEDS_CHECK_REP = False
+_REASON: str | None = None
+
+try:  # modern API (jax >= 0.5): top-level export, check_vma keyword
+    from jax import shard_map as _IMPL  # type: ignore[attr-defined]
+except ImportError:
+    try:  # legacy API (jax 0.4.x): experimental module, check_rep keyword
+        from jax.experimental.shard_map import shard_map as _IMPL
+
+        _NEEDS_CHECK_REP = True
+    except ImportError as e:  # pragma: no cover - no shard_map at all
+        _IMPL = None
+        _REASON = f"jax provides no shard_map implementation: {e}"
+
+
+def shard_map_available() -> bool:
+    """Whether ANY shard_map implementation exists in this environment."""
+    return _IMPL is not None
+
+
+def shard_map_unavailable_reason() -> str:
+    return _REASON or "shard_map is available"
+
+
+def enable_cpu_collectives() -> bool:
+    """Arm gloo TCP collectives on the CPU backend (required for ANY
+    multiprocess computation there — XLA's default CPU client refuses
+    them outright). Must run before the first backend/distributed-client
+    creation; harmless no-op on TPU/GPU or when the config knob or gloo
+    build is absent. Returns whether CPU collectives are armed."""
+    import jax
+
+    try:
+        # NB: attribute-style reads of this option raise on the 0.4.x
+        # line; the values mapping + update() are the portable surface
+        if jax.config.values.get("jax_cpu_collectives_implementation") == "gloo":
+            return True
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        return True
+    except Exception:
+        return False
+
+
+def multihost_cpu_supported() -> tuple[bool, str]:
+    """Whether this environment can run multiprocess computations on the
+    CPU backend — (ok, reason). Capability probe for tests: a False here
+    means 'skip with this reason', not 'xfail and hope'."""
+    try:
+        import jaxlib.xla_extension as xe
+
+        if not hasattr(xe, "make_gloo_tcp_collectives"):
+            return False, (
+                "jaxlib built without gloo TCP collectives: multiprocess "
+                "computations are unimplemented on the default CPU client"
+            )
+    except ImportError as e:
+        return False, f"jaxlib.xla_extension unavailable: {e}"
+    import jax
+
+    if "jax_cpu_collectives_implementation" not in jax.config.values:
+        return False, (
+            "jax.config lacks jax_cpu_collectives_implementation: cannot "
+            "arm gloo CPU collectives on this jax version"
+        )
+    return True, "gloo CPU collectives available"
+
+
+def shard_map(f: Any = None, **kwargs: Any) -> Any:
+    """``jax.shard_map`` with the keyword dialect of the installed JAX.
+
+    Usable directly or via ``functools.partial(shard_map, mesh=...)`` the
+    way every call site in this repo does."""
+    if _IMPL is None:
+        raise ImportError(shard_map_unavailable_reason())
+    if _NEEDS_CHECK_REP and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    if f is None:
+        return functools.partial(shard_map, **kwargs)
+    return _IMPL(f, **kwargs)

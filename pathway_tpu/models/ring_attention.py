@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ..internals.jax_compat import shard_map
 
 __all__ = ["ring_attention", "full_attention"]
 

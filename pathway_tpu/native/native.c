@@ -882,6 +882,84 @@ static PyObject *keyregistry_register(PyObject *self, PyObject *args) {
     return PyLong_FromSsize_t(conflict);
 }
 
+/* register_overflow(lo_u64_buf, hi_u64_buf, miss_u8_buf)
+ *   -> first conflicting index or -1
+ * Two-tier variant of register(): identical insert/detect behavior for
+ * the hot in-memory table, but once the table is FROZEN (cap reached),
+ * keys absent from it are NOT silently passed — miss[i] is set to 1 and
+ * the caller (engine/keys.py) probes/inserts them in the spilled cold
+ * tier. miss must be a writable byte buffer of at least n entries; only
+ * miss indexes of absent-while-frozen keys are written (caller zeroes). */
+static PyObject *keyregistry_register_overflow(PyObject *self, PyObject *args) {
+    KeyRegistryObject *t = (KeyRegistryObject *)self;
+    PyObject *lo_obj, *hi_obj, *miss_obj;
+    Py_buffer lo, hi, miss;
+    Py_ssize_t n, i, conflict = -1;
+    if (!PyArg_ParseTuple(args, "OOO", &lo_obj, &hi_obj, &miss_obj))
+        return NULL;
+    if (PyObject_GetBuffer(lo_obj, &lo, PyBUF_C_CONTIGUOUS) < 0) return NULL;
+    if (PyObject_GetBuffer(hi_obj, &hi, PyBUF_C_CONTIGUOUS) < 0) {
+        PyBuffer_Release(&lo);
+        return NULL;
+    }
+    if (PyObject_GetBuffer(miss_obj, &miss,
+                           PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE) < 0) {
+        PyBuffer_Release(&lo); PyBuffer_Release(&hi);
+        return NULL;
+    }
+    n = lo.len / 8;
+    if (hi.len / 8 < n || miss.len < n) {
+        PyBuffer_Release(&lo); PyBuffer_Release(&hi); PyBuffer_Release(&miss);
+        PyErr_SetString(PyExc_ValueError, "hi/miss buffer too small");
+        return NULL;
+    }
+    if (!t->frozen && (t->size + n) * 10 >= t->capacity * 7) {
+        Py_ssize_t want = (t->size + n) * 2;
+        if (want > t->max_entries * 2) want = t->max_entries * 2;
+        if (want > t->capacity && keyregistry_grow(t, want) < 0) {
+            PyBuffer_Release(&lo); PyBuffer_Release(&hi);
+            PyBuffer_Release(&miss);
+            return NULL;
+        }
+    }
+    if (t->capacity) {
+        const uint64_t *slo = (const uint64_t *)lo.buf;
+        const uint64_t *shi = (const uint64_t *)hi.buf;
+        uint8_t *smiss = (uint8_t *)miss.buf;
+        uint64_t mask = (uint64_t)(t->capacity - 1);
+        for (i = 0; i < n; i++) {
+            uint64_t k = slo[i];
+            Py_ssize_t j = (Py_ssize_t)(splitmix(k) & mask);
+            while (t->used[j] && t->keys[j] != k) j = (j + 1) & mask;
+            if (t->used[j]) {
+                if (t->his[j] != shi[i]) {
+                    conflict = i;
+                    break;
+                }
+            } else if (!t->frozen) {
+                t->used[j] = 1;
+                t->keys[j] = k;
+                t->his[j] = shi[i];
+                t->size++;
+                if (t->size >= t->max_entries) t->frozen = 1;
+            } else {
+                smiss[i] = 1;
+            }
+        }
+    } else {
+        /* zero-capacity table (cap so small nothing was ever inserted):
+         * every key is an overflow miss once frozen; pre-freeze the grow
+         * above always allocates, so capacity==0 implies nothing stored */
+        uint8_t *smiss = (uint8_t *)miss.buf;
+        if (t->frozen)
+            for (i = 0; i < n; i++) smiss[i] = 1;
+    }
+    PyBuffer_Release(&lo);
+    PyBuffer_Release(&hi);
+    PyBuffer_Release(&miss);
+    return PyLong_FromSsize_t(conflict);
+}
+
 static PyObject *keyregistry_stats(PyObject *self, PyObject *noarg) {
     KeyRegistryObject *t = (KeyRegistryObject *)self;
     (void)noarg;
@@ -911,6 +989,9 @@ static PyObject *keyregistry_new(PyTypeObject *type, PyObject *args,
 static PyMethodDef keyregistry_methods[] = {
     {"register", keyregistry_register, METH_VARARGS,
      "register(lo_u64, hi_u64) -> first conflicting index or -1"},
+    {"register_overflow", keyregistry_register_overflow, METH_VARARGS,
+     "register_overflow(lo_u64, hi_u64, miss_u8) -> first conflicting "
+     "index or -1; frozen-table misses flagged for the cold tier"},
     {"stats", keyregistry_stats, METH_NOARGS, "stats() -> (size, frozen)"},
     {NULL, NULL, 0, NULL},
 };

@@ -251,18 +251,39 @@ class ObservabilityHub:
         tracer = get_tracer()
         return tracer._dropped if tracer is not None else None
 
+    @staticmethod
+    def memory_stats_snapshot() -> dict[str, float]:
+        """This process's memory/spill/key-registry gauges (RSS, state
+        budget occupancy, spill counters, registry tiers) — shipped in
+        /snapshot like the comm gauges so the roll-up renders them per
+        process."""
+        try:
+            from ..engine.spill import memory_snapshot
+
+            return memory_snapshot()
+        except Exception:
+            # telemetry must not fail the run it observes
+            return {}
+
     def snapshot_document(self) -> dict:
         """The /snapshot payload peers serve to process 0."""
         return {
             "process_id": self.process_id,
             "workers": self.local_snapshots(),
             "comm": self.comm_snapshot(),
+            "memory": self.memory_stats_snapshot(),
             "trace_dropped": self._local_trace_dropped(),
         }
 
     def cluster_snapshots(
         self,
-    ) -> tuple[list[dict], dict[str, dict], dict[str, int], dict[str, float]]:
+    ) -> tuple[
+        list[dict],
+        dict[str, dict],
+        dict[str, int],
+        dict[str, float],
+        dict[str, dict],
+    ]:
         """Local snapshots plus every reachable peer's; comm stats keyed
         by process id; tracer drops per reporting process (a transiently
         unreachable peer is MISSING from the dict, so its metrics series
@@ -277,6 +298,7 @@ class ObservabilityHub:
         so a dead peer reads as STALE, not as a smaller fleet."""
         snapshots = self.local_snapshots()
         comm_stats = {str(self.process_id): self.comm_snapshot()}
+        memory_stats = {str(self.process_id): self.memory_stats_snapshot()}
         trace_dropped: dict[str, int] = {}
         stale: dict[str, float] = {}
         local_dropped = self._local_trace_dropped()
@@ -309,13 +331,16 @@ class ObservabilityHub:
             self._peer_cache[i] = (now, doc)
             snapshots.extend(doc.get("workers", []))
             comm_stats[str(doc.get("process_id", "?"))] = doc.get("comm", {})
+            peer_mem = doc.get("memory")
+            if peer_mem:
+                memory_stats[str(doc.get("process_id", "?"))] = peer_mem
             peer_dropped = doc.get("trace_dropped")
             if peer_dropped is not None:
                 trace_dropped[str(doc.get("process_id", "?"))] = int(
                     peer_dropped
                 )
         snapshots.sort(key=lambda s: s.get("worker", 0))
-        return snapshots, comm_stats, trace_dropped, stale
+        return snapshots, comm_stats, trace_dropped, stale, memory_stats
 
     @staticmethod
     def _scrape_peer(host: str, port: int) -> dict | None:
@@ -424,6 +449,7 @@ class ObservabilityHub:
                 "comm.send_queue_depth", None, w
             )
         doc["comm"] = comm
+        doc["memory"] = self.memory_stats_snapshot()
         from .attribution import attribution_document
 
         doc["attribution"] = attribution_document(sig, w)
@@ -492,6 +518,7 @@ class ObservabilityHub:
         merged["stale_workers"] = stale_workers
         merged["workers"] = dict(local.get("workers", {}))
         merged["comm"] = {str(self.process_id): local.get("comm", {})}
+        merged["memory"] = {str(self.process_id): local.get("memory", {})}
         merged["alerts"] = {
             "active": list(local.get("alerts", {}).get("active", [])),
             "history": list(local.get("alerts", {}).get("history", [])),
@@ -506,6 +533,7 @@ class ObservabilityHub:
             processes.append(pid)
             merged["workers"].update(doc.get("workers", {}))
             merged["comm"][str(pid)] = doc.get("comm", {})
+            merged["memory"][str(pid)] = doc.get("memory", {})
             alerts = doc.get("alerts", {})
             merged["alerts"]["active"].extend(alerts.get("active", []))
             merged["alerts"]["history"].extend(alerts.get("history", []))
@@ -616,7 +644,7 @@ class ObservabilityHub:
         trace_dropped: int | dict[str, int] | None
         stale: dict[str, float] | None = None
         if self.peer_http:
-            snapshots, comm_stats, dropped_by_proc, stale = (
+            snapshots, comm_stats, dropped_by_proc, stale, memory_stats = (
                 self.cluster_snapshots()
             )
             # per-process labels, like the comm gauges: series identity
@@ -626,6 +654,8 @@ class ObservabilityHub:
             snapshots = self.local_snapshots()
             comm = self.comm_snapshot()
             comm_stats = {str(self.process_id): comm} if comm else {}
+            mem = self.memory_stats_snapshot()
+            memory_stats = {str(self.process_id): mem} if mem else {}
             trace_dropped = self._local_trace_dropped()
         # label by TOPOLOGY, not by how many snapshots this scrape got:
         # in cluster mode a transient peer outage must not flip series
@@ -669,6 +699,7 @@ class ObservabilityHub:
             alerts_fired=alerts_fired,
             alerts_active=alerts_active,
             autoscale=self._autoscale_snapshot(),
+            memory_stats=memory_stats or None,
         )
 
     @staticmethod

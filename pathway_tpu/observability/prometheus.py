@@ -117,6 +117,7 @@ def render_snapshots(
     alerts_fired: dict[str, int] | None = None,
     alerts_active: int | None = None,
     autoscale: dict | None = None,
+    memory_stats: dict[str, dict[str, float]] | None = None,
 ) -> str:
     """Exposition text for a set of worker stats snapshots.
 
@@ -202,6 +203,22 @@ def render_snapshots(
             # (queue depths, broken flag)
             kind = "counter" if key.endswith("_total") else "gauge"
             r.add(f"pathway_comm_{key}", kind, value, plab)
+    for proc, gauges in sorted((memory_stats or {}).items()):
+        # memory-at-scale surface (engine/spill.py memory_snapshot):
+        # process RSS, state-budget occupancy, spill counters and the
+        # two-tier key registry — per process, like the comm gauges
+        plab = {"process": str(proc)}
+        for key, value in sorted(gauges.items()):
+            if key.startswith("key_registry"):
+                name = f"pathway_{key}"
+            elif key == "rss_bytes":
+                name = "pathway_process_rss_bytes"
+            elif key.endswith("_total"):
+                name = f"pathway_state_{key}"  # spill/load event counters
+            else:
+                name = f"pathway_{key}"  # state_*_bytes gauges
+            kind = "counter" if name.endswith("_total") else "gauge"
+            r.add(name, kind, value, plab)
     r.add("pathway_cluster_workers", "gauge", len(snapshots))
     if stale_workers:
         # a peer whose /snapshot scrape failed: its workers are reported

@@ -462,6 +462,11 @@ class SignalsPlane:
                 rec(OP_ROWS_PREFIX + op, float(n))
         for key, value in self.hub.comm_snapshot().items():
             self.store.record(f"comm.{key}", float(value), None, t)
+        # memory/spill/key-registry gauges (engine/spill.py): process-
+        # scoped like the comm series — SLO rules and the autoscale
+        # decider can watch rss_bytes or state_spilled_bytes directly
+        for key, value in self.hub.memory_stats_snapshot().items():
+            self.store.record(f"mem.{key}", float(value), None, t)
 
     # -- lifecycle -----------------------------------------------------
 

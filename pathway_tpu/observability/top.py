@@ -88,6 +88,35 @@ def render_frame(doc: dict, now: float | None = None) -> str:
             f" frames, {_fmt(c.get('send_mb_per_sec'), ' MB/s', 2)}, "
             f"inbox {_fmt(c.get('cluster_inbox_depth'), nd=0)}"
         )
+    mem = doc.get("memory", {})
+    # merged docs key memory by process; single-process docs are flat
+    mem_by_proc = (
+        mem
+        if mem and all(isinstance(v, dict) for v in mem.values())
+        else {str(doc.get("process_id", 0)): mem}
+    )
+    for proc in sorted(mem_by_proc):
+        m = mem_by_proc[proc] or {}
+        if not m:
+            continue
+        line = (
+            f"mem p{proc}: rss {_fmt(m.get('rss_bytes', 0) / 1e6, ' MB', 0)}"
+        )
+        if m.get("state_budget_bytes"):
+            line += (
+                f", state {_fmt(m.get('state_resident_bytes', 0) / 1e6, nd=1)}"
+                f"/{_fmt(m['state_budget_bytes'] / 1e6, ' MB', 1)} resident"
+                f", {_fmt(m.get('state_spilled_bytes', 0) / 1e6, ' MB', 1)}"
+                f" spilled ({_fmt(m.get('spill_events_total'), nd=0)} spills)"
+            )
+        entries = m.get("key_registry_entries", 0)
+        if entries:
+            line += f", registry {entries:.0f} key(s)"
+            if m.get("key_registry_cold_entries"):
+                line += f" ({m['key_registry_cold_entries']:.0f} cold)"
+            if m.get("key_registry_frozen"):
+                line += " FROZEN"
+        lines.append(line)
     sup = doc.get("supervisor")
     if sup is not None and sup.get("window_failures") is not None:
         budget = sup.get("window_budget")

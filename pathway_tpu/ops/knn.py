@@ -78,7 +78,7 @@ def sharded_knn_search(
             "raise index capacity or lower k"
         )
 
-    from jax import shard_map
+    from ..internals.jax_compat import shard_map
 
     specs_in = [P(), P(axis, None)]
     args = [queries, index_sharded]

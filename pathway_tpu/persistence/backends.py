@@ -43,6 +43,12 @@ class PersistenceBackend:
     def remove_key(self, key: str) -> None:
         raise NotImplementedError
 
+    def size_of(self, key: str) -> int:
+        """Blob size in bytes without necessarily reading it (stat where
+        the backend can; this fallback reads). `rescale --dry-run` sizes
+        per-operator state with it."""
+        return len(self.get_value(key))
+
     def close(self) -> None:
         pass
 
@@ -160,6 +166,9 @@ class FilesystemBackend(PersistenceBackend):
         except FileNotFoundError:
             pass
 
+    def size_of(self, key: str) -> int:
+        return os.path.getsize(self._path(key))
+
 
 class PrefixBackend(PersistenceBackend):
     """View of another backend under a key prefix. Sharded runs give every
@@ -185,6 +194,9 @@ class PrefixBackend(PersistenceBackend):
 
     def remove_key(self, key: str) -> None:
         self._inner.remove_key(self._prefix + key)
+
+    def size_of(self, key: str) -> int:
+        return self._inner.size_of(self._prefix + key)
 
     def close(self) -> None:
         self._inner.close()

@@ -226,12 +226,31 @@ def _pick_snapshot_time(metas: list[dict]) -> int:
     return -1
 
 
+def _op_chunk_bytes(view: PersistenceBackend, rank: int, desc: dict) -> int:
+    """Size of one operator's persisted snapshot (stat-only where the
+    backend can): resident AND spilled state — the spill tier
+    materializes into snapshots, so this is the full per-operator state
+    footprint the target workers must absorb."""
+    from ..persistence.snapshots import OperatorSnapshots
+
+    total = 0
+    at = int(desc.get("at", desc.get("time", 0)))
+    for c in range(int(desc["chunks"])):
+        try:
+            total += view.size_of(OperatorSnapshots._key(rank, at, c))
+        except (OSError, KeyError):
+            pass  # chunk pruned mid-report; keep the estimate partial
+    return total
+
+
 def _dry_run_report(
     report: dict, metas: list[dict], snap_time: int,
-    n_from: int, to_workers: int,
+    n_from: int, to_workers: int, views: list[PersistenceBackend],
 ) -> dict:
     """Fill the plan-only report: per-operator split/merge actions by
-    reshard mode, plus the input-tail chunks each worker would replay.
+    reshard mode, per-operator persisted state bytes (so an operator can
+    size the target worker count before committing), plus the input-tail
+    chunks each worker would replay.
 
     Refuses exactly what the real run refuses (per-worker operator-count
     mismatch): a dry run that prints a confident plan for a store the
@@ -272,6 +291,10 @@ def _dry_run_report(
                     "worker(s)"
                 ),
             }.get(mode, f"cannot plan (mode {mode})")
+            bytes_per_source = [
+                _op_chunk_bytes(views[i], rank, d) if d is not None else None
+                for i, d in enumerate(descs)
+            ]
             ops_plan.append({
                 "rank": rank,
                 "cls": cls_name,
@@ -281,9 +304,12 @@ def _dry_run_report(
                     int(d["chunks"]) if d is not None else None
                     for d in descs
                 ],
+                "state_bytes_per_source": bytes_per_source,
+                "state_bytes": sum(b or 0 for b in bytes_per_source),
             })
     report["ranks"] = len(ops_plan)
     report["operators"] = ops_plan
+    report["state_bytes_total"] = sum(o["state_bytes"] for o in ops_plan)
     report["tail_chunks_per_source"] = [
         max(0, int(m.get("n_chunks", 0)) - int(m.get("first_chunk", 0)))
         for m in metas
@@ -359,7 +385,9 @@ def _rescale_root(
     if dry_run:
         # plan only: name what the real run WOULD do per operator, write
         # nothing (no staging keys, no marker, no chaos protocol)
-        return _dry_run_report(report, metas, snap_time, n_from, to_workers)
+        return _dry_run_report(
+            report, metas, snap_time, n_from, to_workers, views
+        )
     fire("plan")
 
     # stale staging from a previously crashed attempt is garbage — clear it

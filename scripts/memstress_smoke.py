@@ -1,0 +1,322 @@
+"""Memory-at-scale smoke: spill-to-disk state tier + two-tier key registry.
+
+Three phases over one streaming join + groupby pipeline (ISSUE 8):
+
+1. **A/B under budget** — the pipeline runs unbudgeted, then again under
+   a deliberately tiny ``PATHWAY_STATE_MEMORY_BUDGET_MB``. The budgeted
+   run must (a) actually spill (nonzero spill counters), and (b) produce
+   a final output multiset EQUAL to the unbudgeted run — memory pressure
+   degrades to disk traffic, never to wrong answers.
+2. **Registry past the cap** — same pipeline with a scaled-down
+   ``PATHWAY_KEY_REGISTRY_CAP`` and a spill dir: the run completes with
+   cold registry entries > 0 (128-bit conflation detection continued
+   past the cap through the spilled tier).
+3. **SIGKILL mid-spill** — under ``spawn --supervise`` + persistence,
+   a ``state.spill``-site chaos fault SIGKILLs the worker DURING a spill
+   blob write (generation 0 only). The supervisor restarts; recovery
+   must come from operator snapshots (never the scratch spill dir) and
+   converge to the exact expected counts.
+
+Usable standalone (``python scripts/memstress_smoke.py`` → exit 0/1) and
+as a tier-1 test (``tests/test_memstress_smoke.py``).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_KEYS = 400
+REPS = 3
+TIERS = 4
+
+_PROGRAM = """
+import json, os, sys, time
+
+import pathway_tpu as pw
+from pathway_tpu.persistence import Backend, Config
+
+out_path = sys.argv[1]
+pstate = sys.argv[2] if len(sys.argv) > 2 and sys.argv[2] != "-" else None
+N_KEYS, REPS, TIERS = {n_keys}, {reps}, {tiers}
+
+gen = os.environ.get("PATHWAY_RESTART_COUNT", "0")
+with open(out_path, "a") as f:
+    f.write(json.dumps(["gen", int(gen)]) + "\\n")
+
+
+class S(pw.io.python.ConnectorSubject):
+    def run(self):
+        for rep in range(REPS):
+            for k in range(N_KEYS):
+                self.next(sess="s%d" % k, v=rep * N_KEYS + k)
+                if k % 40 == 39:
+                    self.commit()
+                    time.sleep(0.001)
+            self.commit()
+
+
+t = pw.io.python.read(
+    S(), schema=pw.schema_from_types(sess=str, v=int), name="sessions",
+    autocommit_ms=None,
+)
+agg = t.groupby(pw.this.sess).reduce(
+    pw.this.sess, s=pw.reducers.sum(pw.this.v), c=pw.reducers.count()
+)
+labels = pw.debug.table_from_markdown(
+    "\\n".join(
+        ["sess | tier"]
+        + ["s%d | t%d" % (k, k % TIERS) for k in range(N_KEYS)]
+    )
+)
+res = agg.join(labels, agg.sess == labels.sess).select(
+    pw.left.sess, pw.right.tier, s=pw.left.s, c=pw.left.c
+)
+f = open(out_path, "a")
+
+
+def on_change(key, row, time, is_addition):
+    f.write(
+        json.dumps(
+            ["row", row["sess"], row["tier"], int(row["s"]), int(row["c"]),
+             bool(is_addition)]
+        ) + "\\n"
+    )
+    f.flush()
+
+
+pw.io.subscribe(res, on_change=on_change)
+if pstate is not None:
+    cfg = Config.simple_config(
+        Backend.filesystem(pstate), snapshot_interval_ms=10
+    )
+    pw.run(persistence_config=cfg)
+else:
+    pw.run()
+
+from pathway_tpu.engine import spill
+from pathway_tpu.engine import keys as K
+
+f.write(json.dumps(["counters", spill.spill_counters()]) + "\\n")
+f.write(json.dumps(["registry", K.registry_stats()]) + "\\n")
+f.close()
+"""
+
+#: SIGKILL this process during its 2nd spill blob write, generation 0
+#: only — the restarted generation runs fault-free and must finish
+KILL_PLAN = {
+    "seed": 11,
+    "faults": [
+        {"site": "state.spill", "action": "kill", "nth": 2, "run": 0},
+    ],
+}
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _events(path: str) -> list:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:  # a SIGKILL may tear the last line mid-write
+                out.append(json.loads(line))
+            except (json.JSONDecodeError, ValueError):
+                pass
+    return out
+
+
+def _expected_final() -> dict:
+    return {
+        f"s{k}": (f"t{k % TIERS}", sum(r * N_KEYS + k for r in range(REPS)),
+                  REPS)
+        for k in range(N_KEYS)
+    }
+
+
+def _final_rows(events: list) -> dict:
+    """Last addition per session key = the settled output row."""
+    final: dict = {}
+    for e in events:
+        if e and e[0] == "row" and e[5]:
+            final[e[1]] = (e[2], e[3], e[4])
+    return final
+
+
+def _net_multiset(events: list) -> collections.Counter:
+    net: collections.Counter = collections.Counter()
+    for e in events:
+        if e and e[0] == "row":
+            net[(e[1], e[2], e[3], e[4])] += 1 if e[5] else -1
+    return +net
+
+
+def _counters(events: list, kind: str) -> dict:
+    for e in reversed(events):
+        if e and e[0] == kind:
+            return e[1]
+    return {}
+
+
+def _write_program(tmp: str) -> str:
+    prog = os.path.join(tmp, "prog.py")
+    with open(prog, "w") as f:
+        f.write(textwrap.dedent(
+            _PROGRAM.format(n_keys=N_KEYS, reps=REPS, tiers=TIERS)
+        ))
+    return prog
+
+
+def _base_env(repo_root: str) -> dict:
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo_root,
+    }
+    for stale in (
+        "PATHWAY_STATE_MEMORY_BUDGET_MB", "PATHWAY_STATE_SPILL_DIR",
+        "PATHWAY_KEY_REGISTRY_CAP", "PATHWAY_KEY_REGISTRY_SPILL_DIR",
+        "PATHWAY_KEY_REGISTRY_OVERFLOW", "PATHWAY_FAULT_PLAN",
+    ):
+        env.pop(stale, None)
+    return env
+
+
+def _run_once(prog: str, out: str, env: dict, pstate: str = "-") -> None:
+    proc = subprocess.run(
+        [sys.executable, prog, out, pstate],
+        env=env, timeout=240, capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        raise AssertionError(
+            f"pipeline exited {proc.returncode}\nstderr:\n"
+            f"{proc.stderr[-4000:]}"
+        )
+
+
+def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
+    tmp = workdir or tempfile.mkdtemp(prefix="memstress_smoke_")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prog = _write_program(tmp)
+    expected = _expected_final()
+    report: dict = {}
+
+    # -- phase 1: A/B multiset equality under a tiny budget ---------------
+    base_out = os.path.join(tmp, "base.jsonl")
+    _run_once(prog, base_out, _base_env(repo_root))
+    base_events = _events(base_out)
+    assert _final_rows(base_events) == expected, (
+        f"unbudgeted run wrong: {len(_final_rows(base_events))} rows"
+    )
+
+    budget_out = os.path.join(tmp, "budget.jsonl")
+    _run_once(prog, budget_out, {
+        **_base_env(repo_root),
+        "PATHWAY_STATE_MEMORY_BUDGET_MB": "0.05",
+        "PATHWAY_STATE_SPILL_DIR": os.path.join(tmp, "spill-ab"),
+    })
+    budget_events = _events(budget_out)
+    counters = _counters(budget_events, "counters")
+    assert counters.get("spill_events_total", 0) > 0, (
+        f"budgeted run never spilled: {counters}"
+    )
+    assert counters.get("spill_errors_total", 0) == 0, counters
+    assert _net_multiset(budget_events) == _net_multiset(base_events), (
+        "budgeted run output differs from unbudgeted run"
+    )
+    report["spill_counters"] = counters
+
+    # -- phase 2: key registry past a scaled-down cap ---------------------
+    reg_out = os.path.join(tmp, "registry.jsonl")
+    _run_once(prog, reg_out, {
+        **_base_env(repo_root),
+        "PATHWAY_KEY_REGISTRY_CAP": "256",
+        "PATHWAY_KEY_REGISTRY_SPILL_DIR": os.path.join(tmp, "spill-kreg"),
+    })
+    reg_events = _events(reg_out)
+    assert _final_rows(reg_events) == expected
+    reg = _counters(reg_events, "registry")
+    assert reg.get("mode") == "spill" and reg.get("cold_entries", 0) > 0, (
+        f"registry never spilled past the 256 cap: {reg}"
+    )
+    assert reg.get("frozen") == 0, reg
+    report["registry"] = reg
+
+    # -- phase 3: SIGKILL mid-spill, supervised recovery ------------------
+    kill_out = os.path.join(tmp, "kill.jsonl")
+    pstate = os.path.join(tmp, "pstate")
+    env = {
+        **_base_env(repo_root),
+        "PATHWAY_STATE_MEMORY_BUDGET_MB": "0.05",
+        "PATHWAY_STATE_SPILL_DIR": os.path.join(tmp, "spill-kill"),
+        "PATHWAY_FAULT_PLAN": json.dumps(KILL_PLAN),
+        "PATHWAY_FLIGHT_DIR": os.path.join(tmp, "flight"),
+        "PATHWAY_SUPERVISE_BACKOFF_S": "0.05",
+        "PATHWAY_SUPERVISE_BACKOFF_MAX_S": "0.2",
+        "PATHWAY_SUPERVISE_GRACE_S": "5",
+    }
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pathway_tpu.cli", "spawn",
+            "--supervise", "-n", "1", "-t", "1",
+            "--first-port", str(_free_port()),
+            sys.executable, prog, kill_out, pstate,
+        ],
+        env=env, timeout=240, capture_output=True, text=True,
+    )
+    kill_events = _events(kill_out)
+    if proc.returncode != 0:
+        raise AssertionError(
+            f"supervised spawn exited {proc.returncode}\nstderr:\n"
+            f"{proc.stderr[-4000:]}\nevents: {kill_events[-10:]}"
+        )
+    generations = sorted({e[1] for e in kill_events if e and e[0] == "gen"})
+    assert generations == [0, 1], (
+        f"expected exactly one mid-spill kill + restart, saw generations "
+        f"{generations}; stderr:\n{proc.stderr[-2000:]}"
+    )
+    assert _final_rows(kill_events) == expected, (
+        "recovered run did not converge to exact counts"
+    )
+    report["generations"] = generations
+
+    if verbose:
+        print(
+            f"memstress_smoke: spills={counters['spill_events_total']} "
+            f"loads={counters['load_events_total']} "
+            f"registry_cold={reg['cold_entries']} "
+            f"kill_generations={generations}"
+        )
+    return report
+
+
+def main() -> int:
+    try:
+        run_smoke(verbose=True)
+    except BaseException as e:  # noqa: BLE001 — CLI exit-code surface
+        print(
+            f"memstress_smoke FAILED: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    print("memstress_smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

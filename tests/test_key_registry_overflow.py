@@ -1,0 +1,209 @@
+"""Key registry past PATHWAY_KEY_REGISTRY_CAP (ISSUE 8 tentpole a).
+
+Scaled-down cap: the two-tier registry must keep FULL 128-bit conflation
+detection through the spilled cold tier, refuse loudly when no spill path
+is configured, and freeze open ONLY under the explicit
+``PATHWAY_KEY_REGISTRY_OVERFLOW=allow`` escape hatch — for both the
+native C and the pure-python hot tables.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import pathway_tpu.engine.keys as K
+from pathway_tpu.native import native_available
+
+
+def _fresh_registry(monkeypatch, tmp_path, cap, *, overflow=None,
+                    spill=True, force_py=False):
+    monkeypatch.setattr(K, "_REGISTRY", None)
+    monkeypatch.setenv("PATHWAY_KEY_REGISTRY_CAP", str(cap))
+    if overflow is not None:
+        monkeypatch.setenv("PATHWAY_KEY_REGISTRY_OVERFLOW", overflow)
+    else:
+        monkeypatch.delenv("PATHWAY_KEY_REGISTRY_OVERFLOW", raising=False)
+    if spill:
+        monkeypatch.setenv(
+            "PATHWAY_KEY_REGISTRY_SPILL_DIR", str(tmp_path / "kreg")
+        )
+    else:
+        monkeypatch.delenv("PATHWAY_KEY_REGISTRY_SPILL_DIR", raising=False)
+        monkeypatch.delenv("PATHWAY_STATE_SPILL_DIR", raising=False)
+    if force_py:
+        import pathway_tpu.native as native_mod
+
+        monkeypatch.setattr(native_mod, "_cached", None)
+        monkeypatch.setattr(native_mod, "_tried", True)
+    reg = K._get_registry()
+    assert isinstance(reg, K._TwoTierRegistry)
+    if force_py:
+        assert isinstance(reg._hot, K._PyKeyRegistry)
+    return reg
+
+
+def _pairs(start, n):
+    lo = np.arange(start, start + n, dtype=np.uint64)
+    hi = lo + np.uint64(10_000_000)
+    return lo, hi
+
+
+_BOTH = pytest.mark.parametrize(
+    "force_py",
+    [
+        pytest.param(True, id="python"),
+        pytest.param(
+            False,
+            id="native",
+            marks=pytest.mark.skipif(
+                not native_available(), reason="no C compiler for native.c"
+            ),
+        ),
+    ],
+)
+
+
+@_BOTH
+def test_detection_survives_past_cap_via_cold_tier(
+    monkeypatch, tmp_path, force_py
+):
+    cap = 64
+    reg = _fresh_registry(monkeypatch, tmp_path, cap, force_py=force_py)
+    lo, hi = _pairs(0, 1000)  # ~16x the cap
+    assert reg.register(lo, hi) == -1
+    st = reg.detailed_stats()
+    assert st["entries"] == 1000
+    assert st["cold_entries"] > 0
+    assert st["spilled_total"] == st["cold_entries"]
+    assert st["mode"] == "spill"
+    assert st["frozen"] == 0  # spill mode is NOT a frozen registry
+
+    # re-registering the same pairs (replay) is clean — hot AND cold
+    assert reg.register(lo, hi) == -1
+
+    # a forged conflation against a COLD key (same LO, different HI)
+    # must be detected, exactly as it would below the cap
+    cold_lo = np.array([900], dtype=np.uint64)
+    assert reg.register(cold_lo, cold_lo + np.uint64(1)) == 0
+    # ... and against a hot key too
+    hot_lo = np.array([1], dtype=np.uint64)
+    assert reg.register(hot_lo, hot_lo) == 0
+
+
+@_BOTH
+def test_cold_tier_detects_after_writeback_flush(
+    monkeypatch, tmp_path, force_py
+):
+    cap = 32
+    reg = _fresh_registry(monkeypatch, tmp_path, cap, force_py=force_py)
+    lo, hi = _pairs(0, 400)
+    assert reg.register(lo, hi) == -1
+    # force the write-behind batches to disk, then drop the bucket cache:
+    # probes must come back from the spilled files, not resident dicts
+    cold = reg._cold
+    assert cold is not None
+    cold.flush()
+    assert cold._pending_n == 0
+    cold._cache.clear()
+    assert reg.register(lo, hi) == -1  # replay reads disk buckets
+    bad = np.array([399], dtype=np.uint64)
+    assert reg.register(bad, bad) == 0  # conflation via a disk bucket
+
+
+@_BOTH
+def test_cap_hit_without_spill_path_is_a_hard_error(
+    monkeypatch, tmp_path, force_py
+):
+    reg = _fresh_registry(
+        monkeypatch, tmp_path, 16, spill=False, force_py=force_py
+    )
+    lo, hi = _pairs(0, 16)
+    assert reg.register(lo, hi) == -1
+    over_lo, over_hi = _pairs(100, 8)
+    with pytest.raises(K.KeyRegistryOverflowError, match="OVERFLOW=allow"):
+        reg.register(over_lo, over_hi)
+    # keys already registered keep working after the refusal
+    assert reg.register(lo, hi) == -1
+
+
+@_BOTH
+def test_overflow_allow_restores_freeze_open_loudly(
+    monkeypatch, tmp_path, force_py, caplog
+):
+    import logging
+
+    reg = _fresh_registry(
+        monkeypatch, tmp_path, 16, overflow="allow", spill=False,
+        force_py=force_py,
+    )
+    lo, hi = _pairs(0, 16)
+    assert reg.register(lo, hi) == -1
+    with caplog.at_level(logging.WARNING, logger="pathway_tpu.keys"):
+        over_lo, over_hi = _pairs(100, 8)
+        assert reg.register(over_lo, over_hi) == -1  # passes unchecked
+    assert any("FROZEN" in r.message for r in caplog.records)
+    st = reg.detailed_stats()
+    assert st["frozen"] == 1
+    assert st["mode"] == "allow"
+    # frozen-open: a conflation among NEW keys is NOT detected (the
+    # documented 64-bit degradation the operator explicitly accepted)...
+    assert reg.register(over_lo, over_hi + np.uint64(1)) == -1
+    # ...but the registered prefix still detects
+    assert reg.register(lo[:1], hi[:1] + np.uint64(1)) == 0
+
+
+@_BOTH
+def test_explicit_error_mode_beats_configured_spill_dir(
+    monkeypatch, tmp_path, force_py
+):
+    reg = _fresh_registry(
+        monkeypatch, tmp_path, 16, overflow="error", force_py=force_py
+    )
+    lo, hi = _pairs(0, 24)
+    with pytest.raises(K.KeyRegistryOverflowError):
+        reg.register(lo, hi)
+
+
+def test_register_keys_entry_point_spills(monkeypatch, tmp_path):
+    """The real `_register_keys` path (mix_columns & co) rides the
+    two-tier registry transparently."""
+    _fresh_registry(monkeypatch, tmp_path, 32)
+    lo, hi = _pairs(0, 200)
+    K._register_keys(lo, hi)  # no error
+    with pytest.raises(K.KeyCollisionError):
+        K._register_keys(
+            np.array([150], np.uint64), np.array([3], np.uint64)
+        )
+    st = K.registry_stats()
+    assert st["entries"] == 200
+    assert st["cold_entries"] > 0
+
+
+def test_cap_hit_emits_flight_recorder_event(monkeypatch, tmp_path):
+    from pathway_tpu.observability import flightrecorder as fr
+
+    monkeypatch.setenv("PATHWAY_FLIGHT_DIR", str(tmp_path / "flight"))
+    try:
+        reg = _fresh_registry(monkeypatch, tmp_path, 16)
+        lo, hi = _pairs(0, 64)
+        assert reg.register(lo, hi) == -1
+        rec = fr.get_recorder()
+        assert rec is not None
+        rec.close()
+        doc = fr.harvest(rec.path)
+        hits = [r for r in doc["records"] if r["kind"] == "keyreg.cap_hit"]
+        assert hits and hits[0]["mode"] == "spill"
+        assert hits[0]["cap"] == 16
+    finally:
+        if fr._active is not None:
+            fr._active.close()
+        fr._active = None
+        fr._env_sig = None
+
+
+def test_registry_stats_unarmed_is_cheap(monkeypatch):
+    monkeypatch.setattr(K, "_REGISTRY", None)
+    st = K.registry_stats()
+    assert st["mode"] == "unarmed"
+    assert K._REGISTRY is None  # stats did not instantiate the registry

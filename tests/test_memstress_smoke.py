@@ -1,0 +1,25 @@
+"""Tier-1 wrapper around scripts/memstress_smoke.py: a join + groupby
+pipeline forced under a tiny PATHWAY_STATE_MEMORY_BUDGET_MB completes
+multiset-equal to an unbudgeted run with nonzero spill counters; the key
+registry keeps 128-bit detection past a scaled-down cap via the spilled
+cold tier; and a SIGKILL mid-spill-write recovers (from operator
+snapshots, never the scratch spill dir) to exact counts."""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    ),
+)
+
+
+def test_memstress_smoke(tmp_path):
+    from memstress_smoke import run_smoke
+
+    report = run_smoke(workdir=str(tmp_path))
+    assert report["spill_counters"]["spill_events_total"] > 0
+    assert report["registry"]["cold_entries"] > 0
+    assert report["generations"] == [0, 1]

@@ -426,6 +426,11 @@ def test_rescale_cli_noop_and_dry_run(tmp_path, monkeypatch):
     # the plan names each stateful operator's split/merge action
     assert "split 1 piece(s) by key shard, merge into 3 worker(s)" in res.output
     assert "input tail chunks to re-route" in res.output
+    # ...and sizes the state the target workers must absorb (ISSUE 8
+    # satellite: estimated per-operator bytes, resident + spilled)
+    assert "incl. spilled" in res.output
+    assert "total stateful-operator bytes to redistribute" in res.output
+    assert "MB/worker" in res.output
     assert snap(store) == before, "--dry-run must write NOTHING"
     assert rescale_stats()["total"] == totals_before, (
         "a dry run is not a rescale: the /metrics counter must not move"
@@ -451,8 +456,20 @@ def test_rescale_dry_run_library_reports_plan(monkeypatch):
         assert op["mode"] in ("keyed", "pinned", "replicate", "unresolved")
         assert op["action"]
         assert len(op["chunks_per_source"]) == 1
+        # per-operator state sizing: every present snapshot measures > 0
+        # bytes (pickle headers alone are nonzero), and the rollup agrees
+        assert len(op["state_bytes_per_source"]) == 1
+        assert op["state_bytes"] == sum(
+            b or 0 for b in op["state_bytes_per_source"]
+        )
+        if op["chunks_per_source"][0]:
+            assert op["state_bytes"] > 0
     modes = {op["mode"] for op in report["operators"]}
     assert "keyed" in modes  # the groupby arena splits by key shard
+    assert report["state_bytes_total"] == sum(
+        op["state_bytes"] for op in report["operators"]
+    )
+    assert report["state_bytes_total"] > 0
 
 
 def test_marker_io_errors_propagate():

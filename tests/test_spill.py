@@ -1,0 +1,495 @@
+"""Spill-to-disk state tier (ISSUE 8 tentpole b, engine/spill.py).
+
+- SpillStore: generation-versioned blobs; a torn/failed write (chaos
+  ``state.spill`` site) leaves the previous generation readable and the
+  caller's resident copy authoritative.
+- _SortedSide: cold runs spill payload-only; probe/totals stay correct;
+  pickling (= snapshots) materializes spilled runs.
+- GroupByReduce: dense cold-prefix arena block + general cold-group
+  buckets; fault-in on touch; snapshot materialization.
+- StateBudget: sheds the largest holdings, survives failing stores.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+import pytest
+
+from pathway_tpu import chaos
+from pathway_tpu.engine import spill
+from pathway_tpu.engine.operators import GroupByReduce, _SortedSide
+from pathway_tpu.persistence.backends import FilesystemBackend, MemoryBackend
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    chaos.disarm()
+    spill._reset_for_tests()
+    yield
+    chaos.disarm()
+    spill._reset_for_tests()
+
+
+def _arm_budget(monkeypatch, tmp_path, mb="0.01"):
+    monkeypatch.setenv("PATHWAY_STATE_MEMORY_BUDGET_MB", str(mb))
+    monkeypatch.setenv("PATHWAY_STATE_SPILL_DIR", str(tmp_path / "spill"))
+    spill._reset_for_tests()
+    budget = spill.get_budget()
+    assert budget is not None
+    return budget
+
+
+# -- SpillStore ------------------------------------------------------------
+
+
+def test_spillstore_roundtrip_and_generations():
+    store = spill.SpillStore(MemoryBackend())
+    h1 = store.put_blob("x", {"a": 1})
+    assert store.get_blob(h1) == {"a": 1}
+    h2 = store.put_blob("x", {"a": 2}, prev=h1)
+    assert store.get_blob(h2) == {"a": 2}
+    with pytest.raises(KeyError):
+        store.get_blob(h1)  # previous generation deleted AFTER success
+    c = spill.spill_counters()
+    assert c["spill_events_total"] == 2 and c["load_events_total"] >= 2
+
+
+def test_spillstore_chunks_large_blobs(monkeypatch):
+    monkeypatch.setattr(spill, "CHUNK_BYTES", 1024)
+    store = spill.SpillStore(MemoryBackend())
+    payload = np.arange(2000, dtype=np.int64)  # 16KB > several chunks
+    h = store.put_blob("big", payload)
+    assert h["chunks"] > 1
+    np.testing.assert_array_equal(store.get_blob(h), payload)
+
+
+def test_chaos_fail_keeps_previous_generation():
+    plan = chaos.FaultPlan.from_dict({
+        "faults": [{"site": "state.spill", "action": "fail", "nth": 2}],
+    })
+    chaos.arm(plan)
+    store = spill.SpillStore(MemoryBackend())
+    h1 = store.put_blob("seg", [1, 2, 3])
+    from pathway_tpu.chaos.injector import ChaosInjected
+
+    with pytest.raises(ChaosInjected):
+        store.put_blob("seg", [4, 5, 6], prev=h1)
+    assert store.get_blob(h1) == [1, 2, 3]  # old generation intact
+
+
+def test_chaos_torn_write_keeps_previous_generation():
+    plan = chaos.FaultPlan.from_dict({
+        "faults": [{"site": "state.spill", "action": "torn", "nth": 2}],
+    })
+    chaos.arm(plan)
+    backend = MemoryBackend()
+    store = spill.SpillStore(backend)
+    h1 = store.put_blob("seg", list(range(100)))
+    from pathway_tpu.chaos.injector import ChaosInjected
+
+    with pytest.raises(ChaosInjected):
+        store.put_blob("seg", list(range(200)), prev=h1)
+    # the torn generation DID write garbage bytes somewhere — but the
+    # handle protocol never exposed it, and the old blob still loads
+    assert store.get_blob(h1) == list(range(100))
+
+
+def test_chaos_key_prefix_selects_site():
+    plan = chaos.FaultPlan.from_dict({
+        "faults": [{
+            "site": "state.spill", "action": "fail", "nth": 1,
+            "key_prefix": "gb/",
+        }],
+    })
+    chaos.arm(plan)
+    store = spill.SpillStore(MemoryBackend())
+    store.put_blob("join/run", [1])  # prefix mismatch: untouched
+    from pathway_tpu.chaos.injector import ChaosInjected
+
+    with pytest.raises(ChaosInjected):
+        store.put_blob("gb/bucket/00", [2])
+
+
+# -- _SortedSide spill -----------------------------------------------------
+
+
+def _apply_batch(side, start, n, tag):
+    jks = np.arange(start, start + n, dtype=np.uint64)
+    keys = jks + np.uint64(1000)
+    cols = [np.full(n, tag, dtype=np.int64)]
+    side.apply(jks, keys, cols, np.ones(n, dtype=np.int64))
+
+
+def _probe_all(side, qjks):
+    hits = []
+    for q_idx, keys, cols, counts in side.probe(qjks):
+        for i in range(len(q_idx)):
+            hits.append((int(qjks[q_idx[i]]), int(keys[i]), int(cols[0][i]),
+                         int(counts[i])))
+    return sorted(hits)
+
+
+def test_sorted_side_spill_probe_totals_equal(monkeypatch, tmp_path):
+    _arm_budget(monkeypatch, tmp_path)
+    side = _SortedSide(1)
+    ref = _SortedSide(1)
+    for b, (s, n) in enumerate([(0, 500), (500, 300), (800, 50)]):
+        _apply_batch(side, s, n, b)
+        _apply_batch(ref, s, n, b)
+    freed = side.spill(1 << 30)  # spill everything spillable
+    assert freed > 0 and side._spilled and side.spilled_bytes() > 0
+    assert len(side) == len(ref) == 850
+    q = np.array([0, 123, 499, 700, 820, 9999], dtype=np.uint64)
+    np.testing.assert_array_equal(side.totals(q), ref.totals(q))
+    assert _probe_all(side, q) == _probe_all(ref, q)
+    # spill/load moved real counters
+    c = spill.spill_counters()
+    assert c["spill_events_total"] > 0 and c["load_events_total"] > 0
+
+
+def test_sorted_side_pickle_materializes_spilled_runs(monkeypatch, tmp_path):
+    _arm_budget(monkeypatch, tmp_path)
+    side = _SortedSide(1)
+    _apply_batch(side, 0, 400, 7)
+    side.spill(1 << 30)
+    assert side._spilled
+    clone = pickle.loads(pickle.dumps(side))
+    assert not clone._spilled  # snapshot-format: fully resident
+    assert len(clone) == 400
+    q = np.array([5, 399], dtype=np.uint64)
+    np.testing.assert_array_equal(clone.totals(q), side.totals(q))
+    # the LIVE side still works after being snapshotted
+    assert _probe_all(side, q) == _probe_all(clone, q)
+
+
+def test_sorted_side_compaction_unspills(monkeypatch, tmp_path):
+    _arm_budget(monkeypatch, tmp_path)
+    side = _SortedSide(1)
+    _apply_batch(side, 0, 512, 0)
+    side.spill(1 << 30)
+    # retract everything: the retraction batch + compaction must net out
+    jks = np.arange(512, dtype=np.uint64)
+    side.apply(jks, jks + np.uint64(1000),
+               [np.zeros(512, dtype=np.int64)], np.full(512, -1, np.int64))
+    side._compact()
+    assert not side._spilled
+    # values differ between insert (tag 0) and retract batches, so rows
+    # do NOT cancel: both multiplicities survive, totals say net zero
+    assert side.totals(jks).sum() == 0
+
+
+def test_sorted_side_failed_spill_keeps_runs_resident(monkeypatch, tmp_path):
+    budget = _arm_budget(monkeypatch, tmp_path, mb="0.001")
+    plan = chaos.FaultPlan.from_dict({
+        "faults": [{"site": "state.spill", "action": "fail", "prob": 1.0}],
+    })
+    chaos.arm(plan)
+    side = _SortedSide(1)
+    _apply_batch(side, 0, 300, 1)
+    n_runs = len(side._runs)
+    freed = budget.maybe_spill()  # swallows the chaos failure
+    assert freed == 0
+    assert len(side._runs) == n_runs and not side._spilled
+    q = np.array([0, 299], dtype=np.uint64)
+    assert side.totals(q).sum() == 2
+    assert spill.spill_counters()["spill_errors_total"] >= 1
+
+
+# -- GroupByReduce spill ---------------------------------------------------
+
+
+def _dense_groupby():
+    from pathway_tpu.engine.reducers import CountReducer, SumReducer
+
+    class _Stub:
+        node_id = 0
+        column_names = ["k"]
+
+        def __init__(self):
+            self.inputs = []
+
+    import pathway_tpu.engine.operators as ops
+
+    src = ops.SourceNode.__new__(ops.SourceNode)
+    src.node_id = 0
+    src.column_names = ["k", "v"]
+    src.inputs = []
+    return GroupByReduce(
+        src, ["k"], [("c", CountReducer(), []), ("s", SumReducer(), ["v"])]
+    )
+
+
+def _delta(gks, vals, diffs=None):
+    from pathway_tpu.engine.delta import Delta
+
+    n = len(gks)
+    return Delta(
+        keys=np.arange(n, dtype=np.uint64),
+        data={
+            "k": np.asarray(gks, dtype=np.int64),
+            "v": np.asarray(vals, dtype=np.int64),
+        },
+        diffs=np.ones(n, np.int64) if diffs is None else np.asarray(diffs),
+    )
+
+
+def _collect(node, d, t=2):
+    return node.process(t, [d])
+
+
+def test_groupby_dense_arena_spills_and_faults_in(monkeypatch, tmp_path):
+    _arm_budget(monkeypatch, tmp_path)
+    g = _dense_groupby()
+    assert g._dense
+    # ticks over disjoint group ranges: early groups go cold
+    for tick in range(6):
+        gks = np.arange(tick * 200, (tick + 1) * 200)
+        _collect(g, _delta(gks, gks * 10), t=2 + 2 * tick)
+    before = g.spillable_bytes()
+    freed = g.spill(1 << 30)
+    assert freed > 0 and g._arena_base > 0
+    assert g.spilled_bytes() > 0
+    assert g.spillable_bytes() < before
+    # touching an OLD group faults the cold block back in and the
+    # retract/emit algebra stays exact
+    out = _collect(g, _delta([5], [1]), t=99)
+    assert g._arena_base == 0
+    rows = {
+        (int(k), int(c), int(s), int(d))
+        for k, c, s, d in zip(
+            out.data["k"], out.data["c"], out.data["s"], out.diffs
+        )
+    }
+    assert (5, 1, 50, -1) in rows  # retract old aggregate for group 5
+    assert (5, 2, 51, 1) in rows  # insert updated one
+
+
+def test_groupby_dense_snapshot_materializes_cold_block(
+    monkeypatch, tmp_path
+):
+    _arm_budget(monkeypatch, tmp_path)
+    g = _dense_groupby()
+    # > deque(maxlen=4) ticks over disjoint ranges so the recency
+    # watermark rises above slot 0 and a cold prefix exists to spill
+    for tick in range(6):
+        gks = np.arange(tick * 100, (tick + 1) * 100)
+        _collect(g, _delta(gks, gks), t=2 + 2 * tick)
+    unspilled_snapshot = g.snapshot_state()
+    g.spill(1 << 30)
+    assert g._arena_base > 0
+    snap = g.snapshot_state()
+    a, b = unspilled_snapshot["arena"], snap["arena"]
+    np.testing.assert_array_equal(a["_counts"], b["_counts"])
+    np.testing.assert_array_equal(a["_gkey_by_slot"], b["_gkey_by_slot"])
+    np.testing.assert_array_equal(a["_prev"][1], b["_prev"][1])
+    # a fresh operator restored from the snapshot serves all groups with
+    # NO spill dir dependency
+    g2 = _dense_groupby()
+    g2.restore_state(pickle.loads(pickle.dumps(snap)))
+    out = _collect(g2, _delta([0], [7]), t=50)
+    assert out is not None and len(out)
+
+
+def test_groupby_general_cold_buckets(monkeypatch, tmp_path):
+    _arm_budget(monkeypatch, tmp_path)
+    from pathway_tpu.engine.reducers import MinReducer
+
+    import pathway_tpu.engine.operators as ops
+
+    src = ops.SourceNode.__new__(ops.SourceNode)
+    src.node_id = 0
+    src.column_names = ["k", "v"]
+    src.inputs = []
+    g = GroupByReduce(src, ["k"], [("m", MinReducer(), ["v"])])
+    assert not g._dense
+    # three disjoint batches: the first falls out of the 2-batch recency
+    # window and becomes spillable
+    _collect(g, _delta(np.arange(300), np.arange(300) + 5), t=2)
+    _collect(g, _delta(np.arange(300, 600), np.arange(300)), t=4)
+    _collect(g, _delta(np.arange(600, 700), np.arange(100)), t=6)
+    n_resident = len(g._state)
+    freed = g.spill(1 << 30)
+    assert freed > 0 and g._cold_set
+    assert len(g._state) < n_resident
+    # cold groups materialize into snapshots
+    snap = g.snapshot_state()
+    assert len(snap["_state"]) == 700
+    # touching cold groups faults them back in with exact accumulators
+    out = _collect(g, _delta([10], [0]), t=60)
+    rows = {
+        (int(k), int(m), int(d))
+        for k, m, d in zip(out.data["k"], out.data["m"], out.diffs)
+    }
+    assert (10, 15, -1) in rows and (10, 0, 1) in rows
+
+
+# -- StateBudget -----------------------------------------------------------
+
+
+class _FakeStore:
+    def __init__(self, resident):
+        self.resident = resident
+        self.disk = 0
+
+    def spillable_bytes(self):
+        return self.resident
+
+    def spilled_bytes(self):
+        return self.disk
+
+    def spill(self, want):
+        moved = min(self.resident, want)
+        self.resident -= moved
+        self.disk += moved
+        return moved
+
+
+def test_budget_sheds_largest_first(monkeypatch, tmp_path):
+    budget = spill.StateBudget(1000)
+    small, big = _FakeStore(400), _FakeStore(5000)
+    budget.register(small)
+    budget.register(big)
+    freed = budget.maybe_spill()
+    assert freed >= 4400
+    assert big.resident < 5000
+    assert small.resident == 400  # big alone got under budget
+    assert budget.maybe_spill() == 0  # already under budget
+
+
+def test_budget_unspillable_warns_once(caplog):
+    import logging
+
+    class _Stuck(_FakeStore):
+        def spill(self, want):
+            return 0
+
+    budget = spill.StateBudget(10)
+    stuck = _Stuck(1000)  # strong ref: registration is a WeakSet
+    budget.register(stuck)
+    with caplog.at_level(logging.WARNING, logger="pathway_tpu.spill"):
+        budget.maybe_spill()
+        budget.maybe_spill()
+    warnings = [
+        r for r in caplog.records if "could spill" in r.getMessage()
+    ]
+    assert len(warnings) == 1
+
+
+def test_budget_env_parsing(monkeypatch, tmp_path):
+    monkeypatch.delenv("PATHWAY_STATE_MEMORY_BUDGET_MB", raising=False)
+    spill._reset_for_tests()
+    assert spill.get_budget() is None
+    monkeypatch.setenv("PATHWAY_STATE_MEMORY_BUDGET_MB", "bogus")
+    spill._reset_for_tests()
+    assert spill.get_budget() is None  # logged, disabled — not a crash
+    monkeypatch.setenv("PATHWAY_STATE_MEMORY_BUDGET_MB", "2.5")
+    spill._reset_for_tests()
+    assert spill.get_budget().budget_bytes == int(2.5 * (1 << 20))
+
+
+def test_memory_snapshot_shape(monkeypatch, tmp_path):
+    _arm_budget(monkeypatch, tmp_path)
+    snap = spill.memory_snapshot()
+    for key in (
+        "rss_bytes", "state_budget_bytes", "state_resident_bytes",
+        "state_spilled_bytes", "spill_events_total",
+        "key_registry_entries", "key_registry_frozen",
+        "key_registry_spilled_total",
+    ):
+        assert key in snap and isinstance(snap[key], (int, float))
+    assert snap["rss_bytes"] > 0
+
+
+def test_dead_pid_scratch_swept(monkeypatch, tmp_path):
+    import os
+
+    root = tmp_path / "spillroot"
+    dead = root / "p999999999"  # no such pid
+    dead.mkdir(parents=True)
+    (dead / "junk").write_bytes(b"x")
+    monkeypatch.setenv("PATHWAY_STATE_SPILL_DIR", str(root))
+    got = spill._default_spill_root()
+    assert got == str(root / f"p{os.getpid()}")
+    assert not dead.exists()
+
+
+# -- observability wiring (metrics / signals / top) ------------------------
+
+
+def test_memory_gauges_on_metrics(monkeypatch, tmp_path):
+    """RSS + state-budget + key-registry gauges render per process on
+    /metrics (ISSUE 8 satellite: surface registry state everywhere)."""
+    _arm_budget(monkeypatch, tmp_path)
+    from pathway_tpu.observability.hub import ObservabilityHub
+
+    hub = ObservabilityHub()
+    body = hub.render_metrics()
+    for name in (
+        "pathway_process_rss_bytes",
+        "pathway_state_budget_bytes",
+        "pathway_state_resident_bytes",
+        "pathway_state_spilled_bytes",
+        "pathway_state_spill_events_total",
+        "pathway_key_registry_entries",
+        "pathway_key_registry_frozen",
+        "pathway_key_registry_spilled_total",
+    ):
+        assert name in body, f"{name} missing from /metrics"
+    assert 'process="0"' in body
+    # counters typed as counters, gauges as gauges
+    assert "# TYPE pathway_state_spill_events_total counter" in body
+    assert "# TYPE pathway_process_rss_bytes gauge" in body
+
+
+def test_memory_series_sampled_into_signals(monkeypatch, tmp_path):
+    _arm_budget(monkeypatch, tmp_path)
+    from pathway_tpu.observability.hub import ObservabilityHub
+    from pathway_tpu.observability.timeseries import SignalsPlane
+
+    hub = ObservabilityHub()
+    plane = SignalsPlane(hub, sample_s=0.05, window_s=5)
+    plane.sample_once(t=100.0)
+    plane.sample_once(t=100.5)
+    metrics = set(plane.signals.store.metrics(None))
+    assert "mem.rss_bytes" in metrics
+    assert "mem.state_budget_bytes" in metrics
+    assert "mem.key_registry_entries" in metrics
+    assert plane.signals.last("mem.rss_bytes", None) > 0
+
+
+def test_top_renders_memory_line(monkeypatch, tmp_path):
+    from pathway_tpu.observability.top import render_frame
+
+    doc = {
+        "process_id": 0,
+        "workers": {},
+        "memory": {
+            "rss_bytes": 123_000_000.0,
+            "state_budget_bytes": 1_000_000.0,
+            "state_resident_bytes": 400_000.0,
+            "state_spilled_bytes": 2_600_000.0,
+            "spill_events_total": 7.0,
+            "key_registry_entries": 5000.0,
+            "key_registry_cold_entries": 1200.0,
+            "key_registry_frozen": 0.0,
+        },
+    }
+    frame = render_frame(doc, now=0.0)
+    assert "mem p0: rss 123 MB" in frame
+    assert "0.4/1.0 MB resident" in frame
+    assert "2.6 MB spilled (7 spills)" in frame
+    assert "registry 5000 key(s) (1200 cold)" in frame
+    assert "FROZEN" not in frame
+    doc["memory"]["key_registry_frozen"] = 1.0
+    assert "FROZEN" in render_frame(doc, now=0.0)
+
+
+def test_snapshot_document_carries_memory(monkeypatch, tmp_path):
+    _arm_budget(monkeypatch, tmp_path)
+    from pathway_tpu.observability.hub import ObservabilityHub
+
+    doc = ObservabilityHub().snapshot_document()
+    assert doc["memory"]["rss_bytes"] > 0
+    assert "state_budget_bytes" in doc["memory"]

@@ -156,7 +156,11 @@ def test_sharded_sigkill_supervised_recovery(tmp_path):
     operator snapshot COMMON to both workers, with the recorded input
     tail replayed (at-least-once callbacks, exactly-once final state)."""
     prog = tmp_path / "prog.py"
-    prog.write_text(textwrap.dedent(_PROGRAM))
+    # 3x the smoke's stream: the run-1 kill at tick 14 must land
+    # mid-stream, but generation 1 only replays the post-snapshot tail —
+    # with the 20-word stream that tail can finish in <14 ticks on a fast
+    # host and the second kill never fires
+    prog.write_text(textwrap.dedent(_PROGRAM).replace('"] * 5', '"] * 15'))
     out = tmp_path / "events.jsonl"
     pstate = tmp_path / "pstate"
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -199,7 +203,7 @@ def test_sharded_sigkill_supervised_recovery(tmp_path):
 
     # both kills landed mid-stream: no generation before the last saw the
     # complete final counts
-    expected = _EXPECTED
+    expected = {k: v * 3 for k, v in _EXPECTED.items()}
     gen_starts = [
         i for i, e in enumerate(events) if e[0] == "gen" and e[2] == 0
     ]
