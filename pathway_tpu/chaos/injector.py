@@ -181,6 +181,13 @@ class ActiveFaults:
         ]
         return AutoscaleFaults(self, matches) if matches else None
 
+    def sink_faults(self, worker_id: int) -> "SinkFaults | None":
+        matches = [
+            (i, f) for i, f in enumerate(self.plan.faults)
+            if f.site == "sink.write" and f.worker in (None, worker_id)
+        ]
+        return SinkFaults(self, worker_id, matches) if matches else None
+
     def spill_faults(self, worker_id: int) -> "SpillFaults | None":
         matches = [
             (i, f) for i, f in enumerate(self.plan.faults)
@@ -339,6 +346,35 @@ class LocalFaults:
                 return None
             time.sleep(f.delay_s if f.delay_s is not None else 0.05)
         return payload
+
+
+class SinkFaults:
+    """Bound sink.write-site handle for one worker's delivery sinks.
+
+    ``op_for(sink_name)`` returns the (action, delay_s) to apply to the
+    NEXT write attempt of a matching sink ("fail" | "torn" | "delay" |
+    "hang" | "reject") or None. The delivery layer implements the
+    actions itself — it owns the retry/rollback/DLQ machinery each one
+    must exercise (io/delivery.py ``_chaos_gate``)."""
+
+    def __init__(self, owner: ActiveFaults, worker_id: int,
+                 matches: list[tuple[int, Fault]]):
+        self._owner = owner
+        self._scope = f"sink/w{worker_id}"
+        self._matches = matches
+
+    def op_for(self, sink_name: str) -> tuple[str, float] | None:
+        for idx, f in self._matches:
+            if (
+                f.key_prefix is not None
+                and not sink_name.startswith(f.key_prefix)
+            ):
+                continue
+            if self._owner._decide(idx, f, self._scope):
+                return f.action, (
+                    f.delay_s if f.delay_s is not None else 0.05
+                )
+        return None
 
 
 class SpillFaults:

@@ -52,6 +52,18 @@ Sites and actions:
   persisted layout: the controller only mutates state through the
   resharder's atomic-marker protocol, so a supervised elastic boot
   afterwards converges back to a healthy cluster.
+- ``sink.write`` — the output-plane delivery layer's per-attempt write
+  gate (``io/delivery.py``: every external sink write rides it).
+  ``action`` is ``fail`` (raise before the adapter write — retryable),
+  ``torn`` (write a half-batch through the adapter, then raise — the
+  retry must not double the half; transactional adapters roll back),
+  ``delay`` (sleep ``delay_s`` before writing), ``hang`` (sleep
+  effectively-forever — the per-sink timeout watchdog must fire) or
+  ``reject`` (raise a non-retryable reject naming the first row — the
+  delivery layer must dead-letter it, never drop it silently or crash).
+  Selected by ``worker`` (the sink worker), ``nth``/``prob`` and
+  optional ``key_prefix`` matching the SINK NAME (the delivery layer's
+  stable sink id).
 - ``state.spill`` — the memory-budget spill tier's blob writes
   (``engine/spill.py``: join-run payloads, groupby cold buckets, key-
   registry cold buckets). ``action`` is ``fail`` (raise before writing),
@@ -88,7 +100,7 @@ __all__ = ["Fault", "FaultPlan", "load_plan_from_env"]
 
 _SITES = (
     "tick", "comm.send", "comm.local", "persistence.put", "rescale",
-    "autoscale", "state.spill",
+    "autoscale", "state.spill", "sink.write",
 )
 _ACTIONS = {
     "tick": ("crash", "exit", "kill", "hang"),
@@ -98,6 +110,7 @@ _ACTIONS = {
     "rescale": ("crash", "exit", "kill"),
     "autoscale": ("crash", "exit", "kill"),
     "state.spill": ("fail", "torn", "kill"),
+    "sink.write": ("fail", "torn", "delay", "hang", "reject"),
 }
 #: rescale-site phase boundaries, in execution order (resharder.py)
 RESCALE_PHASES = ("plan", "stage", "copy", "promote", "cleanup")
@@ -123,7 +136,9 @@ class Fault:
     nth: int | None = None
     #: seeded per-event probability (alternative to nth)
     prob: float | None = None
-    #: persistence.put: only count puts whose key starts with this
+    #: persistence.put / state.spill: only count puts whose key starts
+    #: with this; sink.write: only count writes of sinks whose NAME
+    #: starts with this
     key_prefix: str | None = None
     #: rescale site: fire at this phase boundary of the resharder
     #: (one of RESCALE_PHASES); None = any phase

@@ -21,7 +21,7 @@ import click
 
 from .internals.config import MAX_WORKERS
 
-__all__ = ["main", "spawn", "replay", "rescale", "top", "trace"]
+__all__ = ["main", "spawn", "replay", "rescale", "top", "trace", "dlq"]
 
 
 @click.group()
@@ -468,6 +468,53 @@ def top(url, host, port, interval, frames, no_clear):
         url = f"http://{host}:{port}/query"
     sys.exit(run_top(url, interval_s=interval, frames=frames,
                      clear=not no_clear))
+
+
+@main.command()
+@click.argument("dlq_dir", required=False, type=str, default=None)
+@click.option("--sink", "sink_name", type=str, default=None,
+              help="only this sink's entries")
+@click.option("--tail", "tail_n", type=int, default=5,
+              help="newest entries to print per sink (0 = summary only)")
+def dlq(dlq_dir, sink_name, tail_n) -> None:
+    """Inspect the sink dead-letter queue (poison rows the delivery
+    layer refused to drop silently). Default directory:
+    PATHWAY_SINK_DLQ_DIR or ./pathway-dlq."""
+    import json as _json
+
+    root = dlq_dir or os.environ.get("PATHWAY_SINK_DLQ_DIR", "./pathway-dlq")
+    if not os.path.isdir(root):
+        raise click.ClickException(f"no dead-letter directory at {root}")
+    files = sorted(
+        f for f in os.listdir(root)
+        if f.endswith(".jsonl")
+        and (sink_name is None or f == f"{sink_name}.jsonl")
+    )
+    if not files:
+        raise click.ClickException(
+            f"no dead-letter files in {root}"
+            + (f" for sink {sink_name!r}" if sink_name else "")
+        )
+    total = 0
+    for fn in files:
+        path = os.path.join(root, fn)
+        entries = []
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        entries.append(_json.loads(line))
+                    except ValueError:
+                        entries.append({"error": "<unparseable entry>"})
+        total += len(entries)
+        click.echo(f"{fn[:-6]}: {len(entries)} dead-lettered row(s) ({path})")
+        for e in entries[-tail_n:] if tail_n else []:
+            click.echo(
+                f"  t={e.get('time')} stamp={e.get('stamp')} "
+                f"error={e.get('error')!r} row={_json.dumps(e.get('row'))}"
+            )
+    click.echo(f"total: {total} row(s) across {len(files)} sink(s)")
 
 
 @main.group()

@@ -228,6 +228,23 @@ class Node:
             f: getattr(self, f) for f in self.STATE_FIELDS if hasattr(self, f)
         }
 
+    def snapshot_state_parts(self):
+        """Streaming snapshot protocol: yield picklable parts that
+        together reproduce ``snapshot_state()``'s result via
+        ``state_from_parts``. Operators whose state partially lives in
+        the spill tier override this to load one spilled segment at a
+        time while the snapshot writer flushes chunks incrementally
+        (persistence/snapshots.py ``write_parts``) — commit-time peak
+        RSS stays bounded by the memory budget, not total state. The
+        default is a single part: the monolithic state."""
+        yield self.snapshot_state()
+
+    @classmethod
+    def state_from_parts(cls, parts) -> dict:
+        """Reassemble the materialized state dict from a parts stream
+        (inverse of ``snapshot_state_parts``; fed to ``restore_state``)."""
+        return next(parts)
+
     def restore_state(self, state: dict) -> None:
         for f, v in state.items():
             setattr(self, f, v)
@@ -958,18 +975,66 @@ class Executor:
 
         # pick the newest operator snapshot present on EVERY worker — a crash
         # mid-commit-wave may have left some workers one version ahead; the
-        # manager retains two versions so a common one always exists
+        # manager retains two versions so a common one always exists.
+        # Delivery-managed sinks add a FLOOR (io/delivery.py): restore must
+        # not climb above the minimum ack cursor, or output between the
+        # cursor and the snapshot would never be regenerated (replay only
+        # covers times after the restored snapshot) — a kill between a
+        # metadata commit and its post-commit sink drain lands exactly here
         local_times = self.persistence.available_op_times()
+        delivery_mgr = getattr(self.persistence, "delivery", None)
+        floor = (
+            delivery_mgr.recovery_floor() if delivery_mgr is not None else None
+        )
+        first_chunk = getattr(self.persistence, "_first_chunk", 0)
         if self.ctx.is_sharded:
             gathered = self.ctx.comm.allgather(
-                ("recover-op",), self.ctx.worker_id, tuple(local_times)
+                ("recover-op",), self.ctx.worker_id,
+                (tuple(local_times), floor, first_chunk),
             )
-            common = set(gathered[0])
-            for avail in gathered[1:]:
+            common = set(gathered[0][0])
+            for avail, _f, _c in gathered[1:]:
                 common &= set(avail)
-            op_time = max(common) if common else -1
+            floors = [f for _, f, _ in gathered if f is not None]
+            floor = min(floors) if floors else None
+            first_chunk = max(c for _, _, c in gathered)
         else:
-            op_time = max(local_times) if local_times else -1
+            common = set(local_times)
+        eligible = {
+            t for t in common if floor is None or t <= floor
+        }
+        if common and not eligible:
+            # reachable exactly once: a kill between the FIRST metadata
+            # commit (snapshot written) and its post-commit sink drain —
+            # the cursor still reads -1. Nothing was truncated yet
+            # (truncation needs a full retention window), so restore
+            # NOTHING and replay the retained input log from scratch: the
+            # pending (never-released) output regenerates and the cursor
+            # dedupes. Restoring a snapshot instead would suppress replay
+            # below it and silently LOSE the undelivered output.
+            import logging
+
+            if first_chunk == 0:
+                logging.getLogger("pathway_tpu.persistence").warning(
+                    "sink ack floor %s sits below every operator snapshot "
+                    "%s; replaying the input log from scratch so the "
+                    "undelivered output regenerates", floor, sorted(common),
+                )
+            else:
+                # input below the oldest snapshot is gone — full replay
+                # would rebuild garbage state. Restore the oldest
+                # snapshot (loses the least output) and say so. Should be
+                # unreachable: truncation requires commits whose drains
+                # advanced the floor past the oldest retained snapshot.
+                logging.getLogger("pathway_tpu.persistence").warning(
+                    "sink ack floor %s sits below every operator snapshot "
+                    "%s but the input log was truncated (first chunk %d); "
+                    "restoring the oldest snapshot — output between the "
+                    "floor and it is LOST", floor, sorted(common),
+                    first_chunk,
+                )
+                eligible = {min(common)}
+        op_time = max(eligible) if eligible else -1
         if op_time >= 0:
             self.persistence.restore_operators(op_time)
         clock = max(0, op_time)
@@ -1168,6 +1233,22 @@ class Executor:
             inbox.setdefault(consumer.node_id, {}).setdefault(port, []).append(delta)
 
     def _finish(self) -> None:
+        delivery = (
+            getattr(self.persistence, "delivery", None)
+            if self.persistence is not None
+            else None
+        )
+        if delivery is not None and not delivery.has_sinks():
+            delivery = None
+        if delivery is not None:
+            # final consistency point FIRST, snapshotting PRE-end-of-stream
+            # state: the END_TIME flush output generated below is a pure
+            # function of this state, so a crash mid-final-delivery
+            # restores here, re-runs _finish, regenerates the same END
+            # batches, and the ack cursor dedupes — commit-after-sweep
+            # would snapshot post-flush state that can never regenerate
+            # the END batches a partial drain left undelivered
+            self.persistence.commit(self._last_clock)
         inbox: dict[int, dict[int, list[Delta]]] = {}
         for node in self.nodes:
             out_parts: list[Delta] = []
@@ -1190,6 +1271,10 @@ class Executor:
                 self._route(node, emitted, inbox)
         for cb in self._on_time_end:
             cb(END_TIME)
-        if self.persistence is not None:
+        if self.persistence is not None and delivery is None:
             self.persistence.commit(self._last_clock)
+        if delivery is not None:
+            # after the pre-sweep commit: release everything still pending
+            # (END_TIME flush batches included), drain to acked, close
+            delivery.finish()
         self.stats.finished = True

@@ -475,6 +475,106 @@ class GroupByReduce(Node):
             st["arena"] = self._arena_full_trimmed()
         return st
 
+    def snapshot_state_parts(self):
+        """Streaming snapshot (persistence/snapshots.py write_parts): the
+        resident head first, then each cold arena delta block and each
+        cold general bucket loaded ONE AT A TIME — the writer flushes
+        chunks between parts, so commit-time peak RSS is bounded by the
+        largest single spilled segment plus a chunk, never the
+        operator's total state (ROADMAP PR-8 corner)."""
+        head: dict = {
+            "dense": self._dense,
+            "gerrs": self._gerrs,
+            "state_resident": self._state,
+            "n_cold_buckets": (
+                len(self._cold_buckets) if self._cold_set else 0
+            ),
+        }
+        if self._dense:
+            n = len(self._slots)
+            base = self._arena_base
+            r = n - base
+            head["arena_tail"] = {
+                "_counts": self._counts[:r].copy(),
+                "_gkey_by_slot": self._gkey_by_slot[:r].copy(),
+                "_emitted": self._emitted[:r].copy(),
+                "_accs": [
+                    None if a is None else a[:r].copy() for a in self._accs
+                ],
+                "_prev": [p[:r].copy() for p in self._prev],
+                "_gvals": [
+                    None if g is None else g[:r].copy() for g in self._gvals
+                ],
+            }
+            head["n_arena_blocks"] = len(self._arena_cold)
+        yield head
+        if self._dense and self._arena_cold:
+            store = self._budget.spill_store()
+            for h in self._arena_cold:
+                yield store.get_blob(h)  # one cold block resident at a time
+        if self._cold_set:
+            store = self._budget.spill_store()
+            for b in sorted(self._cold_buckets):
+                blob = store.get_blob(self._cold_buckets[b])
+                yield {
+                    gk: entry
+                    for gk, entry in blob.items()
+                    if gk in self._cold_set
+                }
+
+    @classmethod
+    def state_from_parts(cls, parts) -> dict:
+        head = next(parts)
+        st: dict = {
+            "_state": dict(head["state_resident"]),
+            "dense": head["dense"],
+            "gerrs": head["gerrs"],
+        }
+        if head["dense"]:
+            blocks = [next(parts) for _ in range(head["n_arena_blocks"])]
+            st["arena"] = cls._cat_arena_parts(
+                blocks + [head["arena_tail"]]
+            )
+        for _ in range(head.get("n_cold_buckets", 0)):
+            st["_state"].update(next(parts))
+        return st
+
+    @staticmethod
+    def _cat_arena_parts(blocks: list[dict]) -> dict:
+        """Concatenate arena dicts in slot order (cold delta blocks, then
+        the resident tail). Column None-ness is decided before the first
+        slot exists, so a column is None in every block or in none; an
+        empty tail array concatenates away."""
+        if len(blocks) == 1:
+            return blocks[0]
+
+        def cat(cols):
+            present = [c for c in cols if c is not None and len(c)]
+            if not present:
+                return None if all(c is None for c in cols) else cols[-1]
+            if len(present) == 1:
+                return present[0]
+            return _concat_arena(present)
+
+        first = blocks[0]
+        return {
+            "_counts": cat([b["_counts"] for b in blocks]),
+            "_gkey_by_slot": cat([b["_gkey_by_slot"] for b in blocks]),
+            "_emitted": cat([b["_emitted"] for b in blocks]),
+            "_accs": [
+                cat([b["_accs"][j] for b in blocks])
+                for j in range(len(first["_accs"]))
+            ],
+            "_prev": [
+                cat([b["_prev"][j] for b in blocks])
+                for j in range(len(first["_prev"]))
+            ],
+            "_gvals": [
+                cat([b["_gvals"][j] for b in blocks])
+                for j in range(len(first["_gvals"]))
+            ],
+        }
+
     def _general_materialized(self) -> dict:
         """The general-path state with every cold group faulted into a
         COPY (the live dict and the cold tier stay as they are)."""
@@ -1337,6 +1437,17 @@ class _SortedSide:
         if self._budget is not None:
             self._budget.register(self)
 
+    def _snapshot_skeleton(self) -> dict:
+        """The resident-only pickle dict (spilled payloads EXCLUDED) —
+        the streaming-snapshot head Join.snapshot_state_parts yields
+        before streaming each spilled run's payload individually."""
+        d = dict(self.__dict__)
+        d.pop("_range_cache", None)
+        d.pop("_budget", None)
+        d.pop("_spilled", None)
+        d["_runs"] = list(self._runs)
+        return d
+
     def __len__(self) -> int:
         return sum(len(r[0]) for r in self._runs) + sum(
             len(rec[0]) for rec in self._spilled
@@ -1632,6 +1743,59 @@ class Join(Node):
     STATE_FIELDS = (
         "_cleft", "_cright", "_left", "_right", "_lpad", "_rpad", "_idstate"
     )
+
+    # -- streaming snapshots (persistence/snapshots.py write_parts) -------
+    #
+    # A sorted-merge arrangement under the memory budget holds most of
+    # its payload in spilled runs; pickling it (``__getstate__``)
+    # materializes every run resident. The parts protocol instead streams
+    # the resident skeleton first and each spilled run's payload one at a
+    # time — commit-time peak RSS stays bounded by one run + one chunk.
+
+    def snapshot_state_parts(self):
+        base: dict = {}
+        sides: dict[str, _SortedSide] = {}
+        for f in self.STATE_FIELDS:
+            if not hasattr(self, f):
+                continue
+            v = getattr(self, f)
+            if (
+                f in ("_cleft", "_cright")
+                and isinstance(v, _SortedSide)
+                and v._spilled
+            ):
+                sides[f] = v
+            else:
+                base[f] = v
+        yield {
+            "base": base,
+            "sides": {f: len(s._spilled) for f, s in sides.items()},
+        }
+        for f in sorted(sides):
+            side = sides[f]
+            yield side._snapshot_skeleton()
+            store = side._budget.spill_store()
+            for rec in side._spilled:
+                # (sorted jks, count prefix-sum, payload) — ONE spilled
+                # run resident at a time
+                yield (rec[0], rec[1], store.get_blob(rec[2]))
+
+    @classmethod
+    def state_from_parts(cls, parts) -> dict:
+        head = next(parts)
+        state = dict(head["base"])
+        for f in sorted(head["sides"]):
+            skel = next(parts)
+            runs = []
+            for _ in range(head["sides"][f]):
+                jks, csum, payload = next(parts)
+                keys, cols, counts = payload
+                runs.append([jks, keys, cols, counts, csum])
+            side = _SortedSide.__new__(_SortedSide)
+            skel["_runs"] = runs + list(skel["_runs"])
+            side.__setstate__(skel)
+            state[f] = side
+        return state
 
     # -- elastic rescale (rescale/resharder.py) ---------------------------
     #

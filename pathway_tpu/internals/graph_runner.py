@@ -310,10 +310,55 @@ class GraphRunner:
         self._nodes.append(cap)
         return cap
 
+    def _build_delivery_sink(self, spec: dict) -> Any:
+        """Instantiate one delivery-managed sink (io/delivery.py) for this
+        worker's runner. The DeliveryManager attaches to the persistence
+        manager on EVERY worker (so all workers agree on the finish-path
+        commit ordering), but only worker 0's sinks are transactional —
+        sink callbacks gather there, and a peer's idle cursor must never
+        drag the cluster's recovery floor down."""
+        from ..io import delivery as _dlv
+
+        mgr = getattr(self, "_delivery_mgr", None)
+        worker_id = (
+            self.persistence.worker_id if self.persistence is not None else 0
+        )
+        if mgr is None:
+            mgr = self._delivery_mgr = _dlv.DeliveryManager(worker_id)
+            if self.persistence is not None:
+                self.persistence.delivery = mgr
+        active = worker_id == 0
+        transactional = self.persistence is not None and active
+        dsink = _dlv.DeliverySink(
+            spec["adapter_factory"](),
+            spec["name"],
+            policy=spec.get("retry_policy"),
+            worker_id=worker_id,
+            backend=self.persistence.backend if transactional else None,
+            transactional=transactional,
+            dlq=mgr.dlq,
+        )
+        mgr.add(dsink)
+        return dsink
+
     def lower_sink(self, sink: Any) -> None:
         kind = sink["kind"]
         if kind == "subscribe":
             node = self.lower(sink["table"])
+            dspec = sink.get("delivery")
+            if dspec is not None:
+                # delivery-managed sink: retries/acks/DLQ live in the
+                # delivery layer; recovery dedup is the durable ack
+                # cursor, NOT skip_until — replayed output above the
+                # restore point must REACH the sink for re-delivery
+                dsink = self._build_delivery_sink(dspec)
+                self._nodes.append(ops.Subscribe(
+                    node,
+                    on_batch=dsink.on_batch,
+                    on_end=dsink.on_end,
+                    skip_until=-1,
+                ))
+                return
             skip_until = -1
             if (
                 self.persistence is not None

@@ -18,6 +18,7 @@ from . import (  # noqa: E402,F401
     bigquery,
     csv,
     debezium,
+    delivery,
     deltalake,
     elasticsearch,
     fs,
@@ -46,6 +47,7 @@ __all__ = [
     "bigquery",
     "csv",
     "debezium",
+    "delivery",
     "deltalake",
     "elasticsearch",
     "fs",

@@ -40,7 +40,7 @@ def write(table: Table, dataset_name: str, table_name: str, *,
     must include integral ``time`` and ``diff`` fields (reference
     io/bigquery/__init__.py:55). ``_client`` injects anything exposing
     ``insert_rows_json(table_ref, rows) -> errors`` (tests use a fake)."""
-    from . import subscribe
+    from .delivery import CallableAdapter, SinkRejectedError, deliver
     from .fs import _jsonable
 
     client = _client if _client is not None else _bq_client(
@@ -49,16 +49,60 @@ def write(table: Table, dataset_name: str, table_name: str, *,
     table_ref = f"{dataset_name}.{table_name}"
     names = table.column_names()
 
-    def on_batch(time, batch):
-        cols = [batch.data[n] for n in names]
+    def write_batch(batch):
+        cols = [batch.delta.data[n] for n in names]
         rows = []
-        for vals, diff in zip(zip(*cols), batch.diffs):
+        for vals, diff in zip(zip(*cols), batch.delta.diffs):
             row = {n: _jsonable(v) for n, v in zip(names, vals)}
-            row["time"] = int(time)
+            row["time"] = int(batch.time)
             row["diff"] = int(diff)
             rows.append(row)
         errors = client.insert_rows_json(table_ref, rows)
         if errors:
-            raise RuntimeError(f"bigquery insert failed: {errors}")
+            # per-row insert errors are schema rejects, not transient
+            # failures: dead-letter them instead of retrying forever.
+            # BigQuery reports VALID rows of a failed insertAll with
+            # reason "stopped" — those must redeliver, never dead-letter
+            def _poison(entry) -> bool:
+                errs = entry.get("errors") if isinstance(entry, dict) else None
+                if not errs:
+                    return True  # shapeless entry: treat as poison
+                return any(
+                    (e or {}).get("reason") != "stopped" for e in errs
+                )
 
-    subscribe(table, on_batch=on_batch)
+            indexed = [
+                e for e in errors
+                if isinstance(e, dict) and e.get("index") is not None
+            ]
+            bad = [int(e["index"]) for e in indexed if _poison(e)]
+            stopped = {int(e["index"]) for e in indexed if not _poison(e)}
+            unattributed_poison = any(
+                not (isinstance(e, dict) and e.get("index") is not None)
+                and _poison(e)
+                for e in errors
+            )
+            if not bad and not unattributed_poison:
+                # every entry is a "stopped" echo of some upstream failure
+                # — nothing identifiably poison, so retry the whole batch
+                raise RuntimeError(f"bigquery insert failed: {errors}")
+            if unattributed_poison:
+                # poison exists but can't be pinned to a row: dead-letter
+                # everything EXCEPT the rows BigQuery explicitly marked
+                # "stopped" (those are valid and must redeliver)
+                bad = sorted(
+                    set(range(len(rows))) - stopped | set(bad)
+                )
+            raise SinkRejectedError(
+                f"bigquery insert failed: {errors}",
+                row_indices=bad or None,
+            )
+        return None
+
+    deliver(
+        table,
+        lambda: CallableAdapter(write_batch, "bigquery"),
+        name=name,
+        default_name=f"bigquery-{dataset_name}.{table_name}",
+        retry_policy=kwargs.get("retry_policy"),
+    )

@@ -172,16 +172,32 @@ class DeltaTableWriter:
 
 
 def write(table: Table, uri: str, *, min_commit_frequency: int | None = 60_000,
-          name: str | None = None, **kwargs: Any) -> None:
-    from . import subscribe
+          name: str | None = None, retry_policy: Any = None,
+          **kwargs: Any) -> None:
+    """Append the update stream to a Delta table through the transactional
+    delivery layer. With ``min_commit_frequency=None`` every delivered
+    batch is its own Delta commit (ack = durable); a nonzero frequency
+    trades ack granularity for fewer commits (rows acked while buffered
+    ride the NEXT flush — a crash inside that window re-delivers none of
+    them but may lose the buffer tail to the log's last commit)."""
+    from .delivery import CallableAdapter, deliver
 
     uri = os.fspath(uri)
     names = table.column_names()
     writer = DeltaTableWriter(uri, names, table.schema, min_commit_frequency)
-    subscribe(
+
+    def write_batch(batch):
+        writer.add_batch(batch.time, batch.delta)
+        return None
+
+    deliver(
         table,
-        on_batch=lambda time, batch: writer.add_batch(time, batch),
-        on_end=writer.flush,
+        lambda: CallableAdapter(
+            write_batch, "deltalake", on_close=writer.flush
+        ),
+        name=name,
+        default_name=f"deltalake-{os.path.basename(uri.rstrip('/'))}",
+        retry_policy=retry_policy,
     )
 
 

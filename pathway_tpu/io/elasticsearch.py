@@ -38,7 +38,8 @@ class ElasticSearchParams:
 
 
 def write(table: Table, host: str | None = None, auth: ElasticSearchAuth | None = None,
-          index_name: str | None = None, **kwargs: Any) -> None:
+          index_name: str | None = None, name: str | None = None,
+          retry_policy: Any = None, **kwargs: Any) -> None:
     es_mod = require("elasticsearch", "elasticsearch", "pw.io.elasticsearch")
     client_kwargs: dict[str, Any] = {"hosts": [host]}
     if auth is not None:
@@ -53,12 +54,22 @@ def write(table: Table, host: str | None = None, auth: ElasticSearchAuth | None 
         elif auth.kind == "bearer":
             client_kwargs["bearer_auth"] = auth.options["bearer"]
     client = es_mod.Elasticsearch(**client_kwargs)
-    from . import subscribe
+    from .delivery import CallableAdapter, deliver
 
     names = table.column_names()
 
-    def on_change(key, row, time, is_addition):
-        if is_addition:
-            client.index(index=index_name, document={n: row[n] for n in names})
+    def write_batch(batch):
+        for row, diff in batch.rows():
+            if diff > 0:
+                client.index(
+                    index=index_name, document={n: row[n] for n in names}
+                )
+        return None
 
-    subscribe(table, on_change=on_change)
+    deliver(
+        table,
+        lambda: CallableAdapter(write_batch, "elasticsearch"),
+        name=name,
+        default_name=f"elasticsearch-{index_name}",
+        retry_policy=retry_policy,
+    )

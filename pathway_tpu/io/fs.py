@@ -380,44 +380,121 @@ def read(
     return rows_to_table(names, rows, schema=schema, id_from=id_from)
 
 
-def write(table: Table, filename: str | os.PathLike, *, format: str = "csv", name: str | None = None, **kwargs: Any) -> None:
-    """Write the table's update stream to a file (time/diff columns appended,
-    like the reference's FileWriter + DsvFormatter/JsonLinesFormatter)."""
-    from . import subscribe
+class _FsSinkAdapter:
+    """Transactional file writer (the reference FileWriter +
+    DsvFormatter/JsonLinesFormatter, made exactly-once): the resume token
+    is the byte position of the last ACKED batch — ``open`` truncates a
+    recovered file back to it (a kill mid-write leaves a torn tail past
+    the token; it is cut before new bytes land) and ``rollback`` does the
+    same within a run, so retries after a torn write never double rows."""
+
+    def __init__(self, filename: str, format: str, names: list[str]):
+        self.filename = filename
+        self.format = format
+        self.names = names
+        self._raw: Any = None
+        self._f: Any = None
+        self._writer: Any = None
+        #: byte position writes resume from after a rollback: the last
+        #: ACKED batch's end (or the post-header position) — NOT the last
+        #: write's end, which a torn attempt may have advanced
+        self._acked_pos = 0
+        from .delivery import _env_f
+
+        self._fsync = _env_f("PATHWAY_SINK_FSYNC", 1.0) > 0
+
+    def open(self, resume_token: Any) -> None:
+        import io as _io
+
+        resume = (
+            int(resume_token)
+            if resume_token is not None and os.path.exists(self.filename)
+            else None
+        )
+        self._raw = open(self.filename, "r+b" if resume is not None else "w+b")
+        # text layer for csv/json rendering; byte positions come from the
+        # binary layer (text-mode tell() cookies are not truncate() args)
+        self._f = _io.TextIOWrapper(self._raw, encoding="utf-8", newline="")
+        if self.format == "csv":
+            self._writer = _csv.writer(self._f)
+        if resume is not None:
+            self._raw.truncate(resume)
+            self._raw.seek(resume)
+            self._acked_pos = resume
+            return
+        if self.format == "csv":
+            self._writer.writerow(self.names + ["time", "diff"])
+        self._f.flush()
+        self._acked_pos = self._raw.tell()
+
+    def write_batch(self, batch: Any) -> int:
+        cols = [batch.delta.data[n] for n in self.names]
+        if self.format == "csv":
+            self._writer.writerows(
+                list(vals) + [batch.time, int(diff)]
+                for vals, diff in zip(zip(*cols), batch.delta.diffs)
+            )
+        else:
+            for vals, diff in zip(zip(*cols), batch.delta.diffs):
+                obj = {n: _jsonable(v) for n, v in zip(self.names, vals)}
+                obj["time"] = batch.time
+                obj["diff"] = int(diff)
+                self._f.write(json.dumps(obj) + "\n")
+        self._f.flush()
+        if self._fsync:
+            os.fsync(self._raw.fileno())
+        return self._raw.tell()
+
+    def rollback(self, resume_token: Any = None) -> None:
+        if self._raw is None:
+            return
+        pos = (
+            int(resume_token) if resume_token is not None else self._acked_pos
+        )
+        self._f.flush()
+        self._raw.truncate(pos)
+        self._raw.seek(pos)
+
+    def on_timeout(self) -> None:
+        """A watchdog-abandoned write thread may still be inside
+        ``write_batch`` on this handle: close it so the zombie's next
+        write fails on a closed fd instead of interleaving bytes with
+        the retry's reopened file (delivery reopens via ``open`` with
+        the last acked token, which truncates whatever the zombie
+        managed to push)."""
+        try:
+            if self._f is not None:
+                self._f.close()
+        except Exception:
+            pass
+        self._raw = self._f = self._writer = None
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+
+
+def write(table: Table, filename: str | os.PathLike, *, format: str = "csv",
+          name: str | None = None, retry_policy: Any = None,
+          **kwargs: Any) -> None:
+    """Write the table's update stream to a file (time/diff columns
+    appended). Rides the transactional delivery layer (``io/delivery``):
+    with persistence on, batches are acked against the committed frontier
+    and the file recovers exactly-once across crashes."""
+    from .delivery import deliver
 
     filename = os.fspath(filename)
     names = table.column_names()
-    state: dict[str, Any] = {"f": None, "writer": None}
 
-    def ensure_open():
-        if state["f"] is None:
-            state["f"] = open(filename, "w", newline="")
-            if format == "csv":
-                w = _csv.writer(state["f"])
-                w.writerow(names + ["time", "diff"])
-                state["writer"] = w
-        return state["f"]
+    def adapter():
+        return _FsSinkAdapter(filename, format, names)
 
-    def on_batch(time, batch):
-        f = ensure_open()
-        cols = [batch.data[n] for n in names]
-        if format == "csv":
-            state["writer"].writerows(
-                list(vals) + [time, int(diff)]
-                for vals, diff in zip(zip(*cols), batch.diffs)
-            )
-        else:
-            for vals, diff in zip(zip(*cols), batch.diffs):
-                obj = {n: _jsonable(v) for n, v in zip(names, vals)}
-                obj["time"] = time
-                obj["diff"] = int(diff)
-                f.write(json.dumps(obj) + "\n")
-
-    def on_end():
-        ensure_open()
-        state["f"].close()
-
-    subscribe(table, on_batch=on_batch, on_end=on_end)
+    deliver(
+        table, adapter,
+        name=name,
+        default_name=f"fs-{os.path.basename(filename)}",
+        retry_policy=retry_policy,
+    )
 
 
 def _jsonable(v: Any) -> Any:

@@ -1,33 +1,33 @@
 """``pw.io.http`` — HTTP streaming client + REST server connector
-(reference ``python/pathway/io/http``)."""
+(reference ``python/pathway/io/http``).
+
+The retry surface is the shared :class:`pathway_tpu.io.delivery.RetryPolicy`
+(re-exported here for the reference-compatible import path); both the
+streaming reader below and the delivery-managed writer ride it instead of
+hand-rolled backoff loops."""
 
 from __future__ import annotations
 
 import json
-import threading
 import time as _time
 from typing import Any, Callable, Sequence
 
 from ...internals.schema import SchemaMetaclass
 from ...internals.table import Table
+from ..delivery import RetryPolicy
 from ._server import PathwayWebserver, rest_connector
 
 __all__ = ["rest_connector", "PathwayWebserver", "read", "write", "RetryPolicy"]
 
 
-class RetryPolicy:
-    """Exponential backoff policy (reference io/http RetryPolicy surface)."""
-
-    def __init__(self, first_delay_ms: int = 1000, backoff_factor: float = 2.0,
-                 jitter_ms: int = 0, max_retries: int = 5):
-        self.first_delay_ms = first_delay_ms
-        self.backoff_factor = backoff_factor
-        self.jitter_ms = jitter_ms
-        self.max_retries = max_retries
-
-    @classmethod
-    def default(cls) -> "RetryPolicy":
-        return cls()
+def _retrying(attempts: int, policy: RetryPolicy):
+    """Shared attempt loop: yields attempt indices, sleeping the policy's
+    jittered backoff between them. The caller breaks on success; the last
+    attempt's exception propagates (the generator simply runs out)."""
+    for attempt in range(1, attempts + 1):
+        if attempt > 1:
+            _time.sleep(policy.delay_s(attempt - 1))
+        yield attempt
 
 
 def read(
@@ -61,8 +61,8 @@ def read(
 
     class _HttpSubject(ConnectorSubject):
         def run(self) -> None:
-            delay = policy.first_delay_ms / 1000.0
-            for attempt in range(attempts):
+            last: BaseException | None = None
+            for _attempt in _retrying(attempts, policy):
                 try:
                     resp = _requests.request(
                         method, url, json=payload, headers=headers, stream=True,
@@ -80,12 +80,12 @@ def read(
                             row = {"data": line.decode()}
                         if row is not None:
                             self.next(**row)
+                    last = None
                     break
-                except Exception:
-                    if attempt == attempts - 1:
-                        raise
-                    _time.sleep(delay)
-                    delay *= policy.backoff_factor
+                except Exception as e:
+                    last = e
+            if last is not None:
+                raise last
             self.close()
 
     return python_read(
@@ -104,62 +104,40 @@ def write(
     n_retries: int = 0,
     headers: dict[str, str] | None = None,
     retry_policy: RetryPolicy | None = None,
+    name: str | None = None,
 ) -> None:
-    """POST one request per row change. Requests drain on a writer thread so
-    retries/backoff never stall the engine tick (the reference likewise runs
-    sink I/O off the worker loop)."""
-    import queue as _queue
-
+    """POST one request per row change, through the delivery layer: the
+    writer thread, retry/backoff, circuit breaker, bounded buffering and
+    the dead-letter queue all come from ``io/delivery`` (the reference
+    likewise runs sink I/O off the worker loop). ``n_retries`` folds into
+    the policy for reference-surface compatibility."""
     import requests as _requests
 
-    from .. import subscribe
+    from ..delivery import CallableAdapter, deliver
     from ._server import _dumps
 
-    q: "_queue.Queue[Any]" = _queue.Queue()
-    _END = object()
-    failure: list[BaseException] = []
+    if retry_policy is None and n_retries:
+        retry_policy = RetryPolicy(max_retries=n_retries)
 
-    def drain():
-        while True:
-            body = q.get()
-            if body is _END:
-                return
-            attempts = max(1, n_retries + 1)
-            delay = (retry_policy.first_delay_ms / 1000.0) if retry_policy else 1.0
-            for i in range(attempts):
-                try:
-                    _requests.request(
-                        method, url, data=_dumps(body),
-                        headers={
-                            "Content-Type": "application/json",
-                            **(headers or {}),
-                        },
-                        timeout=30,
-                    ).raise_for_status()
-                    break
-                except Exception as e:
-                    if i == attempts - 1:
-                        failure.append(e)
-                        return
-                    _time.sleep(delay)
-                    if retry_policy:
-                        delay *= retry_policy.backoff_factor
+    def write_batch(batch):
+        for row, diff in batch.rows():
+            body = dict(row)
+            body["diff"] = 1 if diff > 0 else -1
+            body["time"] = batch.time
+            _requests.request(
+                method, url, data=_dumps(body),
+                headers={
+                    "Content-Type": "application/json",
+                    **(headers or {}),
+                },
+                timeout=30,
+            ).raise_for_status()
+        return None
 
-    worker = threading.Thread(target=drain, daemon=True)
-    worker.start()
-
-    def on_change(key, row, time, is_addition):
-        if failure:
-            raise RuntimeError("http.write sink failed") from failure[0]
-        body = dict(row)
-        body["diff"] = 1 if is_addition else -1
-        body["time"] = time
-        q.put(body)
-
-    def on_end():
-        q.put(_END)
-        worker.join(timeout=60)
-        if failure:
-            raise RuntimeError("http.write sink failed") from failure[0]
-
-    subscribe(table, on_change=on_change, on_end=on_end)
+    deliver(
+        table,
+        lambda: CallableAdapter(write_batch, "http"),
+        name=name,
+        default_name="http",
+        retry_policy=retry_policy,
+    )

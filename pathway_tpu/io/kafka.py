@@ -106,18 +106,26 @@ def write(
 ) -> None:
     ck = _require_client()
     producer = ck.Producer(rdkafka_settings)
-    from . import subscribe
+    from .delivery import CallableAdapter, deliver
     from .http._server import _dumps
 
     names = table.column_names()
 
-    def on_change(key_, row, time, is_addition):
-        payload = {**{n: row[n] for n in names}, "time": time,
-                   "diff": 1 if is_addition else -1}
-        producer.produce(topic_name, _dumps(payload).encode())
-        producer.poll(0)
-
-    def on_end():
+    def write_batch(batch):
+        for row, diff in batch.rows():
+            payload = {**{n: row[n] for n in names}, "time": batch.time,
+                       "diff": 1 if diff > 0 else -1}
+            producer.produce(topic_name, _dumps(payload).encode())
+            producer.poll(0)
+        # ack only after the local producer queue drained to the broker —
+        # produce() alone is buffered, not delivered
         producer.flush()
+        return None
 
-    subscribe(table, on_change=on_change, on_end=on_end)
+    deliver(
+        table,
+        lambda: CallableAdapter(write_batch, "kafka"),
+        name=name,
+        default_name=f"kafka-{topic_name}",
+        retry_policy=kwargs.get("retry_policy"),
+    )

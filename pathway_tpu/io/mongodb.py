@@ -21,24 +21,34 @@ __all__ = ["write"]
 
 def write(table: Table, connection_string: str, database: str, collection: str,
           *, max_batch_size: int | None = None, name: str | None = None,
-          **kwargs: Any) -> None:
+          retry_policy: Any = None, **kwargs: Any) -> None:
     pymongo = require("pymongo", "pymongo", "pw.io.mongodb")
     client = pymongo.MongoClient(connection_string)
     coll = client[database][collection]
-    from . import subscribe
+    from .delivery import CallableAdapter, deliver
 
-    def on_batch(time, delta):
-        names = list(delta.columns)
+    def write_batch(batch):
         docs = []
-        for _key, row, diff in delta.iter_rows():
-            doc = dict(zip(names, row))
-            doc["time"] = time
+        for row, diff in batch.rows():
+            doc = dict(row)
+            doc["time"] = batch.time
             doc["diff"] = 1 if diff > 0 else -1
             docs.append(doc)
         if not docs:
-            return
-        step = max_batch_size if max_batch_size and max_batch_size > 0 else len(docs)
+            return None
+        step = (
+            max_batch_size
+            if max_batch_size and max_batch_size > 0
+            else len(docs)
+        )
         for i in range(0, len(docs), step):
             coll.insert_many(docs[i : i + step])
+        return None
 
-    subscribe(table, on_batch=on_batch)
+    deliver(
+        table,
+        lambda: CallableAdapter(write_batch, "mongodb"),
+        name=name,
+        default_name=f"mongodb-{database}.{collection}",
+        retry_policy=retry_policy,
+    )

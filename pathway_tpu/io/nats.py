@@ -193,7 +193,7 @@ def write(table: Table, uri: str, topic: str, *, format: str = "json",
     """Publish the table's change stream to a NATS topic: one message per
     row update, JSON with the reference's ``time``/``diff`` fields
     (``NatsWriter``, data_storage.rs:2300)."""
-    from . import subscribe
+    from .delivery import CallableAdapter, deliver
     from .fs import _jsonable
 
     if format != "json":
@@ -201,12 +201,19 @@ def write(table: Table, uri: str, topic: str, *, format: str = "json",
     names = table.column_names()
     client = _client if _client is not None else _natspy_client(uri)
 
-    def on_batch(time, batch):
-        cols = [batch.data[n] for n in names]
-        for vals, diff in zip(zip(*cols), batch.diffs):
+    def write_batch(batch):
+        cols = [batch.delta.data[n] for n in names]
+        for vals, diff in zip(zip(*cols), batch.delta.diffs):
             obj = {n: _jsonable(v) for n, v in zip(names, vals)}
-            obj["time"] = int(time)
+            obj["time"] = int(batch.time)
             obj["diff"] = int(diff)
             client.publish(topic, json.dumps(obj).encode())
+        return None
 
-    subscribe(table, on_batch=on_batch, on_end=lambda: client.close())
+    deliver(
+        table,
+        lambda: CallableAdapter(write_batch, "nats", on_close=client.close),
+        name=name,
+        default_name=f"nats-{topic}",
+        retry_policy=kwargs.get("retry_policy"),
+    )

@@ -1,5 +1,7 @@
 """``pw.io.null`` — sink that discards output (reference NullWriter,
-data_storage.rs:1387); still forces the table to be computed."""
+data_storage.rs:1387); still forces the table to be computed. Rides the
+delivery layer like every other sink (a discarded batch still moves the
+per-sink delivered counters — useful as a load probe)."""
 
 from __future__ import annotations
 
@@ -7,6 +9,11 @@ from typing import Any
 
 
 def write(table, *, name: str | None = None, **kwargs: Any) -> None:
-    from . import subscribe
+    from .delivery import CallableAdapter, deliver
 
-    subscribe(table, on_change=lambda **kw: None)
+    deliver(
+        table,
+        lambda: CallableAdapter(lambda batch: None, "null"),
+        name=name,
+        default_name="null",
+    )

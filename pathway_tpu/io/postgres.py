@@ -70,6 +70,7 @@ def write(
     max_batch_size: int | None = None,
     init_mode: str = "default",
     name: str | None = None,
+    retry_policy: Any = None,
     **kwargs: Any,
 ) -> None:
     """Append every row update with time/diff (reference PsqlUpdates).
@@ -78,35 +79,54 @@ def write(
     conn = _connect(postgres_settings)
     _init_table(conn, table, table_name, init_mode,
                 ["time BIGINT", "diff BIGINT"], None)
-    from . import subscribe
+    from .delivery import CallableAdapter, deliver
 
     names = table.column_names()
     cols = ", ".join(names + ["time", "diff"])
     ph = ", ".join(["%s"] * (len(names) + 2))
     sql = f"INSERT INTO {table_name} ({cols}) VALUES ({ph})"
-    pending: list[list] = []
 
-    def flush():
-        if not pending:
-            return
+    def stage(batch):
+        params = [
+            [row[n] for n in names] + [batch.time, 1 if diff > 0 else -1]
+            for row, diff in batch.rows()
+        ]
+        step = (
+            max_batch_size
+            if max_batch_size and max_batch_size > 0
+            else len(params)
+        )
         with conn.cursor() as cur:
-            cur.executemany(sql, pending)
+            for i in range(0, len(params), max(1, step)):
+                cur.executemany(sql, params[i : i + max(1, step)])
+
+    def write_batch(batch):
+        # ONE SQL transaction per sink batch: conn.commit() only after
+        # every row landed, so a failed/torn attempt rolls back server-
+        # side and the delivery layer's retry starts clean (genuinely
+        # transactional re-delivery, the PsqlWriter analog)
+        stage(batch)
         conn.commit()
-        pending.clear()
+        return None
 
-    def on_change(key, row, time, is_addition):
-        pending.append([row[n] for n in names] + [time, 1 if is_addition else -1])
-        if max_batch_size is not None and len(pending) >= max_batch_size:
-            flush()
+    def rollback(_resume_token=None):
+        try:
+            conn.rollback()
+        except Exception:
+            pass
 
-    def on_time_end(time):
-        flush()
+    def adapter():
+        a = CallableAdapter(write_batch, "postgres", on_close=conn.close)
+        a.rollback = rollback
+        a.write_torn = stage  # torn chaos stages WITHOUT committing
+        return a
 
-    def on_end():
-        flush()
-        conn.close()
-
-    subscribe(table, on_change=on_change, on_time_end=on_time_end, on_end=on_end)
+    deliver(
+        table, adapter,
+        name=name,
+        default_name=f"postgres-{table_name}",
+        retry_policy=retry_policy,
+    )
 
 
 def write_snapshot(
@@ -118,13 +138,15 @@ def write_snapshot(
     max_batch_size: int | None = None,
     init_mode: str = "default",
     name: str | None = None,
+    retry_policy: Any = None,
     **kwargs: Any,
 ) -> None:
     """Maintain the current state: upsert on addition, delete on retraction
-    (reference PsqlSnapshotFormatter). Statements batch per commit tick."""
+    (reference PsqlSnapshotFormatter). One SQL transaction per sink batch,
+    delivered through the transactional output plane (io/delivery)."""
     conn = _connect(postgres_settings)
     _init_table(conn, table, table_name, init_mode, [], primary_key)
-    from . import subscribe
+    from .delivery import CallableAdapter, deliver
 
     names = table.column_names()
     cols = ", ".join(names)
@@ -138,30 +160,34 @@ def write_snapshot(
     where = " AND ".join(f"{k} = %s" for k in primary_key)
     delete = f"DELETE FROM {table_name} WHERE {where}"
 
-    pending: list[tuple[str, list]] = []
-
-    def flush():
-        if not pending:
-            return
+    def stage(batch):
         with conn.cursor() as cur:
-            for stmt, params in pending:
-                cur.execute(stmt, params)
+            for row, diff in batch.rows():
+                if diff > 0:
+                    cur.execute(upsert, [row[n] for n in names])
+                else:
+                    cur.execute(delete, [row[k] for k in primary_key])
+
+    def write_batch(batch):
+        stage(batch)
         conn.commit()
-        pending.clear()
+        return None
 
-    def on_change(key, row, time, is_addition):
-        if is_addition:
-            pending.append((upsert, [row[n] for n in names]))
-        else:
-            pending.append((delete, [row[k] for k in primary_key]))
-        if max_batch_size is not None and len(pending) >= max_batch_size:
-            flush()
+    def rollback(_resume_token=None):
+        try:
+            conn.rollback()
+        except Exception:
+            pass
 
-    def on_time_end(time):
-        flush()
+    def adapter():
+        a = CallableAdapter(write_batch, "postgres", on_close=conn.close)
+        a.rollback = rollback
+        a.write_torn = stage
+        return a
 
-    def on_end():
-        flush()
-        conn.close()
-
-    subscribe(table, on_change=on_change, on_time_end=on_time_end, on_end=on_end)
+    deliver(
+        table, adapter,
+        name=name,
+        default_name=f"postgres-snapshot-{table_name}",
+        retry_policy=retry_policy,
+    )

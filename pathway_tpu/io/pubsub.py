@@ -24,8 +24,6 @@ def write(table: Table, publisher: Any, project_id: str, topic_id: str,
     must have exactly one column, of binary type (reference
     io/pubsub/__init__.py:49); each update becomes one message with
     ``pathway_time`` and ``pathway_diff`` attributes."""
-    from . import subscribe
-
     names = table.column_names()
     if len(names) != 1:
         raise ValueError(
@@ -39,14 +37,23 @@ def write(table: Table, publisher: Any, project_id: str, topic_id: str,
         )
     (column,) = names
     topic_path = publisher.topic_path(project_id, topic_id)
+    from .delivery import CallableAdapter, deliver
 
-    def on_batch(time, batch):
-        vals = batch.data[column]
-        for v, diff in zip(vals, batch.diffs):
+    def write_batch(batch):
+        vals = batch.delta.data[column]
+        for v, diff in zip(vals, batch.delta.diffs):
             data = v if isinstance(v, bytes) else str(v).encode()
             publisher.publish(
                 topic_path, data,
-                pathway_time=str(int(time)), pathway_diff=str(int(diff)),
+                pathway_time=str(int(batch.time)),
+                pathway_diff=str(int(diff)),
             )
+        return None
 
-    subscribe(table, on_batch=on_batch)
+    deliver(
+        table,
+        lambda: CallableAdapter(write_batch, "pubsub"),
+        name=kwargs.get("name"),
+        default_name=f"pubsub-{topic_id}",
+        retry_policy=kwargs.get("retry_policy"),
+    )

@@ -19,28 +19,36 @@ def send_alerts(
     **kwargs: Any,
 ) -> None:
     """Each addition in the (single text column) table becomes one Slack
-    message to the channel."""
-    from . import subscribe
+    message to the channel (delivered through the retrying output plane)."""
+    from .delivery import CallableAdapter, deliver
 
     (col,) = messages.column_names()
 
-    def on_change(key, row, time, is_addition):
-        if not is_addition:
-            return
+    def write_batch(batch):
         import json
         import urllib.request
 
-        req = urllib.request.Request(
-            _SLACK_URL,
-            data=json.dumps(
-                {"channel": slack_channel_id, "text": str(row[col])}
-            ).encode(),
-            headers={
-                "Content-Type": "application/json",
-                "Authorization": f"Bearer {slack_token}",
-            },
-            method="POST",
-        )
-        urllib.request.urlopen(req, timeout=30)
+        for row, diff in batch.rows():
+            if diff <= 0:
+                continue
+            req = urllib.request.Request(
+                _SLACK_URL,
+                data=json.dumps(
+                    {"channel": slack_channel_id, "text": str(row[col])}
+                ).encode(),
+                headers={
+                    "Content-Type": "application/json",
+                    "Authorization": f"Bearer {slack_token}",
+                },
+                method="POST",
+            )
+            urllib.request.urlopen(req, timeout=30)
+        return None
 
-    subscribe(messages, on_change=on_change)
+    deliver(
+        messages,
+        lambda: CallableAdapter(write_batch, "slack"),
+        name=kwargs.get("name"),
+        default_name=f"slack-{slack_channel_id}",
+        retry_policy=kwargs.get("retry_policy"),
+    )

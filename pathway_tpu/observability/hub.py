@@ -265,6 +265,19 @@ class ObservabilityHub:
             # telemetry must not fail the run it observes
             return {}
 
+    @staticmethod
+    def sink_stats_snapshot() -> dict[str, dict[str, float]]:
+        """This process's output-plane sink counters (delivered / retries
+        / DLQ / breaker / queue depth / delivery lag per sink —
+        io/delivery.py) — shipped in /snapshot like the memory gauges."""
+        try:
+            from ..io.delivery import sink_stats_snapshot
+
+            return sink_stats_snapshot()
+        except Exception:
+            # telemetry must not fail the run it observes
+            return {}
+
     def snapshot_document(self) -> dict:
         """The /snapshot payload peers serve to process 0."""
         return {
@@ -272,6 +285,7 @@ class ObservabilityHub:
             "workers": self.local_snapshots(),
             "comm": self.comm_snapshot(),
             "memory": self.memory_stats_snapshot(),
+            "sinks": self.sink_stats_snapshot(),
             "trace_dropped": self._local_trace_dropped(),
         }
 
@@ -282,6 +296,7 @@ class ObservabilityHub:
         dict[str, dict],
         dict[str, int],
         dict[str, float],
+        dict[str, dict],
         dict[str, dict],
     ]:
         """Local snapshots plus every reachable peer's; comm stats keyed
@@ -299,6 +314,7 @@ class ObservabilityHub:
         snapshots = self.local_snapshots()
         comm_stats = {str(self.process_id): self.comm_snapshot()}
         memory_stats = {str(self.process_id): self.memory_stats_snapshot()}
+        sink_stats = {str(self.process_id): self.sink_stats_snapshot()}
         trace_dropped: dict[str, int] = {}
         stale: dict[str, float] = {}
         local_dropped = self._local_trace_dropped()
@@ -334,13 +350,19 @@ class ObservabilityHub:
             peer_mem = doc.get("memory")
             if peer_mem:
                 memory_stats[str(doc.get("process_id", "?"))] = peer_mem
+            peer_sinks = doc.get("sinks")
+            if peer_sinks:
+                sink_stats[str(doc.get("process_id", "?"))] = peer_sinks
             peer_dropped = doc.get("trace_dropped")
             if peer_dropped is not None:
                 trace_dropped[str(doc.get("process_id", "?"))] = int(
                     peer_dropped
                 )
         snapshots.sort(key=lambda s: s.get("worker", 0))
-        return snapshots, comm_stats, trace_dropped, stale, memory_stats
+        return (
+            snapshots, comm_stats, trace_dropped, stale, memory_stats,
+            sink_stats,
+        )
 
     @staticmethod
     def _scrape_peer(host: str, port: int) -> dict | None:
@@ -450,6 +472,7 @@ class ObservabilityHub:
             )
         doc["comm"] = comm
         doc["memory"] = self.memory_stats_snapshot()
+        doc["sinks"] = self.sink_stats_snapshot()
         from .attribution import attribution_document
 
         doc["attribution"] = attribution_document(sig, w)
@@ -519,6 +542,7 @@ class ObservabilityHub:
         merged["workers"] = dict(local.get("workers", {}))
         merged["comm"] = {str(self.process_id): local.get("comm", {})}
         merged["memory"] = {str(self.process_id): local.get("memory", {})}
+        merged["sinks"] = {str(self.process_id): local.get("sinks", {})}
         merged["alerts"] = {
             "active": list(local.get("alerts", {}).get("active", [])),
             "history": list(local.get("alerts", {}).get("history", [])),
@@ -534,6 +558,7 @@ class ObservabilityHub:
             merged["workers"].update(doc.get("workers", {}))
             merged["comm"][str(pid)] = doc.get("comm", {})
             merged["memory"][str(pid)] = doc.get("memory", {})
+            merged["sinks"][str(pid)] = doc.get("sinks", {})
             alerts = doc.get("alerts", {})
             merged["alerts"]["active"].extend(alerts.get("active", []))
             merged["alerts"]["history"].extend(alerts.get("history", []))
@@ -644,9 +669,10 @@ class ObservabilityHub:
         trace_dropped: int | dict[str, int] | None
         stale: dict[str, float] | None = None
         if self.peer_http:
-            snapshots, comm_stats, dropped_by_proc, stale, memory_stats = (
-                self.cluster_snapshots()
-            )
+            (
+                snapshots, comm_stats, dropped_by_proc, stale,
+                memory_stats, sink_stats,
+            ) = self.cluster_snapshots()
             # per-process labels, like the comm gauges: series identity
             # stays stable when a peer scrape transiently fails
             trace_dropped = dropped_by_proc or None
@@ -656,6 +682,8 @@ class ObservabilityHub:
             comm_stats = {str(self.process_id): comm} if comm else {}
             mem = self.memory_stats_snapshot()
             memory_stats = {str(self.process_id): mem} if mem else {}
+            sinks = self.sink_stats_snapshot()
+            sink_stats = {str(self.process_id): sinks} if sinks else {}
             trace_dropped = self._local_trace_dropped()
         # label by TOPOLOGY, not by how many snapshots this scrape got:
         # in cluster mode a transient peer outage must not flip series
@@ -700,6 +728,7 @@ class ObservabilityHub:
             alerts_active=alerts_active,
             autoscale=self._autoscale_snapshot(),
             memory_stats=memory_stats or None,
+            sink_stats=sink_stats or None,
         )
 
     @staticmethod

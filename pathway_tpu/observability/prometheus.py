@@ -118,6 +118,7 @@ def render_snapshots(
     alerts_active: int | None = None,
     autoscale: dict | None = None,
     memory_stats: dict[str, dict[str, float]] | None = None,
+    sink_stats: dict[str, dict[str, dict[str, float]]] | None = None,
 ) -> str:
     """Exposition text for a set of worker stats snapshots.
 
@@ -219,6 +220,15 @@ def render_snapshots(
                 name = f"pathway_{key}"  # state_*_bytes gauges
             kind = "counter" if name.endswith("_total") else "gauge"
             r.add(name, kind, value, plab)
+    for proc, sinks in sorted((sink_stats or {}).items()):
+        # output plane (io/delivery.py): per-sink delivery counters. The
+        # process label keeps a muted worker's zeroed copy of a sink from
+        # colliding with the delivering worker's live series
+        for sink, gauges in sorted(sinks.items()):
+            slab = {"process": str(proc), "sink": str(sink)}
+            for key, value in sorted(gauges.items()):
+                kind = "counter" if key.endswith("_total") else "gauge"
+                r.add(f"pathway_sink_{key}", kind, value, slab)
     r.add("pathway_cluster_workers", "gauge", len(snapshots))
     if stale_workers:
         # a peer whose /snapshot scrape failed: its workers are reported

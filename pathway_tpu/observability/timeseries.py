@@ -467,6 +467,13 @@ class SignalsPlane:
         # decider can watch rss_bytes or state_spilled_bytes directly
         for key, value in self.hub.memory_stats_snapshot().items():
             self.store.record(f"mem.{key}", float(value), None, t)
+        # output-plane delivery counters (io/delivery.py): per-sink series
+        # — SLO rules can watch sink.out.dlq_total or queue_depth directly
+        for sink, gauges in self.hub.sink_stats_snapshot().items():
+            for key, value in gauges.items():
+                self.store.record(
+                    f"sink.{sink}.{key}", float(value), None, t
+                )
 
     # -- lifecycle -----------------------------------------------------
 

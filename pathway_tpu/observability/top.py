@@ -117,6 +117,43 @@ def render_frame(doc: dict, now: float | None = None) -> str:
             if m.get("key_registry_frozen"):
                 line += " FROZEN"
         lines.append(line)
+    sinks = doc.get("sinks", {})
+    # merged docs key sinks by process; single-process docs are flat
+    # (sink name -> counters). Flat docs have dicts of floats one level
+    # down, merged docs dicts of dicts.
+    flat: dict[str, dict] = {}
+
+    def _absorb(name: str, counters: dict) -> None:
+        # a sink is constructed (with zeroed counters) on EVERY worker but
+        # delivers on one — keep the copy that has actually moved, never
+        # let a muted peer's zeros shadow the live series
+        cur = flat.get(name)
+        if cur is None or (counters or {}).get(
+            "delivered_rows_total", 0
+        ) >= (cur or {}).get("delivered_rows_total", 0):
+            flat[name] = counters
+
+    for k, v in (sinks or {}).items():
+        if v and all(isinstance(x, dict) for x in v.values()):
+            for name, counters in v.items():  # process-keyed: union
+                _absorb(name, counters)
+        elif isinstance(v, dict):
+            _absorb(k, v)
+    for sname in sorted(flat):
+        s = flat[sname] or {}
+        if not s:
+            continue
+        line = (
+            f"sink {sname}: {_fmt(s.get('delivered_rows_total'), nd=0)} "
+            f"row(s) delivered, queue {_fmt(s.get('queue_depth'), nd=0)}"
+        )
+        if s.get("retries_total"):
+            line += f", {s['retries_total']:.0f} retr(ies)"
+        if s.get("dlq_total"):
+            line += f", DLQ {s['dlq_total']:.0f}"
+        if s.get("breaker_open"):
+            line += ", breaker OPEN"
+        lines.append(line)
     sup = doc.get("supervisor")
     if sup is not None and sup.get("window_failures") is not None:
         budget = sup.get("window_budget")

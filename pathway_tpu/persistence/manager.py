@@ -121,6 +121,12 @@ class PersistenceManager:
         self.auto_commit = True
         self._stateful: list[Any] = []  # rank -> node
         self._dirty_ranks: set[int] = set()
+        #: the output plane (io/delivery.DeliveryManager) when this
+        #: worker owns delivery-managed sinks: commits barrier on the
+        #: previous release, then release acked output up to the
+        #: committed frontier (exactly-once sinks — see delivery.py)
+        self.delivery: Any = None
+        self._closing = False
 
     @staticmethod
     def _resolve_layout(
@@ -225,8 +231,10 @@ class PersistenceManager:
                     f"{desc and desc['cls']!r}, program builds {cls!r} — the "
                     "dataflow changed since the snapshot was taken"
                 )
+            from .snapshots import read_op_state
+
             node.restore_state(
-                self._ops.read(rank, int(desc["at"]), int(desc["chunks"]))
+                read_op_state(self._ops, rank, desc, type(node))
             )
 
     def replay_batches(
@@ -278,11 +286,15 @@ class PersistenceManager:
         self._last_recorded_time = max(self._last_recorded_time, int(time))
 
     def should_commit(self) -> bool:
-        return (
-            self._recording
-            and self._dirty
-            and _time.monotonic() - self._last_flush >= self.snapshot_interval_s
-        )
+        if not (self._recording and self._dirty):
+            return False
+        if _time.monotonic() - self._last_flush >= self.snapshot_interval_s:
+            return True
+        # output pressure: delivery-managed sinks hold their batches until
+        # the commit that makes the batches' input durable — when that
+        # pending buffer passes its bound, commit EARLY so output releases
+        # (growing it unboundedly would trade backpressure for OOM)
+        return self.delivery is not None and self.delivery.want_early_commit()
 
     def on_time_end(self, time: int) -> None:
         self._last_completed_time = max(
@@ -310,6 +322,15 @@ class PersistenceManager:
         snapshot; normal commits run AT a boundary, where live is exact)."""
         if not self._recording:
             return
+        delivery = None if self._closing else self.delivery
+        if delivery is not None:
+            # the previous release must be fully acked before a NEW
+            # snapshot commits: recovery restores the newest snapshot
+            # at-or-below the ack floor, and retention keeps two versions
+            # — a release lagging more than one commit would strand
+            # unacked output below every restorable snapshot. A down sink
+            # blocks here: that block IS the engine's backpressure.
+            delivery.pre_commit_barrier()
         written = self._writer.flush()
         if written is not None:
             seq, max_t = written
@@ -349,18 +370,42 @@ class PersistenceManager:
         self._safe_offsets = dict(self.offsets)
         self._safe_recorded = 0
         self._safe_time = self.last_time
+        if delivery is not None:
+            # input through last_time is durable — release the sink
+            # batches it produced and drain them now, so their acks (and
+            # the commit-tick cursor heartbeat) land while this commit is
+            # the newest: at any later crash, acked >= this commit's
+            # predecessor, keeping a restorable snapshot under the floor
+            delivery.on_commit(self.last_time)
 
     def _snapshot_operators(self, time: int) -> None:
         if self.op_snapshots and int(self.op_snapshots[-1]["time"]) == time:
             # same-tick re-commit (e.g. final commit right after an interval
             # commit): the existing snapshot already covers this time
             return
+        from ..engine.executor import Node
+
         prev_ops = self.op_snapshots[-1]["ops"] if self.op_snapshots else {}
         ops: dict[str, dict] = {}
         for rank, node in enumerate(self._stateful):
             prev = prev_ops.get(str(rank))
             if prev is not None and rank not in self._dirty_ranks:
                 ops[str(rank)] = prev  # unchanged state: re-reference blob
+                continue
+            if (
+                type(node).snapshot_state_parts
+                is not Node.snapshot_state_parts
+            ):
+                # spill-aware operator: stream the snapshot part by part
+                # (one spilled segment resident at a time) — commit-time
+                # peak RSS stays budget-bounded instead of O(total state)
+                n_chunks = self._ops.write_parts(
+                    rank, time, node.snapshot_state_parts()
+                )
+                ops[str(rank)] = {
+                    "cls": type(node).__name__, "at": time,
+                    "chunks": n_chunks, "fmt": "parts",
+                }
                 continue
             n_chunks = self._ops.write(rank, time, node.snapshot_state())
             ops[str(rank)] = {
@@ -388,6 +433,16 @@ class PersistenceManager:
             # full retention window exists.
             return []
         min_op_time = int(self.op_snapshots[0]["time"])
+        if self.delivery is not None and self.delivery.has_sinks():
+            # delivery sinks regenerate unacked output by REPLAYING input:
+            # a chunk whose output is not yet acked must survive even when
+            # an operator snapshot covers it (acute for stateless
+            # pipelines, where the empty snapshot trivially "covers"
+            # everything at the very first commit — before the first
+            # post-commit drain has acked anything)
+            floor = self.delivery.recovery_floor()
+            if floor is not None:
+                min_op_time = min(min_op_time, floor)
         covered = [
             seq for seq, max_t in self.chunk_spans.items() if max_t <= min_op_time
         ]
@@ -434,6 +489,8 @@ class PersistenceManager:
         time is likewise the boundary's last COMPLETED tick, so replayed
         rows sit above skip_until and re-emit (at-least-once output,
         exactly-once state)."""
+        self._closing = True  # abnormal path: no delivery barrier/release
+        # (unacked output re-delivers on recovery, deduped by ack cursor)
         if self._dirty:
             self._writer.truncate(self._safe_recorded)
             self.commit(
@@ -441,4 +498,6 @@ class PersistenceManager:
                 with_operators=False,
                 offsets=self._safe_offsets,
             )
+        if self.delivery is not None:
+            self.delivery.abort()
         self.backend.close()

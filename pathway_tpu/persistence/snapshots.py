@@ -33,6 +33,7 @@ __all__ = [
     "SnapshotReader",
     "MetadataAccessor",
     "OperatorSnapshots",
+    "read_op_state",
 ]
 
 _CHUNK_PREFIX = "chunks/chunk-"
@@ -239,6 +240,89 @@ class OperatorSnapshots:
         )
         return pickle.loads(blob)
 
+    # -- streaming parts format (spill-aware operators) -------------------
+    #
+    # An operator whose state is partially spilled to disk must not
+    # materialize every spilled segment resident just to snapshot it —
+    # commit-time peak RSS would be bounded by its TOTAL state, not the
+    # memory budget. ``write_parts`` consumes an ITERATOR of picklable
+    # parts (the operator loads one spilled segment at a time), framing
+    # each part with an 8-byte length prefix and flushing chunks as the
+    # buffer passes CHUNK_BYTES: peak memory = one part + one chunk.
+    # Descriptors carry ``"fmt": "parts"``; the monolithic format stays
+    # readable (old stores) and is still what the resharder writes.
+
+    def write_parts(self, rank: int, at: int, parts: Any) -> int:
+        import struct
+
+        buf = bytearray()
+        n = 0
+        for part in parts:
+            blob = pickle.dumps(part, protocol=pickle.HIGHEST_PROTOCOL)
+            buf += struct.pack("<Q", len(blob))
+            buf += blob
+            del blob
+            while len(buf) >= self.CHUNK_BYTES:
+                self._backend.put_value(
+                    self._key(rank, at, n), bytes(buf[: self.CHUNK_BYTES])
+                )
+                del buf[: self.CHUNK_BYTES]
+                n += 1
+        if buf or n == 0:
+            self._backend.put_value(self._key(rank, at, n), bytes(buf))
+            n += 1
+        return n
+
+    def read_parts(self, rank: int, at: int, n_chunks: int):
+        """Yield the parts ``write_parts`` framed, reading chunks lazily
+        (one blob resident at a time)."""
+        import struct
+
+        buf = bytearray()
+        next_chunk = 0
+
+        def fill(need: int) -> None:
+            nonlocal next_chunk
+            while len(buf) < need and next_chunk < n_chunks:
+                buf.extend(
+                    self._backend.get_value(self._key(rank, at, next_chunk))
+                )
+                next_chunk += 1
+            if len(buf) < need:
+                raise EOFError(
+                    f"operator snapshot rank {rank} at t={at}: truncated "
+                    f"parts stream (need {need} bytes, have {len(buf)})"
+                )
+
+        while True:
+            # probe: pull chunks until bytes appear or the stream ends
+            # (a zero-part snapshot is one empty chunk)
+            while not buf and next_chunk < n_chunks:
+                buf.extend(
+                    self._backend.get_value(self._key(rank, at, next_chunk))
+                )
+                next_chunk += 1
+            if not buf:
+                return
+            fill(8)
+            (size,) = struct.unpack("<Q", bytes(buf[:8]))
+            fill(8 + size)
+            part = pickle.loads(bytes(buf[8 : 8 + size]))
+            del buf[: 8 + size]
+            yield part
+
     def drop(self, rank: int, at: int, n_chunks: int) -> None:
         for c in range(n_chunks):
             self._backend.remove_key(self._key(rank, at, c))
+
+
+def read_op_state(ops: "OperatorSnapshots", rank: int, desc: dict,
+                  node_cls: Any) -> Any:
+    """Materialized operator state from a snapshot descriptor, whichever
+    format it carries — the one read path the manager, the resharder and
+    recovery all share."""
+    if desc.get("fmt") == "parts":
+        return node_cls.state_from_parts(
+            ops.read_parts(rank, int(desc["at"]), int(desc["chunks"]))
+        )
+    return ops.read(rank, int(desc["at"]), int(desc["chunks"]))

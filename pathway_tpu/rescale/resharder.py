@@ -450,10 +450,10 @@ def _rescale_root(
                         f"across workers: {sorted(cls_names)}"
                     )
                 cls = _node_class(descs[0]["cls"])
+                from ..persistence.snapshots import read_op_state
+
                 pieces = [
-                    OperatorSnapshots(view).read(
-                        rank, int(d["at"]), int(d["chunks"])
-                    )
+                    read_op_state(OperatorSnapshots(view), rank, d, cls)
                     for view, d in zip(views, descs)
                 ]
                 for j in range(to_workers):
@@ -516,6 +516,32 @@ def _rescale_root(
         }
         staged[j].put_value("meta/meta-00000000", json.dumps(meta).encode())
 
+    # carry the output plane's ack cursors (io/delivery.py delivery/<sink>
+    # keys): sinks gather to worker 0 in every layout, so destination
+    # worker 0 inherits each sink's cursor — dropping them would reset the
+    # recovery floor to -1 and re-deliver the whole replayed tail after
+    # every rescale (duplicate external output). If several source workers
+    # carry a cursor for one sink (residue of an older layout), the
+    # highest acked_time wins — cursors only ever advance, on the single
+    # delivering worker, exactly like offsets.
+    delivery_cursors: dict[str, tuple[int, bytes]] = {}
+    for view in views:
+        for key in view.list_keys():
+            if not key.startswith("delivery/"):
+                continue
+            blob = view.get_value(key)
+            try:
+                acked = int(json.loads(blob).get("acked_time", -1))
+            except (ValueError, TypeError):
+                continue  # torn cursor: the other copies (if any) win
+            cur = delivery_cursors.get(key)
+            if cur is None or acked > cur[0]:
+                delivery_cursors[key] = (acked, blob)
+    for key, (_acked, blob) in sorted(delivery_cursors.items()):
+        staged[0].put_value(key, blob)
+    if delivery_cursors:
+        report["delivery_cursors"] = len(delivery_cursors)
+
     fire("copy")
     staged_keys = [
         k for k in root.list_keys() if k.startswith(_layout.STAGING_PREFIX)
@@ -545,7 +571,7 @@ def _rescale_root(
         if key == _layout.MARKER_KEY or key.startswith(tgt):
             continue
         if key.startswith(_layout.STAGING_PREFIX) or key.startswith(
-            ("epoch-", "meta/", "chunks/", "ops/", "worker-")
+            ("epoch-", "meta/", "chunks/", "ops/", "worker-", "delivery/")
         ):
             root.remove_key(key)
     report["epoch"] = new_epoch

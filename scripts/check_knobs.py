@@ -24,7 +24,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: read sites; \s* spans newlines so black-wrapped calls still match
 _READ = re.compile(
     r"(?:os\.environ\.get\(|os\.environ\[|environ\.get\(|getenv\(|"
-    r"_env_(?:bool|int|float|addresses)\()\s*[\"'](PATHWAY_[A-Z0-9_]+)[\"']",
+    r"_env_(?:bool|int|float|addresses|f|i)\()\s*[\"'](PATHWAY_[A-Z0-9_]+)[\"']",
     re.S,
 )
 

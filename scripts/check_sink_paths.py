@@ -1,0 +1,126 @@
+"""Static check: every ``pw.io`` sink write entrypoint routes through the
+transactional delivery layer (``io/delivery.py``) — no naked external
+writes regress in later PRs.
+
+A sink module "routes through delivery" when each of its public write
+entrypoints (``write`` / ``write_snapshot`` / ``send_alerts``) either
+calls ``deliver(`` in its body or delegates to a module that does (the
+``csv``/``jsonlines``→``fs`` and ``logstash``→``http`` wrappers). Raw
+``subscribe(`` inside a write entrypoint is exactly the regression this
+guard exists to catch: a sink wired that way has no retries, no acks, no
+DLQ, no backpressure — an external outage crashes or wedges the worker.
+
+Usable standalone (``python scripts/check_sink_paths.py`` → exit 0/1)
+and as a tier-1 test (``tests/test_check_sink_paths.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+IO_DIR = os.path.join(ROOT, "pathway_tpu", "io")
+
+#: public sink entrypoints a connector module may export
+ENTRYPOINTS = ("write", "write_snapshot", "send_alerts")
+
+#: modules that are pure wrappers: their write() delegates to another
+#: sink module's write(), which this check covers directly
+DELEGATORS = {
+    "csv.py": "fs",
+    "jsonlines.py": "fs",
+    "logstash.py": "http",
+}
+
+#: non-connector infrastructure under io/ (no external write entrypoints
+#: of their own)
+SKIP = {"__init__.py", "_gated.py", "_object_scanner.py", "delivery.py"}
+
+
+def _calls_in(fn: ast.FunctionDef) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                out.add(f.attr)
+    return out
+
+
+def check_module(path: str) -> list[str]:
+    """Violations in one io/ module: write entrypoints that neither call
+    deliver() nor delegate to a delivery-routed sibling."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    fname = os.path.basename(path)
+    delegate_to = DELEGATORS.get(fname)
+    problems: list[str] = []
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in ENTRYPOINTS:
+            continue
+        calls = _calls_in(node)
+        if "deliver" in calls:
+            continue
+        if delegate_to is not None and "write" in calls:
+            continue
+        how = (
+            "calls subscribe() directly"
+            if "subscribe" in calls
+            else "never calls deliver()"
+        )
+        problems.append(f"{fname}:{node.lineno} {node.name}() {how}")
+    return problems
+
+
+def check_all(io_dir: str | None = None) -> dict[str, list[str]]:
+    io_dir = io_dir or IO_DIR
+    out: dict[str, list[str]] = {}
+    for fn in sorted(os.listdir(io_dir)):
+        if not fn.endswith(".py") or fn in SKIP:
+            continue
+        problems = check_module(os.path.join(io_dir, fn))
+        if problems:
+            out[fn] = problems
+    # http is a package: its writer lives in http/__init__.py
+    http_init = os.path.join(io_dir, "http", "__init__.py")
+    if os.path.exists(http_init):
+        problems = check_module(http_init)
+        if problems:
+            out["http/__init__.py"] = problems
+    return out
+
+
+def main() -> int:
+    bad = check_all()
+    if bad:
+        print(
+            "check_sink_paths FAILED: naked sink writes (not routed "
+            "through io/delivery):",
+            file=sys.stderr,
+        )
+        for mod, problems in sorted(bad.items()):
+            for p in problems:
+                print(f"  {p}", file=sys.stderr)
+        print(
+            "route them through pathway_tpu.io.delivery.deliver() — see "
+            "README 'Exactly-once output & sink resilience'",
+            file=sys.stderr,
+        )
+        return 1
+    n = sum(
+        1
+        for fn in os.listdir(IO_DIR)
+        if fn.endswith(".py") and fn not in SKIP
+    )
+    print(f"check_sink_paths OK ({n} io modules scanned)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

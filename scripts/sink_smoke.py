@@ -1,0 +1,397 @@
+"""Sink smoke test: the exactly-once output plane under chaos.
+
+The output-plane analog of ``chaos_smoke.py``: a persisted streaming
+wordcount delivers through the transactional sink layer
+(``io/delivery.py``) while seeded ``sink.write`` chaos and hard SIGKILLs
+land on it. Scenarios (each standalone-assertable):
+
+- **clean** — baseline: the delivered jsonlines multiset of
+  ``(word, count, diff)`` rows and the exact final counts.
+- **flaky** — seeded ``sink.write`` fail/delay chaos on every other
+  attempt: the run converges to a multiset EQUAL to clean (retries
+  redeliver, the ack log prevents duplicates), with retries > 0 on the
+  sink's metrics.
+- **kill** — SIGKILL mid-stream (after sink acks landed, before the next
+  offset commit), then a restart of the same program: recovery restores
+  at-or-below the ack floor, replays, skips acked batches — final
+  multiset EQUAL to clean, zero duplicate deliveries.
+- **dlq** — seeded reject-nth poison: the rejected row lands in the
+  dead-letter queue with its original content and error (never a silent
+  drop: delivered ∪ DLQ == clean), and ``pathway_sink_dlq_total`` > 0.
+- **outage** — in-process: a down sink degrades to BOUNDED buffering
+  that blocks the producer (backpressure), opens the breaker, and
+  drains fully — exactly once, in order — when the sink recovers.
+- **sharded** — the 2-thread run (sink callbacks gather to worker 0)
+  produces the same multiset.
+
+Usable standalone (``python scripts/sink_smoke.py`` → exit 0/1) and as a
+tier-1 test (``tests/test_sink_smoke.py`` imports :func:`run_smoke`).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXPECTED = {"foo": 10, "bar": 5, "baz": 5}
+
+_PROGRAM = """
+import json, os, sys, time
+
+import pathway_tpu as pw
+from pathway_tpu.persistence import Backend, Config
+
+out_path, pstate = sys.argv[1], sys.argv[2]
+WORDS = ["foo", "bar", "foo", "baz"] * 5
+
+
+class S(pw.io.python.ConnectorSubject):
+    def run(self):
+        for w in WORDS:
+            self.next(word=w)
+            self.commit()
+            time.sleep(float(os.environ.get("SMOKE_ROW_SLEEP_S", "0.01")))
+
+
+t = pw.io.python.read(
+    S(), schema=pw.schema_from_types(word=str), name="words",
+    autocommit_ms=None,
+)
+counts = t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+pw.io.jsonlines.write(counts, out_path, name="out")
+cfg = Config.simple_config(Backend.filesystem(pstate), snapshot_interval_ms=15)
+pw.run(persistence_config=cfg)
+
+from pathway_tpu.io.delivery import sink_stats_snapshot
+
+stats_path = os.environ.get("SMOKE_STATS_PATH")
+if stats_path:
+    with open(stats_path, "w") as f:
+        json.dump(sink_stats_snapshot(), f)
+"""
+
+
+def _rows(path: str) -> list[tuple[str, int, int]]:
+    out = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)  # delivered files are NEVER torn:
+            # the fs adapter truncates to the last acked byte on recovery
+            out.append((obj["word"], int(obj["c"]), int(obj["diff"])))
+    return out
+
+
+def _multiset(rows) -> collections.Counter:
+    return collections.Counter(rows)
+
+
+def _finals(rows) -> dict[str, int]:
+    finals: dict[str, int] = {}
+    net: dict[tuple[str, int], int] = collections.defaultdict(int)
+    for w, c, d in rows:
+        net[(w, c)] += d
+    for (w, c), n in net.items():
+        if n > 0:
+            finals[w] = max(finals.get(w, 0), c)
+    return finals
+
+
+def _run_program(workdir: str, tag: str, env_extra: dict | None = None,
+                 expect_kill: bool = False, timeout: float = 120.0,
+                 threads: int = 1) -> tuple[str, str, int]:
+    prog = os.path.join(workdir, "prog.py")
+    if not os.path.exists(prog):
+        with open(prog, "w") as f:
+            f.write(textwrap.dedent(_PROGRAM))
+    out = os.path.join(workdir, f"{tag}.jsonl")
+    stats = os.path.join(workdir, f"{tag}.stats.json")
+    pstate = os.path.join(workdir, f"{tag}-pstate")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo_root,
+        "PATHWAY_THREADS": str(threads),
+        "SMOKE_STATS_PATH": stats,
+        "PATHWAY_SINK_DLQ_DIR": os.path.join(workdir, f"{tag}-dlq"),
+        "PATHWAY_SINK_RETRY_FIRST_DELAY_MS": "5",
+        "PATHWAY_SINK_RETRY_JITTER_MS": "2",
+        "PATHWAY_SINK_BREAKER_COOLDOWN_S": "0.05",
+        **(env_extra or {}),
+    }
+    p = subprocess.Popen(
+        [sys.executable, prog, out, pstate], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    if expect_kill:
+        # wait until sink output is live (acks have landed), then SIGKILL
+        # mid-stream: the death lands between sink acks and whatever
+        # offset commit would have come next
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(_rows(out)) >= 8:
+                break
+            if p.poll() is not None:
+                raise AssertionError(
+                    f"[{tag}] program finished before the kill:\n"
+                    + p.stdout.read().decode(errors="replace")
+                )
+            time.sleep(0.01)
+        else:
+            raise AssertionError(f"[{tag}] no output before kill deadline")
+        os.kill(p.pid, signal.SIGKILL)
+        p.wait(timeout=30)
+        return out, stats, p.returncode
+    try:
+        stdout, _ = p.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        stdout, _ = p.communicate()
+        raise AssertionError(
+            f"[{tag}] program timed out\n" + stdout.decode(errors="replace")
+        )
+    if p.returncode != 0:
+        raise AssertionError(
+            f"[{tag}] program failed rc={p.returncode}\n"
+            + stdout.decode(errors="replace")
+        )
+    return out, stats, p.returncode
+
+
+def _assert_no_duplicates(rows, tag: str) -> None:
+    """Every (word, count, diff) event is unique in a wordcount stream —
+    any duplicate is a double delivery."""
+    dupes = [k for k, n in _multiset(rows).items() if n > 1]
+    assert not dupes, f"[{tag}] duplicate deliveries: {dupes}"
+
+
+def scenario_clean(workdir: str) -> collections.Counter:
+    out, stats, _ = _run_program(workdir, "clean")
+    rows = _rows(out)
+    assert _finals(rows) == EXPECTED, f"[clean] finals {_finals(rows)}"
+    _assert_no_duplicates(rows, "clean")
+    st = json.load(open(stats))
+    assert st["out"]["delivered_rows_total"] == len(rows), st
+    return _multiset(rows)
+
+
+def scenario_flaky(workdir: str, baseline: collections.Counter) -> dict:
+    plan = {"seed": 7, "faults": [
+        {"site": "sink.write", "action": "fail", "prob": 0.4,
+         "key_prefix": "out", "run": -1},
+        {"site": "sink.write", "action": "delay", "prob": 0.1,
+         "delay_s": 0.01, "run": -1},
+    ]}
+    out, stats, _ = _run_program(
+        workdir, "flaky", env_extra={"PATHWAY_FAULT_PLAN": json.dumps(plan)}
+    )
+    rows = _rows(out)
+    assert _multiset(rows) == baseline, (
+        f"[flaky] delivered multiset diverged: "
+        f"missing={baseline - _multiset(rows)} "
+        f"extra={_multiset(rows) - baseline}"
+    )
+    _assert_no_duplicates(rows, "flaky")
+    st = json.load(open(stats))
+    assert st["out"]["retries_total"] > 0, st
+    assert st["out"]["chaos_injections_total"] > 0, st
+    return {"retries": st["out"]["retries_total"]}
+
+
+def scenario_kill(workdir: str, baseline: collections.Counter) -> dict:
+    out, _, rc = _run_program(workdir, "kill", expect_kill=True)
+    assert rc == -signal.SIGKILL, f"[kill] rc={rc}"
+    mid_rows = _rows(out)
+    assert mid_rows, "[kill] kill landed before any delivery"
+    # restart the same program against the same store + output file
+    prog = os.path.join(workdir, "prog.py")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo_root,
+        "PATHWAY_THREADS": "1",
+        "PATHWAY_SINK_DLQ_DIR": os.path.join(workdir, "kill-dlq"),
+        "SMOKE_STATS_PATH": os.path.join(workdir, "kill.stats.json"),
+    }
+    p = subprocess.run(
+        [sys.executable, prog, out, os.path.join(workdir, "kill-pstate")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        timeout=120,
+    )
+    assert p.returncode == 0, (
+        "[kill] restart failed\n" + p.stdout.decode(errors="replace")
+    )
+    rows = _rows(out)
+    assert _multiset(rows) == baseline, (
+        f"[kill] multiset diverged after recovery: "
+        f"missing={baseline - _multiset(rows)} "
+        f"extra={_multiset(rows) - baseline}"
+    )
+    _assert_no_duplicates(rows, "kill")
+    assert _finals(rows) == EXPECTED
+    return {"rows_before_kill": len(mid_rows), "rows_total": len(rows)}
+
+
+def scenario_dlq(workdir: str, baseline: collections.Counter) -> dict:
+    plan = {"seed": 3, "faults": [
+        {"site": "sink.write", "action": "reject", "nth": 4,
+         "key_prefix": "out"},
+    ]}
+    out, stats, _ = _run_program(
+        workdir, "dlq", env_extra={"PATHWAY_FAULT_PLAN": json.dumps(plan)}
+    )
+    rows = _rows(out)
+    dlq_path = os.path.join(workdir, "dlq-dlq", "out.jsonl")
+    assert os.path.exists(dlq_path), "[dlq] no dead-letter file"
+    dlq_rows = []
+    with open(dlq_path) as f:
+        for line in f:
+            entry = json.loads(line)
+            assert entry["sink"] == "out"
+            assert "error" in entry and "reject" in entry["error"], entry
+            assert "stamp" in entry and len(entry["stamp"]) == 3, entry
+            r = entry["row"]
+            dlq_rows.append((r["word"], int(r["c"]), int(r["diff"])))
+    assert dlq_rows, "[dlq] dead-letter file empty"
+    # no silent drop: delivered + dead-lettered == the clean multiset
+    union = _multiset(rows) + _multiset(dlq_rows)
+    assert union == baseline, (
+        f"[dlq] delivered ∪ DLQ diverged from clean: "
+        f"missing={baseline - union} extra={union - baseline}"
+    )
+    st = json.load(open(stats))
+    assert st["out"]["dlq_total"] >= 1, st
+    return {"dlq_rows": len(dlq_rows)}
+
+
+def scenario_outage() -> dict:
+    """In-process: a down sink → bounded queue → blocked producer
+    (backpressure) → breaker open; recovery → full in-order drain."""
+    import threading
+
+    import numpy as np
+
+    from pathway_tpu.engine.delta import Delta
+    from pathway_tpu.io.delivery import (
+        CallableAdapter,
+        DeliverySink,
+        RetryPolicy,
+        _reset_stats_for_tests,
+    )
+
+    _reset_stats_for_tests()
+    down = threading.Event()
+    down.set()
+    delivered: list[int] = []
+
+    def write_batch(batch):
+        if down.is_set():
+            raise ConnectionError("sink down")
+        delivered.append(batch.time)
+
+    with tempfile.TemporaryDirectory() as td:
+        sink = DeliverySink(
+            CallableAdapter(write_batch, "outage"), "outage",
+            policy=RetryPolicy(first_delay_ms=2, jitter_ms=0, max_retries=1),
+            dlq=None, queue_batches=4,
+        )
+        sink._breaker.cooldown_s = 0.02
+        sink.dlq.root = td  # keep any accidental DLQ writes in the tmpdir
+
+        def batch(t):
+            return Delta(
+                keys=np.arange(1, dtype=np.uint64),
+                data={"x": np.asarray([t])},
+                diffs=np.ones(1, dtype=np.int64),
+            )
+
+        n_total = 12
+        enq_done = threading.Event()
+
+        def producer():
+            for t in range(2, 2 + 2 * n_total, 2):
+                sink.on_batch(t, batch(t))
+            enq_done.set()
+
+        prod = threading.Thread(target=producer, daemon=True)
+        prod.start()
+        # the producer must BLOCK: bounded queue + down sink
+        time.sleep(1.0)
+        assert not enq_done.is_set(), "producer was never backpressured"
+        depth = sink.stats.queue_depth
+        assert depth <= 4, f"queue grew past its bound: {depth}"
+        assert sink.stats.breaker_open == 1, "breaker never opened"
+        assert sink.stats.breaker_opens_total >= 1
+        # sink recovers -> everything drains, exactly once, in order
+        down.clear()
+        assert enq_done.wait(timeout=30), "producer still blocked after recovery"
+        assert sink.drain(timeout=30), "queue did not drain after recovery"
+        sink.shutdown()
+        expected = list(range(2, 2 + 2 * n_total, 2))
+        assert delivered == expected, (delivered, expected)
+        assert sink.stats.breaker_open == 0, "breaker did not close"
+        return {"max_depth": depth, "retries": sink.stats.retries_total}
+
+
+def scenario_sharded(workdir: str, baseline: collections.Counter) -> dict:
+    out, stats, _ = _run_program(workdir, "sharded", threads=2)
+    rows = _rows(out)
+    assert _multiset(rows) == baseline, (
+        f"[sharded] multiset diverged: "
+        f"missing={baseline - _multiset(rows)} "
+        f"extra={_multiset(rows) - baseline}"
+    )
+    _assert_no_duplicates(rows, "sharded")
+    return {"rows": len(rows)}
+
+
+def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
+    own = workdir is None
+    if own:
+        td = tempfile.TemporaryDirectory(prefix="sink-smoke-")
+        workdir = td.name
+    report: dict = {}
+    try:
+        baseline = scenario_clean(workdir)
+        report["clean_events"] = sum(baseline.values())
+        report["flaky"] = scenario_flaky(workdir, baseline)
+        report["kill"] = scenario_kill(workdir, baseline)
+        report["dlq"] = scenario_dlq(workdir, baseline)
+        report["outage"] = scenario_outage()
+        report["sharded"] = scenario_sharded(workdir, baseline)
+        report["ok"] = True
+        if verbose:
+            print(json.dumps(report, indent=2))
+        return report
+    finally:
+        if own:
+            td.cleanup()
+
+
+def main() -> int:
+    try:
+        run_smoke(verbose=True)
+    except AssertionError as e:
+        print(f"sink_smoke FAILED: {e}", file=sys.stderr)
+        return 1
+    print("sink_smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+"""Tier-1 wiring for ``scripts/check_sink_paths.py``: every io/ sink
+write entrypoint routes through the delivery layer, and the checker
+itself catches a naked write."""
+
+from __future__ import annotations
+
+import os
+import sys
+import textwrap
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ),
+)
+
+import check_sink_paths  # noqa: E402
+
+
+def test_no_naked_sink_writes():
+    bad = check_sink_paths.check_all()
+    assert not bad, (
+        "io/ sinks bypass the delivery layer (retries/acks/DLQ):\n"
+        + "\n".join(p for ps in bad.values() for p in ps)
+    )
+
+
+def test_checker_catches_naked_subscribe(tmp_path):
+    mod = tmp_path / "naked.py"
+    mod.write_text(textwrap.dedent("""
+        def write(table, target):
+            from . import subscribe
+            subscribe(table, on_change=lambda **kw: None)
+    """))
+    problems = check_sink_paths.check_module(str(mod))
+    assert len(problems) == 1
+    assert "subscribe" in problems[0]
+
+
+def test_checker_accepts_deliver_and_delegation(tmp_path):
+    mod = tmp_path / "fs.py"
+    mod.write_text(textwrap.dedent("""
+        def write(table, target):
+            from .delivery import deliver
+            deliver(table, lambda: None, name="x")
+    """))
+    assert check_sink_paths.check_module(str(mod)) == []
+    wrapper = tmp_path / "csv.py"
+    wrapper.write_text(textwrap.dedent("""
+        from . import fs
+        def write(table, target, **kw):
+            fs.write(table, target, format="csv", **kw)
+    """))
+    assert check_sink_paths.check_module(str(wrapper)) == []

@@ -1,0 +1,726 @@
+"""Unit tests for the exactly-once output plane (``io/delivery.py``):
+RetryPolicy backoff, circuit breaker, DLQ routing, ack-cursor recovery
+skip, the commit-boundary release protocol, the sink.write chaos gate,
+and the recovery-floor math the executor uses to pick a snapshot."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.delta import Delta
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io.delivery import (
+    CallableAdapter,
+    DeadLetterQueue,
+    DeliveryManager,
+    DeliverySink,
+    RetryPolicy,
+    SinkRejectedError,
+    _reset_stats_for_tests,
+    _sanitize,
+    sink_stats_snapshot,
+)
+from pathway_tpu.persistence.backends import MemoryBackend
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    G.clear()
+    _reset_stats_for_tests()
+    yield
+    G.clear()
+    _reset_stats_for_tests()
+
+
+def _batch(t: int, vals: list[int]) -> Delta:
+    return Delta(
+        keys=np.arange(len(vals), dtype=np.uint64),
+        data={"x": np.asarray(vals)},
+        diffs=np.ones(len(vals), dtype=np.int64),
+    )
+
+
+def _sink(fn, tmp_path, *, backend=None, transactional=False,
+          policy=None, queue_batches=8, name="t") -> DeliverySink:
+    return DeliverySink(
+        CallableAdapter(fn, name), name,
+        policy=policy or RetryPolicy(first_delay_ms=1, jitter_ms=0,
+                                     max_retries=2),
+        backend=backend,
+        transactional=transactional,
+        dlq=DeadLetterQueue(str(tmp_path / "dlq")),
+        queue_batches=queue_batches,
+    )
+
+
+# -- RetryPolicy ---------------------------------------------------------
+
+
+def test_retry_policy_backoff_shape():
+    p = RetryPolicy(first_delay_ms=100, backoff_factor=3.0, jitter_ms=0)
+    assert p.delay_s(1) == pytest.approx(0.1)
+    assert p.delay_s(2) == pytest.approx(0.3)
+    assert p.delay_s(3) == pytest.approx(0.9)
+    assert p.attempts() == 6  # max_retries=5 default
+
+
+def test_retry_policy_jitter_bounded():
+    import random
+
+    p = RetryPolicy(first_delay_ms=10, jitter_ms=50)
+    rng = random.Random(1)
+    for _ in range(50):
+        d = p.delay_s(1, rng)
+        assert 0.01 <= d <= 0.06
+
+
+def test_retry_policy_http_reexport():
+    from pathway_tpu.io.http import RetryPolicy as HttpPolicy
+
+    assert HttpPolicy is RetryPolicy
+
+
+def test_sanitize():
+    assert _sanitize("fs-/tmp/out file.csv") == "fs-_tmp_out_file.csv"
+    assert _sanitize("///") == "sink"
+
+
+# -- immediate-mode delivery: retries, DLQ, breaker ----------------------
+
+
+def test_transient_failures_retry_then_deliver_once(tmp_path):
+    calls = []
+
+    def fn(batch):
+        calls.append(batch.time)
+        if len(calls) <= 2:
+            raise ConnectionError("transient")
+
+    s = _sink(fn, tmp_path)
+    s.on_batch(2, _batch(2, [1]))
+    assert s.drain(timeout=10)
+    s.shutdown()
+    assert calls == [2, 2, 2]  # two failures, one success — delivered once
+    assert s.stats.retries_total == 2
+    assert s.stats.delivered_total == 1
+
+
+def test_reject_routes_rows_to_dlq_and_delivers_rest(tmp_path):
+    delivered = []
+
+    def fn(batch):
+        vals = list(batch.delta.data["x"])
+        if 13 in vals:
+            raise SinkRejectedError("bad row", row_indices=[vals.index(13)])
+        delivered.extend(vals)
+
+    s = _sink(fn, tmp_path, name="rj")
+    s.on_batch(2, _batch(2, [7, 13, 9]))
+    assert s.drain(timeout=10)
+    s.shutdown()
+    assert sorted(delivered) == [7, 9]
+    assert s.stats.dlq_total == 1
+    entries = [
+        json.loads(line)
+        for line in open(tmp_path / "dlq" / "rj.jsonl")
+    ]
+    assert len(entries) == 1
+    assert entries[0]["row"]["x"] == 13
+    assert entries[0]["row"]["diff"] == 1
+    assert "bad row" in entries[0]["error"]
+    assert entries[0]["stamp"][2] == 2  # boundary_seq = tick time
+
+
+def test_whole_batch_reject_is_fully_dead_lettered_and_acked(tmp_path):
+    def fn(batch):
+        raise SinkRejectedError("all bad")
+
+    s = _sink(fn, tmp_path, name="allbad")
+    s.on_batch(4, _batch(4, [1, 2]))
+    assert s.drain(timeout=10)
+    s.shutdown()
+    assert s.stats.dlq_total == 2
+    assert s.acked_time == 4  # accounted for: recovery must not re-deliver
+
+
+def test_breaker_opens_and_recovers(tmp_path):
+    down = threading.Event()
+    down.set()
+    delivered = []
+
+    def fn(batch):
+        if down.is_set():
+            raise ConnectionError("down")
+        delivered.append(batch.time)
+
+    s = _sink(fn, tmp_path, name="brk",
+              policy=RetryPolicy(first_delay_ms=1, jitter_ms=0,
+                                 max_retries=0))
+    s._breaker.cooldown_s = 0.02
+    s._breaker.threshold = 2
+    s.on_batch(2, _batch(2, [1]))
+    deadline = time.monotonic() + 10
+    while s.stats.breaker_open == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert s.stats.breaker_open == 1
+    assert s.stats.breaker_opens_total >= 1
+    down.clear()
+    assert s.drain(timeout=10)
+    s.shutdown()
+    assert delivered == [2]
+    assert s.stats.breaker_open == 0
+
+
+def test_timeout_watchdog_turns_hang_into_retry(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_SINK_TIMEOUT_S", "0.1")
+    calls = []
+
+    def fn(batch):
+        calls.append(1)
+        if len(calls) == 1:
+            time.sleep(30)  # wedged external client
+        return None
+
+    s = _sink(fn, tmp_path, name="hang")
+    assert s.timeout_s == pytest.approx(0.1)
+    s.on_batch(2, _batch(2, [1]))
+    assert s.drain(timeout=15)
+    s.shutdown()
+    assert len(calls) == 2
+    assert s.stats.delivered_total == 1
+
+
+# -- ack cursor / recovery skip ------------------------------------------
+
+
+def test_ack_cursor_persists_and_skips_replayed_batches(tmp_path):
+    backend = MemoryBackend()
+    delivered = []
+
+    def fn(batch):
+        delivered.append(batch.time)
+
+    s = _sink(fn, tmp_path, backend=backend, transactional=True, name="ack")
+    # initial cursor is stamped at construction: floor -1, nothing acked
+    doc = json.loads(backend.get_value("delivery/ack"))
+    assert doc["acked_time"] == -1
+    s.on_batch(2, _batch(2, [1]))
+    s.on_batch(4, _batch(4, [2]))
+    s.release(4)
+    assert s.drain(timeout=10, bump_to=6)
+    s.shutdown()
+    assert delivered == [2, 4]
+    doc = json.loads(backend.get_value("delivery/ack"))
+    assert doc["acked_time"] == 6  # heartbeat bump to the commit tick
+    assert doc["worker"] == 0
+
+    # "restarted" sink over the same backend: replayed batches at or
+    # below the cursor are skipped, fresh ones deliver
+    delivered2 = []
+    s2 = _sink(lambda b: delivered2.append(b.time), tmp_path,
+               backend=backend, transactional=True, name="ack")
+    assert s2.recovery_floor() == 6
+    s2.on_batch(2, _batch(2, [1]))   # replay — skipped
+    s2.on_batch(4, _batch(4, [2]))   # replay — skipped
+    s2.on_batch(8, _batch(8, [3]))   # fresh
+    s2.release_all()
+    assert s2.drain(timeout=10)
+    s2.shutdown()
+    assert delivered2 == [8]
+
+
+def test_transactional_batches_wait_for_release(tmp_path):
+    backend = MemoryBackend()
+    delivered = []
+    s = _sink(lambda b: delivered.append(b.time), tmp_path,
+              backend=backend, transactional=True, name="rel")
+    s.on_batch(2, _batch(2, [1]))
+    s.on_batch(4, _batch(4, [2]))
+    time.sleep(0.1)
+    assert delivered == []  # input not committed yet — nothing delivered
+    s.release(2)
+    assert s.drain(timeout=10)
+    assert delivered == [2]
+    s.release(4)
+    assert s.drain(timeout=10)
+    s.shutdown()
+    assert delivered == [2, 4]
+
+
+def test_manager_commit_protocol_and_floor(tmp_path):
+    backend = MemoryBackend()
+    mgr = DeliveryManager(worker_id=0)
+    delivered = []
+    s = _sink(lambda b: delivered.append(b.time), tmp_path,
+              backend=backend, transactional=True, name="mgr")
+    mgr.add(s)
+    assert mgr.recovery_floor() == -1
+    s.on_batch(2, _batch(2, [1]))
+    mgr.pre_commit_barrier()  # nothing released yet — no-op
+    mgr.on_commit(2)
+    assert delivered == [2]
+    assert mgr.recovery_floor() == 2
+    s.on_batch(4, _batch(4, [2]))
+    mgr.on_commit(4)
+    assert mgr.recovery_floor() == 4
+    mgr.finish()
+    assert delivered == [2, 4]
+
+
+def test_manager_want_early_commit(tmp_path):
+    mgr = DeliveryManager(worker_id=0)
+    s = _sink(lambda b: None, tmp_path, backend=MemoryBackend(),
+              transactional=True, queue_batches=2, name="early")
+    mgr.add(s)
+    assert not mgr.want_early_commit()
+    s.on_batch(2, _batch(2, [1]))
+    s.on_batch(4, _batch(4, [1]))
+    assert mgr.want_early_commit()
+    mgr.on_commit(4)
+    assert not mgr.want_early_commit()
+    mgr.finish()
+
+
+# -- sink.write chaos gate ----------------------------------------------
+
+
+def _armed(plan_doc):
+    from pathway_tpu.chaos import injector as inj
+    from pathway_tpu.chaos.plan import FaultPlan
+
+    return inj.arm(FaultPlan.from_dict(plan_doc), run=0)
+
+
+def test_chaos_fail_nth_is_retried_exactly_once(tmp_path):
+    from pathway_tpu.chaos import injector as inj
+
+    _armed({"seed": 1, "faults": [
+        {"site": "sink.write", "action": "fail", "nth": 1},
+    ]})
+    try:
+        calls = []
+        s = _sink(lambda b: calls.append(b.time), tmp_path, name="cf")
+        s.on_batch(2, _batch(2, [1]))
+        assert s.drain(timeout=10)
+        s.shutdown()
+        assert calls == [2]
+        assert s.stats.retries_total == 1
+        assert s.stats.chaos_injections_total == 1
+    finally:
+        inj.disarm()
+
+
+def test_chaos_reject_dead_letters_first_row(tmp_path):
+    from pathway_tpu.chaos import injector as inj
+
+    _armed({"seed": 1, "faults": [
+        {"site": "sink.write", "action": "reject", "nth": 1,
+         "key_prefix": "cr"},
+    ]})
+    try:
+        delivered = []
+        s = _sink(lambda b: delivered.extend(b.delta.data["x"]),
+                  tmp_path, name="cr")
+        s.on_batch(2, _batch(2, [5, 6]))
+        assert s.drain(timeout=10)
+        s.shutdown()
+        assert sorted(delivered) == [6]
+        assert s.stats.dlq_total == 1
+    finally:
+        inj.disarm()
+
+
+def test_chaos_torn_with_rollback_never_duplicates(tmp_path):
+    """fs-adapter-style rollback: the torn half-batch is undone before
+    the retry, so the delivered file carries each row exactly once."""
+    from pathway_tpu.chaos import injector as inj
+
+    _armed({"seed": 1, "faults": [
+        {"site": "sink.write", "action": "torn", "nth": 1},
+    ]})
+    try:
+        lines: list[int] = []
+
+        def fn(batch):
+            # fs-style: append rows, return the post-write position as
+            # the resume token (acked by the delivery layer on success)
+            lines.extend(int(v) for v in batch.delta.data["x"])
+            return len(lines)
+
+        def rollback(resume_token=None):
+            del lines[int(resume_token or 0):]
+
+        adapter = CallableAdapter(fn, "torn")
+        adapter.rollback = rollback
+        s = DeliverySink(
+            adapter, "torn",
+            policy=RetryPolicy(first_delay_ms=1, jitter_ms=0, max_retries=2),
+            dlq=DeadLetterQueue(str(tmp_path / "dlq")),
+        )
+        s.on_batch(2, _batch(2, [1, 2, 3, 4]))
+        assert s.drain(timeout=10)
+        s.shutdown()
+        assert lines == [1, 2, 3, 4]
+    finally:
+        inj.disarm()
+
+
+# -- review-hardening regressions ----------------------------------------
+
+
+def test_end_time_batch_skips_when_already_acked(tmp_path):
+    """A kill after the END_TIME flush batch acked must not re-deliver
+    the regenerated END batch on restart."""
+    backend = MemoryBackend()
+    END = 1 << 62
+    delivered = []
+    s = _sink(lambda b: delivered.append(b.time), tmp_path,
+              backend=backend, transactional=True, name="endt")
+    s.on_batch(END, _batch(END, [1]))
+    s.release_all()
+    assert s.drain(timeout=10)
+    s.shutdown()
+    assert delivered == [END]
+    s2 = _sink(lambda b: delivered.append(("dup", b.time)), tmp_path,
+               backend=backend, transactional=True, name="endt")
+    assert s2.acked_time == END
+    s2.on_batch(END, _batch(END, [1]))  # regenerated on restart — skipped
+    s2.release_all()
+    assert s2.drain(timeout=10)
+    s2.shutdown()
+    assert delivered == [END]
+
+
+def test_on_end_drain_timeout_raises_not_drops(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_SINK_DRAIN_TIMEOUT_S", "0.2")
+
+    def fn(batch):
+        raise ConnectionError("down forever")
+
+    s = _sink(fn, tmp_path, name="stuck",
+              policy=RetryPolicy(first_delay_ms=1, jitter_ms=0,
+                                 max_retries=0))
+    s._breaker.cooldown_s = 0.01
+    s.on_batch(2, _batch(2, [1]))
+    with pytest.raises(RuntimeError, match="failed to drain"):
+        s.on_end()
+
+
+def test_duplicate_sink_names(tmp_path):
+    t = pw.debug.table_from_rows(pw.schema_from_types(a=int), [(1,)])
+    # DERIVED defaults de-collide with a deterministic suffix (two csv
+    # writes to files sharing a basename stay valid)
+    pw.io.csv.write(t, str(tmp_path / "a" / "out.csv"))
+    pw.io.csv.write(t, str(tmp_path / "b" / "out.csv"))
+    names = [s["delivery"]["name"] for s in G.sinks]
+    assert names == ["fs-out.csv", "fs-out.csv-2"]
+    # EXPLICIT duplicate names are refused (shared cursor = skipped rows)
+    pw.io.csv.write(t, str(tmp_path / "c" / "out.csv"), name="mine")
+    with pytest.raises(ValueError, match="already registered"):
+        pw.io.csv.write(t, str(tmp_path / "d" / "out.csv"), name="mine")
+
+
+def test_chaos_hang_is_cut_by_timeout_watchdog(tmp_path, monkeypatch):
+    """The hang action runs INSIDE the watchdog: with a timeout set, a
+    hung write turns into a retry instead of wedging the writer."""
+    from pathway_tpu.chaos import injector as inj
+
+    monkeypatch.setenv("PATHWAY_SINK_TIMEOUT_S", "0.1")
+    _armed({"seed": 1, "faults": [
+        {"site": "sink.write", "action": "hang", "nth": 1},
+    ]})
+    try:
+        delivered = []
+        s = _sink(lambda b: delivered.append(b.time), tmp_path, name="chang")
+        s.on_batch(2, _batch(2, [1]))
+        assert s.drain(timeout=15), "writer wedged on the chaos hang"
+        s.shutdown()
+        assert delivered == [2]
+        assert s.stats.retries_total >= 1
+    finally:
+        inj.disarm()
+
+
+def test_rescale_carries_delivery_cursors(tmp_path):
+    """A rescale must carry the sink ack cursors into the new epoch —
+    dropping them resets the recovery floor and re-delivers the replayed
+    tail (duplicate external output)."""
+    import json as _json
+    import time as _time_mod
+
+    from pathway_tpu.persistence import Backend, Config
+    from pathway_tpu.persistence.backends import FilesystemBackend
+    from pathway_tpu.rescale import rescale
+
+    out = tmp_path / "out.jsonl"
+    store = tmp_path / "store"
+
+    class S(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(12):
+                self.next(x=i)
+                self.commit()
+                _time_mod.sleep(0.01)
+
+    t = pw.io.python.read(
+        S(), schema=pw.schema_from_types(x=int), name="src",
+        autocommit_ms=None,
+    )
+    pw.io.jsonlines.write(t, str(out), name="resc-out")
+    cfg = Config.simple_config(
+        Backend.filesystem(str(store)), snapshot_interval_ms=10
+    )
+    pw.run(persistence_config=cfg)
+    root = FilesystemBackend(str(store))
+    before = [k for k in root.list_keys() if "delivery/resc-out" in k]
+    assert before, "run never wrote an ack cursor"
+    acked = _json.loads(root.get_value(before[0]))["acked_time"]
+    assert acked > 0
+    rescale(root, 2)
+    after = [k for k in root.list_keys() if "delivery/resc-out" in k]
+    assert after, "rescale dropped the delivery ack cursor"
+    assert all(k.startswith("epoch-1/") for k in after), after
+    carried = _json.loads(root.get_value(after[0]))["acked_time"]
+    assert carried == acked
+
+
+def test_top_merged_sinks_prefer_live_over_muted_zeros():
+    from pathway_tpu.observability.top import render_frame
+
+    doc = {
+        "process_id": 0,
+        "workers": {},
+        "sinks": {
+            "0": {"out": {"delivered_rows_total": 42.0, "queue_depth": 1.0}},
+            "1": {"out": {"delivered_rows_total": 0.0, "queue_depth": 0.0}},
+        },
+    }
+    frame = render_frame(doc, now=0.0)
+    assert "sink out: 42 row(s) delivered" in frame
+
+
+def test_drain_interrupted_by_stop_never_bumps_cursor(tmp_path):
+    """A shutdown racing a drain must not advance the durable cursor past
+    undelivered batches — recovery would skip them (lost rows)."""
+    backend = MemoryBackend()
+    hold = threading.Event()
+
+    def fn(batch):
+        hold.wait(10)  # sink wedged until released
+
+    s = _sink(fn, tmp_path, backend=backend, transactional=True, name="intr")
+    s.on_batch(2, _batch(2, [1]))
+    s.release_all()
+    done: list[bool] = []
+
+    def drainer():
+        done.append(s.drain(timeout=None, bump_to=99))
+
+    th = threading.Thread(target=drainer, daemon=True)
+    th.start()
+    time.sleep(0.2)
+    s._stop.set()  # teardown races the drain
+    th.join(timeout=10)
+    hold.set()
+    s.shutdown()
+    assert done == [False]
+    assert s.acked_time < 99  # no heartbeat past the undelivered batch
+    doc = json.loads(backend.get_value("delivery/intr"))
+    assert doc["acked_time"] < 99
+
+
+def test_kill_between_first_commit_and_drain_loses_nothing(tmp_path):
+    """The one reachable floor-below-all-snapshots window: die after the
+    FIRST metadata commit's snapshot write but before the post-commit
+    sink release/drain. Recovery must replay the input log from scratch
+    (restore nothing) so the never-released output still delivers."""
+    import subprocess
+    import sys as _sys
+    import textwrap
+
+    prog = tmp_path / "prog.py"
+    prog.write_text(textwrap.dedent("""
+        import os, sys, time
+        import pathway_tpu as pw
+        from pathway_tpu.persistence import Backend, Config
+
+        out, pstate = sys.argv[1], sys.argv[2]
+        if os.environ.get("DIE_AT_FIRST_RELEASE") == "1":
+            from pathway_tpu.io.delivery import DeliveryManager
+
+            def dying_on_commit(self, up_to_time):
+                # the metadata commit (snapshot included) just landed;
+                # die before any batch releases or acks
+                os._exit(17)
+
+            DeliveryManager.on_commit = dying_on_commit
+
+        class S(pw.io.python.ConnectorSubject):
+            def run(self):
+                for i in range(10):
+                    self.next(x=i)
+                    self.commit()
+                    time.sleep(0.01)
+
+        t = pw.io.python.read(
+            S(), schema=pw.schema_from_types(x=int), name="src",
+            autocommit_ms=None,
+        )
+        pw.io.jsonlines.write(t, out, name="out")
+        cfg = Config.simple_config(
+            Backend.filesystem(pstate), snapshot_interval_ms=20
+        )
+        pw.run(persistence_config=cfg)
+    """))
+    out = tmp_path / "o.jsonl"
+    env = {
+        **__import__("os").environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": __import__("os").path.dirname(
+            __import__("os").path.dirname(
+                __import__("os").path.abspath(__file__)
+            )
+        ),
+        "PATHWAY_THREADS": "1",
+        "PATHWAY_SINK_DLQ_DIR": str(tmp_path / "dlq"),
+    }
+    p1 = subprocess.run(
+        [_sys.executable, str(prog), str(out), str(tmp_path / "ps")],
+        env={**env, "DIE_AT_FIRST_RELEASE": "1"},
+        capture_output=True, timeout=120,
+    )
+    assert p1.returncode == 17, p1.stderr.decode(errors="replace")
+    assert not out.exists() or not out.read_text().strip()
+    p2 = subprocess.run(
+        [_sys.executable, str(prog), str(out), str(tmp_path / "ps")],
+        env=env, capture_output=True, timeout=120,
+    )
+    assert p2.returncode == 0, p2.stderr.decode(errors="replace")
+    rows = [json.loads(line)["x"] for line in out.open()]
+    assert sorted(rows) == list(range(10)), rows  # nothing lost, no dupes
+
+
+def test_cursor_transient_read_error_propagates(tmp_path):
+    """A transient backend error while loading the cursor must surface —
+    overwriting a good cursor with -1 would re-deliver the whole tail."""
+
+    class FlakyBackend(MemoryBackend):
+        def get_value(self, key):
+            raise OSError("EIO")
+
+    with pytest.raises(OSError, match="EIO"):
+        _sink(lambda b: None, tmp_path, backend=FlakyBackend(),
+              transactional=True, name="flaky-cur")
+
+
+def test_cursor_corrupt_blob_not_overwritten(tmp_path):
+    backend = MemoryBackend()
+    backend.put_value("delivery/corr", b"\xff not json")
+    s = _sink(lambda b: None, tmp_path, backend=backend,
+              transactional=True, name="corr")
+    assert s.acked_time == -1  # conservative floor in memory
+    # the evidence blob survives until the next real ack rewrites it
+    assert backend.get_value("delivery/corr") == b"\xff not json"
+    s.shutdown()
+
+
+def test_timeout_resets_adapter_before_retry(tmp_path, monkeypatch):
+    """A watchdog-abandoned write leaves a zombie thread inside the
+    adapter: the delivery layer must reset the adapter (on_timeout +
+    reopen) so the retry never shares live handles with the zombie."""
+    monkeypatch.setenv("PATHWAY_SINK_TIMEOUT_S", "0.1")
+    events = []
+    calls = [0]
+
+    def fn(batch):
+        calls[0] += 1
+        if calls[0] == 1:
+            time.sleep(5)  # zombie
+        events.append(("write", batch.time))
+
+    adapter = CallableAdapter(fn, "tz")
+    adapter.open = lambda tok: events.append(("open", tok))
+    adapter.on_timeout = lambda: events.append(("on_timeout",))
+    s = DeliverySink(
+        adapter, "tz",
+        policy=RetryPolicy(first_delay_ms=1, jitter_ms=0, max_retries=2),
+        dlq=DeadLetterQueue(str(tmp_path / "dlq")),
+    )
+    s.on_batch(2, _batch(2, [1]))
+    assert s.drain(timeout=15)
+    s.shutdown()
+    assert ("on_timeout",) in events
+    # reopened (with the last acked token, None here) before the retry
+    reset_ix = events.index(("on_timeout",))
+    assert ("open", None) in events[reset_ix:]
+    assert events[-1] == ("write", 2)
+
+
+def test_fs_adapter_on_timeout_reopen_keeps_file_exact(tmp_path):
+    from pathway_tpu.io.fs import _FsSinkAdapter
+
+    path = tmp_path / "o.csv"
+    a = _FsSinkAdapter(str(path), "csv", ["x"])
+    a.open(None)
+    tok = a.write_batch(SinkBatchStub(2, [1, 2]))
+    a.on_timeout()  # zombie cutoff: handles closed
+    a.open(tok)  # delivery reopens from the last acked token
+    a.write_batch(SinkBatchStub(4, [3]))
+    a.close()
+    lines = path.read_text().strip().splitlines()
+    assert lines == ["x,time,diff", "1,2,1", "2,2,1", "3,4,1"]
+
+
+class SinkBatchStub:
+    def __init__(self, t, vals):
+        from pathway_tpu.io.delivery import SinkBatch
+
+        self.time = t
+        self.delta = _batch(t, vals)
+
+    def __len__(self):
+        return len(self.delta)
+
+
+# -- stats plumbing ------------------------------------------------------
+
+
+def test_sink_stats_snapshot_surface(tmp_path):
+    s = _sink(lambda b: None, tmp_path, name="stats")
+    s.on_batch(2, _batch(2, [1, 2]))
+    assert s.drain(timeout=10)
+    s.shutdown()
+    snap = sink_stats_snapshot()
+    assert snap["stats"]["delivered_total"] == 1
+    assert snap["stats"]["delivered_rows_total"] == 2
+    assert snap["stats"]["acked_time"] == 2
+
+
+def test_fatal_writer_failure_surfaces_on_engine_thread(tmp_path):
+    s = _sink(lambda b: None, tmp_path, name="fatal")
+    s._failure = RuntimeError("writer died")
+    with pytest.raises(RuntimeError, match="delivery failed"):
+        s.on_batch(2, _batch(2, [1]))
+
+
+# -- end-to-end through pw.run (non-persisted immediate mode) ------------
+
+
+def test_pw_run_static_table_through_delivery(tmp_path):
+    out = tmp_path / "out.csv"
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int, b=str), [(1, "x"), (2, "y")]
+    )
+    pw.io.csv.write(t, str(out), name="e2e")
+    pw.run()
+    lines = out.read_text().strip().splitlines()
+    assert lines[0] == "a,b,time,diff"
+    assert len(lines) == 3
+    snap = sink_stats_snapshot()
+    assert snap["e2e"]["delivered_rows_total"] == 2

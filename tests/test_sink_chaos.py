@@ -1,0 +1,276 @@
+"""Client-sink resilience under ``sink.write`` chaos, against fake
+clients: mongodb, postgres and elasticsearch each prove
+
+- transient fail ×2 → delivered exactly once (the shared RetryPolicy
+  redelivers, the batch lands one time in the external system);
+- reject-nth → the poison row lands in the DLQ with its original
+  content and error, the rest of the batch still delivers, and nothing
+  is silently dropped.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import types
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.chaos import injector as inj
+from pathway_tpu.chaos.plan import FaultPlan
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io.delivery import _reset_stats_for_tests
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch, tmp_path):
+    monkeypatch.setenv("PATHWAY_SINK_DLQ_DIR", str(tmp_path / "dlq"))
+    monkeypatch.setenv("PATHWAY_SINK_RETRY_FIRST_DELAY_MS", "1")
+    monkeypatch.setenv("PATHWAY_SINK_RETRY_JITTER_MS", "0")
+    G.clear()
+    _reset_stats_for_tests()
+    inj.disarm()
+    yield
+    inj.disarm()
+    G.clear()
+    _reset_stats_for_tests()
+
+
+def _arm(faults):
+    inj.arm(FaultPlan.from_dict({"seed": 5, "faults": faults}), run=0)
+
+
+def _fail_twice(sink_prefix):
+    return [
+        {"site": "sink.write", "action": "fail", "nth": 1,
+         "key_prefix": sink_prefix},
+        {"site": "sink.write", "action": "fail", "nth": 2,
+         "key_prefix": sink_prefix},
+    ]
+
+
+def _reject_first(sink_prefix):
+    return [
+        {"site": "sink.write", "action": "reject", "nth": 1,
+         "key_prefix": sink_prefix},
+    ]
+
+
+def _dlq_entries(tmp_path, sink_name):
+    path = tmp_path / "dlq" / f"{sink_name}.jsonl"
+    assert path.exists(), f"no DLQ file at {path}"
+    return [json.loads(line) for line in path.open()]
+
+
+def _table(rows=3):
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(x=int, label=str),
+        [(i, f"row-{i}") for i in range(rows)],
+    )
+
+
+# -- mongodb -------------------------------------------------------------
+
+
+class _FakeCollection:
+    def __init__(self):
+        self.insert_many_calls: list[list[dict]] = []
+
+    def insert_many(self, docs):
+        self.insert_many_calls.append([dict(d) for d in docs])
+
+
+class _FakeMongoClient:
+    instances: list["_FakeMongoClient"] = []
+
+    def __init__(self, connection_string):
+        self._dbs: dict = {}
+        _FakeMongoClient.instances.append(self)
+
+    def __getitem__(self, name):
+        return self._dbs.setdefault(name, _FakeMongoDb())
+
+
+class _FakeMongoDb:
+    def __init__(self):
+        self._colls: dict = {}
+
+    def __getitem__(self, name):
+        return self._colls.setdefault(name, _FakeCollection())
+
+
+@pytest.fixture
+def fake_pymongo(monkeypatch):
+    mod = types.ModuleType("pymongo")
+    mod.MongoClient = _FakeMongoClient
+    _FakeMongoClient.instances = []
+    monkeypatch.setitem(sys.modules, "pymongo", mod)
+    yield mod
+
+
+def _mongo_docs():
+    coll = _FakeMongoClient.instances[-1]["db"]["events"]
+    return [d for call in coll.insert_many_calls for d in call]
+
+
+def test_mongodb_transient_fail_twice_delivered_once(fake_pymongo):
+    _arm(_fail_twice("mongodb"))
+    pw.io.mongodb.write(_table(), "mongodb://fake", "db", "events")
+    pw.run()
+    docs = _mongo_docs()
+    assert sorted(d["x"] for d in docs) == [0, 1, 2]  # once each, no dupes
+
+
+def test_mongodb_reject_goes_to_dlq(fake_pymongo, tmp_path):
+    _arm(_reject_first("mongo-sink"))
+    pw.io.mongodb.write(_table(), "mongodb://fake", "db", "events",
+                        name="mongo-sink")
+    pw.run()
+    docs = _mongo_docs()
+    entries = _dlq_entries(tmp_path, "mongo-sink")
+    assert len(entries) == 1
+    dead = entries[0]["row"]
+    assert "reject" in entries[0]["error"]
+    # no silent drop: delivered ∪ DLQ covers every input row exactly once
+    assert sorted([d["x"] for d in docs] + [dead["x"]]) == [0, 1, 2]
+    from pathway_tpu.io.delivery import sink_stats_snapshot
+
+    assert sink_stats_snapshot()["mongo-sink"]["dlq_total"] == 1
+
+
+# -- postgres ------------------------------------------------------------
+
+
+class _FakePgCursor:
+    def __init__(self, conn):
+        self._conn = conn
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    def execute(self, sql, params=None):
+        self._conn._staged.append((sql, list(params or [])))
+
+    def executemany(self, sql, rows):
+        for r in rows:
+            self._conn._staged.append((sql, list(r)))
+
+
+class _FakePgConn:
+    instances: list["_FakePgConn"] = []
+
+    def __init__(self):
+        self._staged: list = []
+        self.committed: list = []
+        self.rollbacks = 0
+        _FakePgConn.instances.append(self)
+
+    def cursor(self):
+        return _FakePgCursor(self)
+
+    def commit(self):
+        self.committed.extend(self._staged)
+        self._staged = []
+
+    def rollback(self):
+        self.rollbacks += 1
+        self._staged = []
+
+    def close(self):
+        pass
+
+
+@pytest.fixture
+def fake_psycopg(monkeypatch):
+    mod = types.ModuleType("psycopg")
+    mod.connect = lambda **kw: _FakePgConn()
+    _FakePgConn.instances = []
+    monkeypatch.setitem(sys.modules, "psycopg", mod)
+    yield mod
+
+
+def test_postgres_transient_fail_twice_delivered_once(fake_psycopg):
+    _arm(_fail_twice("postgres"))
+    pw.io.postgres.write(_table(), {}, "tbl")
+    pw.run()
+    conn = _FakePgConn.instances[-1]
+    xs = sorted(p[0] for _sql, p in conn.committed)
+    assert xs == [0, 1, 2]  # one committed transaction, no dupes
+
+
+def test_postgres_reject_goes_to_dlq(fake_psycopg, tmp_path):
+    _arm(_reject_first("pg-sink"))
+    pw.io.postgres.write(_table(), {}, "tbl", name="pg-sink")
+    pw.run()
+    conn = _FakePgConn.instances[-1]
+    entries = _dlq_entries(tmp_path, "pg-sink")
+    assert len(entries) == 1
+    xs = sorted(p[0] for _sql, p in conn.committed)
+    assert sorted(xs + [entries[0]["row"]["x"]]) == [0, 1, 2]
+    assert entries[0]["row"]["label"].startswith("row-")
+
+
+def test_postgres_write_snapshot_retries_rollback_server_side(fake_psycopg):
+    """A torn attempt must roll the SQL transaction back before the
+    retry: committed rows appear exactly once."""
+    _arm([{"site": "sink.write", "action": "torn", "nth": 1,
+           "key_prefix": "postgres"}])
+    pw.io.postgres.write_snapshot(_table(), {}, "tbl", ["x"])
+    pw.run()
+    conn = _FakePgConn.instances[-1]
+    assert conn.rollbacks >= 1
+    upserts = [p for sql, p in conn.committed if "INSERT" in sql]
+    assert sorted(p[0] for p in upserts) == [0, 1, 2]
+
+
+# -- elasticsearch -------------------------------------------------------
+
+
+class _FakeEs:
+    instances: list["_FakeEs"] = []
+
+    def __init__(self, **kwargs):
+        self.indexed: list[tuple[str, dict]] = []
+        _FakeEs.instances.append(self)
+
+    def index(self, index, document):
+        self.indexed.append((index, dict(document)))
+
+
+@pytest.fixture
+def fake_elasticsearch(monkeypatch):
+    mod = types.ModuleType("elasticsearch")
+    mod.Elasticsearch = _FakeEs
+    _FakeEs.instances = []
+    monkeypatch.setitem(sys.modules, "elasticsearch", mod)
+    yield mod
+
+
+def test_elasticsearch_transient_fail_twice_delivered_once(
+    fake_elasticsearch,
+):
+    _arm(_fail_twice("elasticsearch"))
+    pw.io.elasticsearch.write(_table(), host="http://x", index_name="idx")
+    pw.run()
+    es = _FakeEs.instances[-1]
+    assert sorted(d["x"] for _i, d in es.indexed) == [0, 1, 2]
+    assert all(i == "idx" for i, _d in es.indexed)
+
+
+def test_elasticsearch_reject_goes_to_dlq(fake_elasticsearch, tmp_path):
+    _arm(_reject_first("es-sink"))
+    pw.io.elasticsearch.write(
+        _table(), host="http://x", index_name="idx", name="es-sink"
+    )
+    pw.run()
+    es = _FakeEs.instances[-1]
+    entries = _dlq_entries(tmp_path, "es-sink")
+    assert len(entries) == 1
+    got = sorted(
+        [d["x"] for _i, d in es.indexed] + [entries[0]["row"]["x"]]
+    )
+    assert got == [0, 1, 2]

@@ -1,0 +1,61 @@
+"""Tier-1 wiring for the exactly-once output-plane smoke
+(``scripts/sink_smoke.py``): seeded flaky-sink and SIGKILL-mid-delivery
+runs are multiset-equal to a clean run with zero duplicate deliveries;
+a sink outage degrades to bounded buffering + backpressure and drains on
+recovery; seeded poison rows land in the dead-letter queue."""
+
+from __future__ import annotations
+
+import collections
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ),
+)
+
+import sink_smoke  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def smoke_dir(tmp_path_factory) -> str:
+    return str(tmp_path_factory.mktemp("sink-smoke"))
+
+
+@pytest.fixture(scope="module")
+def baseline(smoke_dir) -> collections.Counter:
+    # one clean run shared by every scenario: the multiset ground truth
+    return sink_smoke.scenario_clean(smoke_dir)
+
+
+def test_outage_backpressure_and_drain():
+    report = sink_smoke.scenario_outage()
+    assert report["max_depth"] <= 4
+    assert report["retries"] > 0
+
+
+def test_clean_and_flaky_multiset_equal(smoke_dir, baseline):
+    report = sink_smoke.scenario_flaky(smoke_dir, baseline)
+    assert report["retries"] > 0
+
+
+def test_sigkill_mid_delivery_no_double_deliver(smoke_dir, baseline):
+    report = sink_smoke.scenario_kill(smoke_dir, baseline)
+    assert 0 < report["rows_before_kill"] < report["rows_total"]
+
+
+def test_dlq_captures_poison_rows(smoke_dir, baseline):
+    report = sink_smoke.scenario_dlq(smoke_dir, baseline)
+    assert report["dlq_rows"] >= 1
+
+
+@pytest.mark.slow
+def test_sharded_delivery_multiset_equal(smoke_dir, baseline):
+    report = sink_smoke.scenario_sharded(smoke_dir, baseline)
+    assert report["rows"] == sum(baseline.values())

@@ -1,0 +1,295 @@
+"""Streaming chunked operator snapshots (ROADMAP PR-8 corner):
+``OperatorSnapshots.write_parts`` frames a parts iterator into chunks
+incrementally, spill-aware operators (GroupByReduce, Join/_SortedSide)
+stream spilled segments one at a time, and commit-time peak RSS stays
+budget-bounded instead of O(total state) — pinned by a regression test
+comparing the parts path against monolithic materialization."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine import spill
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.persistence.backends import FilesystemBackend, MemoryBackend
+from pathway_tpu.persistence.snapshots import OperatorSnapshots, read_op_state
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+# -- framing -------------------------------------------------------------
+
+
+def test_write_read_parts_roundtrip_multi_chunk():
+    backend = MemoryBackend()
+    ops = OperatorSnapshots(backend)
+    ops.CHUNK_BYTES = 256  # force many chunks
+    parts = [
+        {"head": True, "n": 3},
+        np.arange(100, dtype=np.int64),
+        b"x" * 1000,
+        ("tail", [1, 2, 3]),
+    ]
+    n = ops.write_parts(0, 7, iter(parts))
+    assert n > 1  # genuinely chunked
+    got = list(ops.read_parts(0, 7, n))
+    assert got[0] == parts[0]
+    np.testing.assert_array_equal(got[1], parts[1])
+    assert got[2] == parts[2]
+    assert got[3] == parts[3]
+
+
+def test_write_parts_zero_and_single_part():
+    backend = MemoryBackend()
+    ops = OperatorSnapshots(backend)
+    assert ops.write_parts(1, 2, iter([])) == 1  # one empty chunk
+    assert list(ops.read_parts(1, 2, 1)) == []
+    n = ops.write_parts(2, 2, iter(["only"]))
+    assert list(ops.read_parts(2, 2, n)) == ["only"]
+
+
+def test_write_parts_flushes_chunks_between_parts():
+    """The writer must flush chunks WHILE the generator still has parts
+    to produce — that interleaving is what bounds peak memory to one
+    part + one chunk instead of the whole state."""
+    backend = MemoryBackend()
+    ops = OperatorSnapshots(backend)
+    ops.CHUNK_BYTES = 1024
+    puts_at_yield: list[int] = []
+
+    def gen():
+        for _ in range(4):
+            puts_at_yield.append(len(backend.list_keys()))
+            yield b"y" * 4096  # each part spans multiple chunks
+
+    ops.write_parts(0, 1, gen())
+    # by the time part k is produced, earlier parts' chunks already landed
+    assert puts_at_yield[0] == 0
+    assert all(b > a for a, b in zip(puts_at_yield, puts_at_yield[1:])), (
+        puts_at_yield
+    )
+
+
+def test_read_parts_truncated_stream_raises():
+    backend = MemoryBackend()
+    ops = OperatorSnapshots(backend)
+    ops.CHUNK_BYTES = 128
+    n = ops.write_parts(0, 3, iter([b"a" * 500, b"b" * 500]))
+    with pytest.raises(EOFError, match="truncated"):
+        list(ops.read_parts(0, 3, n - 1))
+
+
+def test_read_op_state_legacy_monolithic_without_fmt():
+    """Old stores' descriptors (no "fmt") read through the monolithic
+    path — format compatibility across the PR boundary."""
+    from pathway_tpu.engine.executor import Node
+
+    backend = MemoryBackend()
+    ops = OperatorSnapshots(backend)
+    state = {"_live": {1: "a", 2: "b"}}
+    n = ops.write(4, 9, state)
+    desc = {"cls": "X", "at": 9, "chunks": n}
+    assert read_op_state(ops, 4, desc, Node) == state
+
+
+# -- spilled operators stream their segments -----------------------------
+
+
+def _run_spilled_groupby(tmp_path, monkeypatch, n_groups=6000, val_kb=1,
+                         n_batches=6):
+    """Stream a groupby whose dense arena spills under a tiny budget;
+    returns (runner, GroupByReduce node) with the engine state live."""
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    monkeypatch.setenv("PATHWAY_STATE_MEMORY_BUDGET_MB", "0.2")
+    monkeypatch.setenv(
+        "PATHWAY_STATE_SPILL_DIR", str(tmp_path / "spill")
+    )
+    spill._reset_for_tests()
+
+    class S(pw.io.python.ConnectorSubject):
+        def run(self):
+            pad = "v" * (val_kb * 1024)
+            bs = n_groups // n_batches
+            for start in range(0, n_groups, bs):
+                self.next_batch({
+                    "g": [f"group-{i}-{pad}" for i in range(start, start + bs)],
+                })
+                self.commit()
+
+    t = pw.io.python.read(
+        S(), schema=pw.schema_from_types(g=str), autocommit_ms=None,
+    )
+    counts = t.groupby(pw.this.g).reduce(pw.this.g, c=pw.reducers.count())
+    runner = GraphRunner()
+    caps = runner.run_tables(counts)
+    node = next(
+        n for n in runner.executor.nodes
+        if type(n).__name__ == "GroupByReduce"
+    )
+    assert len(caps[0].state._rows) == n_groups
+    return runner, node
+
+
+def test_groupby_parts_equivalent_to_monolithic(tmp_path, monkeypatch):
+    _, node = _run_spilled_groupby(tmp_path, monkeypatch, n_groups=6000)
+    try:
+        assert node._arena_cold, "arena never spilled — test is inert"
+        backend = MemoryBackend()
+        ops = OperatorSnapshots(backend)
+        n = ops.write_parts(0, 1, node.snapshot_state_parts())
+        desc = {"cls": "GroupByReduce", "at": 1, "chunks": n, "fmt": "parts"}
+        streamed = read_op_state(ops, 0, desc, type(node))
+        mono = node.snapshot_state()
+        assert streamed["dense"] == mono["dense"]
+        assert streamed["gerrs"] == mono["gerrs"]
+        assert streamed["_state"] == mono["_state"]
+        for key in ("_counts", "_gkey_by_slot", "_emitted"):
+            np.testing.assert_array_equal(
+                streamed["arena"][key], mono["arena"][key]
+            )
+        for group in ("_accs", "_prev", "_gvals"):
+            assert len(streamed["arena"][group]) == len(mono["arena"][group])
+            for a, b in zip(streamed["arena"][group], mono["arena"][group]):
+                if a is None or b is None:
+                    assert a is None and b is None
+                else:
+                    np.testing.assert_array_equal(a, b)
+    finally:
+        spill._reset_for_tests()
+
+
+def test_join_parts_equivalent_to_materialized(tmp_path, monkeypatch):
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    monkeypatch.setenv("PATHWAY_STATE_MEMORY_BUDGET_MB", "0.05")
+    monkeypatch.setenv(
+        "PATHWAY_STATE_SPILL_DIR", str(tmp_path / "spill")
+    )
+    spill._reset_for_tests()
+    try:
+
+        class L(pw.io.python.ConnectorSubject):
+            def run(self):
+                for start in range(0, 4000, 500):
+                    self.next_batch({
+                        "k": list(range(start, start + 500)),
+                        "a": [f"left-{i}" * 8 for i in range(start, start + 500)],
+                    })
+                    self.commit()
+
+        lt = pw.io.python.read(
+            L(), schema=pw.schema_from_types(k=int, a=str),
+            autocommit_ms=None,
+        )
+        rt = pw.debug.table_from_rows(
+            pw.schema_from_types(k=int, b=str),
+            [(i, f"right-{i}") for i in range(0, 4000, 4)],
+        )
+        joined = lt.join(rt, lt.k == rt.k).select(
+            pw.this.a, pw.this.b
+        )
+        runner = GraphRunner()
+        caps = runner.run_tables(joined)
+        assert len(caps[0].state._rows) == 1000
+        node = next(
+            n for n in runner.executor.nodes if type(n).__name__ == "Join"
+        )
+        spilled_sides = [
+            s for s in (getattr(node, "_cleft", None),
+                        getattr(node, "_cright", None))
+            if s is not None and s._spilled
+        ]
+        assert spilled_sides, "no join side spilled — test is inert"
+        backend = MemoryBackend()
+        ops = OperatorSnapshots(backend)
+        n = ops.write_parts(0, 1, node.snapshot_state_parts())
+        desc = {"cls": "Join", "at": 1, "chunks": n, "fmt": "parts"}
+        streamed = read_op_state(ops, 0, desc, type(node))
+        mono = node.snapshot_state()  # materializes via __getstate__ on pickle
+        import pickle
+
+        for f in ("_cleft", "_cright"):
+            if f not in mono:
+                continue
+            a = pickle.loads(pickle.dumps(streamed[f]))
+            b = pickle.loads(pickle.dumps(mono[f]))
+            assert len(a) == len(b)
+            assert len(a._runs) == len(b._runs)
+            for ra, rb in zip(a._runs, b._runs):
+                np.testing.assert_array_equal(ra[0], rb[0])
+                np.testing.assert_array_equal(ra[1], rb[1])
+                np.testing.assert_array_equal(ra[3], rb[3])
+    finally:
+        spill._reset_for_tests()
+
+
+# -- the RSS regression pin ----------------------------------------------
+
+
+def test_commit_peak_rss_streams_not_materializes(tmp_path, monkeypatch):
+    """Snapshotting a mostly-spilled operator must not materialize the
+    spilled state resident: the parts path's RSS growth stays well under
+    the monolithic path's (which loads every cold block + builds one
+    pickle of the whole state)."""
+    _, node = _run_spilled_groupby(
+        tmp_path, monkeypatch, n_groups=48_000, val_kb=1, n_batches=12
+    )
+    try:
+        spilled = node.spilled_bytes()
+        assert spilled > 12 * (1 << 20), f"only {spilled} bytes spilled"
+        backend = FilesystemBackend(str(tmp_path / "snap"))
+        ops = OperatorSnapshots(backend)
+        ops.CHUNK_BYTES = 2 << 20  # small chunks tighten the peak bound
+
+        def growth(write):
+            before = spill._rss_bytes()
+            peak = before
+            orig = FilesystemBackend.put_value
+
+            def sampling_put(self, key, value):
+                nonlocal peak
+                peak = max(peak, spill._rss_bytes())
+                orig(self, key, value)
+
+            monkeypatch.setattr(FilesystemBackend, "put_value", sampling_put)
+            try:
+                write()
+            finally:
+                monkeypatch.setattr(FilesystemBackend, "put_value", orig)
+            return max(peak, spill._rss_bytes()) - before
+
+        # parts FIRST (fresh allocator state), monolithic second: the
+        # monolithic pass materializes every cold block + one whole-state
+        # pickle, so its growth floor is ~2x the spilled bytes; streaming
+        # must stay well under the spilled total
+        parts_growth = growth(
+            lambda: ops.write_parts(0, 1, node.snapshot_state_parts())
+        )
+        mono_growth = growth(lambda: ops.write(0, 2, node.snapshot_state()))
+        # measured on this host class: parts ~17 MB (one block + one
+        # chunk + pickle transients) vs monolithic ~90 MB (every cold
+        # block materialized + one whole-state pickle) on 34 MB spilled
+        assert mono_growth > spilled, (
+            f"monolithic baseline grew only {mono_growth} for {spilled} "
+            "spilled — the counterfactual lost its teeth; rescale the test"
+        )
+        assert parts_growth < spilled, (
+            f"streaming snapshot grew RSS by {parts_growth} "
+            f"(spilled {spilled}) — it materialized the spill tier"
+        )
+        assert parts_growth < mono_growth * 0.45, (
+            f"streaming snapshot growth {parts_growth} is not well under "
+            f"the monolithic path's {mono_growth}"
+        )
+    finally:
+        spill._reset_for_tests()
