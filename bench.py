@@ -204,8 +204,12 @@ def main() -> None:
     _wordcount_throughput(n_rows=100_000)
     wc_reps = [_wordcount_throughput() for _ in range(3)]
     wc_rows_per_sec = max(wc_reps)
-    wc_rowwise = _wordcount_throughput(rowwise=True)
-    apply_lifted, apply_perrow = _apply_throughput()
+    wc_rowwise_reps = [_wordcount_throughput(rowwise=True) for _ in range(3)]
+    wc_rowwise = max(wc_rowwise_reps)
+    apply_reps = [_apply_throughput() for _ in range(3)]
+    apply_lifted = max(r[0] for r in apply_reps)
+    apply_perrow = max(r[1] for r in apply_reps)
+    apply_traced = max(r[2] for r in apply_reps)
     join_reps = [_join_throughput() for _ in range(3)]
     join_rows_per_sec = max(join_reps)
     outer_join_rows_per_sec = _join_throughput(mode="left")
@@ -238,6 +242,10 @@ def main() -> None:
             # _perrow is the untraceable-lambda fallback lane
             "apply_lifted_rows_per_sec": round(apply_lifted, 1),
             "apply_perrow_rows_per_sec": round(apply_perrow, 1),
+            # probe-row tracing fallback (PR 10): an eval-defined lambda
+            # with a builtin call — unliftable statically — runs once as
+            # a probe, then rides the same columnar kernels as _lifted
+            "apply_traced_rows_per_sec": round(apply_traced, 1),
             "join_stream_rows_per_sec": round(join_rows_per_sec, 1),
             "outer_join_stream_rows_per_sec": round(outer_join_rows_per_sec, 1),
             # sharded engine numbers are HONEST, not flattering: this host
@@ -312,6 +320,18 @@ def main() -> None:
             # real regression/improvement (VERDICT #9)
             "lane_variance": {
                 "wordcount_stream_rows_per_sec": _rep_stats(wc_reps),
+                "wordcount_rowwise_api_rows_per_sec": _rep_stats(
+                    wc_rowwise_reps
+                ),
+                "apply_lifted_rows_per_sec": _rep_stats(
+                    [r[0] for r in apply_reps]
+                ),
+                "apply_perrow_rows_per_sec": _rep_stats(
+                    [r[1] for r in apply_reps]
+                ),
+                "apply_traced_rows_per_sec": _rep_stats(
+                    [r[2] for r in apply_reps]
+                ),
                 "join_stream_rows_per_sec": _rep_stats(join_reps),
                 **(
                     {"autoscale_pause_ms": _rep_stats(autoscale_pauses)}
@@ -983,11 +1003,17 @@ def _wordcount_throughput(
     return n_rows / elapsed
 
 
-def _apply_throughput(n_rows: int = 1_000_000, batch: int = 100_000) -> tuple[float, float]:
-    """Streaming select with a ``pw.apply`` lambda: (lifted, per-row-fallback)
-    rows/sec. A pure-operator lambda is traced into the columnar expression
-    compiler — no Python in the hot loop; a lambda reading a closure cell
-    falls back to the exact per-row interpreter."""
+def _apply_throughput(
+    n_rows: int = 1_000_000, batch: int = 100_000
+) -> tuple[float, float, float]:
+    """Streaming select with a ``pw.apply`` lambda: (lifted, per-row-
+    fallback, traced) rows/sec. A pure-operator lambda is lifted into the
+    columnar expression compiler — no Python in the hot loop; a lambda
+    reading a closure cell falls back to the vectorized per-row
+    dispatcher; a source-less lambda calling a builtin (``eval``-defined
+    here, so neither the bytecode-execution lift nor the AST lift can see
+    it) lands on the probe-row tracing fallback — one Python call per
+    dtype signature, columnar kernels after."""
     import pathway_tpu as pw
     from pathway_tpu.internals.parse_graph import G
 
@@ -1022,7 +1048,10 @@ def _apply_throughput(n_rows: int = 1_000_000, batch: int = 100_000) -> tuple[fl
     lifted = run(lambda a: a * 3 + 7)
     cell = 3  # closure read → bytecode gate rejects → per-row lane
     perrow = run(lambda a: a * cell + 7)
-    return lifted, perrow
+    # eval: no source for the AST lift, LOAD_GLOBAL abs for the exec
+    # gate — only the probe-row tracer can make this columnar
+    traced = run(eval("lambda a: abs(a) * 3 + 7"))
+    return lifted, perrow, traced
 
 
 def _join_throughput(n_left: int = 300_000, n_right: int = 50_000,

@@ -757,6 +757,15 @@ class Executor:
 
         if self.persistence is not None:
             clock = max(clock, self._recover(realtime))
+            # exactly-once replay determinism: with persistence on, commit
+            # windows are part of the recorded contract — a recovered run
+            # must re-derive the same tick boundaries (and so the same
+            # delivered change-stream) as the original run, so the
+            # backpressure coalescing of backlogged windows
+            # (PATHWAY_INGEST_COALESCE_WINDOWS, io/python.py) is disabled
+            for src in realtime:
+                if hasattr(src, "_coalesce_windows"):
+                    src._coalesce_windows = 0
 
         if self.ctx.is_sharded:
             self._stream_loop_sharded(realtime, clock)

@@ -3213,22 +3213,35 @@ class Subscribe(Node):
         if self._on_batch is not None and len(d):
             self._on_batch(time, d)
         if self._on_change is not None:
-            # bulk tolist + C-speed zip transposition, one flat loop: the
-            # per-row work is exactly the dict the callback signature
-            # requires plus the call itself
+            # one pass per tick: bulk tolist + C-speed zip transposition,
+            # vectorized diff>0, and dict-display row building for the
+            # common narrow schemas — the per-row work is exactly the
+            # dict the callback signature requires plus the call itself
             cb = self._on_change
             names = tuple(self.column_names)
             cols = [np.asarray(d.data[c]).tolist() for c in names]
-            rows = zip(*cols) if cols else iter([()] * len(d))
-            for key, diff, row in zip(
-                d.keys.tolist(), d.diffs.tolist(), rows
-            ):
-                cb(
-                    key=key,
-                    row=dict(zip(names, row)),
-                    time=time,
-                    is_addition=diff > 0,
-                )
+            keys_l = d.keys.tolist()
+            adds = (d.diffs > 0).tolist()
+            if len(names) == 1:
+                n0 = names[0]
+                for key, add, v0 in zip(keys_l, adds, cols[0]):
+                    cb(key=key, row={n0: v0}, time=time, is_addition=add)
+            elif len(names) == 2:
+                n0, n1 = names
+                for key, add, v0, v1 in zip(keys_l, adds, cols[0], cols[1]):
+                    cb(
+                        key=key, row={n0: v0, n1: v1},
+                        time=time, is_addition=add,
+                    )
+            else:
+                rows = zip(*cols) if cols else iter([()] * len(d))
+                for key, add, row in zip(keys_l, adds, rows):
+                    cb(
+                        key=key,
+                        row=dict(zip(names, row)),
+                        time=time,
+                        is_addition=add,
+                    )
         if self._on_time_end is not None and time != END_TIME:
             self._on_time_end(time)
         return None

@@ -260,8 +260,8 @@ def _make_jitted(expr: ColumnExpression, env: ColumnEnv):
 #: input shape/dtype anyway — so sharing the wrapper is sound.
 #: Tradeoff: each cached wrapper closes over its first (expr, env), so a
 #: retired pipeline's expression tree + table objects stay pinned while
-#: the entry lives — bounded by the cache cap (cleared wholesale at the
-#: cap), and the pin IS the value: the next structurally-equal pipeline
+#: the entry lives — bounded by the cache cap (oldest half evicted at
+#: the cap), and the pin IS the value: the next structurally-equal pipeline
 #: reuses the compiled kernel instead of re-tracing XLA mid-stream.
 _JIT_KERNEL_CACHE: dict = {}
 _JIT_KERNEL_CACHE_MAX = 256
@@ -327,7 +327,11 @@ def _jitted_kernel(expr: ColumnExpression, env: ColumnEnv):
     if hit is None:
         hit = _make_jitted(expr, env)
         if len(_JIT_KERNEL_CACHE) >= _JIT_KERNEL_CACHE_MAX:
-            _JIT_KERNEL_CACHE.clear()
+            # oldest-half eviction, not clear(): a wholesale clear makes
+            # every live pipeline re-trace its XLA kernels at once
+            from .udf_lift import evict_oldest_half
+
+            evict_oldest_half(_JIT_KERNEL_CACHE)
         _JIT_KERNEL_CACHE[sig] = hit
     return hit
 
@@ -715,131 +719,7 @@ def _build(
         return fn, out_dt, False, orefs | ixrefs | drefs
 
     if isinstance(expr, (AsyncApplyExpression, ApplyExpression)):
-        fn_user = expr._fn
-        prop_none = expr._propagate_none
-
-        import asyncio
-        import inspect
-
-        is_coro = inspect.iscoroutinefunction(fn_user)
-
-        # arg kernels compile lazily: a successfully lifted apply never
-        # needs them (the lifted tree re-builds its own arg subtrees), so
-        # the common fast path must not pay a discarded per-argument build
-        parts: list | None = None
-        kparts: dict | None = None
-
-        def _arg_parts() -> tuple[list, dict]:
-            nonlocal parts, kparts
-            if parts is None:
-                parts = [_build(a, env, xp_name) for a in expr._args]
-                kparts = {
-                    k: _build(v, env, xp_name)
-                    for k, v in expr._kwargs.items()
-                }
-            return parts, kparts
-
-        def _lift_key() -> tuple:
-            p, kp = _arg_parts()
-            return (
-                fn_user.__code__,
-                tuple(str(x[1]) for x in p),
-                tuple(sorted((k, str(x[1])) for k, x in kp.items())),
-            )
-
-        if not is_coro and not prop_none and _liftable(fn_user):
-            # AST-lift (reference expression.rs:325 — no Python in the hot
-            # loop): trace the lambda by calling it on the ARGUMENT
-            # EXPRESSIONS themselves. A pure-operator lambda returns a
-            # ColumnExpression tree, which compiles to the same fused
-            # columnar kernel as native expression syntax — per-row Python
-            # disappears. Anything untraceable (branches on values, calls,
-            # closures — the bytecode gate rejects most up front) falls
-            # back to the exact per-row path. Refusals are memoized by
-            # (fn code, argument dtypes) so pipelines rebuilt every run
-            # (streaming services, benches, pw.iterate rounds) skip the
-            # trace attempt and go straight to the per-row kernel. The
-            # dtype-qualified key is only computed for code objects with
-            # a refusal on record — it forces the arg builds.
-            if (
-                fn_user.__code__ not in _LIFT_REFUSED_CODES
-                or _lift_key() not in _LIFT_REFUSED
-            ):
-                try:
-                    traced = fn_user(*expr._args, **expr._kwargs)
-                except Exception:
-                    traced = None
-                lifted = None
-                if isinstance(traced, ColumnExpression) and not isinstance(
-                    traced, (ApplyExpression, AsyncApplyExpression)
-                ):
-                    try:
-                        lifted, _odt, agg, refs = _build(traced, env, xp_name)
-                    except Exception:
-                        # the traced tree may hit operator/dtype combinations
-                        # the columnar compiler refuses (e.g. str * int);
-                        # per-row Python still handles those
-                        lifted = None
-                if lifted is not None:
-                    return (
-                        _align_dtype(lifted, expr._return_type),
-                        expr._return_type, agg, refs,
-                    )
-                if len(_LIFT_REFUSED) >= 4096:
-                    _LIFT_REFUSED.clear()
-                    _LIFT_REFUSED_CODES.clear()
-                _LIFT_REFUSED.add(_lift_key())
-                _LIFT_REFUSED_CODES.add(fn_user.__code__)
-
-        parts, kparts = _arg_parts()
-
-        def fn(cols, keys):
-            n = len(keys)
-            arrs = [_materialize(p[0](cols, keys), n) for p in parts]
-            karrs = {k: _materialize(p[0](cols, keys), n) for k, p in kparts.items()}
-            if is_coro:
-                async def gather():
-                    return await asyncio.gather(*[
-                        fn_user(
-                            *[_unnp(a[i]) for a in arrs],
-                            **{k: _unnp(v[i]) for k, v in karrs.items()},
-                        )
-                        for i in range(n)
-                    ], return_exceptions=True)
-                results = _run_async(gather())
-                out = np.empty(n, dtype=object)
-                for i, r in enumerate(results):
-                    if isinstance(r, BaseException):
-                        if not isinstance(r, Exception):
-                            raise r  # CancelledError etc. must not become data
-                        out[i] = EngineError(
-                            f"{type(r).__name__}: {r}",
-                            getattr(fn_user, "__name__", "async apply"),
-                        )
-                    else:
-                        out[i] = r
-                return _densify(out, expr._return_type)
-            out = np.empty(n, dtype=object)
-            for i in range(n):
-                args_i = [_unnp(a[i]) for a in arrs]
-                if prop_none and any(a is None for a in args_i):
-                    out[i] = None
-                    continue
-                try:
-                    out[i] = fn_user(
-                        *args_i, **{k: _unnp(v[i]) for k, v in karrs.items()}
-                    )
-                except Exception as e:
-                    # per-row failure -> Error value (reference Value::Error,
-                    # value.rs:226): the stream continues, fill_error recovers
-                    out[i] = EngineError(
-                        f"{type(e).__name__}: {e}",
-                        getattr(fn_user, "__name__", "apply"),
-                    )
-            return _densify(out, expr._return_type)
-
-        refs = set().union(*[p[3] for p in parts], *[p[3] for p in kparts.values()]) if (parts or kparts) else set()
-        return fn, expr._return_type, False, refs
+        return _build_apply(expr, env, xp_name)
 
     if isinstance(expr, MethodCallExpression):
         from .expressions_namespaces import compile_method
@@ -854,12 +734,380 @@ def _build(
     raise NotImplementedError(f"cannot compile {type(expr).__name__}")
 
 
+#: process-wide UDF path counters (satellite of the rowwise-fast-path
+#: work): which execution path applies landed on — lifted (static
+#: exec/AST lift at compile time), traced (probe-row plan built at
+#: runtime, one per dtype signature), or per-row Python (counted in
+#: rows, the number that actually hurts). Snapshotted onto /metrics as
+#: pathway_udf_* and into the signals plane (observability.hub).
+UDF_STATS: dict[str, int] = {
+    "lifted_total": 0,
+    "traced_total": 0,
+    "perrow_rows_total": 0,
+}
+
+
+def udf_stats_snapshot() -> dict[str, float]:
+    return {k: float(v) for k, v in UDF_STATS.items()}
+
+
+def _pylist(a: np.ndarray) -> list:
+    """Column array -> plain Python list, numpy scalars unwrapped in ONE
+    pass (``tolist`` for dense dtypes) instead of a per-row ``_unnp``
+    dispatch inside the UDF loop."""
+    out = a.tolist()
+    if a.dtype != object:
+        return out
+    return [x.item() if isinstance(x, np.generic) else x for x in out]
+
+
+def _dispatch_perrow(fn_user, lists, klists, n, prop_none, return_type):
+    """Vectorized residual dispatcher: the per-row path as ONE resolved
+    loop — fn looked up once, argument columns pre-converted to Python
+    lists, no per-row ``_unnp``/list-comprehension machinery. Per-row
+    failures still become per-row Error values (reference Value::Error,
+    value.rs:226)."""
+    out = np.empty(n, dtype=object)
+    name = getattr(fn_user, "__name__", "apply")
+    if not klists and not prop_none:
+        if len(lists) == 1:
+            i = 0
+            for a in lists[0]:
+                try:
+                    out[i] = fn_user(a)
+                except Exception as e:
+                    out[i] = EngineError(f"{type(e).__name__}: {e}", name)
+                i += 1
+        else:
+            i = 0
+            for args_i in zip(*lists):
+                try:
+                    out[i] = fn_user(*args_i)
+                except Exception as e:
+                    out[i] = EngineError(f"{type(e).__name__}: {e}", name)
+                i += 1
+    else:
+        knames = list(klists)
+        kcols = [klists[k] for k in knames]
+        rows = zip(*lists) if lists else iter([()] * n)
+        i = 0
+        for args_i in rows:
+            if prop_none and any(a is None for a in args_i):
+                out[i] = None
+                i += 1
+                continue
+            kw = {k: c[i] for k, c in zip(knames, kcols)}
+            try:
+                out[i] = fn_user(*args_i, **kw)
+            except Exception as e:
+                out[i] = EngineError(f"{type(e).__name__}: {e}", name)
+            i += 1
+    return _densify(out, return_type)
+
+
+def _dtype_sig(arrs: list, karrs: dict) -> tuple | None:
+    """Runtime dtype signature of one batch's argument columns — the
+    guard that keeps a traced plan from serving rows it was not traced
+    for. Dense arrays are uniform by construction (dtype char); object
+    arrays are scanned (one C-speed type pass). None = this batch is
+    not plan-servable (mixed types, None rows, Error carriers) and must
+    run per-row."""
+    sig: list = []
+    for a in list(arrs) + [karrs[k] for k in sorted(karrs)]:
+        if a.dtype != object:
+            sig.append(a.dtype.char)
+            continue
+        kinds = set(map(type, a.tolist()))
+        if len(kinds) != 1:
+            return None
+        t = next(iter(kinds))
+        if t is type(None) or t is EngineError:
+            return None
+        sig.append(t)
+    return tuple(sig)
+
+
+def _build_apply(
+    expr: "ApplyExpression", env: ColumnEnv, xp_name: str
+) -> tuple[Callable, dt.DType, bool, set]:
+    """Compile an apply node through the fast-path ladder:
+
+    1. static lift (bytecode-execution trace, then AST lift) — the UDF
+       becomes a columnar kernel at compile time;
+    2. probe-row tracing at runtime, guarded by the batch's dtype
+       signature (re-traced per signature on mixed-dtype streams);
+    3. the vectorized per-row dispatcher — genuinely impure/unliftable
+       callables, counted on /metrics.
+
+    Lifted and traced kernels carry a per-row fallback: any batch-wide
+    raise re-runs that batch through the exact per-row path (safe — the
+    lift gates admit only side-effect-free callables), so row-error
+    semantics are identical on every path.
+    """
+    import asyncio
+    import inspect
+
+    fn_user = expr._fn
+    prop_none = expr._propagate_none
+    is_coro = inspect.iscoroutinefunction(fn_user)
+    deterministic = getattr(expr, "_deterministic", True)
+    lift_eligible = (
+        deterministic
+        and not is_coro
+        and not prop_none
+        and os.environ.get("PATHWAY_UDF_LIFT", "auto") != "off"
+    )
+    trace_eligible = (
+        deterministic
+        and not is_coro
+        and not prop_none
+        and os.environ.get("PATHWAY_UDF_TRACE", "auto") != "off"
+    )
+
+    # arg kernels are built once and shared by every path (the refusal
+    # memo and the Optional-dtype lift gate are keyed by arg dtypes)
+    parts: list | None = None
+    kparts: dict | None = None
+
+    def _arg_parts() -> tuple[list, dict]:
+        nonlocal parts, kparts
+        if parts is None:
+            parts = [_build(a, env, xp_name) for a in expr._args]
+            kparts = {
+                k: _build(v, env, xp_name) for k, v in expr._kwargs.items()
+            }
+        return parts, kparts
+
+    def _lift_key() -> tuple:
+        p, kp = _arg_parts()
+        return (
+            fn_user.__code__,
+            tuple(str(x[1]) for x in p),
+            tuple(sorted((k, str(x[1])) for k, x in kp.items())),
+        )
+
+    def _perrow(cols, keys):
+        """The exact per-row path — also the fallback a lifted/traced
+        kernel retries a raising batch through."""
+        n = len(keys)
+        p, kp = _arg_parts()
+        lists = [_pylist(_materialize(x[0](cols, keys), n)) for x in p]
+        klists = {
+            k: _pylist(_materialize(x[0](cols, keys), n))
+            for k, x in kp.items()
+        }
+        UDF_STATS["perrow_rows_total"] += n
+        return _dispatch_perrow(
+            fn_user, lists, klists, n, prop_none, expr._return_type
+        )
+
+    def _guard(vec: Callable) -> Callable:
+        # numpy kernels only: under a fused-jax rebuild the tracer flows
+        # through the try body and the fallback must not trace
+        if xp_name != "numpy":
+            return vec
+
+        def fn(cols, keys):
+            try:
+                return vec(cols, keys)
+            except Exception:
+                return _perrow(cols, keys)
+
+        return fn
+
+    def _args_optional() -> bool:
+        """Optional args stay off the static lift: a lifted kernel
+        propagates None through _objsafe while the per-row path raises
+        into a per-row Error — the runtime trace handles optional
+        streams instead (its signature guard routes None-carrying
+        batches per-row). Plain column refs resolve without building
+        their kernels, preserving the lift fast path's lazy arg builds;
+        only computed argument trees force a real build."""
+        computed = False
+        for a in list(expr._args) + list(expr._kwargs.values()):
+            if isinstance(a, ColumnConstExpression):
+                continue
+            if isinstance(a, ColumnReference):  # incl. IdReference
+                try:
+                    _, d = env.resolve(a)
+                except KeyError:
+                    return True  # unresolvable here: stay off the lift
+                if d.is_optional:
+                    return True
+                continue
+            computed = True
+        if computed:
+            p, kp = _arg_parts()
+            return any(x[1].is_optional for x in p + list(kp.values()))
+        return False
+
+    # ---- 1. static lift (exec trace, then AST) -----------------------
+    if lift_eligible and getattr(fn_user, "__code__", None) is not None:
+        if (
+            fn_user.__code__ not in _LIFT_REFUSED_CODES
+            or _lift_key() not in _LIFT_REFUSED
+        ) and not _args_optional():
+            traced = None
+            if _liftable(fn_user):
+                # execution trace (reference expression.rs:325 — no
+                # Python in the hot loop): call the lambda on the
+                # ARGUMENT EXPRESSIONS; a pure-operator lambda returns a
+                # ColumnExpression tree
+                try:
+                    traced = fn_user(*expr._args, **expr._kwargs)
+                except Exception:
+                    traced = None
+                if not isinstance(traced, ColumnExpression) or isinstance(
+                    traced, (ApplyExpression, AsyncApplyExpression)
+                ):
+                    traced = None
+            if traced is None:
+                # widened AST lift: method chains, dict access,
+                # conditionals, builtin subset — no user code runs
+                from .udf_lift import ast_lift
+
+                traced = ast_lift(fn_user, expr._args, expr._kwargs)
+            lifted = None
+            if traced is not None:
+                try:
+                    lifted, _odt, agg, refs = _build(traced, env, xp_name)
+                except Exception:
+                    # the traced tree may hit operator/dtype combinations
+                    # the columnar compiler refuses (e.g. str * int);
+                    # per-row Python still handles those
+                    lifted = None
+            if lifted is not None:
+                UDF_STATS["lifted_total"] += 1
+                return (
+                    _align_dtype(_guard(lifted), expr._return_type),
+                    expr._return_type, agg, refs,
+                )
+            from .udf_lift import evict_oldest_half
+
+            if len(_LIFT_REFUSED) >= 4096:
+                evict_oldest_half(_LIFT_REFUSED)
+                _LIFT_REFUSED_CODES.clear()
+                _LIFT_REFUSED_CODES.update(k[0] for k in _LIFT_REFUSED)
+            _LIFT_REFUSED[_lift_key()] = None
+            _LIFT_REFUSED_CODES.add(fn_user.__code__)
+
+    parts, kparts = _arg_parts()
+    refs = (
+        set().union(*[p[3] for p in parts], *[p[3] for p in kparts.values()])
+        if (parts or kparts)
+        else set()
+    )
+
+    if is_coro:
+        def fn_async(cols, keys):
+            n = len(keys)
+            arrs = [_materialize(p[0](cols, keys), n) for p in parts]
+            karrs = {
+                k: _materialize(p[0](cols, keys), n)
+                for k, p in kparts.items()
+            }
+
+            async def gather():
+                return await asyncio.gather(*[
+                    fn_user(
+                        *[_unnp(a[i]) for a in arrs],
+                        **{k: _unnp(v[i]) for k, v in karrs.items()},
+                    )
+                    for i in range(n)
+                ], return_exceptions=True)
+
+            results = _run_async(gather())
+            out = np.empty(n, dtype=object)
+            for i, r in enumerate(results):
+                if isinstance(r, BaseException):
+                    if not isinstance(r, Exception):
+                        raise r  # CancelledError etc. must not become data
+                    out[i] = EngineError(
+                        f"{type(r).__name__}: {r}",
+                        getattr(fn_user, "__name__", "async apply"),
+                    )
+                else:
+                    out[i] = r
+            return _densify(out, expr._return_type)
+
+        return fn_async, expr._return_type, False, refs
+
+    # ---- 2./3. runtime: probe-row trace, else vectorized per-row -----
+    trace_ok = False
+    if trace_eligible and xp_name == "numpy":
+        from .udf_lift import traceable
+
+        trace_ok = traceable(fn_user)
+    plans: dict[tuple, Callable] = {}
+    refused_sigs: set = set()
+
+    def _try_trace(sig, arrs, karrs, cols, keys):
+        from .udf_lift import TraceRefused, trace_probe
+
+        try:
+            probe = [_unnp(a[0]) for a in arrs]
+            kprobe = {k: _unnp(v[0]) for k, v in karrs.items()}
+            texpr, probe_val = trace_probe(
+                fn_user, probe, list(expr._args), kprobe, dict(expr._kwargs)
+            )
+            kernel, _odt, _agg, _refs = _build(texpr, env, "numpy")
+            kernel = _align_dtype(kernel, expr._return_type)
+            # consistency check: the compiled plan must reproduce the
+            # probe row's genuine result before it serves the stream
+            row0 = {c: a[:1] for c, a in cols.items()}
+            got = _unnp(_materialize(kernel(row0, keys[:1]), 1)[0])
+            same = got == probe_val or (
+                isinstance(got, float)
+                and isinstance(probe_val, float)
+                and np.isnan(got)
+                and np.isnan(probe_val)
+            )
+            if not bool(same):
+                raise TraceRefused
+        except (TraceRefused, Exception):
+            refused_sigs.add(sig)
+            return None
+        plans[sig] = kernel
+        UDF_STATS["traced_total"] += 1
+        return kernel
+
+    def fn(cols, keys):
+        n = len(keys)
+        arrs = [_materialize(p[0](cols, keys), n) for p in parts]
+        karrs = {
+            k: _materialize(p[0](cols, keys), n) for k, p in kparts.items()
+        }
+        if trace_ok and n:
+            sig = _dtype_sig(arrs, karrs)
+            if sig is not None:
+                plan = plans.get(sig)
+                if plan is None and sig not in refused_sigs:
+                    plan = _try_trace(sig, arrs, karrs, cols, keys)
+                if plan is not None:
+                    try:
+                        return plan(cols, keys)
+                    except Exception:
+                        pass  # batch-wide raise: exact per-row semantics
+        lists = [_pylist(a) for a in arrs]
+        klists = {k: _pylist(v) for k, v in karrs.items()}
+        UDF_STATS["perrow_rows_total"] += n
+        return _dispatch_perrow(
+            fn_user, lists, klists, n, prop_none, expr._return_type
+        )
+
+    return fn, expr._return_type, False, refs
+
+
 #: (fn code, arg dtypes) of apply lambdas whose lift attempt failed —
 #: rebuilds skip the re-trace and land on the per-row kernel directly.
+#: Insertion-ordered dict so hitting the cap evicts the OLDEST half
+#: instead of clearing wholesale (a long-lived multi-pipeline process
+#: must not re-trace every lambda at once); _LIFT_REFUSED_CODES is
+#: rebuilt from the surviving keys on every eviction.
 #: Two-level: the dtype-qualified key is only computed (it forces the
 #: arg builds) for code objects that have SOME refusal on record —
 #: never-refused lambdas pay nothing on the lift fast path
-_LIFT_REFUSED: set = set()
+_LIFT_REFUSED: dict = {}
 _LIFT_REFUSED_CODES: set = set()
 #: liftability verdict per code object (bytecode-only property, so the
 #: code object is the exact cache key); skips the dis scan on rebuilds
@@ -906,7 +1154,9 @@ def _liftable(fn: Callable) -> bool:
     )
     if code is not None:
         if len(_LIFTABLE_CACHE) >= 1024:
-            _LIFTABLE_CACHE.clear()
+            from .udf_lift import evict_oldest_half
+
+            evict_oldest_half(_LIFTABLE_CACHE)
         _LIFTABLE_CACHE[code] = verdict
     return verdict
 

@@ -288,6 +288,10 @@ _METHODS: dict[str, tuple[Callable, Callable]] = {
     "str.slice": (lambda s, a, b: s[a:b], lambda ts: dt.STR),
     "num.abs": (lambda v: abs(v), lambda ts: ts[0]),
     "num.round": (lambda v, d: round(v, d), lambda ts: ts[0]),
+    # exact Python int() for lifted UDFs (udf_lift): per element, so
+    # int(nan)/int(inf) raise into per-row semantics instead of the
+    # dense astype path's silent INT64_MIN
+    "py.int": (lambda v: int(v), lambda ts: dt.INT),
     "dt.second": (lambda v: v.second, lambda ts: dt.INT),
     "dt.minute": (lambda v: v.minute, lambda ts: dt.INT),
     "dt.hour": (lambda v: v.hour, lambda ts: dt.INT),

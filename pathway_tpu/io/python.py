@@ -68,6 +68,10 @@ class ConnectorSubject:
         self._buf_lock = threading.Lock()
         self._buf_flushed_at = 0.0
         self._buf_t0_ns = 0
+        #: True while every buffered entry is a bare kwargs dict (plain
+        #: ``next()`` rows) — rides the chunk so the engine-side delta
+        #: build skips its per-entry type scan on the hot path
+        self._buf_plain = True
         #: set when the engine requests shutdown; long-running ``run`` loops
         #: must check ``self.stopped`` (the reference reader threads exit
         #: when the main loop drops the channel, src/connectors/mod.rs:427)
@@ -77,7 +81,7 @@ class ConnectorSubject:
 
     # -- emission API (reference io/python: next_json / next_str / next) --
 
-    def _emit(self, entry: "tuple | dict") -> None:
+    def _emit(self, entry: "tuple | dict", plain: bool = True) -> None:
         # entry: bare kwargs dict (diff=+1 row) or (diff, fields, key) tuple
         # size-triggered flush only: the per-row path must stay lean, so
         # time-based flushing of a lingering buffer is the engine side's
@@ -88,17 +92,21 @@ class ConnectorSubject:
                 # ingest stamp = when the chunk's FIRST row arrived (the
                 # oldest row bounds the batch's end-to-end latency)
                 self._buf_t0_ns = _time.time_ns()
+            if not plain:
+                self._buf_plain = False
             buf.append(entry)
             if len(buf) >= self._CHUNK:
-                self._queue.put((self._buf_t0_ns, buf))
+                self._queue.put((self._buf_t0_ns, buf, self._buf_plain))
                 self._buf = []
+                self._buf_plain = True
                 self._buf_flushed_at = _time.monotonic()
 
     def _flush_rows(self) -> None:
         with self._buf_lock:
             if self._buf:
-                self._queue.put((self._buf_t0_ns, self._buf))
+                self._queue.put((self._buf_t0_ns, self._buf, self._buf_plain))
                 self._buf = []
+                self._buf_plain = True
                 self._buf_flushed_at = _time.monotonic()
 
     def _flush_stale(self) -> None:
@@ -150,11 +158,11 @@ class ConnectorSubject:
 
     def _remove(self, **kwargs: Any) -> None:
         """Retract a previously emitted row (matched by content)."""
-        self._emit((-1, kwargs, None))
+        self._emit((-1, kwargs, None), plain=False)
 
     def _next_with_key(self, key: int, diff: int = 1, **kwargs: Any) -> None:
         """Emit a row under an explicit engine key (rest_connector plumbing)."""
-        self._emit((diff, kwargs, key))
+        self._emit((diff, kwargs, key), plain=False)
 
     def commit(self) -> None:
         self._flush_rows()
@@ -242,6 +250,21 @@ class PythonSubjectSource(RealtimeSource):
             if dt.unoptionalize(dtc) == dt.FLOAT
         )
         self._partial: list[tuple[int, tuple, int | None]] = []  # (diff, row, key)
+        #: AND of the plain-chunk flags accumulated into _partial — True
+        #: means every entry is a bare kwargs dict, so the delta build
+        #: skips its per-entry type scan
+        self._partial_plain = True
+        #: backlogged commit windows drained in ONE poll beyond this
+        #: count are coalesced into a single delta (one engine tick):
+        #: when the producer outruns the engine, per-window sweeps are
+        #: pure overhead — the rows are already consolidated by the
+        #: downstream operators at one logical time. 0 disables (every
+        #: commit window keeps its own tick).
+        import os as _os
+
+        self._coalesce_windows = int(
+            _os.environ.get("PATHWAY_INGEST_COALESCE_WINDOWS", "8")
+        )
         #: deltas built within the current commit window (columnar batches +
         #: flushed row runs), concatenated into ONE delta per commit
         self._pending: list[Delta] = []
@@ -264,7 +287,11 @@ class PythonSubjectSource(RealtimeSource):
         self.waker = event
         self.subject._waker = event
 
-    def _make_delta(self, entries: list[tuple[int, dict, int | None]]) -> Delta:
+    def _make_delta(
+        self,
+        entries: list[tuple[int, dict, int | None]],
+        plain: bool = False,
+    ) -> Delta:
         # the offset covers exactly the rows delivered to the engine as
         # deltas — never rows still sitting in _partial, which would be
         # lost on recovery (persisted offset past unsnapshotted input).
@@ -280,17 +307,26 @@ class PythonSubjectSource(RealtimeSource):
         self._emitted += len(entries)
         n = len(entries)
         # entries are bare kwargs dicts (next(): diff=+1, no key) or
-        # (diff, fields, key) tuples (_remove / _next_with_key)
-        plain = all(type(e) is dict for e in entries)
+        # (diff, fields, key) tuples (_remove / _next_with_key); the
+        # chunk-level plain flag (stamped at _emit time) spares the
+        # per-entry type scan on the hot all-dict path
+        if not plain:
+            plain = all(type(e) is dict for e in entries)
         fields_list = (
             entries if plain else [e if type(e) is dict else e[1] for e in entries]
         )
+        import operator as _operator
+
         data: dict[str, np.ndarray] = {}
         for name in self.names:
-            dflt = self.defaults.get(name)
-            data[name] = self._normalize(name, column_of_values(
-                [f.get(name, dflt) for f in fields_list]
-            ))
+            try:
+                # C-speed extraction; rows missing the column (schema
+                # defaults) fall to the .get comprehension below
+                col = list(map(_operator.itemgetter(name), fields_list))
+            except KeyError:
+                dflt = self.defaults.get(name)
+                col = [f.get(name, dflt) for f in fields_list]
+            data[name] = self._normalize(name, column_of_values(col))
         if plain:
             diffs = np.ones(n, dtype=np.int64)
         else:
@@ -421,8 +457,11 @@ class PythonSubjectSource(RealtimeSource):
 
     def _flush_partial(self) -> None:
         if self._partial:
-            self._pending.append(self._make_delta(self._partial))
+            self._pending.append(
+                self._make_delta(self._partial, self._partial_plain)
+            )
             self._partial = []
+            self._partial_plain = True
 
     def _note_ingest(self, t0_ns: int | None) -> None:
         if t0_ns:
@@ -483,9 +522,12 @@ class PythonSubjectSource(RealtimeSource):
                 continue
             # a chunk of buffered rows (ConnectorSubject._emit): one queue
             # item per ~256 rows instead of one per row, stamped with the
-            # wall time its first row arrived; entries keep their kwargs
-            # dicts — _make_delta extracts columns in bulk
-            t0_ns, item = item
+            # wall time its first row arrived plus the plain-dict flag;
+            # entries keep their kwargs dicts — _make_delta extracts
+            # columns in bulk
+            t0_ns, item, chunk_plain = item
+            if not chunk_plain:
+                self._partial_plain = False
             if self._skip > 0:
                 # already persisted before restart; the restarted subject
                 # re-emits its deterministic prefix (reference
@@ -505,6 +547,24 @@ class PythonSubjectSource(RealtimeSource):
         if (self._partial or self._pending) and (self._done or flush_due):
             self._close_commit(out)
             self._last_flush = now
+        c = self._coalesce_windows
+        if c and len(out) > c:
+            # backpressure coalescing: the subject outran the engine by
+            # more than `c` complete commit windows this poll. Sweeping
+            # each backlogged window as its own tick is pure fixed-cost
+            # overhead (the downstream operators consolidate to the same
+            # net state); merge the backlog into ONE delta so the engine
+            # catches up at columnar speed. Offsets already cover every
+            # merged row, so recovery/exactly-once bookkeeping is
+            # unchanged; the merged window keeps the OLDEST ingest stamp.
+            from ..engine.delta import concat_deltas
+
+            merged = concat_deltas(out, self.names)
+            stamps = self._out_ingest[-len(out):]
+            keep = self._out_ingest[: len(self._out_ingest) - len(out)]
+            live = [s for s in stamps if s is not None]
+            self._out_ingest = keep + [min(live) if live else None]
+            out = [merged]
         return out
 
     def is_finished(self) -> bool:

@@ -278,6 +278,20 @@ class ObservabilityHub:
             # telemetry must not fail the run it observes
             return {}
 
+    @staticmethod
+    def udf_stats_snapshot() -> dict[str, float]:
+        """This process's UDF execution-path counters (lifted / traced /
+        per-row rows — internals/expression_compiler.py) — which lane
+        user ``pw.apply`` callables landed on, so a slow pipeline reads
+        as "N rows ran per-row Python" instead of a guess."""
+        try:
+            from ..internals.expression_compiler import udf_stats_snapshot
+
+            return udf_stats_snapshot()
+        except Exception:
+            # telemetry must not fail the run it observes
+            return {}
+
     def snapshot_document(self) -> dict:
         """The /snapshot payload peers serve to process 0."""
         return {
@@ -286,6 +300,7 @@ class ObservabilityHub:
             "comm": self.comm_snapshot(),
             "memory": self.memory_stats_snapshot(),
             "sinks": self.sink_stats_snapshot(),
+            "udf": self.udf_stats_snapshot(),
             "trace_dropped": self._local_trace_dropped(),
         }
 
@@ -296,6 +311,7 @@ class ObservabilityHub:
         dict[str, dict],
         dict[str, int],
         dict[str, float],
+        dict[str, dict],
         dict[str, dict],
         dict[str, dict],
     ]:
@@ -315,6 +331,7 @@ class ObservabilityHub:
         comm_stats = {str(self.process_id): self.comm_snapshot()}
         memory_stats = {str(self.process_id): self.memory_stats_snapshot()}
         sink_stats = {str(self.process_id): self.sink_stats_snapshot()}
+        udf_stats = {str(self.process_id): self.udf_stats_snapshot()}
         trace_dropped: dict[str, int] = {}
         stale: dict[str, float] = {}
         local_dropped = self._local_trace_dropped()
@@ -353,6 +370,9 @@ class ObservabilityHub:
             peer_sinks = doc.get("sinks")
             if peer_sinks:
                 sink_stats[str(doc.get("process_id", "?"))] = peer_sinks
+            peer_udf = doc.get("udf")
+            if peer_udf:
+                udf_stats[str(doc.get("process_id", "?"))] = peer_udf
             peer_dropped = doc.get("trace_dropped")
             if peer_dropped is not None:
                 trace_dropped[str(doc.get("process_id", "?"))] = int(
@@ -361,7 +381,7 @@ class ObservabilityHub:
         snapshots.sort(key=lambda s: s.get("worker", 0))
         return (
             snapshots, comm_stats, trace_dropped, stale, memory_stats,
-            sink_stats,
+            sink_stats, udf_stats,
         )
 
     @staticmethod
@@ -473,6 +493,7 @@ class ObservabilityHub:
         doc["comm"] = comm
         doc["memory"] = self.memory_stats_snapshot()
         doc["sinks"] = self.sink_stats_snapshot()
+        doc["udf"] = self.udf_stats_snapshot()
         from .attribution import attribution_document
 
         doc["attribution"] = attribution_document(sig, w)
@@ -543,6 +564,7 @@ class ObservabilityHub:
         merged["comm"] = {str(self.process_id): local.get("comm", {})}
         merged["memory"] = {str(self.process_id): local.get("memory", {})}
         merged["sinks"] = {str(self.process_id): local.get("sinks", {})}
+        merged["udf"] = {str(self.process_id): local.get("udf", {})}
         merged["alerts"] = {
             "active": list(local.get("alerts", {}).get("active", [])),
             "history": list(local.get("alerts", {}).get("history", [])),
@@ -559,6 +581,7 @@ class ObservabilityHub:
             merged["comm"][str(pid)] = doc.get("comm", {})
             merged["memory"][str(pid)] = doc.get("memory", {})
             merged["sinks"][str(pid)] = doc.get("sinks", {})
+            merged["udf"][str(pid)] = doc.get("udf", {})
             alerts = doc.get("alerts", {})
             merged["alerts"]["active"].extend(alerts.get("active", []))
             merged["alerts"]["history"].extend(alerts.get("history", []))
@@ -671,7 +694,7 @@ class ObservabilityHub:
         if self.peer_http:
             (
                 snapshots, comm_stats, dropped_by_proc, stale,
-                memory_stats, sink_stats,
+                memory_stats, sink_stats, udf_stats,
             ) = self.cluster_snapshots()
             # per-process labels, like the comm gauges: series identity
             # stays stable when a peer scrape transiently fails
@@ -684,6 +707,8 @@ class ObservabilityHub:
             memory_stats = {str(self.process_id): mem} if mem else {}
             sinks = self.sink_stats_snapshot()
             sink_stats = {str(self.process_id): sinks} if sinks else {}
+            udf = self.udf_stats_snapshot()
+            udf_stats = {str(self.process_id): udf} if udf else {}
             trace_dropped = self._local_trace_dropped()
         # label by TOPOLOGY, not by how many snapshots this scrape got:
         # in cluster mode a transient peer outage must not flip series
@@ -729,6 +754,7 @@ class ObservabilityHub:
             autoscale=self._autoscale_snapshot(),
             memory_stats=memory_stats or None,
             sink_stats=sink_stats or None,
+            udf_stats=udf_stats or None,
         )
 
     @staticmethod

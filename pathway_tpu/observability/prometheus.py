@@ -119,6 +119,7 @@ def render_snapshots(
     autoscale: dict | None = None,
     memory_stats: dict[str, dict[str, float]] | None = None,
     sink_stats: dict[str, dict[str, dict[str, float]]] | None = None,
+    udf_stats: dict[str, dict[str, float]] | None = None,
 ) -> str:
     """Exposition text for a set of worker stats snapshots.
 
@@ -229,6 +230,15 @@ def render_snapshots(
             for key, value in sorted(gauges.items()):
                 kind = "counter" if key.endswith("_total") else "gauge"
                 r.add(f"pathway_sink_{key}", kind, value, slab)
+    for proc, gauges in sorted((udf_stats or {}).items()):
+        # UDF execution-path counters (internals/expression_compiler.py):
+        # lifted / traced plans built and rows that ran per-row Python —
+        # the rowwise-tax visibility surface. Process-scoped like the
+        # memory gauges.
+        plab = {"process": str(proc)}
+        for key, value in sorted(gauges.items()):
+            kind = "counter" if key.endswith("_total") else "gauge"
+            r.add(f"pathway_udf_{key}", kind, value, plab)
     r.add("pathway_cluster_workers", "gauge", len(snapshots))
     if stale_workers:
         # a peer whose /snapshot scrape failed: its workers are reported

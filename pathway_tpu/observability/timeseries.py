@@ -474,6 +474,12 @@ class SignalsPlane:
                 self.store.record(
                     f"sink.{sink}.{key}", float(value), None, t
                 )
+        # UDF execution-path counters (expression_compiler): lifted /
+        # traced plans + rows that ran per-row Python — an SLO rule can
+        # watch udf.perrow_rows_total to catch a pipeline falling off
+        # the columnar fast path after a deploy
+        for key, value in self.hub.udf_stats_snapshot().items():
+            self.store.record(f"udf.{key}", float(value), None, t)
 
     # -- lifecycle -----------------------------------------------------
 

@@ -154,6 +154,22 @@ def render_frame(doc: dict, now: float | None = None) -> str:
         if s.get("breaker_open"):
             line += ", breaker OPEN"
         lines.append(line)
+    udf = doc.get("udf", {})
+    # merged docs key udf by process; single-process docs are flat
+    udf_by_proc = (
+        udf
+        if udf and all(isinstance(v, dict) for v in udf.values())
+        else {str(doc.get("process_id", 0)): udf}
+    )
+    for proc in sorted(udf_by_proc):
+        u = udf_by_proc[proc] or {}
+        if not any(u.values()):
+            continue
+        lines.append(
+            f"udf p{proc}: {_fmt(u.get('lifted_total'), nd=0)} lifted, "
+            f"{_fmt(u.get('traced_total'), nd=0)} traced, "
+            f"{_fmt(u.get('perrow_rows_total'), nd=0)} row(s) per-row"
+        )
     sup = doc.get("supervisor")
     if sup is not None and sup.get("window_failures") is not None:
         budget = sup.get("window_budget")

@@ -1,0 +1,218 @@
+"""Static check: the rowwise connector path routes through the shared
+batch coalescer — no naked per-row flush paths regress back in.
+
+The per-row ingest API (``ConnectorSubject.next`` and friends,
+``io/python.py``) owes its throughput to ONE design invariant: a row
+entry never touches the cross-thread queue by itself. Every row-emitting
+entrypoint buffers through ``_emit`` (the coalescer), ``_emit`` only
+flushes a full chunk (its ``_queue.put`` sits under the chunk-size
+guard), and whole-buffer flushes live in the small sanctioned set of
+flush methods. A future "fix" that makes ``next()`` put per row — or
+adds a helper that drains one entry at a time inside a loop — silently
+reintroduces the ~1.3µs/row cross-thread handoff this PR removed.
+
+Checks, all AST-level over ``pathway_tpu/io/python.py``:
+
+1. every row entrypoint (``next``/``next_json``/``next_str``/
+   ``next_bytes``/``_remove``/``_next_with_key``) calls ``_emit`` or
+   delegates to another row entrypoint — no direct queue access;
+2. ``_queue.put`` appears only in the sanctioned flush set
+   (``_emit``/``_flush_rows``/``next_batch``/``commit``/``close``/
+   ``start``);
+3. inside ``_emit``, every ``put`` is guarded by a conditional (the
+   chunk-size flush), never unconditional per-entry;
+4. no ``put`` anywhere in the module executes inside a ``for``/``while``
+   loop — the signature of a per-row flush path.
+
+Usable standalone (``python scripts/check_ingest_paths.py`` → exit 0/1)
+and as a tier-1 test (``tests/test_check_ingest_paths.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TARGET = os.path.join(ROOT, "pathway_tpu", "io", "python.py")
+
+#: per-row emission API — each must buffer through the coalescer
+ROW_ENTRYPOINTS = (
+    "next", "next_json", "next_str", "next_bytes",
+    "_remove", "_next_with_key",
+)
+
+#: methods allowed to touch the cross-thread queue (whole-chunk flushes
+#: and lifecycle markers)
+SANCTIONED_PUTTERS = (
+    "_emit", "_flush_rows", "next_batch", "commit", "close", "start",
+)
+
+
+def _method_defs(tree: ast.Module, cls: str) -> dict[str, ast.FunctionDef]:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            return {
+                n.name: n
+                for n in node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+    return {}
+
+
+def _calls_in(fn: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                out.add(f.attr)
+    return out
+
+
+def _puts_in(fn: ast.AST) -> list[ast.Call]:
+    return [
+        node
+        for node in ast.walk(fn)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "put"
+    ]
+
+
+def _put_guarded(fn: ast.FunctionDef, put: ast.Call) -> bool:
+    """Is this ``put`` nested under some conditional within ``fn``?"""
+
+    class _Finder(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.guarded = False
+            self._depth = 0
+
+        def visit_If(self, node: ast.If) -> None:
+            self._depth += 1
+            self.generic_visit(node)
+            self._depth -= 1
+
+        def visit_Call(self, node: ast.Call) -> None:
+            if node is put and self._depth > 0:
+                self.guarded = True
+            self.generic_visit(node)
+
+    f = _Finder()
+    f.visit(fn)
+    return f.guarded
+
+
+def _put_in_loop(tree: ast.Module) -> list[str]:
+    """puts lexically inside for/while loops anywhere in the module."""
+    problems: list[str] = []
+
+    class _Walker(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.loop_depth = 0
+
+        def _loop(self, node: ast.AST) -> None:
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        visit_For = _loop
+        visit_While = _loop
+        visit_AsyncFor = _loop
+
+        def visit_Call(self, node: ast.Call) -> None:
+            if (
+                self.loop_depth > 0
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "put"
+            ):
+                problems.append(
+                    f"python.py:{node.lineno} queue put inside a loop "
+                    "(per-row flush path)"
+                )
+            self.generic_visit(node)
+
+    _Walker().visit(tree)
+    return problems
+
+
+def check(path: str | None = None) -> list[str]:
+    path = path or TARGET
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    problems: list[str] = []
+
+    methods = _method_defs(tree, "ConnectorSubject")
+    if not methods:
+        return [f"{os.path.basename(path)}: class ConnectorSubject not found"]
+
+    # 1. row entrypoints buffer through the coalescer
+    for name in ROW_ENTRYPOINTS:
+        fn = methods.get(name)
+        if fn is None:
+            continue
+        calls = _calls_in(fn)
+        if "_emit" in calls or any(
+            e in calls for e in ROW_ENTRYPOINTS if e != name
+        ):
+            if _puts_in(fn):
+                problems.append(
+                    f"python.py:{fn.lineno} {name}() calls the queue "
+                    "directly as well as the coalescer"
+                )
+            continue
+        problems.append(
+            f"python.py:{fn.lineno} {name}() does not route through "
+            "_emit (the batch coalescer)"
+        )
+
+    # 2. queue puts only in the sanctioned flush set
+    for name, fn in methods.items():
+        if name in SANCTIONED_PUTTERS:
+            continue
+        for put in _puts_in(fn):
+            problems.append(
+                f"python.py:{put.lineno} {name}() flushes the queue "
+                "(only " + "/".join(SANCTIONED_PUTTERS) + " may)"
+            )
+
+    # 3. _emit's put must sit under the chunk-size guard
+    emit = methods.get("_emit")
+    if emit is not None:
+        for put in _puts_in(emit):
+            if not _put_guarded(emit, put):
+                problems.append(
+                    f"python.py:{put.lineno} _emit() flushes per entry "
+                    "(put not under the chunk-size guard)"
+                )
+
+    # 4. no puts inside loops anywhere
+    problems.extend(_put_in_loop(tree))
+    return problems
+
+
+def main() -> int:
+    bad = check()
+    if bad:
+        print(
+            "check_ingest_paths FAILED: naked per-row flush paths in the "
+            "rowwise connector:",
+            file=sys.stderr,
+        )
+        for p in bad:
+            print(f"  {p}", file=sys.stderr)
+        print(
+            "route row emission through ConnectorSubject._emit (see "
+            "README 'Writing fast UDFs / rowwise ingest')",
+            file=sys.stderr,
+        )
+        return 1
+    print("check_ingest_paths OK (rowwise connector rides the coalescer)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""Tier-1 wiring for ``scripts/check_ingest_paths.py``: the rowwise
+connector path routes through the shared batch coalescer, and the
+checker itself catches a naked per-row flush."""
+
+from __future__ import annotations
+
+import os
+import sys
+import textwrap
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ),
+)
+
+import check_ingest_paths  # noqa: E402
+
+
+def test_rowwise_connector_rides_the_coalescer():
+    problems = check_ingest_paths.check()
+    assert not problems, (
+        "per-row flush paths regressed into the rowwise connector:\n"
+        + "\n".join(problems)
+    )
+
+
+def test_checker_catches_per_row_put(tmp_path):
+    mod = tmp_path / "python.py"
+    mod.write_text(textwrap.dedent("""
+        class ConnectorSubject:
+            def _emit(self, entry, plain=True):
+                self._buf.append(entry)
+                if len(self._buf) >= 256:
+                    self._queue.put(self._buf)
+            def next(self, **kwargs):
+                self._queue.put(kwargs)  # naked per-row flush
+            def next_json(self, message):
+                self.next(**message)
+    """))
+    problems = check_ingest_paths.check(str(mod))
+    assert any("next()" in p for p in problems), problems
+
+
+def test_checker_catches_unguarded_emit_flush(tmp_path):
+    mod = tmp_path / "python.py"
+    mod.write_text(textwrap.dedent("""
+        class ConnectorSubject:
+            def _emit(self, entry, plain=True):
+                self._queue.put(entry)  # per-entry flush, no chunk guard
+            def next(self, **kwargs):
+                self._emit(kwargs)
+    """))
+    problems = check_ingest_paths.check(str(mod))
+    assert any("chunk-size guard" in p for p in problems), problems
+
+
+def test_checker_catches_put_inside_loop(tmp_path):
+    mod = tmp_path / "python.py"
+    mod.write_text(textwrap.dedent("""
+        class ConnectorSubject:
+            def _emit(self, entry, plain=True):
+                self._buf.append(entry)
+                if len(self._buf) >= 256:
+                    self._queue.put(self._buf)
+            def next(self, **kwargs):
+                self._emit(kwargs)
+            def next_batch(self, data):
+                for row in data:
+                    self._queue.put(row)
+    """))
+    problems = check_ingest_paths.check(str(mod))
+    assert any("inside a loop" in p for p in problems), problems

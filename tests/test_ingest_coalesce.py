@@ -1,0 +1,111 @@
+"""Backpressure coalescing of backlogged commit windows
+(``PATHWAY_INGEST_COALESCE_WINDOWS``, io/python.py) + the plain-chunk
+fast flag on the rowwise ingest path."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io.python import ConnectorSubject, PythonSubjectSource
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _drain_windows(n_windows: int, rows_per: int = 3) -> list:
+    subj = ConnectorSubject()
+    src = PythonSubjectSource(subj, ["x"], {}, None, None, dtypes={})
+    v = 0
+    for _ in range(n_windows):
+        for _ in range(rows_per):
+            subj.next(x=v)
+            v += 1
+        subj.commit()
+    return src.poll()
+
+
+def test_backlog_beyond_threshold_merges_into_one_delta(monkeypatch):
+    deltas = _drain_windows(12)
+    assert len(deltas) == 1  # default threshold 8: backlog coalesced
+    assert len(deltas[0]) == 36  # every row survives the merge
+
+
+def test_small_backlog_keeps_per_window_ticks(monkeypatch):
+    deltas = _drain_windows(5)
+    assert len(deltas) == 5  # at-or-under threshold: one delta per commit
+
+
+def test_knob_zero_disables_coalescing(monkeypatch):
+    monkeypatch.setenv("PATHWAY_INGEST_COALESCE_WINDOWS", "0")
+    deltas = _drain_windows(12)
+    assert len(deltas) == 12
+
+
+def test_merged_window_keeps_oldest_ingest_stamp(monkeypatch):
+    subj = ConnectorSubject()
+    src = PythonSubjectSource(subj, ["x"], {}, None, None, dtypes={})
+    for w in range(10):
+        subj.next(x=w)
+        subj.commit()
+    deltas = src.poll()
+    stamps = src.take_ingest_stamps()
+    assert len(deltas) == len(stamps) == 1
+    assert stamps[0] is not None  # the backlog's oldest row anchors e2e
+
+
+def test_persistence_disables_coalescing(tmp_path):
+    """With persistence on, commit windows are part of the recorded
+    replay contract: every pre-queued commit must keep its own tick even
+    when the backlog exceeds the coalesce threshold."""
+    from pathway_tpu.persistence import Backend, Config
+
+    class Feed(ConnectorSubject):
+        def run(self):
+            for w in range(12):
+                self.next(x=w)
+                self.commit()
+
+    t = pw.io.python.read(
+        Feed(), schema=pw.schema_from_types(x=int),
+        autocommit_duration_ms=None, name="coalesce-src",
+    )
+    times: list[int] = []
+    pw.io.subscribe(t, on_time_end=lambda time: times.append(time))
+    pw.run(persistence_config=Config(Backend.filesystem(str(tmp_path))))
+    assert len(times) == 12  # one tick per commit window, none merged
+
+
+def test_coalesced_stream_multiset_equal_with_retractions():
+    """End-to-end: a backlog with mixed plain/retraction chunks coalesces
+    without losing or ghosting rows (key derivation is content-based, so
+    merged windows net out exactly like per-window processing)."""
+
+    class Feed(ConnectorSubject):
+        def run(self):
+            for i in range(20):
+                self.next(a=i)
+                if i % 5 == 0:
+                    self.commit()
+            self._remove(a=3)
+            self._remove(a=17)
+            self.commit()
+
+    t = pw.io.python.read(
+        Feed(), schema=pw.schema_from_types(a=int),
+        autocommit_duration_ms=10,
+    )
+    live: dict[int, int] = {}
+
+    def on_change(key, row, time, is_addition):
+        live[row["a"]] = live.get(row["a"], 0) + (1 if is_addition else -1)
+
+    pw.io.subscribe(t, on_change=on_change)
+    pw.run()
+    got = sorted(k for k, n in live.items() if n > 0)
+    assert got == [i for i in range(20) if i not in (3, 17)]

@@ -1,0 +1,494 @@
+"""Lift-vs-fallback semantic parity matrix (PR 10 tentpole gate).
+
+Every newly liftable UDF form — method chains, dict/tuple access,
+conditionals, the builtin subset, probe-traced plans — must produce a
+multiset-equal result to the FORCED per-row path (``PATHWAY_UDF_LIFT=off``
++ ``PATHWAY_UDF_TRACE=off``), including ``EngineError`` row-error
+semantics and None propagation; impure UDFs must provably stay per-row;
+the dtype-signature guard must re-trace on mixed-dtype streams; and the
+refusal caches must evict their oldest half instead of cliff-clearing.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.debug as dbg
+from pathway_tpu.internals import expression_compiler as ec
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.testing import T
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _column(table, name="c"):
+    df = dbg.table_to_pandas(table)
+    G.clear()
+    return sorted(df[name].tolist(), key=repr)
+
+
+def _both_ways(make_table, build, monkeypatch):
+    """(fast-path result, forced per-row result) of the same pipeline."""
+    fast = _column(build(make_table()))
+    monkeypatch.setenv("PATHWAY_UDF_LIFT", "off")
+    monkeypatch.setenv("PATHWAY_UDF_TRACE", "off")
+    try:
+        slow = _column(build(make_table()))
+    finally:
+        monkeypatch.delenv("PATHWAY_UDF_LIFT")
+        monkeypatch.delenv("PATHWAY_UDF_TRACE")
+    return fast, slow
+
+
+def _assert_parity(make_table, fn, ret, monkeypatch, n_args=1):
+    def build(t):
+        args = [t.a] if n_args == 1 else [t.a, t.b]
+        return t.select(c=pw.apply_with_type(fn, ret, *args))
+
+    fast, slow = _both_ways(make_table, build, monkeypatch)
+    assert fast == slow, (fast, slow)
+    return fast
+
+
+# ---- method-call chains --------------------------------------------------
+
+
+def test_method_chain_lifts_and_matches(monkeypatch):
+    before = ec.UDF_STATS["lifted_total"]
+    out = _assert_parity(
+        lambda: T("a\nFoo\nBAR\nbaz"),
+        lambda s: s.lower() + "!", str, monkeypatch,
+    )
+    assert out == ["bar!", "baz!", "foo!"]
+    assert ec.UDF_STATS["lifted_total"] > before  # fast path really lifted
+
+
+def test_longer_method_chain(monkeypatch):
+    out = _assert_parity(
+        lambda: T("a\n xax \n byb "),
+        lambda s: s.strip().replace("a", "o").title(), str, monkeypatch,
+    )
+    assert out == ["Byb", "Xox"]
+
+
+def test_predicate_methods(monkeypatch):
+    _assert_parity(
+        lambda: T("a\nfoo\nbar"),
+        lambda s: s.startswith("f"), bool, monkeypatch,
+    )
+    _assert_parity(
+        lambda: T("a\nfoo\nbar"),
+        lambda s: s.endswith("o") or s.find("r") >= 0, bool, monkeypatch,
+    )
+
+
+# ---- dict/tuple-style access ---------------------------------------------
+
+
+def test_tuple_access(monkeypatch):
+    def make():
+        return dbg.table_from_rows(
+            pw.schema_from_types(a=tuple), [((3, 4),), ((5, 6),)]
+        )
+
+    out = _assert_parity(make, lambda t: t[1] * 10, int, monkeypatch)
+    assert out == [40, 60]
+
+
+def test_json_dict_access(monkeypatch):
+    from pathway_tpu.internals.json import Json
+
+    def make():
+        return dbg.table_from_rows(
+            pw.schema_from_types(a=pw.Json if hasattr(pw, "Json") else dict),
+            [(Json({"x": 2}),), (Json({"x": 5}),)],
+        )
+
+    def build(t):
+        return t.select(c=pw.apply_with_type(lambda r: r["x"], pw.Json if hasattr(pw, "Json") else int, t.a))
+
+    fast, slow = _both_ways(make, build, monkeypatch)
+    assert fast == slow
+
+
+# ---- conditionals ---------------------------------------------------------
+
+
+def test_ternary(monkeypatch):
+    out = _assert_parity(
+        lambda: T("a\n-3\n0\n7"),
+        lambda a: a if a > 0 else -a, int, monkeypatch,
+    )
+    assert out == [0, 3, 7]
+
+
+def test_if_return_statements(monkeypatch):
+    def grade(x: int) -> str:
+        if x >= 90:
+            return "A"
+        if x >= 80:
+            return "B"
+        return "C"
+
+    out = _assert_parity(
+        lambda: T("a\n95\n85\n10"), grade, str, monkeypatch
+    )
+    assert out == ["A", "B", "C"]
+
+
+def test_bool_ops_and_not(monkeypatch):
+    _assert_parity(
+        lambda: T("a | b\n1 | 0\n5 | 3\n0 | 0"),
+        lambda a, b: a > 0 and b > 0, bool, monkeypatch, n_args=2,
+    )
+    _assert_parity(
+        lambda: T("a | b\n1 | 0\n0 | 3\n0 | 0"),
+        lambda a, b: a > 0 or b > 0, bool, monkeypatch, n_args=2,
+    )
+    _assert_parity(
+        lambda: T("a\n0\n2"),
+        lambda a: not a > 1, bool, monkeypatch,
+    )
+
+
+def test_conditional_with_division_error_semantics(monkeypatch):
+    # the lifted if_else evaluates a//b eagerly: b==0 rows yield per-row
+    # Error VALUES in the untaken branch, which where-selection discards
+    # — exactly the per-row short-circuit result
+    out = _assert_parity(
+        lambda: T("a | b\n8 | 2\n9 | 0"),
+        lambda a, b: a // b if b != 0 else -1, int, monkeypatch, n_args=2,
+    )
+    assert out == [-1, 4]
+
+
+# ---- builtin subset -------------------------------------------------------
+
+
+def test_builtins(monkeypatch):
+    _assert_parity(
+        lambda: T("a\nfoo\nquux"), lambda s: len(s) * 2, int, monkeypatch
+    )
+    _assert_parity(
+        lambda: T("a\n-3\n4"), lambda a: abs(a) + 1, int, monkeypatch
+    )
+    _assert_parity(
+        lambda: T("a\n3\n4"), lambda a: str(a) + "x", str, monkeypatch
+    )
+    _assert_parity(
+        lambda: T("a\n3\n4"), lambda a: float(a) / 2, float, monkeypatch
+    )
+    _assert_parity(
+        lambda: T("a | b\n3 | 7\n9 | 2"),
+        lambda a, b: min(a, b) * 100 + max(a, b), int, monkeypatch,
+        n_args=2,
+    )
+
+
+def test_round_builtin_matches_python(monkeypatch):
+    # 1-arg round returns int (banker's rounding); 2-arg keeps float
+    out = _assert_parity(
+        lambda: T("a\n0.5\n1.5\n2.345"),
+        lambda a: round(a), int, monkeypatch,
+    )
+    assert out == [0, 2, 2]
+    _assert_parity(
+        lambda: T("a\n2.345\n1.114"),
+        lambda a: round(a, 1), float, monkeypatch,
+    )
+
+
+def test_fstring(monkeypatch):
+    out = _assert_parity(
+        lambda: T("a\n1\n2"), lambda a: f"v={a}!", str, monkeypatch
+    )
+    assert out == ["v=1!", "v=2!"]
+
+
+# ---- error semantics ------------------------------------------------------
+
+
+def test_error_rows_match_per_row_path(monkeypatch):
+    def build(t):
+        return t.select(c=pw.fill_error(
+            pw.apply_with_type(
+                lambda a, b: a // b + len(str(a)), int, t.a, t.b
+            ),
+            -1,
+        ))
+
+    fast, slow = _both_ways(
+        lambda: T("a | b\n8 | 2\n9 | 0\n10 | 5"), build, monkeypatch
+    )
+    assert fast == slow == [-1, 2 + 2, 4 + 1]
+
+
+# ---- None propagation -----------------------------------------------------
+
+
+def test_none_propagation_optional_column(monkeypatch):
+    # a None-guarded conditional lifts to if_else(is_none(x), ...) whose
+    # per-row truthiness selection reproduces the per-row result exactly;
+    # unguarded None-touching batches are kept per-row by the trace
+    # signature guard
+    def make():
+        return dbg.table_from_rows(
+            pw.schema_from_types(a=int | None), [(3,), (None,), (5,)]
+        )
+
+    out = _assert_parity(
+        make, lambda x: 0 if x is None else x + 1, int, monkeypatch
+    )
+    assert out == [0, 4, 6]
+
+
+# ---- probe-row tracing ----------------------------------------------------
+
+
+def test_traced_plan_matches_per_row(monkeypatch):
+    fn = eval("lambda a: abs(a) * 3 + 7")  # no source, LOAD_GLOBAL abs
+    before = ec.UDF_STATS["traced_total"]
+    out = _assert_parity(
+        lambda: T("a\n1\n-2\n3"), fn, int, monkeypatch
+    )
+    assert out == [10, 13, 16]
+    assert ec.UDF_STATS["traced_total"] > before
+
+
+def test_traced_method_chain_matches_per_row(monkeypatch):
+    fn = eval("lambda s: s.strip().upper()")
+    out = _assert_parity(
+        lambda: T("a\n x \n yo "), fn, str, monkeypatch
+    )
+    assert out == ["X", "YO"]
+
+
+def test_dtype_signature_guard_retraces_on_mixed_stream(monkeypatch):
+    # int batch then float batch through a source-less UDF: each dtype
+    # signature gets its own traced plan (coalescing disabled so the two
+    # commit windows stay separate batches)
+    monkeypatch.setenv("PATHWAY_INGEST_COALESCE_WINDOWS", "0")
+    fn = eval("lambda x: abs(x) * 3")
+
+    def run_stream():
+        G.clear()
+
+        class Feed(pw.io.python.ConnectorSubject):
+            def run(self):
+                for v in (1, -2, 3):
+                    self.next(x=v)
+                self.commit()
+                for v in (1.5, -2.5):
+                    self.next(x=v)
+                self.commit()
+
+        t = pw.io.python.read(
+            Feed(),
+            schema=pw.schema_from_types(x=object),
+            autocommit_duration_ms=None,
+        )
+        sel = t.select(c=pw.apply_with_type(fn, float, t.x))
+        got = []
+        pw.io.subscribe(
+            sel,
+            on_change=lambda key, row, time, is_addition: got.append(
+                row["c"]
+            ),
+        )
+        pw.run()
+        G.clear()
+        return sorted(got)
+
+    before = ec.UDF_STATS["traced_total"]
+    fast = run_stream()
+    traced_delta = ec.UDF_STATS["traced_total"] - before
+    monkeypatch.setenv("PATHWAY_UDF_TRACE", "off")
+    monkeypatch.setenv("PATHWAY_UDF_LIFT", "off")
+    slow = run_stream()
+    assert fast == slow == sorted([3.0, 6.0, 9.0, 4.5, 7.5])
+    assert traced_delta == 2  # one plan per dtype signature
+
+
+def test_mixed_types_within_one_batch_stay_per_row(monkeypatch):
+    fn = eval("lambda x: x * 2")
+    # LOAD_GLOBAL-free, so defeat the static lift by schema: ANY column
+    # with str+int in ONE batch — the signature guard must refuse a plan
+    # and the per-row path must serve both types
+    def make():
+        return dbg.table_from_rows(
+            pw.schema_from_types(a=object), [(3,), ("ab",)]
+        )
+
+    def build(t):
+        return t.select(c=pw.apply_with_type(fn, object, t.a))
+
+    fast, slow = _both_ways(make, build, monkeypatch)
+    assert fast == slow == sorted([6, "abab"], key=repr)
+
+
+# ---- review regressions ---------------------------------------------------
+
+
+def test_wraps_decorated_udf_runs_the_wrapper():
+    """functools.wraps unwinds getsource to the ORIGINAL body — the AST
+    lifter must refuse, not silently compile the undecorated function."""
+    import functools
+
+    def base(x: int) -> int:
+        return x + 1
+
+    @functools.wraps(base)
+    def doubled(*args, **kwargs):
+        return base(*args, **kwargs) * 2
+
+    t = T("a\n5\n7")
+    out = _column(t.select(c=pw.apply_with_type(doubled, int, t.a)))
+    assert out == [12, 16]  # (x+1)*2 — the wrapper's behavior, per row
+
+
+def test_int_builtin_nan_matches_python(monkeypatch):
+    # int(nan) must be a per-row Error (Python raises), never a silent
+    # INT64_MIN from a dense astype
+    def build(t):
+        return t.select(c=pw.fill_error(
+            pw.apply_with_type(lambda a: int(a), int, t.a), -7
+        ))
+
+    def make():
+        return dbg.table_from_rows(
+            pw.schema_from_types(a=float), [(2.5,), (float("nan"),)]
+        )
+
+    fast, slow = _both_ways(make, build, monkeypatch)
+    assert fast == slow == [-7, 2]
+
+
+def test_min_max_nan_matches_python(monkeypatch):
+    # Python: min(nan, x) is nan, min(x, nan) is x (NaN compares False)
+    import math
+
+    def build(t):
+        return t.select(c=pw.apply_with_type(
+            lambda a: min(a, 1.0) + 0, float, t.a
+        ))
+
+    def make():
+        return dbg.table_from_rows(
+            pw.schema_from_types(a=float), [(0.5,), (float("nan"),), (2.0,)]
+        )
+
+    fast, slow = _both_ways(make, build, monkeypatch)
+    assert [repr(v) for v in fast] == [repr(v) for v in slow]
+    assert sum(1 for v in fast if isinstance(v, float) and math.isnan(v)) == 1
+
+
+def test_get_on_non_dict_receiver_matches_per_row(monkeypatch):
+    # tuple has no .get: per-row raises AttributeError into an Error row;
+    # the lift/trace paths must NOT silently index the tuple
+    def make():
+        return dbg.table_from_rows(
+            pw.schema_from_types(a=tuple), [((9, 8),)]
+        )
+
+    def build(t):
+        return t.select(c=pw.fill_error(
+            pw.apply_with_type(lambda r: r.get(0, -1), int, t.a), -99
+        ))
+
+    fast, slow = _both_ways(make, build, monkeypatch)
+    assert fast == slow == [-99]
+
+
+def test_get_on_dict_receiver_traces(monkeypatch):
+    fn = eval("lambda r: r.get('x', -1)")  # source-less: tracer path
+
+    def make():
+        return dbg.table_from_rows(
+            pw.schema_from_types(a=object), [({"x": 4},), ({"y": 9},)]
+        )
+
+    def build(t):
+        return t.select(c=pw.apply_with_type(fn, int, t.a))
+
+    fast, slow = _both_ways(make, build, monkeypatch)
+    assert fast == slow == [-1, 4]
+
+
+# ---- impure UDFs provably stay per-row ------------------------------------
+
+
+def test_rng_udf_not_lifted(monkeypatch):
+    import random
+
+    def noisy(x):
+        return x + random.random()
+
+    t = T("a\n1\n2\n3")
+    before = ec.UDF_STATS["perrow_rows_total"]
+    out = t.select(c=pw.apply_with_type(noisy, float, t.a))
+    vals = _column(out)
+    assert ec.UDF_STATS["perrow_rows_total"] - before >= 3
+    # three independent draws — a lifted/traced plan would have reused one
+    fracs = {round(v % 1, 9) for v in vals}
+    assert len(fracs) == 3
+
+
+def test_closure_mutation_stays_per_row(monkeypatch):
+    seen = []
+
+    def note(x):
+        seen.append(x)
+        return x * 2
+
+    t = T("a\n1\n2\n3")
+    assert _column(t.select(c=pw.apply_with_type(note, int, t.a))) == [
+        2, 4, 6,
+    ]
+    assert sorted(seen) == [1, 2, 3]  # once per ROW, not once per trace
+
+
+# ---- refusal-cache eviction (satellite #1) --------------------------------
+
+
+def test_evict_oldest_half_order():
+    from pathway_tpu.internals.udf_lift import evict_oldest_half
+
+    d = {i: None for i in range(100)}
+    evict_oldest_half(d)
+    assert list(d) == list(range(50, 100))
+
+
+def test_lift_refused_eviction_keeps_codes_consistent(monkeypatch):
+    saved = dict(ec._LIFT_REFUSED), set(ec._LIFT_REFUSED_CODES)
+    try:
+        ec._LIFT_REFUSED.clear()
+        ec._LIFT_REFUSED_CODES.clear()
+        fakes = [
+            compile(f"lambda: {i}", "<fake>", "eval") for i in range(4096)
+        ]
+        for c in fakes:
+            ec._LIFT_REFUSED[(c, (), ())] = None
+            ec._LIFT_REFUSED_CODES.add(c)
+        # a genuinely unliftable lambda pushes past the cap -> the OLDEST
+        # half is evicted (no cliff) and CODES mirrors surviving keys
+        cell = [7]
+        t = T("a\n1")
+        _column(t.select(c=pw.apply_with_type(
+            lambda x: x + cell[0], int, t.a
+        )))
+        assert 1 <= len(ec._LIFT_REFUSED) <= 2049
+        assert ec._LIFT_REFUSED_CODES == {k[0] for k in ec._LIFT_REFUSED}
+        # the oldest fakes are gone, the newest survive
+        assert (fakes[0], (), ()) not in ec._LIFT_REFUSED
+        assert (fakes[-1], (), ()) in ec._LIFT_REFUSED
+    finally:
+        ec._LIFT_REFUSED.clear()
+        ec._LIFT_REFUSED.update(saved[0])
+        ec._LIFT_REFUSED_CODES.clear()
+        ec._LIFT_REFUSED_CODES.update(saved[1])
