@@ -22,6 +22,14 @@ queries immediately see new documents (one dataflow, no rebuild).
 from __future__ import annotations
 
 import argparse
+import os
+
+# Static-analysis suppressions (`pathway-tpu lint examples/`):
+# - a document store's index/state is SUPPOSED to grow with the corpus —
+#   there is no temporal cutoff to add;
+# - the parse/split/embed UDFs run arbitrary document-processing Python
+#   per row by design (they are io-heavy, not expression-shaped).
+# pathway: ignore[unbounded-state, perrow-udf]
 
 import pathway_tpu as pw
 from pathway_tpu.stdlib.indexing.nearest_neighbors import BruteForceKnnFactory
@@ -40,6 +48,9 @@ def main() -> None:
     ap.add_argument("--max-tokens", type=int, default=256)
     args = ap.parse_args()
 
+    # a watch directory that does not exist yet is an empty corpus, not an
+    # error — create it so `serve.py` works (and lints) out of the box
+    os.makedirs(args.docs, exist_ok=True)
     docs = pw.io.fs.read(
         args.docs, format="binary", mode="streaming", with_metadata=True,
     )

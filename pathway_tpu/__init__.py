@@ -124,6 +124,9 @@ from .internals.row_transformer import (  # noqa: E402
 )
 
 
+from .analysis import analyze  # noqa: E402
+
+
 def set_license_key(key: str | None) -> None:  # compatibility no-op
     pass
 
@@ -152,6 +155,7 @@ __all__ = [
     "Type",
     "UDF",
     "Universe",
+    "analyze",
     "apply",
     "apply_async",
     "attribute",

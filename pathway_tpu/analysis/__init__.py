@@ -1,0 +1,82 @@
+"""Static analysis of compiled dataflow graphs (``pathway-tpu lint``).
+
+The reference engine compiles whole expression DAGs and rejects bad
+plans before a single row flows (SURVEY §1.3); this package gives the
+reproduction the same ahead-of-time discipline: :func:`analyze` lowers
+the currently-registered parse graph to engine operators WITHOUT
+executing anything and runs a battery of passes over it —
+
+- ``unbounded-state``: groupby/join state growing forever over a
+  never-ending source (names the ForgetAfter / spill-budget mitigation);
+- ``nondeterministic-udf``: RNG/time/io inside UDFs of persisted /
+  exactly-once pipelines (replay divergence);
+- ``perrow-udf``: UDFs that fail both the static lift and the
+  probe-trace gate, with the exact refusal reason;
+- ``fusion-chain``: maximal pure linear operator chains + their
+  intermediate materialization cost (ROADMAP item 3's scouting report);
+- ``shard-skew``: provably low-cardinality keys vs the worker count;
+- ``sink-no-persistence`` / ``sink-name-collision`` / ``dlq-collision``:
+  output-plane misconfiguration.
+
+The report also carries a stable structural fingerprint per operator —
+the identity primitive graph-version migration (ROADMAP item 4) needs.
+
+Surfaces: ``pw.analyze()`` (this function), the ``pathway-tpu lint
+<script.py>`` CLI verb (``analysis/lint.py``: machine-readable JSON,
+severity exit codes, ``# pathway: ignore[<id>]`` suppressions), and the
+repo's own AST gate framework (``analysis/astgate.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .report import CATALOG, Diagnostic, Report
+
+__all__ = ["CATALOG", "Diagnostic", "Report", "analyze"]
+
+
+def analyze(
+    *,
+    persistence_config: Any = None,
+    n_workers: int | None = None,
+) -> Report:
+    """Statically analyze the dataflow registered so far (everything
+    ``pw.run()`` would execute). Lowering runs for real — expression
+    compilation included — but nothing executes: no sources start, no
+    sinks open, no rows flow.
+
+    ``persistence_config``: the config the eventual ``pw.run`` will use
+    (enables the replay-determinism and exactly-once checks); under
+    ``pathway-tpu lint`` it is captured from the script's own stubbed
+    ``pw.run`` call. ``n_workers``: the deployment's worker count for the
+    shard-skew pass (default: PATHWAY_LINT_WORKERS, then the current
+    config's total_workers)."""
+    from .graph import fingerprint_nodes, lower_current_graph, node_labels
+    from .passes import AnalysisContext, run_passes
+
+    runner = lower_current_graph()
+    ctx = AnalysisContext(
+        runner,
+        persistence_config=persistence_config,
+        n_workers=n_workers,
+    )
+    report = Report()
+    report.diagnostics = run_passes(ctx)
+    fps = fingerprint_nodes(ctx.nodes)
+    labels = node_labels(ctx.nodes)
+    report.fingerprints = {
+        labels[nid]: fps[nid]
+        for nid in sorted(
+            labels, key=lambda i: int(labels[i].split(":", 1)[0])
+        )
+        if nid in fps
+    }
+    report.stats = {
+        "operators": len(ctx.nodes),
+        "delivery_sinks": len(runner.sink_specs),
+        "plain_sinks": runner.plain_sinks,
+        "workers_modeled": ctx.n_workers,
+        "persisted": ctx.persisted,
+    }
+    return report

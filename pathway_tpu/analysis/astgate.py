@@ -1,0 +1,382 @@
+"""Shared AST-walker framework for the repo's static gates.
+
+Three ad-hoc checkers grew across PRs 3-10 (``scripts/check_knobs.py``,
+``check_sink_paths.py``, ``check_ingest_paths.py``), each re-implementing
+file walking, AST parsing and call collection. This module is the one
+framework they (and new gates) ride:
+
+- file/AST helpers: :func:`iter_py_files`, :func:`parse_file` (cached),
+  :func:`calls_in`, :func:`method_defs`, :func:`import_aliases`,
+  :func:`calls_inside_loops`, :func:`call_guarded`;
+- a gate registry: decorate a ``() -> list[str]`` function with
+  :func:`gate` and ``scripts/check_all.py`` runs every registered gate
+  as one tier-1 entry;
+- two repo gates that previously drifted by hand:
+  :func:`chaos_sites_gate` — every chaos site declared in
+  ``chaos/plan.py`` has a live injector call-site in the engine; and
+  :func:`metrics_surface_gate` — every ``EngineStats`` counter/gauge is
+  shipped by the hub snapshot and rendered on ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Callable, Iterator
+
+__all__ = [
+    "ROOT",
+    "PACKAGE_DIR",
+    "calls_in",
+    "call_guarded",
+    "calls_inside_loops",
+    "chaos_sites_gate",
+    "gate",
+    "gates",
+    "import_aliases",
+    "iter_py_files",
+    "metrics_surface_gate",
+    "method_defs",
+    "parse_file",
+    "read_text",
+    "run_gates",
+]
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+PACKAGE_DIR = os.path.join(ROOT, "pathway_tpu")
+
+_PARSE_CACHE: dict[str, ast.Module] = {}
+_TEXT_CACHE: dict[str, str] = {}
+
+
+def read_text(path: str) -> str:
+    if path not in _TEXT_CACHE:
+        with open(path, encoding="utf-8") as f:
+            _TEXT_CACHE[path] = f.read()
+    return _TEXT_CACHE[path]
+
+
+def parse_file(path: str) -> ast.Module:
+    if path not in _PARSE_CACHE:
+        _PARSE_CACHE[path] = ast.parse(read_text(path), filename=path)
+    return _PARSE_CACHE[path]
+
+
+def iter_py_files(root: str | None = None) -> Iterator[str]:
+    """Every ``.py`` under ``root`` (default: the package), sorted, with
+    ``__pycache__`` pruned."""
+    root = root or PACKAGE_DIR
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def calls_in(node: ast.AST) -> set[str]:
+    """Names called anywhere under ``node`` — both ``f(...)`` and
+    ``obj.f(...)`` register ``f``."""
+    out: set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                out.add(f.attr)
+    return out
+
+
+def method_defs(tree: ast.Module, cls: str) -> dict[str, ast.FunctionDef]:
+    """name -> def node for the methods of top-level class ``cls``."""
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            return {
+                n.name: n
+                for n in node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+    return {}
+
+
+def import_aliases(tree: ast.Module, module_suffix: str) -> dict[str, str]:
+    """local name -> imported name for every ``from X import a as b``
+    where ``X`` ends with ``module_suffix`` (relative imports included:
+    ``from ..chaos import wrap_backend as _chaos_wrap``)."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == module_suffix or mod.endswith("." + module_suffix) or (
+                node.level > 0 and mod.split(".")[-1:] == [module_suffix]
+            ):
+                for alias in node.names:
+                    out[alias.asname or alias.name] = alias.name
+    return out
+
+
+def calls_inside_loops(tree: ast.AST, attr: str) -> list[int]:
+    """Line numbers of ``*.{attr}(...)`` calls lexically inside a
+    for/while loop anywhere under ``tree``."""
+    hits: list[int] = []
+
+    class _W(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.depth = 0
+
+        def _loop(self, node: ast.AST) -> None:
+            self.depth += 1
+            self.generic_visit(node)
+            self.depth -= 1
+
+        visit_For = _loop
+        visit_While = _loop
+        visit_AsyncFor = _loop
+
+        def visit_Call(self, node: ast.Call) -> None:
+            if (
+                self.depth > 0
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == attr
+            ):
+                hits.append(node.lineno)
+            self.generic_visit(node)
+
+    _W().visit(tree)
+    return hits
+
+
+def call_guarded(fn: ast.AST, call: ast.Call) -> bool:
+    """Is ``call`` nested under some ``if`` within ``fn``?"""
+
+    class _F(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.guarded = False
+            self.depth = 0
+
+        def visit_If(self, node: ast.If) -> None:
+            self.depth += 1
+            self.generic_visit(node)
+            self.depth -= 1
+
+        def visit_Call(self, node: ast.Call) -> None:
+            if node is call and self.depth > 0:
+                self.guarded = True
+            self.generic_visit(node)
+
+    f = _F()
+    f.visit(fn)
+    return f.guarded
+
+
+# ---------------------------------------------------------------------------
+# gate registry
+# ---------------------------------------------------------------------------
+
+#: name -> (description, gate fn returning a list of problem strings)
+gates: dict[str, tuple[str, Callable[[], list[str]]]] = {}
+
+
+def gate(name: str, description: str):
+    """Register a repo gate. The function returns problem strings (empty
+    = green); ``scripts/check_all.py`` runs every registered gate."""
+
+    def deco(fn: Callable[[], list[str]]):
+        gates[name] = (description, fn)
+        return fn
+
+    return deco
+
+
+def run_gates(names: list[str] | None = None) -> dict[str, list[str]]:
+    """Run the selected (default: all) registered gates; name -> problems."""
+    out: dict[str, list[str]] = {}
+    for name, (_desc, fn) in sorted(gates.items()):
+        if names is not None and name not in names:
+            continue
+        out[name] = fn()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# gate: every chaos site has a live injector call-site
+# ---------------------------------------------------------------------------
+
+
+def declared_chaos_sites() -> list[str]:
+    """The ``_SITES`` tuple of ``chaos/plan.py``, read from source (the
+    gate must see the declaration, not a possibly-shadowed import)."""
+    tree = parse_file(os.path.join(PACKAGE_DIR, "chaos", "plan.py"))
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "_SITES" for t in node.targets
+        ):
+            value = ast.literal_eval(node.value)
+            return list(value)
+    raise AssertionError("chaos/plan.py: _SITES declaration not found")
+
+
+def injector_accessors() -> dict[str, str]:
+    """site -> ActiveFaults accessor method name, derived from
+    ``chaos/injector.py``: each accessor filters ``f.site == "<site>"``."""
+    tree = parse_file(os.path.join(PACKAGE_DIR, "chaos", "injector.py"))
+    out: dict[str, str] = {}
+    for name, fn in method_defs(tree, "ActiveFaults").items():
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Compare) and len(node.ops) == 1 and (
+                isinstance(node.ops[0], ast.Eq)
+                and isinstance(node.left, ast.Attribute)
+                and node.left.attr == "site"
+                and len(node.comparators) == 1
+                and isinstance(node.comparators[0], ast.Constant)
+            ):
+                out[node.comparators[0].value] = name
+    return out
+
+
+@gate(
+    "chaos_sites",
+    "every chaos site declared in chaos/plan.py has a live injector "
+    "call-site in the engine",
+)
+def chaos_sites_gate() -> list[str]:
+    sites = declared_chaos_sites()
+    accessors = injector_accessors()
+    problems: list[str] = []
+    missing_accessor = [s for s in sites if s not in accessors]
+    for s in missing_accessor:
+        problems.append(
+            f"site {s!r} declared in plan.py has no ActiveFaults accessor "
+            "in injector.py (no way to arm it)"
+        )
+    # who calls each accessor outside chaos/ — both `armed.tick_fault(...)`
+    # attribute calls and `from ..chaos import wrap_backend as alias` calls
+    called: dict[str, list[str]] = {a: [] for a in accessors.values()}
+    chaos_dir = os.path.join(PACKAGE_DIR, "chaos")
+    for path in iter_py_files():
+        if path.startswith(chaos_dir + os.sep):
+            continue
+        tree = parse_file(path)
+        aliases = import_aliases(tree, "chaos")
+        rel = os.path.relpath(path, ROOT)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = None
+            if isinstance(f, ast.Attribute):
+                name = f.attr
+            elif isinstance(f, ast.Name):
+                name = aliases.get(f.id, f.id if f.id in called else None)
+            if name in called:
+                called[name].append(rel)
+    for site in sites:
+        accessor = accessors.get(site)
+        if accessor is None:
+            continue  # already reported above
+        if not called.get(accessor):
+            problems.append(
+                f"site {site!r}: accessor ActiveFaults.{accessor}() is "
+                "never called outside chaos/ — the site is declared but "
+                "nothing can ever fire it"
+            )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# gate: every EngineStats counter/gauge reaches /metrics
+# ---------------------------------------------------------------------------
+
+#: EngineStats field -> the derived snapshot key it ships under
+#: (ages/uptimes are computed at snapshot time so remote clocks never mix)
+DERIVED_SNAPSHOT_KEYS = {
+    "started_at": "uptime_s",
+    "last_heartbeat": "heartbeat_age_s",
+    "latency_updated_at": "latency_age_s",
+}
+
+#: fields that deliberately never enter the snapshot (reason recorded so
+#: the exemption is auditable; anything NEW must render or be added here)
+NOT_SNAPSHOTTED = {
+    "detailed": "control flag (turns per-node timing on), not a metric",
+    "time_by_node": (
+        "raw feed of node_time_hist, which renders as "
+        "pathway_operator_processing_seconds"
+    ),
+}
+
+#: snapshot keys that ship to the hub but are liveness surface
+#: (/healthz, /readyz, signals plane), not /metrics series
+NOT_RENDERED = {
+    "finished": "liveness surface: /healthz reports run completion",
+    "sources_connected": "readiness surface: first half of /readyz",
+    "heartbeat_age_s": "liveness surface: /healthz wedge detection",
+    "e2e_ms": (
+        "signals-plane gauge companion; the distribution renders as "
+        "pathway_ingest_to_emit_seconds"
+    ),
+}
+
+
+def engine_stats_fields() -> list[str]:
+    """Public ``self.X = ...`` targets of ``EngineStats.__init__``."""
+    tree = parse_file(os.path.join(PACKAGE_DIR, "engine", "executor.py"))
+    init = method_defs(tree, "EngineStats").get("__init__")
+    if init is None:
+        raise AssertionError("EngineStats.__init__ not found")
+    fields: list[str] = []
+    for node in ast.walk(init):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for t in targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+                and not t.attr.startswith("_")
+                and t.attr not in fields
+            ):
+                fields.append(t.attr)
+    return fields
+
+
+@gate(
+    "metrics_surface",
+    "every EngineStats counter/gauge ships in the hub snapshot and "
+    "renders on /metrics (or carries an audited exemption)",
+)
+def metrics_surface_gate() -> list[str]:
+    hub_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "hub.py")
+    )
+    prom_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "prometheus.py")
+    )
+    problems: list[str] = []
+    for field in engine_stats_fields():
+        if field in NOT_SNAPSHOTTED:
+            continue
+        key = DERIVED_SNAPSHOT_KEYS.get(field, field)
+        if not re.search(rf"[\"']{re.escape(key)}[\"']", hub_src):
+            problems.append(
+                f"EngineStats.{field}: snapshot key {key!r} does not "
+                "appear in observability/hub.py stats_snapshot — the "
+                "metric never leaves the worker (add it to the snapshot, "
+                "or record an exemption in astgate.NOT_SNAPSHOTTED)"
+            )
+            continue
+        if key in NOT_RENDERED:
+            continue
+        if not re.search(rf"[\"']{re.escape(key)}[\"']", prom_src):
+            problems.append(
+                f"EngineStats.{field}: snapshot key {key!r} is shipped "
+                "by the hub but never consumed in observability/"
+                "prometheus.py — it silently vanishes from /metrics "
+                "(render it, or record an exemption in "
+                "astgate.NOT_RENDERED)"
+            )
+    return problems

@@ -21,7 +21,9 @@ import click
 
 from .internals.config import MAX_WORKERS
 
-__all__ = ["main", "spawn", "replay", "rescale", "top", "trace", "dlq"]
+__all__ = [
+    "main", "spawn", "replay", "rescale", "top", "trace", "dlq", "lint",
+]
 
 
 @click.group()
@@ -515,6 +517,59 @@ def dlq(dlq_dir, sink_name, tail_n) -> None:
                 f"error={e.get('error')!r} row={_json.dumps(e.get('row'))}"
             )
     click.echo(f"total: {total} row(s) across {len(files)} sink(s)")
+
+
+@main.command()
+@click.option("--json", "as_json", is_flag=True, default=False,
+              help="machine-readable JSON report (one document per script)")
+@click.option("--workers", "n_workers", type=int, default=None,
+              help="worker count the deployment targets (shard-skew "
+                   "modeling; default PATHWAY_LINT_WORKERS or the "
+                   "current config)")
+@click.option("--fail-on",
+              type=click.Choice(["error", "warning", "never"]),
+              default="warning", show_default=True,
+              help="severity threshold for a nonzero exit code")
+@click.option("--no-fingerprints", is_flag=True, default=False,
+              help="omit the per-operator fingerprint table")
+@click.argument("targets", nargs=-1, required=True,
+                type=click.Path(exists=True))
+def lint(as_json, n_workers, fail_on, no_fingerprints, targets):
+    """Statically analyze pipeline scripts without running them.
+
+    Each TARGET (a script, or a directory expanded to every .py beneath
+    it) executes in build-only mode — ``pw.run()`` is stubbed, nothing
+    flows — and the compiled dataflow graph is checked for unbounded
+    state growth, replay-nondeterministic UDFs, per-row dispatch tax,
+    fusion opportunities, shard skew and sink misconfiguration, with a
+    stable structural fingerprint per operator. Suppress a finding
+    inline with ``# pathway: ignore[<id>]``.
+
+    Exit codes: 0 clean (or info only), 1 warnings, 2 errors, 3 a
+    script crashed while building (thresholded by --fail-on)."""
+    import json as _json
+
+    from .analysis.lint import lint_targets
+
+    results, code = lint_targets(
+        list(targets), n_workers=n_workers, fail_on=fail_on
+    )
+    if as_json:
+        click.echo(_json.dumps([r["doc"] for r in results], indent=2))
+    else:
+        for r in results:
+            if r["crash"] is not None:
+                click.echo(
+                    f"== pathway-tpu lint: {r['report'].script} ==\n"
+                    f"script crashed while building its graph: "
+                    f"{r['doc']['crash']}",
+                    err=True,
+                )
+            else:
+                click.echo(
+                    r["report"].render(fingerprints=not no_fingerprints)
+                )
+    sys.exit(code)
 
 
 @main.group()

@@ -150,6 +150,30 @@ class Node:
     #: class can name mode-dependent fields.
     STATE_FIELDS: tuple[str, ...] = ()
 
+    #: static-analysis verdict on this operator's state growth
+    #: (pathway_tpu/analysis unbounded-state pass): None = stateless or no
+    #: verdict; False = state grows with the number of distinct keys/rows
+    #: seen (groupby arenas, join arrangements — unbounded over a
+    #: never-ending source unless something upstream forgets); True = state
+    #: is bounded by construction (temporal buffers drain on watermark
+    #: progress).
+    ANALYSIS_STATE_BOUNDED: "bool | None" = None
+
+    def analysis_forgets(self) -> bool:
+        """Does this operator RETRACT rows once the watermark passes them
+        (bounding every stateful consumer downstream)? ForgetAfter with
+        ``forget_state`` answers True; the analyzer treats such a node as
+        a state-growth firewall on the source→stateful-operator path."""
+        return False
+
+    def analysis_signature(self) -> tuple:
+        """Operator-specific structural parameters folded into the stable
+        operator fingerprint (analysis/fingerprint.py — the identity
+        primitive graph-version migration keys on). Must be identity-free:
+        derived from construction parameters only, never node ids or
+        object identities, so two compiles of the same script agree."""
+        return ()
+
     #: how this operator's persisted state repartitions when the cluster is
     #: resharded from N to M workers (rescale/resharder.py):
     #:

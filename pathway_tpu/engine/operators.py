@@ -84,6 +84,11 @@ class Rowwise(Node):
         super().__init__([inp], list(exprs.keys()))
         self._exprs = exprs
 
+    def analysis_exprs(self) -> dict:
+        """Compiled per-column kernels for the analyzer (each may carry
+        ``_pw_expr``/``_pw_dtype`` breadcrumbs from compile_expr)."""
+        return self._exprs
+
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d = ins[0]
         if d is None or not len(d):
@@ -96,6 +101,9 @@ class Filter(Node):
     def __init__(self, inp: Node, predicate: CompiledExpr):
         super().__init__([inp], inp.column_names)
         self._predicate = predicate
+
+    def analysis_exprs(self) -> dict:
+        return {"__pred__": self._predicate}
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d = ins[0]
@@ -156,6 +164,9 @@ class Reindex(Node):
         super().__init__([inp], keep)
         self._key_column = key_column
         self._keep = keep
+
+    def analysis_signature(self) -> tuple:
+        return (self._key_column, tuple(self._keep))
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d = ins[0]
@@ -449,6 +460,21 @@ class GroupByReduce(Node):
             ]
 
     _DENSE_DTYPES = ("i", "u", "f", "b")
+
+    #: group state grows with the number of distinct keys — unbounded over
+    #: a never-ending source unless something upstream forgets
+    ANALYSIS_STATE_BOUNDED = False
+
+    def analysis_signature(self) -> tuple:
+        return (
+            tuple(self._group_cols),
+            tuple(
+                (name, type(r).__name__, tuple(args))
+                for name, r, args in self._reducers
+            ),
+            self._key_from_column,
+            self._skip_errors,
+        )
 
     def exchange_specs(self):
         if self._key_from_column is not None:
@@ -1744,6 +1770,18 @@ class Join(Node):
         "_cleft", "_cright", "_left", "_right", "_lpad", "_rpad", "_idstate"
     )
 
+    #: both sides' arrangements retain every row seen — unbounded over a
+    #: never-ending source unless something upstream forgets
+    ANALYSIS_STATE_BOUNDED = False
+
+    def analysis_signature(self) -> tuple:
+        return (
+            self._ljk, self._rjk,
+            tuple(self._lcols), tuple(self._rcols),
+            self._mode, self._key_mode,
+            self._emit_matched, self._react_to_right,
+        )
+
     # -- streaming snapshots (persistence/snapshots.py write_parts) -------
     #
     # A sorted-merge arrangement under the memory budget holds most of
@@ -2738,8 +2776,15 @@ class BufferUntil(Node):
 
     STATE_FIELDS = ("_buffer", "_watermark")
 
+    #: the buffer drains as the watermark advances — bounded by lateness,
+    #: not by stream length
+    ANALYSIS_STATE_BOUNDED = True
+
     split_state = classmethod(_split_temporal_state)
     merge_states = classmethod(_merge_temporal_states)
+
+    def analysis_signature(self) -> tuple:
+        return (self._col, self._wm_col)
 
     def __init__(self, inp: Node, threshold_col: str, watermark_col: str | None = None):
         super().__init__([inp], inp.column_names)
@@ -2820,8 +2865,19 @@ class ForgetAfter(Node):
 
     STATE_FIELDS = ("_live", "_watermark")
 
+    #: live-set is bounded by the watermark horizon, not stream length
+    ANALYSIS_STATE_BOUNDED = True
+
     split_state = classmethod(_split_temporal_state)
     merge_states = classmethod(_merge_temporal_states)
+
+    def analysis_forgets(self) -> bool:
+        # with forget_state, rows are RETRACTED once the watermark passes
+        # them — every stateful consumer downstream sees bounded state
+        return self._forget
+
+    def analysis_signature(self) -> tuple:
+        return (self._col, self._forget, self._wm_col)
 
     def __init__(
         self,
@@ -2905,6 +2961,10 @@ class Deduplicate(Node):
 
     STATE_FIELDS = ("_state",)
 
+    #: one accepted-row entry per distinct instance key, kept forever —
+    #: unbounded over a never-ending source of fresh instances
+    ANALYSIS_STATE_BOUNDED = False
+
     def __init__(self, inp: Node, value_col: str, instance_col: str | None, acceptor):
         super().__init__([inp], inp.column_names)
         self._value_col = value_col
@@ -2912,6 +2972,9 @@ class Deduplicate(Node):
         self._acceptor = acceptor
         # instance_key -> [accepted_value, row, out_key]
         self._state: dict[int, list] = {}
+
+    def analysis_signature(self) -> tuple:
+        return (self._value_col, self._instance_col)
 
     def exchange_specs(self):
         if self._instance_col is None:

@@ -135,6 +135,15 @@ def compile_expr(expr: ColumnExpression, env: ColumnEnv) -> Compiled:
     if cache is not None and sig in cache:
         return cache[sig]
     result = _compile_expr_uncached(expr, env)
+    try:
+        # static-analysis breadcrumbs (pathway_tpu/analysis): the lowered
+        # engine nodes hold only compiled kernels — tagging each kernel
+        # with its source expression tree + static dtype lets the analyzer
+        # walk the compiled graph without re-deriving the compile
+        result.fn._pw_expr = expr
+        result.fn._pw_dtype = result.dtype
+    except (AttributeError, TypeError):
+        pass
     if cache is not None:
         cache[sig] = result
     return result
@@ -941,14 +950,48 @@ def _build_apply(
             return any(x[1].is_optional for x in p + list(kp.values()))
         return False
 
+    def _note_outcome(status: str, refusal: str | None = None) -> None:
+        # static-analysis breadcrumb (analysis/passes.py dispatch-tax
+        # pass): which ladder rung this apply landed on, and — when it
+        # fell off the static lift — exactly why
+        try:
+            expr._pw_lift_outcome = {
+                "status": status,
+                "refusal": refusal,
+                "traceable": None,  # filled on the dynamic path
+            }
+        except (AttributeError, TypeError):
+            pass
+
+    #: why the static lift was not even attempted (analysis surfaces it)
+    refusal_reason: str | None = None
+    if not lift_eligible:
+        if not deterministic:
+            refusal_reason = "declared non-deterministic"
+        elif is_coro:
+            refusal_reason = "async UDF"
+        elif prop_none:
+            refusal_reason = "propagate_none=True"
+        else:
+            refusal_reason = "PATHWAY_UDF_LIFT=off"
+
     # ---- 1. static lift (exec trace, then AST) -----------------------
     if lift_eligible and getattr(fn_user, "__code__", None) is not None:
         if (
-            fn_user.__code__ not in _LIFT_REFUSED_CODES
-            or _lift_key() not in _LIFT_REFUSED
-        ) and not _args_optional():
+            fn_user.__code__ in _LIFT_REFUSED_CODES
+            and _lift_key() in _LIFT_REFUSED
+        ):
+            # memoized refusal: skip the re-trace, keep the recorded why
+            refusal_reason = _LIFT_REFUSED[_lift_key()]
+        elif _args_optional():
+            refusal_reason = (
+                "Optional-dtype arguments (runtime probe-trace handles "
+                "None-carrying batches instead)"
+            )
+        else:
             traced = None
-            if _liftable(fn_user):
+            gate_reason = _liftable_reason(fn_user)
+            if gate_reason is None:
                 # execution trace (reference expression.rs:325 — no
                 # Python in the hot loop): call the lambda on the
                 # ARGUMENT EXPRESSIONS; a pure-operator lambda returns a
@@ -966,18 +1009,30 @@ def _build_apply(
                 # conditionals, builtin subset — no user code runs
                 from .udf_lift import ast_lift
 
-                traced = ast_lift(fn_user, expr._args, expr._kwargs)
+                ast_why: list = []
+                traced = ast_lift(
+                    fn_user, expr._args, expr._kwargs, reason_out=ast_why
+                )
+                if traced is None:
+                    refusal_reason = gate_reason or (
+                        f"AST lift: {ast_why[0]}" if ast_why
+                        else "AST lift refused"
+                    )
             lifted = None
             if traced is not None:
                 try:
                     lifted, _odt, agg, refs = _build(traced, env, xp_name)
-                except Exception:
+                except Exception as e:
                     # the traced tree may hit operator/dtype combinations
                     # the columnar compiler refuses (e.g. str * int);
                     # per-row Python still handles those
                     lifted = None
+                    refusal_reason = (
+                        f"columnar compile refused the lifted tree: {e}"
+                    )
             if lifted is not None:
                 UDF_STATS["lifted_total"] += 1
+                _note_outcome("lifted")
                 return (
                     _align_dtype(_guard(lifted), expr._return_type),
                     expr._return_type, agg, refs,
@@ -988,7 +1043,7 @@ def _build_apply(
                 evict_oldest_half(_LIFT_REFUSED)
                 _LIFT_REFUSED_CODES.clear()
                 _LIFT_REFUSED_CODES.update(k[0] for k in _LIFT_REFUSED)
-            _LIFT_REFUSED[_lift_key()] = None
+            _LIFT_REFUSED[_lift_key()] = refusal_reason
             _LIFT_REFUSED_CODES.add(fn_user.__code__)
 
     parts, kparts = _arg_parts()
@@ -1030,6 +1085,7 @@ def _build_apply(
                     out[i] = r
             return _densify(out, expr._return_type)
 
+        _note_outcome("async", refusal_reason)
         return fn_async, expr._return_type, False, refs
 
     # ---- 2./3. runtime: probe-row trace, else vectorized per-row -----
@@ -1038,6 +1094,11 @@ def _build_apply(
         from .udf_lift import traceable
 
         trace_ok = traceable(fn_user)
+    _note_outcome("dynamic", refusal_reason)
+    try:
+        expr._pw_lift_outcome["traceable"] = trace_ok
+    except (AttributeError, TypeError):
+        pass
     plans: dict[tuple, Callable] = {}
     refused_sigs: set = set()
 
@@ -1098,8 +1159,10 @@ def _build_apply(
     return fn, expr._return_type, False, refs
 
 
-#: (fn code, arg dtypes) of apply lambdas whose lift attempt failed —
-#: rebuilds skip the re-trace and land on the per-row kernel directly.
+#: (fn code, arg dtypes) -> refusal reason (str | None) of apply lambdas
+#: whose lift attempt failed — rebuilds skip the re-trace and land on the
+#: per-row kernel directly, carrying the recorded reason into the
+#: dispatch-tax lint diagnostic.
 #: Insertion-ordered dict so hitting the cap evicts the OLDEST half
 #: instead of clearing wholesale (a long-lived multi-pipeline process
 #: must not re-trace every lambda at once); _LIFT_REFUSED_CODES is
@@ -1110,11 +1173,17 @@ def _build_apply(
 _LIFT_REFUSED: dict = {}
 _LIFT_REFUSED_CODES: set = set()
 #: liftability verdict per code object (bytecode-only property, so the
-#: code object is the exact cache key); skips the dis scan on rebuilds
-_LIFTABLE_CACHE: dict[Any, bool] = {}
+#: code object is the exact cache key); skips the dis scan on rebuilds.
+#: Value is None (liftable) or the first blocking construct as a string
+#: (surfaced verbatim by the per-row dispatch-tax lint diagnostic)
+_LIFTABLE_CACHE: dict[Any, str | None] = {}
 
 
 def _liftable(fn: Callable) -> bool:
+    return _liftable_reason(fn) is None
+
+
+def _liftable_reason(fn: Callable) -> str | None:
     """Safe to trace symbolically: a plain function whose bytecode contains
     no calls, no global/closure reads and no imports — so executing it once
     on expression placeholders cannot run user side effects per trace that
@@ -1122,18 +1191,18 @@ def _liftable(fn: Callable) -> bool:
     state. Operator expressions (``lambda x: x * 2 + 1``) pass; anything
     calling functions, reading globals/closures, or branching on values
     (guarded separately by ColumnExpression.__bool__ raising) falls back.
-    Memoized per code object — the verdict is a pure bytecode property."""
+    Returns None when liftable, else the first blocking construct (the
+    dispatch-tax diagnostic surfaces it verbatim). Memoized per code
+    object — the verdict is a pure bytecode property."""
     code = getattr(fn, "__code__", None)
-    if code is not None:
-        hit = _LIFTABLE_CACHE.get(code)
-        if hit is not None:
-            return hit
+    if code is not None and code in _LIFTABLE_CACHE:
+        return _LIFTABLE_CACHE[code]
     import dis
 
     try:
         instructions = list(dis.get_instructions(fn))
     except TypeError:
-        return False
+        return "not introspectable bytecode"
     blocked = (
         "CALL", "LOAD_GLOBAL", "LOAD_DEREF", "IMPORT", "MAKE_FUNCTION",
         # writes are side effects too: lifting would elide the per-row
@@ -1149,9 +1218,12 @@ def _liftable(fn: Callable) -> bool:
         # None-handling branch would vanish from the traced tree
         "IS_OP", "POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE",
     )
-    verdict = not any(
-        ins.opname.startswith(blocked) for ins in instructions
-    )
+    verdict: str | None = None
+    for ins in instructions:
+        if ins.opname.startswith(blocked):
+            what = f" ({ins.argval})" if isinstance(ins.argval, str) else ""
+            verdict = f"bytecode gate: {ins.opname}{what}"
+            break
     if code is not None:
         if len(_LIFTABLE_CACHE) >= 1024:
             from .udf_lift import evict_oldest_half

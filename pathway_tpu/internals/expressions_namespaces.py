@@ -281,8 +281,11 @@ _METHODS: dict[str, tuple[Callable, Callable]] = {
     "str.startswith": (lambda s, p: s.startswith(p), lambda ts: dt.BOOL),
     "str.endswith": (lambda s, p: s.endswith(p), lambda ts: dt.BOOL),
     "str.replace": (lambda s, o, n, c: s.replace(o, n, c), lambda ts: dt.STR),
+    # exact Python list semantics (a lifted `s.split(...)` must be
+    # cell-for-cell identical to the per-row path; the engine used to
+    # wrap in tuple, which diverged on == and isinstance checks)
     "str.split": (
-        lambda s, sep, m: tuple(s.split(sep, m)),
+        lambda s, sep, m: s.split(sep, m),
         lambda ts: dt.List(dt.STR),
     ),
     "str.slice": (lambda s, a, b: s[a:b], lambda ts: dt.STR),
@@ -303,6 +306,11 @@ _METHODS: dict[str, tuple[Callable, Callable]] = {
     "dt.nanosecond": (lambda v: v.microsecond * 1000, lambda ts: dt.INT),
     "dt.strftime": (lambda v, fmt: v.strftime(fmt), lambda ts: dt.STR),
     "dt.weekday": (lambda v: v.weekday(), lambda ts: dt.INT),
+    # exact Python datetime.timestamp() for lifted UDFs (udf_lift): tz-
+    # aware datetimes convert exactly; naive ones use the LOCAL timezone,
+    # like Python — deliberately distinct from dt.timestamp(unit=...),
+    # whose naive anchor is the epoch (reference date_time.py:384)
+    "py.timestamp": (lambda v: v.timestamp(), lambda ts: dt.FLOAT),
     # Duration totals (reference date_time.py:1119-1465: all are *total*
     # durations as ints, truncating toward zero like chrono's num_*)
     "dt.nanoseconds": (lambda d: _td_ns(d), lambda ts: dt.INT),

@@ -723,6 +723,10 @@ class GraphRunner:
                             if m.any():
                                 jks[m] = K.ERROR_KEY
                 return jks
+            # static analysis (shard-skew pass): the per-key compiled
+            # kernels carry _pw_dtype/_pw_expr breadcrumbs; expose them
+            # through the mixing closure the Rowwise node actually holds
+            fn._pw_key_fns = fns
             return fn
 
         lrw = self._add(ops.Rowwise(lnode, {

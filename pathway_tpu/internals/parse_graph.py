@@ -63,6 +63,17 @@ class ParseGraph:
         self.solver.register_as_disjoint(*universes, promised=True)
 
     def add_sink(self, sink: Any) -> None:
+        from . import lintmode
+
+        if lintmode.ACTIVE and isinstance(sink, dict):
+            # static analysis: anchor sink diagnostics to the script line
+            # that registered the output connector
+            loc = lintmode.script_location()
+            if loc is not None:
+                target = sink.get("delivery")
+                (target if isinstance(target, dict) else sink)[
+                    "_lint_loc"
+                ] = loc
         self.sinks.append(sink)
 
 

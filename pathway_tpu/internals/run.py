@@ -25,6 +25,14 @@ def run(
     """Build and run the whole dataflow (all sinks registered so far).
     Blocks until all sources finish (streaming sources may run forever —
     stop from another thread with ``request_stop()``)."""
+    from . import lintmode
+
+    if lintmode.ACTIVE:
+        # `pathway-tpu lint` / pw.analyze() drive the script only to BUILD
+        # its graph; execution (and every side effect behind it) is skipped
+        # and the analyzer reads the parse graph + this captured config
+        lintmode.note_run(persistence_config)
+        return
     from .tracing import init_from_env
 
     init_from_env()  # each pw.run re-reads PATHWAY_TRACE_FILE

@@ -46,6 +46,13 @@ class Table(TableLike):
         self._schema = schema
         self._universe = universe
         self._table_seq = next(Table._id_seq)
+        from . import lintmode
+
+        if lintmode.ACTIVE:
+            # static analysis: remember which script line built this table
+            # so diagnostics (and `# pathway: ignore[...]` suppressions)
+            # can anchor to source
+            lintmode.note_table(self._table_seq)
         from .error_log_table import current_build_scope
 
         #: pw.local_error_log() scope active when this table was built —

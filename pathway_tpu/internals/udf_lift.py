@@ -85,9 +85,12 @@ class TraceRefused(BaseException):
 # Python method name -> expression builder. Every builder constructs a
 # MethodCallExpression whose engine impl (expressions_namespaces._METHODS)
 # is the EXACT Python method it replaces, so lifted and per-row semantics
-# agree cell for cell. Methods with divergent engine semantics (``split``
-# returns a tuple engine-side, ``timestamp`` is tz-sensitive) are
-# deliberately absent.
+# agree cell for cell. The two long-refused corners are now aligned
+# instead of absent: the engine's ``str.split`` returns a plain Python
+# list (it used to wrap in tuple), and ``timestamp`` maps to
+# ``py.timestamp`` — the genuine ``datetime.timestamp()``, tz-aware
+# exactly like Python (naive datetimes use the local timezone, unlike
+# the namespace's epoch-anchored ``dt.timestamp(unit=...)``).
 _METHOD_LIFTS: dict[str, Callable[..., ColumnExpression]] = {
     "lower": lambda r: MethodCallExpression("str.lower", [r]),
     "upper": lambda r: MethodCallExpression("str.upper", [r]),
@@ -108,8 +111,12 @@ _METHOD_LIFTS: dict[str, Callable[..., ColumnExpression]] = {
     "replace": lambda r, o, n, c=-1: MethodCallExpression(
         "str.replace", [r, o, n, c]
     ),
+    "split": lambda r, sep=None, m=-1: MethodCallExpression(
+        "str.split", [r, sep, m]
+    ),
     "strftime": lambda r, f: MethodCallExpression("dt.strftime", [r, f]),
     "weekday": lambda r: MethodCallExpression("dt.weekday", [r]),
+    "timestamp": lambda r: MethodCallExpression("py.timestamp", [r]),
 }
 
 #: methods only the VALUE TRACER may lift: their compiled expression
@@ -225,23 +232,36 @@ _NODE_BUDGET = 400
 
 
 def ast_lift(
-    fn: Callable, args: tuple, kwargs: dict[str, Any]
+    fn: Callable,
+    args: tuple,
+    kwargs: dict[str, Any],
+    reason_out: list | None = None,
 ) -> ColumnExpression | None:
     """Build the ColumnExpression equivalent of ``fn(*args, **kwargs)``
     from ``fn``'s source AST, or None when any construct falls outside
     the liftable subset (source unavailable, closures/globals, loops,
     unknown methods...). ``args``/``kwargs`` are the already-coerced
-    argument ColumnExpressions of the apply node."""
+    argument ColumnExpressions of the apply node. ``reason_out``, when
+    given, receives the refusing construct as a string — the static
+    analyzer's dispatch-tax diagnostic reports it verbatim."""
     try:
         node = _fn_ast(fn)
         if node is None:
+            if reason_out is not None:
+                reason_out.append("source unavailable or ambiguous")
             return None
         scope = _bind_params(fn, node, args, kwargs)
         lifter = _AstLifter(fn)
         if isinstance(node, ast.Lambda):
             return lifter.lift(node.body, scope)
         return lifter.lift_body(list(node.body), scope)
-    except (LiftRefused, RecursionError):
+    except RecursionError:
+        if reason_out is not None:
+            reason_out.append("recursion limit during lift")
+        return None
+    except LiftRefused as e:
+        if reason_out is not None:
+            reason_out.append(str(e) or "refused construct")
         return None
 
 

@@ -1,0 +1,68 @@
+"""Run every registered repo gate — the single static-check entrypoint.
+
+Gates ride the shared AST-walker framework
+(``pathway_tpu/analysis/astgate.py``). Importing the three check scripts
+registers their gates (knobs, sink_paths, ingest_paths); the framework
+itself ships two more (chaos_sites, metrics_surface). One command, one
+tier-1 test entry (``tests/test_check_all.py``) — replacing the three
+separate check-script wrappers that accumulated across PRs 3-10.
+
+    python scripts/check_all.py             # run everything
+    python scripts/check_all.py knobs ...   # run selected gates
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+for p in (ROOT, SCRIPTS):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+from pathway_tpu.analysis import astgate  # noqa: E402
+
+# importing the check scripts registers their gates on the framework
+import check_ingest_paths  # noqa: E402,F401
+import check_knobs  # noqa: E402,F401
+import check_sink_paths  # noqa: E402,F401
+
+
+def run(names: list[str] | None = None) -> dict[str, list[str]]:
+    """name -> problems for the selected (default: all) gates."""
+    known = set(astgate.gates)
+    if names:
+        unknown = set(names) - known
+        if unknown:
+            raise SystemExit(
+                f"unknown gate(s) {sorted(unknown)}; known: {sorted(known)}"
+            )
+    return astgate.run_gates(names)
+
+
+def main(argv: list[str] | None = None) -> int:
+    names = list(argv if argv is not None else sys.argv[1:]) or None
+    results = run(names)
+    failed = {k: v for k, v in results.items() if v}
+    for name in sorted(results):
+        desc = astgate.gates[name][0]
+        if results[name]:
+            print(f"FAIL {name}: {desc}", file=sys.stderr)
+            for p in results[name]:
+                print(f"  {p}", file=sys.stderr)
+        else:
+            print(f"ok   {name}: {desc}")
+    if failed:
+        print(
+            f"check_all FAILED ({len(failed)}/{len(results)} gate(s))",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"check_all OK ({len(results)} gate(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

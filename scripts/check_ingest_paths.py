@@ -1,4 +1,4 @@
-"""Static check: the rowwise connector path routes through the shared
+"""Static gate: the rowwise connector path routes through the shared
 batch coalescer — no naked per-row flush paths regress back in.
 
 The per-row ingest API (``ConnectorSubject.next`` and friends,
@@ -9,7 +9,7 @@ flushes a full chunk (its ``_queue.put`` sits under the chunk-size
 guard), and whole-buffer flushes live in the small sanctioned set of
 flush methods. A future "fix" that makes ``next()`` put per row — or
 adds a helper that drains one entry at a time inside a loop — silently
-reintroduces the ~1.3µs/row cross-thread handoff this PR removed.
+reintroduces the ~1.3µs/row cross-thread handoff PR 10 removed.
 
 Checks, all AST-level over ``pathway_tpu/io/python.py``:
 
@@ -24,8 +24,9 @@ Checks, all AST-level over ``pathway_tpu/io/python.py``:
 4. no ``put`` anywhere in the module executes inside a ``for``/``while``
    loop — the signature of a per-row flush path.
 
-Usable standalone (``python scripts/check_ingest_paths.py`` → exit 0/1)
-and as a tier-1 test (``tests/test_check_ingest_paths.py``).
+Rides the shared AST-gate framework (``pathway_tpu/analysis/astgate.py``)
+and registers as the ``ingest_paths`` gate for ``scripts/check_all.py``.
+Usable standalone: ``python scripts/check_ingest_paths.py`` → exit 0/1.
 """
 
 from __future__ import annotations
@@ -35,7 +36,12 @@ import os
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-TARGET = os.path.join(ROOT, "pathway_tpu", "io", "python.py")
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from pathway_tpu.analysis import astgate  # noqa: E402
+
+TARGET = os.path.join(astgate.PACKAGE_DIR, "io", "python.py")
 
 #: per-row emission API — each must buffer through the coalescer
 ROW_ENTRYPOINTS = (
@@ -50,29 +56,6 @@ SANCTIONED_PUTTERS = (
 )
 
 
-def _method_defs(tree: ast.Module, cls: str) -> dict[str, ast.FunctionDef]:
-    for node in tree.body:
-        if isinstance(node, ast.ClassDef) and node.name == cls:
-            return {
-                n.name: n
-                for n in node.body
-                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-            }
-    return {}
-
-
-def _calls_in(fn: ast.AST) -> set[str]:
-    out: set[str] = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call):
-            f = node.func
-            if isinstance(f, ast.Name):
-                out.add(f.id)
-            elif isinstance(f, ast.Attribute):
-                out.add(f.attr)
-    return out
-
-
 def _puts_in(fn: ast.AST) -> list[ast.Call]:
     return [
         node
@@ -83,69 +66,12 @@ def _puts_in(fn: ast.AST) -> list[ast.Call]:
     ]
 
 
-def _put_guarded(fn: ast.FunctionDef, put: ast.Call) -> bool:
-    """Is this ``put`` nested under some conditional within ``fn``?"""
-
-    class _Finder(ast.NodeVisitor):
-        def __init__(self) -> None:
-            self.guarded = False
-            self._depth = 0
-
-        def visit_If(self, node: ast.If) -> None:
-            self._depth += 1
-            self.generic_visit(node)
-            self._depth -= 1
-
-        def visit_Call(self, node: ast.Call) -> None:
-            if node is put and self._depth > 0:
-                self.guarded = True
-            self.generic_visit(node)
-
-    f = _Finder()
-    f.visit(fn)
-    return f.guarded
-
-
-def _put_in_loop(tree: ast.Module) -> list[str]:
-    """puts lexically inside for/while loops anywhere in the module."""
-    problems: list[str] = []
-
-    class _Walker(ast.NodeVisitor):
-        def __init__(self) -> None:
-            self.loop_depth = 0
-
-        def _loop(self, node: ast.AST) -> None:
-            self.loop_depth += 1
-            self.generic_visit(node)
-            self.loop_depth -= 1
-
-        visit_For = _loop
-        visit_While = _loop
-        visit_AsyncFor = _loop
-
-        def visit_Call(self, node: ast.Call) -> None:
-            if (
-                self.loop_depth > 0
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "put"
-            ):
-                problems.append(
-                    f"python.py:{node.lineno} queue put inside a loop "
-                    "(per-row flush path)"
-                )
-            self.generic_visit(node)
-
-    _Walker().visit(tree)
-    return problems
-
-
 def check(path: str | None = None) -> list[str]:
     path = path or TARGET
-    with open(path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
+    tree = ast.parse(astgate.read_text(path), filename=path)
     problems: list[str] = []
 
-    methods = _method_defs(tree, "ConnectorSubject")
+    methods = astgate.method_defs(tree, "ConnectorSubject")
     if not methods:
         return [f"{os.path.basename(path)}: class ConnectorSubject not found"]
 
@@ -154,7 +80,7 @@ def check(path: str | None = None) -> list[str]:
         fn = methods.get(name)
         if fn is None:
             continue
-        calls = _calls_in(fn)
+        calls = astgate.calls_in(fn)
         if "_emit" in calls or any(
             e in calls for e in ROW_ENTRYPOINTS if e != name
         ):
@@ -183,15 +109,28 @@ def check(path: str | None = None) -> list[str]:
     emit = methods.get("_emit")
     if emit is not None:
         for put in _puts_in(emit):
-            if not _put_guarded(emit, put):
+            if not astgate.call_guarded(emit, put):
                 problems.append(
                     f"python.py:{put.lineno} _emit() flushes per entry "
                     "(put not under the chunk-size guard)"
                 )
 
     # 4. no puts inside loops anywhere
-    problems.extend(_put_in_loop(tree))
+    for lineno in astgate.calls_inside_loops(tree, "put"):
+        problems.append(
+            f"python.py:{lineno} queue put inside a loop "
+            "(per-row flush path)"
+        )
     return problems
+
+
+@astgate.gate(
+    "ingest_paths",
+    "the rowwise connector rides the batch coalescer (no per-row queue "
+    "flushes)",
+)
+def ingest_paths_gate() -> list[str]:
+    return check()
 
 
 def main() -> int:

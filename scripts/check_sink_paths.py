@@ -1,4 +1,4 @@
-"""Static check: every ``pw.io`` sink write entrypoint routes through the
+"""Static gate: every ``pw.io`` sink write entrypoint routes through the
 transactional delivery layer (``io/delivery.py``) — no naked external
 writes regress in later PRs.
 
@@ -10,8 +10,9 @@ calls ``deliver(`` in its body or delegates to a module that does (the
 guard exists to catch: a sink wired that way has no retries, no acks, no
 DLQ, no backpressure — an external outage crashes or wedges the worker.
 
-Usable standalone (``python scripts/check_sink_paths.py`` → exit 0/1)
-and as a tier-1 test (``tests/test_check_sink_paths.py``).
+Rides the shared AST-gate framework (``pathway_tpu/analysis/astgate.py``)
+and registers as the ``sink_paths`` gate for ``scripts/check_all.py``.
+Usable standalone: ``python scripts/check_sink_paths.py`` → exit 0/1.
 """
 
 from __future__ import annotations
@@ -21,7 +22,12 @@ import os
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-IO_DIR = os.path.join(ROOT, "pathway_tpu", "io")
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from pathway_tpu.analysis import astgate  # noqa: E402
+
+IO_DIR = os.path.join(astgate.PACKAGE_DIR, "io")
 
 #: public sink entrypoints a connector module may export
 ENTRYPOINTS = ("write", "write_snapshot", "send_alerts")
@@ -39,23 +45,10 @@ DELEGATORS = {
 SKIP = {"__init__.py", "_gated.py", "_object_scanner.py", "delivery.py"}
 
 
-def _calls_in(fn: ast.FunctionDef) -> set[str]:
-    out: set[str] = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call):
-            f = node.func
-            if isinstance(f, ast.Name):
-                out.add(f.id)
-            elif isinstance(f, ast.Attribute):
-                out.add(f.attr)
-    return out
-
-
 def check_module(path: str) -> list[str]:
     """Violations in one io/ module: write entrypoints that neither call
     deliver() nor delegate to a delivery-routed sibling."""
-    with open(path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
+    tree = ast.parse(astgate.read_text(path), filename=path)
     fname = os.path.basename(path)
     delegate_to = DELEGATORS.get(fname)
     problems: list[str] = []
@@ -64,7 +57,7 @@ def check_module(path: str) -> list[str]:
             continue
         if node.name not in ENTRYPOINTS:
             continue
-        calls = _calls_in(node)
+        calls = astgate.calls_in(node)
         if "deliver" in calls:
             continue
         if delegate_to is not None and "write" in calls:
@@ -94,6 +87,19 @@ def check_all(io_dir: str | None = None) -> dict[str, list[str]]:
         if problems:
             out["http/__init__.py"] = problems
     return out
+
+
+@astgate.gate(
+    "sink_paths",
+    "every io/ sink write entrypoint routes through the transactional "
+    "delivery layer",
+)
+def sink_paths_gate() -> list[str]:
+    return [
+        f"{p} — route through pathway_tpu.io.delivery.deliver()"
+        for problems in check_all().values()
+        for p in problems
+    ]
 
 
 def main() -> int:

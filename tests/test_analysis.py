@@ -1,0 +1,470 @@
+"""Static analyzer (pathway_tpu/analysis): seeded-defect matrix.
+
+Every shipped diagnostic is held to BOTH directions: one pipeline seeded
+with the defect (the diagnostic fires) and one clean counterpart (it
+stays quiet). Plus the fingerprint contract: stable across two compiles
+of the same script, different when the graph changes.
+"""
+
+from __future__ import annotations
+
+import datetime
+
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.debug as dbg
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.persistence import Backend, Config
+from pathway_tpu.testing import T
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph(monkeypatch):
+    # the unbounded-state pass downgrades on a set spill budget — tests
+    # must not inherit one from the environment
+    monkeypatch.delenv("PATHWAY_STATE_MEMORY_BUDGET_MB", raising=False)
+    monkeypatch.delenv("PATHWAY_SINK_DLQ_DIR", raising=False)
+    monkeypatch.delenv("PATHWAY_LINT_WORKERS", raising=False)
+    G.clear()
+    yield
+    G.clear()
+
+
+class _Stream(pw.io.python.ConnectorSubject):
+    """A never-ending-source stand-in (RealtimeSource post-lowering)."""
+
+    def run(self):  # pragma: no cover - never polled by the analyzer
+        pass
+
+
+def _stream_table(**cols):
+    cols = cols or {"word": str}
+    return pw.io.python.read(
+        _Stream(), schema=pw.schema_from_types(**cols), name="s"
+    )
+
+
+def _ids(report):
+    return [d.id for d in report.diagnostics]
+
+
+# ---------------------------------------------------------------------------
+# unbounded-state
+# ---------------------------------------------------------------------------
+
+
+def test_unbounded_state_fires_on_streaming_groupby():
+    t = _stream_table()
+    t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+    # reduce() alone registers no sink; subscribe to pull it into the graph
+    pw.io.subscribe(
+        t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count()),
+        on_change=lambda **kw: None,
+    )
+    report = pw.analyze()
+    found = report.by_id("unbounded-state")
+    assert found and found[0].severity == "warning"
+    assert "GroupByReduce" in found[0].message
+    assert "PATHWAY_STATE_MEMORY_BUDGET_MB" in (found[0].mitigation or "")
+
+
+def test_unbounded_state_fires_on_streaming_join():
+    left = _stream_table()
+    right = T("word | label\nfoo | a")
+    res = left.join(right, left.word == right.word).select(
+        pw.left.word, pw.right.label
+    )
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    report = pw.analyze()
+    assert any(
+        "Join" in d.message for d in report.by_id("unbounded-state")
+    )
+
+
+def test_unbounded_state_fires_on_streaming_deduplicate():
+    t = _stream_table(word=str, n=int)
+    res = t.deduplicate(
+        value=pw.this.n, instance=pw.this.word,
+        acceptor=lambda new, old: new > old,
+    )
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    assert any(
+        "Deduplicate" in d.message
+        for d in pw.analyze().by_id("unbounded-state")
+    )
+
+
+def test_unbounded_state_quiet_on_static_source():
+    t = T("word\nfoo\nbar")
+    res = t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    assert not pw.analyze().by_id("unbounded-state")
+
+
+def test_unbounded_state_quiet_behind_forget_after():
+    from pathway_tpu.stdlib.temporal._shared import apply_behavior_nodes
+
+    t = _stream_table(word=str, t=int)
+    # keep_results=False lowers a ForgetAfter(forget_state=True): rows
+    # retract once the watermark passes them — bounded downstream state
+    bounded = apply_behavior_nodes(t, None, pw.this.t, "t", False)
+    res = bounded.groupby(pw.this.word).reduce(
+        pw.this.word, c=pw.reducers.count()
+    )
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    assert not pw.analyze().by_id("unbounded-state")
+
+
+def test_unbounded_state_downgrades_to_info_with_spill_budget(monkeypatch):
+    monkeypatch.setenv("PATHWAY_STATE_MEMORY_BUDGET_MB", "64")
+    t = _stream_table()
+    res = t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    found = pw.analyze().by_id("unbounded-state")
+    assert found and all(d.severity == "info" for d in found)
+
+
+# ---------------------------------------------------------------------------
+# nondeterministic-udf
+# ---------------------------------------------------------------------------
+
+
+def _rng_udf(x):
+    import random
+
+    return x + random.random()
+
+
+def _time_udf(x):
+    import time
+
+    return x + time.time()
+
+
+def test_nondeterministic_udf_fires_when_persisted():
+    t = T("a\n1\n2")
+    res = t.select(c=pw.apply_with_type(_rng_udf, float, pw.this.a))
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    cfg = Config.simple_config(Backend.memory("lint-nondet"))
+    found = pw.analyze(persistence_config=cfg).by_id("nondeterministic-udf")
+    assert found and found[0].severity == "error"
+    assert "random" in found[0].message
+
+
+def test_nondeterministic_time_udf_fires_for_exactly_once_sinks(tmp_path):
+    t = T("a\n1\n2")
+    res = t.select(c=pw.apply_with_type(_time_udf, float, pw.this.a))
+    pw.io.csv.write(res, tmp_path / "out.csv")
+    report = pw.analyze()  # transactional sink present, no persistence
+    found = report.by_id("nondeterministic-udf")
+    assert found and "time" in found[0].message
+
+
+def test_nondeterministic_udf_quiet_without_persistence_or_sinks():
+    t = T("a\n1\n2")
+    res = t.select(c=pw.apply_with_type(_rng_udf, float, pw.this.a))
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    assert not pw.analyze().by_id("nondeterministic-udf")
+
+
+def test_deterministic_uuid_parsing_quiet_but_uuid4_fires():
+    def parse(s):
+        import uuid
+
+        return uuid.UUID(int=s).hex  # pure parsing: replays identically
+
+    def mint(s):
+        import uuid
+
+        return uuid.uuid4().hex  # entropy: replay diverges
+
+    cfg = Config.simple_config(Backend.memory("lint-uuid"))
+    t = T("a\n1\n2")
+    res = t.select(c=pw.apply_with_type(parse, str, pw.this.a))
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    assert not pw.analyze(persistence_config=cfg).by_id(
+        "nondeterministic-udf"
+    )
+    G.clear()
+    t = T("a\n1\n2")
+    res = t.select(c=pw.apply_with_type(mint, str, pw.this.a))
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    found = pw.analyze(persistence_config=cfg).by_id("nondeterministic-udf")
+    assert found and "uuid4" in found[0].message
+
+
+def test_pure_udf_quiet_when_persisted():
+    t = T("a\n1\n2")
+    res = t.select(c=pw.apply_with_type(lambda x: x * 2, int, pw.this.a))
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    cfg = Config.simple_config(Backend.memory("lint-pure"))
+    assert not pw.analyze(persistence_config=cfg).by_id(
+        "nondeterministic-udf"
+    )
+
+
+# ---------------------------------------------------------------------------
+# perrow-udf (dispatch tax)
+# ---------------------------------------------------------------------------
+
+_LOOKUP = {1: "one", 2: "two"}
+
+
+def test_perrow_udf_fires_with_refusal_reason():
+    t = T("a\n1\n2")
+    res = t.select(
+        c=pw.apply_with_type(lambda x: _LOOKUP[x], str, pw.this.a)
+    )
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    found = pw.analyze().by_id("perrow-udf")
+    assert found, "global-lookup UDF must be flagged as per-row"
+    # the exact refusal reason from the lift ladder is surfaced
+    assert "_LOOKUP" in found[0].message or "LOAD_GLOBAL" in found[0].message
+
+
+def test_lifted_udf_quiet():
+    t = T("a\n1\n2")
+    res = t.select(c=pw.apply_with_type(lambda x: x * 2 + 1, int, pw.this.a))
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    assert not pw.analyze().by_id("perrow-udf")
+
+
+def test_traceable_udf_quiet():
+    # refused by the static lift (eval has no source) but traceable at
+    # runtime: not a dispatch-tax finding
+    fn = eval("lambda x: x * 3")
+    t = T("a\n1\n2")
+    res = t.select(c=pw.apply_with_type(fn, int, pw.this.a))
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    assert not pw.analyze().by_id("perrow-udf")
+
+
+# ---------------------------------------------------------------------------
+# fusion-chain
+# ---------------------------------------------------------------------------
+
+
+def test_fusion_chain_reported_for_pure_select_filter_select():
+    t = T("a\n1\n2\n3")
+    res = (
+        t.select(b=pw.this.a * 2)
+        .filter(pw.this.b > 2)
+        .select(c=pw.this.b + 1)
+    )
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    found = pw.analyze().by_id("fusion-chain")
+    assert found and all(d.severity == "info" for d in found)
+    assert any("Filter" in d.message for d in found)
+
+
+def test_fusion_chain_absent_for_single_node():
+    t = T("a\n1\n2")
+    res = t.select(b=pw.this.a * 2)
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    assert not pw.analyze().by_id("fusion-chain")
+
+
+# ---------------------------------------------------------------------------
+# shard-skew
+# ---------------------------------------------------------------------------
+
+
+def test_shard_skew_fires_on_bool_key_at_four_workers():
+    t = T("a\n1\n2\n3")
+    flagged = t.select(flag=pw.this.a > 1, a=pw.this.a)
+    res = flagged.groupby(pw.this.flag).reduce(
+        pw.this.flag, c=pw.reducers.count()
+    )
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    found = pw.analyze(n_workers=4).by_id("shard-skew")
+    assert found and "2 distinct" in found[0].message
+
+
+def test_shard_skew_quiet_on_string_key():
+    t = T("word\nfoo\nbar")
+    res = t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    assert not pw.analyze(n_workers=4).by_id("shard-skew")
+
+
+def test_shard_skew_quiet_single_worker():
+    t = T("a\n1\n2")
+    flagged = t.select(flag=pw.this.a > 1)
+    res = flagged.groupby(pw.this.flag).reduce(
+        pw.this.flag, c=pw.reducers.count()
+    )
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    assert not pw.analyze(n_workers=1).by_id("shard-skew")
+
+
+def test_shard_skew_fires_on_bool_join_key():
+    t = T("a\n1\n2")
+    l = t.select(flag=pw.this.a > 1, a=pw.this.a)
+    r = t.select(flag=pw.this.a > 0, b=pw.this.a)
+    res = l.join(r, l.flag == r.flag).select(pw.left.a, pw.right.b)
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    found = pw.analyze(n_workers=4).by_id("shard-skew")
+    assert any("Join" in d.message for d in found)
+
+
+# ---------------------------------------------------------------------------
+# sink misconfiguration
+# ---------------------------------------------------------------------------
+
+
+def test_sink_no_persistence_fires_and_clears(tmp_path):
+    t = T("a\n1")
+    pw.io.csv.write(t, tmp_path / "out.csv")
+    assert pw.analyze().by_id("sink-no-persistence")
+    cfg = Config.simple_config(Backend.memory("lint-sinks"))
+    assert not pw.analyze(persistence_config=cfg).by_id(
+        "sink-no-persistence"
+    )
+
+
+def test_sink_name_collision_on_shared_basename(tmp_path):
+    t = T("a\n1")
+    (tmp_path / "x").mkdir()
+    (tmp_path / "y").mkdir()
+    pw.io.csv.write(t, tmp_path / "x" / "out.csv")
+    pw.io.csv.write(t, tmp_path / "y" / "out.csv")
+    found = pw.analyze().by_id("sink-name-collision")
+    assert found and "registration" in found[0].message
+
+
+def test_sink_name_collision_quiet_with_explicit_names(tmp_path):
+    t = T("a\n1")
+    (tmp_path / "x").mkdir()
+    (tmp_path / "y").mkdir()
+    pw.io.csv.write(t, tmp_path / "x" / "out.csv", name="first")
+    pw.io.csv.write(t, tmp_path / "y" / "out.csv", name="second")
+    assert not pw.analyze().by_id("sink-name-collision")
+
+
+def test_dlq_collision_with_persistence_root(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_SINK_DLQ_DIR", str(tmp_path / "store"))
+    t = T("a\n1")
+    pw.io.csv.write(t, tmp_path / "out.csv")
+    cfg = Config.simple_config(Backend.filesystem(str(tmp_path / "store")))
+    found = pw.analyze(persistence_config=cfg).by_id("dlq-collision")
+    assert found and "persistence root" in found[0].message
+
+
+def test_dlq_collision_quiet_with_distinct_dirs(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_SINK_DLQ_DIR", str(tmp_path / "dlq"))
+    t = T("a\n1")
+    pw.io.csv.write(t, tmp_path / "out.csv")
+    cfg = Config.simple_config(Backend.filesystem(str(tmp_path / "store")))
+    assert not pw.analyze(persistence_config=cfg).by_id("dlq-collision")
+
+
+# ---------------------------------------------------------------------------
+# operator fingerprints
+# ---------------------------------------------------------------------------
+
+
+def _fp_pipeline(extra_filter: bool = False):
+    G.clear()
+    t = T("word | n\nfoo | 1\nbar | 2")
+    res = t.groupby(pw.this.word).reduce(
+        pw.this.word, s=pw.reducers.sum(pw.this.n)
+    )
+    if extra_filter:
+        res = res.filter(pw.this.s > 0)
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    report = pw.analyze()
+    G.clear()
+    return report.fingerprints
+
+
+def test_fingerprints_stable_across_two_compiles():
+    first = _fp_pipeline()
+    second = _fp_pipeline()
+    assert first == second
+    assert first, "fingerprints must not be empty"
+
+
+def test_fingerprints_change_when_graph_changes():
+    base = _fp_pipeline()
+    changed = _fp_pipeline(extra_filter=True)
+    assert base != changed
+    # the untouched upstream prefix keeps its identity
+    shared = set(base) & set(changed)
+    assert any(base[k] == changed[k] for k in shared)
+
+
+@pytest.mark.slow
+def test_fingerprints_stable_across_processes():
+    """The graph-migration contract: the SAME script fingerprints
+    identically in two different interpreters, even under different
+    hash randomization (set-literal constants in UDF bytecode repr in
+    hash order — the canonicalizer must neutralize that)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    script = (
+        "import os; os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "import json\n"
+        "import pathway_tpu as pw\n"
+        "from pathway_tpu.testing import T\n"
+        "t = T('a\\nalpha\\nbeta')\n"
+        "res = t.select(c=pw.apply_with_type(\n"
+        "    lambda s: s in {'alpha', 'beta', 'gamma', 'delta'},\n"
+        "    bool, pw.this.a))\n"
+        "pw.io.subscribe(res, on_change=lambda **kw: None)\n"
+        "print(json.dumps(pw.analyze().fingerprints))\n"
+    )
+
+    def run(seed):
+        env = {**os.environ, "PYTHONHASHSEED": seed, "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable, "-c", script], env=env,
+            capture_output=True, text=True, timeout=240,
+        )
+        assert out.returncode == 0, out.stderr
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    assert run("1") == run("42")
+
+
+def test_fingerprints_distinguish_expression_change():
+    def build(mult):
+        G.clear()
+        t = T("a\n1\n2")
+        res = t.select(b=pw.this.a * mult)
+        pw.io.subscribe(res, on_change=lambda **kw: None)
+        report = pw.analyze()
+        G.clear()
+        return report.fingerprints
+
+    assert build(2) != build(3)
+
+
+# ---------------------------------------------------------------------------
+# report surface
+# ---------------------------------------------------------------------------
+
+
+def test_report_json_and_exit_codes(tmp_path):
+    t = _stream_table()
+    res = t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    report = pw.analyze()
+    doc = report.to_dict()
+    assert doc["summary"]["warning"] >= 1
+    assert report.exit_code() == 1
+    assert report.exit_code(fail_on="error") == 0
+    assert report.exit_code(fail_on="never") == 0
+    assert all("id" in d and "severity" in d for d in doc["diagnostics"])
+
+
+def test_analyze_counts_operators():
+    t = T("a\n1")
+    res = t.select(b=pw.this.a + 1)
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    report = pw.analyze()
+    assert report.stats["operators"] >= 2
+    assert report.stats["plain_sinks"] == 1

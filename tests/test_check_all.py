@@ -1,0 +1,232 @@
+"""Unified repo-gate runner (scripts/check_all.py) — the single tier-1
+entry replacing the three separate check-script wrappers.
+
+Covers: every registered gate green against the repo; the shared
+AST-walker framework primitives; each gate's seeded-violation behavior
+(the checker itself catches what it claims to); and the knobs gate's new
+doc→read direction (stale documented knobs fail).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import textwrap
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ),
+)
+
+import check_all  # noqa: E402  (registers every gate)
+import check_ingest_paths  # noqa: E402
+import check_knobs  # noqa: E402
+import check_sink_paths  # noqa: E402
+from pathway_tpu.analysis import astgate  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# the repo is green
+# ---------------------------------------------------------------------------
+
+
+def test_all_gates_green():
+    results = check_all.run()
+    failed = {k: v for k, v in results.items() if v}
+    assert not failed, "repo gates failed:\n" + "\n".join(
+        f"{k}: {p}" for k, ps in failed.items() for p in ps
+    )
+
+
+def test_expected_gates_registered():
+    assert set(astgate.gates) >= {
+        "knobs", "sink_paths", "ingest_paths",
+        "chaos_sites", "metrics_surface",
+    }
+
+
+def test_unknown_gate_name_refused():
+    import pytest
+
+    with pytest.raises(SystemExit):
+        check_all.run(["definitely-not-a-gate"])
+
+
+# ---------------------------------------------------------------------------
+# framework primitives
+# ---------------------------------------------------------------------------
+
+
+def test_calls_in_sees_name_and_attribute_calls(tmp_path):
+    import ast
+
+    tree = ast.parse("def f():\n    g()\n    obj.h()\n")
+    assert astgate.calls_in(tree) >= {"g", "h"}
+
+
+def test_import_aliases_resolves_relative_and_renamed(tmp_path):
+    import ast
+
+    tree = ast.parse(
+        "from ..chaos import wrap_backend as _chaos_wrap\n"
+        "from pathway_tpu.chaos import arm\n"
+    )
+    aliases = astgate.import_aliases(tree, "chaos")
+    assert aliases["_chaos_wrap"] == "wrap_backend"
+    assert aliases["arm"] == "arm"
+
+
+def test_calls_inside_loops_finds_put(tmp_path):
+    import ast
+
+    tree = ast.parse(
+        "def f(q):\n    for x in range(3):\n        q.put(x)\n"
+    )
+    assert astgate.calls_inside_loops(tree, "put")
+
+
+# ---------------------------------------------------------------------------
+# knobs gate — both directions
+# ---------------------------------------------------------------------------
+
+
+def test_knob_scan_sees_core_surface():
+    knobs = check_knobs.collect_knobs()
+    assert "PATHWAY_TRACE_FILE" in knobs
+    assert "PATHWAY_FLIGHT_DIR" in knobs
+    assert "PATHWAY_THREADS" in knobs
+    assert "PATHWAY_LINT_WORKERS" in knobs
+
+
+def test_documented_match_is_whole_name(tmp_path):
+    # a documented PATHWAY_TRACE_FILE must not vouch for a hypothetical
+    # undocumented PATHWAY_TRACE substring-knob
+    readme = tmp_path / "README.md"
+    readme.write_text("only `PATHWAY_TRACE_FILE` is documented here")
+    missing = check_knobs.undocumented(readme_path=str(readme))
+    assert "PATHWAY_TRACE_FILE" not in missing
+    assert "PATHWAY_THREADS" in missing
+
+
+def test_scan_matches_wrapped_calls(tmp_path):
+    import re
+
+    text = 'os.environ.get(\n    "PATHWAY_WRAPPED_KNOB"\n)'
+    assert re.search(check_knobs._READ, text)
+
+
+def test_stale_documented_knob_fails(tmp_path):
+    # assembled at runtime so this test file itself never "references" it
+    fake = "PATHWAY_" + "FAKE_STALE" + "_KNOB"
+    readme = tmp_path / "README.md"
+    readme.write_text(f"| `{fake}` | a knob nothing reads anymore |\n")
+    stale = check_knobs.stale_documented(readme_path=str(readme))
+    assert fake in stale
+
+
+def test_stale_check_ignores_wildcard_family_mentions(tmp_path):
+    readme = tmp_path / "README.md"
+    readme.write_text("breaker knobs (`PATHWAY_SINK_BREAKER_*`) exist\n")
+    assert not check_knobs.stale_documented(readme_path=str(readme))
+
+
+def test_no_stale_documented_knobs_in_repo():
+    assert not check_knobs.stale_documented()
+
+
+# ---------------------------------------------------------------------------
+# sink_paths gate — seeded violation
+# ---------------------------------------------------------------------------
+
+
+def test_sink_checker_catches_naked_subscribe(tmp_path):
+    mod = tmp_path / "naked.py"
+    mod.write_text(textwrap.dedent("""
+        def write(table, target):
+            from . import subscribe
+            subscribe(table, on_change=lambda **kw: None)
+    """))
+    problems = check_sink_paths.check_module(str(mod))
+    assert len(problems) == 1
+    assert "subscribe" in problems[0]
+
+
+def test_sink_checker_accepts_deliver_and_delegation(tmp_path):
+    mod = tmp_path / "fslike.py"
+    mod.write_text(textwrap.dedent("""
+        def write(table, target):
+            deliver(table, lambda: None, name=None)
+    """))
+    assert not check_sink_paths.check_module(str(mod))
+
+
+# ---------------------------------------------------------------------------
+# ingest_paths gate — seeded violation
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_checker_catches_per_row_put(tmp_path):
+    mod = tmp_path / "python.py"
+    mod.write_text(textwrap.dedent("""
+        class ConnectorSubject:
+            def _emit(self, entry, plain=True):
+                self._buf.append(entry)
+                if len(self._buf) >= 256:
+                    self._queue.put(self._buf)
+            def next(self, **kwargs):
+                self._queue.put(kwargs)  # naked per-row flush
+    """))
+    problems = check_ingest_paths.check(str(mod))
+    assert any("next()" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
+# chaos_sites gate
+# ---------------------------------------------------------------------------
+
+
+def test_every_declared_site_has_an_accessor():
+    sites = astgate.declared_chaos_sites()
+    accessors = astgate.injector_accessors()
+    assert set(sites) == set(accessors), (
+        "plan.py sites and injector.py accessors drifted"
+    )
+
+
+def test_chaos_gate_would_catch_a_siteless_accessor(monkeypatch):
+    # seed: declare one extra site that no accessor filters on
+    real = astgate.declared_chaos_sites()
+    monkeypatch.setattr(
+        astgate, "declared_chaos_sites",
+        lambda: real + ["made.up.site"],
+    )
+    problems = astgate.chaos_sites_gate()
+    assert any("made.up.site" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
+# metrics_surface gate
+# ---------------------------------------------------------------------------
+
+
+def test_engine_stats_fields_enumerated():
+    fields = astgate.engine_stats_fields()
+    assert "ticks" in fields and "rows_total" in fields
+    assert not any(f.startswith("_") for f in fields)
+
+
+def test_metrics_gate_would_catch_unrendered_key(monkeypatch):
+    # seed: drop the audited exemption for a health-surface key — the
+    # gate must then demand it render on /metrics
+    monkeypatch.delitem(astgate.NOT_RENDERED, "finished")
+    problems = astgate.metrics_surface_gate()
+    assert any("finished" in p for p in problems)
+
+
+def test_metrics_gate_would_catch_unsnapshotted_field(monkeypatch):
+    monkeypatch.delitem(astgate.NOT_SNAPSHOTTED, "time_by_node")
+    problems = astgate.metrics_surface_gate()
+    assert any("time_by_node" in p for p in problems)

@@ -1,0 +1,192 @@
+"""``pathway-tpu lint`` CLI: severity exit codes, suppressions, JSON
+output — and the tier-1 gate that every shipped example lints clean.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+from click.testing import CliRunner
+
+from pathway_tpu.cli import main as cli_main
+from pathway_tpu.internals.parse_graph import G
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph(monkeypatch):
+    monkeypatch.delenv("PATHWAY_STATE_MEMORY_BUDGET_MB", raising=False)
+    monkeypatch.delenv("PATHWAY_LINT_WORKERS", raising=False)
+    G.clear()
+    yield
+    G.clear()
+
+
+def _lint(*args):
+    return CliRunner().invoke(cli_main, ["lint", *args])
+
+
+CLEAN = """
+import pathway_tpu as pw
+from pathway_tpu.testing import T
+
+t = T("a\\n1\\n2")
+res = t.select(b=pw.this.a + 1)
+pw.io.subscribe(res, on_change=lambda **kw: None)
+pw.run()
+"""
+
+WARNING = """
+import pathway_tpu as pw
+
+class S(pw.io.python.ConnectorSubject):
+    def run(self):
+        pass
+
+t = pw.io.python.read(S(), schema=pw.schema_from_types(word=str), name="w")
+res = t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+pw.io.subscribe(res, on_change=lambda **kw: None)
+pw.run()
+"""
+
+ERROR = """
+import pathway_tpu as pw
+from pathway_tpu.testing import T
+
+def udf(x):
+    import random
+    return x + random.random()
+
+t = T("a\\n1\\n2")
+res = t.select(c=pw.apply_with_type(udf, float, pw.this.a))
+pw.io.subscribe(res, on_change=lambda **kw: None)
+pw.run(persistence_config=pw.persistence.Config.simple_config(
+    pw.persistence.Backend.memory("lint-cli-test")))
+"""
+
+CRASH = """
+raise ValueError("broken pipeline script")
+"""
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_clean_script_exits_zero(tmp_path):
+    r = _lint(_write(tmp_path, "clean.py", CLEAN))
+    assert r.exit_code == 0, r.output
+    assert "0 error(s), 0 warning(s)" in r.output
+
+
+def test_warning_script_exits_one(tmp_path):
+    r = _lint(_write(tmp_path, "warn.py", WARNING))
+    assert r.exit_code == 1, r.output
+    assert "unbounded-state" in r.output
+
+
+def test_fail_on_error_ignores_warnings(tmp_path):
+    r = _lint("--fail-on", "error", _write(tmp_path, "warn.py", WARNING))
+    assert r.exit_code == 0, r.output
+
+
+def test_error_script_exits_two(tmp_path):
+    r = _lint(_write(tmp_path, "err.py", ERROR))
+    assert r.exit_code == 2, r.output
+    assert "nondeterministic-udf" in r.output
+
+
+def test_crashing_script_exits_three(tmp_path):
+    r = _lint(_write(tmp_path, "crash.py", CRASH))
+    assert r.exit_code == 3, r.output
+    assert "crashed" in r.output
+
+
+def test_fail_on_never_covers_crashes_too(tmp_path):
+    # "never" means never: a non-building script still reports, but the
+    # run collects non-fatally
+    r = _lint("--fail-on", "never", _write(tmp_path, "crash.py", CRASH))
+    assert r.exit_code == 0, r.output
+
+
+def test_filewide_suppression_cleans_exit(tmp_path):
+    body = "# pathway: ignore[unbounded-state]\n" + WARNING
+    r = _lint(_write(tmp_path, "sup.py", body))
+    assert r.exit_code == 0, r.output
+    assert "suppressed" in r.output
+
+
+def test_line_suppression_is_line_scoped(tmp_path):
+    # suppressing on the WRONG line leaves the finding alive
+    body = WARNING.replace(
+        'name="w")', 'name="w")  # pathway: ignore[unbounded-state]'
+    )
+    r = _lint(_write(tmp_path, "wrongline.py", body))
+    assert r.exit_code == 1, r.output
+
+
+def test_json_output_parses(tmp_path):
+    r = _lint("--json", _write(tmp_path, "warn.py", WARNING))
+    docs = json.loads(r.output)
+    assert len(docs) == 1
+    assert any(
+        d["id"] == "unbounded-state" for d in docs[0]["diagnostics"]
+    )
+    assert docs[0]["fingerprints"]
+    assert docs[0]["summary"]["warning"] >= 1
+
+
+def test_directory_target_expands(tmp_path):
+    _write(tmp_path, "one.py", CLEAN)
+    _write(tmp_path, "two.py", CLEAN)
+    r = _lint(str(tmp_path))
+    assert r.exit_code == 0, r.output
+    assert r.output.count("== pathway-tpu lint:") == 2
+
+
+def test_workers_flag_drives_shard_skew(tmp_path):
+    body = """
+    import pathway_tpu as pw
+    from pathway_tpu.testing import T
+
+    t = T("a\\n1\\n2")
+    flagged = t.select(flag=pw.this.a > 1)
+    res = flagged.groupby(pw.this.flag).reduce(
+        pw.this.flag, c=pw.reducers.count())
+    pw.io.subscribe(res, on_change=lambda **kw: None)
+    pw.run()
+    """
+    path = _write(tmp_path, "skew.py", body)
+    assert "shard-skew" in _lint("--workers", "4", path).output
+    assert "shard-skew" not in _lint("--workers", "1", path).output
+
+
+def test_fingerprints_stable_across_cli_runs(tmp_path):
+    path = _write(tmp_path, "fp.py", CLEAN)
+    a = _lint("--json", path)
+    b = _lint("--json", path)
+    fa = json.loads(a.output)[0]["fingerprints"]
+    fb = json.loads(b.output)[0]["fingerprints"]
+    assert fa == fb and fa
+
+
+# ---------------------------------------------------------------------------
+# tier-1: every shipped example lints clean (or carries an explicit
+# suppression) — the CI wiring the ISSUE asks for
+# ---------------------------------------------------------------------------
+
+
+def test_wordcount_example_lints_clean():
+    r = _lint(os.path.join(REPO, "examples", "wordcount"))
+    assert r.exit_code == 0, r.output
+
+
+def test_rag_server_example_lints_clean():
+    r = _lint(os.path.join(REPO, "examples", "rag_server"))
+    assert r.exit_code == 0, r.output

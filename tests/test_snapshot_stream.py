@@ -114,6 +114,10 @@ def _run_spilled_groupby(tmp_path, monkeypatch, n_groups=6000, val_kb=1,
     monkeypatch.setenv(
         "PATHWAY_STATE_SPILL_DIR", str(tmp_path / "spill")
     )
+    # the spill watermark advances per TICK: ingest coalescing (PR 10)
+    # can merge every commit window into one tick on a fast producer,
+    # leaving nothing cold to spill — keep one tick per commit here
+    monkeypatch.setenv("PATHWAY_INGEST_COALESCE_WINDOWS", "0")
     spill._reset_for_tests()
 
     class S(pw.io.python.ConnectorSubject):

@@ -117,6 +117,120 @@ def test_json_dict_access(monkeypatch):
     assert fast == slow
 
 
+# ---- the two long-refused corners: str.split + tz-aware timestamp -------
+
+
+def test_split_lifts_with_python_list_semantics(monkeypatch):
+    before = ec.UDF_STATS["lifted_total"]
+    out = _assert_parity(
+        lambda: T("a\nx,y,z\nq\na,,b"),
+        lambda s: s.split(","), list, monkeypatch,
+    )
+    # exact Python semantics: a LIST (the engine used to return a tuple,
+    # which kept this method off the lift table)
+    assert sorted(out, key=repr) == sorted(
+        [["x", "y", "z"], ["q"], ["a", "", "b"]], key=repr
+    )
+    assert all(isinstance(v, list) for v in out)
+    assert ec.UDF_STATS["lifted_total"] > before
+
+
+def test_split_whitespace_and_maxsplit(monkeypatch):
+    def make():
+        return dbg.table_from_rows(
+            pw.schema_from_types(a=str),
+            [("  foo  bar baz ",), ("one",)],
+        )
+
+    out = _assert_parity(make, lambda s: s.split(), list, monkeypatch)
+    assert sorted(out, key=repr) == sorted(
+        [["foo", "bar", "baz"], ["one"]], key=repr
+    )
+    out = _assert_parity(
+        lambda: T("a\nx-y-z-w"),
+        lambda s: s.split("-", 2), list, monkeypatch,
+    )
+    assert out == [["x", "y", "z-w"]]
+
+
+def test_split_chained_with_len(monkeypatch):
+    out = _assert_parity(
+        lambda: T("a\nx,y,z\nq"),
+        lambda s: len(s.split(",")), int, monkeypatch,
+    )
+    assert out == [1, 3]
+
+
+def _dt_table():
+    import datetime
+
+    return dbg.table_from_rows(
+        pw.schema_from_types(a=datetime.datetime),
+        [
+            (datetime.datetime(2023, 1, 1, 12, 30),),
+            (datetime.datetime(1970, 1, 2),),
+        ],
+    )
+
+
+def _aware_dt_table():
+    import datetime
+    from zoneinfo import ZoneInfo
+
+    return dbg.table_from_rows(
+        pw.schema_from_types(a=datetime.datetime),
+        [
+            (datetime.datetime(
+                2023, 7, 1, 9, 0, tzinfo=ZoneInfo("Europe/Warsaw")
+            ),),
+            (datetime.datetime(
+                2023, 1, 1, 9, 0, tzinfo=datetime.timezone.utc
+            ),),
+        ],
+    )
+
+
+def test_timestamp_lifts_tz_aware(monkeypatch):
+    before = ec.UDF_STATS["lifted_total"]
+    out = _assert_parity(
+        _aware_dt_table, lambda d: d.timestamp(), float, monkeypatch,
+    )
+    import datetime
+    from zoneinfo import ZoneInfo
+
+    assert sorted(out) == sorted([
+        datetime.datetime(
+            2023, 7, 1, 9, 0, tzinfo=ZoneInfo("Europe/Warsaw")
+        ).timestamp(),
+        datetime.datetime(
+            2023, 1, 1, 9, 0, tzinfo=datetime.timezone.utc
+        ).timestamp(),
+    ])
+    assert ec.UDF_STATS["lifted_total"] > before
+
+
+def test_timestamp_naive_matches_python_local_rule(monkeypatch):
+    # Python interprets a NAIVE datetime in the local timezone; the lifted
+    # kernel must reproduce exactly that (py.timestamp), not the
+    # epoch-anchored dt.timestamp(unit=...) namespace rule
+    out = _assert_parity(
+        _dt_table, lambda d: d.timestamp(), float, monkeypatch,
+    )
+    import datetime
+
+    assert sorted(out) == sorted([
+        datetime.datetime(2023, 1, 1, 12, 30).timestamp(),
+        datetime.datetime(1970, 1, 2).timestamp(),
+    ])
+
+
+def test_timestamp_arithmetic_chain(monkeypatch):
+    out = _assert_parity(
+        _dt_table, lambda d: d.timestamp() / 3600.0, float, monkeypatch,
+    )
+    assert len(out) == 2
+
+
 # ---- conditionals ---------------------------------------------------------
 
 
