@@ -213,6 +213,20 @@ def main() -> None:
     join_reps = [_join_throughput() for _ in range(3)]
     join_rows_per_sec = max(join_reps)
     outer_join_rows_per_sec = _join_throughput(mode="left")
+    # same-host fused-vs-unfused A/B (PATHWAY_FUSION=0 escape hatch): the
+    # unfused companions make the fusion speedup attributable on ANY host
+    # — compare _unfused lanes against the fused numbers above, never
+    # against another round's absolute values
+    with _fusion_off():
+        wc_unfused = max(_wordcount_throughput() for _ in range(2))
+        join_unfused = max(_join_throughput() for _ in range(2))
+        outer_join_unfused = _join_throughput(mode="left")
+        apply_lifted_unfused = max(
+            _apply_throughput()[0] for _ in range(2)
+        )
+    from pathway_tpu.engine.fusion import FUSION_STATS as _FS
+
+    fusion_chains_compiled = int(_FS["chains_total"])
     wc_sharded_t2 = _wordcount_throughput(threads=2)
     wc_sharded_t4 = _wordcount_throughput(threads=4)
     mesh_rows_per_sec = _mesh_exchange_throughput()
@@ -248,6 +262,28 @@ def main() -> None:
             "apply_traced_rows_per_sec": round(apply_traced, 1),
             "join_stream_rows_per_sec": round(join_rows_per_sec, 1),
             "outer_join_stream_rows_per_sec": round(outer_join_rows_per_sec, 1),
+            # whole-graph kernel fusion A/B (engine/fusion.py): the same
+            # lanes through the PATHWAY_FUSION=0 escape hatch, so the
+            # fused speedup is a same-host ratio, not a cross-round guess
+            "wordcount_stream_unfused_rows_per_sec": round(wc_unfused, 1),
+            "join_stream_unfused_rows_per_sec": round(join_unfused, 1),
+            "outer_join_stream_unfused_rows_per_sec": round(
+                outer_join_unfused, 1
+            ),
+            "apply_lifted_unfused_rows_per_sec": round(
+                apply_lifted_unfused, 1
+            ),
+            "fusion_chains_compiled": fusion_chains_compiled,
+            "fusion_speedup": {
+                "wordcount": round(wc_rows_per_sec / wc_unfused, 3),
+                "join": round(join_rows_per_sec / join_unfused, 3),
+                "outer_join": round(
+                    outer_join_rows_per_sec / outer_join_unfused, 3
+                ),
+                "apply_lifted": round(
+                    apply_lifted / apply_lifted_unfused, 3
+                ),
+            },
             # sharded engine numbers are HONEST, not flattering: this host
             # exposes `host_cores` cores — with one core, N workers
             # time-slice it and the ratio measures the distribution tax
@@ -932,6 +968,28 @@ def _comm_codec_throughput(
     dec_s = max(time.perf_counter() - t0, 1e-9)
     mb = nbytes * iters / 1e6
     return mb / enc_s, mb / dec_s, nbytes / n_rows
+
+
+def _fusion_off():
+    """Context manager: run a lane through the PATHWAY_FUSION=0 escape
+    hatch (the knob is read at executor construction, so flipping the
+    env between lanes is exact)."""
+    import contextlib
+    import os
+
+    @contextlib.contextmanager
+    def ctx():
+        prev = os.environ.get("PATHWAY_FUSION")
+        os.environ["PATHWAY_FUSION"] = "0"
+        try:
+            yield
+        finally:
+            if prev is None:
+                os.environ.pop("PATHWAY_FUSION", None)
+            else:
+                os.environ["PATHWAY_FUSION"] = prev
+
+    return ctx()
 
 
 def _wordcount_throughput(

@@ -32,6 +32,8 @@ __all__ = [
     "call_guarded",
     "calls_inside_loops",
     "chaos_sites_gate",
+    "fusion_metrics_gate",
+    "fusion_reasons_gate",
     "gate",
     "gates",
     "import_aliases",
@@ -378,5 +380,117 @@ def metrics_surface_gate() -> list[str]:
                 "prometheus.py — it silently vanishes from /metrics "
                 "(render it, or record an exemption in "
                 "astgate.NOT_RENDERED)"
+            )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# gates: kernel fusion (engine/fusion.py)
+# ---------------------------------------------------------------------------
+
+
+def fusion_module_constants() -> tuple[dict[str, str], list[str]]:
+    """(REASON_* constants, FUSION_STATS keys) parsed from the fusion
+    module's AST — the single source both fusion gates check against."""
+    tree = parse_file(os.path.join(PACKAGE_DIR, "engine", "fusion.py"))
+    reasons: dict[str, str] = {}
+    stats_keys: list[str] = []
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id.startswith("REASON_"):
+                if isinstance(node.value, ast.Constant) and isinstance(
+                    node.value.value, str
+                ):
+                    reasons[t.id] = node.value.value
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        if (
+            target is not None
+            and isinstance(target, ast.Name)
+            and target.id == "FUSION_STATS"
+            and isinstance(getattr(node, "value", None), ast.Dict)
+        ):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    stats_keys.append(k.value)
+    return reasons, stats_keys
+
+
+@gate(
+    "fusion_reasons",
+    "every fusion decline reason (engine/fusion.py REASON_*) is "
+    "exercised by a fusion parity test",
+)
+def fusion_reasons_gate() -> list[str]:
+    reasons, _ = fusion_module_constants()
+    problems: list[str] = []
+    if not reasons:
+        return ["engine/fusion.py declares no REASON_* constants"]
+    test_dir = os.path.join(ROOT, "tests")
+    test_src = ""
+    for fn in sorted(os.listdir(test_dir)):
+        if fn.startswith("test_fusion") and fn.endswith(".py"):
+            test_src += read_text(os.path.join(test_dir, fn))
+    if not test_src:
+        return ["no tests/test_fusion*.py found to cover decline reasons"]
+    for name, text in sorted(reasons.items()):
+        # covered by constant name (preferred: survives rewording) or by
+        # the verbatim string
+        if name not in test_src and text not in test_src:
+            problems.append(
+                f"decline reason {name} ({text!r}) is never referenced "
+                "in tests/test_fusion*.py — a declined chain with this "
+                "reason has no parity test proving the per-node path "
+                "still runs it correctly"
+            )
+    return problems
+
+
+@gate(
+    "fusion_metrics",
+    "every FUSION_STATS counter ships in the hub snapshot and renders "
+    "as pathway_fusion_* on /metrics",
+)
+def fusion_metrics_gate() -> list[str]:
+    _, stats_keys = fusion_module_constants()
+    problems: list[str] = []
+    if not stats_keys:
+        return ["engine/fusion.py declares no FUSION_STATS keys"]
+    hub_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "hub.py")
+    )
+    prom_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "prometheus.py")
+    )
+    ts_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "timeseries.py")
+    )
+    if "fusion_stats_snapshot" not in hub_src or '"fusion"' not in hub_src:
+        problems.append(
+            "observability/hub.py does not ship the fusion counters in "
+            "its snapshot/query documents"
+        )
+    if "pathway_fusion_" not in prom_src or "fusion_stats" not in prom_src:
+        problems.append(
+            "observability/prometheus.py never renders pathway_fusion_* "
+            "— the counters silently vanish from /metrics"
+        )
+    if '"fusion.' not in ts_src and "f\"fusion." not in ts_src:
+        problems.append(
+            "observability/timeseries.py never records the fusion.* "
+            "signals series"
+        )
+    # the prometheus renderer is generic over FUSION_STATS keys, so
+    # per-key coverage is proven at the source: every key must be a
+    # *_total counter or a gauge the renderer's suffix rule understands
+    for key in stats_keys:
+        if not key.endswith("_total"):
+            problems.append(
+                f"FUSION_STATS key {key!r} is not *_total — it would "
+                "render as a gauge; rename it or extend the renderer"
             )
     return problems

@@ -342,73 +342,49 @@ def pass_dispatch_tax(ctx: AnalysisContext) -> list[Diagnostic]:
 # ---------------------------------------------------------------------------
 
 
-def _chain_pure(node: Node) -> bool:
-    """A node is chain-pure when every compiled kernel is either a plain
-    expression tree with no dynamic apply, or an engine-internal
-    projection closure."""
-    hook = getattr(node, "analysis_exprs", None)
-    if hook is None:
-        return False
-    for _name, fn in hook().items():
-        expr = getattr(fn, "_pw_expr", None)
-        if expr is None:
-            continue  # engine-internal projection: pure by construction
-        for e in _walk_expr(expr):
-            if isinstance(e, ApplyExpression):
-                outcome = getattr(e, "_pw_lift_outcome", None)
-                if outcome is None or outcome.get("status") != "lifted":
-                    return False
-    return True
-
-
 def pass_fusion_readiness(ctx: AnalysisContext) -> list[Diagnostic]:
-    chain_types = (ops.Rowwise, ops.Filter)
-    eligible = {
-        id(n): n
-        for n in ctx.nodes
-        if isinstance(n, chain_types) and _chain_pure(n)
-        and len(n.inputs) == 1
-    }
+    """Cross-check of the compiler's ACTUAL fusion decisions: the same
+    chain walk the executor's fusion pass performs (engine/fusion.py
+    ``plan_chains`` — one implementation, so analyzer and compiler can
+    never disagree on chain shape), with each chain's fuse/decline
+    verdict surfaced. A fused chain is an info note; a chain the
+    compiler detected but DECLINED carries the verbatim decline reason
+    at warning severity — the same reason-plumbing contract the
+    ``_LIFT_REFUSED`` per-row diagnostics established."""
+    from ..engine.fusion import plan_chains
+
     out: list[Diagnostic] = []
-    seen: set[int] = set()
-    for n in ctx.nodes:
-        if id(n) not in eligible or id(n) in seen:
-            continue
-        # walk to the chain head: predecessor stays in the chain only if
-        # it is eligible AND feeds this node alone
-        head = n
-        while True:
-            prev = head.inputs[0]
-            if id(prev) in eligible and ctx.consumers.get(id(prev), 0) == 1:
-                head = prev
-            else:
-                break
-        # walk forward collecting the maximal chain
-        chain = [head]
-        while ctx.consumers.get(id(chain[-1]), 0) == 1:
-            (consumer,) = [
-                m for m in ctx.nodes if chain[-1] in m.inputs
-            ] or (None,)
-            if consumer is None or id(consumer) not in eligible:
-                break
-            chain.append(consumer)
-        for m in chain:
-            seen.add(id(m))
-        if len(chain) < 2:
-            continue
+    for plan in plan_chains(ctx.nodes):
+        chain = plan.members
         # every internal boundary re-enters Python dispatch and
         # materializes the upstream node's full column set
         cost = sum(len(m.column_names) for m in chain[:-1])
         shape = "→".join(type(m).__name__ for m in chain)
-        out.append(Diagnostic(
-            "fusion-chain",
-            f"pure linear chain {shape} ({len(chain)} operators) "
-            f"materializes ~{cost} intermediate column(s) per batch "
-            "between nodes — fusable into one compiled kernel",
-            operator=ctx.label(chain[0]),
-            location=ctx.location_of(chain[0]),
-            mitigation=None,
-        ))
+        if plan.fused:
+            out.append(Diagnostic(
+                "fusion-chain",
+                f"pure linear chain {shape} ({len(chain)} operators) "
+                f"fuses into one compiled kernel — ~{cost} intermediate "
+                "column(s) per batch stop materializing between nodes",
+                severity="info",
+                operator=ctx.label(chain[0]),
+                location=ctx.location_of(chain[0]),
+                mitigation=None,
+            ))
+        else:
+            out.append(Diagnostic(
+                "fusion-chain",
+                f"linear chain {shape} ({len(chain)} operators) "
+                f"materializes ~{cost} intermediate column(s) per batch "
+                f"but the compiler declined to fuse it: {plan.reason}",
+                severity="warning",
+                operator=ctx.label(chain[0]),
+                location=ctx.location_of(chain[0]),
+                mitigation=(
+                    "resolve the decline reason (or unset PATHWAY_FUSION=0) "
+                    "so the chain compiles into one kernel"
+                ),
+            ))
     return out
 
 

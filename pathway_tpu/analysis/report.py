@@ -40,8 +40,9 @@ CATALOG: dict[str, tuple[str, str]] = {
     ),
     "fusion-chain": (
         "info",
-        "a pure linear operator chain materializes intermediate columns "
-        "between nodes — a whole-chain fusion candidate",
+        "a linear operator chain the compiler fuses into one kernel — "
+        "or, at warning severity, one it detected but DECLINED to fuse "
+        "(the message carries the compiler's verbatim decline reason)",
     ),
     "shard-skew": (
         "warning",

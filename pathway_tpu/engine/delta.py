@@ -100,6 +100,19 @@ class Delta:
     data: dict[str, np.ndarray] = field(default_factory=dict)  # each [n]
     diffs: np.ndarray = None  # type: ignore[assignment]  # int64[n]
 
+    #: key provenance (engine/fusion.py content-key reuse): the ordered
+    #: column names this batch's keys were derived from via
+    #: ``K.mix_columns(data[c] for c in cols, salt=0)`` — set by the io
+    #: ingest paths on purely content-keyed batches (no explicit keys),
+    #: carried through row-subset operations, dropped by anything that
+    #: changes keys or data. A groupby/join whose key expressions are
+    #: exactly these column references can then reuse the row keys as
+    #: group/join keys BIT-FOR-BIT instead of re-hashing the columns.
+    #: Class-level default (not a dataclass field) so Deltas pickled
+    #: before this attribute existed — recorded input logs, snapshots —
+    #: deserialize cleanly and simply skip the fast path.
+    keys_content_cols = None  # type: tuple | None
+
     def __post_init__(self) -> None:
         self.keys = np.asarray(self.keys, dtype=np.uint64)
         if self.diffs is None:
@@ -123,11 +136,14 @@ class Delta:
         )
 
     def take(self, idx: np.ndarray) -> "Delta":
-        return Delta(
+        out = Delta(
             keys=self.keys[idx],
             data={c: a[idx] for c, a in self.data.items()},
             diffs=self.diffs[idx],
         )
+        # a row subset keeps every row's key/content relationship
+        out.keys_content_cols = self.keys_content_cols
+        return out
 
     def replace_data(self, data: dict[str, np.ndarray]) -> "Delta":
         return Delta(keys=self.keys, data=data, diffs=self.diffs)
@@ -175,16 +191,35 @@ class Delta:
     def select_columns(self, names: list[str]) -> "Delta":
         return Delta(keys=self.keys, data={n: self.data[n] for n in names}, diffs=self.diffs)
 
-    def consolidated(self) -> "Delta":
+    def consolidated(self, multiset_ok: bool = False) -> "Delta":
         """Sum diffs of identical (key, row) entries; drop zero-diff entries.
 
         The analog of differential's ``consolidate``; output ops use it so a
         retract+insert of an unchanged row cancels out within a tick.
+
+        Fast paths (fusion subsystem, ``PATHWAY_FUSION=0`` disables):
+        an all-insertions batch can neither cancel nor go negative, so
+
+        - with unique keys it is PROVABLY already consolidated — the
+          batch returns as-is, skipping the row-signature hash + sort of
+          every column (the chain-exit/sink-side cost the fusion work
+          targets);
+        - ``multiset_ok=True`` (engine-internal edges: the join output
+          feeding downstream operators) returns it as-is even with
+          duplicate keys — duplicate (key, row) entries at +1/+1 are the
+          same multiset as one entry at +2, and every engine operator
+          folds diffs.
         """
         if len(self) <= 1:
             if len(self) == 1 and self.diffs[0] == 0:
                 return self.take(np.array([], dtype=np.int64))
             return self
+        from .fusion import FUSION_STATS, fusion_enabled
+
+        if fusion_enabled() and int(self.diffs.min()) > 0:
+            if multiset_ok or K.all_unique(self.keys):
+                FUSION_STATS["consolidation_skips_total"] += 1
+                return self
         # asymmetric combine — a plain xor would zero out whenever row keys
         # are themselves content-derived (same mix as the row hash)
         row_sig = K.derive_pair(
@@ -210,13 +245,18 @@ def concat_deltas(deltas: list[Delta], columns: list[str] | None = None) -> Delt
     if len(deltas) == 1:
         return deltas[0]
     cols = columns if columns is not None else deltas[0].columns
-    return Delta(
+    out = Delta(
         keys=np.concatenate([d.keys for d in deltas]),
         data={
             c: _concat_cols([d.data[c] for d in deltas]) for c in cols
         },
         diffs=np.concatenate([d.diffs for d in deltas]),
     )
+    # key provenance survives concatenation only when every part agrees
+    prov = deltas[0].keys_content_cols
+    if prov is not None and all(d.keys_content_cols == prov for d in deltas):
+        out.keys_content_cols = prov
+    return out
 
 
 def _concat_cols(arrs: list[np.ndarray]) -> np.ndarray:

@@ -90,11 +90,25 @@ class EngineStats:
         self.rows_total += n_rows
         if is_source:
             self.input_rows += n_rows
-        label = f"{type(node).__name__}#{node.node_id}"
-        self.rows_by_node[label] = self.rows_by_node.get(label, 0) + n_rows
+        # fused chains (engine/fusion.py) attribute under their MEMBER
+        # labels so the rows and time series of /attribution share keys;
+        # the chain's emitted count is credited to each member (the
+        # single-kernel XLA tier has no per-member intermediate counts —
+        # a best-effort rate, exact for filterless chains)
+        labels = getattr(node, "attribution_labels", None) or (
+            f"{type(node).__name__}#{node.node_id}",
+        )
+        for label in labels:
+            self.rows_by_node[label] = self.rows_by_node.get(label, 0) + n_rows
 
     def note_node_time(self, node: "Node", ns: int) -> None:
-        label = f"{type(node).__name__}#{node.node_id}"
+        self.note_op_time(f"{type(node).__name__}#{node.node_id}", ns)
+
+    def note_op_time(self, label: str, ns: int) -> None:
+        """Per-operator time under an explicit label — fused chains
+        (engine/fusion.py) self-report their MEMBER operators' cost
+        splits here so /attribution still names the bottleneck operator
+        inside a fused chain."""
         self.time_by_node[label] = self.time_by_node.get(label, 0) + ns
         hist = self.node_time_hist.get(label)
         if hist is None:
@@ -614,6 +628,14 @@ class Executor:
         self.ctx = ctx
         if ctx.is_sharded:
             nodes = shard_graph(nodes, ctx)
+        # whole-graph kernel fusion (engine/fusion.py): maximal pure
+        # Rowwise/Filter chains collapse into single FusedChain nodes and
+        # groupby/join preambles are absorbed — AFTER sharding, so
+        # Exchange boundaries are fusion barriers by construction.
+        # PATHWAY_FUSION=0 is the escape hatch (fuse_graph no-ops).
+        from .fusion import fuse_graph
+
+        nodes = fuse_graph(nodes)
         self.nodes = _topological(nodes)
         self._consumers: dict[int, list[tuple[Node, int]]] = {}
         for node in self.nodes:
@@ -1202,7 +1224,12 @@ class Executor:
                         node_t0,
                         {"rows": emitted_rows},
                     )
-                if self.stats.detailed:
+                if self.stats.detailed and not getattr(
+                    node, "ATTRIBUTES_MEMBERS", False
+                ):
+                    # fused chains self-report per-MEMBER cost splits
+                    # (fusion.py) — recording the chain's own label too
+                    # would double-count it above every member
                     self.stats.note_node_time(
                         node, _wall.perf_counter_ns() - node_t0
                     )

@@ -848,6 +848,26 @@ def pointer_from_ints(vals: np.ndarray) -> KeyArray:
     return mix_columns([arr], len(arr))
 
 
+def all_unique(keys: KeyArray) -> bool:
+    """True when no key repeats — O(n) native open-addressing probe
+    (engine keys are already avalanche-mixed, so masked-key slots
+    distribute uniformly); numpy sort-based fallback without the native
+    module. Used by the consolidation identity fast path
+    (engine/delta.py) to prove an all-insertions batch is already
+    consolidated."""
+    n = len(keys)
+    if n < 2:
+        return True
+    from ..native import get_native
+
+    native = get_native()
+    if native is not None and hasattr(native, "all_unique_u64"):
+        return bool(
+            native.all_unique_u64(np.ascontiguousarray(keys, dtype=np.uint64))
+        )
+    return len(np.unique(keys)) == n
+
+
 def derive(keys: KeyArray, salt: int) -> KeyArray:
     """Derive child keys from parent keys (concat_reindex, flatten branches)."""
     return _splitmix(keys ^ _splitmix(np.full(len(keys), np.uint64(salt), dtype=np.uint64)))

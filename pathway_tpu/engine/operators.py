@@ -439,6 +439,16 @@ class GroupByReduce(Node):
         self._cold_buckets: dict[int, dict] = {}  # bucket id -> handle
         self._entry_bytes_est = 512  # refined from real pickles at spill
 
+        # reducer-preamble fusion (engine/fusion.py): the adjacent Rowwise
+        # the lowering materializes group keys / reducer args in can be
+        # absorbed so its kernels run inside this node, and — when the
+        # group keys are plain references to exactly the columns the
+        # source derived row keys from — the row keys are reused as group
+        # keys bit-for-bit instead of re-hashing the columns
+        self._preamble: dict[str, Any] | None = None
+        self._preamble_label: str | None = None
+        self._gkey_reuse_cols: tuple | None = None
+
         self._dense = all(
             type(r) in (CountReducer, SumReducer) for _, r, _ in reducers
         )
@@ -966,10 +976,64 @@ class GroupByReduce(Node):
             ),
         }
 
+    def absorb_preamble(self, port: int, rowwise: "Rowwise") -> bool:
+        """Fuse the adjacent Rowwise preamble into this node (called by
+        engine/fusion.fuse_graph; the caller rewires inputs)."""
+        if port != 0 or self._preamble is not None:
+            return False
+        self._preamble = dict(rowwise._exprs)
+        self._preamble_label = f"Rowwise#{rowwise.node_id}"
+        # content-key reuse precondition: every group key is a plain
+        # column reference, in order — matched per batch against the
+        # delta's key-provenance columns (Delta.keys_content_cols)
+        self._gkey_reuse_cols = None
+        if self._key_from_column is None and self._key_salt == 0:
+            cols = []
+            for c in self._group_cols:
+                ref = getattr(self._preamble.get(c), "_pw_colref", None)
+                if ref is None:
+                    break
+                cols.append(ref)
+            else:
+                self._gkey_reuse_cols = tuple(cols)
+        return True
+
+    def _apply_preamble(self, d: Delta) -> Delta:
+        import time as _wall
+
+        stats = getattr(self, "_engine_stats", None)
+        timed = stats is not None and stats.detailed
+        t0 = _wall.perf_counter_ns() if timed else 0
+        n = len(d)
+        data = {
+            name: _as_column(fn(d.data, d.keys), n)
+            for name, fn in self._preamble.items()
+        }
+        if timed:
+            # the absorbed Rowwise keeps its own attribution label, so
+            # /attribution still names it when IT is the bottleneck
+            stats.note_op_time(
+                self._preamble_label, _wall.perf_counter_ns() - t0
+            )
+        return d.replace_data(data)
+
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
         d = ins[0]
         if d is None or not len(d):
             return None
+        reuse_keys = None
+        if self._preamble is not None:
+            if (
+                self._gkey_reuse_cols is not None
+                and d.keys_content_cols == self._gkey_reuse_cols
+                and not errors_seen()
+            ):
+                # the group keys would fold exactly the column hashes the
+                # ingest row keys folded, same salt — the values are
+                # bit-identical, and conflation detection already covers
+                # them (the 128-bit pair was registered at ingest)
+                reuse_keys = d.keys
+            d = self._apply_preamble(d)
         d = self._skip_error_keys(d)
         if not len(d):
             return None
@@ -977,6 +1041,11 @@ class GroupByReduce(Node):
         gcols = [np.asarray(d.data[c]) for c in self._group_cols]
         if self._key_from_column is not None:
             gkeys = np.asarray(d.data[self._key_from_column], dtype=np.uint64)
+        elif reuse_keys is not None and len(reuse_keys) == n:
+            from .fusion import FUSION_STATS
+
+            FUSION_STATS["key_reuse_total"] += 1
+            gkeys = reuse_keys
         else:
             gkeys = K.mix_columns(gcols, n, salt=self._key_salt)
         if self._dense:
@@ -1081,6 +1150,27 @@ class GroupByReduce(Node):
             if self._gvals[ci] is not None:
                 self._gvals[ci] = self._gvals[ci][live].copy()
 
+    def _store_fresh_groups(
+        self, fresh_slots, fresh_first_ix, gcols, gkeys
+    ) -> None:
+        """Record a batch's NEW groups into the arena: group key per
+        slot + the grouping values from each group's first occurrence.
+        Shared by the sort and bincount segment-reduce paths — the
+        dtype rules must never diverge between them: can_cast(int64,
+        float64) is "safe" to numpy but rounds values > 2^53, so
+        cross-kind mixes go to object instead."""
+        self._gkey_by_slot[fresh_slots] = gkeys[fresh_first_ix]
+        for ci, col in enumerate(gcols):
+            stored = self._gvals[ci]
+            if stored is None:
+                stored = np.empty(len(self._counts), dtype=col.dtype)
+                self._gvals[ci] = stored
+            elif stored.dtype != object and not _lossless_cast(
+                col.dtype, stored.dtype
+            ):
+                self._gvals[ci] = stored = stored.astype(object)
+            stored[fresh_slots] = col[fresh_first_ix]
+
     def _process_dense(self, d, n, gcols, gkeys, arg_arrays) -> Delta | None:
         self._reclaim_arena()
         slots, n_new = self._slots.lookup_or_insert(gkeys)
@@ -1092,42 +1182,67 @@ class GroupByReduce(Node):
         base = self._arena_base
         self._hot_slot_mins.append(int(slots.min()))
         old_n = len(self._slots) - n_new
-        self._grow(len(self._slots) - base)
-        order = np.argsort(slots, kind="stable")
-        ss = slots[order]
-        boundaries = np.flatnonzero(np.diff(ss) != 0) + 1
-        starts = np.concatenate([[0], boundaries])
-        u_slots_abs = ss[starts]
-        # arena arrays cover slots [base, n) — index them relative
-        u_slots = u_slots_abs - base
-        if n_new:
-            first_ix = order[starts]  # first batch occurrence of each u_slot
-            fresh = u_slots_abs >= old_n
-            self._gkey_by_slot[u_slots[fresh]] = gkeys[first_ix[fresh]]
-            for ci, col in enumerate(gcols):
-                stored = self._gvals[ci]
-                if stored is None:
-                    stored = np.empty(len(self._counts), dtype=col.dtype)
-                    self._gvals[ci] = stored
-                elif stored.dtype != object and not _lossless_cast(
-                    col.dtype, stored.dtype
-                ):
-                    # can_cast(int64, float64) is "safe" to numpy but rounds
-                    # values > 2^53 — cross-kind mixes go to object instead
-                    self._gvals[ci] = stored = stored.astype(object)
-                stored[u_slots[fresh]] = col[first_ix[fresh]]
+        total = len(self._slots)
+        self._grow(total - base)
+        from .fusion import fusion_enabled as _fusion_on
 
-        diffs_sorted = d.diffs[order]
-        self._counts[u_slots] += np.add.reduceat(diffs_sorted, starts)
-        for j, arr in enumerate(arg_arrays):
-            if arr is None:
-                continue
-            acc = self._accs[j]
-            if arr.dtype.kind == "f" and acc.dtype.kind != "f":
-                self._accs[j] = acc = acc.astype(np.float64)
-                self._prev[j] = self._prev[j].astype(np.float64)
-            contrib = arr.astype(acc.dtype) * d.diffs
-            acc[u_slots] += np.add.reduceat(contrib[order], starts)
+        if (
+            _fusion_on()
+            and all(a is None for a in arg_arrays)
+            # bincount scans O(arena) per batch — only when the arena is
+            # not much larger than the batch (or small outright); a huge
+            # arena fed tiny batches keeps the O(n log n) sort path
+            and (total <= 4 * n or total <= 65536)
+        ):
+            # fused segmented reduce for pure-count groupbys (wordcount
+            # shape): two O(n + arena) bincounts replace the stable
+            # argsort + reduceat — touched slots come out ascending,
+            # exactly the order the sort path produced. Float64 bincount
+            # sums of per-batch diffs are exact (|sum| <= n < 2^53).
+            occ = np.bincount(slots, minlength=total)
+            u_slots_abs = np.flatnonzero(occ)
+            u_slots = u_slots_abs - base
+            if n_new:
+                # SlotMap assigns fresh ids in first-occurrence order;
+                # reversed fancy-store leaves each slot's FIRST index
+                first_ix = np.empty(total, dtype=np.int64)
+                first_ix[slots[::-1]] = np.arange(n - 1, -1, -1)
+                fresh = u_slots_abs >= old_n
+                self._store_fresh_groups(
+                    u_slots[fresh], first_ix[u_slots_abs[fresh]],
+                    gcols, gkeys,
+                )
+            if (d.diffs == 1).all():
+                self._counts[u_slots] += occ[u_slots_abs]
+            else:
+                sums = np.bincount(slots, weights=d.diffs, minlength=total)
+                self._counts[u_slots] += sums[u_slots_abs].astype(np.int64)
+        else:
+            order = np.argsort(slots, kind="stable")
+            ss = slots[order]
+            boundaries = np.flatnonzero(np.diff(ss) != 0) + 1
+            starts = np.concatenate([[0], boundaries])
+            u_slots_abs = ss[starts]
+            # arena arrays cover slots [base, n) — index them relative
+            u_slots = u_slots_abs - base
+            if n_new:
+                first_ix = order[starts]  # first occurrence of each u_slot
+                fresh = u_slots_abs >= old_n
+                self._store_fresh_groups(
+                    u_slots[fresh], first_ix[fresh], gcols, gkeys
+                )
+
+            diffs_sorted = d.diffs[order]
+            self._counts[u_slots] += np.add.reduceat(diffs_sorted, starts)
+            for j, arr in enumerate(arg_arrays):
+                if arr is None:
+                    continue
+                acc = self._accs[j]
+                if arr.dtype.kind == "f" and acc.dtype.kind != "f":
+                    self._accs[j] = acc = acc.astype(np.float64)
+                    self._prev[j] = self._prev[j].astype(np.float64)
+                contrib = arr.astype(acc.dtype) * d.diffs
+                acc[u_slots] += np.add.reduceat(contrib[order], starts)
 
         new_counts = self._counts[u_slots]
         if (new_counts < 0).any():
@@ -1429,6 +1544,16 @@ class _SortedSide:
         #: (id(run_jks), id(qjks)) -> (run_jks, qjks, lo, hi); strong refs
         #: make ids valid, the size bound makes the pinning harmless
         self._range_cache: dict = {}
+        #: id(run_jks) -> [run_jks, probe_count, (SlotMap, lo, hi) | None]
+        #: — fusion fast path: a run probed repeatedly (the static
+        #: dimension side of a stream⋈dim join is probed EVERY tick)
+        #: gets a jk→(lo,hi) hash index replacing the per-probe binary
+        #: search; runs are immutable so the index never invalidates
+        self._jk_hash_idx: dict = {}
+        #: fusion lane: raw (jks, keys, cols, diffs) batches whose sort +
+        #: tiered merge is deferred until the arrangement is read
+        self._pending: list[tuple] = []
+        self._pending_rows = 0
         #: spilled cold runs, oldest first: [jks_sorted, csum, handle] —
         #: payload (row_keys, cols, counts) lives in the spill store
         self._spilled: list[list] = []
@@ -1443,8 +1568,12 @@ class _SortedSide:
         # arrays and is identity-keyed — meaningless after unpickling);
         # spilled runs MATERIALIZE into the snapshot — the scratch spill
         # dir is a cache, never part of durable or resharded state
+        self._flush_pending()  # snapshots see the arranged representation
         d = dict(self.__dict__)
         d.pop("_range_cache", None)
+        d.pop("_jk_hash_idx", None)
+        d.pop("_pending", None)
+        d.pop("_pending_rows", None)
         d.pop("_budget", None)
         spilled = d.pop("_spilled", None)
         if spilled:
@@ -1456,6 +1585,9 @@ class _SortedSide:
     def __setstate__(self, d: dict) -> None:
         self.__dict__.update(d)
         self._range_cache = {}
+        self._jk_hash_idx = {}
+        self._pending = []
+        self._pending_rows = 0
         self._spilled = []
         from . import spill as _spill
 
@@ -1467,16 +1599,22 @@ class _SortedSide:
         """The resident-only pickle dict (spilled payloads EXCLUDED) —
         the streaming-snapshot head Join.snapshot_state_parts yields
         before streaming each spilled run's payload individually."""
+        self._flush_pending()
         d = dict(self.__dict__)
         d.pop("_range_cache", None)
+        d.pop("_jk_hash_idx", None)
+        d.pop("_pending", None)
+        d.pop("_pending_rows", None)
         d.pop("_budget", None)
         d.pop("_spilled", None)
         d["_runs"] = list(self._runs)
         return d
 
     def __len__(self) -> int:
-        return sum(len(r[0]) for r in self._runs) + sum(
-            len(rec[0]) for rec in self._spilled
+        return (
+            sum(len(r[0]) for r in self._runs)
+            + sum(len(rec[0]) for rec in self._spilled)
+            + getattr(self, "_pending_rows", 0)
         )
 
     # -- spill tier (engine/spill.py spillable protocol) -----------------
@@ -1499,6 +1637,7 @@ class _SortedSide:
         )
 
     def spillable_bytes(self) -> int:
+        self._flush_pending()  # spill decisions see arranged runs
         return sum(self._payload_bytes(r) for r in self._runs)
 
     def spilled_bytes(self) -> int:
@@ -1510,6 +1649,7 @@ class _SortedSide:
         run still resident (the budget logs and keeps going)."""
         if self._budget is None:
             return 0
+        self._flush_pending()  # only arranged runs spill
         store = self._budget.spill_store()
         freed = 0
         while self._runs and freed < want_bytes:
@@ -1546,15 +1686,62 @@ class _SortedSide:
                 np.concatenate([[0], np.cumsum(counts)])]
 
     def _ranges(self, run: list, qjks: np.ndarray) -> tuple:
-        """Memoized ``(searchsorted left, right)`` of ``qjks`` in a run."""
+        """Memoized ``(searchsorted left, right)`` of ``qjks`` in a run.
+
+        A run probed repeatedly (fusion lane: the static dimension side
+        of a stream⋈dim join takes a probe EVERY tick) upgrades to a
+        jk→(lo, hi) hash index — native KeyTable lookups replace the
+        two binary searches. Misses land on a (0, 0) sentinel: lo == hi,
+        i.e. an empty range, exactly what searchsorted yields for an
+        absent key."""
         jks_s = run[0]
         cache = self._range_cache
         key = (id(jks_s), id(qjks))
         hit = cache.get(key)
         if hit is not None and hit[0] is jks_s and hit[1] is qjks:
             return hit[2], hit[3]
-        lo = np.searchsorted(jks_s, qjks, "left")
-        hi = np.searchsorted(jks_s, qjks, "right")
+        lo = hi = None
+        from .fusion import fusion_enabled
+
+        if fusion_enabled() and len(jks_s) >= 4096:
+            ent = self._jk_hash_idx.get(id(jks_s))
+            if ent is not None and ent[0] is not jks_s:
+                ent = None  # recycled id
+            if ent is None:
+                if len(self._jk_hash_idx) >= 8:
+                    self._jk_hash_idx.clear()
+                ent = self._jk_hash_idx[id(jks_s)] = [jks_s, 0, None]
+            ent[1] += 1
+            if ent[2] is None and (
+                ent[1] >= 2 or len(qjks) * 4 >= len(jks_s)
+            ):
+                # build on the second probe — or immediately when one
+                # query batch alone amortizes the O(run) build (a large
+                # coalesced probe pays ~150ns/query in binary-search
+                # cache misses vs ~10ns hashed)
+                from .slotmap import SlotMap
+
+                starts = np.concatenate(
+                    [[0], np.flatnonzero(np.diff(jks_s) != 0) + 1]
+                )
+                ends = np.concatenate([starts[1:], [len(jks_s)]])
+                sm = SlotMap()
+                slots, _ = sm.lookup_or_insert(jks_s[starts])
+                # first-occurrence slot order over sorted uniques makes
+                # slot i == position i; trailing sentinel serves slot -1
+                ent[2] = (
+                    sm,
+                    np.concatenate([starts, [0]]),
+                    np.concatenate([ends, [0]]),
+                )
+            if ent[2] is not None:
+                sm, lo_by_slot, hi_by_slot = ent[2]
+                slots = sm.lookup(qjks)
+                lo = lo_by_slot[slots]
+                hi = hi_by_slot[slots]
+        if lo is None:
+            lo = np.searchsorted(jks_s, qjks, "left")
+            hi = np.searchsorted(jks_s, qjks, "right")
         if len(cache) >= self._RANGE_CACHE_MAX:
             cache.clear()
         cache[key] = (jks_s, qjks, lo, hi)
@@ -1563,6 +1750,48 @@ class _SortedSide:
     def apply(self, jks, keys, cols, diffs) -> None:
         if not len(jks):
             return
+        from .fusion import fusion_enabled
+
+        if (
+            fusion_enabled()
+            and self._budget is None
+            # only batches big enough that the deferred sort pays (tiny
+            # batches keep the original eager layout, which unit tests
+            # of the physical run structure observe) — but once a
+            # pending list exists, EVERYTHING defers behind it: runs
+            # must arrange in arrival order or retractions would
+            # consolidate against the wrong prefix
+            and (len(jks) >= 256 or self._pending)
+        ):
+            # fusion lane: defer sort + tiered merging until something
+            # actually reads the arrangement (probe/totals/snapshot). A
+            # side that is never probed again — the FACT side of a
+            # stream⋈static-dimension join — never pays the maintenance
+            # at all; an always-probed side flushes one batch per tick,
+            # exactly the eager schedule. Bounded so a never-read side
+            # cannot defer an unbounded compaction to snapshot time.
+            # Eager under a state memory budget: pending raw batches
+            # would dodge the spill tier's accounting.
+            self._pending.append((
+                jks, keys,
+                [np.asarray(c) for c in cols],
+                diffs.astype(np.int64),
+            ))
+            self._pending_rows += len(jks)
+            if self._pending_rows >= 262_144:
+                self._flush_pending()
+            return
+        self._apply_now(jks, keys, cols, diffs)
+
+    def _flush_pending(self) -> None:
+        if not getattr(self, "_pending", None):
+            return
+        pend, self._pending = self._pending, []
+        self._pending_rows = 0
+        for jks, keys, cols, diffs in pend:
+            self._apply_now(jks, keys, cols, diffs)
+
+    def _apply_now(self, jks, keys, cols, diffs) -> None:
         order = np.argsort(jks, kind="stable")
         self._runs.append(self._make_run(
             jks[order],
@@ -1627,7 +1856,10 @@ class _SortedSide:
     def _compact(self) -> None:
         from .delta import _concat_cols
 
+        self._flush_pending()
         self._unspill_all()
+        if not self._runs:
+            return
         jks = np.concatenate([r[0] for r in self._runs])
         keys = np.concatenate([r[1] for r in self._runs])
         cols = [
@@ -1655,6 +1887,7 @@ class _SortedSide:
         Spilled runs (oldest, probed first to keep run order) decide the
         match from their RESIDENT jk array and load the payload from disk
         only on an actual hit — the working set stays in memory."""
+        self._flush_pending()
         for rec in self._spilled:
             lo, hi = self._ranges(rec, qjks)
             m = hi - lo
@@ -1686,6 +1919,7 @@ class _SortedSide:
         prefix sum (shared with ``probe`` on the same query array). Pure
         in-memory even for spilled runs: their jks + prefix sums never
         leave RAM."""
+        self._flush_pending()
         out = np.zeros(len(qjks), dtype=np.int64)
         for rec in self._spilled:
             lo, hi = self._ranges(rec, qjks)
@@ -1765,10 +1999,81 @@ class Join(Node):
         # reference's behavior (test_errors.py:483 left_join_preserving_id).
         # out_key -> {row_sig: [row_tuple, count]} of emitted rows.
         self._idstate: dict[int, dict[int, list]] = {}
+        # pre-join projection/filter fusion (engine/fusion.py): the
+        # adjacent per-side Rowwise (renames + row id + join-key mixing)
+        # absorbed into this node, with the join keys reused from the
+        # row keys bit-for-bit when they mix exactly the columns the
+        # source derived its keys from
+        self._preambles: list[dict[str, Any] | None] = [None, None]
+        self._preamble_labels: list[str | None] = [None, None]
+        self._jk_reuse_cols: list[tuple | None] = [None, None]
+
+    def absorb_preamble(self, port: int, rowwise: "Rowwise") -> bool:
+        """Fuse a side's Rowwise preamble into the join (called by
+        engine/fusion.fuse_graph; the caller rewires inputs)."""
+        if self._preambles[port] is not None:
+            return False
+        self._preambles[port] = dict(rowwise._exprs)
+        self._preamble_labels[port] = f"Rowwise#{rowwise.node_id}"
+        jk_col = self._ljk if port == 0 else self._rjk
+        jk_fn = self._preambles[port].get(jk_col)
+        key_fns = getattr(jk_fn, "_pw_key_fns", None)
+        if key_fns:
+            cols = []
+            for f in key_fns:
+                ref = getattr(f, "_pw_colref", None)
+                if ref is None:
+                    break
+                cols.append(ref)
+            else:
+                self._jk_reuse_cols[port] = tuple(cols)
+        return True
+
+    def _apply_preamble(self, side: int, d: "Delta | None") -> "Delta | None":
+        if d is None or not len(d):
+            return d
+        import time as _wall
+
+        stats = getattr(self, "_engine_stats", None)
+        timed = stats is not None and stats.detailed
+        t0 = _wall.perf_counter_ns() if timed else 0
+        preamble = self._preambles[side]
+        jk_col = self._ljk if side == 0 else self._rjk
+        reuse = (
+            self._jk_reuse_cols[side] is not None
+            and d.keys_content_cols == self._jk_reuse_cols[side]
+            and not errors_seen()
+        )
+        n = len(d)
+        data = {
+            name: (d.keys if reuse and name == jk_col
+                   else _as_column(fn(d.data, d.keys), n))
+            for name, fn in preamble.items()
+        }
+        if reuse:
+            from .fusion import FUSION_STATS
+
+            FUSION_STATS["key_reuse_total"] += 1
+        out = d.replace_data(data)
+        if timed:
+            stats.note_op_time(
+                self._preamble_labels[side], _wall.perf_counter_ns() - t0
+            )
+        return out
 
     STATE_FIELDS = (
         "_cleft", "_cright", "_left", "_right", "_lpad", "_rpad", "_idstate"
     )
+
+    def snapshot_state(self) -> dict:
+        # deferred (fusion-lane) arrangement batches must be arranged
+        # before any state consumer walks _runs directly — pickling
+        # flushes via __getstate__, but split_state/unit tests may read
+        # the live object
+        for side in (getattr(self, "_cleft", None), getattr(self, "_cright", None)):
+            if side is not None:
+                side._flush_pending()
+        return super().snapshot_state()
 
     #: both sides' arrangements retain every row seen — unbounded over a
     #: never-ending source unless something upstream forgets
@@ -2172,7 +2477,12 @@ class Join(Node):
             )
         if not parts:
             return None
-        return concat_deltas(parts, self.column_names).consolidated()
+        # engine-internal edge: duplicate all-insert (key,row) entries are
+        # the same multiset as merged ones — downstream operators fold
+        # diffs, so an all-positive batch skips the signature sort
+        return concat_deltas(parts, self.column_names).consolidated(
+            multiset_ok=True
+        )
 
     @staticmethod
     def _affected_jks(this, other) -> np.ndarray | None:
@@ -2216,6 +2526,11 @@ class Join(Node):
             ))
 
     def process(self, time: int, ins: list[Delta | None]) -> Delta | None:
+        if self._preambles[0] is not None or self._preambles[1] is not None:
+            ins = [
+                self._apply_preamble(side, d) if self._preambles[side] else d
+                for side, d in enumerate(ins)
+            ]
         clean: list[Delta | None] = []
         pad_parts: list[Delta] = []
         padded_sides = {
@@ -2231,7 +2546,9 @@ class Join(Node):
             out = self._process_columnar(ins)
             if pad_parts:
                 parts = ([out] if out is not None and len(out) else []) + pad_parts
-                out = concat_deltas(parts, self.column_names).consolidated()
+                out = concat_deltas(parts, self.column_names).consolidated(
+                    multiset_ok=True
+                )
             return self._check_unique_ids(out)
         dl = self._rows_of(ins[0], self._ljk, self._lcols)
         dr = self._rows_of(ins[1], self._rjk, self._rcols)

@@ -92,6 +92,12 @@ class ColumnEnv:
 class Compiled:
     fn: Callable[[dict[str, np.ndarray], np.ndarray], np.ndarray]
     dtype: dt.DType
+    #: the whole tree is jax-compilable (dense numeric, total ops) —
+    #: the chain-fusion pass (engine/fusion.py) uses this both as the
+    #: whole-chain XLA gate and as the mask-deferral proof (a total
+    #: kernel evaluated on masked-out rows cannot raise, build Error
+    #: carriers, or touch the error log)
+    jax_ok: bool = False
 
 
 def infer_dtype(expr: ColumnExpression, env: ColumnEnv) -> dt.DType:
@@ -142,6 +148,23 @@ def compile_expr(expr: ColumnExpression, env: ColumnEnv) -> Compiled:
         # walk the compiled graph without re-deriving the compile
         result.fn._pw_expr = expr
         result.fn._pw_dtype = result.dtype
+        # chain-fusion breadcrumbs (engine/fusion.py): the fused-chain
+        # compiler rebuilds member kernels with jax.numpy inside ONE
+        # traced function, which needs the binding environment back
+        result.fn._pw_env = env
+        result.fn._pw_jax_ok = result.jax_ok
+        if isinstance(expr, ColumnReference) and not isinstance(
+            expr, IdReference
+        ):
+            # plain column pass-through: the groupby/join content-key
+            # reuse fast path matches these against the source delta's
+            # key-derivation columns (operators.py)
+            try:
+                engine_col, _cdt = env.resolve(expr)
+                if engine_col is not None:
+                    result.fn._pw_colref = engine_col
+            except KeyError:
+                pass
     except (AttributeError, TypeError):
         pass
     if cache is not None:
@@ -210,8 +233,8 @@ def _compile_expr_uncached(expr: ColumnExpression, env: ColumnEnv) -> Compiled:
                 return np.asarray(jitted(cols, keys))
             return np_fn(cols, keys)
 
-        return Compiled(fn, dtype)
-    return Compiled(np_fn, dtype)
+        return Compiled(fn, dtype, jax_ok=True)
+    return Compiled(np_fn, dtype, jax_ok=jax_ok)
 
 
 _engine_dev_cache: list = []
@@ -328,6 +351,35 @@ def _structural_sig(expr: ColumnExpression, env: ColumnEnv) -> tuple | None:
     return None
 
 
+#: fused-chain cache entries ("chain", ...) -> frozenset of the member
+#: expression signatures they were compiled from. A fused kernel is only
+#: as alive as its members: the eviction sweep drops any chain entry
+#: whose member signature it just evicted, so a rebuilt pipeline can
+#: never pair a fresh member kernel with a stale fused composite.
+_JIT_CHAIN_DEPS: dict = {}
+
+
+def _evict_jit_cache() -> None:
+    """Oldest-half eviction of the jit kernel cache, with fused-chain
+    entries evicting as a unit with their member-node signatures."""
+    from .udf_lift import evict_oldest_half
+
+    before = set(_JIT_KERNEL_CACHE)
+    evict_oldest_half(_JIT_KERNEL_CACHE)
+    evicted = before - set(_JIT_KERNEL_CACHE)
+    if evicted:
+        for sig in [
+            s
+            for s in _JIT_KERNEL_CACHE
+            if isinstance(s, tuple) and s and s[0] == "chain"
+        ]:
+            if _JIT_CHAIN_DEPS.get(sig, frozenset()) & evicted:
+                del _JIT_KERNEL_CACHE[sig]
+    for sig in list(_JIT_CHAIN_DEPS):
+        if sig not in _JIT_KERNEL_CACHE:
+            del _JIT_CHAIN_DEPS[sig]
+
+
 def _jitted_kernel(expr: ColumnExpression, env: ColumnEnv):
     sig = _structural_sig(expr, env)
     if sig is None:
@@ -338,10 +390,26 @@ def _jitted_kernel(expr: ColumnExpression, env: ColumnEnv):
         if len(_JIT_KERNEL_CACHE) >= _JIT_KERNEL_CACHE_MAX:
             # oldest-half eviction, not clear(): a wholesale clear makes
             # every live pipeline re-trace its XLA kernels at once
-            from .udf_lift import evict_oldest_half
-
-            evict_oldest_half(_JIT_KERNEL_CACHE)
+            _evict_jit_cache()
         _JIT_KERNEL_CACHE[sig] = hit
+    return hit
+
+
+def fused_chain_kernel(chain_sig: tuple, member_sigs: list, build: Callable):
+    """Whole-chain jit wrapper for engine/fusion.py: one ``jax.jit``
+    callable per structurally-distinct chain, shared process-wide on the
+    same cache the per-expression kernels ride (rebuilt pipelines reuse
+    compiled chains instead of re-tracing XLA mid-stream). ``build()``
+    returns the traceable composed function."""
+    hit = _JIT_KERNEL_CACHE.get(chain_sig)
+    if hit is None:
+        import jax
+
+        hit = jax.jit(build())
+        if len(_JIT_KERNEL_CACHE) >= _JIT_KERNEL_CACHE_MAX:
+            _evict_jit_cache()
+        _JIT_KERNEL_CACHE[chain_sig] = hit
+        _JIT_CHAIN_DEPS[chain_sig] = frozenset(member_sigs)
     return hit
 
 

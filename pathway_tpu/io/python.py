@@ -349,21 +349,27 @@ class PythonSubjectSource(RealtimeSource):
         )
         if not explicit:
             keys = K.mix_columns(key_cols, n)
-        else:
-            # rows carrying an explicit key never USE their derived key —
-            # registering it would poison the 128-bit conflation registry
-            # with dead entries (and a later legitimate use of the same
-            # content key would false-collide). Derive + register only
-            # the surviving rows (advisor-low python.py:279).
-            keys = np.empty(n, dtype=np.uint64)
-            keep = np.ones(n, dtype=bool)
-            keep[explicit] = False
-            if keep.any():
-                keys[keep] = K.mix_columns(
-                    [np.asarray(c)[keep] for c in key_cols], int(keep.sum())
-                )
-            for i in explicit:
-                keys[i] = entries[i][2]
+            out = Delta(keys=keys, data=data, diffs=diffs)
+            out.keys_content_cols = tuple(
+                self.names[i] for i in self.pk_indices
+            ) if self.pk_indices is not None else tuple(self.names)
+            return out
+        # rows carrying an explicit key never USE their derived key —
+        # registering it would poison the 128-bit conflation registry
+        # with dead entries (and a later legitimate use of the same
+        # content key would false-collide). Derive + register only
+        # the surviving rows (advisor-low python.py:279). No content
+        # provenance either: explicit keys break the keys==fold(cols)
+        # invariant the fusion key-reuse fast path depends on.
+        keys = np.empty(n, dtype=np.uint64)
+        keep = np.ones(n, dtype=bool)
+        keep[explicit] = False
+        if keep.any():
+            keys[keep] = K.mix_columns(
+                [np.asarray(c)[keep] for c in key_cols], int(keep.sum())
+            )
+        for i in explicit:
+            keys[i] = entries[i][2]
         return Delta(keys=keys, data=data, diffs=diffs)
 
     def _normalize(self, name: str, arr: np.ndarray) -> np.ndarray:
@@ -443,17 +449,22 @@ class PythonSubjectSource(RealtimeSource):
             n -= start
         self._emitted += n
         if self.pk_indices is not None:
-            keys = K.mix_columns(
-                [data[self.names[i]] for i in self.pk_indices], n
-            )
+            key_names = [self.names[i] for i in self.pk_indices]
         else:
-            keys = K.mix_columns([data[c] for c in self.names], n)
+            key_names = list(self.names)
+        keys = K.mix_columns([data[c] for c in key_names], n)
         diffs = (
             np.ones(n, dtype=np.int64)
             if batch.diffs is None
             else np.asarray(batch.diffs, dtype=np.int64)[start:]
         )
-        return Delta(keys=keys, data=data, diffs=diffs)
+        out = Delta(keys=keys, data=data, diffs=diffs)
+        # key provenance for the fusion content-key reuse fast path
+        # (engine/fusion.py): these keys are a pure fold of exactly
+        # these columns at salt 0 — a downstream groupby/join keying on
+        # the same columns reuses them bit-for-bit
+        out.keys_content_cols = tuple(key_names)
+        return out
 
     def _flush_partial(self) -> None:
         if self._partial:

@@ -1007,7 +1007,69 @@ static PyTypeObject KeyRegistryType = {
     .tp_new = keyregistry_new,
 };
 
+/* all_unique_u64(uint64_contiguous_buffer) -> bool
+ *
+ * O(n) open-addressing duplicate probe over already-avalanched engine
+ * keys (splitmix64 outputs distribute uniformly, so the slot is just
+ * the masked key). The consolidation identity fast path
+ * (engine/delta.py) uses it to prove an all-insertions batch is
+ * already consolidated — the alternative is the full row-signature
+ * hash + sort. */
+static PyObject *py_all_unique_u64(PyObject *self, PyObject *arg) {
+    Py_buffer buf;
+    if (PyObject_GetBuffer(arg, &buf, PyBUF_C_CONTIGUOUS) < 0) return NULL;
+    if (buf.itemsize != 8) {
+        PyBuffer_Release(&buf);
+        PyErr_SetString(PyExc_TypeError, "expected a uint64 buffer");
+        return NULL;
+    }
+    Py_ssize_t n = buf.len / 8;
+    const uint64_t *keys = (const uint64_t *)buf.buf;
+    if (n < 2) {
+        PyBuffer_Release(&buf);
+        Py_RETURN_TRUE;
+    }
+    size_t cap = 64;
+    while ((Py_ssize_t)cap < n * 2) cap <<= 1;
+    uint64_t *table = (uint64_t *)calloc(cap, sizeof(uint64_t));
+    if (table == NULL) {
+        PyBuffer_Release(&buf);
+        PyErr_NoMemory();
+        return NULL;
+    }
+    size_t mask = cap - 1;
+    int seen_zero = 0, unique = 1;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        uint64_t k = keys[i];
+        if (k == 0) { /* 0 marks empty slots: track it out-of-band */
+            if (seen_zero) { unique = 0; break; }
+            seen_zero = 1;
+            continue;
+        }
+        size_t slot = (size_t)k & mask;
+        for (;;) {
+            uint64_t cur = table[slot];
+            if (cur == 0) {
+                table[slot] = k;
+                break;
+            }
+            if (cur == k) {
+                unique = 0;
+                break;
+            }
+            slot = (slot + 1) & mask;
+        }
+        if (!unique) break;
+    }
+    free(table);
+    PyBuffer_Release(&buf);
+    if (unique) Py_RETURN_TRUE;
+    Py_RETURN_FALSE;
+}
+
 static PyMethodDef methods[] = {
+    {"all_unique_u64", py_all_unique_u64, METH_O,
+     "all_unique_u64(uint64_buffer) -> bool (O(n) duplicate probe)"},
     {"hash_rows", py_hash_rows, METH_VARARGS,
      "hash_rows(rows, salt, fallback, out_uint64_buffer)"},
     {"hash_scalars", py_hash_scalars, METH_VARARGS,

@@ -292,6 +292,20 @@ class ObservabilityHub:
             # telemetry must not fail the run it observes
             return {}
 
+    @staticmethod
+    def fusion_stats_snapshot() -> dict[str, float]:
+        """This process's kernel-fusion counters (chains compiled, member
+        operators fused, per-batch fallbacks — engine/fusion.py), so a
+        pipeline that silently fell back to per-node dispatch reads as
+        "N batches fell back" instead of a mystery slowdown."""
+        try:
+            from ..engine.fusion import fusion_stats_snapshot
+
+            return fusion_stats_snapshot()
+        except Exception:
+            # telemetry must not fail the run it observes
+            return {}
+
     def snapshot_document(self) -> dict:
         """The /snapshot payload peers serve to process 0."""
         return {
@@ -301,6 +315,7 @@ class ObservabilityHub:
             "memory": self.memory_stats_snapshot(),
             "sinks": self.sink_stats_snapshot(),
             "udf": self.udf_stats_snapshot(),
+            "fusion": self.fusion_stats_snapshot(),
             "trace_dropped": self._local_trace_dropped(),
         }
 
@@ -332,6 +347,7 @@ class ObservabilityHub:
         memory_stats = {str(self.process_id): self.memory_stats_snapshot()}
         sink_stats = {str(self.process_id): self.sink_stats_snapshot()}
         udf_stats = {str(self.process_id): self.udf_stats_snapshot()}
+        fusion_stats = {str(self.process_id): self.fusion_stats_snapshot()}
         trace_dropped: dict[str, int] = {}
         stale: dict[str, float] = {}
         local_dropped = self._local_trace_dropped()
@@ -373,6 +389,9 @@ class ObservabilityHub:
             peer_udf = doc.get("udf")
             if peer_udf:
                 udf_stats[str(doc.get("process_id", "?"))] = peer_udf
+            peer_fusion = doc.get("fusion")
+            if peer_fusion:
+                fusion_stats[str(doc.get("process_id", "?"))] = peer_fusion
             peer_dropped = doc.get("trace_dropped")
             if peer_dropped is not None:
                 trace_dropped[str(doc.get("process_id", "?"))] = int(
@@ -381,7 +400,7 @@ class ObservabilityHub:
         snapshots.sort(key=lambda s: s.get("worker", 0))
         return (
             snapshots, comm_stats, trace_dropped, stale, memory_stats,
-            sink_stats, udf_stats,
+            sink_stats, udf_stats, fusion_stats,
         )
 
     @staticmethod
@@ -494,6 +513,7 @@ class ObservabilityHub:
         doc["memory"] = self.memory_stats_snapshot()
         doc["sinks"] = self.sink_stats_snapshot()
         doc["udf"] = self.udf_stats_snapshot()
+        doc["fusion"] = self.fusion_stats_snapshot()
         from .attribution import attribution_document
 
         doc["attribution"] = attribution_document(sig, w)
@@ -565,6 +585,7 @@ class ObservabilityHub:
         merged["memory"] = {str(self.process_id): local.get("memory", {})}
         merged["sinks"] = {str(self.process_id): local.get("sinks", {})}
         merged["udf"] = {str(self.process_id): local.get("udf", {})}
+        merged["fusion"] = {str(self.process_id): local.get("fusion", {})}
         merged["alerts"] = {
             "active": list(local.get("alerts", {}).get("active", [])),
             "history": list(local.get("alerts", {}).get("history", [])),
@@ -582,6 +603,7 @@ class ObservabilityHub:
             merged["memory"][str(pid)] = doc.get("memory", {})
             merged["sinks"][str(pid)] = doc.get("sinks", {})
             merged["udf"][str(pid)] = doc.get("udf", {})
+            merged["fusion"][str(pid)] = doc.get("fusion", {})
             alerts = doc.get("alerts", {})
             merged["alerts"]["active"].extend(alerts.get("active", []))
             merged["alerts"]["history"].extend(alerts.get("history", []))
@@ -694,7 +716,7 @@ class ObservabilityHub:
         if self.peer_http:
             (
                 snapshots, comm_stats, dropped_by_proc, stale,
-                memory_stats, sink_stats, udf_stats,
+                memory_stats, sink_stats, udf_stats, fusion_stats,
             ) = self.cluster_snapshots()
             # per-process labels, like the comm gauges: series identity
             # stays stable when a peer scrape transiently fails
@@ -709,6 +731,8 @@ class ObservabilityHub:
             sink_stats = {str(self.process_id): sinks} if sinks else {}
             udf = self.udf_stats_snapshot()
             udf_stats = {str(self.process_id): udf} if udf else {}
+            fusion = self.fusion_stats_snapshot()
+            fusion_stats = {str(self.process_id): fusion} if fusion else {}
             trace_dropped = self._local_trace_dropped()
         # label by TOPOLOGY, not by how many snapshots this scrape got:
         # in cluster mode a transient peer outage must not flip series
@@ -755,6 +779,7 @@ class ObservabilityHub:
             memory_stats=memory_stats or None,
             sink_stats=sink_stats or None,
             udf_stats=udf_stats or None,
+            fusion_stats=fusion_stats or None,
         )
 
     @staticmethod

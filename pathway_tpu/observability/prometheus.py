@@ -120,6 +120,7 @@ def render_snapshots(
     memory_stats: dict[str, dict[str, float]] | None = None,
     sink_stats: dict[str, dict[str, dict[str, float]]] | None = None,
     udf_stats: dict[str, dict[str, float]] | None = None,
+    fusion_stats: dict[str, dict[str, float]] | None = None,
 ) -> str:
     """Exposition text for a set of worker stats snapshots.
 
@@ -239,6 +240,15 @@ def render_snapshots(
         for key, value in sorted(gauges.items()):
             kind = "counter" if key.endswith("_total") else "gauge"
             r.add(f"pathway_udf_{key}", kind, value, plab)
+    for proc, gauges in sorted((fusion_stats or {}).items()):
+        # kernel-fusion counters (engine/fusion.py): chains compiled,
+        # member operators they absorbed, batches that fell back to the
+        # per-node path, whole-chain XLA compiles, key-reuse hits —
+        # the pathway_fusion_{chains,fused_ops,fallbacks}_total surface
+        plab = {"process": str(proc)}
+        for key, value in sorted(gauges.items()):
+            kind = "counter" if key.endswith("_total") else "gauge"
+            r.add(f"pathway_fusion_{key}", kind, value, plab)
     r.add("pathway_cluster_workers", "gauge", len(snapshots))
     if stale_workers:
         # a peer whose /snapshot scrape failed: its workers are reported

@@ -480,6 +480,12 @@ class SignalsPlane:
         # the columnar fast path after a deploy
         for key, value in self.hub.udf_stats_snapshot().items():
             self.store.record(f"udf.{key}", float(value), None, t)
+        # kernel-fusion counters (engine/fusion.py): chains compiled,
+        # member operators fused, per-batch fallbacks — an SLO rule can
+        # watch fusion.fallbacks_total to catch a stream that fell off
+        # the fused path after a schema/dtype change
+        for key, value in self.hub.fusion_stats_snapshot().items():
+            self.store.record(f"fusion.{key}", float(value), None, t)
 
     # -- lifecycle -----------------------------------------------------
 

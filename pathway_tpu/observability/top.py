@@ -170,6 +170,26 @@ def render_frame(doc: dict, now: float | None = None) -> str:
             f"{_fmt(u.get('traced_total'), nd=0)} traced, "
             f"{_fmt(u.get('perrow_rows_total'), nd=0)} row(s) per-row"
         )
+    fus = doc.get("fusion", {})
+    # merged docs key fusion by process; single-process docs are flat
+    fus_by_proc = (
+        fus
+        if fus and all(isinstance(v, dict) for v in fus.values())
+        else {str(doc.get("process_id", 0)): fus}
+    )
+    for proc in sorted(fus_by_proc):
+        f = fus_by_proc[proc] or {}
+        if not any(f.values()):
+            continue
+        line = (
+            f"fusion p{proc}: {_fmt(f.get('chains_total'), nd=0)} chain(s) "
+            f"({_fmt(f.get('fused_ops_total'), nd=0)} ops), "
+            f"{_fmt(f.get('preambles_total'), nd=0)} preamble(s), "
+            f"{_fmt(f.get('fallbacks_total'), nd=0)} fallback(s)"
+        )
+        if f.get("jit_chains_total"):
+            line += f", {_fmt(f.get('jit_chains_total'), nd=0)} XLA"
+        lines.append(line)
     sup = doc.get("supervisor")
     if sup is not None and sup.get("window_failures") is not None:
         budget = sup.get("window_budget")

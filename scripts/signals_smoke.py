@@ -263,6 +263,25 @@ def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
         assert "pathway_bottleneck_operator" in metrics
         report["alerts"] = {"fired": 1}
 
+        # -- attribution names the bottleneck INSIDE a fused chain: the
+        # slow Rowwise and the groupby preamble Rowwise fuse into one
+        # FusedChain node (engine/fusion.py), yet the ranked-first
+        # operator above is the member Rowwise label — per-chain cost
+        # splits re-derive per-operator attribution. The counters prove
+        # the chain really fused in this run.
+        import re as _re
+
+        m = _re.search(
+            r"pathway_fusion_chains_total\{[^}]*\} (\d+)", metrics
+        )
+        assert m is not None and int(m.group(1)) >= 1, (
+            "expected at least one fused chain on /metrics "
+            "(pathway_fusion_chains_total)"
+        )
+        assert "pathway_fusion_fused_ops_total" in metrics
+        assert "pathway_fusion_fallbacks_total" in metrics
+        report["fusion"] = {"chains": int(m.group(1))}
+
         # -- pathway-tpu top renders a live frame without errors
         top = subprocess.run(
             [
