@@ -229,6 +229,23 @@ def main() -> None:
     fusion_chains_compiled = int(_FS["chains_total"])
     wc_sharded_t2 = _wordcount_throughput(threads=2)
     wc_sharded_t4 = _wordcount_throughput(threads=4)
+    # same-host async-vs-BSP A/B on the UNIFORM lane: both arms (and the
+    # t1 denominator) in FRESH processes — in-process A/B is
+    # asymmetrically contaminated (key registry + hash memos grow across
+    # lanes; see the skew lane note)
+    t2_ab = _uniform_t2_ab()
+    skew = _skew_lane()
+    from pathway_tpu.io.python import INGEST_BUILD_STATS as _IBS
+
+    ingest_build = {
+        # delta building + key hashing fused into the connector batch
+        # builder (io/python._prebuild_batch): the subject share ran on
+        # producer threads, OFF the engine thread's critical path
+        "subject_ms": round(_IBS["subject_ns"] / 1e6, 1),
+        "engine_ms": round(_IBS["engine_ns"] / 1e6, 1),
+        "subject_rows": _IBS["subject_rows"],
+        "engine_rows": _IBS["engine_rows"],
+    }
     mesh_rows_per_sec = _mesh_exchange_throughput()
     cluster_n2 = _cluster_throughput()
     autoscale_pauses = _autoscale_pause_bench()
@@ -293,13 +310,33 @@ def main() -> None:
             "wordcount_sharded_t2_rows_per_sec": round(wc_sharded_t2, 1),
             "wordcount_sharded_t4_rows_per_sec": round(wc_sharded_t4, 1),
             "sharded_t2_efficiency": round(wc_sharded_t2 / wc_rows_per_sec, 3),
+            # fresh-process UNIFORM A/B (t1 + t2 async + t2 BSP escape
+            # hatch, one process each): on a uniform load the tick
+            # barrier was never the distribution tax (2x sweep cost +
+            # exchange bucketing + GIL are), so the two t2 arms track
+            # each other on this host — the async win shows where the
+            # barrier actually bites: the skew lane
+            "sharded_t2_ab": t2_ab,
+            # frontier-driven async execution under a deliberately
+            # hot-keyed, straggling shard (fresh processes per arm):
+            # rows/s of the FAST shard's drain, async vs the BSP barrier
+            # — "fast shards keep draining" vs "collapse to the slowest
+            # worker" — plus the fast worker's busy fraction over its
+            # drain window
+            "sharded_skew_rows_per_sec": (
+                skew["rows_per_sec"] if skew else None
+            ),
+            "sharded_skew": skew,
+            "ingest_build": ingest_build,
             "host_cores": n_cores,
             "sharded_note": (
                 "host exposes ONE core: N workers time-slice it, so "
                 "multi-worker ratios measure distribution overhead, not "
                 "parallel speedup (VERDICT r4 #6 needs a multi-core host; "
                 "correctness at 8 workers is covered by dryrun_multichip "
-                "+ tests/test_sharded.py)"
+                "+ tests/test_sharded.py). The uniform t2 efficiency is "
+                "barrier-independent here (see sharded_t2_ab); the "
+                "barrier's real cost shows in sharded_skew_*"
             ) if n_cores == 1 else None,
             "mesh_exchange_t2_rows_per_sec": (
                 round(mesh_rows_per_sec, 1) if mesh_rows_per_sec else None
@@ -369,6 +406,11 @@ def main() -> None:
                     [r[2] for r in apply_reps]
                 ),
                 "join_stream_rows_per_sec": _rep_stats(join_reps),
+                **(
+                    {"sharded_skew_rows_per_sec": _rep_stats(skew["reps"])}
+                    if skew and len(skew["reps"]) > 1
+                    else {}
+                ),
                 **(
                     {"autoscale_pause_ms": _rep_stats(autoscale_pauses)}
                     if autoscale_pauses and len(autoscale_pauses) > 1
@@ -970,26 +1012,299 @@ def _comm_codec_throughput(
     return mb / enc_s, mb / dec_s, nbytes / n_rows
 
 
-def _fusion_off():
-    """Context manager: run a lane through the PATHWAY_FUSION=0 escape
-    hatch (the knob is read at executor construction, so flipping the
-    env between lanes is exact)."""
+_SKEW_PROG = """
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+from pathway_tpu.utils.jaxcfg import guard_cpu_platform
+guard_cpu_platform()
+import numpy as np
+import pathway_tpu as pw
+from pathway_tpu.engine import keys as K
+
+# words pre-picked by shard: row keys AND groupby mix keys both derive
+# from the single word column at salt 0, so one shard_of probe pins a
+# word's entire path (source exchange + groupby exchange) to one worker
+fast_words, slow_words = [], []
+i = 0
+while len(fast_words) < 64 or len(slow_words) < 8:
+    w = f"w{{i}}"
+    key = K.mix_columns([np.array([w], dtype=object)], 1, register=False)
+    if int(K.shard_of(key, 2)[0]) == 0:
+        if len(fast_words) < 64:
+            fast_words.append(w)
+    elif len(slow_words) < 8:
+        slow_words.append(w)
+    i += 1
+
+N_FAST, BATCH = {n_fast}, 5_000
+N_SLOW = {n_slow}
+
+
+class FastFeed(pw.io.python.ConnectorSubject):
+    def run(self):
+        for s in range(0, N_FAST, BATCH):
+            self.next_batch({{
+                "word": [fast_words[j % len(fast_words)]
+                          for j in range(s, min(s + BATCH, N_FAST))]
+            }})
+            self.commit()
+
+
+class SlowFeed(pw.io.python.ConnectorSubject):
+    def run(self):
+        for j in range(N_SLOW):
+            self.next(word=slow_words[j % len(slow_words)])
+            self.commit()
+
+
+fast = pw.io.python.read(
+    FastFeed(), schema=pw.schema_from_types(word=str),
+    autocommit_duration_ms=None,
+)
+slow = pw.io.python.read(
+    SlowFeed(), schema=pw.schema_from_types(word=str),
+    autocommit_duration_ms=None,
+)
+pause = {pause_ms} / 1000.0
+
+
+def crawl(w):
+    # the straggler: a blocking external call per hot row (sleep releases
+    # the GIL — I/O-bound slowness, the realistic skew). Closure-impure so
+    # the lifter leaves it on the per-row path.
+    time.sleep(pause)
+    return w
+
+
+slowed = slow.select(word=pw.apply_with_type(crawl, str, pw.this.word))
+fc = fast.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+sc = slowed.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+prog = {{"fast_rows": 0, "fast_last": 0.0, "park_ns": 0, "exch_ns": 0}}
+t0 = time.perf_counter()
+
+
+def on_fast(time_, b):
+    prog["fast_rows"] = max(prog["fast_rows"], int(b.data["c"].max()))
+    prog["fast_last"] = time.perf_counter()
+    r = holder.get("r")
+    if r is not None:
+        # this callback runs ON worker 0's engine thread (gather):
+        # snapshot its waiting counters AT the fast stream's drain point
+        ex0 = r._peer_executors[0]
+        prog["park_ns"] = ex0._idle_park_ns
+        prog["exch_ns"] = sum(
+            ns for label, ns in ex0.stats.time_by_node.items()
+            if label.startswith("Exchange#")
+        )
+
+
+pw.io.subscribe(fc, on_batch=on_fast)
+pw.io.subscribe(sc, on_batch=lambda t, b: None)
+
+# the runner reference is cleared when pw.run returns — grab it mid-run
+import threading
+
+holder = {{}}
+
+
+def grab():
+    from pathway_tpu.internals.run import _current
+
+    while "r" not in holder:
+        r = _current["runner"]
+        if r is not None and getattr(r, "_peer_executors", None):
+            holder["r"] = r
+            return
+        time.sleep(0.01)
+
+
+threading.Thread(target=grab, daemon=True).start()
+pw.run()
+total_s = time.perf_counter() - t0
+fast_drain_s = max(prog["fast_last"] - t0, 1e-9)
+
+# busy over the fast worker's drain window = 1 - waiting/window.
+# Waiting = idle parks; under the BSP barrier also the time blocked
+# inside exchange collectives (that is exactly the wait the barrier
+# forces — under async, Exchange node time is genuine routing work and
+# stays "busy"). Conservative for BSP: the cycle-allgather wait is not
+# even counted.
+waiting_s = prog["park_ns"] / 1e9
+if os.environ.get("PATHWAY_ASYNC_EXEC") == "0":
+    waiting_s += prog["exch_ns"] / 1e9
+busy_frac = max(0.0, min(1.0, 1.0 - waiting_s / fast_drain_s))
+print(json.dumps({{
+    "rows_per_sec": N_FAST / fast_drain_s,
+    "fast_drain_s": fast_drain_s,
+    "total_s": total_s,
+    "fast_busy_frac": busy_frac,
+}}))
+"""
+
+
+def _skew_lane(reps: int = 3) -> dict | None:
+    """``sharded_skew_rows_per_sec``: 2-worker wordcount with a
+    deliberately hot-keyed, straggling shard — worker 1's keys pass a
+    blocking per-row call while worker 0 gets a firehose of cold keys.
+    Measures how fast the FAST shard drains (rows/s of the fast stream
+    until its last output update): under the BSP tick barrier the fast
+    worker advances in lock-step with the straggler (throughput collapses
+    to the slowest worker); under frontier-driven async execution
+    (PATHWAY_ASYNC_EXEC=1, the default) fast shards keep draining. Both
+    arms run in FRESH processes, ``reps`` times each (A/B lanes
+    contaminate each other in-process: key registry + hash memos grow
+    across runs)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    prog = _SKEW_PROG.format(
+        repo=repo, n_fast=150_000, n_slow=40, pause_ms=25,
+    )
+
+    def arm(async_exec: str) -> list[dict]:
+        out = []
+        for _ in range(reps):
+            env = {
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "PATHWAY_THREADS": "2",
+                "PATHWAY_ASYNC_EXEC": async_exec,
+                # detailed per-node timing (busy fractions) rides the
+                # monitoring hub; the port hardly matters — a taken port
+                # degrades to metrics-off but keeps detailed timing on
+                "PATHWAY_MONITORING_HTTP_SERVER": "1",
+                "PATHWAY_MONITORING_HTTP_PORT": "0",
+            }
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c", prog], env=env,
+                    capture_output=True, text=True, timeout=600,
+                )
+            except subprocess.TimeoutExpired:
+                print("bench: skew lane rep timed out", file=sys.stderr)
+                return out
+            if r.returncode != 0:
+                print(
+                    f"bench: skew lane rep failed (rc={r.returncode}):\n"
+                    f"{r.stderr.strip()[-2000:]}",
+                    file=sys.stderr,
+                )
+                return out
+            try:
+                out.append(json.loads(r.stdout.strip().splitlines()[-1]))
+            except (ValueError, IndexError):
+                print(
+                    f"bench: skew lane output unreadable: "
+                    f"{r.stdout[-500:]}", file=sys.stderr,
+                )
+                return out
+        return out
+
+    async_reps = arm("1")
+    bsp_reps = arm("0")
+    if not async_reps or not bsp_reps:
+        return None
+    best_async = max(async_reps, key=lambda d: d["rows_per_sec"])
+    best_bsp = max(bsp_reps, key=lambda d: d["rows_per_sec"])
+    return {
+        "rows_per_sec": round(best_async["rows_per_sec"], 1),
+        "rows_per_sec_bsp": round(best_bsp["rows_per_sec"], 1),
+        # >1 = the async fast shard drains that many times faster than
+        # the barrier lets it; the "collapse to the slowest worker" ratio
+        "graceful_vs_collapse": round(
+            best_async["rows_per_sec"] / best_bsp["rows_per_sec"], 2
+        ),
+        "fast_busy_frac": round(best_async["fast_busy_frac"], 3),
+        "fast_busy_frac_bsp": round(best_bsp["fast_busy_frac"], 3),
+        "fast_drain_s": round(best_async["fast_drain_s"], 3),
+        "total_s": round(best_async["total_s"], 3),
+        "reps": [round(d["rows_per_sec"], 1) for d in async_reps],
+        "reps_bsp": [round(d["rows_per_sec"], 1) for d in bsp_reps],
+    }
+
+
+def _env_off(name: str):
+    """Context manager: run a lane with ``name=0`` (escape hatches are
+    read at executor construction, so flipping the env between lanes is
+    exact)."""
     import contextlib
     import os
 
     @contextlib.contextmanager
     def ctx():
-        prev = os.environ.get("PATHWAY_FUSION")
-        os.environ["PATHWAY_FUSION"] = "0"
+        prev = os.environ.get(name)
+        os.environ[name] = "0"
         try:
             yield
         finally:
             if prev is None:
-                os.environ.pop("PATHWAY_FUSION", None)
+                os.environ.pop(name, None)
             else:
-                os.environ["PATHWAY_FUSION"] = prev
+                os.environ[name] = prev
 
     return ctx()
+
+
+def _fusion_off():
+    return _env_off("PATHWAY_FUSION")
+
+
+def _uniform_t2_ab() -> dict | None:
+    """Uniform-load sharded A/B in FRESH processes: single-worker
+    baseline, 2-thread async, and 2-thread BSP (PATHWAY_ASYNC_EXEC=0) —
+    one process per arm, one warmup + best-of-2 each, so neither arm
+    inherits the other's key-registry/memo contamination."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    prog = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from pathway_tpu.utils.jaxcfg import guard_cpu_platform\n"
+        "guard_cpu_platform()\n"
+        "from bench import _wordcount_throughput\n"
+        "_wordcount_throughput(n_rows=100_000, threads=%d)\n"
+        "print(max(_wordcount_throughput(threads=%d) for _ in range(2)))\n"
+    )
+
+    def arm(threads: int, async_exec: str) -> float | None:
+        env = {
+            **os.environ, "JAX_PLATFORMS": "cpu",
+            "PATHWAY_ASYNC_EXEC": async_exec,
+        }
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", prog % (repo, threads, threads)],
+                env=env, capture_output=True, text=True, timeout=600,
+            )
+        except subprocess.TimeoutExpired:
+            return None
+        if r.returncode != 0:
+            print(
+                f"bench: uniform t2 A/B arm failed (rc={r.returncode}):\n"
+                f"{r.stderr.strip()[-1000:]}", file=sys.stderr,
+            )
+            return None
+        try:
+            return float(r.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            return None
+
+    t1 = arm(1, "1")
+    t2_async = arm(2, "1")
+    t2_bsp = arm(2, "0")
+    if not t1 or not t2_async or not t2_bsp:
+        return None
+    return {
+        "t1_rows_per_sec": round(t1, 1),
+        "t2_async_rows_per_sec": round(t2_async, 1),
+        "t2_bsp_rows_per_sec": round(t2_bsp, 1),
+        "efficiency_async": round(t2_async / t1, 3),
+        "efficiency_bsp": round(t2_bsp / t1, 3),
+    }
 
 
 def _wordcount_throughput(

@@ -31,6 +31,7 @@ __all__ = [
     "calls_in",
     "call_guarded",
     "calls_inside_loops",
+    "async_chaos_sites_gate",
     "chaos_sites_gate",
     "fusion_metrics_gate",
     "fusion_reasons_gate",
@@ -283,6 +284,136 @@ def chaos_sites_gate() -> list[str]:
                 "never called outside chaos/ — the site is declared but "
                 "nothing can ever fire it"
             )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# gate: tick/phase-indexed chaos sites stay live on the ASYNC path
+# ---------------------------------------------------------------------------
+
+
+def _reachable_methods(methods: dict, start: str) -> set[str]:
+    """Method names transitively reachable from ``start`` via
+    ``self.<name>(...)`` calls (one class, name-based — exactly what the
+    executor's loop structure needs)."""
+    seen: set[str] = set()
+    frontier = [start]
+    while frontier:
+        name = frontier.pop()
+        if name in seen or name not in methods:
+            continue
+        seen.add(name)
+        for node in ast.walk(methods[name]):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+            ):
+                frontier.append(node.func.attr)
+    return seen
+
+
+def declared_phase_vocab() -> dict[str, tuple[str, ...]]:
+    """site -> phase tuple, read from chaos/plan.py source (RESCALE_PHASES
+    / AUTOSCALE_PHASES feeding _PHASES_BY_SITE)."""
+    tree = parse_file(os.path.join(PACKAGE_DIR, "chaos", "plan.py"))
+    consts: dict[str, tuple] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+            isinstance(node.targets[0], ast.Name)
+        ):
+            name = node.targets[0].id
+            if name in ("RESCALE_PHASES", "AUTOSCALE_PHASES"):
+                consts[name] = tuple(ast.literal_eval(node.value))
+    return {
+        "rescale": consts.get("RESCALE_PHASES", ()),
+        "autoscale": consts.get("AUTOSCALE_PHASES", ()),
+    }
+
+
+@gate(
+    "async_chaos_sites",
+    "tick/autoscale/rescale chaos sites keep live call-sites under the "
+    "frontier-driven async executor (no silently disarmed fault "
+    "injection after the BSP refactor)",
+)
+def async_chaos_sites_gate() -> list[str]:
+    """The BSP→async refactor moved the executor's event loop; a fault
+    plan written against tick-indexed sites (``tick``, and the phased
+    ``rescale``/``autoscale`` sites it composes with) must keep firing:
+
+    - the async loop must transitively reach ``_tick``, and ``_tick``
+      must still fire the bound tick fault (``self._tick_fault.fire``);
+    - both async sweep shapes (source rounds AND the commit-wave settle)
+      must go through ``_tick`` — a settle path with its own sweep would
+      silently skip the tick site;
+    - every declared rescale/autoscale phase must still appear as a
+      literal ``fire("<phase>")`` call site in its owning module (those
+      fire from the resharder/controller, which the async executor's
+      drain/commit protocol drives).
+    """
+    problems: list[str] = []
+    tree = parse_file(os.path.join(PACKAGE_DIR, "engine", "executor.py"))
+    methods = method_defs(tree, "Executor")
+    for loop_entry in ("_stream_loop_sharded_async", "_async_settle"):
+        if loop_entry not in methods:
+            problems.append(
+                f"executor.py: Executor.{loop_entry} not found — the "
+                "async loop the gate audits is gone (rename the gate's "
+                "anchor or restore the method)"
+            )
+            continue
+        if "_tick" not in _reachable_methods(methods, loop_entry):
+            problems.append(
+                f"Executor.{loop_entry} never reaches _tick: async "
+                "sweeps bypass the tick chaos site — fault plans with "
+                "site 'tick' are silently disarmed on this path"
+            )
+    tick_fn = methods.get("_tick")
+    fires_tick = tick_fn is not None and any(
+        isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "fire"
+        and isinstance(n.func.value, ast.Attribute)
+        and n.func.value.attr == "_tick_fault"
+        for n in ast.walk(tick_fn)
+    )
+    if not fires_tick:
+        problems.append(
+            "Executor._tick no longer fires self._tick_fault — the tick "
+            "chaos site is dead in BOTH execution modes"
+        )
+    # phased sites: every declared phase keeps a literal fire call-site
+    owners = {
+        "rescale": os.path.join(PACKAGE_DIR, "rescale"),
+        "autoscale": os.path.join(PACKAGE_DIR, "autoscale"),
+    }
+    for site, phases in declared_phase_vocab().items():
+        fired: set[str] = set()
+        for path in iter_py_files(owners[site]):
+            for node in ast.walk(parse_file(path)):
+                if (
+                    isinstance(node, ast.Call)
+                    and (
+                        (isinstance(node.func, ast.Name)
+                         and "fire" in node.func.id)
+                        or (isinstance(node.func, ast.Attribute)
+                            and "fire" in node.func.attr)
+                    )
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    fired.add(node.args[0].value)
+        for phase in phases:
+            if phase not in fired:
+                problems.append(
+                    f"chaos site {site!r}: declared phase {phase!r} has "
+                    f"no fire({phase!r}) call-site under "
+                    f"{os.path.relpath(owners[site], ROOT)} — the phase "
+                    "is plannable but can never fire"
+                )
     return problems
 
 

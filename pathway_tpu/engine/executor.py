@@ -10,7 +10,12 @@ order", which is exactly the reference's total-order ``Timestamp``
 (``src/engine/timestamp.rs:20``) semantics.
 
 Multi-worker sharding (reference: timely exchange channels) is layered above
-by partitioning deltas on ``keys.shard_of`` — see ``parallel/``.
+by partitioning deltas on ``keys.shard_of`` — see ``parallel/``. Sharded
+STREAMING runs default to frontier-driven asynchronous execution (each
+worker advances on data availability, consistency via frontier broadcasts
+and commit waves — the timely progress model proper; see the block comment
+above ``_use_async``); ``PATHWAY_ASYNC_EXEC=0`` restores the lock-step
+global tick.
 """
 
 from __future__ import annotations
@@ -657,6 +662,14 @@ class Executor:
             armed.tick_fault(self.ctx.worker_id) if armed is not None else None
         )
         self._tick_seq = 0
+        #: perf_counter_ns of the last flight-recorded tick (throttle)
+        self._flight_tick_ns = 0
+        #: cumulative ns this worker spent PARKED waiting for work in its
+        #: streaming loop (async or BSP) — the skew bench's busy-fraction
+        #: denominator piece ("waiting" vs "working"); blocked-in-
+        #: collective time is NOT parked time (it hides in Exchange
+        #: node time under detailed monitoring)
+        self._idle_park_ns = 0
         #: ingest wall-time (ns) of the oldest row feeding the NEXT tick
         #: (set by the streaming loops from connector stamps); consumed
         #: and cleared by _tick to observe ingest→emit latency
@@ -719,6 +732,12 @@ class Executor:
         stateless = not any(n.has_state() for n in self.nodes)
         if stateless:
             K._suspend_registration(+1)  # thread-local: this executor only
+        # the suspension is thread-local, but connector batch builders now
+        # hash keys on their SUBJECT threads (io/python._prebuild_batch —
+        # fused key derivation): tell the sources explicitly
+        for node in self.nodes:
+            if isinstance(node, RealtimeSource):
+                node._keys_register = not stateless
         if self.flight is not None:
             self.flight.record(
                 "run.start",
@@ -814,7 +833,14 @@ class Executor:
                     src._coalesce_windows = 0
 
         if self.ctx.is_sharded:
-            self._stream_loop_sharded(realtime, clock)
+            # frontier-driven asynchronous execution is the default for
+            # sharded streaming (PATHWAY_ASYNC_EXEC=0 restores the BSP
+            # lock-step tick loop bit-for-bit); recovery replay above ran
+            # lock-step either way — only the LIVE loop changes shape
+            if self._use_async():
+                self._stream_loop_sharded_async(realtime, clock)
+            else:
+                self._stream_loop_sharded(realtime, clock)
             self._finish()
             return
 
@@ -958,11 +984,361 @@ class Executor:
                     # park until owned-source data arrives or the poll
                     # interval lapses; peers' data surfaces via the next
                     # cycle's allgather either way
+                    park_t0 = _time.perf_counter_ns()
                     wake.wait(0.005)
                     wake.clear()
+                    self._idle_park_ns += _time.perf_counter_ns() - park_t0
         finally:
             for src in owned:
                 src.stop()
+
+    # -- frontier-driven asynchronous execution (ROADMAP item 2) ---------
+    #
+    # The BSP loop above advances the whole cluster in lock-step: a
+    # per-cycle allgather plus a blocking all-to-all per Exchange per tick
+    # means one slow or skewed worker stalls everyone. The async loop
+    # below is the timely/differential model (SURVEY §0/§2.5) under this
+    # engine's total-order timestamps:
+    #
+    # - each worker mints its OWN tick times and sweeps on data
+    #   availability (its sources' polls + whatever peers posted);
+    # - Exchange nodes post buckets fire-and-forget and merge arrivals
+    #   eagerly — data moves asynchronously, accumulation commutes;
+    # - consistency comes from frontiers (engine/frontier.py): each
+    #   worker broadcasts "all my future sends are at times > f", and
+    #   commits/termination settle on a frontier-agreed boundary via the
+    #   QuiesceVotes protocol before any worker snapshots state;
+    # - exactly-once carries over because the delivery layer and the
+    #   persistence snapshots key on logical time: commit waves pick a
+    #   global time T > every worker's clock, settle all data <= T
+    #   everywhere (two clean vote rounds), then every worker snapshots
+    #   at the SAME T — the frontier-derived commit boundary replacing
+    #   the BSP "agreed tick".
+    #
+    # PATHWAY_ASYNC_EXEC=0 restores the BSP loop bit-for-bit; recovery
+    # replay and the END_TIME flush sweep stay lock-step in both modes.
+
+    def _use_async(self) -> bool:
+        if not self.ctx.is_sharded or self.ctx.comm is None:
+            return False
+        import os
+
+        raw = os.environ.get("PATHWAY_ASYNC_EXEC")
+        if raw is not None:
+            enabled = raw.strip().lower() not in ("0", "false", "no", "off")
+        else:
+            # the ICI mesh-exchange collective is bulk-synchronous by
+            # construction — keep it the owner of record exchange unless
+            # async is explicitly requested
+            enabled = not hasattr(self.ctx.comm, "exchange_deltas")
+        return enabled and self.ctx.comm.supports_async()
+
+    def _mint(self, clock: int) -> int:
+        """Next local tick time: even wall-clock ms, strictly increasing
+        (timestamp.rs:22-28) — per worker now, not cluster-agreed."""
+        import time as _time
+
+        return max(clock + 2, int(_time.time() * 1000) & ~1)
+
+    def _stream_loop_sharded_async(
+        self, realtime: list[RealtimeSource], clock: int
+    ) -> None:
+        import time as _time
+
+        from ..internals.config import _env_float
+        from ..parallel.asyncplane import AsyncPlane
+        from .frontier import QuiesceVotes
+
+        ctx = self.ctx
+        plane = AsyncPlane(ctx.comm, ctx.worker_id, ctx.n_workers)
+        ctx.async_plane = plane
+        self._async_timeout_s = _env_float(
+            "PATHWAY_COLLECTIVE_TIMEOUT_S", 600.0
+        )
+        bcast_s = _env_float("PATHWAY_FRONTIER_MS", 5.0) / 1000.0
+        delivery = (
+            getattr(self.persistence, "delivery", None)
+            if self.persistence is not None
+            else None
+        )
+        if delivery is not None:
+            delivery.use_boundary_acks()
+        owned = owned_sources(realtime, ctx)
+        for src in owned:
+            src.attach_waker(plane.waker)
+            src.start()
+        self.stats.sources_connected = True
+        epoch = 0
+        stop_seen = False
+        term_votes: QuiesceVotes | None = None
+        stall_logged = False
+        participated_final = False
+        if self.flight is not None:
+            self.flight.record(
+                "async.start", worker=ctx.worker_id, n_workers=ctx.n_workers
+            )
+        try:
+            plane.broadcast_status({"ep": 0})
+            while True:
+                self.stats.heartbeat()
+                plane.drain()
+                worked = False
+                # 1. poll OWNED sources; each commit batch gets its own
+                #    locally-minted tick (round alignment across sources
+                #    as in the BSP loop; no cross-worker agreement needed)
+                rounds: list[list[tuple[SourceNode, Delta]]] = []
+                ingest: list[int | None] = []
+                # backpressure: a peer inbox (or outbound pipeline) at its
+                # bound pauses ingestion — queued work drains, new data
+                # waits at the connectors (bounded per-operator queues;
+                # remote workers' depths ride their status broadcasts)
+                if not stop_seen and not plane.congested():
+                    for src in owned:
+                        deltas = src.poll()
+                        stamps = src.take_ingest_stamps()
+                        for j, delta in enumerate(deltas):
+                            if delta is None or not len(delta):
+                                continue
+                            while len(rounds) <= j:
+                                rounds.append([])
+                                ingest.append(None)
+                            rounds[j].append((src, delta))
+                            ingest[j] = _min_stamp(
+                                ingest[j],
+                                stamps[j] if j < len(stamps) else None,
+                            )
+                for j, emissions in enumerate(rounds):
+                    clock = self._mint(clock)
+                    self._next_tick_ingest_ns = _min_stamp(
+                        ingest[j], plane.pending_ingest_ns()
+                    )
+                    self._tick(clock, emissions)
+                    worked = True
+                # 2. peer arrivals with no local round to ride (Exchange
+                #    is always_run, so round sweeps above already took
+                #    them) get a sweep of their own
+                if not rounds and plane.releasable():
+                    clock = self._mint(clock)
+                    self._next_tick_ingest_ns = plane.pending_ingest_ns()
+                    self._tick(clock, [])
+                    worked = True
+                # NOTE: unlike the BSP loop, no note_delivery_boundary()
+                # here — a locally-ticked round only proves the rows were
+                # POSTED, not that peers processed them or that their
+                # output came back. Advancing the close-path boundary on
+                # local progress would let a surviving worker's close()
+                # commit input whose output died in a peer, and the
+                # replay's skip_until would then suppress it forever (one
+                # lost row per in-flight exchange). The boundary advances
+                # only inside commit waves, where the settle quiesce
+                # proves global <=T processing; input recorded after the
+                # last wave is truncated by close() and re-read live on
+                # resume (at-least-once callbacks, exactly-once state).
+                # 3. frontier: everything this worker will ever send now
+                #    carries a time > its clock; an idle worker promises
+                #    up to the wall clock so peers' commit waves and stall
+                #    detection never wait on a parked worker
+                now = _time.monotonic()
+                if not worked:
+                    # idle promise up to the wall clock — and raise the
+                    # local clock floor WITH it, so a later backwards
+                    # wall step (NTP) can never mint a tick at or below
+                    # the already-broadcast frontier (mints are
+                    # max(clock+2, wall), monotone in clock)
+                    clock = max(clock, (int(_time.time() * 1000) & ~1) - 2)
+                plane.tracker.advance_local(
+                    max(clock, plane.tracker.local()), now=now
+                )
+                plane.broadcast_status({}, min_interval_s=bcast_s)
+                if not stop_seen and (
+                    self._stop_requested
+                    or any(
+                        st.get("stop")
+                        for st in plane.peer_status.values()
+                    )
+                ):
+                    # sticky + broadcast: every worker flushes its drained
+                    # rounds, stops polling, and converges on termination
+                    stop_seen = True
+                    plane.broadcast_status({"stop": True})
+                # 4. commit wave: any worker's snapshot-interval lapse (or
+                #    sink release pressure) pulls the whole cluster into a
+                #    wave at a frontier-agreed time
+                if self.persistence is not None:
+                    want = self.persistence.should_commit() or any(
+                        st.get("wc") == epoch
+                        or (
+                            st.get("cr") is not None
+                            and st["cr"][0] == epoch
+                        )
+                        for st in plane.peer_status.values()
+                    )
+                    if want:
+                        clock, was_final = self._async_commit_wave(
+                            plane, clock, epoch
+                        )
+                        epoch += 1
+                        if was_final:
+                            # a terminated peer marked this wave final:
+                            # global quiescence is proven (its vote round
+                            # needed everyone), so skip straight out
+                            participated_final = True
+                            break
+                        continue
+                # 5. termination: when locally drained + finished (or
+                #    stopping), vote; two clean rounds across the cluster
+                #    = the dataflow is quiescent everywhere
+                finished = all(src.is_finished() for src in owned)
+                if not worked and (finished or stop_seen) \
+                        and not plane.releasable():
+                    if term_votes is None:
+                        term_votes = QuiesceVotes(
+                            ctx.n_workers, ctx.worker_id, "term"
+                        )
+                    if term_votes.needs_cast():
+                        payload = term_votes.cast(
+                            plane.sent_events, plane.recv_events,
+                            plane.take_activity(),
+                        )
+                        plane.broadcast_status({"vote": payload})
+                    for w, v in plane.take_votes("term"):
+                        term_votes.observe(w, v)
+                    if term_votes.step():
+                        break
+                if not worked:
+                    # stall observability: name a peer that stopped
+                    # advancing while others make progress (once)
+                    if not stall_logged:
+                        stalled = plane.tracker.stalled(now, 30.0)
+                        if stalled and self.flight is not None:
+                            self.flight.record(
+                                "async.stall",
+                                worker=ctx.worker_id,
+                                stalled=stalled,
+                            )
+                            stall_logged = True
+                    park_t0 = _time.perf_counter_ns()
+                    plane.waker.wait(0.005)
+                    plane.waker.clear()
+                    self._idle_park_ns += _time.perf_counter_ns() - park_t0
+            # final consistency point: one last wave so every worker's
+            # newest snapshot shares ONE frontier-derived time (the
+            # _finish path then commits at the same _last_clock cluster-
+            # wide, exactly like the BSP loop's agreed ticks). It is a
+            # REGULAR epoch wave carrying a ``fin`` marker: workers still
+            # inside their main loop join it by epoch number exactly like
+            # any other wave (a sentinel epoch would deadlock against a
+            # concurrently-triggered regular wave), and the marker tells
+            # them it was the last one.
+            if self.persistence is not None and not participated_final:
+                clock, _ = self._async_commit_wave(
+                    plane, clock, epoch, fin=True
+                )
+                epoch += 1
+            if self.flight is not None:
+                self.flight.record(
+                    "async.end", worker=ctx.worker_id,
+                    frontier=plane.tracker.local(), epochs=epoch,
+                )
+        finally:
+            for src in owned:
+                src.stop()
+            # the END_TIME flush sweep (and any recovery that follows a
+            # crash) runs over the blocking collectives again
+            ctx.async_plane = None
+
+    def _async_commit_wave(
+        self, plane, clock: int, epoch: int, fin: bool = False
+    ) -> tuple[int, bool]:
+        """One frontier-coordinated commit: agree on a target time T
+        greater than every worker's clock, settle all data <= T
+        everywhere (quiesce votes — settle sweeps are labeled exactly T,
+        so multi-hop forwarding of <=T input stays inside the boundary),
+        then snapshot at T on every worker. Replaces the BSP loop's
+        agreed-tick collective commit; SIGKILL at ANY point recovers to
+        the newest snapshot common to all workers, exactly as before.
+        Returns (clock, was_final): final when any participant entered
+        post-termination (its ``fin`` marker rides the ready payload)."""
+        import time as _time
+
+        from .frontier import QuiesceVotes
+
+        ctx = self.ctx
+        deadline = _time.monotonic() + self._async_timeout_s
+        ready_clock = max(clock, plane.tracker.local())
+        plane.broadcast_status(
+            {"wc": epoch, "cr": [epoch, ready_clock, bool(fin)]}
+        )
+        readys = {ctx.worker_id: ready_clock}
+        was_final = bool(fin)
+        while len(readys) < ctx.n_workers:
+            plane.drain()  # keeps inbox bounds free; nothing is processed
+            for w, st in plane.peer_status.items():
+                cr = st.get("cr")
+                if cr is not None and cr[0] == epoch:
+                    readys[w] = cr[1]
+                    if len(cr) > 2 and cr[2]:
+                        was_final = True
+            if len(readys) >= ctx.n_workers:
+                break
+            if _time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"worker {ctx.worker_id}: commit wave {epoch} timed "
+                    f"out collecting ready clocks ({len(readys)}/"
+                    f"{ctx.n_workers}; PATHWAY_COLLECTIVE_TIMEOUT_S)"
+                )
+            plane.waker.wait(0.002)
+            plane.waker.clear()
+        # T is strictly greater than every worker's promise: settle
+        # sweeps at T can lawfully post data derived from <=T arrivals
+        T = (max(readys.values()) + 2) & ~1
+        clock = max(clock, T)
+        plane.hold_above = T
+        votes = QuiesceVotes(ctx.n_workers, ctx.worker_id, f"cw{epoch}")
+        self._async_settle(plane, votes, deadline, label=T)
+        if plane.tracker.local() < T:
+            plane.tracker.advance_local(T, now=_time.monotonic())
+        if self.flight is not None:
+            self.flight.record(
+                "async.commit", worker=ctx.worker_id, epoch=epoch, time=T
+            )
+        self.persistence.commit(T)
+        self._last_clock = max(self._last_clock, T)
+        plane.hold_above = None
+        plane.broadcast_status({"wc": -1, "cr": None, "ep": epoch + 1})
+        return clock, was_final
+
+    def _async_settle(self, plane, votes, deadline: float,
+                      label: int) -> None:
+        """Drive the quiesce protocol for one commit wave: deliver every
+        queued arrival <= label (sweeps run at exactly ``label``), vote,
+        repeat until two consecutive clean rounds prove nothing at or
+        below the boundary is in flight anywhere."""
+        import time as _time
+
+        while True:
+            plane.drain()
+            while plane.releasable():
+                self._next_tick_ingest_ns = plane.pending_ingest_ns()
+                self._tick(label, [])
+            if votes.needs_cast():
+                payload = votes.cast(
+                    plane.sent_events, plane.recv_events,
+                    plane.take_activity(),
+                )
+                plane.broadcast_status({"vote": payload})
+            for w, v in plane.take_votes(votes.phase):
+                votes.observe(w, v)
+            if votes.step():
+                return
+            if _time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"worker {self.ctx.worker_id}: commit-wave settle "
+                    f"({votes.phase}) timed out at round {votes.round} "
+                    "(PATHWAY_COLLECTIVE_TIMEOUT_S)"
+                )
+            plane.waker.wait(0.002)
+            plane.waker.clear()
 
     def _recover(self, realtime: list[RealtimeSource]) -> int:
         """Restore operator state from the newest usable snapshot, replay
@@ -1151,6 +1527,13 @@ class Executor:
         tick_t0 = _wall.perf_counter_ns()
         ingest_ns = self._next_tick_ingest_ns
         self._next_tick_ingest_ns = None
+        plane = getattr(self.ctx, "async_plane", None)
+        if plane is not None:
+            # async mode: Exchange posts forward the ORIGIN's ingest stamp
+            # with the data, so the sink worker's ingest→emit observation
+            # measures the true cross-worker path (the BSP loop shipped
+            # this through the cycle allgather instead)
+            plane.cur_ingest_ns = ingest_ns
         out_rows_before = self.stats.output_rows
         inbox: dict[int, dict[int, list[Delta]]] = {}
         seeded: dict[int, list[Delta]] = {}
@@ -1266,15 +1649,23 @@ class Executor:
                 ),
             )
         if self.flight is not None:
-            self.flight.record(
-                "tick",
-                worker=self.ctx.worker_id,
-                time=time if time != END_TIME else -1,
-                seq=self._tick_seq - 1,
-                dur_ms=round((_wall.perf_counter_ns() - tick_t0) / 1e6, 3),
-                rows=self.stats.rows_total,
-                out=self.stats.output_rows,
-            )
+            # throttled to one record per 10ms: the ring's job is the
+            # FINAL ticks before a crash, and async execution sweeps more
+            # often than the BSP loop ticked (arrival sweeps) — recording
+            # every sweep would rotate rarer forensic records (chaos
+            # fired, slo.alert, comm.broken) out of the ring faster
+            now_ns = _wall.perf_counter_ns()
+            if now_ns - self._flight_tick_ns >= 10_000_000:
+                self._flight_tick_ns = now_ns
+                self.flight.record(
+                    "tick",
+                    worker=self.ctx.worker_id,
+                    time=time if time != END_TIME else -1,
+                    seq=self._tick_seq - 1,
+                    dur_ms=round((now_ns - tick_t0) / 1e6, 3),
+                    rows=self.stats.rows_total,
+                    out=self.stats.output_rows,
+                )
         if self._state_budget is not None:
             # after the persistence commit: spilled segments materialize
             # into snapshots, so shedding right after one avoids paying an

@@ -348,25 +348,35 @@ def _suspend_registration(delta: int) -> None:
 
 
 class _PyKeyRegistry:
-    """Pure-python fallback registry (native module unavailable)."""
+    """Pure-python fallback registry (native module unavailable).
+
+    Locked: registration runs concurrently from sharded worker threads
+    AND connector subject threads (fused batch-builder key hashing,
+    io/python._prebuild_batch) — an unlocked get-then-insert could let
+    two racing threads insert two different HI lanes for one LO lane and
+    silently miss the very conflation this registry exists to catch.
+    (The native registry is a single C call that never releases the GIL,
+    so it is serialized by construction.)"""
 
     def __init__(self, cap: int):
         self._map: dict[int, int] = {}
         self._cap = cap
         self.frozen = False
+        self._lock = _threading.Lock()
 
     def register(self, lo: np.ndarray, hi: np.ndarray) -> int:
-        m = self._map
-        for i, (l, h) in enumerate(zip(lo.tolist(), hi.tolist())):
-            cur = m.get(l)
-            if cur is None:
-                if not self.frozen:
-                    m[l] = h
-                    if len(m) >= self._cap:
-                        self.frozen = True
-            elif cur != h:
-                return i
-        return -1
+        with self._lock:
+            m = self._map
+            for i, (l, h) in enumerate(zip(lo.tolist(), hi.tolist())):
+                cur = m.get(l)
+                if cur is None:
+                    if not self.frozen:
+                        m[l] = h
+                        if len(m) >= self._cap:
+                            self.frozen = True
+                elif cur != h:
+                    return i
+            return -1
 
     def register_overflow(
         self, lo: np.ndarray, hi: np.ndarray, miss: np.ndarray
@@ -374,19 +384,20 @@ class _PyKeyRegistry:
         """Native ``KeyRegistry.register_overflow`` parity: frozen-table
         misses flag ``miss[i] = 1`` for the cold tier instead of passing
         unchecked."""
-        m = self._map
-        for i, (l, h) in enumerate(zip(lo.tolist(), hi.tolist())):
-            cur = m.get(l)
-            if cur is None:
-                if not self.frozen:
-                    m[l] = h
-                    if len(m) >= self._cap:
-                        self.frozen = True
-                else:
-                    miss[i] = 1
-            elif cur != h:
-                return i
-        return -1
+        with self._lock:
+            m = self._map
+            for i, (l, h) in enumerate(zip(lo.tolist(), hi.tolist())):
+                cur = m.get(l)
+                if cur is None:
+                    if not self.frozen:
+                        m[l] = h
+                        if len(m) >= self._cap:
+                            self.frozen = True
+                    else:
+                        miss[i] = 1
+                elif cur != h:
+                    return i
+            return -1
 
     def stats(self):
         return len(self._map), int(self.frozen)

@@ -301,6 +301,32 @@ class Exchange(Node):
                     ix = np.flatnonzero(shards == w)
                     if len(ix):
                         buckets[w] = d.take(ix)
+        plane = getattr(ctx, "async_plane", None)
+        if plane is not None:
+            # frontier-driven mode: post peer buckets fire-and-forget and
+            # merge whatever peers already delivered for this channel —
+            # no rendezvous, no waiting on the slowest worker. Delivery is
+            # eager (timely's model: data moves asynchronously, only
+            # notifications/commits follow the frontier); accumulation
+            # commutes, so out-of-order cross-worker merge is lawful.
+            own = buckets[ctx.worker_id]
+            sent_rows = sum(
+                len(b) for i, b in enumerate(buckets)
+                if b is not None and i != ctx.worker_id
+            )
+            plane.post(self.channel, time, buckets)
+            received, _ingest = plane.take(self.channel)
+            if own is not None and len(own):
+                received.append(own)
+            stats = getattr(self, "_engine_stats", None)
+            if stats is not None:
+                stats.note_exchange(
+                    sent_rows + (len(own) if own is not None else 0),
+                    sum(len(r) for r in received),
+                )
+            if not received:
+                return None
+            return concat_deltas(received, self.column_names)
         if hasattr(ctx.comm, "exchange_deltas"):
             # ICI path (MeshComm): dense columns ride the device mesh via
             # bucketed_all_to_all; object columns fall back to host frames

@@ -448,6 +448,16 @@ class DeliverySink:
         self._backend = backend
         #: True when delivery is gated to persistence commit boundaries
         self.transactional = transactional
+        #: frontier-driven (async) execution: the durable ack cursor only
+        #: advances at commit boundaries (``drain(bump_to=T)``), never per
+        #: batch. Async sweep labels are not reproducible across a
+        #: crash-replay (replay runs at recorded input times, live runs at
+        #: per-worker mint times), so a mid-window cursor would be a dedup
+        #: frontier in a coordinate system the replay does not share —
+        #: boundary-only acks keep the cursor on the commit times both
+        #: runs agree on, and the resume token rolls the external system
+        #: back to that boundary before the window redelivers.
+        self.boundary_acks = False
         self.dlq = dlq or DeadLetterQueue()
         self.stats = stats or _stats_for(name)
         self._queue_bound = queue_batches or _env_i(
@@ -923,15 +933,21 @@ class DeliverySink:
         the persistence backend BEFORE anything else can commit offsets
         past it. A SIGKILL after this point cannot double-deliver — the
         cursor survives and replay skips the batch."""
-        self.acked_time = max(self.acked_time, batch.time)
         if token is not None:
             self._resume_token = token
-        self.stats.acked_time = self.acked_time
         self.stats.delivered_total += 1
         self.stats.delivered_rows_total += len(batch)
         self.stats.delivery_lag_seconds = max(
             0.0, _time.monotonic() - batch.enqueued_at
         )
+        if self.boundary_acks:
+            # async mode: the durable cursor + resume token persist only
+            # at the commit-boundary bump (drain(bump_to=T)); a crash
+            # mid-window rolls the external system back to the boundary
+            # token and the whole window redelivers after replay
+            return
+        self.acked_time = max(self.acked_time, batch.time)
+        self.stats.acked_time = self.acked_time
         self._write_cursor(token)
 
 
@@ -957,6 +973,13 @@ class DeliveryManager:
 
     def add(self, sink: DeliverySink) -> None:
         self.sinks.append(sink)
+
+    def use_boundary_acks(self) -> None:
+        """Frontier-driven executor: persist ack cursors only at commit
+        boundaries (see DeliverySink.boundary_acks). Called once when the
+        async streaming loop takes over, before any release."""
+        for s in self.sinks:
+            s.boundary_acks = True
 
     def has_sinks(self) -> bool:
         return bool(self.sinks)
@@ -995,6 +1018,21 @@ class DeliveryManager:
             if not s.transactional:
                 continue
             s.release_all()
+            if s.boundary_acks:
+                # async mode defers cursor writes to boundary bumps — the
+                # final drain must bump past the END_TIME flush batches,
+                # or a kill after a CLEAN finish would re-deliver the
+                # regenerated END batch on the supervised restart
+                from ..engine.executor import END_TIME
+
+                if not s.drain(timeout=timeout, bump_to=END_TIME):
+                    raise RuntimeError(
+                        f"sink {s.name!r} failed to drain within "
+                        f"PATHWAY_SINK_DRAIN_TIMEOUT_S={timeout}s at end "
+                        f"of run ({len(s._queue)} batch(es) still queued)"
+                    )
+                s.shutdown()
+                continue
             if not s.drain(timeout=timeout):
                 raise RuntimeError(
                     f"sink {s.name!r} failed to drain within "

@@ -32,7 +32,7 @@ _DONE = object()
 
 
 class _Batch:
-    __slots__ = ("data", "diffs", "ingest_ns")
+    __slots__ = ("data", "diffs", "ingest_ns", "keys", "key_names")
 
     def __init__(self, data: dict[str, Any], diffs: Any):
         self.data = data
@@ -41,6 +41,24 @@ class _Batch:
         #: to the engine — the ingest→emit latency anchor
         #: (observability signals plane, EngineStats.e2e_latency_hist)
         self.ingest_ns = _time.time_ns()
+        #: set by the source's pre-builder on the SUBJECT thread (fused
+        #: key derivation): schema-ordered normalized columns land in
+        #: ``data`` and the vectorized row keys here, so the engine
+        #: thread's poll skips the whole delta-build + string-hash pass
+        #: — the post-fusion wordcount bottleneck (PR 14 headroom note)
+        self.keys: Any = None
+        self.key_names: tuple | None = None
+
+
+#: process-wide ingest-build accounting (read by bench.py's ingest-split
+#: extra block): ns spent building batch deltas on subject (producer)
+#: threads vs on the engine thread, and the rows covered by each
+INGEST_BUILD_STATS = {
+    "subject_ns": 0,
+    "subject_rows": 0,
+    "engine_ns": 0,
+    "engine_rows": 0,
+}
 
 
 class _SourceError:
@@ -143,7 +161,16 @@ class ConnectorSubject:
         elif isinstance(diffs, list):
             diffs = list(diffs)
         self._flush_rows()  # arrival order: buffered rows precede the batch
-        self._queue.put(_Batch(data, diffs))
+        batch = _Batch(data, diffs)
+        builder = getattr(self, "_batch_builder", None)
+        if builder is not None:
+            # fused key derivation: normalize columns + hash row keys HERE,
+            # on the producer thread, overlapping with engine compute —
+            # the engine-side poll then just slices and wraps. A build
+            # error surfaces exactly like any other subject failure
+            # (_SourceError via ConnectorSubject.start's catch).
+            builder(batch)
+        self._queue.put(batch)
 
     def next_json(self, message: dict | str) -> None:
         if isinstance(message, str):
@@ -279,9 +306,40 @@ class PythonSubjectSource(RealtimeSource):
         self._emitted = 0  # rows delivered to the engine (offset state)
         self._skip = 0  # rows to drop after a recovery seek
 
+    #: set False by the executor for stateless dataflows (suspended key
+    #: registration is thread-local to the executor thread, so the
+    #: subject-thread builder must be told explicitly)
+    _keys_register = True
+
     def start(self) -> None:
+        # install the fused batch builder BEFORE the reader thread exists:
+        # every next_batch() then normalizes columns and hashes keys on
+        # the producer thread (io/python module docstring: the reference's
+        # connector-thread model — here the thread also pays the
+        # delta-build so the engine loop does not)
+        self.subject._batch_builder = self._prebuild_batch
         self._thread = threading.Thread(target=self.subject.start, daemon=True)
         self._thread.start()
+
+    def _prebuild_batch(self, batch: _Batch) -> None:
+        """Producer-thread half of the batch path: columns → schema-ordered
+        normalized arrays + vectorized row keys (pure per-row work; the
+        engine-side poll keeps the skip/offset bookkeeping). Bit-identical
+        to the engine-side build — ``K.mix_columns`` over the same
+        normalized columns."""
+        t0 = _time.perf_counter_ns()
+        data, n = self._batch_columns(batch)
+        if self.pk_indices is not None:
+            key_names = tuple(self.names[i] for i in self.pk_indices)
+        else:
+            key_names = tuple(self.names)
+        batch.data = data
+        batch.keys = K.mix_columns(
+            [data[c] for c in key_names], n, register=self._keys_register
+        )
+        batch.key_names = key_names
+        INGEST_BUILD_STATS["subject_ns"] += _time.perf_counter_ns() - t0
+        INGEST_BUILD_STATS["subject_rows"] += n
 
     def attach_waker(self, event) -> None:
         self.waker = event
@@ -403,11 +461,11 @@ class PythonSubjectSource(RealtimeSource):
             return column_of_values(list(out))
         return arr
 
-    def _make_batch_delta(self, batch: _Batch) -> Delta | None:
-        """Columnar batch → Delta with vectorized key hashing.
-        ``K.mix_columns`` over columns is bit-identical to ``hash_values``
-        over the corresponding row tuples (same per-scalar digests), so
-        row-wise and batch emission produce the same keys."""
+    def _batch_columns(
+        self, batch: _Batch
+    ) -> tuple[dict[str, np.ndarray], int]:
+        """Pure half of the batch build: raw snapshot columns →
+        schema-ordered, declared-dtype-normalized arrays + row count."""
         from ..engine.delta import column_of_values
 
         data: dict[str, np.ndarray] = {}
@@ -436,7 +494,33 @@ class PythonSubjectSource(RealtimeSource):
                 data[name] = column_of_values([fill] * n)
         # schema order + declared-dtype normalization (same key-stability
         # contract as the row path: keys must not depend on the batch)
-        data = {name: self._normalize(name, data[name]) for name in self.names}
+        return (
+            {name: self._normalize(name, data[name]) for name in self.names},
+            n,
+        )
+
+    def _make_batch_delta(self, batch: _Batch) -> Delta | None:
+        """Columnar batch → Delta with vectorized key hashing.
+        ``K.mix_columns`` over columns is bit-identical to ``hash_values``
+        over the corresponding row tuples (same per-scalar digests), so
+        row-wise and batch emission produce the same keys. The normalize +
+        hash pass normally already ran on the SUBJECT thread
+        (_prebuild_batch, fused key derivation); this engine-side path
+        keeps only the skip/offset bookkeeping then — the fallback build
+        covers batches enqueued before the source started."""
+        if batch.keys is not None:
+            data, n, keys = batch.data, len(batch.keys), batch.keys
+            key_names = batch.key_names
+        else:
+            t0 = _time.perf_counter_ns()
+            data, n = self._batch_columns(batch)
+            if self.pk_indices is not None:
+                key_names = tuple(self.names[i] for i in self.pk_indices)
+            else:
+                key_names = tuple(self.names)
+            keys = K.mix_columns([data[c] for c in key_names], n)
+            INGEST_BUILD_STATS["engine_ns"] += _time.perf_counter_ns() - t0
+            INGEST_BUILD_STATS["engine_rows"] += n
         # recovery seek already counted skipped rows into _emitted
         if self._skip >= n:
             self._skip -= n
@@ -446,13 +530,9 @@ class PythonSubjectSource(RealtimeSource):
             start = self._skip
             self._skip = 0
             data = {c: a[start:] for c, a in data.items()}
+            keys = keys[start:]
             n -= start
         self._emitted += n
-        if self.pk_indices is not None:
-            key_names = [self.names[i] for i in self.pk_indices]
-        else:
-            key_names = list(self.names)
-        keys = K.mix_columns([data[c] for c in key_names], n)
         diffs = (
             np.ones(n, dtype=np.int64)
             if batch.diffs is None
@@ -468,9 +548,13 @@ class PythonSubjectSource(RealtimeSource):
 
     def _flush_partial(self) -> None:
         if self._partial:
+            t0 = _time.perf_counter_ns()
+            n = len(self._partial)
             self._pending.append(
                 self._make_delta(self._partial, self._partial_plain)
             )
+            INGEST_BUILD_STATS["engine_ns"] += _time.perf_counter_ns() - t0
+            INGEST_BUILD_STATS["engine_rows"] += n
             self._partial = []
             self._partial_plain = True
 

@@ -12,13 +12,17 @@ burning idle headroom — is named ``pathway_bottleneck_operator`` on
 Rows/s per operator rides along so the view distinguishes "slow because
 it does all the work" from "slow per row".
 
-Exchange nodes are excluded from the ranking: their per-node time is
-dominated by *blocked-in-collective wait* for the slowest peer — the
-symptom of another operator's slowness, not a cause (every BSP worker
-shows huge Exchange time whenever ANY worker is slow). Their aggregate
-rides along as ``exchange_wait_ms`` so a genuinely comm-bound pipeline
-is still visible: large exchange wait with NO dominant compute operator
-points at the wire, not the DAG.
+Exchange nodes rank like any other operator. Under frontier-driven
+asynchronous execution (the default for sharded streaming —
+``PATHWAY_ASYNC_EXEC``, engine/executor.py) their per-node time is
+genuine work: bucketing, posting, and merging arrivals, with no
+blocked-in-collective component — so it belongs in the ranking. Their
+aggregate still rides along as ``exchange_wait_ms`` so a comm-bound
+pipeline is visible at a glance. (Before async execution this module
+EXCLUDED Exchange nodes: under the BSP tick barrier their time measured
+waiting for the slowest peer — the symptom of another operator's
+slowness, not a cause. ``PATHWAY_ASYNC_EXEC=0`` runs re-inherit that
+caveat: read large Exchange shares there as barrier wait.)
 """
 
 from __future__ import annotations
@@ -81,9 +85,9 @@ def attribution_document(
             backlogged.append(worker)
         for entry in _worker_attribution(signals, worker, window_s):
             if entry["operator"].startswith("Exchange#"):
-                # collective wait, not compute — see module docstring
+                # ranked AND aggregated: async execution made this real
+                # per-operator work (see module docstring)
                 exchange_wait_ms += entry["busy_ms"]
-                continue
             doc = per_op.setdefault(
                 entry["operator"],
                 {

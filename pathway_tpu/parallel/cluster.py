@@ -283,6 +283,18 @@ class ClusterComm(Comm):
         #: ("g", tag) -> {src: payload}
         self._inbox: dict[Any, dict[int, Any]] = {}
         self._gather_reads: dict[Any, int] = {}
+        #: async (frontier-driven) plane: per-LOCAL-worker event inboxes.
+        #: Remote arrivals ride the same columnar frames as BSP exchange
+        #: (meta channel tagged ("a", ...)) and are filed here by the
+        #: reader threads instead of the rendezvous inbox.
+        self._async_q: dict[int, collections.deque] = {
+            w: collections.deque() for w in self._local_workers
+        }
+        self._async_data: dict[int, int] = {w: 0 for w in self._local_workers}
+        self._async_wakers: dict[int, Any] = {}
+        from .comm import async_queue_bound
+
+        self._async_bound = async_queue_bound()
         self._broken: str | None = None
         self._socks: dict[int, socket.socket] = {}
         self._writers: dict[int, _PeerWriter] = {}
@@ -483,17 +495,47 @@ class ClusterComm(Comm):
         trace context (run_id, flow_id) when the sender shipped one."""
         kind = frame[0]
         ctx = None
+        wake: list[int] = []
         with self._cond:
             if kind == "x":
                 _, channel, tick, src, per_dst = frame[:5]
                 ctx = frame[5] if len(frame) > 5 else None
-                for dst, payload in per_dst.items():
-                    self._inbox.setdefault(("x", channel, tick, dst), {})[src] = payload
+                if isinstance(channel, tuple) and channel and channel[0] == "a":
+                    # async data plane: file per-worker events, never the
+                    # rendezvous inbox (nothing is waiting collectively).
+                    # The reader thread NEVER blocks on the inbox bound —
+                    # remote backpressure is the peer-status depth the
+                    # executor consults before polling sources.
+                    _a, real_channel, ingest_ns, seq = channel
+                    for dst, payload in per_dst.items():
+                        q = self._async_q.get(dst)
+                        if q is None:
+                            continue  # stale frame for a non-local worker
+                        q.append(
+                            ("x", real_channel, tick, src, payload,
+                             ingest_ns, seq)
+                        )
+                        self._async_data[dst] += 1
+                        wake.append(dst)
+                else:
+                    for dst, payload in per_dst.items():
+                        self._inbox.setdefault(("x", channel, tick, dst), {})[src] = payload
+            elif kind == "ac":
+                # async control broadcast: fan out to every local worker
+                _, src, payload = frame[:3]
+                ctx = frame[3] if len(frame) > 3 else None
+                for dst, q in self._async_q.items():
+                    q.append(("c", src, payload))
+                    wake.append(dst)
             else:
                 _, tag, src, obj = frame[:4]
                 ctx = frame[4] if len(frame) > 4 else None
                 self._inbox.setdefault(("g", tag), {})[src] = obj
             self._cond.notify_all()
+        for dst in wake:
+            waker = self._async_wakers.get(dst)
+            if waker is not None:
+                waker.set()
         return ctx
 
     # -- clock-offset estimation (mesh establishment) --------------------
@@ -553,17 +595,20 @@ class ClusterComm(Comm):
                    chaos=False)
 
     def _post(self, peer: int, chunks: list, nbytes: int,
-              chaos: bool = True) -> None:
+              chaos: bool = True) -> bool:
         """Enqueue one framed message (length prefix included in
         ``chunks``) onto ``peer``'s writer pipeline. All chaos comm.send
         actions fire here — on the new pipelined path, before the frame
-        reaches the queue."""
+        reaches the queue. Returns False when the frame was chaos-lost
+        (drop/sever) — the async data plane's quiesce ledger needs to
+        know (a counted-sent-but-never-delivered event would unbalance
+        the sent/received totals forever)."""
         if chaos and self._chaos is not None:
             op = self._chaos.op_for(peer)
             if op is not None:
                 action, delay_s = op
                 if action == "drop":
-                    return
+                    return False
                 if action == "delay":
                     time.sleep(delay_s)
                 elif action == "sever":
@@ -576,12 +621,13 @@ class ClusterComm(Comm):
                     except OSError:
                         pass
                     self._socks[peer].close()
-                    return
+                    return False
                 elif action == "duplicate":
                     self._enqueue(peer, list(chunks), nbytes)
                 elif action == "corrupt":
                     chunks = _corrupt_chunks(chunks)
         self._enqueue(peer, chunks, nbytes)
+        return True
 
     def _enqueue(self, peer: int, chunks: list, nbytes: int) -> None:
         writer = self._writers.get(peer)
@@ -686,6 +732,109 @@ class ClusterComm(Comm):
             self._barrier_seqs[worker_id] = seq + 1
         self.allgather(("b", seq), worker_id, None)
 
+    # -- async plane (frontier-driven execution) ------------------------
+
+    def supports_async(self) -> bool:
+        return True
+
+    def async_attach(self, worker_id: int, waker: Any) -> None:
+        self._async_wakers[worker_id] = waker
+
+    def _async_deliver_local(self, dest: int, event: tuple,
+                             is_data: bool) -> None:
+        # never blocks — backpressure is async_congested (see Comm)
+        with self._cond:
+            if self._broken is not None:
+                raise RuntimeError(self._broken)
+            self._async_q[dest].append(event)
+            if is_data:
+                self._async_data[dest] += 1
+            self._cond.notify_all()
+        waker = self._async_wakers.get(dest)
+        if waker is not None:
+            waker.set()
+
+    def async_congested(self, worker_id: int) -> bool:
+        # local thread-peers at the inbox bound, or an outbound pipeline
+        # to a slow peer process at the writer-queue bound — both mean
+        # "stop ingesting, let the backlog drain"
+        if any(
+            n >= self._async_bound
+            for w, n in self._async_data.items()
+            if w != worker_id
+        ):
+            return True
+        return any(
+            w.queue_depth() >= self._queue_frames
+            for w in self._writers.values()
+        )
+
+    def async_post_exchange(self, worker_id, channel, time, buckets,
+                            ingest_ns=None, seq=None):
+        import time as time_mod  # the logical-time param shadows the module
+
+        delivered = 0
+        per_process: dict[int, dict[int, Any]] = {}
+        for dst, payload in enumerate(buckets):
+            if payload is None or dst == worker_id:
+                continue
+            p = self._process_of(dst)
+            if p == self.process_id:
+                self._async_deliver_local(
+                    dst,
+                    ("x", channel, time, worker_id, payload, ingest_ns, seq),
+                    is_data=True,
+                )
+                delivered += 1
+            else:
+                per_process.setdefault(p, {})[dst] = payload
+        tracer = self._tracer
+        for p, per_dst in per_process.items():
+            ctx = self._frame_ctx(p, channel=channel, tick=time)
+            t0 = time_mod.perf_counter_ns()
+            # the async marker rides the frame metadata: same columnar
+            # codec, same chaos gate (_post), different delivery side
+            chunks, body_len = frames.encode_frame(
+                ("a", channel, ingest_ns, seq), int(time), worker_id,
+                per_dst, ctx,
+            )
+            with self._encode_lock:
+                self.encode_ns += time_mod.perf_counter_ns() - t0
+            if tracer is not None:
+                tracer.complete(
+                    "comm.encode", t0,
+                    {"peer_process": p, "bytes": body_len, "channel": channel},
+                )
+            if self._post(p, [_LEN.pack(body_len)] + chunks, 8 + body_len):
+                delivered += len(per_dst)
+        return delivered
+
+    def async_broadcast(self, worker_id, payload):
+        for dst in self._local_workers:
+            if dst != worker_id:
+                self._async_deliver_local(
+                    dst, ("c", worker_id, payload), is_data=False
+                )
+        for p in range(self.n_processes):
+            if p != self.process_id:
+                # rides the same chaos-gated _send as the BSP control
+                # plane, so comm.send faults stay honest under async
+                self._send(p, ("ac", worker_id, payload, None))
+
+    def async_drain(self, worker_id: int) -> list:
+        with self._cond:
+            if self._broken is not None:
+                raise RuntimeError(
+                    f"process {self.process_id}: a peer worker failed: "
+                    f"{self._broken}"
+                )
+            q = self._async_q[worker_id]
+            out = list(q)
+            q.clear()
+            self._async_data[worker_id] = 0
+            self._cond.notify_all()
+        return out
+
     def _wait(self, key: Any, n: int) -> dict[int, Any]:
         deadline = time.monotonic() + self.collective_timeout_s
         with self._cond:
@@ -753,6 +902,15 @@ class ClusterComm(Comm):
             "encode_seconds_total": self.encode_ns / 1e9,
             "cluster_inbox_depth": float(len(self._inbox)),
             "cluster_broken": float(self._broken is not None),
+            # frontier-driven plane: events delivered but not yet drained
+            # by a local worker — the per-operator input-queue
+            # backpressure signal of async execution
+            "async_inbox_depth": float(
+                sum(len(q) for q in self._async_q.values())
+            ),
+            "async_inbox_capacity": float(
+                self._async_bound * max(1, len(self._async_q))
+            ),
         }
 
     def _break(self, reason: str) -> None:
@@ -765,6 +923,10 @@ class ClusterComm(Comm):
                 self._broken = reason
                 first = True
             self._cond.notify_all()
+        # async-plane parks wait on wake events, not the condition — set
+        # them all so a frontier-driven loop sees the break immediately
+        for waker in self._async_wakers.values():
+            waker.set()
         if first:
             # black-box evidence: the crash bundle of a worker that died
             # *because a peer died* should name the peer, not look idle

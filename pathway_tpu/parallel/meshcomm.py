@@ -112,6 +112,30 @@ class MeshComm(Comm):
     def barrier(self, worker_id: int):
         self.inner.barrier(worker_id)
 
+    # async (frontier-driven) plane: host-path delegation — the ICI
+    # collective is inherently bulk-synchronous, so PATHWAY_ASYNC_EXEC=1
+    # with mesh exchange routes record exchange over the host plane
+    def supports_async(self) -> bool:
+        return self.inner.supports_async()
+
+    def async_attach(self, worker_id, waker):
+        self.inner.async_attach(worker_id, waker)
+
+    def async_post_exchange(self, worker_id, channel, time, buckets,
+                            ingest_ns=None, seq=None):
+        return self.inner.async_post_exchange(
+            worker_id, channel, time, buckets, ingest_ns, seq
+        )
+
+    def async_broadcast(self, worker_id, payload):
+        self.inner.async_broadcast(worker_id, payload)
+
+    def async_drain(self, worker_id):
+        return self.inner.async_drain(worker_id)
+
+    def async_congested(self, worker_id):
+        return self.inner.async_congested(worker_id)
+
     def abort(self):
         self.inner.abort()
 
@@ -337,6 +361,28 @@ class MultiHostMeshComm(Comm):
 
     def barrier(self, worker_id: int):
         self.inner.barrier(worker_id)
+
+    # async (frontier-driven) plane delegation — see MeshComm note
+    def supports_async(self) -> bool:
+        return self.inner.supports_async()
+
+    def async_attach(self, worker_id, waker):
+        self.inner.async_attach(worker_id, waker)
+
+    def async_post_exchange(self, worker_id, channel, time, buckets,
+                            ingest_ns=None, seq=None):
+        return self.inner.async_post_exchange(
+            worker_id, channel, time, buckets, ingest_ns, seq
+        )
+
+    def async_broadcast(self, worker_id, payload):
+        self.inner.async_broadcast(worker_id, payload)
+
+    def async_drain(self, worker_id):
+        return self.inner.async_drain(worker_id)
+
+    def async_congested(self, worker_id):
+        return self.inner.async_congested(worker_id)
 
     def abort(self):
         self._local_barrier.abort()

@@ -1,0 +1,177 @@
+"""Frontier tracker + quiesce votes as pure components
+(``engine/frontier.py``) — the progress protocol of the asynchronous
+executor, tested without threads, comm, or an engine:
+
+- monotonic local advance (regression raises, equal re-advance no-ops);
+- broadcast merge across workers (max-merge, stale broadcasts ignored);
+- stall detection when a peer stops advancing while others progress;
+- the frontier-derived commit boundary equals the old tick-derived
+  boundary on a synchronous (lock-step) schedule;
+- quiesce: two clean rounds with balanced, stable totals — and the
+  single-round forgery (in-flight message masked by a recv counted
+  before its send) is correctly rejected.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from pathway_tpu.engine.frontier import FrontierTracker, QuiesceVotes
+
+
+# -- FrontierTracker ---------------------------------------------------------
+
+
+def test_local_advance_is_monotone():
+    ft = FrontierTracker(2, 0)
+    assert ft.local() == -1
+    ft.advance_local(10)
+    ft.advance_local(10)  # equal re-advance: lawful no-op
+    assert ft.local() == 10
+    with pytest.raises(ValueError):
+        ft.advance_local(8)
+
+
+def test_broadcast_merge_across_workers():
+    ft = FrontierTracker(3, 0)
+    ft.advance_local(100)
+    assert ft.observe(1, 50)
+    assert ft.observe(2, 80)
+    assert ft.frontiers() == [100, 50, 80]
+    assert ft.global_frontier() == 50
+    # stale/duplicate broadcasts (status rebroadcasts) are ignored
+    assert not ft.observe(1, 50)
+    assert not ft.observe(1, 40)
+    assert ft.frontiers()[1] == 50
+    assert ft.observe(1, 120)
+    assert ft.global_frontier() == 80
+
+
+def test_global_frontier_requires_every_worker():
+    ft = FrontierTracker(2, 0)
+    ft.advance_local(1000)
+    # peer never broadcast: nothing can be considered complete
+    assert ft.global_frontier() == -1
+    assert ft.commit_boundary() == -1
+
+
+def test_stall_detection_when_peer_stops_advancing():
+    ft = FrontierTracker(2, 0)
+    ft.advance_local(100, now=0.0)
+    ft.observe(1, 100, now=0.0)
+    # both idle: parked, not stalled
+    assert ft.stalled(now=100.0, timeout_s=30.0) == []
+    # worker 0 keeps advancing, worker 1 goes quiet and falls behind
+    ft.advance_local(500, now=95.0)
+    assert ft.stalled(now=100.0, timeout_s=30.0) == [1]
+    # worker 1 resumes: no longer stalled
+    ft.observe(1, 600, now=99.0)
+    assert ft.stalled(now=100.0, timeout_s=30.0) == []
+
+
+def test_commit_boundary_matches_tick_boundary_on_synchronous_schedule():
+    """On a lock-step schedule (every worker sweeps the same even tick
+    sequence, as the BSP loop does) the frontier-derived commit boundary
+    is exactly the tick-derived one: the last tick completed
+    everywhere."""
+    n = 3
+    trackers = [FrontierTracker(n, w) for w in range(n)]
+    ticks = [1000, 1002, 1004, 1006]
+    for t in ticks:
+        for w, ft in enumerate(trackers):
+            ft.advance_local(t) if w == ft.worker_id else None
+        # broadcast wave after the tick completes on every worker
+        for w, ft in enumerate(trackers):
+            for peer, pft in enumerate(trackers):
+                if peer != w:
+                    ft.observe(peer, trackers[peer].local())
+        for ft in trackers:
+            assert ft.global_frontier() == t
+            assert ft.commit_boundary() == t  # == the agreed BSP tick
+    # a straggler mid-tick drags the boundary back to the last COMPLETE one
+    trackers[0].advance_local(1008)
+    trackers[1].observe(0, 1008)
+    assert trackers[1].commit_boundary() == 1006
+
+
+def test_commit_boundary_rounds_to_even():
+    ft = FrontierTracker(1, 0)
+    ft.advance_local(1001)  # idle promise between even mints
+    assert ft.commit_boundary() == 1000
+
+
+# -- QuiesceVotes ------------------------------------------------------------
+
+
+def _exchange_all(voters, payloads):
+    for w, p in payloads.items():
+        for v, qv in enumerate(voters):
+            if v != w:
+                qv.observe(w, p)
+
+
+def test_quiesce_two_clean_rounds():
+    voters = [QuiesceVotes(2, w, "term") for w in range(2)]
+    # round 0: balanced and inactive... but ONE clean round is not enough
+    p = {w: voters[w].cast(3, 3, False) for w in range(2)}
+    _exchange_all(voters, p)
+    assert not any(v.step() for v in voters)
+    # round 1: still clean with the same totals -> quiesced, everywhere
+    p = {w: voters[w].cast(3, 3, False) for w in range(2)}
+    _exchange_all(voters, p)
+    assert all(v.step() for v in voters)
+
+
+def test_quiesce_rejects_single_round_forgery():
+    """The classic asymmetry: totals balance at round k while a message
+    is in flight (a recv counted whose send was cast after the sender's
+    vote). The second round exposes it as activity / changed totals."""
+    voters = [QuiesceVotes(2, w, "term") for w in range(2)]
+    # round 0: balanced (worker 0 sent 2/recv 1, worker 1 sent 1/recv 2)
+    # but a 3rd message is in flight from w0, sent AFTER w0's vote
+    p = {0: voters[0].cast(2, 1, False), 1: voters[1].cast(1, 2, False)}
+    _exchange_all(voters, p)
+    assert not any(v.step() for v in voters)
+    # round 1: w1 processed the in-flight message -> active + totals moved
+    p = {0: voters[0].cast(3, 1, False), 1: voters[1].cast(1, 3, True)}
+    _exchange_all(voters, p)
+    assert not any(v.step() for v in voters)
+    # rounds 2+3: genuinely drained now
+    for _ in range(2):
+        p = {0: voters[0].cast(3, 1, False), 1: voters[1].cast(1, 3, False)}
+        _exchange_all(voters, p)
+        done = [v.step() for v in voters]
+    assert all(done)
+
+
+def test_quiesce_unbalanced_totals_never_complete():
+    voters = [QuiesceVotes(2, w, "term") for w in range(2)]
+    for _ in range(4):
+        p = {w: voters[w].cast(5, 4, False) for w in range(2)}
+        _exchange_all(voters, p)
+        assert not any(v.step() for v in voters)
+
+
+def test_quiesce_rounds_stay_aligned_across_skew():
+    """A worker that starts voting late catches up through the kept
+    per-round votes — rounds advance in lock-step, max skew one."""
+    a, b = QuiesceVotes(2, 0, "term"), QuiesceVotes(2, 1, "term")
+    pa = a.cast(1, 1, False)
+    assert not a.step()  # b has not voted: round 0 incomplete for a
+    assert a.round == 0
+    # b arrives late, receives a's round-0 vote, casts, both advance
+    b.observe(0, pa)
+    pb = b.cast(1, 1, False)
+    a.observe(1, pb)
+    assert not a.step() and not b.step()
+    assert a.round == b.round == 1
+    pa, pb = a.cast(1, 1, False), b.cast(1, 1, False)
+    a.observe(1, pb)
+    b.observe(0, pa)
+    assert a.step() and b.step()
+
+
+def test_quiesce_phase_isolation():
+    term = QuiesceVotes(2, 0, "term")
+    term.observe(1, ("cw3", 0, 5, 5, False))  # a commit wave's vote
+    assert 1 not in term._votes.get(0, {})

@@ -248,10 +248,12 @@ def test_slo_rule_over_missing_metric_is_inert():
 
 def _attribution_store():
     store = TimeSeriesStore(capacity=16)
-    # worker 0: SlowOp burns 9x the time of FastOp over the window
+    # worker 0: SlowOp burns 9x the time of FastOp over the window; an
+    # Exchange node does real (async-mode) routing work in between
     for i, t in enumerate((T0, T0 + 1, T0 + 2)):
         store.record("op_time_ns:SlowOp#1", 9e9 * i, 0, t)
         store.record("op_time_ns:FastOp#2", 1e9 * i, 0, t)
+        store.record("op_time_ns:Exchange#3", 0.5e9 * i, 0, t)
         store.record("op_rows:SlowOp#1", 100.0 * i, 0, t)
         store.record("op_rows:FastOp#2", 1000.0 * i, 0, t)
         store.record("frontier_lag_ms", 100.0 * i, 0, t)  # growing lag
@@ -262,9 +264,15 @@ def test_attribution_ranks_by_windowed_time_share():
     doc = attribution_document(Signals(_attribution_store()), 10.0)
     assert doc["bottleneck"] == "SlowOp#1"
     ranked = doc["ranked"]
-    assert [d["operator"] for d in ranked] == ["SlowOp#1", "FastOp#2"]
-    assert ranked[0]["share"] == pytest.approx(0.9, abs=0.01)
-    assert ranked[1]["share"] == pytest.approx(0.1, abs=0.01)
+    # Exchange nodes RANK like any operator (PR 15: under async
+    # execution their time is genuine routing/merge work, not barrier
+    # wait) — and still aggregate into exchange_wait_ms below
+    assert [d["operator"] for d in ranked] == [
+        "SlowOp#1", "FastOp#2", "Exchange#3"
+    ]
+    assert ranked[0]["share"] == pytest.approx(9 / 10.5, abs=0.01)
+    assert ranked[1]["share"] == pytest.approx(1 / 10.5, abs=0.01)
+    assert doc["exchange_wait_ms"] == pytest.approx(1000.0, rel=0.01)
     assert doc["backlogged_workers"] == [0]
     assert ranked[0]["rows_per_sec"] == pytest.approx(100.0, rel=0.05)
 
