@@ -235,6 +235,7 @@ def main() -> None:
     # lanes; see the skew lane note)
     t2_ab = _uniform_t2_ab()
     skew = _skew_lane()
+    lineage = _lineage_lane()
     from pathway_tpu.io.python import INGEST_BUILD_STATS as _IBS
 
     ingest_build = {
@@ -327,6 +328,12 @@ def main() -> None:
                 skew["rows_per_sec"] if skew else None
             ),
             "sharded_skew": skew,
+            # latency lineage (observability/critpath.py + keyload.py):
+            # commit-wave duration percentiles off the engine's own
+            # LogHistogram under persistence, and the key-load sketch's
+            # accounting tax as a fresh-process PATHWAY_KEYLOAD on/off
+            # rows/s A/B (budget <= 3%)
+            "latency_lineage": lineage,
             "ingest_build": ingest_build,
             "host_cores": n_cores,
             "sharded_note": (
@@ -1222,6 +1229,141 @@ def _skew_lane(reps: int = 3) -> dict | None:
         "total_s": round(best_async["total_s"], 3),
         "reps": [round(d["rows_per_sec"], 1) for d in async_reps],
         "reps_bsp": [round(d["rows_per_sec"], 1) for d in bsp_reps],
+    }
+
+
+_LINEAGE_PROG = """
+import json, os, sys, tempfile, threading, time
+sys.path.insert(0, {repo!r})
+from pathway_tpu.utils.jaxcfg import guard_cpu_platform
+guard_cpu_platform()
+import pathway_tpu as pw
+from pathway_tpu.persistence import Backend, Config
+
+N_ROWS, BATCH = {n_rows}, 10_000
+words = [f"w{{i % 997}}" for i in range(N_ROWS)]
+
+
+class Feed(pw.io.python.ConnectorSubject):
+    def run(self):
+        for s in range(0, N_ROWS, BATCH):
+            self.next_batch({{"word": words[s:s + BATCH]}})
+            self.commit()
+            time.sleep(0.02)  # stretch the run across snapshot intervals
+
+
+t = pw.io.python.read(
+    Feed(), schema=pw.schema_from_types(word=str), name="words",
+    autocommit_duration_ms=None,
+)
+counts = t.groupby(pw.this.word).reduce(
+    pw.this.word, c=pw.reducers.count()
+)
+pw.io.subscribe(counts, on_batch=lambda t_, b: None)
+
+# the runner reference is cleared when pw.run returns - grab it mid-run
+holder = {{}}
+
+
+def grab():
+    from pathway_tpu.internals.run import _current
+
+    while "r" not in holder:
+        r = _current["runner"]
+        if r is not None and getattr(r, "_peer_executors", None):
+            holder["r"] = r
+            return
+        time.sleep(0.01)
+
+
+threading.Thread(target=grab, daemon=True).start()
+pstate = tempfile.mkdtemp(prefix="lineage_bench_")
+cfg = Config.simple_config(
+    Backend.filesystem(os.path.join(pstate, "pstate")),
+    snapshot_interval_ms=100,
+)
+t0 = time.perf_counter()
+pw.run(persistence_config=cfg)
+elapsed = max(time.perf_counter() - t0, 1e-9)
+stats = holder["r"]._peer_executors[0].stats
+pct = stats.wave_duration.percentiles()
+print(json.dumps({{
+    "rows_per_sec": N_ROWS / elapsed,
+    "waves": stats.waves_total,
+    "wave_p50_ms": pct["p50"] / 1e6,
+    "wave_p95_ms": pct["p95"] / 1e6,
+}}))
+"""
+
+
+def _lineage_lane(reps: int = 2) -> dict | None:
+    """``latency_lineage``: commit-wave duration percentiles plus the
+    key-load accounting overhead, from a PERSISTED 2-worker wordcount
+    (commit waves only exist under persistence). Two fresh-process arms
+    differing only in ``PATHWAY_KEYLOAD``: the on-arm reports
+    wave_p50/p95_ms off the engine's own LogHistogram, and the rows/s
+    ratio of the arms is the sketch's accounting tax on the uniform
+    sharded lane (budget: <= 3%, well inside this lane's noise floor)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    prog = _LINEAGE_PROG.format(repo=repo, n_rows=100_000)
+
+    def arm(keyload: str) -> dict | None:
+        best: dict | None = None
+        for _ in range(reps):
+            env = {
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "PATHWAY_THREADS": "2",
+                "PATHWAY_KEYLOAD": keyload,
+            }
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c", prog], env=env,
+                    capture_output=True, text=True, timeout=600,
+                )
+            except subprocess.TimeoutExpired:
+                print("bench: lineage lane rep timed out", file=sys.stderr)
+                return best
+            if r.returncode != 0:
+                print(
+                    f"bench: lineage lane rep failed (rc={r.returncode}):\n"
+                    f"{r.stderr.strip()[-2000:]}",
+                    file=sys.stderr,
+                )
+                return best
+            try:
+                rep = json.loads(r.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                print(
+                    f"bench: lineage lane output unreadable: "
+                    f"{r.stdout[-500:]}", file=sys.stderr,
+                )
+                return best
+            if best is None or rep["rows_per_sec"] > best["rows_per_sec"]:
+                best = rep
+        return best
+
+    on = arm("1")
+    off = arm("0")
+    if not on or not off or not on.get("waves"):
+        return None
+    overhead_pct = (
+        (off["rows_per_sec"] - on["rows_per_sec"])
+        / off["rows_per_sec"] * 100.0
+    )
+    return {
+        "wave_p50_ms": round(on["wave_p50_ms"], 3),
+        "wave_p95_ms": round(on["wave_p95_ms"], 3),
+        "waves": int(on["waves"]),
+        "rows_per_sec": round(on["rows_per_sec"], 1),
+        "rows_per_sec_keyload_off": round(off["rows_per_sec"], 1),
+        # negative = the on-arm measured faster (pure noise floor)
+        "keyload_overhead_pct": round(overhead_pct, 2),
+        "keyload_overhead_ok": overhead_pct <= 3.0,
     }
 
 

@@ -37,6 +37,7 @@ __all__ = [
     "fusion_reasons_gate",
     "gate",
     "gates",
+    "latency_lineage_gate",
     "import_aliases",
     "iter_py_files",
     "metrics_surface_gate",
@@ -624,4 +625,89 @@ def fusion_metrics_gate() -> list[str]:
                 f"FUSION_STATS key {key!r} is not *_total — it would "
                 "render as a gauge; rename it or extend the renderer"
             )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# gate: latency lineage (observability/critpath.py + keyload.py)
+# ---------------------------------------------------------------------------
+
+
+def critpath_phases() -> list[str]:
+    """The ``PHASES`` tuple of ``observability/critpath.py``, read from
+    source (same rationale as :func:`declared_chaos_sites`)."""
+    tree = parse_file(
+        os.path.join(PACKAGE_DIR, "observability", "critpath.py")
+    )
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "PHASES"
+            for t in node.targets
+        ):
+            return list(ast.literal_eval(node.value))
+    raise AssertionError("observability/critpath.py: PHASES not found")
+
+
+@gate(
+    "latency_lineage",
+    "commit-wave and key-load accounting ship end to end: hub /query "
+    "docs, pathway_wave_*/pathway_key_group_* on /metrics, and the "
+    "wave.*/keyload.* signals series",
+)
+def latency_lineage_gate() -> list[str]:
+    problems: list[str] = []
+    hub_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "hub.py")
+    )
+    prom_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "prometheus.py")
+    )
+    ts_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "timeseries.py")
+    )
+    exec_src = read_text(os.path.join(PACKAGE_DIR, "engine", "executor.py"))
+    if not critpath_phases():
+        problems.append("observability/critpath.py declares no PHASES")
+    for key, where in (('"waves"', "hub"), ('"keyload"', "hub")):
+        if key not in hub_src:
+            problems.append(
+                f"observability/hub.py never ships the {key} document — "
+                "the lineage never leaves the process"
+            )
+    for marker in ("pathway_wave_", "pathway_key_group_share",
+                   "pathway_ingest_to_emit_stage_seconds"):
+        if marker not in prom_src:
+            problems.append(
+                f"observability/prometheus.py never renders {marker}* — "
+                "the accounting silently vanishes from /metrics"
+            )
+    for marker in ('"wave.', '"keyload.'):
+        if marker not in ts_src and f"f{marker}" not in ts_src:
+            problems.append(
+                f"observability/timeseries.py never records the "
+                f"{marker[1:]}* signals series"
+            )
+    # the staged e2e decomposition must stay wired through note_e2e:
+    # every E2E_STAGES name needs a histogram fed from the executor
+    for node in parse_file(
+        os.path.join(PACKAGE_DIR, "engine", "executor.py")
+    ).body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "E2E_STAGES"
+            for t in node.targets
+        ):
+            if len(ast.literal_eval(node.value)) < 4:
+                problems.append(
+                    "engine/executor.py E2E_STAGES lost stages — the "
+                    "ingest_to_emit decomposition no longer covers the "
+                    "route/dwell/settle/deliver pipeline"
+                )
+            break
+    else:
+        problems.append("engine/executor.py: E2E_STAGES not found")
+    if "stage_hists" not in exec_src or "note_e2e" not in exec_src:
+        problems.append(
+            "engine/executor.py dropped the staged e2e histograms "
+            "(stage_hists/note_e2e)"
+        )
     return problems

@@ -457,7 +457,11 @@ def pass_shard_skew(ctx: AnalysisContext) -> list[Diagnostic]:
             location=ctx.location_of(node),
             mitigation=(
                 "group/join on a higher-cardinality key (or a composite "
-                "key), or run fewer workers for this stage"
+                "key), or run fewer workers for this stage; at runtime, "
+                "the keyload.* signals series and pathway_key_group_share "
+                "on /metrics (observability/keyload.py) measure the "
+                "realized per-key-group row distribution this pass can "
+                "only predict statically"
             ),
         ))
     return out

@@ -22,7 +22,8 @@ import click
 from .internals.config import MAX_WORKERS
 
 __all__ = [
-    "main", "spawn", "replay", "rescale", "top", "trace", "dlq", "lint",
+    "main", "spawn", "replay", "rescale", "top", "critpath", "trace",
+    "dlq", "lint",
 ]
 
 
@@ -470,6 +471,52 @@ def top(url, host, port, interval, frames, no_clear):
         url = f"http://{host}:{port}/query"
     sys.exit(run_top(url, interval_s=interval, frames=frames,
                      clear=not no_clear))
+
+
+@main.command()
+@click.option("--url", type=str, default=None,
+              help="full /query URL (overrides --host/--port)")
+@click.option("--host", type=str, default="127.0.0.1",
+              help="monitoring host of process 0")
+@click.option("--port", type=int, default=None,
+              help="monitoring port of process 0 (default "
+                   "PATHWAY_MONITORING_HTTP_PORT or 20000)")
+@click.option("-k", "--top-k", "top_k", type=int, default=10,
+              help="slowest waves to report")
+@click.option("--json", "as_json", is_flag=True, default=False,
+              help="dump the raw merged waves document instead")
+def critpath(url, host, port, top_k, as_json):
+    """Commit-wave critical-path report over the /query endpoint.
+
+    Fetches the merged latency-lineage document (process 0 of a running
+    pipeline) and prints the top-K slowest commit waves with the holding
+    worker — the last frontier to arrive — and the per-stage split of
+    each wave's wall time: ``pathway-tpu critpath --port 20000``."""
+    import json as _json
+
+    from .observability.critpath import render_report
+    from .observability.top import fetch_query
+
+    if url is None:
+        if port is None:
+            try:
+                port = int(
+                    os.environ.get("PATHWAY_MONITORING_HTTP_PORT", "20000")
+                )
+            except ValueError:
+                port = 20000
+        url = f"http://{host}:{port}/query"
+    elif not url.rstrip("/").endswith("/query"):
+        url = url.rstrip("/") + "/query"
+    try:
+        doc = fetch_query(url)
+    except Exception as e:
+        raise click.ClickException(f"{url} unreachable ({e})")
+    waves = doc.get("waves")
+    if as_json:
+        click.echo(_json.dumps(waves, indent=2, sort_keys=True))
+        return
+    click.echo(render_report(waves, top_k=top_k))
 
 
 @main.command()

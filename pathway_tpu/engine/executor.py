@@ -27,9 +27,19 @@ import numpy as np
 
 from .delta import Delta, concat_deltas
 
-__all__ = ["Node", "SourceNode", "Executor", "EngineStats", "END_TIME"]
+__all__ = [
+    "Node", "SourceNode", "Executor", "EngineStats", "END_TIME", "E2E_STAGES",
+]
 
 END_TIME = 1 << 62
+
+#: staged decomposition of the ingest→emit histogram, pipeline order:
+#: connector ingest → exchange post (route), post → operator delivery
+#: (inbox dwell), delivery → emitting sweep (settle/commit wait), sweep
+#: start → emit. The four stage observations sum EXACTLY to the
+#: ``e2e_latency_hist`` observation they decompose (the third stage is
+#: the remainder by construction) — see EngineStats.note_e2e.
+E2E_STAGES = ("ingest_route", "inbox_dwell", "settle_commit", "commit_deliver")
 
 
 class EngineStats:
@@ -85,6 +95,28 @@ class EngineStats:
         self.exchange_rows_out = 0
         self.exchange_rows_in = 0
         self.exchange_batches = 0
+        #: staged ingest→emit histograms (E2E_STAGES order); each e2e
+        #: observation lands once in every stage, so per-stage p99s name
+        #: the stage behind an e2e p99 move
+        self.stage_hists: dict[str, Any] = {
+            s: LogHistogram() for s in E2E_STAGES
+        }
+        # -- commit-wave critical path (observability/critpath.py) --
+        self.waves_total = 0
+        #: wall duration of each commit wave (entry → release), ns
+        self.wave_duration = LogHistogram()
+        #: cumulative per-phase ns across waves (critpath.PHASES keys)
+        self.wave_stage_ns: dict[str, int] = {}
+        #: waves held per worker id (str keys — prometheus label values)
+        self.wave_held_total: dict[str, int] = {}
+        #: per-worker WaveRecorder ring, attached by the async loop
+        self._waves: Any = None
+        # -- key-group load accounting (observability/keyload.py) --
+        #: bounded SpaceSaving sketch over routed exchange buckets;
+        #: None when PATHWAY_KEYLOAD=0
+        from ..observability.keyload import maybe_account
+
+        self.keyload = maybe_account()
 
     def heartbeat(self) -> None:
         import time as _time
@@ -120,16 +152,54 @@ class EngineStats:
             hist = self.node_time_hist[label] = self._hist_factory()
         hist.observe(ns)
 
-    def note_e2e(self, ingest_ns: int) -> None:
+    def note_e2e(
+        self,
+        ingest_ns: int,
+        route_ns: int = 0,
+        dwell_ns: int = 0,
+        sweep_t0_wall_ns: "int | None" = None,
+    ) -> None:
         """Record one ingest→emit observation: rows stamped at connector
-        ingest time ``ingest_ns`` just reached a terminal output node."""
+        ingest time ``ingest_ns`` just reached a terminal output node —
+        and decompose it into the E2E_STAGES. ``route_ns`` is the
+        sender-side ingest→exchange-post latency, ``dwell_ns`` the
+        exchange inbox dwell (both ride the frame meta through the async
+        plane), ``sweep_t0_wall_ns`` the wall clock at the start of the
+        sweep that emitted. Stages are clamped in order against the
+        total, the settle/commit stage is the remainder — the four
+        observations sum exactly to the e2e one."""
         import time as _time
 
-        lat_ns = _time.time_ns() - int(ingest_ns)
+        now = _time.time_ns()
+        lat_ns = now - int(ingest_ns)
         if lat_ns < 0:  # clock skew guard (stamps come from this host)
             lat_ns = 0
         self.e2e_latency_hist.observe(lat_ns)
         self.e2e_ms = lat_ns / 1e6
+        s1 = min(max(0, int(route_ns)), lat_ns)
+        s2 = min(max(0, int(dwell_ns)), lat_ns - s1)
+        s4 = 0
+        if sweep_t0_wall_ns is not None:
+            s4 = min(max(0, now - int(sweep_t0_wall_ns)), lat_ns - s1 - s2)
+        h = self.stage_hists
+        h["ingest_route"].observe(s1)
+        h["inbox_dwell"].observe(s2)
+        h["settle_commit"].observe(lat_ns - s1 - s2 - s4)
+        h["commit_deliver"].observe(s4)
+
+    def note_wave(self, doc: dict, duration_ns: int) -> None:
+        """Fold one commit-wave document (critpath.WaveRecorder) into
+        the scalar counters rendered on /metrics."""
+        self.waves_total += 1
+        self.wave_duration.observe(max(0, int(duration_ns)))
+        for p, ms in (doc.get("phases_ms") or {}).items():
+            self.wave_stage_ns[p] = (
+                self.wave_stage_ns.get(p, 0) + int(ms * 1e6)
+            )
+        holder = doc.get("holder")
+        if holder is not None:
+            k = str(holder)
+            self.wave_held_total[k] = self.wave_held_total.get(k, 0) + 1
 
     def note_exchange(self, rows_out: int, rows_in: int) -> None:
         self.exchange_batches += 1
@@ -664,6 +734,13 @@ class Executor:
         self._tick_seq = 0
         #: perf_counter_ns of the last flight-recorded tick (throttle)
         self._flight_tick_ns = 0
+        #: cumulative ns spent inside _tick sweeps — the busy half of the
+        #: wave critical path (sweep phase = busy delta between waves)
+        self._busy_ns_total = 0
+        #: (busy_ns, dwell_ns, perf_ns) snapshot at the end of the last
+        #: commit wave; the next wave's sweep/inbox_dwell phases and its
+        #: inter-wave interval are deltas against this mark
+        self._wave_mark: "tuple[int, int, int] | None" = None
         #: cumulative ns this worker spent PARKED waiting for work in its
         #: streaming loop (async or BSP) — the skew bench's busy-fraction
         #: denominator piece ("waiting" vs "working"); blocked-in-
@@ -1052,6 +1129,11 @@ class Executor:
         ctx = self.ctx
         plane = AsyncPlane(ctx.comm, ctx.worker_id, ctx.n_workers)
         ctx.async_plane = plane
+        if self.stats._waves is None:
+            from ..observability.critpath import WaveRecorder
+
+            self.stats._waves = WaveRecorder(ctx.worker_id)
+        self._wave_mark = None
         self._async_timeout_s = _env_float(
             "PATHWAY_COLLECTIVE_TIMEOUT_S", 600.0
         )
@@ -1265,27 +1347,72 @@ class Executor:
 
         ctx = self.ctx
         deadline = _time.monotonic() + self._async_timeout_s
+        # -- phase stamps: the wave's accounting window opened when the
+        # LAST wave released (self._wave_mark); sweep busy time and inbox
+        # dwell accumulated since then are this wave's pipeline phases
+        t_entry = _time.perf_counter_ns()
+        mark = self._wave_mark
+        if self.flight is not None:
+            self.flight.record(
+                "wave.phase", worker=ctx.worker_id, epoch=epoch,
+                phase="frontier_wait",
+            )
         ready_clock = max(clock, plane.tracker.local())
+        # the ready broadcast carries this worker's wave-entry wall time
+        # and its pre-wave busy time so every worker elects the holding
+        # worker from IDENTICAL data (critpath.attribute_holder): last
+        # entry when the spread is real, busiest pipeline when everyone
+        # joined within scheduler jitter
+        entry_wall = _time.time()
+        busy_pre_ms = (
+            self._busy_ns_total - (mark[0] if mark else 0)
+        ) / 1e6
         plane.broadcast_status(
-            {"wc": epoch, "cr": [epoch, ready_clock, bool(fin)]}
+            {
+                "wc": epoch,
+                "cr": [
+                    epoch, ready_clock, bool(fin),
+                    entry_wall, round(busy_pre_ms, 3),
+                ],
+            }
         )
         readys = {ctx.worker_id: ready_clock}
+        ready_order = [(ctx.worker_id, ready_clock, entry_wall)]
+        busy_by = {ctx.worker_id: busy_pre_ms}
         was_final = bool(fin)
         while len(readys) < ctx.n_workers:
             plane.drain()  # keeps inbox bounds free; nothing is processed
             for w, st in plane.peer_status.items():
                 cr = st.get("cr")
                 if cr is not None and cr[0] == epoch:
+                    if w not in readys:
+                        ready_order.append(
+                            (w, cr[1], cr[3] if len(cr) > 3 else 0.0)
+                        )
+                        busy_by[w] = cr[4] if len(cr) > 4 else 0.0
                     readys[w] = cr[1]
                     if len(cr) > 2 and cr[2]:
                         was_final = True
             if len(readys) >= ctx.n_workers:
                 break
-            if _time.monotonic() > deadline:
+            now_mono = _time.monotonic()
+            if now_mono > deadline:
+                ages = plane.tracker.ages(now_mono)
+                missing = ", ".join(
+                    f"w{w}"
+                    + (
+                        f" (quiet {ages[w]:.1f}s)"
+                        if ages.get(w) is not None
+                        else " (never heard)"
+                    )
+                    for w in range(ctx.n_workers)
+                    if w not in readys
+                )
                 raise RuntimeError(
                     f"worker {ctx.worker_id}: commit wave {epoch} timed "
                     f"out collecting ready clocks ({len(readys)}/"
-                    f"{ctx.n_workers}; PATHWAY_COLLECTIVE_TIMEOUT_S)"
+                    f"{ctx.n_workers}; waiting on {missing}; "
+                    "PATHWAY_COLLECTIVE_TIMEOUT_S)"
                 )
             plane.waker.wait(0.002)
             plane.waker.clear()
@@ -1294,18 +1421,101 @@ class Executor:
         T = (max(readys.values()) + 2) & ~1
         clock = max(clock, T)
         plane.hold_above = T
+        t_ready = _time.perf_counter_ns()
+        tracer = self.tracer
+        if tracer is not None:
+            tracer.complete("wave.frontier_wait", t_entry, {"epoch": epoch})
+        if self.flight is not None:
+            self.flight.record(
+                "wave.phase", worker=ctx.worker_id, epoch=epoch,
+                phase="settle", time=T,
+            )
         votes = QuiesceVotes(ctx.n_workers, ctx.worker_id, f"cw{epoch}")
+        busy_before_settle = self._busy_ns_total
         self._async_settle(plane, votes, deadline, label=T)
+        t_settled = _time.perf_counter_ns()
+        settle_rounds = votes.round
+        if tracer is not None:
+            tracer.complete(
+                "wave.settle", t_ready,
+                {"epoch": epoch, "rounds": settle_rounds},
+            )
         if plane.tracker.local() < T:
             plane.tracker.advance_local(T, now=_time.monotonic())
         if self.flight is not None:
             self.flight.record(
-                "async.commit", worker=ctx.worker_id, epoch=epoch, time=T
+                "wave.phase", worker=ctx.worker_id, epoch=epoch,
+                phase="snapshot", time=T,
             )
         self.persistence.commit(T)
+        if tracer is not None:
+            tracer.complete("wave.snapshot", t_settled, {"epoch": epoch})
         self._last_clock = max(self._last_clock, T)
         plane.hold_above = None
         plane.broadcast_status({"wc": -1, "cr": None, "ep": epoch + 1})
+        t_end = _time.perf_counter_ns()
+        # -- build the wave document and fold it into the counters
+        commit_ns = t_end - t_settled
+        snapshot_ns, release_ns = commit_ns, 0
+        ph = getattr(self.persistence, "last_commit_phase_ns", None)
+        if ph:
+            # the manager's own split: snapshotting proper vs delivery
+            # barrier + post-commit release (io/delivery.py boundary)
+            release_ns = min(
+                commit_ns, int(ph.get("barrier", 0)) + int(ph.get("release", 0))
+            )
+            snapshot_ns = commit_ns - release_ns
+        phases_ms = {
+            # busy sweep time since the last wave — includes this wave's
+            # settle sweeps, which is why settle subtracts them below
+            "sweep": (
+                self._busy_ns_total - (mark[0] if mark else 0)
+            ) / 1e6,
+            "inbox_dwell": (
+                plane.dwell_total_ns - (mark[1] if mark else 0)
+            ) / 1e6,
+            "frontier_wait": (t_ready - t_entry) / 1e6,
+            "settle": max(
+                0.0,
+                (t_settled - t_ready)
+                - (self._busy_ns_total - busy_before_settle),
+            ) / 1e6,
+            "snapshot": snapshot_ns / 1e6,
+            "release": release_ns / 1e6,
+        }
+        duration_ns = t_end - t_entry
+        doc = self.stats._waves.record_wave(
+            epoch=epoch,
+            T=T,
+            t=_time.time(),
+            duration_ms=duration_ns / 1e6,
+            interval_ms=(t_entry - mark[2]) / 1e6 if mark else 0.0,
+            phases_ms=phases_ms,
+            settle_rounds=settle_rounds,
+            ready_order=ready_order,
+            busy_ms=busy_by,
+            fin=was_final,
+        )
+        self.stats.note_wave(doc, duration_ns)
+        self._wave_mark = (self._busy_ns_total, plane.dwell_total_ns, t_end)
+        if self.flight is not None:
+            self.flight.record(
+                "async.commit", worker=ctx.worker_id, epoch=epoch, time=T,
+                holder=doc["holder"], critical=doc["critical_stage"],
+                dur_ms=round(duration_ns / 1e6, 3), rounds=settle_rounds,
+            )
+        if tracer is not None:
+            # the wave.commit parent is emitted LAST but began at
+            # t_entry: complete events nest by time-range enclosure on
+            # the worker's track, so the merged Perfetto timeline shows
+            # the wave span wrapping its phase children above
+            tracer.complete(
+                "wave.commit", t_entry,
+                {
+                    "epoch": epoch, "T": T, "holder": doc["holder"],
+                    "critical": doc["critical_stage"],
+                },
+            )
         return clock, was_final
 
     def _async_settle(self, plane, votes, deadline: float,
@@ -1525,6 +1735,7 @@ class Executor:
         # against a full topological sweep is noise, and it is the one
         # distribution that catches hot-path regressions unconditionally
         tick_t0 = _wall.perf_counter_ns()
+        tick_wall_t0 = _wall.time_ns()
         ingest_ns = self._next_tick_ingest_ns
         self._next_tick_ingest_ns = None
         plane = getattr(self.ctx, "async_plane", None)
@@ -1534,6 +1745,9 @@ class Executor:
             # measures the true cross-worker path (the BSP loop shipped
             # this through the cycle allgather instead)
             plane.cur_ingest_ns = ingest_ns
+            # fresh per-sweep slot: take() fills it with the oldest
+            # arrival's route/dwell stamps for the staged e2e split
+            plane.sweep_oldest = None
         out_rows_before = self.stats.output_rows
         inbox: dict[int, dict[int, list[Delta]]] = {}
         seeded: dict[int, list[Delta]] = {}
@@ -1616,11 +1830,24 @@ class Executor:
                     self.stats.note_node_time(
                         node, _wall.perf_counter_ns() - node_t0
                     )
-        self.stats.tick_duration.observe(_wall.perf_counter_ns() - tick_t0)
+        sweep_ns = _wall.perf_counter_ns() - tick_t0
+        self.stats.tick_duration.observe(sweep_ns)
+        self._busy_ns_total += sweep_ns
         if ingest_ns is not None and self.stats.output_rows > out_rows_before:
             # rows stamped at connector ingest reached a terminal output
-            # node within this sweep — one ingest→emit observation
-            self.stats.note_e2e(ingest_ns)
+            # node within this sweep — one ingest→emit observation,
+            # staged: when the oldest arrival this sweep delivered IS the
+            # stamped row, its frame meta supplies route/dwell; a locally
+            # sourced row spent its pre-sweep time in the route stage
+            route_ns = dwell_ns = 0
+            oldest = plane.sweep_oldest if plane is not None else None
+            if oldest is not None and oldest[0] == ingest_ns:
+                route_ns, dwell_ns = oldest[1], oldest[2]
+            else:
+                route_ns = max(0, tick_wall_t0 - ingest_ns)
+            self.stats.note_e2e(
+                ingest_ns, route_ns, dwell_ns, tick_wall_t0
+            )
         self.stats.note_tick(time)
         for cb in self._on_time_end:
             cb(time)

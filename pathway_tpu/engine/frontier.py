@@ -104,6 +104,17 @@ class FrontierTracker:
             return -1
         return g & ~1
 
+    def ages(self, now: float) -> dict[int, float | None]:
+        """Seconds since each worker's last frontier advance (None =
+        never advanced). The commit-wave timeout uses this to name WHO
+        the wave was waiting on and for how long — the crash-side
+        counterpart of the per-wave holding-worker election
+        (observability/critpath.py)."""
+        return {
+            w: (None if a is None else max(0.0, now - a))
+            for w, a in enumerate(self._advanced_at)
+        }
+
     def stalled(self, now: float, timeout_s: float) -> list[int]:
         """Workers that look wedged: their frontier sits strictly behind
         the most advanced worker AND they have not advanced for

@@ -970,6 +970,11 @@ class DeliveryManager:
         self.worker_id = worker_id
         self.sinks: list[DeliverySink] = []
         self.dlq = DeadLetterQueue()
+        #: cumulative ns blocked in pre_commit_barrier / on_commit —
+        #: the delivery plane's share of the commit wave's release
+        #: phase (critical-path attribution, observability/critpath.py)
+        self.barrier_wait_ns = 0
+        self.release_ns = 0
 
     def add(self, sink: DeliverySink) -> None:
         self.sinks.append(sink)
@@ -985,11 +990,14 @@ class DeliveryManager:
         return bool(self.sinks)
 
     def pre_commit_barrier(self) -> None:
+        t0 = _time.perf_counter_ns()
         for s in self.sinks:
             if s.transactional:
                 s.drain(timeout=None)
+        self.barrier_wait_ns += _time.perf_counter_ns() - t0
 
     def on_commit(self, up_to_time: int) -> None:
+        t0 = _time.perf_counter_ns()
         for s in self.sinks:
             if s.transactional:
                 s.release(up_to_time)
@@ -999,6 +1007,7 @@ class DeliveryManager:
         for s in self.sinks:
             if s.transactional:
                 s.drain(timeout=None, bump_to=up_to_time)
+        self.release_ns += _time.perf_counter_ns() - t0
 
     def want_early_commit(self) -> bool:
         """Pending (uncommitted) output grew past the queue bound: ask the

@@ -76,7 +76,20 @@ def attribution_document(
     per_op: dict[str, dict[str, Any]] = {}
     backlogged: list[int] = []
     exchange_wait_ms = 0.0
+    wave_stages: dict[str, float] = {}
     for worker in signals.store.workers():
+        # commit-wave phase attribution (async plane): cumulative
+        # per-phase seconds sampled by the signals plane — the
+        # cluster-level complement of the per-operator ranking (which
+        # stage of the wave pipeline the cluster's wall time went to)
+        for metric in signals.store.metrics(worker):
+            if metric.startswith("wave.stage_") and metric.endswith("_s"):
+                v = signals.last(metric, worker)
+                if v:
+                    phase = metric[len("wave.stage_"):-2]
+                    wave_stages[phase] = (
+                        wave_stages.get(phase, 0.0) + float(v)
+                    )
         lag_pts = signals.store.points("frontier_lag_ms", worker, window_s)
         if (
             len(lag_pts) >= 2
@@ -101,7 +114,10 @@ def attribution_document(
             if entry["rows_per_sec"] is not None:
                 doc["rows_per_sec"] += entry["rows_per_sec"]
             doc["workers"][str(worker)] = round(entry["busy_ms"], 3)
-    return _finalize(per_op, exchange_wait_ms, backlogged, window_s)
+    return _finalize(
+        per_op, exchange_wait_ms, backlogged, window_s,
+        wave_stages=wave_stages,
+    )
 
 
 def _finalize(
@@ -109,6 +125,7 @@ def _finalize(
     exchange_wait_ms: float,
     backlogged: list,
     window_s: Any,
+    wave_stages: dict[str, float] | None = None,
 ) -> dict[str, Any]:
     """Rank, compute shares, round — THE one place the attribution
     document takes its final shape (single- and merged-process paths)."""
@@ -120,7 +137,7 @@ def _finalize(
         doc["share"] = round(doc["busy_ms"] / total, 4) if total > 0 else 0.0
         doc["busy_ms"] = round(doc["busy_ms"], 3)
         doc["rows_per_sec"] = round(doc["rows_per_sec"], 1)
-    return {
+    out = {
         "window_s": window_s,
         "total_busy_ms": round(total, 3),
         "exchange_wait_ms": round(exchange_wait_ms, 3),
@@ -128,6 +145,14 @@ def _finalize(
         "bottleneck": ranked[0]["operator"] if ranked else None,
         "ranked": ranked,
     }
+    if wave_stages:
+        out["wave_stages_s"] = {
+            p: round(v, 3) for p, v in sorted(wave_stages.items())
+        }
+        out["wave_critical_stage"] = max(
+            wave_stages, key=lambda p: wave_stages[p]
+        )
+    return out
 
 
 def merge_attribution_documents(docs: list[dict]) -> dict:
@@ -143,9 +168,12 @@ def merge_attribution_documents(docs: list[dict]) -> dict:
     per_op: dict[str, dict[str, Any]] = {}
     backlogged: list = []
     exchange_wait_ms = 0.0
+    wave_stages: dict[str, float] = {}
     for doc in docs:
         backlogged.extend(doc.get("backlogged_workers", []))
         exchange_wait_ms += float(doc.get("exchange_wait_ms", 0.0))
+        for p, v in (doc.get("wave_stages_s") or {}).items():
+            wave_stages[p] = wave_stages.get(p, 0.0) + float(v)
         for entry in doc.get("ranked", []):
             agg = per_op.setdefault(
                 entry["operator"],
@@ -160,7 +188,8 @@ def merge_attribution_documents(docs: list[dict]) -> dict:
             agg["rows_per_sec"] += float(entry.get("rows_per_sec") or 0.0)
             agg["workers"].update(entry.get("workers", {}))
     return _finalize(
-        per_op, exchange_wait_ms, backlogged, docs[0].get("window_s")
+        per_op, exchange_wait_ms, backlogged, docs[0].get("window_s"),
+        wave_stages=wave_stages,
     )
 
 

@@ -62,6 +62,27 @@ def stats_snapshot(stats: Any, worker_id: int = 0) -> dict:
             label: h.snapshot()
             for label, h in list(stats.node_time_hist.items())
         },
+        # staged ingest→emit decomposition (executor.E2E_STAGES)
+        "stage_hists": {
+            name: h.snapshot()
+            for name, h in (getattr(stats, "stage_hists", None) or {}).items()
+        },
+        # commit-wave critical path (observability/critpath.py)
+        "waves_total": getattr(stats, "waves_total", 0),
+        "wave_duration": stats.wave_duration.snapshot()
+        if getattr(stats, "wave_duration", None) is not None
+        else None,
+        "wave_stage_ns": dict(getattr(stats, "wave_stage_ns", None) or {}),
+        "wave_held_total": dict(
+            getattr(stats, "wave_held_total", None) or {}
+        ),
+        "waves": stats._waves.snapshot()
+        if getattr(stats, "_waves", None) is not None
+        else None,
+        # key-group load sketch (observability/keyload.py)
+        "keyload": stats.keyload.snapshot()
+        if getattr(stats, "keyload", None) is not None
+        else None,
     }
     if stats.latency_updated_at is not None:
         snap["latency_age_s"] = max(0.0, now - stats.latency_updated_at)
@@ -514,6 +535,8 @@ class ObservabilityHub:
         doc["sinks"] = self.sink_stats_snapshot()
         doc["udf"] = self.udf_stats_snapshot()
         doc["fusion"] = self.fusion_stats_snapshot()
+        doc["waves"] = self._waves_document()
+        doc["keyload"] = self._keyload_document()
         from .attribution import attribution_document
 
         doc["attribution"] = attribution_document(sig, w)
@@ -529,6 +552,39 @@ class ObservabilityHub:
         if auto is not None:
             doc["autoscale"] = auto
         return doc
+
+    def _waves_document(self) -> dict | None:
+        """Process-level commit-wave merge: every local worker's
+        WaveRecorder ring folded into one ``waves`` document (the
+        per-epoch merge elects the holder by majority — see
+        observability/critpath.py)."""
+        from .critpath import merge_worker_waves
+
+        with self._lock:
+            items = sorted(self._workers.items())
+        snaps = {
+            str(w): s._waves.snapshot()
+            for w, s in items
+            if getattr(s, "_waves", None) is not None
+        }
+        if not snaps:
+            return None
+        return merge_worker_waves(snaps)
+
+    def _keyload_document(self) -> dict | None:
+        """Process-level key-group load merge over local workers'
+        sketches (observability/keyload.py)."""
+        from .keyload import merge_snapshots
+
+        with self._lock:
+            items = sorted(self._workers.items())
+        return merge_snapshots(
+            [
+                s.keyload.snapshot()
+                for _, s in items
+                if getattr(s, "keyload", None) is not None
+            ]
+        )
 
     def query_document(self) -> dict:
         """The merged ``/query`` view: process 0 scrapes every peer's
@@ -618,6 +674,19 @@ class ObservabilityHub:
 
         merged["processes"] = processes
         merged["attribution"] = merge_attribution_documents(attributions)
+        # cluster-wide wave + key-load roll-ups: peer documents carry
+        # the same shapes, so the merges re-merge; a stale (cached) peer
+        # doc still contributes its last-good wave phases — a dead peer's
+        # view is marked stale above, never silently dropped
+        from .critpath import merge_process_waves
+        from .keyload import merge_snapshots as _merge_keyload
+
+        merged["waves"] = merge_process_waves(
+            [local.get("waves")] + [d.get("waves") for d in peer_docs]
+        )
+        merged["keyload"] = _merge_keyload(
+            [local.get("keyload")] + [d.get("keyload") for d in peer_docs]
+        )
         self._add_cluster_lag(merged)
         return merged
 

@@ -196,6 +196,50 @@ def render_snapshots(
                 r, "pathway_operator_processing_seconds", hsnap,
                 {**lab, "operator": op},
             )
+        for stage, hsnap in sorted(s.get("stage_hists", {}).items()):
+            # staged decomposition of ingest→emit (executor.E2E_STAGES):
+            # every e2e observation lands once per stage, so the staged
+            # sums add up to pathway_ingest_to_emit_seconds_sum and a p99
+            # move decomposes into the stage that caused it
+            if hsnap and hsnap.get("count"):
+                render_histogram(
+                    r, "pathway_ingest_to_emit_stage_seconds", hsnap,
+                    {**lab, "stage": stage},
+                )
+        # commit-wave critical path (async plane, observability/critpath)
+        if s.get("waves_total"):
+            r.add("pathway_waves_total", "counter", s["waves_total"], lab)
+            if s.get("wave_duration") and s["wave_duration"]["count"]:
+                render_histogram(
+                    r, "pathway_wave_duration_seconds",
+                    s["wave_duration"], lab,
+                )
+            for stage, ns in sorted(s.get("wave_stage_ns", {}).items()):
+                r.add(
+                    "pathway_wave_stage_seconds_total", "counter",
+                    int(ns) / 1e9, {**lab, "stage": stage},
+                )
+            for holder, n in sorted(s.get("wave_held_total", {}).items()):
+                # which worker's frontier arrived last (held the wave)
+                r.add(
+                    "pathway_wave_held_total", "counter", int(n),
+                    {**lab, "holder": str(holder)},
+                )
+        kl = s.get("keyload")
+        if kl and kl.get("rows_total"):
+            # key-group heavy hitters (observability/keyload.py): top
+            # tracked groups' share of routed rows — bounded label
+            # cardinality (top 8 of a capacity-bounded sketch)
+            r.add(
+                "pathway_keyload_rows_total", "counter",
+                kl["rows_total"], lab,
+            )
+            for entry in (kl.get("top") or [])[:8]:
+                r.add(
+                    "pathway_key_group_share", "gauge",
+                    round(float(entry.get("share", 0.0)), 4),
+                    {**lab, "group": str(entry.get("group"))},
+                )
     for proc, gauges in sorted((comm_stats or {}).items()):
         plab = {"process": str(proc)}
         for key, value in sorted(gauges.items()):

@@ -453,6 +453,35 @@ class SignalsPlane:
                 self.store.record(
                     "e2e_latency", e2e.snapshot()["counts"], wid, t
                 )
+            # commit-wave critical path (async plane): wave counters +
+            # per-phase cumulative seconds so SLO rules can watch e.g.
+            # rate(wave.stage_settle_s) — the lineage behind an e2e p99
+            waves_total = getattr(stats, "waves_total", 0)
+            if waves_total:
+                rec("wave.total", float(waves_total))
+                for phase, ns in list(
+                    (getattr(stats, "wave_stage_ns", None) or {}).items()
+                ):
+                    rec(f"wave.stage_{phase}_s", float(ns) / 1e9)
+                last = None
+                rec_ring = getattr(stats, "_waves", None)
+                if rec_ring is not None and rec_ring.recent:
+                    last = rec_ring.recent[-1]
+                if last is not None:
+                    rec("wave.last_duration_ms", float(last["duration_ms"]))
+                    if last.get("holder") is not None:
+                        rec("wave.last_holder", float(last["holder"]))
+            # key-group load sketch: top share + skew vs uniform — the
+            # rebalancer's (ROADMAP item 3) runtime input
+            acct = getattr(stats, "keyload", None)
+            if acct is not None and acct.rows_total:
+                rec("keyload.rows_total", float(acct.rows_total))
+                items = acct.sketch.items()
+                if items:
+                    top_share = items[0][1] / (acct.sketch.total or 1.0)
+                    rec("keyload.top_share", top_share)
+                    rec("keyload.top_group", float(items[0][0]))
+                    rec("keyload.skew", top_share * acct.n_groups)
             # per-operator cumulative processing time + rows — the
             # attribution inputs (populated when stats.detailed is on,
             # which the hub enables alongside the metrics endpoint)

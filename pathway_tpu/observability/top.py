@@ -36,6 +36,15 @@ def _fmt(v: Any, unit: str = "", nd: int = 1) -> str:
     return f"{v}{unit}"
 
 
+def _keyload_line(kl: dict | None) -> str | None:
+    """The shard-skew line (observability/keyload.py skew_line)."""
+    if not kl:
+        return None
+    from .keyload import skew_line
+
+    return skew_line(kl)
+
+
 def render_frame(doc: dict, now: float | None = None) -> str:
     """One dashboard frame from a ``/query`` document."""
     if now is None:
@@ -190,6 +199,26 @@ def render_frame(doc: dict, now: float | None = None) -> str:
         if f.get("jit_chains_total"):
             line += f", {_fmt(f.get('jit_chains_total'), nd=0)} XLA"
         lines.append(line)
+    waves = doc.get("waves")
+    if waves and waves.get("last"):
+        last = waves["last"]
+        share = waves.get("holder_share") or {}
+        holder = last.get("holder")
+        held = (
+            f", w{holder} holds {share.get(str(holder), 0) * 100:.0f}% "
+            "of waves"
+            if holder is not None
+            else ""
+        )
+        lines.append(
+            f"waves: {_fmt(waves.get('waves'), nd=0)} recorded, last "
+            f"{_fmt(last.get('duration_ms'), ' ms', 1)} "
+            f"(critical {last.get('critical_stage')}, "
+            f"holder w{holder if holder is not None else '?'}{held})"
+        )
+    kl_line = _keyload_line(doc.get("keyload"))
+    if kl_line:
+        lines.append(kl_line)
     sup = doc.get("supervisor")
     if sup is not None and sup.get("window_failures") is not None:
         budget = sup.get("window_budget")

@@ -31,7 +31,7 @@ import json
 import os
 from typing import Any
 
-__all__ = ["discover_parts", "merge_trace"]
+__all__ = ["discover_parts", "merge_trace", "wave_spans"]
 
 
 def discover_parts(base: str) -> list[str]:
@@ -159,3 +159,30 @@ def merge_trace(
         "n_flows": n_flows,
         "run_id": next(iter(run_ids)) if run_ids else None,
     }
+
+
+def wave_spans(doc: dict, top_k: int = 10) -> list[dict]:
+    """Offline critical-path view over a merged trace document: the
+    ``wave.commit`` spans (engine/executor.py ``_async_commit_wave``),
+    slowest first, each carrying its pid, epoch, holding worker, and
+    critical stage from the span args. Complements the live
+    ``pathway-tpu critpath`` report when all that's left of a run is
+    its trace."""
+    spans: list[dict] = []
+    for ev in doc.get("traceEvents", []):
+        if ev.get("name") != "wave.commit" or ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        spans.append(
+            {
+                "pid": ev.get("pid"),
+                "ts_us": float(ev.get("ts", 0.0)),
+                "dur_ms": float(ev.get("dur", 0.0)) / 1e3,
+                "epoch": args.get("epoch"),
+                "T": args.get("T"),
+                "holder": args.get("holder"),
+                "critical": args.get("critical"),
+            }
+        )
+    spans.sort(key=lambda s: s["dur_ms"], reverse=True)
+    return spans[: max(0, top_k)]

@@ -52,7 +52,11 @@ class AsyncPlane:
         self.tracker = FrontierTracker(n_workers, worker_id)
         self.waker = threading.Event()
         comm.async_attach(worker_id, self.waker)
-        #: channel -> deque[(time, delta, ingest_ns, recv_perf_ns)]
+        #: channel -> deque[(time, delta, ingest_ns, recv_perf_ns,
+        #: route_ns, dwell0_ns)] — route_ns is the sender-side
+        #: ingest→post latency carried in the frame meta, dwell0_ns the
+        #: enqueue→drain inbox dwell measured on arrival; take() adds the
+        #: drain→delivery queue wait to complete the dwell
         self._arrivals: dict[int, collections.deque] = {}
         self._arrivals_pending = 0
         #: running min of queued arrivals' ingest stamps, maintained on
@@ -91,6 +95,13 @@ class AsyncPlane:
         # wait accounting: ns arrivals spent queued before delivery —
         # the genuine per-operator exchange wait of the async mode
         self.arrival_wait_ns = 0
+        #: cumulative enqueue→drain→delivery dwell across ALL arrivals
+        #: (commit waves read deltas of this for the inbox_dwell phase)
+        self.dwell_total_ns = 0
+        #: (ingest_ns, route_ns, dwell_ns) of the OLDEST arrival taken
+        #: during the current sweep — the stamps behind the staged
+        #: ingest→emit decomposition; _tick resets it per sweep
+        self.sweep_oldest: "tuple[int, int, int] | None" = None
         self.last_broadcast = 0.0
 
     # -- data plane ------------------------------------------------------
@@ -112,7 +123,8 @@ class AsyncPlane:
         seq = self._post_seq
         self._post_seq += 1
         delivered = self.comm.async_post_exchange(
-            self.worker_id, channel, time, buckets, self.cur_ingest_ns, seq
+            self.worker_id, channel, time, buckets, self.cur_ingest_ns, seq,
+            _time.time_ns(),
         )
         self.sent_events += delivered
         self.activity = True
@@ -129,7 +141,7 @@ class AsyncPlane:
         hold = self.hold_above
         now = _time.perf_counter_ns()
         while q:
-            t, delta, ing, recv_ns = q[0]
+            t, delta, ing, recv_ns, route_ns, dwell0_ns = q[0]
             if hold is not None and t > hold:
                 break  # FIFO per sender; later entries are >= t anyway
             q.popleft()
@@ -137,7 +149,14 @@ class AsyncPlane:
             ingest = _min_opt(ingest, ing)
             if ing is not None and ing == self._ingest_min:
                 self._ingest_min_dirty = True  # the minimum departed
-            self.arrival_wait_ns += now - recv_ns
+            wait_ns = now - recv_ns
+            self.arrival_wait_ns += wait_ns
+            dwell_ns = dwell0_ns + wait_ns
+            self.dwell_total_ns += dwell_ns
+            if ing is not None and (
+                self.sweep_oldest is None or ing < self.sweep_oldest[0]
+            ):
+                self.sweep_oldest = (ing, route_ns, dwell_ns)
             self.recv_events += 1
             self._arrivals_pending -= 1
         if out:
@@ -177,19 +196,31 @@ class AsyncPlane:
         if not events:
             return False
         now_ns = _time.perf_counter_ns()
+        now_wall = _time.time_ns()
         now = _time.monotonic()
         for ev in events:
             if ev[0] == "x":
-                _, channel, t, src, delta, ingest_ns, seq = ev
+                _, channel, t, src, delta, ingest_ns, seq = ev[:7]
+                enq_ns = ev[7] if len(ev) > 7 else None
                 if seq is not None:
                     # FIFO per sender link: a seq at or below the highest
                     # seen is a chaos-duplicated frame — drop the copy
                     if seq <= self._seen_seq.get(src, -1):
                         continue
                     self._seen_seq[src] = seq
+                # frame-meta stamps: sender-side ingest→post (route) and
+                # post→drain inbox dwell, both wall-clock and clamped so
+                # cross-process skew can only shrink them
+                route_ns = dwell0_ns = 0
+                if enq_ns is not None:
+                    dwell0_ns = max(0, now_wall - enq_ns)
+                    if ingest_ns is not None:
+                        route_ns = max(0, enq_ns - ingest_ns)
                 self._arrivals.setdefault(
                     channel, collections.deque()
-                ).append((t, delta, ingest_ns, now_ns))
+                ).append(
+                    (t, delta, ingest_ns, now_ns, route_ns, dwell0_ns)
+                )
                 self._arrivals_pending += 1
                 if ingest_ns is not None and (
                     self._ingest_min is None or ingest_ns < self._ingest_min
@@ -264,6 +295,7 @@ class AsyncPlane:
             "sent_events": float(self.sent_events),
             "recv_events": float(self.recv_events),
             "arrival_wait_ms": self.arrival_wait_ns / 1e6,
+            "dwell_total_ms": self.dwell_total_ns / 1e6,
             "frontier": float(self.tracker.local()),
             "global_frontier": float(self.tracker.global_frontier()),
         }

@@ -506,14 +506,15 @@ class ClusterComm(Comm):
                     # The reader thread NEVER blocks on the inbox bound —
                     # remote backpressure is the peer-status depth the
                     # executor consults before polling sources.
-                    _a, real_channel, ingest_ns, seq = channel
+                    _a, real_channel, ingest_ns, seq = channel[:4]
+                    enq_ns = channel[4] if len(channel) > 4 else None
                     for dst, payload in per_dst.items():
                         q = self._async_q.get(dst)
                         if q is None:
                             continue  # stale frame for a non-local worker
                         q.append(
                             ("x", real_channel, tick, src, payload,
-                             ingest_ns, seq)
+                             ingest_ns, seq, enq_ns)
                         )
                         self._async_data[dst] += 1
                         wake.append(dst)
@@ -770,7 +771,7 @@ class ClusterComm(Comm):
         )
 
     def async_post_exchange(self, worker_id, channel, time, buckets,
-                            ingest_ns=None, seq=None):
+                            ingest_ns=None, seq=None, enq_ns=None):
         import time as time_mod  # the logical-time param shadows the module
 
         delivered = 0
@@ -782,7 +783,8 @@ class ClusterComm(Comm):
             if p == self.process_id:
                 self._async_deliver_local(
                     dst,
-                    ("x", channel, time, worker_id, payload, ingest_ns, seq),
+                    ("x", channel, time, worker_id, payload, ingest_ns, seq,
+                     enq_ns),
                     is_data=True,
                 )
                 delivered += 1
@@ -793,9 +795,11 @@ class ClusterComm(Comm):
             ctx = self._frame_ctx(p, channel=channel, tick=time)
             t0 = time_mod.perf_counter_ns()
             # the async marker rides the frame metadata: same columnar
-            # codec, same chaos gate (_post), different delivery side
+            # codec, same chaos gate (_post), different delivery side —
+            # the enqueue stamp travels with the frame so the receiver's
+            # drain can measure the enqueue->drain inbox dwell
             chunks, body_len = frames.encode_frame(
-                ("a", channel, ingest_ns, seq), int(time), worker_id,
+                ("a", channel, ingest_ns, seq, enq_ns), int(time), worker_id,
                 per_dst, ctx,
             )
             with self._encode_lock:

@@ -93,8 +93,14 @@ class Comm:
     # -- asynchronous (frontier-driven) plane ---------------------------
     #
     # Events are plain tuples:
-    #   ("x", channel, time, src_worker, delta, ingest_ns, seq) — data
+    #   ("x", channel, time, src_worker, delta, ingest_ns, seq, enq_ns)
+    #                                                           — data
     #   ("c", src_worker, payload)                              — control
+    # ``enq_ns`` is the sender's wall-clock enqueue stamp (time_ns at
+    # post): the receiver's drain measures the enqueue→drain inbox dwell
+    # from it — the per-frame meta behind the commit-wave ``inbox_dwell``
+    # phase (observability/critpath.py). Same-host clocks; the reader
+    # clamps negatives so skew can only shrink a dwell, never fake one.
     # ``seq`` is the sender's per-post counter: the receiver dedupes
     # chaos-duplicated frames by (src, seq), the async analog of the BSP
     # rendezvous inbox where a duplicate overwrote its own slot. Control
@@ -113,7 +119,7 @@ class Comm:
     def async_post_exchange(
         self, worker_id: int, channel: int, time: int,
         buckets: Sequence[Any], ingest_ns: "int | None" = None,
-        seq: "int | None" = None,
+        seq: "int | None" = None, enq_ns: "int | None" = None,
     ) -> int:
         """Fire-and-forget exchange: ``buckets[w]`` goes to worker ``w``'s
         async inbox (None/own slot skipped). Never waits for peers.
@@ -316,7 +322,7 @@ class LocalComm(Comm):
         )
 
     def async_post_exchange(self, worker_id, channel, time, buckets,
-                            ingest_ns=None, seq=None):
+                            ingest_ns=None, seq=None, enq_ns=None):
         if self._chaos is not None:
             # the comm.local chaos site stays live on the async data
             # plane: 'drop' vanishes this worker's rows for this post —
@@ -332,7 +338,8 @@ class LocalComm(Comm):
                 continue
             self._async_deliver(
                 dest,
-                ("x", channel, time, worker_id, payload, ingest_ns, seq),
+                ("x", channel, time, worker_id, payload, ingest_ns, seq,
+                 enq_ns),
                 is_data=True,
             )
             delivered += 1

@@ -122,9 +122,9 @@ class MeshComm(Comm):
         self.inner.async_attach(worker_id, waker)
 
     def async_post_exchange(self, worker_id, channel, time, buckets,
-                            ingest_ns=None, seq=None):
+                            ingest_ns=None, seq=None, enq_ns=None):
         return self.inner.async_post_exchange(
-            worker_id, channel, time, buckets, ingest_ns, seq
+            worker_id, channel, time, buckets, ingest_ns, seq, enq_ns
         )
 
     def async_broadcast(self, worker_id, payload):
@@ -370,9 +370,9 @@ class MultiHostMeshComm(Comm):
         self.inner.async_attach(worker_id, waker)
 
     def async_post_exchange(self, worker_id, channel, time, buckets,
-                            ingest_ns=None, seq=None):
+                            ingest_ns=None, seq=None, enq_ns=None):
         return self.inner.async_post_exchange(
-            worker_id, channel, time, buckets, ingest_ns, seq
+            worker_id, channel, time, buckets, ingest_ns, seq, enq_ns
         )
 
     def async_broadcast(self, worker_id, payload):

@@ -440,6 +440,18 @@ class Supervisor:
                 "last_ticks": [
                     r for r in records if r.get("kind") == "tick"
                 ][-50:],
+                # the in-flight commit wave at death: the last wave-phase
+                # transition stamp ("wave.phase") or completed wave record
+                # ("async.commit", which names the holding worker) —
+                # answers "which wave, which phase, who was it waiting on"
+                "last_wave": next(
+                    (
+                        r for r in reversed(records)
+                        if str(r.get("kind", "")).startswith("wave")
+                        or r.get("kind") == "async.commit"
+                    ),
+                    None,
+                ),
                 "records": records[-400:],
             }
             path = os.path.join(

@@ -127,6 +127,12 @@ class PersistenceManager:
         #: committed frontier (exactly-once sinks — see delivery.py)
         self.delivery: Any = None
         self._closing = False
+        #: per-phase split of the last commit() call, ns — barrier
+        #: (waiting on the previous release's sink acks), snapshot
+        #: (flush + operator snapshots + metadata), release (delivery
+        #: release + drain). Read by the async commit wave to attribute
+        #: its snapshot/release phases (observability/critpath.py).
+        self.last_commit_phase_ns: dict[str, int] | None = None
 
     @staticmethod
     def _resolve_layout(
@@ -322,6 +328,8 @@ class PersistenceManager:
         snapshot; normal commits run AT a boundary, where live is exact)."""
         if not self._recording:
             return
+        t0 = _time.perf_counter_ns()
+        barrier_ns = 0
         delivery = None if self._closing else self.delivery
         if delivery is not None:
             # the previous release must be fully acked before a NEW
@@ -331,6 +339,7 @@ class PersistenceManager:
             # unacked output below every restorable snapshot. A down sink
             # blocks here: that block IS the engine's backpressure.
             delivery.pre_commit_barrier()
+            barrier_ns = _time.perf_counter_ns() - t0
         written = self._writer.flush()
         if written is not None:
             seq, max_t = written
@@ -370,13 +379,22 @@ class PersistenceManager:
         self._safe_offsets = dict(self.offsets)
         self._safe_recorded = 0
         self._safe_time = self.last_time
+        release_ns = 0
         if delivery is not None:
             # input through last_time is durable — release the sink
             # batches it produced and drain them now, so their acks (and
             # the commit-tick cursor heartbeat) land while this commit is
             # the newest: at any later crash, acked >= this commit's
             # predecessor, keeping a restorable snapshot under the floor
+            t_rel = _time.perf_counter_ns()
             delivery.on_commit(self.last_time)
+            release_ns = _time.perf_counter_ns() - t_rel
+        end = _time.perf_counter_ns()
+        self.last_commit_phase_ns = {
+            "barrier": barrier_ns,
+            "snapshot": max(0, end - t0 - barrier_ns - release_ns),
+            "release": release_ns,
+        }
 
     def _snapshot_operators(self, time: int) -> None:
         if self.op_snapshots and int(self.op_snapshots[-1]["time"]) == time:

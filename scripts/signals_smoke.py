@@ -15,7 +15,11 @@ whole signals plane end to end against process 0's merged endpoints:
   EXACTLY once on each process — visible on ``/alerts``, in the trace
   stream, and (after a SIGKILL) in the crash bundle harvested from the
   dead process's flight-recorder ring;
-- ``pathway-tpu top`` renders a live frame without errors.
+- ``pathway-tpu top`` renders a live frame without errors;
+- latency lineage: 90% of rows carry one hot key, so the key-load
+  sketch must rank that key-group first cluster-wide and the commit-wave
+  holder election must attribute the steady-state waves to the worker
+  the hot group routes to (``pathway-tpu critpath`` renders the report).
 
 Usable standalone (``python scripts/signals_smoke.py`` → exit 0/1) and
 as a tier-1 test (``tests/test_signals_smoke.py``).
@@ -37,9 +41,11 @@ import urllib.request
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 _PROGRAM = """
+import os
 import time
 
 import pathway_tpu as pw
+from pathway_tpu.persistence import Backend, Config
 
 
 class S(pw.io.python.ConnectorSubject):
@@ -56,9 +62,22 @@ class S(pw.io.python.ConnectorSubject):
 
 def crawl(x):
     # deliberately slow AND impure: the lifter refuses it, so every row
-    # pays the sleep on the per-row path — the seeded bottleneck
+    # pays the sleep on the per-row path — the seeded bottleneck. The
+    # return value seeds key SKEW too: 90% of rows key to ONE hot value,
+    # so the groupby exchange routes them to one shard.
     time.sleep(0.004)
-    return x + 1
+    return 7 if x % 10 else 100 + (x % 7)
+
+
+def follow(s):
+    # impure (stays per-row) and applied to the REDUCED table: the hot
+    # key's aggregate lives on exactly one worker, so this cost rides the
+    # hot shard only — the seeded straggler the wave holder election and
+    # the key-load sketch must both name. (Each input row drives a
+    # retraction + insertion through the reduce, so the per-row cost is
+    # ~2x the sleep — keep it below crawl's share.)
+    time.sleep(0.001)
+    return s + 0
 
 
 t = pw.io.python.read(
@@ -66,11 +85,18 @@ t = pw.io.python.read(
     autocommit_ms=None,
 )
 slow = t.select(y=pw.apply(crawl, pw.this.x))
-counts = slow.groupby(pw.this.y % 5).reduce(
+counts = slow.groupby(pw.this.y).reduce(
     s=pw.reducers.sum(pw.this.y), n=pw.reducers.count()
 )
-pw.io.subscribe(counts, on_change=lambda **kw: None)
-pw.run(with_http_server=True)
+hot = counts.select(z=pw.apply(follow, pw.this.s))
+pw.io.subscribe(hot, on_change=lambda **kw: None)
+# persistence turns on the async plane's commit waves — the subject of
+# the latency-lineage assertions (no persistence => no waves to observe)
+cfg = Config.simple_config(
+    Backend.filesystem(os.environ["SMOKE_PSTATE"]),
+    snapshot_interval_ms=250,
+)
+pw.run(persistence_config=cfg, with_http_server=True)
 """
 
 #: sustained-threshold rule the run must trip: the slow operator pushes
@@ -142,6 +168,7 @@ def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
         "PATHWAY_SIGNALS_WINDOW_S": "30",
         "PATHWAY_SLO_RULES": json.dumps(SLO_RULES),
         "PATHWAY_FLIGHT_DIR": flight,
+        "SMOKE_PSTATE": os.path.join(tmp, "pstate"),
         "PATHWAY_RUN_ID": run_id,
         "PATHWAY_TRACE_FILE": trace_base,
         # the periodic flusher rewrites the trace file every 0.3 s, so
@@ -212,7 +239,14 @@ def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
         def attribution_ready():
             att = _get_json(base + "/attribution")
             ranked = att.get("ranked", [])
-            return att if ranked and att.get("bottleneck") else None
+            if not ranked or not att.get("bottleneck"):
+                return None
+            # let the window warm up past its first samples: the share
+            # assertion below is about the steady state, not the first
+            # delta after the (persistence-slowed) startup
+            if att.get("total_busy_ms", 0.0) < 1000.0:
+                return None
+            return att
 
         att = _poll(attribution_ready, 30, "attribution ranking")
         top_op = att["ranked"][0]["operator"]
@@ -281,6 +315,86 @@ def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
         assert "pathway_fusion_fused_ops_total" in metrics
         assert "pathway_fusion_fallbacks_total" in metrics
         report["fusion"] = {"chains": int(m.group(1))}
+
+        # -- latency lineage: the merged /query names the seeded straggler.
+        # The key-load sketch must rank the hot key-group first
+        # cluster-wide, and the commit-wave holder election must
+        # attribute the steady-state waves to the worker that hot group
+        # routes to (the straggler paying the follow() cost).
+        def lineage_ready():
+            doc = _get_json(base + "/query")
+            kl = doc.get("keyload") or {}
+            top_groups = kl.get("top") or []
+            waves = (doc.get("waves") or {}).get("recent") or []
+            if not top_groups or len(waves) < 10:
+                return None
+            head = top_groups[0]
+            # 90% of GROUPBY rows carry the hot key, but the sketch
+            # counts every exchange — the uniformly-keyed ingest route
+            # dilutes the cluster share to ~0.45. Demand dominance: a
+            # large absolute share AND an order of magnitude over the
+            # runner-up group.
+            runner_up = (
+                top_groups[1].get("share", 0.0)
+                if len(top_groups) > 1
+                else 0.0
+            )
+            if head.get("share", 0.0) < 0.3:
+                return None
+            if head.get("share", 0.0) < 5.0 * runner_up:
+                return None
+            dests = head.get("dest_rows") or {}
+            if not dests:
+                return None
+            hot_worker = max(dests, key=lambda w: dests[w])
+            tail = waves[-10:]
+            held = [w for w in tail if str(w.get("holder")) == hot_worker]
+            if len(held) < 9:  # >= 90% of the steady-state window
+                return None
+            return {
+                "hot_group": head.get("group"),
+                "hot_share": head.get("share"),
+                "hot_worker": hot_worker,
+                "holder_share": len(held) / len(tail),
+                "waves": len(waves),
+            }
+
+        lineage = _poll(
+            lineage_ready, 60,
+            "hot key-group ranked first and its shard holding >=90% of "
+            "recent commit waves",
+        )
+        report["lineage"] = lineage
+        # the staged ingest->emit decomposition and wave/keyload counters
+        # ride /metrics alongside the single e2e histogram
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            metrics2 = r.read().decode()
+        assert "pathway_ingest_to_emit_stage_seconds" in metrics2
+        assert "pathway_waves_total" in metrics2
+        assert "pathway_wave_stage_seconds_total" in metrics2
+        assert "pathway_key_group_share" in metrics2
+
+        # -- pathway-tpu critpath renders the top-K wave report
+        cp = subprocess.run(
+            [
+                sys.executable, "-m", "pathway_tpu.cli", "critpath",
+                "--url", base + "/query", "-k", "5",
+            ],
+            env={**env, "PATHWAY_PROCESSES": "1"},
+            timeout=60, capture_output=True, text=True,
+        )
+        assert cp.returncode == 0, (
+            f"critpath exited {cp.returncode}\n"
+            f"stderr:\n{cp.stderr[-2000:]}"
+        )
+        assert "slowest waves" in cp.stdout, cp.stdout
+        # the straggler shows up either among the slowest waves' holders
+        # or leading the cumulative holder tally
+        assert (
+            f"holder=w{lineage['hot_worker']}" in cp.stdout
+            or f"w{lineage['hot_worker']}:" in cp.stdout.splitlines()[0]
+        ), cp.stdout
+        report["critpath"] = {"lines": cp.stdout.count("\n")}
 
         # -- pathway-tpu top renders a live frame without errors
         top = subprocess.run(

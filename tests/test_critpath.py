@@ -1,0 +1,331 @@
+"""Commit-wave critical-path attribution (observability/critpath.py):
+holding-worker election, stage-split math, the bounded wave history
+ring, the process/cluster merges, the report renderer, and the staged
+ingest->emit decomposition the executor feeds from the same stamps."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from pathway_tpu.observability.critpath import (
+    PHASES,
+    WaveRecorder,
+    attribute_holder,
+    elect_holder,
+    merge_process_waves,
+    merge_worker_waves,
+    render_report,
+    stage_split,
+)
+
+
+def _phases(**kw) -> dict:
+    out = {p: 0.0 for p in PHASES}
+    out.update(kw)
+    return out
+
+
+def _wave(recorder, epoch, *, holder=2, dur=10.0, **phase_kw):
+    order = [(recorder.worker_id, epoch, 0), (holder, epoch, 5)]
+    return recorder.record_wave(
+        epoch=epoch,
+        T=epoch,
+        t=1000.0 + epoch,
+        duration_ms=dur,
+        interval_ms=50.0,
+        phases_ms=_phases(**phase_kw),
+        settle_rounds=1,
+        ready_order=order,
+    )
+
+
+# -- holding-worker election -------------------------------------------------
+
+
+def test_elect_holder_last_arrival_wins():
+    # worker 3 arrives in the last drain batch: it held the wave
+    order = [(0, 10, 0), (1, 10, 1), (3, 10, 4), (2, 10, 2)]
+    assert elect_holder(order) == 3
+
+
+def test_elect_holder_tie_breaks_by_ready_clock_then_worker_id():
+    # same arrival seq (one drain batch): the larger ready clock forced
+    # T higher, so it held the wave longer
+    assert elect_holder([(0, 10, 0), (1, 12, 3), (2, 15, 3)]) == 2
+    # full tie: smaller worker id, so every worker elects the same one
+    assert elect_holder([(0, 10, 0), (2, 15, 3), (1, 15, 3)]) == 1
+
+
+def test_elect_holder_empty_order():
+    assert elect_holder([]) is None
+
+
+def test_attribute_holder_real_straggler_elected_by_arrival():
+    # entry spread 80ms >= floor: the last frontier to arrive holds the
+    # wave even though another worker burned more busy time
+    order = [(0, 10, 100.0), (1, 10, 100.08)]
+    holder, by = attribute_holder(
+        order, busy_ms={0: 200.0, 1: 5.0}, floor_ms=25.0
+    )
+    assert (holder, by) == (1, "arrival")
+
+
+def test_attribute_holder_jitter_falls_back_to_busy():
+    # entries within 2ms of each other (timer-driven wave): arrival
+    # order is scheduler noise, the busiest pipeline holds the wave
+    order = [(0, 10, 100.001), (1, 10, 100.0)]
+    holder, by = attribute_holder(
+        order, busy_ms={0: 140.0, 1: 60.0}, floor_ms=25.0
+    )
+    assert (holder, by) == (0, "busy")
+
+
+def test_attribute_holder_without_busy_data_keeps_arrival_verdict():
+    order = [(0, 10, 100.001), (1, 10, 100.0)]
+    assert attribute_holder(order, None, 25.0) == (0, "arrival")
+    assert attribute_holder([], {0: 1.0}) == (None, "arrival")
+
+
+# -- stage split -------------------------------------------------------------
+
+
+def test_stage_split_names_largest_phase_and_shares_sum_to_one():
+    critical, shares = stage_split(
+        _phases(sweep=2.0, frontier_wait=6.0, settle=2.0)
+    )
+    assert critical == "frontier_wait"
+    assert shares["frontier_wait"] == pytest.approx(0.6)
+    assert sum(shares.values()) == pytest.approx(1.0)
+
+
+def test_stage_split_ties_break_in_phase_order():
+    # sweep precedes settle in PHASES: deterministic verdict on a tie
+    critical, _ = stage_split(_phases(sweep=3.0, settle=3.0))
+    assert critical == "sweep"
+
+
+def test_stage_split_nothing_measured():
+    critical, shares = stage_split(_phases())
+    assert critical is None
+    assert all(s == 0.0 for s in shares.values())
+
+
+def test_stage_split_ignores_negative_phases():
+    critical, shares = stage_split(_phases(settle=-5.0, release=1.0))
+    assert critical == "release"
+    assert shares["settle"] == 0.0
+
+
+# -- per-worker recorder -----------------------------------------------------
+
+
+def test_wave_recorder_ring_is_bounded_and_tallies_holders():
+    rec = WaveRecorder(0, history=4)
+    for ep in range(10):
+        _wave(rec, ep, holder=ep % 2)
+    assert len(rec.recent) == 4
+    assert [d["epoch"] for d in rec.recent] == [6, 7, 8, 9]
+    assert rec.held_total == {"0": 5, "1": 5}
+    snap = rec.snapshot()
+    assert snap["last"]["epoch"] == 9
+    assert snap["worker"] == 0
+
+
+def test_wave_recorder_document_shape():
+    rec = WaveRecorder(1, history=8)
+    doc = _wave(rec, 3, holder=2, dur=12.5, frontier_wait=9.0, sweep=3.0)
+    assert doc["holder"] == 2
+    assert doc["critical_stage"] == "frontier_wait"
+    assert doc["duration_ms"] == 12.5
+    assert set(doc["phases_ms"]) == set(PHASES)
+    assert doc["ready_order"][-1] == (2, 3, 5)
+    assert "fin" not in doc
+
+
+def test_wave_recorder_marks_fin_wave():
+    rec = WaveRecorder(0, history=2)
+    doc = rec.record_wave(
+        epoch=9, T=9, t=1.0, duration_ms=1.0, interval_ms=1.0,
+        phases_ms=_phases(snapshot=1.0), settle_rounds=0,
+        ready_order=[(0, 9, 0)], fin=True,
+    )
+    assert doc["fin"] is True
+
+
+def test_wave_recorder_history_env_knob(monkeypatch):
+    monkeypatch.setenv("PATHWAY_WAVE_HISTORY", "3")
+    rec = WaveRecorder(0)
+    assert rec.recent.maxlen == 3
+
+
+# -- process merge (per-worker snapshots -> /query waves doc) ----------------
+
+
+def _two_worker_snaps(holder_a=2, holder_b=2):
+    a, b = WaveRecorder(0, history=8), WaveRecorder(2, history=8)
+    _wave(a, 1, holder=holder_a, dur=10.0, frontier_wait=8.0)
+    _wave(b, 1, holder=holder_b, dur=14.0, settle=12.0)
+    return {"0": a.snapshot(), "2": b.snapshot()}
+
+
+def test_merge_worker_waves_unanimous_holder_and_max_phases():
+    doc = merge_worker_waves(_two_worker_snaps())
+    assert doc["waves"] == 1
+    wave = doc["recent"][0]
+    assert wave["holder"] == 2 and wave["agreed"] is True
+    # per-stage max over the workers' views; critical recomputed from it
+    assert wave["critical_stage"] == "settle"
+    assert wave["duration_ms"] == 14.0
+    assert set(wave["workers"]) == {"0", "2"}
+    assert doc["holder_share"] == {"2": 1.0}
+
+
+def test_merge_worker_waves_disputed_holder_breaks_to_smaller_id():
+    doc = merge_worker_waves(_two_worker_snaps(holder_a=3, holder_b=1))
+    wave = doc["recent"][0]
+    assert wave["agreed"] is False
+    assert wave["holder"] == 1  # 1-1 vote: smaller worker id wins
+
+
+def test_merge_worker_waves_skips_missing_snapshots():
+    snaps = _two_worker_snaps()
+    snaps["5"] = None
+    doc = merge_worker_waves(snaps)
+    assert doc["waves"] == 1
+
+
+# -- cluster merge (process docs -> merged /query doc) -----------------------
+
+
+def test_merge_process_waves_unions_workers_and_reelects():
+    p0 = merge_worker_waves(_two_worker_snaps())
+    c = WaveRecorder(4, history=8)
+    _wave(c, 1, holder=4, dur=20.0, frontier_wait=18.0)
+    _wave(c, 2, holder=4, dur=5.0, release=4.0)
+    p1 = merge_worker_waves({"4": c.snapshot()})
+    merged = merge_process_waves([p0, p1])
+    assert merged["waves"] == 2
+    wave1 = merged["recent"][0]
+    assert set(wave1["workers"]) == {"0", "2", "4"}
+    # 2 votes for w2, 1 for w4 over the union of verdicts
+    assert wave1["holder"] == 2 and wave1["agreed"] is False
+    # the slowest view's duration and split win
+    assert wave1["duration_ms"] == 20.0
+    assert merged["recent"][1]["holder"] == 4
+    assert merged["held_total"] == {"2": 2, "4": 2}
+
+
+def test_merge_process_waves_output_remerges():
+    # the cluster doc has the same shape as a process doc, so merging
+    # merges == merging the originals (re-merge associativity)
+    p0 = merge_worker_waves(_two_worker_snaps())
+    c = WaveRecorder(4, history=8)
+    _wave(c, 1, holder=4, dur=20.0, frontier_wait=18.0)
+    p1 = merge_worker_waves({"4": c.snapshot()})
+    once = merge_process_waves([p0, p1])
+    twice = merge_process_waves([merge_process_waves([p0]), p1])
+    assert twice["recent"][0]["workers"] == once["recent"][0]["workers"]
+    assert twice["recent"][0]["holder"] == once["recent"][0]["holder"]
+    assert twice["held_total"] == once["held_total"]
+
+
+def test_merge_process_waves_empty_inputs():
+    doc = merge_process_waves([None, None])
+    assert doc["waves"] == 0 and doc["last"] is None
+
+
+# -- report ------------------------------------------------------------------
+
+
+def test_render_report_ranks_slowest_and_names_holder():
+    rec = WaveRecorder(0, history=16)
+    for ep in range(6):
+        _wave(rec, ep, holder=3, dur=float(ep), frontier_wait=float(ep))
+    _wave(rec, 9, holder=1, dur=99.0, settle=90.0)
+    doc = merge_worker_waves({"0": rec.snapshot()})
+    report = render_report(doc, top_k=3)
+    lines = report.splitlines()
+    assert "wave 9" in lines[2] and "holder=w1" in lines[2]
+    assert "critical=settle" in lines[2]
+    assert len([ln for ln in lines if ln.startswith("  wave")]) == 3
+
+
+def test_render_report_handles_empty_doc():
+    assert "no commit waves" in render_report(None)
+    assert "no commit waves" in render_report(merge_process_waves([]))
+
+
+# -- staged ingest->emit decomposition (EngineStats.note_e2e) ----------------
+
+
+def test_note_e2e_stages_sum_to_total_latency():
+    from pathway_tpu.engine.executor import E2E_STAGES, EngineStats
+
+    stats = EngineStats()
+    now = time.time_ns()
+    ingest = now - 100_000_000  # 100 ms ago
+    stats.note_e2e(
+        ingest, route_ns=10_000_000, dwell_ns=20_000_000,
+        sweep_t0_wall_ns=now - 5_000_000,
+    )
+    assert stats.e2e_latency_hist._count == 1
+    total = stats.e2e_latency_hist._sum
+    staged = sum(stats.stage_hists[s]._sum for s in E2E_STAGES)
+    assert staged == total
+    assert stats.stage_hists["ingest_route"]._sum == 10_000_000
+    assert stats.stage_hists["inbox_dwell"]._sum == 20_000_000
+    assert stats.stage_hists["commit_deliver"]._sum >= 5_000_000
+
+
+def test_note_e2e_clamps_stages_against_total():
+    from pathway_tpu.engine.executor import E2E_STAGES, EngineStats
+
+    stats = EngineStats()
+    # claimed route latency exceeds the whole e2e: clamp, never negative
+    stats.note_e2e(time.time_ns() - 1_000_000, route_ns=10_000_000_000)
+    total = stats.e2e_latency_hist._sum
+    staged = sum(stats.stage_hists[s]._sum for s in E2E_STAGES)
+    assert staged == total
+    assert all(stats.stage_hists[s]._sum >= 0 for s in E2E_STAGES)
+
+
+def test_note_wave_folds_doc_into_counters():
+    from pathway_tpu.engine.executor import EngineStats
+
+    stats = EngineStats()
+    rec = WaveRecorder(0, history=4)
+    doc = _wave(rec, 1, holder=2, dur=10.0, frontier_wait=8.0, sweep=2.0)
+    stats.note_wave(doc, 10_000_000)
+    stats.note_wave(doc, 12_000_000)
+    assert stats.waves_total == 2
+    assert stats.wave_held_total == {"2": 2}
+    assert stats.wave_stage_ns["frontier_wait"] == 16_000_000
+    assert stats.wave_duration._count == 2
+
+
+# -- offline trace view ------------------------------------------------------
+
+
+def test_wave_spans_ranks_merged_trace_commit_spans():
+    from pathway_tpu.observability.trace_merge import wave_spans
+
+    doc = {
+        "traceEvents": [
+            {"name": "wave.commit", "ph": "X", "pid": 0, "ts": 10.0,
+             "dur": 5000.0, "args": {"epoch": 1, "T": 1, "holder": 2,
+                                     "critical": "settle"}},
+            {"name": "wave.commit", "ph": "X", "pid": 1, "ts": 20.0,
+             "dur": 9000.0, "args": {"epoch": 2, "T": 2, "holder": 3,
+                                     "critical": "frontier_wait"}},
+            {"name": "wave.settle", "ph": "X", "pid": 0, "ts": 11.0,
+             "dur": 100.0, "args": {}},
+            {"name": "process_name", "ph": "M", "pid": 0},
+        ]
+    }
+    spans = wave_spans(doc, top_k=5)
+    assert [s["epoch"] for s in spans] == [2, 1]
+    assert spans[0]["holder"] == 3 and spans[0]["dur_ms"] == 9.0
+    assert spans[0]["critical"] == "frontier_wait"
+    assert wave_spans({"traceEvents": []}) == []

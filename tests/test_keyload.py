@@ -1,0 +1,256 @@
+"""Heavy-hitter key-load accounting (observability/keyload.py): the
+SpaceSaving sketch's error bounds at capacity, merge associativity,
+decay/window semantics, the per-worker account fed by Exchange routing,
+and the cluster merge + skew rendering."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pathway_tpu.observability.keyload import (
+    KeyLoadAccount,
+    SpaceSaving,
+    maybe_account,
+    merge_snapshots,
+    skew_line,
+)
+
+
+# -- sketch: bounds at capacity ----------------------------------------------
+
+
+def _zipf_stream(n_keys=40, reps=None):
+    """Deterministic skewed stream: key k appears reps[k] times."""
+    if reps is None:
+        reps = [max(1, 400 // (k + 1)) for k in range(n_keys)]
+    stream = []
+    for k, r in enumerate(reps):
+        stream.extend([k] * r)
+    # deterministic interleave so eviction pressure is realistic
+    stream.sort(key=lambda k: (hash((k, len(stream))) % 7, k))
+    return stream, dict(enumerate(reps))
+
+
+def test_spacesaving_exact_under_capacity():
+    sk = SpaceSaving(capacity=16)
+    for k in [1, 2, 2, 3, 3, 3]:
+        sk.observe(k)
+    assert sk.estimate(3) == (3.0, 0.0)
+    assert sk.estimate(99) == (0.0, 0.0)  # room left: untracked == unseen
+    assert sk.total == 6.0
+    assert [k for k, _c, _e in sk.items()][0] == 3
+
+
+def test_spacesaving_error_bounds_at_capacity():
+    stream, truth = _zipf_stream(n_keys=40)
+    sk = SpaceSaving(capacity=8)
+    for k in stream:
+        sk.observe(k)
+    n = len(stream)
+    assert sk.total == n
+    assert sk.error_bound() == pytest.approx(n / 8)
+    for key, count, err in sk.items():
+        true = truth[key]
+        # the classic SpaceSaving guarantee per tracked key
+        assert true <= count <= true + err
+        assert err <= sk.error_bound()
+    # the heaviest key must survive eviction (it dominates the floor)
+    assert sk.estimate(0)[0] >= truth[0]
+
+
+def test_spacesaving_heaviest_key_ranks_first():
+    stream, _ = _zipf_stream(n_keys=30)
+    sk = SpaceSaving(capacity=6)
+    for k in stream:
+        sk.observe(k)
+    assert sk.items()[0][0] == 0  # key 0 carries ~400 of ~1000 rows
+
+
+def test_spacesaving_rejects_bad_inputs():
+    with pytest.raises(ValueError):
+        SpaceSaving(capacity=0)
+    sk = SpaceSaving(capacity=2)
+    sk.observe("k", 0.0)  # non-positive weight: ignored
+    assert sk.total == 0.0
+    with pytest.raises(ValueError):
+        sk.decay(1.5)
+
+
+# -- sketch: merge -----------------------------------------------------------
+
+
+def test_merge_exact_and_associative_when_union_fits():
+    def build(keys):
+        sk = SpaceSaving(capacity=32)
+        for k in keys:
+            sk.observe(k)
+        return sk
+
+    a, b, c = build([1, 1, 2]), build([2, 3]), build([3, 3, 3, 4])
+    left = a.merge(b).merge(c)
+    right = a.merge(b.merge(c))
+    want = {1: 2.0, 2: 2.0, 3: 4.0, 4: 1.0}
+    for sk in (left, right):
+        assert sk.total == 9.0
+        assert {k: v for k, v, _e in sk.items()} == want
+        assert all(e == 0.0 for _k, _c, e in sk.items())
+
+
+def test_merge_over_capacity_keeps_epsilon_bound():
+    stream, truth = _zipf_stream(n_keys=40)
+    half = len(stream) // 2
+    a, b = SpaceSaving(capacity=8), SpaceSaving(capacity=8)
+    for k in stream[:half]:
+        a.observe(k)
+    for k in stream[half:]:
+        b.observe(k)
+    m = a.merge(b)
+    assert m.capacity == 8 and len(m) <= 8
+    assert m.total == len(stream)
+    for key, count, err in m.items():
+        assert truth[key] <= count
+        assert count - err <= truth[key]
+
+
+def test_sketch_snapshot_roundtrip():
+    sk = SpaceSaving(capacity=4)
+    for k in [7, 7, 8]:
+        sk.observe(k)
+    back = SpaceSaving.from_snapshot(sk.snapshot())
+    assert back.total == sk.total
+    assert back.estimate("7")[0] == 2.0  # wire form stringifies keys
+
+
+# -- sketch: decay window ----------------------------------------------------
+
+
+def test_decay_halves_counts_and_total():
+    sk = SpaceSaving(capacity=4)
+    for _ in range(8):
+        sk.observe("hot")
+    sk.decay(0.5)
+    assert sk.estimate("hot")[0] == 4.0
+    assert sk.total == 4.0
+    # new observations then dominate the old window
+    for _ in range(6):
+        sk.observe("new")
+    assert sk.items()[0][0] == "new"
+
+
+# -- per-worker account ------------------------------------------------------
+
+
+def _routed_batch(n_hot=90, n_cold=10, n_groups=8, n_workers=4):
+    """route_keys biased so one key-group dominates."""
+    from pathway_tpu.engine import keys as K
+
+    rk = np.concatenate([
+        np.full(n_hot, 12345, dtype=np.uint64),
+        np.arange(n_cold, dtype=np.uint64) * 7919 + 1,
+    ])
+    shards = K.shard_of(rk, n_workers)
+    return rk, shards
+
+
+def test_account_observes_exchange_batches():
+    from pathway_tpu.engine import keys as K
+
+    acct = KeyLoadAccount(capacity=8, n_groups=8)
+    rk, shards = _routed_batch()
+    acct.observe_exchange(rk, shards, nbytes=800)
+    acct.observe_exchange(rk, shards, nbytes=800)
+    assert acct.rows_total == 200 and acct.batches == 2
+    assert acct.bytes_total == 1600
+    snap = acct.snapshot()
+    hot_group = int(K.shard_of(np.array([12345], dtype=np.uint64), 8)[0])
+    assert snap["top"][0]["group"] == hot_group
+    assert snap["top"][0]["rows"] >= 180
+    # the hot key maps to ONE destination; its dest split must show it
+    hot_dest = str(int(shards[0]))
+    assert snap["top"][0]["dest_rows"].get(hot_dest, 0) >= 180
+
+
+def test_account_empty_batch_is_noop():
+    acct = KeyLoadAccount(capacity=4, n_groups=4)
+    acct.observe_exchange(
+        np.array([], dtype=np.uint64), np.array([], dtype=np.int64)
+    )
+    assert acct.rows_total == 0 and acct.batches == 0
+
+
+def test_account_decay_uses_injected_clock():
+    acct = KeyLoadAccount(capacity=4, n_groups=4, decay_s=10.0)
+    rk, shards = _routed_batch(n_hot=40, n_cold=0)
+    acct.observe_exchange(rk, shards, now=100.0)
+    before = acct.sketch.total
+    acct.observe_exchange(rk, shards, now=110.5)  # one interval elapsed
+    assert acct.sketch.total == pytest.approx(before * 0.5 + 40)
+
+
+def test_account_dest_rows_stay_bounded():
+    acct = KeyLoadAccount(capacity=4, n_groups=4096)
+    rng = np.random.default_rng(7)
+    for _ in range(30):
+        rk = rng.integers(0, 2**62, size=50, dtype=np.uint64)
+        from pathway_tpu.engine import keys as K
+
+        acct.observe_exchange(rk, K.shard_of(rk, 4))
+    assert len(acct.dest_rows) <= 2 * acct.capacity
+
+
+def test_maybe_account_honors_kill_switch(monkeypatch):
+    monkeypatch.setenv("PATHWAY_KEYLOAD", "0")
+    assert maybe_account() is None
+    monkeypatch.setenv("PATHWAY_KEYLOAD", "1")
+    assert maybe_account() is not None
+
+
+# -- cluster merge + rendering -----------------------------------------------
+
+
+def _snap_for(hot_group, rows, dest, n_groups=8):
+    acct = KeyLoadAccount(capacity=8, n_groups=n_groups)
+    acct.rows_total = rows
+    acct.batches = 1
+    acct.sketch.observe(hot_group, rows * 0.9)
+    acct.sketch.observe((hot_group + 1) % n_groups, rows * 0.1)
+    acct.dest_rows[hot_group] = {dest: int(rows * 0.9)}
+    return acct.snapshot()
+
+
+def test_merge_snapshots_ranks_cluster_wide():
+    merged = merge_snapshots(
+        [_snap_for(3, 100, 1), _snap_for(3, 300, 1), None]
+    )
+    assert merged["rows_total"] == 400
+    assert str(merged["top"][0]["group"]) == "3"
+    assert merged["top"][0]["share"] == pytest.approx(0.9)
+    assert merged["skew"] == pytest.approx(0.9 * 8, rel=0.01)
+    assert merged["top"][0]["dest_rows"]["1"] == 360
+
+
+def test_merge_snapshots_output_remerges():
+    # the merged doc keeps a sketch wire form, so process-level merges
+    # re-merge into the cluster roll-up without losing counts
+    a, b, c = _snap_for(2, 100, 0), _snap_for(2, 200, 0), _snap_for(5, 50, 3)
+    once = merge_snapshots([a, b, c])
+    twice = merge_snapshots([merge_snapshots([a, b]), c])
+    assert twice["rows_total"] == once["rows_total"]
+    assert [e["group"] for e in twice["top"]] == [
+        e["group"] for e in once["top"]
+    ]
+    assert twice["top"][0]["rows"] == once["top"][0]["rows"]
+
+
+def test_merge_snapshots_empty():
+    assert merge_snapshots([]) is None
+    assert merge_snapshots([None]) is None
+
+
+def test_skew_line_names_hot_group_and_destination():
+    line = skew_line(merge_snapshots([_snap_for(3, 1000, 2)]))
+    assert line is not None
+    assert "group 3" in line and "->w2" in line and "90.0%" in line
+    assert skew_line(None) is None
+    assert skew_line({"top": []}) is None

@@ -323,6 +323,44 @@ def test_sampler_records_engine_and_comm_series():
     assert plane.samples_taken == 2
 
 
+def test_sampler_records_wave_and_keyload_series_without_starving_ops():
+    # regression: the wave/keyload sampling block runs BEFORE the
+    # op_time series record; an exception there silently killed the
+    # whole sample (and with it /attribution) on every persisted run
+    import numpy as np
+
+    from pathway_tpu.engine import keys as K
+    from pathway_tpu.observability.critpath import WaveRecorder
+    from pathway_tpu.observability.keyload import KeyLoadAccount
+
+    hub, stats, plane = _hub_with_plane()
+    stats._waves = WaveRecorder(0, history=4)
+    doc = stats._waves.record_wave(
+        epoch=1, T=2, t=T0, duration_ms=8.0, interval_ms=250.0,
+        phases_ms={"sweep": 6.0, "settle": 2.0}, settle_rounds=2,
+        ready_order=[(0, 2, 100.0)], busy_ms={0: 6.0},
+    )
+    stats.note_wave(doc, 8_000_000)
+    stats.keyload = KeyLoadAccount(capacity=8, n_groups=8)
+    rk = np.full(20, 12345, dtype=np.uint64)
+    stats.keyload.observe_exchange(rk, K.shard_of(rk, 2))
+    stats.note_node_time(type("N", (), {"node_id": 7})(), 5_000_000)
+    plane.sample_once(t=T0)
+    sig = plane.signals
+    assert sig.last("wave.total", 0) == 1.0
+    assert sig.last("wave.stage_sweep_s", 0) == pytest.approx(6e-3)
+    assert sig.last("wave.last_duration_ms", 0) == 8.0
+    assert sig.last("wave.last_holder", 0) == 0.0
+    assert sig.last("keyload.rows_total", 0) == 20.0
+    assert sig.last("keyload.top_share", 0) == pytest.approx(1.0)
+    assert sig.last("keyload.skew", 0) == pytest.approx(8.0)
+    # the op series AFTER the wave/keyload block still landed
+    assert any(
+        m.startswith("op_time_ns:N#7") for m in plane.store.metrics(0)
+    )
+    assert plane.samples_taken == 1
+
+
 def test_query_document_and_eval():
     hub, stats, plane = _hub_with_plane()
     stats.ticks = 5
@@ -708,6 +746,64 @@ def test_query_merge_marks_cached_peer_scrape_as_stale(monkeypatch):
     d = Decider(cfg)
     assert d.observe(doc, 1, doc["t"]) is None
     assert d.refusals == 1
+
+
+def test_query_merge_serves_dead_peer_wave_doc_from_cache(monkeypatch):
+    """A dead peer's commit-wave and key-load documents keep riding the
+    merged /query from its last good scrape — the latency-lineage view
+    must never silently drop a worker's wave phases (the dead worker is
+    exactly the one whose phases explain the stall), only stale-mark
+    them like every other cached series."""
+    from pathway_tpu.observability.hub import ObservabilityHub
+
+    hub, stats, plane = _hub_with_plane()
+    hub.peer_http = [("127.0.0.1", 1)]
+    plane.sample_once(t=T0)
+    phases = {"sweep": 2.0, "inbox_dwell": 1.0, "frontier_wait": 6.0,
+              "settle": 2.0, "snapshot": 0.5, "release": 0.5}
+    wave = {
+        "epoch": 3, "T": 7, "t": T0, "duration_ms": 12.0,
+        "holder": 1, "agreed": True, "critical_stage": "frontier_wait",
+        "shares": {}, "settle_rounds": 1,
+        "workers": {"1": {"duration_ms": 12.0, "phases_ms": phases,
+                          "critical_stage": "frontier_wait", "holder": 1}},
+    }
+    peer_doc = {
+        "process_id": 1,
+        "workers": {"1": {"tick_rate": 3.0}},
+        "alerts": {"active": [], "history": [], "fired_total": {}},
+        "waves": {"waves": 1, "recent": [wave], "held_total": {"1": 1},
+                  "holder_share": {"1": 1.0}, "last": wave},
+        "keyload": {
+            "groups": 8, "capacity": 8, "rows_total": 100,
+            "bytes_total": 0, "batches": 1, "error_bound": 12.5,
+            "top": [{"group": 3, "rows": 90.0, "err": 0.0, "share": 0.9,
+                     "bytes_est": 0, "dest_rows": {"1": 90}}],
+            "sketch": {"capacity": 8, "total": 100.0,
+                       "counts": {"3": 90.0, "1": 10.0}, "errs": {}},
+        },
+    }
+    alive = {"up": True}
+    monkeypatch.setattr(
+        ObservabilityHub, "_scrape_peer_path",
+        staticmethod(
+            lambda host, port, path: peer_doc if alive["up"] else None
+        ),
+    )
+    doc = hub.query_document()
+    assert doc["waves"]["recent"][0]["workers"]["1"]["phases_ms"] == phases
+    assert doc["keyload"]["rows_total"] == 100
+
+    alive["up"] = False
+    doc = hub.query_document()
+    # stale-marked like every cached series, but the lineage survives
+    assert set(doc["stale_workers"]) == {"1"}
+    merged_wave = doc["waves"]["recent"][0]
+    assert merged_wave["workers"]["1"]["phases_ms"] == phases
+    assert merged_wave["holder"] == 1
+    assert doc["waves"]["held_total"] == {"1": 1}
+    assert doc["keyload"]["rows_total"] == 100
+    assert str(doc["keyload"]["top"][0]["group"]) == "3"
 
 
 def test_query_merge_flags_never_scraped_peer(monkeypatch):

@@ -26,3 +26,5 @@ def test_signals_smoke(tmp_path):
     assert result["alerts"]["fired"] == 1
     assert result["bundle"]["alerts"] >= 1
     assert result["trace"]["alert_events"] >= 1
+    assert result["lineage"]["hot_share"] >= 0.3
+    assert result["lineage"]["holder_share"] >= 0.9
