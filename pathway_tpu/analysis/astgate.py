@@ -38,6 +38,7 @@ __all__ = [
     "gate",
     "gates",
     "latency_lineage_gate",
+    "upgrade_metrics_gate",
     "import_aliases",
     "iter_py_files",
     "metrics_surface_gate",
@@ -317,7 +318,7 @@ def _reachable_methods(methods: dict, start: str) -> set[str]:
 
 def declared_phase_vocab() -> dict[str, tuple[str, ...]]:
     """site -> phase tuple, read from chaos/plan.py source (RESCALE_PHASES
-    / AUTOSCALE_PHASES feeding _PHASES_BY_SITE)."""
+    / AUTOSCALE_PHASES / UPGRADE_PHASES feeding _PHASES_BY_SITE)."""
     tree = parse_file(os.path.join(PACKAGE_DIR, "chaos", "plan.py"))
     consts: dict[str, tuple] = {}
     for node in tree.body:
@@ -325,11 +326,13 @@ def declared_phase_vocab() -> dict[str, tuple[str, ...]]:
             isinstance(node.targets[0], ast.Name)
         ):
             name = node.targets[0].id
-            if name in ("RESCALE_PHASES", "AUTOSCALE_PHASES"):
+            if name in ("RESCALE_PHASES", "AUTOSCALE_PHASES",
+                        "UPGRADE_PHASES"):
                 consts[name] = tuple(ast.literal_eval(node.value))
     return {
         "rescale": consts.get("RESCALE_PHASES", ()),
         "autoscale": consts.get("AUTOSCALE_PHASES", ()),
+        "upgrade": consts.get("UPGRADE_PHASES", ()),
     }
 
 
@@ -349,10 +352,10 @@ def async_chaos_sites_gate() -> list[str]:
     - both async sweep shapes (source rounds AND the commit-wave settle)
       must go through ``_tick`` — a settle path with its own sweep would
       silently skip the tick site;
-    - every declared rescale/autoscale phase must still appear as a
-      literal ``fire("<phase>")`` call site in its owning module (those
-      fire from the resharder/controller, which the async executor's
-      drain/commit protocol drives).
+    - every declared rescale/autoscale/upgrade phase must still appear
+      as a literal ``fire("<phase>")`` call site in its owning module
+      (those fire from the resharder/controller/migrator, which the
+      async executor's drain/commit protocol drives).
     """
     problems: list[str] = []
     tree = parse_file(os.path.join(PACKAGE_DIR, "engine", "executor.py"))
@@ -389,6 +392,7 @@ def async_chaos_sites_gate() -> list[str]:
     owners = {
         "rescale": os.path.join(PACKAGE_DIR, "rescale"),
         "autoscale": os.path.join(PACKAGE_DIR, "autoscale"),
+        "upgrade": os.path.join(PACKAGE_DIR, "upgrade"),
     }
     for site, phases in declared_phase_vocab().items():
         fired: set[str] = set()
@@ -624,6 +628,58 @@ def fusion_metrics_gate() -> list[str]:
             problems.append(
                 f"FUSION_STATS key {key!r} is not *_total — it would "
                 "render as a gauge; rename it or extend the renderer"
+            )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# gate: upgrade migration counters reach the hub and /metrics
+# ---------------------------------------------------------------------------
+
+
+@gate(
+    "upgrade_metrics",
+    "graph-version upgrade counters ship in the hub snapshot and render "
+    "as pathway_upgrade_* on /metrics",
+)
+def upgrade_metrics_gate() -> list[str]:
+    """A migration that succeeds invisibly is indistinguishable from one
+    that never ran: the migrator's ``_STATS`` must flow through the hub
+    supervisor document and out the prometheus renderer, per verb."""
+    problems: list[str] = []
+    mig_src = read_text(
+        os.path.join(PACKAGE_DIR, "upgrade", "migrator.py")
+    )
+    hub_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "hub.py")
+    )
+    prom_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "prometheus.py")
+    )
+    if "_STATS" not in mig_src:
+        return ["upgrade/migrator.py declares no _STATS counters"]
+    for key in ('"upgrades"', '"upgrade_duration_s"',
+                '"upgrade_operators"'):
+        if key not in hub_src:
+            problems.append(
+                f"observability/hub.py never ships the {key} key — "
+                "migration outcomes never leave the supervisor"
+            )
+    for marker in ("pathway_upgrade_total",
+                   "pathway_upgrade_duration_seconds",
+                   "pathway_upgrade_operators_total"):
+        if marker not in prom_src:
+            problems.append(
+                f"observability/prometheus.py never renders {marker} — "
+                "the migration counters silently vanish from /metrics"
+            )
+    # every classification verb the planner can emit must be a labelled
+    # series, or operators disappear from the per-verb breakdown
+    for verb in ("carried", "remapped", "new", "dropped"):
+        if f'"{verb}"' not in mig_src:
+            problems.append(
+                f"upgrade/migrator.py _STATS no longer tracks verb "
+                f"{verb!r} — the per-verb operator breakdown is partial"
             )
     return problems
 

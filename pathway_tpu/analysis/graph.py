@@ -120,11 +120,14 @@ def _const_repr(c: Any) -> str:
 
 def _code_fp(code: Any, h: "hashlib._Hash") -> None:
     """Fold a code object into the hash, identity-free: raw bytecode +
-    names + canonicalized non-code constants (nested code objects recurse
-    — their repr embeds a memory address and must never be hashed)."""
+    global/attribute names + canonicalized non-code constants (nested
+    code objects recurse — their repr embeds a memory address and must
+    never be hashed). Local variable names (``co_varnames``) are
+    deliberately NOT hashed: bytecode addresses locals by slot, so a
+    pure rename is semantically invisible — and graph-version migration
+    relies on renames not moving fingerprints."""
     h.update(code.co_code)
     h.update(repr(code.co_names).encode())
-    h.update(repr(code.co_varnames).encode())
     for const in code.co_consts:
         if hasattr(const, "co_code"):
             _code_fp(const, h)
@@ -195,6 +198,13 @@ def fingerprint_nodes(nodes: list[Node]) -> dict[int, str]:
     order = _topological(nodes)
     fps: dict[int, str] = {}
     for node in order:
+        if getattr(node, "FINGERPRINT_TRANSPARENT", False) and node.inputs:
+            # Exchange: sharding inserts it, offline lowering doesn't —
+            # pass the input's fingerprint through so the manifests a
+            # live sharded run and an unsharded `upgrade --plan` compile
+            # write agree bit-for-bit
+            fps[id(node)] = fps[id(node.inputs[0])]
+            continue
         h = hashlib.sha256()
         h.update(type(node).__name__.encode())
         h.update(repr(tuple(node.column_names)).encode())

@@ -181,6 +181,13 @@ class ActiveFaults:
         ]
         return AutoscaleFaults(self, matches) if matches else None
 
+    def upgrade_faults(self) -> "UpgradeFaults | None":
+        matches = [
+            (i, f) for i, f in enumerate(self.plan.faults)
+            if f.site == "upgrade"
+        ]
+        return UpgradeFaults(self, matches) if matches else None
+
     def sink_faults(self, worker_id: int) -> "SinkFaults | None":
         matches = [
             (i, f) for i, f in enumerate(self.plan.faults)
@@ -291,6 +298,42 @@ class AutoscaleFaults:
             else:  # crash
                 raise ChaosInjected(
                     f"chaos: injected crash at autoscale phase {phase!r}"
+                )
+
+
+class UpgradeFaults:
+    """Bound upgrade-site handle for the offline graph-version migrator:
+    fires at its phase boundaries (plan/stage/backfill/carry/promote/
+    cleanup). ``kill`` mid-upgrade is the crash the atomic-marker cutover
+    must survive with the OLD code version still bootable; ``torn``
+    lands a truncated blob under the upgrade staging prefix (via the
+    migrator-provided callback) before raising — half-written staging
+    must never contaminate a bootable layout."""
+
+    def __init__(self, owner: ActiveFaults, matches: list[tuple[int, Fault]]):
+        self._owner = owner
+        self._matches = matches
+
+    def fire(self, phase: str, torn: Any = None) -> None:
+        for idx, f in self._matches:
+            if f.phase not in (None, phase):
+                continue
+            if not self._owner._decide(idx, f, f"upgrade/{phase}"):
+                continue
+            if f.action == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif f.action == "exit":
+                os._exit(29)
+            elif f.action == "torn":
+                if torn is not None:
+                    torn()
+                raise ChaosInjected(
+                    f"chaos: injected torn staging write at upgrade "
+                    f"phase {phase!r}"
+                )
+            else:  # crash
+                raise ChaosInjected(
+                    f"chaos: injected crash at upgrade phase {phase!r}"
                 )
 
 

@@ -64,6 +64,14 @@ Sites and actions:
   Selected by ``worker`` (the sink worker), ``nth``/``prob`` and
   optional ``key_prefix`` matching the SINK NAME (the delivery layer's
   stable sink id).
+- ``upgrade`` — the offline graph-version migrator's phase boundaries
+  (``upgrade/migrator.py``: plan, stage, backfill, carry, promote,
+  cleanup). ``action`` is ``crash``, ``exit``, ``kill`` or ``torn``
+  (write a truncated blob under the upgrade staging prefix, then raise —
+  proving half-written staging never contaminates a bootable layout);
+  selected by ``phase`` and ``nth``. A kill before ``promote`` must
+  leave the OLD graph version bootable; at/after ``cleanup`` the NEW
+  one — exactly-once output must hold across the code-version flip.
 - ``state.spill`` — the memory-budget spill tier's blob writes
   (``engine/spill.py``: join-run payloads, groupby cold buckets, key-
   registry cold buckets). ``action`` is ``fail`` (raise before writing),
@@ -100,7 +108,7 @@ __all__ = ["Fault", "FaultPlan", "load_plan_from_env"]
 
 _SITES = (
     "tick", "comm.send", "comm.local", "persistence.put", "rescale",
-    "autoscale", "state.spill", "sink.write",
+    "autoscale", "state.spill", "sink.write", "upgrade",
 )
 _ACTIONS = {
     "tick": ("crash", "exit", "kill", "hang"),
@@ -111,13 +119,20 @@ _ACTIONS = {
     "autoscale": ("crash", "exit", "kill"),
     "state.spill": ("fail", "torn", "kill"),
     "sink.write": ("fail", "torn", "delay", "hang", "reject"),
+    "upgrade": ("crash", "exit", "kill", "torn"),
 }
 #: rescale-site phase boundaries, in execution order (resharder.py)
 RESCALE_PHASES = ("plan", "stage", "copy", "promote", "cleanup")
 #: autoscale-site phase boundaries, in execution order (controller.py)
 AUTOSCALE_PHASES = ("decide", "drain", "reshard", "resume")
+#: upgrade-site phase boundaries, in execution order (upgrade/migrator.py)
+UPGRADE_PHASES = ("plan", "stage", "backfill", "carry", "promote", "cleanup")
 #: which phase vocabulary each phased site validates against
-_PHASES_BY_SITE = {"rescale": RESCALE_PHASES, "autoscale": AUTOSCALE_PHASES}
+_PHASES_BY_SITE = {
+    "rescale": RESCALE_PHASES,
+    "autoscale": AUTOSCALE_PHASES,
+    "upgrade": UPGRADE_PHASES,
+}
 
 
 @dataclass(frozen=True)
@@ -140,8 +155,8 @@ class Fault:
     #: with this; sink.write: only count writes of sinks whose NAME
     #: starts with this
     key_prefix: str | None = None
-    #: rescale site: fire at this phase boundary of the resharder
-    #: (one of RESCALE_PHASES); None = any phase
+    #: phased sites (rescale/autoscale/upgrade): fire at this phase
+    #: boundary (the site's *_PHASES vocabulary); None = any phase
     phase: str | None = None
     #: delay/hang duration; None = the action's default (delay 0.05s,
     #: hang effectively-forever)

@@ -22,8 +22,8 @@ import click
 from .internals.config import MAX_WORKERS
 
 __all__ = [
-    "main", "spawn", "replay", "rescale", "top", "critpath", "trace",
-    "dlq", "lint",
+    "main", "spawn", "replay", "rescale", "upgrade", "top", "critpath",
+    "trace", "dlq", "lint",
 ]
 
 
@@ -218,12 +218,23 @@ def _run_supervised(
                    "layout (clamped into the range)")
 @click.option("--store", "autoscale_store", type=str, default=None,
               help="persistence root the program writes (the path given "
-                   "to pw.persistence.Backend.filesystem) — the state the "
-                   "autoscaler reshards between worker counts")
+                   "to pw.persistence.Backend.filesystem) — the state "
+                   "--autoscale reshards between worker counts and "
+                   "--upgrade-to migrates between graph versions")
+@click.option("--upgrade-to", "upgrade_to", type=str, default=None,
+              metavar="NEW_SCRIPT",
+              help="zero-downtime code upgrade: before launching, migrate "
+                   "the persisted state at --store to the graph version "
+                   "NEW_SCRIPT builds (pathway-tpu upgrade --apply), then "
+                   "launch PROGRAM — an empty store skips the migration "
+                   "and boots fresh")
+@click.option("--allow-drop", is_flag=True, default=False,
+              help="with --upgrade-to: accept dropping stateful operators "
+                   "that have no match in the new script")
 @click.argument("program", nargs=-1, type=click.UNPROCESSED)
 def spawn(threads, processes, first_port, record, record_path, addresses,
           local_ids, supervise, elastic, autoscale_range, autoscale_store,
-          program):
+          upgrade_to, allow_drop, program):
     """Launch PROGRAM with the worker environment set (reference cli.py:53).
 
     Multi-host: run once per machine with the same ``--addresses`` book and
@@ -235,6 +246,34 @@ def spawn(threads, processes, first_port, record, record_path, addresses,
         env_extra["PATHWAY_SNAPSHOT_ACCESS"] = "record"
     if elastic:
         env_extra["PATHWAY_ELASTIC"] = "1"
+    if upgrade_to is not None:
+        if not autoscale_store:
+            raise click.ClickException(
+                "--upgrade-to needs --store <persistence root>: the "
+                "migration rewrites the program's persisted state to the "
+                "new graph version before the ensemble boots"
+            )
+        from .persistence import Backend
+        from .upgrade import NoStoreMarker, UpgradeError, apply_upgrade
+
+        try:
+            report = apply_upgrade(
+                Backend.filesystem(autoscale_store), upgrade_to,
+                allow_drop=allow_drop,
+                log=lambda m: click.echo(m, err=True),
+            )
+            if report.get("noop"):
+                click.echo(
+                    "[upgrade] store already matches the new graph "
+                    "version — launching", err=True,
+                )
+        except NoStoreMarker:
+            click.echo(
+                "[upgrade] store is empty — nothing to migrate, the new "
+                "version boots fresh", err=True,
+            )
+        except UpgradeError as e:
+            raise click.ClickException(str(e))
     if autoscale_range is not None:
         sys.exit(_run_autoscaled(threads, autoscale_range, autoscale_store,
                                  first_port, env_extra, program,
@@ -384,35 +423,106 @@ def rescale(to_workers, backend_kind, dry_run, store):
             + (" (dry run)" if dry_run else "")
         )
     elif dry_run:
-        click.echo(
-            f"dry run: would rescale {report['from']} -> {report['to']} "
-            f"worker(s) at snapshot time {report['snapshot_time']} "
-            f"(epoch {report['epoch']} -> {report['epoch'] + 1}):"
-        )
-        for op in report.get("operators", []):
-            mb = op.get("state_bytes", 0) / 1e6
-            click.echo(
-                f"  rank {op['rank']} {op['cls']} [{op['mode']}]: "
-                f"{op['action']} "
-                f"(source snapshot chunks: {op['chunks_per_source']}, "
-                f"state {mb:.2f} MB = {op.get('state_bytes_per_source')} B "
-                "per source, incl. spilled)"
-            )
-        if not report.get("operators"):
-            click.echo("  (no stateful operator snapshots at that time)")
-        total_mb = report.get("state_bytes_total", 0) / 1e6
-        click.echo(
-            f"  total stateful-operator bytes to redistribute: "
-            f"{total_mb:.2f} MB across {report['to']} target worker(s) "
-            f"(~{total_mb / max(1, report['to']):.2f} MB/worker)"
-        )
-        click.echo(
-            "  input tail chunks to re-route per source worker: "
-            f"{report.get('tail_chunks_per_source')}"
-        )
+        from .upgrade.render import render_dry_run
+
+        for line in render_dry_run(report):
+            click.echo(line)
         click.echo(_json.dumps(report))
     else:
         click.echo(_json.dumps(report))
+
+
+@main.command()
+@click.option("--plan", "plan_only", is_flag=True, default=False,
+              help="diff only (the default): classify every stateful "
+                   "operator as carried / remapped / new / dropped and "
+                   "exit with a lint-style severity code — nothing is "
+                   "written")
+@click.option("--apply", "do_apply", is_flag=True, default=False,
+              help="execute the migration: stage the new graph version's "
+                   "layout under upgrade-tmp/, carry offsets and delivery "
+                   "ack cursors, promote with one atomic marker put")
+@click.option("--json", "as_json", is_flag=True, default=False,
+              help="emit the plan/report as JSON instead of prose")
+@click.option("--allow-drop", is_flag=True, default=False,
+              help="accept DROPPING stateful operators that have no "
+                   "match in the new script (their persisted state is "
+                   "discarded); without it a stateful drop is an error")
+@click.option("--backend", "backend_kind",
+              type=click.Choice(["filesystem", "s3"]), default="filesystem",
+              help="persistence backend kind holding the state")
+@click.argument("store")
+@click.argument("new_script")
+@click.argument("script_args", nargs=-1, type=click.UNPROCESSED)
+def upgrade(plan_only, do_apply, as_json, allow_drop, backend_kind, store,
+            new_script, script_args):
+    """Migrate persisted state at STORE to the graph NEW_SCRIPT builds.
+
+    NEW_SCRIPT runs build-only (``pw.run`` stubbed, like ``lint``) with
+    any trailing SCRIPT_ARGS as its argv; its operators are matched
+    against the fingerprint manifest the running pipeline persisted. ``--plan`` previews; ``--apply`` stages a
+    complete next-epoch layout and promotes it with ONE atomic cluster-
+    marker put — a crash at any earlier instant leaves the OLD code
+    version bootable. Plan exit codes mirror ``pathway-tpu lint``:
+    0 clean, 1 warnings, 2 errors (e.g. a stateful operator would be
+    dropped without --allow-drop), 3 NEW_SCRIPT crashed while building."""
+    import json as _json
+
+    from .persistence import Backend
+    from .upgrade import (
+        UpgradeError,
+        apply_upgrade,
+        plan_exit_code,
+        plan_upgrade,
+        render_plan,
+    )
+
+    if plan_only and do_apply:
+        raise click.ClickException("--plan and --apply are exclusive")
+    spec = (
+        Backend.filesystem(store)
+        if backend_kind == "filesystem"
+        else Backend.s3(store)
+    )
+    if do_apply:
+        try:
+            report = apply_upgrade(
+                spec, new_script, script_args=tuple(script_args),
+                allow_drop=allow_drop,
+                log=lambda m: click.echo(m, err=True),
+            )
+        except UpgradeError as e:
+            raise click.ClickException(str(e))
+        if as_json:
+            click.echo(_json.dumps(report))
+        elif report.get("noop"):
+            click.echo("nothing to migrate")
+        else:
+            click.echo(
+                f"upgraded: {report['carried']} carried, "
+                f"{report['remapped']} remapped, {report['new']} new, "
+                f"{report['dropped']} dropped (epoch {report['epoch']})"
+            )
+        return
+    try:
+        plan, crash = plan_upgrade(
+            spec, new_script, script_args=tuple(script_args),
+            allow_drop=allow_drop,
+            log=lambda m: click.echo(m, err=True),
+        )
+    except UpgradeError as e:
+        raise click.ClickException(str(e))
+    if as_json:
+        doc = dict(plan)
+        if crash is not None:
+            doc["crash"] = f"{type(crash).__name__}: {crash}"
+        click.echo(_json.dumps(doc))
+    else:
+        for line in render_plan(plan):
+            click.echo(line)
+        if crash is not None:
+            click.echo(f"  crash: {type(crash).__name__}: {crash}")
+    sys.exit(3 if crash is not None else plan_exit_code(plan))
 
 
 @main.command(context_settings={"ignore_unknown_options": True})

@@ -239,6 +239,23 @@ class Node:
     #: class can name mode-dependent fields.
     STATE_FIELDS: tuple[str, ...] = ()
 
+    #: user-pinned stable identity (``Table.named``) — survives structural
+    #: edits, so graph-version migration can match this operator across
+    #: code versions even when its fingerprint drifts
+    pw_name: "str | None" = None
+
+    #: pre-fusion structural fingerprint, stamped by Executor.__init__
+    #: before fuse_graph rewrites chains — the persisted graph manifest
+    #: must match what a build-only (unfused) compile of the same script
+    #: would produce
+    pw_fingerprint: "str | None" = None
+
+    #: fingerprint-transparent nodes (Exchange) take their input's
+    #: structural fingerprint verbatim: sharding inserts them between
+    #: stateful operators, and the persisted fingerprint manifest must
+    #: agree with an UNsharded offline lowering of the same script
+    FINGERPRINT_TRANSPARENT = False
+
     #: static-analysis verdict on this operator's state growth
     #: (pathway_tpu/analysis unbounded-state pass): None = stateless or no
     #: verdict; False = state grows with the number of distinct keys/rows
@@ -708,6 +725,19 @@ class Executor:
         # groupby/join preambles are absorbed — AFTER sharding, so
         # Exchange boundaries are fusion barriers by construction.
         # PATHWAY_FUSION=0 is the escape hatch (fuse_graph no-ops).
+        # stamp pre-fusion structural fingerprints: the persisted graph
+        # manifest must match a build-only compile of the same script
+        # (`pathway-tpu upgrade --plan`), and fusion below rewrites
+        # chains the offline compile never sees (advisory — a failure
+        # here only degrades upgrade matching, never execution)
+        try:
+            from ..analysis.graph import fingerprint_nodes as _fp_nodes
+
+            _fps = _fp_nodes(nodes)
+            for node in nodes:
+                node.pw_fingerprint = _fps.get(id(node))
+        except Exception:
+            pass
         from .fusion import fuse_graph
 
         nodes = fuse_graph(nodes)

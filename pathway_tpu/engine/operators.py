@@ -254,6 +254,9 @@ class Exchange(Node):
     """
 
     always_run = True
+    # sharding inserts Exchanges the offline (unsharded) lowering never
+    # sees; transparent fingerprints keep both compiles' manifests equal
+    FINGERPRINT_TRANSPARENT = True
 
     def __init__(self, inp: Node, route_spec: tuple, ctx):
         super().__init__([inp], inp.column_names)

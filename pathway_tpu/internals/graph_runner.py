@@ -398,6 +398,20 @@ class GraphRunner:
             # pw.local_error_log() attribution: errors raised while this
             # node processes carry the scope its table was built under
             node.error_scope = scope
+        pw_name = getattr(table, "_pw_name", None)
+        if pw_name is not None and node.pw_name is None:
+            # Table.named() pins a stable identity for upgrade matching.
+            # The pin names the STATE behind this table: tables like
+            # `.reduce(...)` lower to a stateless column projection over
+            # the stateful operator, so walk up through single-input
+            # stateless wrappers and land the name on the operator whose
+            # snapshot actually migrates.
+            node.pw_name = pw_name
+            cur = node
+            while not cur.has_state() and len(cur.inputs) == 1:
+                cur = cur.inputs[0]
+                if cur.pw_name is None:
+                    cur.pw_name = pw_name
         self._cache[key] = node
         return node
 

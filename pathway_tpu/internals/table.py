@@ -58,6 +58,19 @@ class Table(TableLike):
         #: pw.local_error_log() scope active when this table was built —
         #: its nodes' runtime row errors carry the scope
         self._error_scope = current_build_scope()
+        #: user-pinned stable operator name (``named``); None = unnamed
+        self._pw_name: str | None = None
+
+    def named(self, name: str) -> "Table":
+        """Pin a stable, user-visible operator identity onto this table's
+        node. ``pathway-tpu upgrade`` matches operators across code
+        versions by structural fingerprint first and pinned name second —
+        naming a stateful table lets its snapshots survive structural
+        edits (the *remapped* plan verb) instead of being dropped."""
+        if not name or not isinstance(name, str):
+            raise ValueError("named() needs a non-empty string")
+        self._pw_name = name
+        return self
 
     # -- schema surface -----------------------------------------------------
 

@@ -871,21 +871,35 @@ class ObservabilityHub:
             rescales = _rescale_stats()
         except Exception:  # pragma: no cover — import cycle safety net
             rescales = {"total": 0}
+        try:  # spawn --upgrade-to migrates in-process before launching
+            from ..upgrade import stats as _upgrade_stats
+
+            upgrades = _upgrade_stats()
+        except Exception:  # pragma: no cover — import cycle safety net
+            upgrades = {"total": 0}
         if (
             not supervised
             and restarts is None
             and armed is None
             and flight_dumps is None
         ):
-            if not rescales["total"]:
+            if not rescales["total"] and not upgrades["total"]:
                 return None
-            # an elastic rescale happened but nothing is supervised —
-            # surface ONLY the rescale counters (no pathway_restarts_total
+            # a rescale/upgrade happened but nothing is supervised —
+            # surface ONLY those counters (no pathway_restarts_total
             # outside supervision)
-            return {
-                "rescales": int(rescales["total"]),
-                "rescale_duration_s": float(rescales["duration_s"]),
-            }
+            doc = {}
+            if rescales["total"]:
+                doc["rescales"] = int(rescales["total"])
+                doc["rescale_duration_s"] = float(rescales["duration_s"])
+            if upgrades["total"]:
+                doc["upgrades"] = int(upgrades["total"])
+                doc["upgrade_duration_s"] = float(upgrades["duration_s"])
+                doc["upgrade_operators"] = {
+                    v: int(upgrades.get(v, 0))
+                    for v in ("carried", "remapped", "new", "dropped")
+                }
+            return doc
         doc: dict = {
             "restarts": int(restarts or 0),
             "reason": os.environ.get("PATHWAY_LAST_RESTART_REASON"),
@@ -921,6 +935,13 @@ class ObservabilityHub:
         if rescales["total"]:
             doc["rescales"] = int(rescales["total"])
             doc["rescale_duration_s"] = float(rescales["duration_s"])
+        if upgrades["total"]:
+            doc["upgrades"] = int(upgrades["total"])
+            doc["upgrade_duration_s"] = float(upgrades["duration_s"])
+            doc["upgrade_operators"] = {
+                v: int(upgrades.get(v, 0))
+                for v in ("carried", "remapped", "new", "dropped")
+            }
         return doc
 
     @staticmethod

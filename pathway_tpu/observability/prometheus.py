@@ -378,6 +378,25 @@ def render_snapshots(
                 "pathway_rescale_duration_seconds", "gauge",
                 float(supervisor.get("rescale_duration_s", 0.0)),
             )
+        if supervisor.get("upgrades") is not None:
+            # graph-version migrations completed in this process
+            # (pathway-tpu upgrade --apply / spawn --upgrade-to) +
+            # cumulative wall time + per-verb operator counts
+            r.add(
+                "pathway_upgrade_total", "counter",
+                int(supervisor["upgrades"]),
+            )
+            r.add(
+                "pathway_upgrade_duration_seconds", "gauge",
+                float(supervisor.get("upgrade_duration_s", 0.0)),
+            )
+            verbs = supervisor.get("upgrade_operators") or {}
+            for verb in ("carried", "remapped", "new", "dropped"):
+                if verbs.get(verb) is not None:
+                    r.add(
+                        "pathway_upgrade_operators_total", "counter",
+                        int(verbs[verb]), {"verb": verb},
+                    )
         if supervisor.get("window_failures") is not None:
             # circuit-breaker window position: failures inside the
             # sliding window at this generation's launch vs the restart

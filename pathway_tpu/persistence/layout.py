@@ -26,6 +26,7 @@ from .backends import PersistenceBackend
 __all__ = [
     "MARKER_KEY",
     "STAGING_PREFIX",
+    "UPGRADE_STAGING_PREFIX",
     "read_marker",
     "write_marker",
     "epoch_prefix",
@@ -37,6 +38,10 @@ __all__ = [
 MARKER_KEY = "cluster"
 #: where a rescale stages the next epoch's layout before promotion
 STAGING_PREFIX = "rescale-tmp/"
+#: where a graph-version upgrade stages the migrated layout before its
+#: atomic marker flip (upgrade/migrator.py) — same discipline as rescale:
+#: everything under here is scratch, never part of a bootable layout
+UPGRADE_STAGING_PREFIX = "upgrade-tmp/"
 
 
 def read_marker(root: PersistenceBackend) -> tuple[int, int] | None:
@@ -83,7 +88,9 @@ def layout_keys(root: PersistenceBackend, epoch: int, n_workers: int) -> list[st
     out: list[str] = []
     base = epoch_prefix(epoch)
     for key in root.list_keys():
-        if key == MARKER_KEY or key.startswith(STAGING_PREFIX):
+        if key == MARKER_KEY or key.startswith(
+            (STAGING_PREFIX, UPGRADE_STAGING_PREFIX)
+        ):
             continue
         if epoch == 0 and key.startswith("epoch-"):
             continue

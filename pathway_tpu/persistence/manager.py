@@ -41,11 +41,50 @@ from .snapshots import (
     SnapshotWriter,
 )
 
-__all__ = ["PersistenceManager"]
+__all__ = ["PersistenceManager", "MANIFEST_KEY", "build_manifest"]
 
 #: operator snapshot versions retained (reference keeps enough history for
 #: all workers to agree on a complete snapshot, worker-architecture doc)
 KEEP_OP_VERSIONS = 2
+
+#: per-worker-namespace key carrying the graph version's fingerprint
+#: manifest — what `pathway-tpu upgrade --plan` matches the new code
+#: version against (upgrade/planner.py reads worker 0's copy)
+MANIFEST_KEY = "graph/manifest"
+
+
+def build_manifest(
+    stateful: list[Any], nodes: list[Any], fps: dict[int, str]
+) -> dict:
+    """The graph's identity manifest: stateful ranks with structural
+    fingerprints + pinned names + signatures, and persisted sources. All
+    fields are identity-free — two compiles of the same script produce
+    byte-identical manifests."""
+    ops = []
+    for rank, n in enumerate(stateful):
+        try:
+            sig = repr(n.analysis_signature())
+        except Exception:
+            sig = ""
+        ops.append({
+            "rank": rank,
+            "cls": type(n).__name__,
+            "fingerprint": fps[id(n)],
+            "name": n.pw_name,
+            "signature": sig,
+            "reshard": getattr(n, "RESHARD", "keyed"),
+        })
+    from ..engine.executor import SourceNode
+
+    sources = []
+    for n in sorted(nodes, key=lambda x: x.node_id):
+        if isinstance(n, SourceNode):
+            sources.append({
+                "pid": getattr(n, "persistent_id", None),
+                "cls": type(n).__name__,
+                "fingerprint": fps[id(n)],
+            })
+    return {"version": 1, "stateful": ops, "sources": sources}
 
 
 class PersistenceManager:
@@ -60,6 +99,10 @@ class PersistenceManager:
         self.backend: PersistenceBackend = (
             PrefixBackend(root, ns) if ns else root
         )
+        # the un-chaos-wrapped view: advisory writes (the fingerprint
+        # manifest) must not consume fault-plan put counters or fail under
+        # injected put faults — they are not part of the commit protocol
+        self._plain_backend: PersistenceBackend = self.backend
         # chaos site (persistence.put): identity pass-through unless a
         # fault plan targets this worker's puts. Wraps the WORKER's view
         # (inside the worker-{id}/ prefix), so plan key_prefix values like
@@ -199,10 +242,43 @@ class PersistenceManager:
 
     def attach_nodes(self, nodes: list[Any]) -> None:
         """Register the executor's nodes; stateful ones get stable ranks by
-        deterministic build order (same program -> same ranks on restart)."""
+        deterministic build order (same program -> same ranks on restart).
+        Also persists this graph version's fingerprint manifest into the
+        worker namespace (``graph/manifest``) so a later ``pathway-tpu
+        upgrade`` can match operators across code versions."""
         ordered = sorted(nodes, key=lambda n: n.node_id)
         self._stateful = [n for n in ordered if n.has_state()]
         self._rank_of = {id(n): r for r, n in enumerate(self._stateful)}
+        self._write_manifest(nodes)
+
+    def _write_manifest(self, nodes: list[Any]) -> None:
+        """Best-effort: the manifest is advisory metadata for offline
+        upgrade planning, never part of the commit protocol — a failure
+        here must not take down a boot (and the write bypasses the chaos
+        backend so fault-plan put counters stay unperturbed)."""
+        try:
+            import json as _json
+
+            from ..analysis.graph import fingerprint_nodes
+
+            fps = fingerprint_nodes(nodes)
+            # prefer the pre-fusion stamps from Executor.__init__: the
+            # attached graph is already fused/sharded, but the manifest
+            # must match an offline (unfused) compile of the script
+            for n in nodes:
+                stamped = getattr(n, "pw_fingerprint", None)
+                if stamped is not None:
+                    fps[id(n)] = stamped
+            doc = build_manifest(self._stateful, nodes, fps)
+            raw = _json.dumps(doc, sort_keys=True).encode()
+            try:
+                if self._plain_backend.get_value(MANIFEST_KEY) == raw:
+                    return
+            except Exception:
+                pass
+            self._plain_backend.put_value(MANIFEST_KEY, raw)
+        except Exception:  # pragma: no cover - advisory path
+            pass
 
     def mark_dirty(self, node: Any) -> None:
         rank = self._rank_of.get(id(node))
@@ -441,6 +517,14 @@ class PersistenceManager:
         self._drop_versions = self.op_snapshots[:max(0, keep_from)]
         self.op_snapshots = self.op_snapshots[max(0, keep_from):]
         if not self.op_snapshots:
+            return []
+        from ..internals.config import _env_bool
+
+        if _env_bool("PATHWAY_UPGRADE_RETAIN_LOG"):
+            # keep the FULL input log: operators added by a future
+            # `pathway-tpu upgrade` backfill by replaying retained input,
+            # and rows truncated here can never reach them (the upgrade
+            # plan warns when it detects a truncated log)
             return []
         if self.n_workers > 1 and len(self.op_snapshots) < KEEP_OP_VERSIONS:
             # sharded: a crash between two workers' commits in the same wave

@@ -255,6 +255,18 @@ def _dry_run_report(
     Refuses exactly what the real run refuses (per-worker operator-count
     mismatch): a dry run that prints a confident plan for a store the
     real rescale would reject defeats its preview purpose."""
+    from ..persistence.manager import MANIFEST_KEY
+
+    # the store's fingerprint manifest (graph/manifest, written at boot):
+    # lets the report name operators by structural identity, not just
+    # rank — the same identities `pathway-tpu upgrade --plan` prints
+    ident_by_rank: dict[int, dict] = {}
+    try:
+        manifest = json.loads(views[0].get_value(MANIFEST_KEY))
+        for e in manifest.get("stateful", []):
+            ident_by_rank[int(e["rank"])] = e
+    except Exception:
+        pass  # pre-manifest store: rows render without identities
     ops_plan: list[dict] = []
     if snap_time >= 0:
         entries = [
@@ -295,9 +307,12 @@ def _dry_run_report(
                 _op_chunk_bytes(views[i], rank, d) if d is not None else None
                 for i, d in enumerate(descs)
             ]
+            ident = ident_by_rank.get(rank, {})
             ops_plan.append({
                 "rank": rank,
                 "cls": cls_name,
+                "fingerprint": ident.get("fingerprint"),
+                "name": ident.get("name"),
                 "mode": mode,
                 "action": action,
                 "chunks_per_source": [
@@ -542,6 +557,18 @@ def _rescale_root(
     if delivery_cursors:
         report["delivery_cursors"] = len(delivery_cursors)
 
+    # carry the graph's fingerprint manifest: the dataflow is unchanged
+    # by a rescale, and `pathway-tpu upgrade --plan` must keep working on
+    # the new layout before its first boot rewrites the manifest
+    from ..persistence.manager import MANIFEST_KEY
+
+    for view in views:
+        try:
+            staged[0].put_value(MANIFEST_KEY, view.get_value(MANIFEST_KEY))
+            break
+        except (KeyError, FileNotFoundError):
+            continue
+
     fire("copy")
     staged_keys = [
         k for k in root.list_keys() if k.startswith(_layout.STAGING_PREFIX)
@@ -570,8 +597,11 @@ def _rescale_root(
     for key in root.list_keys():
         if key == _layout.MARKER_KEY or key.startswith(tgt):
             continue
-        if key.startswith(_layout.STAGING_PREFIX) or key.startswith(
-            ("epoch-", "meta/", "chunks/", "ops/", "worker-", "delivery/")
+        if key.startswith(
+            (_layout.STAGING_PREFIX, _layout.UPGRADE_STAGING_PREFIX)
+        ) or key.startswith(
+            ("epoch-", "meta/", "chunks/", "ops/", "worker-", "delivery/",
+             "graph/")
         ):
             root.remove_key(key)
     report["epoch"] = new_epoch

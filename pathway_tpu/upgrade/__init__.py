@@ -1,0 +1,42 @@
+"""Zero-downtime graph-version upgrades (``pathway-tpu upgrade``).
+
+Snapshots key on operator identities, so historically ANY edit to a
+pipeline script orphaned its persisted store (``restore_operators``:
+"the dataflow changed since the snapshot was taken"). This package turns
+the structural-fingerprint + atomic-marker + ack-cursor machinery into a
+migration path instead:
+
+- ``planner`` — diff the store's persisted fingerprint manifest against
+  a build-only compile of the new script; classify every stateful
+  operator as carried / remapped / new / dropped.
+- ``migrator`` — stage the migrated layout under ``upgrade-tmp/``,
+  backfill new operators from the retained input log, carry offsets and
+  delivery ack cursors, promote with one atomic marker put.
+- ``render`` — the human-readable plan renderer, shared with
+  ``pathway-tpu rescale --dry-run``.
+"""
+
+from .migrator import (
+    NoStoreManifest,
+    NoStoreMarker,
+    UpgradeError,
+    apply_upgrade,
+    plan_upgrade,
+    stats,
+)
+from .planner import classify, load_new_graph, plan_exit_code
+from .render import render_dry_run, render_plan
+
+__all__ = [
+    "UpgradeError",
+    "NoStoreManifest",
+    "NoStoreMarker",
+    "plan_upgrade",
+    "apply_upgrade",
+    "classify",
+    "load_new_graph",
+    "plan_exit_code",
+    "render_dry_run",
+    "render_plan",
+    "stats",
+]

@@ -1,0 +1,612 @@
+"""Staged graph-version cutover behind ``pathway-tpu upgrade --apply``.
+
+Rides the rescale substrate (``rescale/resharder.py``): the migrated
+layout is staged under ``upgrade-tmp/`` as a COMPLETE next-epoch layout
+— carried snapshots copied verbatim, remapped state rewritten through
+``split_state``/``merge_states``, new operators backfilled by replaying
+the retained input log through just their ancestor subgraph, the live
+input tail + per-source offsets + delivery ack cursors carried exactly
+as rescale carries them — then promoted with ONE atomic ``cluster``
+marker put. A crash at any earlier instant leaves the old code version
+bootable against the old, untouched layout; after the marker flip the
+new version boots with exactly-once output intact across the code flip.
+
+Every phase boundary (plan / stage / backfill / carry / promote /
+cleanup) is an ``upgrade`` chaos site and an ``upgrade.*`` trace span.
+The ``torn`` chaos action lands a truncated blob under the staging
+prefix before raising — proving half-written staging never contaminates
+a bootable layout.
+
+Unlike rescale, the worker count is UNCHANGED: per-worker namespaces map
+1:1, so tail chunks and ack cursors copy verbatim per worker and keyed
+state never crosses shard boundaries (remap rewrites are per-worker
+normalizations, not reshuffles).
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import sys
+import time as _time
+from typing import Any, Callable
+
+from ..internals.config import _env_bool
+from ..internals.tracing import span as _span
+from ..persistence import layout as _layout
+from ..persistence.backends import PersistenceBackend, open_backend
+from ..persistence.manager import MANIFEST_KEY
+from ..persistence.snapshots import (
+    MetadataAccessor,
+    OperatorSnapshots,
+    SnapshotReader,
+    read_op_state,
+)
+from ..rescale.resharder import (
+    _merge_offsets,
+    _node_class,
+    _pick_snapshot_time,
+    _worker_view,
+)
+from .planner import UpgradeError, classify, load_new_graph
+
+__all__ = [
+    "UpgradeError",
+    "NoStoreManifest",
+    "NoStoreMarker",
+    "plan_upgrade",
+    "apply_upgrade",
+    "stats",
+]
+
+
+class NoStoreManifest(UpgradeError):
+    """The store predates fingerprint manifests (or was never booted):
+    there is nothing to match the new script against. Boot once with the
+    CURRENT code version — attach_nodes persists the manifest — then
+    plan the upgrade."""
+
+
+class NoStoreMarker(UpgradeError):
+    """The store has no cluster marker: nothing was ever persisted.
+    ``spawn --upgrade-to`` catches THIS (not a message substring) and
+    boots fresh — an empty store needs no migration."""
+
+
+#: process-local counters surfaced as ``pathway_upgrade_total`` /
+#: ``pathway_upgrade_duration_seconds`` on /metrics (observability/hub.py)
+_STATS: dict[str, Any] = {
+    "total": 0, "duration_s": 0.0, "planned": 0,
+    "carried": 0, "remapped": 0, "new": 0, "dropped": 0,
+    "last": None,
+}
+
+
+def stats() -> dict[str, Any]:
+    return dict(_STATS)
+
+
+def _default_log(msg: str) -> None:
+    print(f"[upgrade] {msg}", file=sys.stderr)
+
+
+def _open_root(backend: Any) -> tuple[PersistenceBackend, bool]:
+    if isinstance(backend, PersistenceBackend):
+        return backend, False
+    return open_backend(backend), True
+
+
+def _load_store(
+    root: PersistenceBackend, log: Callable[[str], Any]
+) -> dict[str, Any]:
+    """Read marker + per-worker metadata + the persisted manifest."""
+    marker = _layout.read_marker(root)
+    if marker is None:
+        raise NoStoreMarker(
+            f"no cluster marker at {root.describe()}: nothing was ever "
+            "persisted, so there is no state to upgrade (the new version "
+            "can simply boot)"
+        )
+    n_workers, epoch = marker
+    views: list[PersistenceBackend] = []
+    metas: list[dict] = []
+    missing: list[int] = []
+    for i in range(n_workers):
+        ns = _layout.worker_namespace(epoch, n_workers, i)
+        view = _worker_view(root, ns)
+        views.append(view)
+        cur = MetadataAccessor(view).current
+        if cur is None:
+            missing.append(i)
+        metas.append(cur or {})
+    if missing and len(missing) < n_workers:
+        raise UpgradeError(
+            f"worker(s) {missing} have no committed metadata while others "
+            "do — the store is torn mid-first-commit; boot the old version "
+            "once, then upgrade"
+        )
+    try:
+        manifest = json.loads(views[0].get_value(MANIFEST_KEY))
+    except (KeyError, FileNotFoundError):
+        manifest = None
+    except Exception as e:
+        raise UpgradeError(f"corrupt graph manifest in store: {e}")
+    return {
+        "n_workers": n_workers,
+        "epoch": epoch,
+        "views": views,
+        "metas": metas,
+        "empty": len(missing) == n_workers,
+        "manifest": manifest,
+    }
+
+
+def plan_upgrade(
+    backend: Any, script: str, *,
+    script_args: tuple = (),
+    allow_drop: bool = False, log: Callable[[str], Any] | None = None,
+) -> tuple[dict[str, Any], BaseException | None]:
+    """Classify every stateful operator of the store's persisted graph
+    version against a build-only compile of ``script``. Returns
+    ``(plan, crash)`` — ``crash`` is the exception the new script raised
+    while building (plan is then empty; exit code 3). Writes nothing."""
+    log = log or _default_log
+    allow_drop = allow_drop or _env_bool("PATHWAY_UPGRADE_ALLOW_DROP")
+    root, close_after = _open_root(backend)
+    try:
+        store = _load_store(root, log)
+        plan = _build_plan(root, store, script, script_args, allow_drop)
+    finally:
+        if close_after:
+            root.close()
+    _STATS["planned"] += 1
+    plan.pop("_new_doc", None)
+    return plan, plan.pop("_crash", None)
+
+
+def _build_plan(
+    root: PersistenceBackend, store: dict, script: str,
+    script_args: tuple, allow_drop: bool
+) -> dict[str, Any]:
+    head = {
+        "store": root.describe(),
+        "script": script,
+        "epoch": store["epoch"],
+        "n_workers": store["n_workers"],
+        "snapshot_time": None,
+    }
+    if store["empty"]:
+        # marker without committed state: the new version boots fresh
+        return {
+            **head, "operators": [], "carried": 0, "remapped": 0,
+            "new": 0, "dropped": 0, "warnings": [], "errors": [],
+            "noop": True, "_crash": None,
+        }
+    if store["manifest"] is None:
+        raise NoStoreManifest(
+            f"store at {root.describe()} carries no graph manifest "
+            f"({MANIFEST_KEY}) — boot it once with the CURRENT code "
+            "version (any committed run persists the manifest), then "
+            "plan the upgrade"
+        )
+    new_doc = load_new_graph(script, tuple(script_args))
+    if new_doc.get("crash") is not None:
+        return {
+            **head, "operators": [], "carried": 0, "remapped": 0,
+            "new": 0, "dropped": 0, "warnings": [],
+            "errors": [f"new script failed to build: {new_doc['crash']}"],
+            "_crash": new_doc["crash"],
+        }
+    snap_time = _pick_snapshot_time(store["metas"])
+    plan = classify(store["manifest"], new_doc, allow_drop=allow_drop)
+    plan.update(head)
+    plan["snapshot_time"] = snap_time
+    plan["_crash"] = None
+    plan["_new_doc"] = new_doc
+    backfill_on = _env_bool("PATHWAY_UPGRADE_BACKFILL", True)
+    plan["backfill"] = backfill_on
+    if plan["new"] and snap_time >= 0:
+        if not backfill_on:
+            plan["warnings"].append(
+                f"{plan['new']} new stateful operator(s) start from "
+                "INITIAL state (PATHWAY_UPGRADE_BACKFILL=0)"
+            )
+        elif any(
+            int(m.get("first_chunk", 0)) > 0 for m in store["metas"]
+        ):
+            plan["warnings"].append(
+                "input history was already truncated: new operators "
+                "backfill from the RETAINED log only — rows persisted "
+                "before the oldest retained chunk are not replayed "
+                "into them"
+            )
+    return plan
+
+
+def apply_upgrade(
+    backend: Any, script: str, *,
+    script_args: tuple = (),
+    allow_drop: bool = False, log: Callable[[str], Any] | None = None,
+) -> dict[str, Any]:
+    """Migrate the store to the graph version built by ``script`` and
+    promote it atomically. Raises :class:`UpgradeError` (with the plan's
+    errors) instead of ever applying a refused plan."""
+    log = log or _default_log
+    t0 = _time.monotonic()
+    root, close_after = _open_root(backend)
+    try:
+        report = _apply_root(root, script, script_args, allow_drop, log)
+    finally:
+        if close_after:
+            root.close()
+    dt = _time.monotonic() - t0
+    report["duration_s"] = round(dt, 6)
+    if not report.get("noop"):
+        _STATS["total"] += 1
+        _STATS["duration_s"] += dt
+        for verb in ("carried", "remapped", "new", "dropped"):
+            _STATS[verb] += report.get(verb, 0)
+        _STATS["last"] = {
+            k: v for k, v in report.items() if k != "operators"
+        }
+    return report
+
+
+def _apply_root(
+    root: PersistenceBackend, script: str, script_args: tuple,
+    allow_drop: bool, log: Callable[[str], Any],
+) -> dict[str, Any]:
+    from ..chaos import injector as _chaos
+
+    try:
+        from ..parallel.exchange import shard_rows
+    except ImportError:
+        from ..engine.keys import shard_of as shard_rows
+
+    import numpy as np
+
+    allow_drop = allow_drop or _env_bool("PATHWAY_UPGRADE_ALLOW_DROP")
+    armed = _chaos.current()
+    fault = armed.upgrade_faults() if armed is not None else None
+
+    def torn() -> None:
+        # half-written staging blob: must never contaminate the old
+        # layout (it lives under the staging prefix, swept on retry)
+        root.put_value(
+            _layout.UPGRADE_STAGING_PREFIX + "torn-blob", b'{"half": '
+        )
+
+    def fire(phase: str) -> None:
+        if fault is not None:
+            fault.fire(phase, torn=torn)
+
+    with _span("upgrade.plan", script=script):
+        store = _load_store(root, log)
+        plan = _build_plan(root, store, script, script_args, allow_drop)
+        crash = plan.pop("_crash", None)
+        if crash is not None:
+            raise UpgradeError(
+                f"new script failed to build: {crash}"
+            ) from crash
+        if plan.get("errors"):
+            raise UpgradeError(
+                "refusing to apply a plan with errors:\n  "
+                + "\n  ".join(plan["errors"])
+            )
+    fire("plan")
+    if plan.get("noop"):
+        return plan
+
+    new_doc = plan.pop("_new_doc")
+    new_manifest = {
+        k: new_doc[k] for k in ("version", "stateful", "sources")
+    }
+    if json.dumps(new_manifest, sort_keys=True) == json.dumps(
+        store["manifest"], sort_keys=True
+    ):
+        # identical graph version: every operator carried at its own rank
+        # — the store already matches, flipping epochs would only churn
+        plan["noop"] = True
+        log(
+            f"store at {root.describe()} already matches {script} — "
+            "nothing to migrate"
+        )
+        return plan
+    n_workers, epoch = store["n_workers"], store["epoch"]
+    views, metas = store["views"], store["metas"]
+    snap_time = plan["snapshot_time"]
+    new_epoch = epoch + 1
+
+    # stale staging from a previously crashed attempt is garbage
+    for key in root.list_keys():
+        if key.startswith(_layout.UPGRADE_STAGING_PREFIX):
+            root.remove_key(key)
+
+    staged = [
+        _worker_view(
+            root,
+            _layout.UPGRADE_STAGING_PREFIX
+            + _layout.worker_namespace(new_epoch, n_workers, i),
+        )
+        for i in range(n_workers)
+    ]
+
+    def mask_for(i: int):
+        def mask(keys: np.ndarray) -> np.ndarray:
+            return (
+                shard_rows(np.asarray(keys, dtype=np.uint64), n_workers) == i
+            )
+
+        return mask
+
+    # per-worker snapshot descriptors at the chosen time
+    entries: list[dict] = []
+    if snap_time >= 0:
+        for m in metas:
+            entry = next(
+                (
+                    e for e in m.get("op_snapshots", [])
+                    if int(e["time"]) == snap_time
+                ),
+                None,
+            )
+            assert entry is not None  # snap_time is the common time
+            entries.append(entry["ops"])
+
+    fire("stage")
+    ops_per_worker: list[dict] = [{} for _ in range(n_workers)]
+    moved = [
+        op for op in plan["operators"]
+        if op["verb"] in ("carried", "remapped")
+    ]
+    with _span("upgrade.stage", ops=len(moved), at=snap_time):
+        for op in moved:
+            if snap_time < 0:
+                continue  # nothing snapshotted yet; tail replay covers it
+            cls = _node_class(op["cls"])
+            for i in range(n_workers):
+                desc = entries[i].get(str(op["old_rank"])) or entries[i].get(
+                    op["old_rank"]
+                )
+                if desc is None:
+                    raise UpgradeError(
+                        f"operator snapshot is missing rank "
+                        f"{op['old_rank']} on worker {i}"
+                    )
+                piece = read_op_state(
+                    OperatorSnapshots(views[i]), op["old_rank"], desc, cls
+                )
+                if op["verb"] == "remapped":
+                    # normalize through the operator's own reshard
+                    # protocol: the signature drifted, so the state is
+                    # re-expressed rather than byte-copied
+                    piece = cls.merge_states(
+                        [cls.split_state(piece, mask_for(i))]
+                    )
+                n_chunks = OperatorSnapshots(staged[i]).write(
+                    op["rank"], snap_time, piece
+                )
+                ops_per_worker[i][str(op["rank"])] = {
+                    "cls": op["cls"], "at": snap_time, "chunks": n_chunks,
+                }
+
+    fire("backfill")
+    new_ops = [op for op in plan["operators"] if op["verb"] == "new"]
+    if snap_time >= 0 and new_ops:
+        with _span("upgrade.backfill", ops=len(new_ops), upto=snap_time):
+            states = _backfill_states(
+                new_doc, new_ops, views, metas, snap_time,
+                enabled=plan["backfill"], log=log,
+            )
+            for op in new_ops:
+                initial, final = states[op["rank"]]
+                cls = type(new_doc["stateful_nodes"][op["rank"]])
+                mode = op.get("reshard", "keyed")
+                for i in range(n_workers):
+                    if mode == "keyed":
+                        state = cls.split_state(final, mask_for(i))
+                    elif mode == "pinned":
+                        # single-owner composite: worker 0 owns it
+                        state = final if i == 0 else initial
+                    else:  # replicate
+                        state = final
+                    n_chunks = OperatorSnapshots(staged[i]).write(
+                        op["rank"], snap_time, state
+                    )
+                    ops_per_worker[i][str(op["rank"])] = {
+                        "cls": op["cls"], "at": snap_time,
+                        "chunks": n_chunks,
+                    }
+
+    fire("carry")
+    offsets = _merge_offsets(metas, log)
+    carried_cursors = 0
+    with _span("upgrade.carry", workers=n_workers):
+        for i in range(n_workers):
+            view, m = views[i], metas[i]
+            # the live input tail copies VERBATIM: worker count (and so
+            # key sharding) is unchanged across an upgrade
+            for key in view.list_keys():
+                if key.startswith("chunks/"):
+                    staged[i].put_value(key, view.get_value(key))
+            meta = {
+                "last_time": int(m.get("last_time", -1)),
+                "n_chunks": int(m.get("n_chunks", 0)),
+                "first_chunk": int(m.get("first_chunk", 0)),
+                "chunk_spans": m.get("chunk_spans", {}),
+                "offsets": offsets,
+                "n_workers": n_workers,
+                "op_snapshots": (
+                    [{"time": snap_time, "ops": ops_per_worker[i]}]
+                    if snap_time >= 0
+                    else []
+                ),
+            }
+            staged[i].put_value(
+                "meta/meta-00000000", json.dumps(meta).encode()
+            )
+            # delivery ack cursors: same worker owns the same sinks on
+            # both sides of the flip — dropping them would reset the
+            # recovery floor and re-deliver the replayed tail (duplicate
+            # external output across the code-version boundary)
+            for key in view.list_keys():
+                if key.startswith("delivery/"):
+                    staged[i].put_value(key, view.get_value(key))
+                    carried_cursors += 1
+            # the NEW graph version's manifest: the store self-describes
+            # before the new code ever boots
+            staged[i].put_value(
+                MANIFEST_KEY,
+                json.dumps(
+                    {
+                        k: new_doc[k]
+                        for k in ("version", "stateful", "sources")
+                    },
+                    sort_keys=True,
+                ).encode(),
+            )
+    plan["delivery_cursors"] = carried_cursors
+
+    staged_keys = [
+        k for k in root.list_keys()
+        if k.startswith(_layout.UPGRADE_STAGING_PREFIX)
+    ]
+    with _span("upgrade.promote", staged_keys=len(staged_keys)):
+        # leftovers of a crashed attempt under the target epoch would
+        # survive next to the fresh copy as unreferenced orphans
+        tgt = _layout.epoch_prefix(new_epoch)
+        for key in root.list_keys():
+            if tgt and key.startswith(tgt):
+                root.remove_key(key)
+        for key in staged_keys:
+            root.put_value(
+                key[len(_layout.UPGRADE_STAGING_PREFIX):],
+                root.get_value(key),
+            )
+        fire("promote")
+        # THE commit point: one atomic marker rewrite flips the cluster
+        # to the new graph version's layout; everything before this line
+        # left the old version's layout untouched
+        _layout.write_marker(root, n_workers, new_epoch)
+    fire("cleanup")
+    with _span("upgrade.cleanup"):
+        tgt = _layout.epoch_prefix(new_epoch)
+        for key in root.list_keys():
+            if key == _layout.MARKER_KEY or (tgt and key.startswith(tgt)):
+                continue
+            if key.startswith(
+                (_layout.STAGING_PREFIX, _layout.UPGRADE_STAGING_PREFIX)
+            ) or key.startswith(
+                ("epoch-", "meta/", "chunks/", "ops/", "worker-",
+                 "delivery/", "graph/")
+            ):
+                root.remove_key(key)
+    plan["epoch"] = new_epoch
+    log(
+        f"upgraded store at {root.describe()} to {script} "
+        f"(snapshot time {snap_time}, {plan['carried']} carried / "
+        f"{plan['remapped']} remapped / {plan['new']} new / "
+        f"{plan['dropped']} dropped, epoch {new_epoch})"
+    )
+    return plan
+
+
+def _backfill_states(
+    new_doc: dict, new_ops: list[dict], views: list, metas: list[dict],
+    snap_time: int, *, enabled: bool, log: Callable[[str], Any],
+) -> dict[int, tuple[Any, Any]]:
+    """rank -> (initial_state, final_state) for every NEW stateful
+    operator: replay the retained input log (entries at or before the
+    carried snapshot time — the post-snapshot tail replays live at boot)
+    through just the new operators' ancestor subgraph of the offline
+    compile. History before the oldest retained chunk is gone; the plan
+    already warned about that."""
+    import numpy as np  # noqa: F401
+
+    from ..engine.delta import concat_deltas
+    from ..engine.executor import SourceNode, _topological
+
+    nodes = new_doc["nodes"]
+    stateful = new_doc["stateful_nodes"]
+    targets = [stateful[op["rank"]] for op in new_ops]
+
+    def snap(node: Any) -> Any:
+        return pickle.loads(pickle.dumps(node.snapshot_state()))
+
+    initials = {id(n): snap(n) for n in targets}
+    if not enabled:
+        return {
+            op["rank"]: (initials[id(t)], initials[id(t)])
+            for op, t in zip(new_ops, targets)
+        }
+
+    # ancestor closure of the new operators, in topological order
+    wanted: set[int] = set()
+    stack = list(targets)
+    while stack:
+        n = stack.pop()
+        if id(n) in wanted:
+            continue
+        wanted.add(id(n))
+        stack.extend(n.inputs)
+    subgraph = [n for n in _topological(nodes) if id(n) in wanted]
+
+    # the boot-time pid assignment: declared persistent ids, positional
+    # src-{i} fallback in source order (executor._recover)
+    sources = [n for n in subgraph if isinstance(n, SourceNode)]
+    all_sources = [
+        n for n in sorted(nodes, key=lambda x: x.node_id)
+        if isinstance(n, SourceNode)
+    ]
+    pid_of: dict[int, str] = {}
+    for i, src in enumerate(all_sources):
+        pid_of[id(src)] = getattr(src, "persistent_id", None) or f"src-{i}"
+    by_pid = {pid_of[id(s)]: s for s in sources}
+
+    # union of every worker's retained entries up to the snapshot time
+    entries: list[tuple[int, str, Any]] = []
+    for view, m in zip(views, metas):
+        reader = SnapshotReader(
+            view, int(m.get("n_chunks", 0)), int(m.get("first_chunk", 0))
+        )
+        for t, pid, delta in reader.batches(after_time=-1):
+            if int(t) <= snap_time and pid in by_pid:
+                entries.append((int(t), pid, delta))
+    entries.sort(key=lambda e: e[0])
+
+    replayed = 0
+    ticks: dict[int, dict[str, list]] = {}
+    for t, pid, delta in entries:
+        ticks.setdefault(t, {}).setdefault(pid, []).append(delta)
+        replayed += 1
+    for t in sorted(ticks):
+        seeded = ticks[t]
+        outputs: dict[int, Any] = {}
+        for node in subgraph:
+            parts: list[Any] = []
+            released = node.advance_to(t)
+            if released is not None and len(released):
+                parts.append(released)
+            if isinstance(node, SourceNode):
+                for d in seeded.get(pid_of.get(id(node), ""), []):
+                    if len(d):
+                        parts.append(d)
+            else:
+                ins = [outputs.get(id(inp)) for inp in node.inputs]
+                if any(x is not None for x in ins) or node.always_run:
+                    out = node.process(t, ins)
+                    if out is not None and len(out):
+                        parts.append(out)
+            outputs[id(node)] = (
+                concat_deltas(parts, list(node.column_names))
+                if parts
+                else None
+            )
+    log(
+        f"backfilled {len(new_ops)} new operator(s) from {replayed} "
+        f"retained input entr(ies) up to snapshot time {snap_time}"
+    )
+    return {
+        op["rank"]: (initials[id(t)], snap(t))
+        for op, t in zip(new_ops, targets)
+    }

@@ -1,0 +1,231 @@
+"""Graph-version diff/plan engine behind ``pathway-tpu upgrade --plan``.
+
+Matches the fingerprint manifest a running pipeline persisted into its
+store (``persistence/manager.py`` ``graph/manifest``) against a fresh
+build-only compile of the NEW script (the same lint-mode execution
+``pathway-tpu lint`` uses: imports and table building run for real,
+``pw.run`` is stubbed — nothing external opens). Every stateful operator
+gets one verb:
+
+- **carried** — identical structural fingerprint, or pinned ``name=``
+  with an unchanged signature: the persisted snapshot is reused verbatim.
+- **remapped** — pinned name matches but the construction signature
+  drifted compatibly: state is rewritten through the operator's
+  ``split_state``/``merge_states`` protocol.
+- **new** — no match: state is backfilled by replaying the retained
+  input log through just that operator's ancestor subgraph.
+- **dropped** — an old stateful operator with no successor: refused
+  (exit code 2, operator named) unless ``--allow-drop``.
+
+Exit codes mirror ``pathway-tpu lint``: 0 clean, 1 warnings, 2 errors,
+3 the new script crashed while building.
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+from typing import Any
+
+from ..internals import lintmode
+from ..internals.parse_graph import G
+
+__all__ = [
+    "UpgradeError",
+    "load_new_graph",
+    "classify",
+    "plan_exit_code",
+]
+
+
+class UpgradeError(RuntimeError):
+    pass
+
+
+def load_new_graph(
+    script: str, script_args: tuple[str, ...] = ()
+) -> dict[str, Any]:
+    """Build-only compile of ``script``: run it with ``pw.run`` stubbed
+    (lint mode), lower every registered sink, fingerprint the nodes.
+    ``script_args`` becomes ``sys.argv[1:]`` for scripts that parse
+    their command line while building. Returns ``{"crash": exc}`` when
+    the script itself failed, else a manifest-shaped doc plus the live
+    node objects (``"stateful_nodes"``, ``"nodes"``) the migrator needs
+    for remap/backfill."""
+    from ..analysis.graph import fingerprint_nodes, lower_current_graph
+    from ..persistence.manager import build_manifest
+
+    script = os.path.abspath(script)
+    saved_graph = dict(G.__dict__)
+    saved_argv = list(sys.argv)
+    G.clear()
+    lintmode.arm(script)
+    crash: BaseException | None = None
+    nodes: list[Any] = []
+    try:
+        sys.argv = [script, *script_args]
+        try:
+            runpy.run_path(script, run_name="__main__")
+        except SystemExit as e:
+            # argparse --help / sys.exit(0) is not a crash; nonzero is
+            if e.code not in (None, 0):
+                crash = e
+        except BaseException as e:
+            crash = e
+        if crash is None:
+            runner = lower_current_graph()
+            nodes = list(runner._nodes)
+    finally:
+        lintmode.disarm()
+        sys.argv = saved_argv
+        G.__dict__.clear()
+        G.__dict__.update(saved_graph)
+    if crash is not None:
+        return {"crash": crash}
+    fps = fingerprint_nodes(nodes)
+    ordered = sorted(nodes, key=lambda n: n.node_id)
+    stateful = [n for n in ordered if n.has_state()]
+    # the EXACT manifest a boot of this script would persist — matching
+    # against anything else would let plan and runtime disagree
+    doc = build_manifest(stateful, nodes, fps)
+    doc["crash"] = None
+    doc["nodes"] = nodes
+    doc["stateful_nodes"] = stateful
+    return doc
+
+
+def classify(
+    old_manifest: dict[str, Any],
+    new_doc: dict[str, Any],
+    *,
+    allow_drop: bool = False,
+) -> dict[str, Any]:
+    """The migration plan: one entry per stateful operator (old or new),
+    with counts, warnings and errors. Pure function of the two manifests
+    — the migrator executes exactly what this returns."""
+    from .render import op_label
+
+    old_ops = list(old_manifest.get("stateful", []))
+    new_ops = list(new_doc.get("stateful", []))
+    matched_old: set[int] = set()
+    by_fp: dict[tuple[str, str], list[dict]] = {}
+    for e in old_ops:
+        by_fp.setdefault((e["fingerprint"], e["cls"]), []).append(e)
+    by_name = {e["name"]: e for e in old_ops if e.get("name")}
+
+    entries: list[dict[str, Any]] = []
+    errors: list[str] = []
+    warnings: list[str] = []
+    for e in new_ops:
+        entry = {
+            "rank": e["rank"],
+            "old_rank": None,
+            "cls": e["cls"],
+            "fingerprint": e["fingerprint"],
+            "name": e.get("name"),
+            "reshard": e.get("reshard", "keyed"),
+            "verb": "new",
+            "detail": None,
+        }
+        # 1. exact structural identity: two compiles of unchanged code
+        cands = [
+            c for c in by_fp.get((e["fingerprint"], e["cls"]), [])
+            if c["rank"] not in matched_old
+        ]
+        if cands:
+            old = cands[0]
+            matched_old.add(old["rank"])
+            entry.update(verb="carried", old_rank=old["rank"])
+            entries.append(entry)
+            continue
+        # 2. pinned identity survives structural drift
+        name = e.get("name")
+        old = by_name.get(name) if name else None
+        if old is not None and old["rank"] not in matched_old:
+            if old["cls"] != e["cls"]:
+                errors.append(
+                    f"pinned name {name!r} is {old['cls']} in the store "
+                    f"but {e['cls']} in the new script — state cannot "
+                    "migrate across operator classes"
+                )
+                entry["detail"] = (
+                    f"name {name!r} reused for a different class "
+                    f"({old['cls']} -> {e['cls']})"
+                )
+            elif old.get("signature") == e.get("signature"):
+                matched_old.add(old["rank"])
+                entry.update(
+                    verb="carried", old_rank=old["rank"],
+                    detail="pinned name; upstream drift only",
+                )
+            else:
+                matched_old.add(old["rank"])
+                entry.update(
+                    verb="remapped", old_rank=old["rank"],
+                    detail=(
+                        f"signature drifted under pinned name {name!r}"
+                    ),
+                )
+        entries.append(entry)
+
+    for e in old_ops:
+        if e["rank"] in matched_old:
+            continue
+        entry = {
+            "rank": None,
+            "old_rank": e["rank"],
+            "cls": e["cls"],
+            "fingerprint": e["fingerprint"],
+            "name": e.get("name"),
+            "reshard": e.get("reshard", "keyed"),
+            "verb": "dropped",
+            "detail": None,
+        }
+        label = op_label({**entry, "rank": e["rank"]})
+        if allow_drop:
+            warnings.append(
+                f"stateful operator {label} is dropped: its persisted "
+                "state is discarded (--allow-drop)"
+            )
+        else:
+            errors.append(
+                f"stateful operator {label} would be DROPPED and its "
+                "persisted state discarded — rerun with --allow-drop to "
+                "accept, or pin it in the new script via .named(...)"
+            )
+        entries.append(entry)
+
+    old_pids = {
+        s.get("pid") for s in old_manifest.get("sources", []) if s.get("pid")
+    }
+    new_pids = {
+        s.get("pid") for s in new_doc.get("sources", []) if s.get("pid")
+    }
+    gone = sorted(old_pids - new_pids)
+    if gone:
+        warnings.append(
+            f"persisted source id(s) {gone} have no matching source in "
+            "the new script — their recorded tail rows cannot replay"
+        )
+
+    counts = {"carried": 0, "remapped": 0, "new": 0, "dropped": 0}
+    for entry in entries:
+        counts[entry["verb"]] += 1
+    return {
+        "operators": entries,
+        **counts,
+        "warnings": warnings,
+        "errors": errors,
+    }
+
+
+def plan_exit_code(plan: dict[str, Any]) -> int:
+    """lint-style severity exit code: 0 clean, 1 warnings, 2 errors
+    (3 — script crash — is decided by the caller, which holds the
+    exception)."""
+    if plan.get("errors"):
+        return 2
+    if plan.get("warnings"):
+        return 1
+    return 0

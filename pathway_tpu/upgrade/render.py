@@ -1,0 +1,93 @@
+"""Human-readable rendering shared by ``pathway-tpu rescale --dry-run``
+and ``pathway-tpu upgrade --plan``.
+
+Both verbs preview a store migration as a per-operator table; keeping one
+renderer means operators read the same vocabulary in both reports — rank,
+class, reshard mode, structural fingerprint, pinned name, state bytes —
+and a fingerprint printed by a dry run can be grepped verbatim in an
+upgrade plan for the same store.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["render_dry_run", "render_plan", "op_label"]
+
+
+def op_label(op: dict[str, Any]) -> str:
+    """``rank <r> <Cls>`` plus the identity a human can match across
+    reports: the pinned name when one exists, else the fingerprint."""
+    ident = []
+    if op.get("name"):
+        ident.append(f"name={op['name']!r}")
+    if op.get("fingerprint"):
+        ident.append(f"fp={op['fingerprint']}")
+    tail = f" ({', '.join(ident)})" if ident else ""
+    return f"rank {op['rank']} {op['cls']}{tail}"
+
+
+def render_dry_run(report: dict[str, Any]) -> list[str]:
+    """The rescale dry-run preview (previously inlined in cli.py), now
+    fingerprint-aware: operators are identifiable, not just numbered."""
+    lines = [
+        f"dry run: would rescale {report['from']} -> {report['to']} "
+        f"worker(s) at snapshot time {report['snapshot_time']} "
+        f"(epoch {report['epoch']} -> {report['epoch'] + 1}):"
+    ]
+    for op in report.get("operators", []):
+        mb = op.get("state_bytes", 0) / 1e6
+        lines.append(
+            f"  {op_label(op)} [{op['mode']}]: {op['action']} "
+            f"(source snapshot chunks: {op['chunks_per_source']}, "
+            f"state {mb:.2f} MB = {op.get('state_bytes_per_source')} B "
+            "per source, incl. spilled)"
+        )
+    if not report.get("operators"):
+        lines.append("  (no stateful operator snapshots at that time)")
+    total_mb = report.get("state_bytes_total", 0) / 1e6
+    lines.append(
+        f"  total stateful-operator bytes to redistribute: "
+        f"{total_mb:.2f} MB across {report['to']} target worker(s) "
+        f"(~{total_mb / max(1, report['to']):.2f} MB/worker)"
+    )
+    lines.append(
+        "  input tail chunks to re-route per source worker: "
+        f"{report.get('tail_chunks_per_source')}"
+    )
+    return lines
+
+
+_VERB_GLOSS = {
+    "carried": "snapshot reused verbatim",
+    "remapped": "state rewritten via split_state/merge_states",
+    "new": "backfilled from the retained input log",
+    "dropped": "persisted state discarded",
+}
+
+
+def render_plan(plan: dict[str, Any]) -> list[str]:
+    """The upgrade plan: every old/new stateful operator with its verb
+    (carried / remapped / new / dropped), then warnings and errors."""
+    lines = [
+        f"upgrade plan: {plan['store']} (epoch {plan['epoch']}, "
+        f"{plan['n_workers']} worker(s), snapshot time "
+        f"{plan['snapshot_time']}) -> {plan['script']}:"
+    ]
+    for op in plan.get("operators", []):
+        gloss = _VERB_GLOSS.get(op["verb"], "")
+        detail = f" — {op['detail']}" if op.get("detail") else ""
+        lines.append(
+            f"  [{op['verb']:>8}] {op_label(op)}: {gloss}{detail}"
+        )
+    if not plan.get("operators"):
+        lines.append("  (no stateful operators on either side)")
+    counts = ", ".join(
+        f"{plan.get(v, 0)} {v}" for v in ("carried", "remapped", "new", "dropped")
+    )
+    lines.append(f"  operators: {counts}")
+    for w in plan.get("warnings", []):
+        lines.append(f"  warning: {w}")
+    for e in plan.get("errors", []):
+        lines.append(f"  error: {e}")
+    return lines

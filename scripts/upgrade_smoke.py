@@ -1,0 +1,410 @@
+"""Zero-downtime upgrade smoke test: kill a persisted cluster, migrate
+its state to a NEW code version, and resume exactly-once.
+
+The graph-version analog of ``rescale_smoke.py``, exercising the whole
+``pathway_tpu/upgrade`` surface end to end with real processes:
+
+1. a two-process sharded wordcount (v1) runs persisted and is SIGKILLed
+   mid-stream by a fault plan (hard death, state left mid-flight);
+2. ``pathway-tpu upgrade --plan`` classifies v2 — which renames Rowwise
+   variables (pure rename: fingerprints hold, the untouched groupby is
+   CARRIED), flips the pinned groupby's error semantics (`.named` pin +
+   signature drift: REMAPPED), and adds a reducer (NEW, backfilled from
+   the retained input log);
+3. ``spawn --supervise --store ... --upgrade-to v2.py`` migrates the
+   layout (staged under ``upgrade-tmp/``, ONE atomic marker put) and
+   resumes v2 on the same two workers: final counts are EXACT across
+   code versions, with zero duplicate sink deliveries (ack cursors
+   carried);
+4. on pristine copies of the crashed v1 state, chaos faults fire at
+   EVERY migration phase (plan/stage/backfill/carry/promote: kill;
+   stage: torn write) — the OLD version must stay bootable, proven by
+   marker inspection everywhere and a supervised v1 boot after the
+   promote-phase kill; a cleanup-phase kill lands AFTER the marker put,
+   so the NEW version must boot.
+
+Usable standalone (``python scripts/upgrade_smoke.py`` → exit 0/1) and
+as a tier-1 test (``tests/test_upgrade_smoke.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXPECTED = {"foo": 10, "bar": 5, "baz": 5}
+#: v2's added reducer: sum of word lengths per word
+EXPECTED_LENS = {"foo": 30, "bar": 15, "baz": 15}
+
+_V1 = """
+import json, sys, time
+
+import pathway_tpu as pw
+from pathway_tpu.persistence import Backend, Config
+
+out_path = sys.argv[1] if len(sys.argv) > 1 else "/dev/null"
+pstate = sys.argv[2] if len(sys.argv) > 2 else "pstate-scratch"
+
+WORDS = ["foo", "bar", "foo", "baz"] * 5
+
+
+class S(pw.io.python.ConnectorSubject):
+    def run(self):
+        for w in WORDS:
+            self.next(word=w)
+            self.commit()
+            time.sleep(0.02)
+
+
+t = pw.io.python.read(
+    S(), schema=pw.schema_from_types(word=str), name="words",
+    autocommit_ms=None,
+)
+shouted = t.select(
+    word=pw.this.word,
+    loud=pw.apply_with_type(lambda w: w.upper(), str, pw.this.word),
+)
+counts = shouted.groupby(pw.this.word).reduce(
+    pw.this.word, c=pw.reducers.count()
+).named("tally")
+f = open(out_path, "a")
+
+
+def on_change(key, row, time, is_addition):
+    f.write(json.dumps([row["word"], int(row["c"]), bool(is_addition)]) + "\\n")
+    f.flush()
+
+
+pw.io.subscribe(counts, on_change=on_change, name="counts")
+cfg = Config.simple_config(Backend.filesystem(pstate), snapshot_interval_ms=10)
+pw.run(persistence_config=cfg)
+"""
+
+#: v2 = v1 with Rowwise variables RENAMED (t->rows, shouted->yelled,
+#: lambda w->token: fingerprints must not move), the pinned groupby's
+#: error semantics flipped (signature drift under the `.named` pin ->
+#: remapped), and a SECOND reducer added (new operator, backfilled)
+_V2 = """
+import json, sys, time
+
+import pathway_tpu as pw
+from pathway_tpu.persistence import Backend, Config
+
+out_path = sys.argv[1] if len(sys.argv) > 1 else "/dev/null"
+pstate = sys.argv[2] if len(sys.argv) > 2 else "pstate-scratch"
+
+WORDS = ["foo", "bar", "foo", "baz"] * 5
+
+
+class S(pw.io.python.ConnectorSubject):
+    def run(self):
+        for w in WORDS:
+            self.next(word=w)
+            self.commit()
+            time.sleep(0.02)
+
+
+rows = pw.io.python.read(
+    S(), schema=pw.schema_from_types(word=str), name="words",
+    autocommit_ms=None,
+)
+yelled = rows.select(
+    word=pw.this.word,
+    loud=pw.apply_with_type(lambda token: token.upper(), str, pw.this.word),
+)
+counts = yelled.groupby(pw.this.word, _skip_errors=False).reduce(
+    pw.this.word, c=pw.reducers.count()
+).named("tally")
+lens = yelled.groupby(pw.this.word).reduce(
+    pw.this.word,
+    total_len=pw.reducers.sum(pw.apply_with_type(len, int, pw.this.word)),
+)
+f = open(out_path, "a")
+
+
+def on_change(key, row, time, is_addition):
+    f.write(json.dumps([row["word"], int(row["c"]), bool(is_addition)]) + "\\n")
+    f.flush()
+
+
+def on_len(key, row, time, is_addition):
+    f.write(json.dumps(["len:" + row["word"], int(row["total_len"]),
+                        bool(is_addition)]) + "\\n")
+    f.flush()
+
+
+pw.io.subscribe(counts, on_change=on_change, name="counts")
+pw.io.subscribe(lens, on_change=on_len, name="lens")
+cfg = Config.simple_config(Backend.filesystem(pstate), snapshot_interval_ms=10)
+pw.run(persistence_config=cfg)
+"""
+
+#: SIGKILL worker 1 at its 8th tick — a hard mid-stream death of the
+#: 2-process v1 generation
+KILL_PLAN = {
+    "seed": 7,
+    "faults": [
+        {"site": "tick", "worker": 1, "tick": 8, "action": "kill", "run": 0},
+    ],
+}
+
+
+def _upgrade_fault(phase: str, action: str) -> dict:
+    return {
+        "seed": 7,
+        "faults": [{"site": "upgrade", "phase": phase, "action": action}],
+    }
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _events(path: str) -> list:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:  # a SIGKILL may tear the last line mid-write
+                out.append(json.loads(line))
+            except (json.JSONDecodeError, ValueError):
+                pass
+    return out
+
+
+def _finals(events: list) -> dict:
+    final: dict = {}
+    for e in events:
+        if len(e) == 3 and e[2]:
+            final[e[0]] = e[1]
+    return final
+
+
+def _marker(pstate: str) -> dict:
+    with open(os.path.join(pstate, "cluster")) as f:
+        return json.load(f)
+
+
+def _spawn(args, env, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "pathway_tpu.cli", *args],
+        env=env, timeout=timeout, capture_output=True, text=True,
+    )
+
+
+def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
+    tmp = workdir or tempfile.mkdtemp(prefix="upgrade_smoke_")
+    v1 = os.path.join(tmp, "v1.py")
+    v2 = os.path.join(tmp, "v2.py")
+    with open(v1, "w") as f:
+        f.write(textwrap.dedent(_V1))
+    with open(v2, "w") as f:
+        f.write(textwrap.dedent(_V2))
+    pstate = os.path.join(tmp, "pstate")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo_root,
+        "PATHWAY_FLIGHT_DIR": os.path.join(tmp, "flight"),
+        "PATHWAY_SUPERVISE_BACKOFF_S": "0.05",
+        "PATHWAY_SUPERVISE_BACKOFF_MAX_S": "0.2",
+        # keep the full input log so the operator v2 adds can backfill
+        # from ALL history (the upgrade-aware retention knob)
+        "PATHWAY_UPGRADE_RETAIN_LOG": "1",
+    }
+    base_env.pop("PATHWAY_FAULT_PLAN", None)
+
+    # -- 1. two-process persisted v1 run, SIGKILLed mid-stream ------------
+    out_a = os.path.join(tmp, "events_a.jsonl")
+    proc = _spawn(
+        ["spawn", "-n", "2", "-t", "1", "--first-port", str(_free_port()),
+         sys.executable, v1, out_a, pstate],
+        {**base_env, "PATHWAY_FAULT_PLAN": json.dumps(KILL_PLAN)},
+    )
+    assert proc.returncode != 0, (
+        "the fault plan should have killed generation 0\n"
+        f"stderr:\n{proc.stderr[-2000:]}"
+    )
+    killed_events = _events(out_a)
+    killed_finals = _finals(killed_events)
+    assert killed_finals != EXPECTED, (
+        "the killed run finished the whole stream before the planned kill"
+    )
+    old_marker = _marker(pstate)
+    old_epoch = old_marker.get("epoch", 0)
+    assert old_marker["n_workers"] == 2
+
+    # pristine copies of the crashed state for the chaos matrix
+    copies = {}
+    chaos_matrix = [
+        ("plan", "kill"), ("stage", "kill"), ("stage", "torn"),
+        ("backfill", "kill"), ("carry", "kill"), ("promote", "kill"),
+        ("cleanup", "kill"),
+    ]
+    for phase, action in chaos_matrix:
+        dst = os.path.join(tmp, f"pstate_{phase}_{action}")
+        shutil.copytree(pstate, dst)
+        copies[(phase, action)] = dst
+
+    # -- 2. the plan: carried + remapped + new, nothing dropped -----------
+    proc = _spawn(
+        ["upgrade", "--plan", "--json", pstate, v2, "/dev/null",
+         os.path.join(tmp, "scratch")],
+        base_env,
+    )
+    assert proc.returncode == 0, (
+        f"upgrade --plan exited {proc.returncode}\n"
+        f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n{proc.stderr[-2000:]}"
+    )
+    plan = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert plan["remapped"] == 1 and plan["new"] == 1, plan
+    assert plan["dropped"] == 0 and plan["errors"] == [], plan
+    verbs = {e["verb"] for e in plan["operators"]}
+    assert verbs == {"remapped", "new"}, plan["operators"]
+
+    # -- 3. supervised migrate-and-boot: spawn --upgrade-to ---------------
+    out_b = os.path.join(tmp, "events_b.jsonl")
+    proc = _spawn(
+        ["spawn", "--supervise", "-n", "2", "-t", "1",
+         "--first-port", str(_free_port()),
+         "--store", pstate, "--upgrade-to", v2,
+         sys.executable, v2, out_b, pstate],
+        base_env,
+    )
+    assert proc.returncode == 0, (
+        f"upgraded supervised run exited {proc.returncode}\n"
+        f"stderr:\n{proc.stderr[-3000:]}"
+    )
+    assert _marker(pstate).get("epoch", 0) == old_epoch + 1
+    resumed_events = _events(out_b)
+    count_events = [e for e in killed_events + resumed_events
+                    if not str(e[0]).startswith("len:")]
+    # exactly-once across code versions: no delivery is ever repeated
+    seen = [tuple(e) for e in count_events]
+    assert len(seen) == len(set(seen)), (
+        "duplicate sink deliveries across the upgrade: "
+        f"{[e for e in seen if seen.count(e) > 1][:10]}"
+    )
+    final = _finals(count_events)
+    assert final == EXPECTED, (
+        f"final counts after upgrade {final} != {EXPECTED}\n"
+        f"stderr:\n{proc.stderr[-2000:]}"
+    )
+    lens_final = {
+        k[len("len:"):]: v
+        for k, v in _finals(resumed_events).items()
+        if str(k).startswith("len:")
+    }
+    assert lens_final == EXPECTED_LENS, (
+        f"backfilled reducer converged to {lens_final} != {EXPECTED_LENS}"
+    )
+
+    # -- 4. chaos at every phase: the old version stays bootable ----------
+    for phase, action in chaos_matrix:
+        store = copies[(phase, action)]
+        proc = _spawn(
+            ["upgrade", "--apply", store, v2, "/dev/null",
+             os.path.join(tmp, "scratch")],
+            {**base_env,
+             "PATHWAY_FAULT_PLAN": json.dumps(_upgrade_fault(phase, action))},
+        )
+        assert proc.returncode != 0, (
+            f"the {phase}/{action} fault did not fire\n"
+            f"stdout:\n{proc.stdout[-1000:]}\nstderr:\n{proc.stderr[-1000:]}"
+        )
+        marker = _marker(store)
+        if phase == "cleanup":
+            # cleanup faults land AFTER the atomic marker put: the NEW
+            # version owns the store
+            assert marker.get("epoch", 0) == old_epoch + 1, (
+                f"{phase}/{action}: marker {marker} should be promoted"
+            )
+        else:
+            assert marker == old_marker, (
+                f"{phase}/{action}: marker drifted to {marker} — the old "
+                "layout is no longer the bootable one"
+            )
+
+    # -- 5. boot OLD v1 after the promote-phase kill (worst case) ---------
+    out_c = os.path.join(tmp, "events_c.jsonl")
+    store = copies[("promote", "kill")]
+    proc = _spawn(
+        ["spawn", "--supervise", "-n", "2", "-t", "1",
+         "--first-port", str(_free_port()),
+         sys.executable, v1, out_c, store],
+        base_env,
+    )
+    assert proc.returncode == 0, (
+        f"v1 boot after promote-phase kill exited {proc.returncode}\n"
+        f"stderr:\n{proc.stderr[-3000:]}"
+    )
+    final_c = dict(killed_finals)
+    final_c.update(_finals(_events(out_c)))
+    assert final_c == EXPECTED, (
+        f"old-version recovery after chaos {final_c} != {EXPECTED}"
+    )
+
+    # -- 6. boot NEW v2 after the cleanup-phase kill (already promoted) ---
+    out_d = os.path.join(tmp, "events_d.jsonl")
+    store = copies[("cleanup", "kill")]
+    proc = _spawn(
+        ["spawn", "--supervise", "-n", "2", "-t", "1",
+         "--first-port", str(_free_port()),
+         sys.executable, v2, out_d, store],
+        base_env,
+    )
+    assert proc.returncode == 0, (
+        f"v2 boot after cleanup-phase kill exited {proc.returncode}\n"
+        f"stderr:\n{proc.stderr[-3000:]}"
+    )
+    final_d = dict(killed_finals)
+    final_d.update({
+        k: v for k, v in _finals(_events(out_d)).items()
+        if not str(k).startswith("len:")
+    })
+    assert final_d == EXPECTED, (
+        f"new-version recovery after cleanup chaos {final_d} != {EXPECTED}"
+    )
+
+    if verbose:
+        print(
+            f"upgrade_smoke: killed at {killed_finals}, upgraded plan "
+            f"remapped={plan['remapped']} new={plan['new']}, resumed -> "
+            f"{final} lens={lens_final}, chaos matrix "
+            f"{len(chaos_matrix)} faults OK"
+        )
+    return {
+        "final": final,
+        "lens_final": lens_final,
+        "plan": plan,
+        "old_boot_final": final_c,
+        "new_boot_final": final_d,
+    }
+
+
+def main() -> int:
+    try:
+        run_smoke(verbose=True)
+    except BaseException as e:  # noqa: BLE001 — CLI exit-code surface
+        print(f"upgrade_smoke FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    print("upgrade_smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

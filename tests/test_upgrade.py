@@ -1,0 +1,319 @@
+"""Graph-version upgrades: fingerprint stability, plan classification,
+severity exit codes, and a single-process end-to-end apply.
+
+The chaos-proof multi-process story (kill at every migration phase, old
+version bootable, supervised resume with exactly-once output) lives in
+``scripts/upgrade_smoke.py`` / ``tests/test_upgrade_smoke.py``; this file
+covers the pure layers underneath it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from pathway_tpu.upgrade import (
+    UpgradeError,
+    classify,
+    load_new_graph,
+    plan_exit_code,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _script(tmp_path, name, body):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(body))
+    return str(path)
+
+
+#: a minimal persisted wordcount; placeholders let variants rename
+#: variables or tweak structure without touching anything else
+_BASE = """
+import sys
+import pathway_tpu as pw
+
+class S(pw.io.python.ConnectorSubject):
+    def run(self):
+        pass
+
+{table} = pw.io.python.read(
+    S(), schema=pw.schema_from_types(word=str), name="words",
+    autocommit_ms=None,
+)
+{select} = {table}.select(
+    word=pw.this.word,
+    loud=pw.apply_with_type(lambda {param}: {param}.upper(), str, pw.this.word),
+)
+{counts} = {select}.groupby(pw.this.word{gb_extra}).reduce(
+    pw.this.word, c=pw.reducers.count()
+){named}
+pw.io.subscribe({counts}, on_change=lambda **kw: None)
+pw.run()
+"""
+
+
+def _variant(tmp_path, name, *, table="t", select="shouted", counts="counts",
+             param="w", gb_extra="", named=""):
+    return _script(
+        tmp_path, name,
+        _BASE.format(table=table, select=select, counts=counts, param=param,
+                     gb_extra=gb_extra, named=named),
+    )
+
+
+def _load(script):
+    doc = load_new_graph(script)
+    assert doc.get("crash") is None, doc.get("crash")
+    return doc
+
+
+# -- fingerprint stability under pure renames ------------------------------
+
+
+def test_pure_rename_keeps_fingerprints(tmp_path):
+    """Identical structure + renamed Python variables (including lambda
+    parameters) must produce bit-identical fingerprints — otherwise every
+    cosmetic refactor orphans the persisted store."""
+    a = _load(_variant(tmp_path, "a.py"))
+    b = _load(
+        _variant(tmp_path, "b.py", table="rows", select="yelled",
+                 counts="tallies", param="token")
+    )
+    assert [e["fingerprint"] for e in a["stateful"]] == [
+        e["fingerprint"] for e in b["stateful"]
+    ]
+    assert [s["fingerprint"] for s in a["sources"]] == [
+        s["fingerprint"] for s in b["sources"]
+    ]
+    plan = classify(a, b)
+    assert plan["carried"] == len(a["stateful"])
+    assert plan["remapped"] == plan["new"] == plan["dropped"] == 0
+    assert plan["errors"] == [] and plan["warnings"] == []
+    assert plan_exit_code(plan) == 0
+
+
+def test_structural_tweak_moves_fingerprint(tmp_path):
+    """The complement: an actual structural change (groupby error
+    semantics) must move the fingerprint, or drifted code would silently
+    reuse incompatible state."""
+    a = _load(_variant(tmp_path, "a.py"))
+    c = _load(_variant(tmp_path, "c.py", gb_extra=", _skip_errors=False"))
+    assert [e["fingerprint"] for e in a["stateful"]] != [
+        e["fingerprint"] for e in c["stateful"]
+    ]
+
+
+def test_named_pin_survives_structural_tweak(tmp_path):
+    """`.named()` is the remap hook: same pinned name + drifted signature
+    classifies as remapped (state rewritten through split/merge), not as
+    a drop+new pair."""
+    old = _load(_variant(tmp_path, "old.py", named='.named("tally")'))
+    new = _load(
+        _variant(tmp_path, "new.py", param="token",
+                 gb_extra=", _skip_errors=False", named='.named("tally")')
+    )
+    assert [e["name"] for e in old["stateful"]] == ["tally"]
+    plan = classify(old, new)
+    ops = [e for e in plan["operators"] if e["verb"] == "remapped"]
+    assert len(ops) == 1 and ops[0]["name"] == "tally"
+    assert ops[0]["old_rank"] == old["stateful"][0]["rank"]
+    assert plan["dropped"] == 0 and plan["errors"] == []
+
+
+def test_named_pin_same_signature_is_carried(tmp_path):
+    """A pinned name whose signature did NOT drift (only upstream
+    changed) is carried verbatim — remap machinery stays out of the way."""
+    old = _load(_variant(tmp_path, "old.py", named='.named("tally")'))
+    new = _load(
+        _script(
+            tmp_path, "new.py",
+            _BASE.format(
+                table="t", select="shouted", counts="counts", param="w",
+                gb_extra="", named='.named("tally")',
+            ).replace("w.upper()", "w.lower()"),
+        )
+    )
+    # guard: the upstream tweak actually moved the groupby's fingerprint
+    plan = classify(old, new)
+    [op] = plan["operators"]
+    assert op["verb"] == "carried"
+    assert op["detail"] is None or "pinned" in op["detail"]
+
+
+# -- classification and exit codes over synthetic manifests ----------------
+
+
+def _op(rank, cls="GroupByReduce", fp="aa", name=None, sig="s0",
+        reshard="keyed"):
+    return {"rank": rank, "cls": cls, "fingerprint": fp, "name": name,
+            "signature": sig, "reshard": reshard}
+
+
+def test_classify_dropped_stateful_is_an_error():
+    old = {"stateful": [_op(0, fp="dead")], "sources": []}
+    new = {"stateful": [], "sources": []}
+    plan = classify(old, new)
+    assert plan["dropped"] == 1
+    assert len(plan["errors"]) == 1
+    assert "DROPPED" in plan["errors"][0]
+    assert "GroupByReduce" in plan["errors"][0]
+    assert plan_exit_code(plan) == 2
+
+
+def test_classify_allow_drop_downgrades_to_warning():
+    old = {"stateful": [_op(0, fp="dead")], "sources": []}
+    new = {"stateful": [], "sources": []}
+    plan = classify(old, new, allow_drop=True)
+    assert plan["dropped"] == 1 and plan["errors"] == []
+    assert len(plan["warnings"]) == 1
+    assert plan_exit_code(plan) == 1
+
+
+def test_classify_pinned_name_cross_class_refused():
+    old = {"stateful": [_op(0, cls="GroupByReduce", name="x")],
+           "sources": []}
+    new = {"stateful": [_op(0, cls="Deduplicate", fp="bb", name="x",
+                            sig="s1")],
+           "sources": []}
+    plan = classify(old, new)
+    assert any("cannot migrate across operator classes" in e
+               for e in plan["errors"])
+    # the old op is also unmatched -> dropped without --allow-drop
+    assert plan["dropped"] == 1
+    assert plan_exit_code(plan) == 2
+
+
+def test_classify_gone_source_warns():
+    old = {"stateful": [], "sources": [{"pid": "words", "cls": "X",
+                                        "fingerprint": "ff"}]}
+    new = {"stateful": [], "sources": []}
+    plan = classify(old, new)
+    assert any("words" in w for w in plan["warnings"])
+    assert plan_exit_code(plan) == 1
+
+
+def test_classify_duplicate_fingerprints_pair_one_to_one():
+    """Two structurally identical operators must match 1:1, not both onto
+    the same old snapshot."""
+    old = {"stateful": [_op(0), _op(1)], "sources": []}
+    new = {"stateful": [_op(0), _op(1)], "sources": []}
+    plan = classify(old, new)
+    assert plan["carried"] == 2 and plan["dropped"] == 0
+    assert sorted(e["old_rank"] for e in plan["operators"]) == [0, 1]
+
+
+# -- end-to-end: persisted run -> apply -> boot (single process) -----------
+
+
+_RUN = """
+import json, sys
+import pathway_tpu as pw
+from pathway_tpu.persistence import Backend, Config
+
+out_path = sys.argv[1] if len(sys.argv) > 1 else "/dev/null"
+pstate = sys.argv[2] if len(sys.argv) > 2 else "pstate-scratch"
+WORDS = ["foo", "bar", "foo", "baz"] * 3
+
+class S(pw.io.python.ConnectorSubject):
+    def run(self):
+        for w in WORDS:
+            self.next(word=w)
+            self.commit()
+
+t = pw.io.python.read(S(), schema=pw.schema_from_types(word=str),
+                      name="words", autocommit_ms=None)
+counts = t.groupby(pw.this.word).reduce(pw.this.word,
+                                        c=pw.reducers.count())
+f = open(out_path, "a")
+pw.io.subscribe(counts, on_change=lambda key, row, time, is_addition:
+                (f.write(json.dumps([row["word"], int(row["c"]),
+                                     bool(is_addition)]) + chr(10)),
+                 f.flush()))
+cfg = Config.simple_config(Backend.filesystem(pstate),
+                           snapshot_interval_ms=10)
+pw.run(persistence_config=cfg)
+"""
+
+#: same pipeline plus a second (new) reducer over the same groupby chain
+_RUN_V2 = _RUN.replace(
+    'pw.io.subscribe(counts',
+    'lens = t.groupby(pw.this.word).reduce(pw.this.word,'
+    ' total_len=pw.reducers.sum(pw.apply_with_type(len, int,'
+    ' pw.this.word)))\n'
+    'pw.io.subscribe(lens, on_change=lambda **kw: None)\n'
+    'pw.io.subscribe(counts',
+)
+
+
+def test_apply_end_to_end(tmp_path):
+    from pathway_tpu.persistence import Backend
+    from pathway_tpu.upgrade import apply_upgrade, plan_upgrade
+
+    old = _script(tmp_path, "old.py", _RUN)
+    new = _script(tmp_path, "new.py", _RUN_V2)
+    pstate = str(tmp_path / "pstate")
+    out = str(tmp_path / "events.jsonl")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO_ROOT}
+    env.pop("PATHWAY_FAULT_PLAN", None)
+    proc = subprocess.run(
+        [sys.executable, old, out, pstate], env=env, timeout=180,
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    spec = Backend.filesystem(pstate)
+    plan, crash = plan_upgrade(spec, new, script_args=("/dev/null",))
+    assert crash is None
+    assert plan["carried"] == 1 and plan["new"] == 1
+    assert plan["dropped"] == 0 and plan["errors"] == []
+
+    report = apply_upgrade(spec, new, script_args=("/dev/null",))
+    assert report["epoch"] == plan["epoch"] + 1
+    marker = json.loads((tmp_path / "pstate" / "cluster").read_text())
+    assert marker["epoch"] == report["epoch"]
+    # staging fully swept, the new epoch's layout present
+    assert not list((tmp_path / "pstate" / "upgrade-tmp").rglob("*")) or all(
+        p.is_dir()
+        for p in (tmp_path / "pstate" / "upgrade-tmp").rglob("*")
+    )
+    assert (tmp_path / "pstate" / f"epoch-{report['epoch']}").is_dir()
+
+    # re-apply is a noop: same manifest, no epoch churn
+    again = apply_upgrade(spec, new, script_args=("/dev/null",))
+    assert again.get("noop") is True
+    assert json.loads(
+        (tmp_path / "pstate" / "cluster").read_text()
+    )["epoch"] == report["epoch"]
+
+    # the upgraded store boots under the NEW script with zero duplicate
+    # deliveries (stream already fully consumed -> nothing re-emitted)
+    before = (tmp_path / "events.jsonl").read_text()
+    proc = subprocess.run(
+        [sys.executable, new, out, pstate], env=env, timeout=180,
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert (tmp_path / "events.jsonl").read_text() == before
+
+
+def test_plan_on_unbooted_store_raises(tmp_path):
+    from pathway_tpu.persistence import Backend
+    from pathway_tpu.upgrade import plan_upgrade
+
+    script = _script(tmp_path, "new.py", _RUN)
+    store = tmp_path / "empty"
+    store.mkdir()
+    with pytest.raises(UpgradeError):
+        plan_upgrade(Backend.filesystem(str(store)), script)
+
+
+def test_crashing_script_reports_exit_3(tmp_path):
+    bad = _script(tmp_path, "bad.py", "raise RuntimeError('boom')\n")
+    doc = load_new_graph(bad)
+    assert doc.get("crash") is not None
+    assert "boom" in str(doc["crash"])

@@ -1,0 +1,28 @@
+"""Tier-1 wrapper around scripts/upgrade_smoke.py (like test_rescale_smoke):
+a 2-process persisted wordcount is SIGKILLed mid-stream, its state is
+migrated to a NEW code version (`pathway-tpu upgrade` / `spawn
+--upgrade-to`) — Rowwise renames carry, the pinned groupby remaps, an
+added reducer backfills — and the supervised resume converges to EXACT
+final counts with zero duplicate deliveries; chaos faults at every
+migration phase leave the old version bootable."""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    ),
+)
+
+
+def test_upgrade_smoke(tmp_path):
+    from upgrade_smoke import EXPECTED, EXPECTED_LENS, run_smoke
+
+    result = run_smoke(workdir=str(tmp_path))
+    assert result["final"] == EXPECTED
+    assert result["lens_final"] == EXPECTED_LENS
+    assert result["old_boot_final"] == EXPECTED
+    assert result["new_boot_final"] == EXPECTED
+    assert result["plan"]["dropped"] == 0
