@@ -236,6 +236,7 @@ def main() -> None:
     t2_ab = _uniform_t2_ab()
     skew = _skew_lane()
     lineage = _lineage_lane()
+    ingest_stage = _ingest_stage_lane()
     from pathway_tpu.io.python import INGEST_BUILD_STATS as _IBS
 
     ingest_build = {
@@ -335,6 +336,13 @@ def main() -> None:
             # rows/s A/B (budget <= 3%)
             "latency_lineage": lineage,
             "ingest_build": ingest_build,
+            # continuous profiling + ingest cost split (observability/
+            # profiler.py + io/python.INGEST_STAGE_STATS): parse/hash/
+            # delta seconds per connector flush (must sum to the build
+            # wall within 10%) and the profiler's whole-pipeline tax as
+            # a fresh-process PATHWAY_PROFILE on/off rows/s A/B
+            # (budget <= 3%)
+            "ingest_stage_split": ingest_stage,
             "host_cores": n_cores,
             "sharded_note": (
                 "host exposes ONE core: N workers time-slice it, so "
@@ -1229,6 +1237,138 @@ def _skew_lane(reps: int = 3) -> dict | None:
         "total_s": round(best_async["total_s"], 3),
         "reps": [round(d["rows_per_sec"], 1) for d in async_reps],
         "reps_bsp": [round(d["rows_per_sec"], 1) for d in bsp_reps],
+    }
+
+
+_INGEST_STAGE_PROG = """
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+from pathway_tpu.utils.jaxcfg import guard_cpu_platform
+guard_cpu_platform()
+import pathway_tpu as pw
+
+N_ROWS, BATCH = {n_rows}, 5_000
+words = [f"w{{i % 997}}" for i in range(N_ROWS)]
+
+
+class Feed(pw.io.python.ConnectorSubject):
+    def run(self):
+        for s in range(0, N_ROWS, BATCH):
+            self.next_batch({{"word": words[s:s + BATCH]}})
+            self.commit()
+
+
+t = pw.io.python.read(
+    Feed(), schema=pw.schema_from_types(word=str), name="words",
+    autocommit_duration_ms=None,
+)
+counts = t.groupby(pw.this.word).reduce(
+    pw.this.word, c=pw.reducers.count()
+)
+pw.io.subscribe(counts, on_batch=lambda t_, b: None)
+t0 = time.perf_counter()
+pw.run()
+elapsed = max(time.perf_counter() - t0, 1e-9)
+from pathway_tpu.io.python import INGEST_BUILD_STATS, INGEST_STAGE_STATS
+print(json.dumps({{
+    "rows_per_sec": N_ROWS / elapsed,
+    "build_wall_s": (
+        INGEST_BUILD_STATS["subject_ns"] + INGEST_BUILD_STATS["engine_ns"]
+    ) / 1e9,
+    "parse_s": INGEST_STAGE_STATS["parse_ns"] / 1e9,
+    "hash_s": INGEST_STAGE_STATS["hash_ns"] / 1e9,
+    "delta_s": INGEST_STAGE_STATS["delta_ns"] / 1e9,
+    "rows": INGEST_STAGE_STATS["rows"],
+    "flushes": INGEST_STAGE_STATS["flushes"],
+}}))
+"""
+
+
+def _ingest_stage_lane(reps: int = 2) -> dict | None:
+    """``ingest_stage_split``: where connector ingest wall time goes —
+    parse (column extraction) / hash (key mixing) / delta (Delta assembly
+    + per-flush concat) — from the staged counters riding the
+    INGEST_BUILD_STATS seam (io/python.py), on a fused wordcount fed via
+    ``next_batch``. Two fresh-process arms differing only in
+    ``PATHWAY_PROFILE``: the on-arm reports the split (its three stages
+    must sum to the measured ingest build wall within 10% — anything
+    bigger means an untimed region snuck into the seam), and the rows/s
+    ratio of the arms is the continuous profiler's whole-pipeline
+    overhead (sampler thread + op tagging + stage counters; budget <=
+    3%). Both arms run monitoring+signals (ephemeral port) so the ONLY
+    delta is the profiling plane itself."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    prog = _INGEST_STAGE_PROG.format(repo=repo, n_rows=100_000)
+
+    def arm(profile: str) -> dict | None:
+        best: dict | None = None
+        for _ in range(reps):
+            env = {
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "PATHWAY_PROFILE": profile,
+                "PATHWAY_MONITORING_HTTP_SERVER": "1",
+                "PATHWAY_MONITORING_HTTP_PORT": "0",
+            }
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c", prog], env=env,
+                    capture_output=True, text=True, timeout=600,
+                )
+            except subprocess.TimeoutExpired:
+                print("bench: ingest stage rep timed out", file=sys.stderr)
+                return best
+            if r.returncode != 0:
+                print(
+                    f"bench: ingest stage rep failed (rc={r.returncode}):\n"
+                    f"{r.stderr.strip()[-2000:]}",
+                    file=sys.stderr,
+                )
+                return best
+            try:
+                rep = json.loads(r.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                print(
+                    f"bench: ingest stage output unreadable: "
+                    f"{r.stdout[-500:]}", file=sys.stderr,
+                )
+                return best
+            if best is None or rep["rows_per_sec"] > best["rows_per_sec"]:
+                best = rep
+        return best
+
+    on = arm("1")
+    off = arm("0")
+    if not on or not off or not on.get("flushes"):
+        return None
+    stage_sum = on["parse_s"] + on["hash_s"] + on["delta_s"]
+    wall = on["build_wall_s"]
+    split_gap_pct = (
+        abs(stage_sum - wall) / wall * 100.0 if wall > 0 else 0.0
+    )
+    overhead_pct = (
+        (off["rows_per_sec"] - on["rows_per_sec"])
+        / off["rows_per_sec"] * 100.0
+    )
+    return {
+        "parse_s": round(on["parse_s"], 4),
+        "hash_s": round(on["hash_s"], 4),
+        "delta_s": round(on["delta_s"], 4),
+        "stage_sum_s": round(stage_sum, 4),
+        "build_wall_s": round(wall, 4),
+        "split_gap_pct": round(split_gap_pct, 2),
+        "split_ok": split_gap_pct <= 10.0,
+        "rows": int(on["rows"]),
+        "flushes": int(on["flushes"]),
+        "rows_per_sec": round(on["rows_per_sec"], 1),
+        "rows_per_sec_profile_off": round(off["rows_per_sec"], 1),
+        # negative = the on-arm measured faster (pure noise floor)
+        "profile_overhead_pct": round(overhead_pct, 2),
+        "profile_overhead_ok": overhead_pct <= 3.0,
     }
 
 

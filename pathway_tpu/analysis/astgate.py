@@ -767,3 +767,79 @@ def latency_lineage_gate() -> list[str]:
             "(stage_hists/note_e2e)"
         )
     return problems
+
+
+# ---------------------------------------------------------------------------
+# gate: continuous profiling plane (observability/profiler.py)
+# ---------------------------------------------------------------------------
+
+
+@gate(
+    "profile_metrics",
+    "continuous-profiler and ingest-stage counters ship end to end: hub "
+    "/snapshot+/query docs, pathway_profile_*/pathway_ingest_stage_* on "
+    "/metrics, and the profile.*/ingest.* signals series",
+)
+def profile_metrics_gate() -> list[str]:
+    """A sampling profiler that only answers ``/profile`` is a debugger,
+    not a plane: its health scalars (sample counts, op-tag share) and
+    the ingest parse/hash/delta split must flow through the same
+    snapshot → prometheus → signals path every other counter takes, or
+    regressions in the profiler itself go unnoticed."""
+    problems: list[str] = []
+    hub_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "hub.py")
+    )
+    prom_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "prometheus.py")
+    )
+    ts_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "timeseries.py")
+    )
+    io_src = read_text(os.path.join(PACKAGE_DIR, "io", "python.py"))
+    exec_src = read_text(os.path.join(PACKAGE_DIR, "engine", "executor.py"))
+    http_src = read_text(
+        os.path.join(PACKAGE_DIR, "engine", "http_server.py")
+    )
+    for marker, why in (
+        ("profile_stats_snapshot", "profiler scalars"),
+        ("ingest_stats_snapshot", "ingest stage split"),
+        ('"profile"', "profile document key"),
+        ('"ingest"', "ingest document key"),
+    ):
+        if marker not in hub_src:
+            problems.append(
+                f"observability/hub.py never ships the {why} "
+                f"({marker}) — the profiling plane never leaves the "
+                "process"
+            )
+    for marker in ("pathway_profile_", "pathway_ingest_stage_seconds"):
+        if marker not in prom_src:
+            problems.append(
+                f"observability/prometheus.py never renders {marker}* — "
+                "the profiling counters silently vanish from /metrics"
+            )
+    for marker in ('"profile.', '"ingest.'):
+        if marker not in ts_src and f"f{marker}" not in ts_src:
+            problems.append(
+                f"observability/timeseries.py never records the "
+                f"{marker[1:]}* signals series"
+            )
+    if "INGEST_STAGE_STATS" not in io_src:
+        problems.append(
+            "io/python.py dropped the INGEST_STAGE_STATS staged "
+            "counters — the parse/hash/delta split has no source"
+        )
+    # operator tagging is what joins profiles against /attribution: the
+    # executor must register a slot and label it per node sweep
+    if "_op_slot" not in exec_src or "_op_label" not in exec_src:
+        problems.append(
+            "engine/executor.py dropped the profiler op-slot tagging "
+            "(_op_slot/_op_label) — samples lose their operator labels"
+        )
+    if '"/profile"' not in http_src:
+        problems.append(
+            "engine/http_server.py no longer serves /profile — the "
+            "flamegraph surface is gone"
+        )
+    return problems

@@ -23,7 +23,7 @@ from .internals.config import MAX_WORKERS
 
 __all__ = [
     "main", "spawn", "replay", "rescale", "upgrade", "top", "critpath",
-    "trace", "dlq", "lint",
+    "profile", "trace", "dlq", "lint",
 ]
 
 
@@ -627,6 +627,84 @@ def critpath(url, host, port, top_k, as_json):
         click.echo(_json.dumps(waves, indent=2, sort_keys=True))
         return
     click.echo(render_report(waves, top_k=top_k))
+
+
+@main.command()
+@click.option("--url", type=str, default=None,
+              help="full /profile URL (overrides --host/--port)")
+@click.option("--host", type=str, default="127.0.0.1",
+              help="monitoring host of process 0")
+@click.option("--port", type=int, default=None,
+              help="monitoring port of process 0 (default "
+                   "PATHWAY_MONITORING_HTTP_PORT or 20000)")
+@click.option("--speedscope", "as_speedscope", is_flag=True, default=False,
+              help="emit speedscope JSON (paste into speedscope.app)")
+@click.option("--collapsed", "as_collapsed", is_flag=True, default=False,
+              help="emit collapsed-stack text (flamegraph.pl / inferno)")
+@click.option("--top", "top_n", type=int, default=15,
+              help="frames in the default self-time table")
+@click.option("--mode", type=click.Choice(["wall", "cpu"]), default="wall",
+              help="wall samples or CPU-time-weighted samples")
+@click.option("--local", "local_only", is_flag=True, default=False,
+              help="this process only (skip the cluster merge)")
+@click.option("--heap", "as_heap", is_flag=True, default=False,
+              help="on-demand tracemalloc heap snapshot instead")
+@click.option("-o", "--output", type=str, default=None,
+              help="write to a file instead of stdout")
+def profile(url, host, port, as_speedscope, as_collapsed, top_n, mode,
+            local_only, as_heap, output):
+    """Cluster-merged flamegraph from the continuous profiler.
+
+    Fetches the always-on sampling profiler's merged collapsed-stack
+    table from ``/profile`` on process 0 of a running pipeline (every
+    sample tagged with the executing operator, joining against
+    ``/attribution``) and renders a self-time table, collapsed-stack
+    text, or speedscope JSON: ``pathway-tpu profile --port 20000``."""
+    import json as _json
+    import urllib.request
+
+    from .observability.profile_merge import render_top
+
+    if as_speedscope and as_collapsed:
+        raise click.ClickException("--speedscope and --collapsed are exclusive")
+    if url is None:
+        if port is None:
+            try:
+                port = int(
+                    os.environ.get("PATHWAY_MONITORING_HTTP_PORT", "20000")
+                )
+            except ValueError:
+                port = 20000
+        url = f"http://{host}:{port}/profile"
+    elif not url.rstrip("/").endswith("/profile"):
+        url = url.rstrip("/") + "/profile"
+    params = [f"mode={mode}"]
+    if as_heap:
+        params = ["heap=1"]
+    elif as_speedscope:
+        params.append("format=speedscope")
+    elif as_collapsed:
+        params.append("format=collapsed")
+    if local_only and not as_heap:
+        params.append("local=1")
+    full = url + "?" + "&".join(params)
+    try:
+        with urllib.request.urlopen(full, timeout=10.0) as r:
+            body = r.read().decode()
+    except Exception as e:
+        raise click.ClickException(f"{full} unreachable ({e})")
+    if as_collapsed:
+        text = body
+    elif as_speedscope or as_heap:
+        text = _json.dumps(_json.loads(body), indent=2, sort_keys=True)
+    else:
+        text = render_top(_json.loads(body), n=top_n, mode=mode)
+    if output:
+        with open(output, "w", encoding="utf-8") as f:
+            f.write(text if text.endswith("\n") else text + "\n")
+        click.echo(f"wrote {output}")
+    else:
+        click.echo(text)
 
 
 @main.command()

@@ -117,6 +117,13 @@ class EngineStats:
         from ..observability.keyload import maybe_account
 
         self.keyload = maybe_account()
+        # -- continuous profiling (observability/profiler.py) --
+        #: the worker thread's operator-context slot: the executor (and
+        #: fused chains, which see stats via node._engine_stats) publish
+        #: the executing operator's label here so the sampling profiler
+        #: tags stacks with /attribution's labels; None when
+        #: PATHWAY_PROFILE=0 — one None check per node on the hot path
+        self._op_slot: Any = None
 
     def heartbeat(self) -> None:
         import time as _time
@@ -785,6 +792,9 @@ class Executor:
             # Exchange nodes report per-tick sent/received row counts into
             # the worker's stats (backpressure signals on /metrics)
             node._engine_stats = self.stats
+            # the /attribution label the profiler's op slot publishes
+            # while this node executes (fused chains refine to members)
+            node._op_label = f"{type(node).__name__}#{node.node_id}"
         from ..internals.tracing import get_tracer
 
         self.tracer = get_tracer()
@@ -831,7 +841,12 @@ class Executor:
 
     def run(self) -> None:
         from . import keys as K
+        from ..observability import profiler as _profiler
 
+        # register this worker thread with the sampling profiler: _tick
+        # (and fused chains) publish the executing operator's label into
+        # the slot; None when PATHWAY_PROFILE=0
+        self.stats._op_slot = _profiler.current_op_slot()
         # stateless dataflows (no keyed operator state anywhere) suspend
         # 128-bit key registration for the duration of the run: conflation
         # can only corrupt coexisting keyed STATE, and the registry probe
@@ -890,6 +905,10 @@ class Executor:
         finally:
             if stateless:
                 K._suspend_registration(-1)
+            # a parked pool thread must not keep counting as an engine
+            # thread in the profiler's op-tagged accounting
+            self.stats._op_slot = None
+            _profiler.release_op_slot()
 
     def _run_inner(self) -> None:
         realtime = [n for n in self.nodes if isinstance(n, RealtimeSource)]
@@ -1787,7 +1806,13 @@ class Executor:
                 if src.persistent_id is not None:
                     self.persistence.record(time, src.persistent_id, delta)
         self._last_clock = max(self._last_clock, time) if time != END_TIME else self._last_clock
+        op_slot = self.stats._op_slot
         for node in self.nodes:
+            if op_slot is not None:
+                # publish the executing operator to the sampling profiler
+                # (one GIL-atomic attribute store per node; fused chains
+                # refine this to member labels as they sweep)
+                op_slot.label = node._op_label
             if timed:
                 node_t0 = _wall.perf_counter_ns()
             out_parts: list[Delta] = []
@@ -1860,6 +1885,10 @@ class Executor:
                     self.stats.note_node_time(
                         node, _wall.perf_counter_ns() - node_t0
                     )
+        if op_slot is not None:
+            # between sweeps nothing is executing — a parked worker's
+            # samples must not carry the last node's label
+            op_slot.label = None
         sweep_ns = _wall.perf_counter_ns() - tick_t0
         self.stats.tick_duration.observe(sweep_ns)
         self._busy_ns_total += sweep_ns

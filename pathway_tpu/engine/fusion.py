@@ -478,9 +478,13 @@ class FusedChain(Node):
             diffs = diffs[idx]
             cols = {c: np.asarray(a)[idx] for c, a in cols.items()}
         d = Delta(keys=keys, data=dict(cols), diffs=diffs)
+        es = getattr(self, "_engine_stats", None)
+        op_slot = es._op_slot if es is not None else None
         for m in self.members[start:]:
             if d is None or not len(d):
                 return None
+            if op_slot is not None:
+                op_slot.label = f"{type(m).__name__}#{m.node_id}"
             if stats is not None:
                 t0 = _wall.perf_counter_ns()
             d = m.process(time, [d])
@@ -511,7 +515,14 @@ class FusedChain(Node):
         keys, diffs = d.keys, d.diffs
         mask: np.ndarray | None = None
         member_ns = None if stats is None else np.zeros(len(self.members))
+        es = getattr(self, "_engine_stats", None)
+        op_slot = es._op_slot if es is not None else None
         for i, (m, kind) in enumerate(zip(self.members, self._member_kind)):
+            if op_slot is not None:
+                # refine the executor's chain label to the executing
+                # MEMBER — /attribution ranks member labels, and profiler
+                # samples must join against that ranking
+                op_slot.label = self._labels[i]
             t0 = _wall.perf_counter_ns() if stats is not None else 0
             n = len(keys)
             if kind == "rowwise":

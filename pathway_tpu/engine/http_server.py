@@ -19,6 +19,9 @@ Endpoints:
   With params (``?expr=rate(engine_ticks)&window=10`` or
   ``?metric=...&op=p95``) evaluates one expression.
 - ``/attribution`` — ranked per-operator bottleneck attribution.
+- ``/profile`` — continuous-profiling flamegraph (cluster-merged on
+  process 0; ``?local=1`` per-process, ``?format=collapsed|speedscope``,
+  ``?mode=wall|cpu``, ``?heap=1`` for the tracemalloc view).
 - ``/alerts`` — active + recent SLO alerts (``PATHWAY_SLO_RULES``).
 - ``/healthz`` — 200 while no executor thread is wedged, else 503.
 - ``/readyz`` — 200 once sources are connected and the first frontier
@@ -144,6 +147,46 @@ def start_http_server(
                     self._reply_json(400, {"error": str(e)})
                     return
                 self._reply_json(200, doc)
+            elif path == "/profile":
+                # continuous profiling (observability/profiler.py):
+                # cluster-merged flamegraph by default (process 0 scrapes
+                # peers' ?local=1 docs), per-process with ?local=1;
+                # ?format=collapsed|speedscope render, ?heap=1 the
+                # on-demand tracemalloc view
+                params = dict(parse_qsl(parsed.query))
+                if params.get("heap"):
+                    from ..observability.profiler import heap_document
+
+                    self._reply_json(200, heap_document())
+                    return
+                doc = (
+                    hub.profile_document()
+                    if params.get("local")
+                    else hub.profile_view()
+                )
+                fmt = params.get("format")
+                mode = params.get("mode", "wall")
+                if mode not in ("wall", "cpu"):
+                    self._reply_json(400, {"error": f"bad mode {mode!r}"})
+                    return
+                if fmt == "collapsed":
+                    from ..observability.profile_merge import collapsed_text
+
+                    self._reply(
+                        200,
+                        collapsed_text(doc, mode=mode).encode(),
+                        "text/plain; charset=utf-8",
+                    )
+                elif fmt == "speedscope":
+                    from ..observability.profile_merge import (
+                        speedscope_document,
+                    )
+
+                    self._reply_json(200, speedscope_document(doc, mode=mode))
+                elif fmt:
+                    self._reply_json(400, {"error": f"bad format {fmt!r}"})
+                else:
+                    self._reply_json(200, doc)
             elif path == "/attribution":
                 if hub.signals_plane is None:
                     self._reply_json(

@@ -60,6 +60,29 @@ INGEST_BUILD_STATS = {
     "engine_rows": 0,
 }
 
+#: staged ingest cost split riding the INGEST_BUILD_STATS seam — the
+#: continuous-profiling plane's answer to ROADMAP item 2: "string hashing
+#: + delta building ~60% of wall" must be a measured, regression-gated
+#: number, not folklore. parse = raw values → schema-ordered normalized
+#: columns; hash = vectorized row-key derivation (K.mix_columns); delta =
+#: Delta assembly + per-flush concat. Accrued only while the profiling
+#: plane is on (PATHWAY_PROFILE, same kill switch as the sampler);
+#: surfaces: pathway_ingest_stage_seconds on /metrics, ingest.* signals
+#: series, the `pathway-tpu top` ingest line, bench's ingest_stage_split.
+INGEST_STAGE_STATS = {
+    "parse_ns": 0,
+    "hash_ns": 0,
+    "delta_ns": 0,
+    "rows": 0,
+    "flushes": 0,
+}
+
+
+def _stages_on() -> bool:
+    from ..observability.profiler import enabled
+
+    return enabled()
+
 
 class _SourceError:
     def __init__(self, exc: BaseException):
@@ -327,8 +350,10 @@ class PythonSubjectSource(RealtimeSource):
         engine-side poll keeps the skip/offset bookkeeping). Bit-identical
         to the engine-side build — ``K.mix_columns`` over the same
         normalized columns."""
+        stage = INGEST_STAGE_STATS if _stages_on() else None
         t0 = _time.perf_counter_ns()
         data, n = self._batch_columns(batch)
+        t1 = _time.perf_counter_ns() if stage is not None else 0
         if self.pk_indices is not None:
             key_names = tuple(self.names[i] for i in self.pk_indices)
         else:
@@ -338,7 +363,11 @@ class PythonSubjectSource(RealtimeSource):
             [data[c] for c in key_names], n, register=self._keys_register
         )
         batch.key_names = key_names
-        INGEST_BUILD_STATS["subject_ns"] += _time.perf_counter_ns() - t0
+        t2 = _time.perf_counter_ns()
+        if stage is not None:
+            stage["parse_ns"] += t1 - t0
+            stage["hash_ns"] += t2 - t1
+        INGEST_BUILD_STATS["subject_ns"] += t2 - t0
         INGEST_BUILD_STATS["subject_rows"] += n
 
     def attach_waker(self, event) -> None:
@@ -362,6 +391,8 @@ class PythonSubjectSource(RealtimeSource):
         # rows->columns transpose (VERDICT r4 #4, the per-row API tax).
         from ..engine.delta import column_of_values
 
+        stage = INGEST_STAGE_STATS if _stages_on() else None
+        t0 = _time.perf_counter_ns() if stage is not None else 0
         self._emitted += len(entries)
         n = len(entries)
         # entries are bare kwargs dicts (next(): diff=+1, no key) or
@@ -385,6 +416,9 @@ class PythonSubjectSource(RealtimeSource):
                 dflt = self.defaults.get(name)
                 col = [f.get(name, dflt) for f in fields_list]
             data[name] = self._normalize(name, column_of_values(col))
+        t_parse = _time.perf_counter_ns() if stage is not None else 0
+        if stage is not None:
+            stage["parse_ns"] += t_parse - t0
         if plain:
             diffs = np.ones(n, dtype=np.int64)
         else:
@@ -406,11 +440,21 @@ class PythonSubjectSource(RealtimeSource):
             ]
         )
         if not explicit:
+            h0 = _time.perf_counter_ns() if stage is not None else 0
             keys = K.mix_columns(key_cols, n)
+            h1 = _time.perf_counter_ns() if stage is not None else 0
             out = Delta(keys=keys, data=data, diffs=diffs)
             out.keys_content_cols = tuple(
                 self.names[i] for i in self.pk_indices
             ) if self.pk_indices is not None else tuple(self.names)
+            if stage is not None:
+                # everything past the column extraction that is not the
+                # hash pass (diffs + Delta assembly) counts as delta
+                hash_dt = h1 - h0
+                stage["hash_ns"] += hash_dt
+                stage["delta_ns"] += (
+                    _time.perf_counter_ns() - t_parse - hash_dt
+                )
             return out
         # rows carrying an explicit key never USE their derived key —
         # registering it would poison the 128-bit conflation registry
@@ -422,13 +466,21 @@ class PythonSubjectSource(RealtimeSource):
         keys = np.empty(n, dtype=np.uint64)
         keep = np.ones(n, dtype=bool)
         keep[explicit] = False
+        hash_dt = 0
         if keep.any():
+            h0 = _time.perf_counter_ns() if stage is not None else 0
             keys[keep] = K.mix_columns(
                 [np.asarray(c)[keep] for c in key_cols], int(keep.sum())
             )
+            if stage is not None:
+                hash_dt = _time.perf_counter_ns() - h0
         for i in explicit:
             keys[i] = entries[i][2]
-        return Delta(keys=keys, data=data, diffs=diffs)
+        out = Delta(keys=keys, data=data, diffs=diffs)
+        if stage is not None:
+            stage["hash_ns"] += hash_dt
+            stage["delta_ns"] += _time.perf_counter_ns() - t_parse - hash_dt
+        return out
 
     def _normalize(self, name: str, arr: np.ndarray) -> np.ndarray:
         """Coerce a column's values to the DECLARED schema dtype before
@@ -508,18 +560,25 @@ class PythonSubjectSource(RealtimeSource):
         (_prebuild_batch, fused key derivation); this engine-side path
         keeps only the skip/offset bookkeeping then — the fallback build
         covers batches enqueued before the source started."""
+        stage = INGEST_STAGE_STATS if _stages_on() else None
         if batch.keys is not None:
             data, n, keys = batch.data, len(batch.keys), batch.keys
             key_names = batch.key_names
+            t_built = _time.perf_counter_ns() if stage is not None else 0
         else:
             t0 = _time.perf_counter_ns()
             data, n = self._batch_columns(batch)
+            t1 = _time.perf_counter_ns() if stage is not None else 0
             if self.pk_indices is not None:
                 key_names = tuple(self.names[i] for i in self.pk_indices)
             else:
                 key_names = tuple(self.names)
             keys = K.mix_columns([data[c] for c in key_names], n)
-            INGEST_BUILD_STATS["engine_ns"] += _time.perf_counter_ns() - t0
+            t_built = _time.perf_counter_ns()
+            if stage is not None:
+                stage["parse_ns"] += t1 - t0
+                stage["hash_ns"] += t_built - t1
+            INGEST_BUILD_STATS["engine_ns"] += t_built - t0
             INGEST_BUILD_STATS["engine_rows"] += n
         # recovery seek already counted skipped rows into _emitted
         if self._skip >= n:
@@ -544,6 +603,10 @@ class PythonSubjectSource(RealtimeSource):
         # these columns at salt 0 — a downstream groupby/join keying on
         # the same columns reuses them bit-for-bit
         out.keys_content_cols = tuple(key_names)
+        if stage is not None:
+            # skip/slice bookkeeping + Delta wrap (the whole engine-side
+            # cost of a prebuilt batch)
+            stage["delta_ns"] += _time.perf_counter_ns() - t_built
         return out
 
     def _flush_partial(self) -> None:
@@ -571,11 +634,22 @@ class PythonSubjectSource(RealtimeSource):
         if self._pending:
             from ..engine.delta import concat_deltas
 
-            out.append(
+            stage = INGEST_STAGE_STATS if _stages_on() else None
+            t0 = _time.perf_counter_ns()
+            d = (
                 self._pending[0]
                 if len(self._pending) == 1
                 else concat_deltas(self._pending, self.names)
             )
+            dt = _time.perf_counter_ns() - t0
+            # the per-flush concat is delta-build work: count it into the
+            # engine-side build wall so the staged split sums to it
+            INGEST_BUILD_STATS["engine_ns"] += dt
+            if stage is not None:
+                stage["delta_ns"] += dt
+                stage["rows"] += len(d)
+                stage["flushes"] += 1
+            out.append(d)
             self._pending = []
             self._out_ingest.append(self._window_ingest_ns)
         self._window_ingest_ns = None

@@ -28,7 +28,14 @@ The reference engine's telemetry pair — per-operator OTLP metrics
 - :mod:`slo` — declarative SLO rules (``PATHWAY_SLO_RULES``) evaluated
   against the store; alerts fan out to ``/alerts``, the trace stream
   and the flight recorder;
-- :mod:`top` — the ``pathway-tpu top`` live terminal dashboard.
+- :mod:`top` — the ``pathway-tpu top`` live terminal dashboard;
+- :mod:`profiler` — the always-on sampling profiler: per-process
+  collapsed-stack tables (wall + CPU) with operator tags joining
+  against /attribution, flight-ring top-K deposits, tracemalloc heap
+  view (``/profile``, ``PATHWAY_PROFILE*`` knobs);
+- :mod:`profile_merge` — associative cluster merge of profiler
+  snapshots + collapsed/speedscope/top renderers
+  (``pathway-tpu profile``).
 
 The HTTP surface itself lives in ``engine/http_server.py``; instrumented
 state in ``engine/executor.EngineStats``.
@@ -40,6 +47,13 @@ from .flightrecorder import FlightRecorder, get_recorder, harvest
 from .health import health_status, ready_status
 from .histogram import LogHistogram, merge_snapshots, quantile_from_snapshot
 from .hub import ObservabilityHub, stats_snapshot
+from .profile_merge import (
+    collapsed_text,
+    render_top,
+    speedscope_document,
+    top_frames,
+)
+from .profiler import Profiler, current_op_slot, heap_document
 from .prometheus import (
     escape_label_value,
     parse_exposition,
@@ -54,6 +68,7 @@ __all__ = [
     "LogHistogram",
     "ObservabilityHub",
     "PeriodicFlusher",
+    "Profiler",
     "Rule",
     "Signals",
     "SignalsPlane",
@@ -61,12 +76,18 @@ __all__ = [
     "TimeSeriesStore",
     "attribution_document",
     "bottleneck_operator",
+    "collapsed_text",
+    "current_op_slot",
     "get_recorder",
     "harvest",
+    "heap_document",
     "escape_label_value",
     "health_status",
     "load_rules",
     "merge_snapshots",
+    "render_top",
+    "speedscope_document",
+    "top_frames",
     "parse_exposition",
     "quantile_from_snapshot",
     "ready_status",

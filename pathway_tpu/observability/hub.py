@@ -31,6 +31,13 @@ __all__ = ["ObservabilityHub", "stats_snapshot"]
 _SCRAPE_TIMEOUT_S = 2.0
 
 
+def _drop_empty(stats: dict[str, dict]) -> dict[str, dict] | None:
+    """Per-process stat maps with no live entries render as NO metric
+    families (byte-identical exposition when a concern is disabled)."""
+    out = {k: v for k, v in stats.items() if v}
+    return out or None
+
+
 def stats_snapshot(stats: Any, worker_id: int = 0) -> dict:
     """JSON-serializable snapshot of one worker's EngineStats — the unit
     shipped across processes and merged by process 0. Ages are computed
@@ -123,6 +130,13 @@ class ObservabilityHub:
         #: that act on the numbers (the autoscaler's decider) refuse
         #: stale-marked documents rather than deciding from frozen values
         self._query_cache: dict[int, tuple[float, dict]] = {}
+        #: and for the /profile roll-up: a dead peer's flamegraph serves
+        #: from its last good scrape with ``stale`` ages on the merged doc
+        self._profile_cache: dict[int, tuple[float, dict]] = {}
+        #: per-process sampling profiler (observability/profiler.py) —
+        #: started with the signals plane, stopped in close(); None when
+        #: PATHWAY_PROFILE=0 or before start_signals()
+        self.profiler: Any = None
 
     @classmethod
     def from_config(cls, cfg: Any) -> "ObservabilityHub":
@@ -228,11 +242,33 @@ class ObservabilityHub:
         self.signals_plane = SignalsPlane(
             self, sample_s=sample_s, window_s=window_s, slo_engine=engine
         ).start()
+        self.start_profiler()
         return self.signals_plane
+
+    def start_profiler(self) -> Any:
+        """Start the per-process sampling profiler (idempotent; no-op
+        with ``PATHWAY_PROFILE=0`` — zero threads, zero series)."""
+        if self.profiler is not None:
+            return self.profiler
+        from . import profiler as _profiler
+
+        if not _profiler.enabled():
+            return None
+        try:
+            self.profiler = _profiler.Profiler(
+                process_id=self.process_id
+            ).start()
+        except Exception:
+            # telemetry must not fail the run it observes
+            self.profiler = None
+        return self.profiler
 
     def close(self) -> None:
         if self.signals_plane is not None:
             self.signals_plane.stop()
+        if self.profiler is not None:
+            self.profiler.stop()
+            self.profiler = None
 
     @property
     def worker_stats(self) -> list[Any]:
@@ -327,6 +363,46 @@ class ObservabilityHub:
             # telemetry must not fail the run it observes
             return {}
 
+    @staticmethod
+    def ingest_stats_snapshot() -> dict[str, float]:
+        """This process's staged ingest cost split (parse | hash | delta
+        seconds + rows/flushes — io/python.INGEST_STAGE_STATS), the
+        measured form of ROADMAP item 2's "hashing + delta build ~60% of
+        wall". Empty until a connector flushed (or with
+        ``PATHWAY_PROFILE=0``), so expositions stay byte-identical."""
+        try:
+            from ..io.python import INGEST_STAGE_STATS as s
+            from .profiler import enabled as _prof_enabled
+
+            # re-check the kill switch at read time: the module-global
+            # counters survive a same-process PATHWAY_PROFILE flip
+            if not _prof_enabled():
+                return {}
+            if not s["flushes"] and not s["rows"]:
+                return {}
+            return {
+                "parse_s": round(s["parse_ns"] / 1e9, 6),
+                "hash_s": round(s["hash_ns"] / 1e9, 6),
+                "delta_s": round(s["delta_ns"] / 1e9, 6),
+                "rows_total": float(s["rows"]),
+                "flushes_total": float(s["flushes"]),
+            }
+        except Exception:
+            # telemetry must not fail the run it observes
+            return {}
+
+    def profile_stats_snapshot(self) -> dict[str, float]:
+        """This process's profiler scalars (samples, distinct frames,
+        top-frame/op-tagged shares — the ``pathway_profile_*`` families
+        and ``profile.*`` series). Empty when the profiler is off."""
+        try:
+            if self.profiler is None:
+                return {}
+            return self.profiler.metrics_snapshot()
+        except Exception:
+            # telemetry must not fail the run it observes
+            return {}
+
     def snapshot_document(self) -> dict:
         """The /snapshot payload peers serve to process 0."""
         return {
@@ -337,6 +413,8 @@ class ObservabilityHub:
             "sinks": self.sink_stats_snapshot(),
             "udf": self.udf_stats_snapshot(),
             "fusion": self.fusion_stats_snapshot(),
+            "ingest": self.ingest_stats_snapshot(),
+            "profile": self.profile_stats_snapshot(),
             "trace_dropped": self._local_trace_dropped(),
         }
 
@@ -347,6 +425,9 @@ class ObservabilityHub:
         dict[str, dict],
         dict[str, int],
         dict[str, float],
+        dict[str, dict],
+        dict[str, dict],
+        dict[str, dict],
         dict[str, dict],
         dict[str, dict],
         dict[str, dict],
@@ -369,6 +450,8 @@ class ObservabilityHub:
         sink_stats = {str(self.process_id): self.sink_stats_snapshot()}
         udf_stats = {str(self.process_id): self.udf_stats_snapshot()}
         fusion_stats = {str(self.process_id): self.fusion_stats_snapshot()}
+        ingest_stats = {str(self.process_id): self.ingest_stats_snapshot()}
+        profile_stats = {str(self.process_id): self.profile_stats_snapshot()}
         trace_dropped: dict[str, int] = {}
         stale: dict[str, float] = {}
         local_dropped = self._local_trace_dropped()
@@ -413,6 +496,12 @@ class ObservabilityHub:
             peer_fusion = doc.get("fusion")
             if peer_fusion:
                 fusion_stats[str(doc.get("process_id", "?"))] = peer_fusion
+            peer_ingest = doc.get("ingest")
+            if peer_ingest:
+                ingest_stats[str(doc.get("process_id", "?"))] = peer_ingest
+            peer_profile = doc.get("profile")
+            if peer_profile:
+                profile_stats[str(doc.get("process_id", "?"))] = peer_profile
             peer_dropped = doc.get("trace_dropped")
             if peer_dropped is not None:
                 trace_dropped[str(doc.get("process_id", "?"))] = int(
@@ -421,7 +510,7 @@ class ObservabilityHub:
         snapshots.sort(key=lambda s: s.get("worker", 0))
         return (
             snapshots, comm_stats, trace_dropped, stale, memory_stats,
-            sink_stats, udf_stats, fusion_stats,
+            sink_stats, udf_stats, fusion_stats, ingest_stats, profile_stats,
         )
 
     @staticmethod
@@ -535,6 +624,8 @@ class ObservabilityHub:
         doc["sinks"] = self.sink_stats_snapshot()
         doc["udf"] = self.udf_stats_snapshot()
         doc["fusion"] = self.fusion_stats_snapshot()
+        doc["ingest"] = self.ingest_stats_snapshot()
+        doc["profile"] = self.profile_stats_snapshot()
         doc["waves"] = self._waves_document()
         doc["keyload"] = self._keyload_document()
         from .attribution import attribution_document
@@ -642,6 +733,8 @@ class ObservabilityHub:
         merged["sinks"] = {str(self.process_id): local.get("sinks", {})}
         merged["udf"] = {str(self.process_id): local.get("udf", {})}
         merged["fusion"] = {str(self.process_id): local.get("fusion", {})}
+        merged["ingest"] = {str(self.process_id): local.get("ingest", {})}
+        merged["profile"] = {str(self.process_id): local.get("profile", {})}
         merged["alerts"] = {
             "active": list(local.get("alerts", {}).get("active", [])),
             "history": list(local.get("alerts", {}).get("history", [])),
@@ -660,6 +753,8 @@ class ObservabilityHub:
             merged["sinks"][str(pid)] = doc.get("sinks", {})
             merged["udf"][str(pid)] = doc.get("udf", {})
             merged["fusion"][str(pid)] = doc.get("fusion", {})
+            merged["ingest"][str(pid)] = doc.get("ingest", {})
+            merged["profile"][str(pid)] = doc.get("profile", {})
             alerts = doc.get("alerts", {})
             merged["alerts"]["active"].extend(alerts.get("active", []))
             merged["alerts"]["history"].extend(alerts.get("history", []))
@@ -775,6 +870,57 @@ class ObservabilityHub:
         local["history"].sort(key=lambda e: e.get("t", 0))
         return local
 
+    # -- continuous profiling (/profile) -------------------------------
+
+    def profile_document(self) -> dict:
+        """This process's full profile (collapsed-stack sketches + scalar
+        counters) — what a peer serves at ``/profile?local=1``."""
+        if self.profiler is None:
+            from .profile_merge import merge_snapshots
+
+            doc = merge_snapshots([])
+            doc["process_id"] = self.process_id
+            return doc
+        return self.profiler.snapshot()
+
+    def profile_view(self) -> dict:
+        """The cluster-merged ``/profile`` payload: process 0 scrapes
+        every peer's local profile and merges the sketches (same pull
+        direction as /snapshot). A peer that stops answering serves from
+        its last good scrape, marked in the merged document's ``stale``
+        map (process id -> age s) — ``stale: {pid: null}`` names a peer
+        that never answered at all."""
+        from .profile_merge import merge_snapshots
+
+        local = self.profile_document()
+        if not self.peer_http:
+            merged = merge_snapshots([local])
+            merged["stale"] = {}
+            return merged
+        results = self._scrape_peers_raw("/profile?local=1")
+        now = time.time()
+        stale: dict[str, float | None] = {}
+        peer_ids = [
+            p for p in range(self.n_processes) if p != self.process_id
+        ]
+        docs: list[dict] = [local]
+        for i, doc in enumerate(results):
+            pid = peer_ids[i] if i < len(peer_ids) else i
+            if doc is None:
+                self.scrape_errors += 1
+                cached = self._profile_cache.get(i)
+                if cached is None:
+                    stale[str(pid)] = None
+                    continue
+                seen_at, doc = cached
+                stale[str(pid)] = round(now - seen_at, 3)
+            else:
+                self._profile_cache[i] = (now, doc)
+            docs.append(doc)
+        merged = merge_snapshots(docs)
+        merged["stale"] = stale
+        return merged
+
     # -- rendering + probes --------------------------------------------
 
     def render_metrics(self) -> str:
@@ -786,6 +932,7 @@ class ObservabilityHub:
             (
                 snapshots, comm_stats, dropped_by_proc, stale,
                 memory_stats, sink_stats, udf_stats, fusion_stats,
+                ingest_stats, profile_stats,
             ) = self.cluster_snapshots()
             # per-process labels, like the comm gauges: series identity
             # stays stable when a peer scrape transiently fails
@@ -802,6 +949,12 @@ class ObservabilityHub:
             udf_stats = {str(self.process_id): udf} if udf else {}
             fusion = self.fusion_stats_snapshot()
             fusion_stats = {str(self.process_id): fusion} if fusion else {}
+            ingest = self.ingest_stats_snapshot()
+            ingest_stats = {str(self.process_id): ingest} if ingest else {}
+            profile = self.profile_stats_snapshot()
+            profile_stats = (
+                {str(self.process_id): profile} if profile else {}
+            )
             trace_dropped = self._local_trace_dropped()
         # label by TOPOLOGY, not by how many snapshots this scrape got:
         # in cluster mode a transient peer outage must not flip series
@@ -849,6 +1002,8 @@ class ObservabilityHub:
             sink_stats=sink_stats or None,
             udf_stats=udf_stats or None,
             fusion_stats=fusion_stats or None,
+            ingest_stats=_drop_empty(ingest_stats),
+            profile_stats=_drop_empty(profile_stats),
         )
 
     @staticmethod

@@ -121,6 +121,8 @@ def render_snapshots(
     sink_stats: dict[str, dict[str, dict[str, float]]] | None = None,
     udf_stats: dict[str, dict[str, float]] | None = None,
     fusion_stats: dict[str, dict[str, float]] | None = None,
+    ingest_stats: dict[str, dict[str, float]] | None = None,
+    profile_stats: dict[str, dict[str, float]] | None = None,
 ) -> str:
     """Exposition text for a set of worker stats snapshots.
 
@@ -293,6 +295,36 @@ def render_snapshots(
         for key, value in sorted(gauges.items()):
             kind = "counter" if key.endswith("_total") else "gauge"
             r.add(f"pathway_fusion_{key}", kind, value, plab)
+    for proc, gauges in sorted((ingest_stats or {}).items()):
+        # staged ingest cost split (io/python.INGEST_STAGE_STATS): the
+        # parse | hash | delta seconds per connector flush, as one
+        # stage-labeled family so dashboards stack the split directly
+        for key, value in sorted(gauges.items()):
+            if key.endswith("_s"):
+                r.add(
+                    "pathway_ingest_stage_seconds_total",
+                    "counter",
+                    value,
+                    {"process": str(proc), "stage": key[:-2]},
+                )
+            else:
+                kind = "counter" if key.endswith("_total") else "gauge"
+                r.add(
+                    f"pathway_ingest_{key}",
+                    kind,
+                    value,
+                    {"process": str(proc)},
+                )
+    for proc, gauges in sorted((profile_stats or {}).items()):
+        # continuous-profiling scalars (observability/profiler.py):
+        # samples taken, distinct collapsed stacks, top-frame share and
+        # the op-tagged share of engine-thread samples — the health
+        # gauges of the flamegraph plane (the flamegraph itself lives at
+        # /profile, not in the exposition)
+        plab = {"process": str(proc)}
+        for key, value in sorted(gauges.items()):
+            kind = "counter" if key.endswith("_total") else "gauge"
+            r.add(f"pathway_profile_{key}", kind, value, plab)
     r.add("pathway_cluster_workers", "gauge", len(snapshots))
     if stale_workers:
         # a peer whose /snapshot scrape failed: its workers are reported

@@ -515,6 +515,16 @@ class SignalsPlane:
         # the fused path after a schema/dtype change
         for key, value in self.hub.fusion_stats_snapshot().items():
             self.store.record(f"fusion.{key}", float(value), None, t)
+        # staged ingest cost split (io/python.INGEST_STAGE_STATS): an SLO
+        # rule can watch ingest.hash_s grow faster than ingest.parse_s —
+        # the columnar-ingest arc's regression tripwire (ROADMAP item 2)
+        for key, value in self.hub.ingest_stats_snapshot().items():
+            self.store.record(f"ingest.{key}", float(value), None, t)
+        # continuous-profiling scalars (observability/profiler.py):
+        # samples_total proves the sampler is alive; op_tagged_share
+        # dropping means profiles stopped joining against /attribution
+        for key, value in self.hub.profile_stats_snapshot().items():
+            self.store.record(f"profile.{key}", float(value), None, t)
 
     # -- lifecycle -----------------------------------------------------
 

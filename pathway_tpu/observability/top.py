@@ -199,6 +199,55 @@ def render_frame(doc: dict, now: float | None = None) -> str:
         if f.get("jit_chains_total"):
             line += f", {_fmt(f.get('jit_chains_total'), nd=0)} XLA"
         lines.append(line)
+    ing = doc.get("ingest", {})
+    # merged docs key ingest by process; single-process docs are flat
+    ing_by_proc = (
+        ing
+        if ing and all(isinstance(v, dict) for v in ing.values())
+        else {str(doc.get("process_id", 0)): ing}
+    )
+    for proc in sorted(ing_by_proc):
+        g = ing_by_proc[proc] or {}
+        if not any(g.values()):
+            continue
+        total = (
+            g.get("parse_s", 0) + g.get("hash_s", 0) + g.get("delta_s", 0)
+        )
+
+        def _pct(v: float) -> str:
+            return f"{v / total * 100:.0f}%" if total else "-"
+
+        lines.append(
+            f"ingest p{proc}: parse {_fmt(g.get('parse_s'), 's', 3)} "
+            f"({_pct(g.get('parse_s', 0))}), "
+            f"hash {_fmt(g.get('hash_s'), 's', 3)} "
+            f"({_pct(g.get('hash_s', 0))}), "
+            f"delta {_fmt(g.get('delta_s'), 's', 3)} "
+            f"({_pct(g.get('delta_s', 0))}) over "
+            f"{_fmt(g.get('rows_total'), nd=0)} row(s)/"
+            f"{_fmt(g.get('flushes_total'), nd=0)} flush(es)"
+        )
+    prof = doc.get("profile", {})
+    # merged docs key profile by process; single-process docs are flat
+    prof_by_proc = (
+        prof
+        if prof and all(isinstance(v, dict) for v in prof.values())
+        else {str(doc.get("process_id", 0)): prof}
+    )
+    for proc in sorted(prof_by_proc):
+        p = prof_by_proc[proc] or {}
+        if not any(p.values()):
+            continue
+        tagged = p.get("op_tagged_share")
+        lines.append(
+            f"profile p{proc}: {_fmt(p.get('samples_total'), nd=0)} "
+            f"sample(s), {_fmt(p.get('distinct_frames'), nd=0)} frame(s)"
+            + (
+                f", {tagged * 100:.0f}% op-tagged"
+                if tagged is not None
+                else ""
+            )
+        )
     waves = doc.get("waves")
     if waves and waves.get("last"):
         last = waves["last"]

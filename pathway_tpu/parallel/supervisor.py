@@ -55,6 +55,15 @@ __all__ = ["Supervisor", "RestartBudgetExceeded"]
 #: circuit breaker opened — the ensemble is crash-looping
 EXIT_CIRCUIT_OPEN = 75  # EX_TEMPFAIL
 
+#: ring record kinds emitted continuously during a healthy run — these
+#: dominate the ring byte-for-byte and are only interesting near the
+#: moment of death, so the crash bundle keeps just their recent tail
+#: (rare forensic kinds — slo.alert, chaos.fired, comm.broken — are
+#: kept in full regardless of age)
+_FREQUENT_RECORD_KINDS = frozenset(
+    {"tick", "wave.phase", "async.commit", "profile.top"}
+)
+
 
 class RestartBudgetExceeded(RuntimeError):
     pass
@@ -426,6 +435,18 @@ class Supervisor:
                 # recorder) — not this run's evidence
                 continue
             records = doc["records"]
+            # a flat tail cap would let high-frequency progress records
+            # (ticks at up to 100/s, wave phases, periodic profile
+            # deposits) rotate the rare forensic records — fired alerts,
+            # chaos injections, comm.broken attributions — out of any
+            # bundle harvested more than a few seconds after the event.
+            # Keep every rare record plus the most recent tail.
+            tail = records[-400:]
+            rare = [
+                r
+                for r in records[: len(records) - len(tail)]
+                if r.get("kind") not in _FREQUENT_RECORD_KINDS
+            ]
             bundle = {
                 "generation": generation,
                 "process": proc,
@@ -452,7 +473,7 @@ class Supervisor:
                     ),
                     None,
                 ),
-                "records": records[-400:],
+                "records": rare + tail,
             }
             path = os.path.join(
                 self.flight_dir, f"crash-{generation}-{proc}.json"

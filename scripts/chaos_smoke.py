@@ -17,6 +17,14 @@ the whole self-healing loop:
 - both generations actually ran (restart evidence), and the crashed
   generation had not already finished the stream (mid-run evidence).
 
+A second leg (:func:`run_profiler_chaos_smoke`) reruns the same fault
+plan with the monitoring server + always-on sampling profiler armed and
+proves the profiling plane is chaos-safe: the sampler never wedges the
+cooperative teardown (the supervised run still exits 0 with exact
+counts), the crashed generation's flight ring carries its ``profile.top``
+deposits into the crash bundle, and the restarted generation re-arms a
+fresh sampler whose deposits land in the post-run rings.
+
 Usable standalone (``python scripts/chaos_smoke.py`` → exit 0/1) and as
 a tier-1 test (``tests/test_chaos_smoke.py`` imports :func:`run_smoke`).
 """
@@ -110,9 +118,13 @@ def _events(path: str) -> list:
     return out
 
 
-def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
+def run_smoke(
+    verbose: bool = False,
+    workdir: str | None = None,
+    extra_env: dict | None = None,
+) -> dict:
     """Run the supervised chaos wordcount; returns {"final", "generations",
-    "events"}. Raises AssertionError on any violation."""
+    "events", "flight_dir"}. Raises AssertionError on any violation."""
     tmp = workdir or tempfile.mkdtemp(prefix="chaos_smoke_")
     prog = os.path.join(tmp, "prog.py")
     with open(prog, "w") as f:
@@ -132,6 +144,7 @@ def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
         "PATHWAY_SUPERVISE_BACKOFF_S": "0.05",
         "PATHWAY_SUPERVISE_BACKOFF_MAX_S": "0.2",
         "PATHWAY_SUPERVISE_GRACE_S": "5",
+        **(extra_env or {}),
     }
     proc = subprocess.run(
         [
@@ -189,12 +202,90 @@ def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
             f"chaos_smoke: {len(events)} events, generations {generations}, "
             f"final {final}"
         )
-    return {"final": final, "generations": generations, "events": events}
+    return {
+        "final": final,
+        "generations": generations,
+        "events": events,
+        "flight_dir": env["PATHWAY_FLIGHT_DIR"],
+    }
+
+
+def run_profiler_chaos_smoke(
+    verbose: bool = False, workdir: str | None = None
+) -> dict:
+    """The fault-plan run again, with the monitoring server + sampling
+    profiler armed: the sampler must survive a SIGKILL'd peer, a
+    cooperative teardown, and a generation restart without wedging any
+    of them — and its flight deposits must land on both sides of the
+    crash."""
+    from pathway_tpu.observability import flightrecorder
+
+    result = run_smoke(
+        verbose=verbose,
+        workdir=workdir,
+        extra_env={
+            # arm the hub (and with it the profiler) in every worker;
+            # process p binds base_port + p
+            "PATHWAY_MONITORING_HTTP_SERVER": "1",
+            "PATHWAY_MONITORING_HTTP_PORT": str(_free_port()),
+            # generation 0 lives well under a second past the kill — a
+            # fast sampler + deposit cadence makes its ring evidence
+            # deterministic (stop() also writes a final deposit on the
+            # clean generation-1 exit)
+            "PATHWAY_PROFILE_HZ": "97",
+            "PATHWAY_PROFILE_FLIGHT_S": "0.2",
+        },
+    )
+    # run_smoke already proved the teardown never wedged (the supervised
+    # ensemble exited 0 inside its timeout with exact final counts) and
+    # that both generations ran. Now the ring evidence: the supervisor
+    # harvested the crashed generation's rings into crash bundles...
+    flight = result["flight_dir"]
+    bundles = sorted(
+        f for f in os.listdir(flight) if f.startswith("crash-0-")
+    )
+    assert bundles, f"no generation-0 crash bundle under {flight}"
+    gen0_profiles = []
+    for name in bundles:
+        with open(os.path.join(flight, name)) as f:
+            doc = json.load(f)
+        gen0_profiles += [
+            r for r in doc["records"] if r.get("kind") == "profile.top"
+        ]
+    assert gen0_profiles, (
+        f"no profile.top record in generation-0 crash bundles {bundles} — "
+        "the sampler was not running when the chaos SIGKILL landed"
+    )
+    # ...and generation 1 re-armed fresh rings whose deposits survive the
+    # clean finish (the final stop() deposit at minimum)
+    gen1_profiles = []
+    for proc in (0, 1):
+        try:
+            doc = flightrecorder.harvest(
+                flightrecorder.ring_path(flight, proc)
+            )
+        except (OSError, ValueError):
+            continue
+        gen1_profiles += [
+            r for r in doc["records"] if r.get("kind") == "profile.top"
+        ]
+    assert gen1_profiles, (
+        "no profile.top record in the restarted generation's rings — the "
+        "sampler did not come back after the supervisor's restart"
+    )
+    result["profiler"] = {
+        "gen0_deposits": len(gen0_profiles),
+        "gen1_deposits": len(gen1_profiles),
+    }
+    if verbose:
+        print(f"profiler chaos leg: {result['profiler']}")
+    return result
 
 
 def main() -> int:
     try:
         run_smoke(verbose=True)
+        run_profiler_chaos_smoke(verbose=True)
     except BaseException as e:  # noqa: BLE001 — CLI exit-code surface
         print(f"chaos_smoke FAILED: {type(e).__name__}: {e}", file=sys.stderr)
         return 1

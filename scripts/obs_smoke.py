@@ -108,6 +108,11 @@ def run_smoke(n_rows: int = 8, verbose: bool = False) -> dict:
                 for ep in ("/metrics", "/snapshot", "/healthz", "/readyz"):
                     with urllib.request.urlopen(base + ep, timeout=5) as r:
                         scraped[ep] = (r.status, r.read().decode())
+                # live thread names, captured while the hub is up — the
+                # profile-off leg asserts no sampler thread ever ran
+                scraped["threads"] = sorted(
+                    t.name for t in threading.enumerate()
+                )
             except BaseException as e:  # noqa: BLE001 — surfaced below
                 errors.append(e)
             finally:
@@ -153,12 +158,62 @@ def run_smoke(n_rows: int = 8, verbose: bool = False) -> dict:
         "snapshot": snap,
         "healthz": scraped["/healthz"],
         "readyz": scraped["/readyz"],
+        "threads": scraped.get("threads", []),
     }
+
+
+# the new-plane family prefixes PATHWAY_PROFILE=0 must suppress.
+# pathway_ingest_to_emit_* (staged e2e histograms) predates the
+# profiling plane and is NOT gated by it — hence the specific prefixes
+_PROFILE_FAMILIES = (
+    "pathway_profile_",
+    "pathway_ingest_stage_",
+    "pathway_ingest_rows",
+    "pathway_ingest_flushes",
+)
+
+
+def run_profile_off_smoke(n_rows: int = 8, verbose: bool = False) -> dict:
+    """``PATHWAY_PROFILE=0`` must be silent, not merely idle: zero
+    profiler threads, zero ``pathway_profile_*``/``pathway_ingest_*``
+    families on ``/metrics`` (the family set is byte-identical to a
+    build without the profiling plane), and empty profiling payloads in
+    ``/snapshot``."""
+    from pathway_tpu.observability.prometheus import parse_exposition
+
+    saved = os.environ.get("PATHWAY_PROFILE")
+    os.environ["PATHWAY_PROFILE"] = "0"
+    try:
+        out = run_smoke(n_rows=n_rows, verbose=verbose)
+    finally:
+        if saved is None:
+            os.environ.pop("PATHWAY_PROFILE", None)
+        else:
+            os.environ["PATHWAY_PROFILE"] = saved
+    assert "pathway-profiler" not in out["threads"], (
+        f"PATHWAY_PROFILE=0 still ran a sampler thread: {out['threads']}"
+    )
+    series = parse_exposition(out["metrics"])
+    leaked = sorted({
+        name
+        for (name, _labels) in series
+        if name.startswith(_PROFILE_FAMILIES)
+    })
+    assert not leaked, f"PATHWAY_PROFILE=0 leaked /metrics families: {leaked}"
+    for key in ("profile", "ingest"):
+        payload = out["snapshot"].get(key)
+        assert not payload or not any(payload.values()), (
+            f"PATHWAY_PROFILE=0 leaked a {key!r} snapshot payload: {payload}"
+        )
+    if verbose:
+        print("profile-off leg: no sampler thread, no profiling families")
+    return out
 
 
 def main() -> int:
     try:
         run_smoke(verbose=True)
+        run_profile_off_smoke(verbose=True)
     except BaseException as e:  # noqa: BLE001 — CLI exit-code surface
         print(f"obs_smoke FAILED: {type(e).__name__}: {e}", file=sys.stderr)
         return 1

@@ -16,6 +16,14 @@ whole signals plane end to end against process 0's merged endpoints:
   stream, and (after a SIGKILL) in the crash bundle harvested from the
   dead process's flight-recorder ring;
 - ``pathway-tpu top`` renders a live frame without errors;
+- continuous profiling: the cluster-merged ``/profile`` flamegraph
+  carries both processes with ≥90% of executed engine samples
+  op-tagged, names the slow UDF's own frame as the top tagged
+  self-time frame under the operator ``/attribution`` ranks first,
+  serves speedscope JSON, renders via ``pathway-tpu profile``, and
+  ships the ``pathway_profile_*``/``pathway_ingest_stage_*`` families;
+  the post-SIGKILL crash bundle carries the sampler's last
+  ``profile.top`` deposit;
 - latency lineage: 90% of rows carry one hot key, so the key-load
   sketch must rank that key-group first cluster-wide and the commit-wave
   holder election must attribute the steady-state waves to the worker
@@ -174,6 +182,12 @@ def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
         # the periodic flusher rewrites the trace file every 0.3 s, so
         # the SIGKILL'd process still leaves its alert span on disk
         "PATHWAY_TELEMETRY_FLUSH_S": "0.3",
+        # frequent profile deposits so the crash bundle deterministically
+        # carries a profile.top record from the SIGKILL'd process; widen
+        # the ring so those deposits don't rotate the early slo.alert
+        # record out before the kill
+        "PATHWAY_PROFILE_FLIGHT_S": "1",
+        "PATHWAY_FLIGHT_RING_KB": "4096",
     }
     procs = [
         subprocess.Popen(
@@ -414,6 +428,76 @@ def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
         assert "slow-tick" in top.stdout, top.stdout
         report["top"] = {"lines": top.stdout.count("\n")}
 
+        # -- continuous profiler: the merged /profile flamegraph joins
+        # the attribution ranking. The sampler folds every thread at
+        # PATHWAY_PROFILE_HZ; >=90% of the engine's EXECUTED samples
+        # (parked waits excluded) must carry an operator tag, and the
+        # seeded slow UDF's own frame must be the top tagged self-time
+        # frame under the very operator /attribution ranked first.
+        from pathway_tpu.observability.profile_merge import top_frames
+
+        def profile_ready():
+            doc = _get_json(base + "/profile")
+            if sorted(doc.get("processes", [])) != [0, 1]:
+                return None
+            if doc.get("samples_total", 0) < 200:
+                return None
+            if (doc.get("op_tagged_share") or 0.0) < 0.9:
+                return None
+            return doc
+
+        prof = _poll(
+            profile_ready, 60,
+            "merged /profile from both processes with >=90% op-tagged "
+            "executed engine samples",
+        )
+        tagged = [f for f in top_frames(prof, n=40) if f["op"] != "-"]
+        assert tagged, "no op-tagged frames in the merged profile"
+        head = tagged[0]
+        assert head["frame"].startswith("crawl "), (
+            f"expected the slow UDF's own frame (crawl) as the top "
+            f"tagged self-time frame, got {head}"
+        )
+        assert head["op"] == att["bottleneck"], (
+            f"top profile frame tagged {head['op']!r} but /attribution "
+            f"ranks {att['bottleneck']!r} first — the operator-tag join "
+            "broke"
+        )
+        # speedscope export validates structurally
+        sp = _get_json(base + "/profile?format=speedscope")
+        assert sp["$schema"].endswith("file-format-schema.json"), sp["$schema"]
+        assert sp["profiles"] and sp["profiles"][0]["samples"], (
+            "speedscope document carries no samples"
+        )
+        # profiler + ingest-stage families ride /metrics
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            metrics3 = r.read().decode()
+        assert "pathway_profile_samples_total" in metrics3
+        assert "pathway_profile_op_tagged_share" in metrics3
+        assert "pathway_ingest_stage_seconds_total" in metrics3
+        report["profile"] = {
+            "samples": prof["samples_total"],
+            "op_tagged_share": prof["op_tagged_share"],
+            "top_frame": head["frame"],
+        }
+
+        # -- pathway-tpu profile renders the merged self-time table
+        prof_cli = subprocess.run(
+            [
+                sys.executable, "-m", "pathway_tpu.cli", "profile",
+                "--url", base + "/profile", "--top", "8",
+            ],
+            env={**env, "PATHWAY_PROCESSES": "1"},
+            timeout=60, capture_output=True, text=True,
+        )
+        assert prof_cli.returncode == 0, (
+            f"profile CLI exited {prof_cli.returncode}\n"
+            f"stderr:\n{prof_cli.stderr[-2000:]}"
+        )
+        assert "op-tagged=" in prof_cli.stdout, prof_cli.stdout
+        assert "crawl" in prof_cli.stdout, prof_cli.stdout
+        report["profile_cli"] = {"lines": prof_cli.stdout.count("\n")}
+
         # wait for the periodic flusher to land the slo.alert instant in
         # the on-disk trace part (flushes are atomic: the file is always
         # one complete flush), then SIGKILL process 0
@@ -468,8 +552,21 @@ def run_smoke(verbose: bool = False, workdir: str | None = None) -> dict:
         "the flight recorder"
     )
     assert bundle_alerts[0]["severity"] == "critical"
+    # the sampler's periodic profile.top deposit rides the same ring, so
+    # the bundle names where the dead process was burning time
+    bundle_profiles = [
+        r for r in bundle["records"] if r.get("kind") == "profile.top"
+    ]
+    assert bundle_profiles, (
+        "crash bundle carries no profile.top record — the sampler's "
+        "flight deposits did not reach the ring"
+    )
+    last_prof = bundle_profiles[-1]
+    assert last_prof.get("process") == 0, last_prof
+    assert last_prof.get("top"), last_prof
     report["bundle"] = {
         "path": bundles[0], "alerts": len(bundle_alerts),
+        "profiles": len(bundle_profiles),
         "ticks": len(bundle["last_ticks"]),
     }
 

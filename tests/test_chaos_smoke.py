@@ -20,3 +20,17 @@ def test_chaos_smoke(tmp_path):
     result = run_smoke(workdir=str(tmp_path))
     assert result["final"] == EXPECTED
     assert result["generations"] == [0, 1]
+
+
+def test_chaos_smoke_profiler_survives_crash_loop(tmp_path):
+    # monitoring server + sampling profiler armed: the supervised
+    # crash-recovery loop must still converge (no wedged teardown), the
+    # crashed generation's bundle must carry profile.top deposits, and
+    # the restarted generation must re-arm a fresh sampler
+    from chaos_smoke import EXPECTED, run_profiler_chaos_smoke
+
+    result = run_profiler_chaos_smoke(workdir=str(tmp_path))
+    assert result["final"] == EXPECTED
+    assert result["generations"] == [0, 1]
+    assert result["profiler"]["gen0_deposits"] >= 1
+    assert result["profiler"]["gen1_deposits"] >= 1

@@ -13,13 +13,24 @@ sys.path.insert(
     0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts")
 )
 
-from obs_smoke import run_smoke, validate_exposition  # noqa: E402
+from obs_smoke import (  # noqa: E402
+    run_profile_off_smoke,
+    run_smoke,
+    validate_exposition,
+)
 
 
 def test_obs_smoke_two_workers():
     result = run_smoke()
     assert "pathway_tick_duration_seconds_bucket" in result["metrics"]
     assert "pathway_frontier_lag_ms" in result["metrics"]
+
+
+def test_obs_smoke_profile_off_is_silent():
+    # PATHWAY_PROFILE=0: no sampler thread, no pathway_profile_*/
+    # pathway_ingest_* families, empty profiling snapshot payloads —
+    # the /metrics family set matches a build without the profiler
+    run_profile_off_smoke()
 
 
 def test_validate_exposition_rejects_broken_histogram():

@@ -1,0 +1,417 @@
+"""Continuous-profiling plane unit/property tests.
+
+The live cluster path (merged /profile, op-tag join against
+/attribution on a real 2-process run, CLI rendering, crash-bundle
+deposits) rides scripts/signals_smoke.py and scripts/chaos_smoke.py;
+this file pins the profiler's local invariants deterministically:
+
+- the bounded collapsed-stack table provably keeps the heaviest stacks
+  under eviction pressure;
+- cluster merge is associative (any grouping of peers yields the same
+  merged table and scalar sums);
+- the speedscope export is structurally valid (every sample indexes the
+  shared frame table, weights align);
+- operator tagging: a sampled thread holding an op slot folds its label
+  into the stack key, and the per-operator shares join on exactly the
+  executor's ``Type#node_id`` label form;
+- parked-vs-awake accounting: scheduler waits don't count against the
+  op-tag coverage denominator, executing frames do;
+- the ``PATHWAY_PROFILE=0`` kill switch silences slots, sampler, and
+  ingest counters at read time;
+- a dead peer's profile serves from the hub's last good scrape with a
+  ``stale`` age, and a never-scraped peer is marked ``null``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from pathway_tpu.observability import profiler as profiler_mod
+from pathway_tpu.observability.keyload import SpaceSaving
+from pathway_tpu.observability.profile_merge import (
+    collapsed_text,
+    merge_snapshots,
+    operator_shares,
+    render_top,
+    speedscope_document,
+    split_stack_key,
+    top_frames,
+    top_operator,
+)
+from pathway_tpu.observability.profiler import (
+    Profiler,
+    _fold_stack,
+    _is_parked,
+    _trim_stack,
+    heap_document,
+)
+
+
+def _doc(pid: int, stacks: dict[str, float], tagged: int = 0) -> dict:
+    """A synthetic per-process profile document (Profiler.snapshot shape)."""
+    s = SpaceSaving(64)
+    total = 0
+    for key, w in stacks.items():
+        s.observe(key, w)
+        total += int(w)
+    return {
+        "enabled": True,
+        "process_id": pid,
+        "hz": 19.0,
+        "capacity": 64,
+        "duration_s": 1.0,
+        "samples_total": total,
+        "engine_samples": tagged,
+        "op_tagged": tagged,
+        "errors_total": 0,
+        "threads": 1,
+        "cpu_supported": False,
+        "wall": s.snapshot(),
+        "cpu": SpaceSaving(1).snapshot(),
+    }
+
+
+def _wall_counts(doc: dict) -> dict[str, float]:
+    return {
+        k: round(c, 6)
+        for k, c, _err in SpaceSaving.from_snapshot(doc["wall"]).items()
+    }
+
+
+# -- bounded table -------------------------------------------------------
+
+
+def test_bounded_table_keeps_heaviest_stacks():
+    # 8 heavy stacks (weight 100) among 200 light ones (weight 1) must
+    # all survive a capacity-16 table; the table never exceeds capacity
+    p = Profiler(hz=1.0, capacity=16, flight_interval_s=0, process_id=0)
+    heavy = [f"thread:w;op:Op#{i};hot_{i} (m.py:1)" for i in range(8)]
+    for i in range(200):
+        p.wall.observe(f"thread:w;cold_{i} (m.py:9)", 1.0)
+        p.wall.observe(heavy[i % 8], 100.0 / 25)  # 8 x 100 total
+    kept = {k for k, _c, _e in p.wall.items()}
+    assert len(kept) <= 16
+    assert set(heavy) <= kept, f"evicted a heavy stack: {set(heavy) - kept}"
+    # heaviest-first ordering with the heavy stacks leading
+    ranked = [k for k, _c, _e in p.wall.items()][:8]
+    assert set(ranked) == set(heavy)
+
+
+# -- merge ---------------------------------------------------------------
+
+
+def test_merge_is_associative_and_sums_scalars():
+    a = _doc(0, {"thread:w;op:A#1;f (x.py:1)": 10, "thread:w;g (x.py:5)": 3},
+             tagged=10)
+    b = _doc(1, {"thread:w;op:A#1;f (x.py:1)": 7, "thread:w;op:B#2;h (y.py:2)": 5},
+             tagged=12)
+    c = _doc(2, {"thread:w;op:B#2;h (y.py:2)": 4}, tagged=4)
+    left = merge_snapshots([merge_snapshots([a, b]), c])
+    right = merge_snapshots([a, merge_snapshots([b, c])])
+    flat = merge_snapshots([a, b, c])
+    for m in (left, right):
+        assert _wall_counts(m) == _wall_counts(flat)
+        for k in ("samples_total", "engine_samples", "op_tagged"):
+            assert m[k] == flat[k], k
+        assert m["processes"] == [0, 1, 2]
+        assert m["op_tagged_share"] == flat["op_tagged_share"]
+    # the merged table is exact while the union fits capacity
+    assert _wall_counts(flat)["thread:w;op:A#1;f (x.py:1)"] == 17.0
+    assert _wall_counts(flat)["thread:w;op:B#2;h (y.py:2)"] == 9.0
+
+
+def test_merge_skips_dead_peers_and_doubles_self_merge():
+    a = _doc(0, {"thread:w;f (x.py:1)": 6})
+    merged = merge_snapshots([a, None, a])
+    assert _wall_counts(merged)["thread:w;f (x.py:1)"] == 12.0
+    assert merged["processes"] == [0]
+    empty = merge_snapshots([None, None])
+    assert empty["samples_total"] == 0 and not empty["enabled"]
+
+
+# -- renderers -----------------------------------------------------------
+
+
+def test_speedscope_document_is_structurally_valid():
+    doc = merge_snapshots([
+        _doc(0, {"thread:w;op:A#1;f (x.py:1);g (x.py:5)": 10,
+                 "thread:io;r (z.py:3)": 2}, tagged=10),
+    ])
+    sp = speedscope_document(doc)
+    assert sp["$schema"] == (
+        "https://www.speedscope.app/file-format-schema.json"
+    )
+    prof = sp["profiles"][0]
+    assert prof["type"] == "sampled"
+    nframes = len(sp["shared"]["frames"])
+    assert len(prof["samples"]) == len(prof["weights"]) > 0
+    for stack in prof["samples"]:
+        assert stack and all(0 <= i < nframes for i in stack)
+    assert prof["endValue"] == pytest.approx(sum(prof["weights"]))
+    # thread/op pseudo-frames lead each tagged stack
+    names = [sp["shared"]["frames"][i]["name"] for i in prof["samples"][0]]
+    assert names[0].startswith("[thread ")
+
+
+def test_top_frames_and_collapsed_text():
+    doc = merge_snapshots([
+        _doc(0, {"thread:w;op:A#1;f (x.py:1);leaf (x.py:9)": 30,
+                 "thread:w;op:B#2;g (y.py:2);leaf (x.py:9)": 5,
+                 "thread:w;other (y.py:7)": 1}, tagged=35),
+    ])
+    top = top_frames(doc, n=5)
+    assert top[0]["frame"] == "leaf (x.py:9)"
+    assert top[0]["self"] == 35.0
+    assert top[0]["op"] == "A#1"  # dominant tag wins the join
+    text = collapsed_text(doc)
+    assert "thread:w;op:A#1;f (x.py:1);leaf (x.py:9) 30" in text
+    rendered = render_top(doc, n=3)
+    assert "op-tagged=" in rendered and "leaf (x.py:9)" in rendered
+
+
+def test_operator_shares_join_on_attribution_labels():
+    # the executor publishes f"{type(node).__name__}#{node.node_id}" —
+    # operator_shares must rank exactly those labels (the join key)
+    doc = merge_snapshots([
+        _doc(0, {"thread:w;op:Rowwise#1;f (x.py:1)": 9,
+                 "thread:w;op:Reduce#4;g (y.py:2)": 3,
+                 "thread:w;park (t.py:5)": 88}, tagged=12),
+    ])
+    shares = operator_shares(doc)
+    assert list(shares) == ["Rowwise#1", "Reduce#4"]  # untagged excluded
+    assert shares["Rowwise#1"] == pytest.approx(0.75)
+    assert top_operator(doc) == "Rowwise#1"
+
+
+# -- sampling + op tagging ----------------------------------------------
+
+
+def test_sample_once_tags_thread_holding_op_slot():
+    stop, ready = threading.Event(), threading.Event()
+
+    def engine():
+        slot = profiler_mod.current_op_slot()
+        assert slot is not None
+        slot.label = "Rowwise#1"
+        ready.set()
+        while not stop.is_set():
+            pass
+        profiler_mod.release_op_slot()
+
+    t = threading.Thread(target=engine, name="fake-engine", daemon=True)
+    t.start()
+    try:
+        assert ready.wait(5)
+        p = Profiler(hz=1.0, capacity=64, flight_interval_s=0)
+        for _ in range(3):
+            p.sample_once()
+        snap = p.snapshot()
+        assert snap["op_tagged"] == snap["engine_samples"] >= 3
+        keys = [k for k, _c, _e in SpaceSaving.from_snapshot(
+            snap["wall"]).items()]
+        tagged = [k for k in keys if "op:Rowwise#1" in k]
+        assert tagged, keys
+        thread, op, frames = split_stack_key(tagged[0])
+        assert thread == "fake-engine" and op == "Rowwise#1"
+        # the spinning function is on the stack (leaf may be the
+        # is_set() call it makes each iteration)
+        assert any(fr.startswith("engine ") for fr in frames), frames
+        assert p.metrics_snapshot()["op_tagged_share"] == 1.0
+    finally:
+        stop.set()
+        t.join(5)
+
+
+def test_parked_engine_thread_stays_out_of_coverage_denominator():
+    stop, ready = threading.Event(), threading.Event()
+
+    def engine():
+        profiler_mod.current_op_slot()  # slot registered, label None
+        ready.set()
+        stop.wait(30)  # leaf frame: threading.py wait -> parked
+        profiler_mod.release_op_slot()
+
+    t = threading.Thread(target=engine, name="parked-engine", daemon=True)
+    t.start()
+    try:
+        assert ready.wait(5)
+        time.sleep(0.1)  # let the thread settle into the wait
+        p = Profiler(hz=1.0, capacity=64, flight_interval_s=0)
+        for _ in range(3):
+            p.sample_once()
+        snap = p.snapshot()
+        # wall samples landed (the wait shows in the flamegraph)...
+        assert snap["samples_total"] >= 3
+        # ...but a parked, label-less engine thread is not "untagged
+        # executed work" — coverage denominator stays empty
+        assert snap["engine_samples"] == 0 and snap["op_tagged"] == 0
+    finally:
+        stop.set()
+        t.join(5)
+
+
+def test_is_parked_classification():
+    def frame(fn, name):
+        return SimpleNamespace(
+            f_code=SimpleNamespace(co_filename=fn, co_name=name)
+        )
+
+    assert _is_parked(frame("/usr/lib/python3/threading.py", "wait"))
+    assert _is_parked(frame("/usr/lib/python3/selectors.py", "select"))
+    assert _is_parked(frame("/repo/parallel/cluster.py", "_send_vectored"))
+    assert _is_parked(frame("/repo/parallel/cluster.py", "_recv_into"))
+    assert not _is_parked(frame("/repo/engine/executor.py", "_tick"))
+    assert not _is_parked(frame("/usr/lib/python3/threading.py", "run"))
+    assert not _is_parked(frame("/repo/parallel/cluster.py", "send"))
+
+
+def test_fold_and_trim_stack():
+    def inner():
+        return _fold_stack(
+            __import__("sys")._getframe(), "w0", "Rowwise#1"
+        )
+
+    key = inner()
+    assert key.startswith("thread:w0;op:Rowwise#1;")
+    _thread, _op, frames = split_stack_key(key)
+    assert frames[-1].startswith("inner ")  # leaf-last, root-first
+    deep = "thread:w;op:A#1;" + ";".join(
+        f"f{i} (m.py:{i})" for i in range(20)
+    )
+    trimmed = _trim_stack(deep, keep=6)
+    parts = trimmed.split(";")
+    assert parts[:2] == ["thread:w", "op:A#1"] and parts[2] == "..."
+    assert len(parts) == 2 + 1 + 6 and parts[-1] == "f19 (m.py:19)"
+    assert _trim_stack("thread:w;f (m.py:1)") == "thread:w;f (m.py:1)"
+
+
+# -- flight deposits -----------------------------------------------------
+
+
+def test_flight_deposit_lands_profile_top_record(tmp_path, monkeypatch):
+    from pathway_tpu.observability import flightrecorder
+
+    monkeypatch.setenv("PATHWAY_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "0")
+    monkeypatch.setenv("PATHWAY_RUN_ID", "proftest")
+    p = Profiler(hz=1.0, capacity=64, flight_interval_s=0, process_id=0)
+    p.wall.observe("thread:w;op:A#1;f (x.py:1)", 4.0)
+    p.samples_total = 4
+    p._deposit_flight()
+    doc = flightrecorder.harvest(flightrecorder.ring_path(str(tmp_path), 0))
+    tops = [r for r in doc["records"] if r.get("kind") == "profile.top"]
+    assert tops and tops[-1]["process"] == 0
+    assert tops[-1]["samples"] == 4
+    assert tops[-1]["top"][0][0] == "thread:w;op:A#1;f (x.py:1)"
+
+
+# -- kill switch ---------------------------------------------------------
+
+
+def test_kill_switch_silences_slots_sampler_and_ingest(monkeypatch):
+    from pathway_tpu.observability.hub import ObservabilityHub
+
+    monkeypatch.setenv("PATHWAY_PROFILE", "0")
+    assert not profiler_mod.enabled()
+    assert profiler_mod.current_op_slot() is None
+    hub = ObservabilityHub()
+    assert hub.start_profiler() is None and hub.profiler is None
+    assert hub.profile_stats_snapshot() == {}
+    # module-global ingest counters survive the flip; the read gate hides
+    # them so expositions stay byte-identical to a profiler-less build
+    from pathway_tpu.io.python import INGEST_STAGE_STATS
+
+    monkeypatch.setitem(INGEST_STAGE_STATS, "rows", 100)
+    monkeypatch.setitem(INGEST_STAGE_STATS, "flushes", 3)
+    assert hub.ingest_stats_snapshot() == {}
+    monkeypatch.setenv("PATHWAY_PROFILE", "1")
+    on = hub.ingest_stats_snapshot()
+    assert on["rows_total"] == 100 and on["flushes_total"] == 3
+
+
+def test_profiler_start_stop_never_wedges():
+    p = Profiler(hz=50.0, capacity=32, flight_interval_s=0)
+    p.start()
+    assert p.start() is p  # idempotent
+    deadline = time.monotonic() + 5
+    while p.samples_total == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    t0 = time.monotonic()
+    p.stop()
+    assert time.monotonic() - t0 < 3.0  # bounded join
+    assert p.samples_total > 0
+    assert not any(
+        t.name == profiler_mod.THREAD_NAME for t in threading.enumerate()
+    )
+
+
+# -- dead-peer stale serving --------------------------------------------
+
+
+def _dead_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_profile_view_serves_dead_peer_from_last_good_scrape():
+    from pathway_tpu.observability.hub import ObservabilityHub
+
+    hub = ObservabilityHub(
+        process_id=0, n_processes=2,
+        peer_http=[("127.0.0.1", _dead_port())],
+    )
+    # never answered: marked null, nothing merged for it
+    view = hub.profile_view()
+    assert view["stale"] == {"1": None}
+    assert 1 not in view["processes"]
+    # prime the last-good scrape, then the peer "dies": the merged view
+    # keeps serving its stacks with the age stamped
+    peer = _doc(1, {"thread:w;op:Rowwise#1;f (x.py:1)": 5}, tagged=5)
+    hub._profile_cache[0] = (time.time() - 2.5, peer)
+    view = hub.profile_view()
+    age = view["stale"]["1"]
+    assert isinstance(age, float) and age >= 2.5
+    assert 1 in view["processes"]
+    assert _wall_counts(view)["thread:w;op:Rowwise#1;f (x.py:1)"] == 5.0
+    assert "stale peers" in render_top(view)
+
+
+# -- heap plane ----------------------------------------------------------
+
+
+def test_heap_document_arms_and_reports():
+    import tracemalloc
+
+    was_tracing = tracemalloc.is_tracing()
+    try:
+        doc = heap_document(top=5)
+        assert doc["armed_now"] is (not was_tracing)
+        blob = [bytearray(64 * 1024) for _ in range(8)]  # traced alloc
+        doc2 = heap_document(top=5)
+        assert doc2["armed_now"] is False
+        assert doc2["traced_current_kb"] >= 512 - 64  # the 8 blobs
+        assert doc2["top"] and all(
+            e["stack"] and e["size_kb"] >= 0 for e in doc2["top"]
+        )
+        del blob
+    finally:
+        if not was_tracing:
+            tracemalloc.stop()
+
+
+def test_enabled_reads_env_per_call(monkeypatch):
+    monkeypatch.delenv("PATHWAY_PROFILE", raising=False)
+    assert profiler_mod.enabled()  # on by default
+    monkeypatch.setenv("PATHWAY_PROFILE", "0")
+    assert not profiler_mod.enabled()
+    monkeypatch.setenv("PATHWAY_PROFILE", "1")
+    assert profiler_mod.enabled()
