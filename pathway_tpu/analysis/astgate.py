@@ -38,6 +38,7 @@ __all__ = [
     "gate",
     "gates",
     "latency_lineage_gate",
+    "serve_metrics_gate",
     "upgrade_metrics_gate",
     "import_aliases",
     "iter_py_files",
@@ -629,6 +630,94 @@ def fusion_metrics_gate() -> list[str]:
                 f"FUSION_STATS key {key!r} is not *_total — it would "
                 "render as a gauge; rename it or extend the renderer"
             )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# gate: serve-plane counters reach the hub, /metrics, signals and top
+# ---------------------------------------------------------------------------
+
+
+def serve_stats_keys() -> list[str]:
+    """The ``SERVE_STATS`` keys of ``serve/stats.py``, read from source
+    (same rationale as :func:`declared_chaos_sites`)."""
+    tree = parse_file(os.path.join(PACKAGE_DIR, "serve", "stats.py"))
+    for node in tree.body:
+        targets = (
+            node.targets
+            if isinstance(node, ast.Assign)
+            else [node.target]
+            if isinstance(node, ast.AnnAssign) and node.value is not None
+            else []
+        )
+        if any(
+            isinstance(t, ast.Name) and t.id == "SERVE_STATS"
+            for t in targets
+        ):
+            return list(ast.literal_eval(node.value))
+    raise AssertionError("serve/stats.py: SERVE_STATS not found")
+
+
+@gate(
+    "serve_metrics",
+    "every SERVE_STATS counter ships in the hub snapshot, renders as "
+    "pathway_serve_* on /metrics, records as serve.* signals and shows "
+    "in `pathway-tpu top`",
+)
+def serve_metrics_gate() -> list[str]:
+    problems: list[str] = []
+    keys = serve_stats_keys()
+    if not keys:
+        return ["serve/stats.py declares no SERVE_STATS keys"]
+    hub_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "hub.py")
+    )
+    prom_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "prometheus.py")
+    )
+    ts_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "timeseries.py")
+    )
+    top_src = read_text(
+        os.path.join(PACKAGE_DIR, "observability", "top.py")
+    )
+    if "serve_stats_snapshot" not in hub_src or '"serve"' not in hub_src:
+        problems.append(
+            "observability/hub.py does not ship the serve counters in "
+            "its snapshot/query documents"
+        )
+    if "pathway_serve_" not in prom_src or "serve_stats" not in prom_src:
+        problems.append(
+            "observability/prometheus.py never renders pathway_serve_* "
+            "— the counters silently vanish from /metrics"
+        )
+    if '"serve.' not in ts_src and 'f"serve.' not in ts_src:
+        problems.append(
+            "observability/timeseries.py never records the serve.* "
+            "signals series — the autoscale decider flies blind on "
+            "admission pressure"
+        )
+    if '"serve"' not in top_src:
+        problems.append(
+            "observability/top.py never renders a serve line — overload "
+            "is invisible in the operator dashboard"
+        )
+    # the prometheus renderer is generic over SERVE_STATS keys: every
+    # key must be *_total so it renders as a counter (live gauges come
+    # from the registered providers and must NOT use the suffix)
+    for key in keys:
+        if not key.endswith("_total"):
+            problems.append(
+                f"SERVE_STATS key {key!r} is not *_total — it would "
+                "render as a gauge; rename it or extend the renderer"
+            )
+    # the decider must consume the serve signal it scales on
+    dec_src = read_text(os.path.join(PACKAGE_DIR, "autoscale", "decider.py"))
+    if "serve_frac" not in dec_src:
+        problems.append(
+            "autoscale/decider.py never consumes the serve admission "
+            "signal — 429 pressure can't trigger a scale-up"
+        )
     return problems
 
 

@@ -13,10 +13,14 @@ Decision rules (knobs in :class:`DeciderConfig`, env-filled by
 
 - **scale up** when the worst worker's wall-anchored frontier lag stays
   above ``up_lag_ms`` for ``up_for_s`` *while input is flowing* (a lag
-  that grows because the stream ended is idleness, not pressure), or
-  when the comm send queues stay at ``up_queue_frac`` of their bound
+  that grows because the stream ended is idleness, not pressure), when
+  the comm send queues stay at ``up_queue_frac`` of their bound
   for as long — the PATHWAY_COMM_QUEUE_FRAMES backpressure about to
-  block the tick loop;
+  block the tick loop — or when the serve plane's admission queue
+  (``serve.queue_depth`` vs ``serve.queue_bound``, serve/stats.py)
+  stays at ``up_serve_frac`` of its bound for as long: sustained 429
+  pressure at the query door is exactly the signal "add a shard
+  worker";
 - **scale down** when total ingest+emit falls below ``down_rows_per_s``
   for ``down_for_s``;
 - **hysteresis**: a breach streak is a run of *consecutive* breaching
@@ -56,6 +60,9 @@ class DeciderConfig:
     up_lag_ms: float = 1000.0
     #: sustained send-queue occupancy (fraction of the queue bound)
     up_queue_frac: float = 0.5
+    #: sustained serve admission-queue occupancy (fraction of
+    #: PATHWAY_SERVE_QUEUE_BOUND) that means "queries are being shed"
+    up_serve_frac: float = 0.5
     #: total input+output rows/s below which the cluster counts as idle
     down_rows_per_s: float = 1.0
     up_for_s: float = 3.0
@@ -77,6 +84,7 @@ class DeciderConfig:
             max_workers=max_workers,
             up_lag_ms=_env_float("PATHWAY_AUTOSCALE_UP_LAG_MS", 1000.0),
             up_queue_frac=_env_float("PATHWAY_AUTOSCALE_UP_QUEUE_FRAC", 0.5),
+            up_serve_frac=_env_float("PATHWAY_AUTOSCALE_UP_SERVE_FRAC", 0.5),
             down_rows_per_s=_env_float(
                 "PATHWAY_AUTOSCALE_DOWN_ROWS_PER_S", 1.0
             ),
@@ -127,10 +135,28 @@ def _doc_signals(doc: dict) -> dict | None:
         frac = float(depth) / float(cap)
         if queue_frac is None or frac > queue_frac:
             queue_frac = frac
+    # serve section: merged docs key by process, single-process docs are
+    # flat; the worst process's admission-queue occupancy is the signal
+    serve = doc.get("serve") or {}
+    serve_by_proc = (
+        serve
+        if serve and all(isinstance(v, dict) for v in serve.values())
+        else {"0": serve}
+    )
+    serve_frac = None
+    for s in serve_by_proc.values():
+        depth = (s or {}).get("queue_depth")
+        cap = (s or {}).get("queue_bound")
+        if depth is None or not cap:
+            continue
+        frac = float(depth) / float(cap)
+        if serve_frac is None or frac > serve_frac:
+            serve_frac = frac
     return {
         "lag_ms": max(lags) if lags else None,
         "rows_per_s": rate if saw_rate else None,
         "queue_frac": queue_frac,
+        "serve_frac": serve_frac,
         "n_workers_reporting": len(workers),
     }
 
@@ -197,10 +223,14 @@ class Decider:
         lag, rows, queue = (
             sig["lag_ms"], sig["rows_per_s"], sig["queue_frac"]
         )
+        serve = sig["serve_frac"]
         flowing = rows is not None and rows >= cfg.down_rows_per_s
         lag_hot = lag is not None and lag > cfg.up_lag_ms and flowing
         queue_hot = queue is not None and queue >= cfg.up_queue_frac
-        up = lag_hot or queue_hot
+        # serve pressure needs no "flowing" guard: queries queueing at
+        # the admission door IS the load, whatever the ingest rate says
+        serve_hot = serve is not None and serve >= cfg.up_serve_frac
+        up = lag_hot or queue_hot or serve_hot
         down = rows is not None and rows < cfg.down_rows_per_s and not up
         if up:
             self._down_since = None
@@ -225,11 +255,14 @@ class Decider:
             and current < cfg.max_workers
         ):
             target = min(cfg.max_workers, current + cfg.step)
-            why = (
-                f"frontier lag {lag:.0f}ms > {cfg.up_lag_ms:.0f}ms"
-                if lag_hot
-                else f"send queue {queue:.2f} >= {cfg.up_queue_frac:.2f}"
-            )
+            if lag_hot:
+                why = f"frontier lag {lag:.0f}ms > {cfg.up_lag_ms:.0f}ms"
+            elif queue_hot:
+                why = f"send queue {queue:.2f} >= {cfg.up_queue_frac:.2f}"
+            else:
+                why = (
+                    f"serve queue {serve:.2f} >= {cfg.up_serve_frac:.2f}"
+                )
             return Decision(
                 target, "up", f"{why} for {cfg.up_for_s:.1f}s", sig
             )

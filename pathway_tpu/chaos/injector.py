@@ -195,6 +195,13 @@ class ActiveFaults:
         ]
         return SinkFaults(self, worker_id, matches) if matches else None
 
+    def serve_faults(self) -> "ServeFaults | None":
+        matches = [
+            (i, f) for i, f in enumerate(self.plan.faults)
+            if f.site == "serve.query"
+        ]
+        return ServeFaults(self, matches) if matches else None
+
     def spill_faults(self, worker_id: int) -> "SpillFaults | None":
         matches = [
             (i, f) for i, f in enumerate(self.plan.faults)
@@ -414,6 +421,38 @@ class SinkFaults:
             ):
                 continue
             if self._owner._decide(idx, f, self._scope):
+                return f.action, (
+                    f.delay_s if f.delay_s is not None else 0.05
+                )
+        return None
+
+
+class ServeFaults:
+    """Bound serve.query-site handle for the serve router's hops.
+
+    ``op_for(phase, shard_worker)`` returns the (action, delay_s) to
+    apply to the NEXT matching hop event — ``drop`` / ``delay`` /
+    ``fail`` (the router implements those, it owns the degraded-gather
+    machinery each must exercise) — or None. ``kill`` executes HERE
+    (SIGKILL self): the hop that matched runs in the process hosting
+    the shard, which is exactly the shard-loss the smoke wants dead."""
+
+    def __init__(self, owner: ActiveFaults, matches: list[tuple[int, Fault]]):
+        self._owner = owner
+        self._matches = matches
+
+    def op_for(
+        self, phase: str, shard_worker: int
+    ) -> tuple[str, float] | None:
+        for idx, f in self._matches:
+            if f.phase not in (None, phase):
+                continue
+            if f.worker not in (None, shard_worker):
+                continue
+            scope = f"serve/{phase}/w{shard_worker}"
+            if self._owner._decide(idx, f, scope):
+                if f.action == "kill":
+                    os.kill(os.getpid(), signal.SIGKILL)
                 return f.action, (
                     f.delay_s if f.delay_s is not None else 0.05
                 )

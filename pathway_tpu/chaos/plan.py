@@ -72,6 +72,16 @@ Sites and actions:
   selected by ``phase`` and ``nth``. A kill before ``promote`` must
   leave the OLD graph version bootable; at/after ``cleanup`` the NEW
   one — exactly-once output must hold across the code-version flip.
+- ``serve.query`` — the serve plane's query fan-out hops
+  (``serve/router.py``): ``phase`` selects the hop — ``scatter`` (the
+  origin posting a query to a shard), ``search`` (a shard responder
+  about to search its local index), ``result`` (a responder posting
+  its answer back). ``action`` is ``drop`` (lose the event at that
+  hop — the gather must degrade, never hang), ``delay`` (sleep
+  ``delay_s``), ``fail`` (the responder answers with an error) or
+  ``kill`` (SIGKILL the responder's process mid-load — the shard-loss
+  smoke). Selected by ``worker`` (the SHARD worker the hop concerns),
+  ``nth``/``prob`` and ``phase``.
 - ``state.spill`` — the memory-budget spill tier's blob writes
   (``engine/spill.py``: join-run payloads, groupby cold buckets, key-
   registry cold buckets). ``action`` is ``fail`` (raise before writing),
@@ -108,7 +118,7 @@ __all__ = ["Fault", "FaultPlan", "load_plan_from_env"]
 
 _SITES = (
     "tick", "comm.send", "comm.local", "persistence.put", "rescale",
-    "autoscale", "state.spill", "sink.write", "upgrade",
+    "autoscale", "state.spill", "sink.write", "upgrade", "serve.query",
 )
 _ACTIONS = {
     "tick": ("crash", "exit", "kill", "hang"),
@@ -120,6 +130,7 @@ _ACTIONS = {
     "state.spill": ("fail", "torn", "kill"),
     "sink.write": ("fail", "torn", "delay", "hang", "reject"),
     "upgrade": ("crash", "exit", "kill", "torn"),
+    "serve.query": ("drop", "delay", "fail", "kill"),
 }
 #: rescale-site phase boundaries, in execution order (resharder.py)
 RESCALE_PHASES = ("plan", "stage", "copy", "promote", "cleanup")
@@ -127,11 +138,14 @@ RESCALE_PHASES = ("plan", "stage", "copy", "promote", "cleanup")
 AUTOSCALE_PHASES = ("decide", "drain", "reshard", "resume")
 #: upgrade-site phase boundaries, in execution order (upgrade/migrator.py)
 UPGRADE_PHASES = ("plan", "stage", "backfill", "carry", "promote", "cleanup")
+#: serve.query-site hops, in query-lifecycle order (serve/router.py)
+SERVE_PHASES = ("scatter", "search", "result")
 #: which phase vocabulary each phased site validates against
 _PHASES_BY_SITE = {
     "rescale": RESCALE_PHASES,
     "autoscale": AUTOSCALE_PHASES,
     "upgrade": UPGRADE_PHASES,
+    "serve.query": SERVE_PHASES,
 }
 
 

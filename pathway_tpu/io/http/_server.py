@@ -214,8 +214,29 @@ class _RestSubject(ConnectorSubject):
         self._names = schema.column_names()
         webserver._add_route(route, methods, self._handle)
 
+    #: cap on how long one admission wait may hold an executor thread;
+    #: past it the client gets 429 + Retry-After instead of a slot
+    _ADMIT_WAIT_S = 2.0
+
     async def _handle(self, request):
         web = self.webserver._web
+        try:
+            return await self._handle_inner(request, web)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # a handler bug answers as structured JSON, never a bare 500
+            # page (and never a silently dropped connection)
+            return web.json_response(
+                {"error": str(e), "kind": type(e).__name__}, status=500
+            )
+
+    async def _handle_inner(self, request, web):
+        from ...serve import status as serve_status
+        from ...serve.admission import shared_controller
+        from ...serve.merge import default_deadline_ms
+        from ...serve.stats import bump as serve_bump
+
         if request.method in ("POST", "PUT", "PATCH"):
             try:
                 payload = await request.json()
@@ -243,21 +264,96 @@ class _RestSubject(ConnectorSubject):
                 return web.json_response(
                     {"error": f"missing field {n!r}"}, status=400
                 )
+
+        # per-query deadline: client header beats the knob default
+        deadline_ms = default_deadline_ms()
+        hdr = request.headers.get("X-Pathway-Deadline-Ms")
+        if hdr:
+            try:
+                deadline_ms = max(1.0, float(hdr))
+            except ValueError:
+                return web.json_response(
+                    {"error": "bad X-Pathway-Deadline-Ms"}, status=400
+                )
+
+        ctrl = shared_controller()
+        loop = asyncio.get_event_loop()
+        t0 = loop.time()
+        admit = loop.run_in_executor(
+            None,
+            ctrl.try_admit,
+            min(self._ADMIT_WAIT_S, deadline_ms / 1e3),
+        )
+        try:
+            slot = await admit
+        except asyncio.CancelledError:
+            # client gone while waiting at the door: a slot granted after
+            # this point must go straight back
+            admit.add_done_callback(
+                lambda f: (
+                    ctrl.cancel(f.result())
+                    if not f.cancelled()
+                    and f.exception() is None
+                    and f.result() is not None
+                    else None
+                )
+            )
+            raise
+        if slot is None:
+            # saturated: shed at the door with back-off advice so the
+            # accepted-query tail stays bounded
+            retry_s = ctrl.retry_after_s()
+            return web.json_response(
+                {"error": "saturated", "retry_after_s": round(retry_s, 3)},
+                status=429,
+                headers={"Retry-After": str(max(1, int(retry_s + 0.999)))},
+            )
+
         key = int(K.ref_scalar(next(_request_counter), salt=0x9E57))
         fut = asyncio.get_event_loop().create_future()
         self._futures[key] = fut
         if self.delete_completed_queries:
             self._rows[key] = row  # kept only for the later retraction
-        self._next_with_key(key, **row)
-        self.commit()
         try:
-            result = await asyncio.wait_for(fut, timeout=120)
-        except asyncio.TimeoutError:
+            import time as _time
+
+            serve_status.note_deadline(
+                key, _time.time_ns() + int(deadline_ms * 1e6)
+            )
+            self._next_with_key(key, **row)
+            self.commit()
+            remaining_s = max(0.001, deadline_ms / 1e3 - (loop.time() - t0))
+            try:
+                result = await asyncio.wait_for(fut, timeout=remaining_s)
+            except asyncio.TimeoutError:
+                self._futures.pop(key, None)
+                serve_bump("deadline_dropped_total")
+                return web.json_response({"error": "timeout"}, status=504)
+            if isinstance(result, Json):
+                result = result.value
+            headers = {}
+            st = serve_status.take_status(key)
+            if st is not None and (
+                st.get("degraded") or st.get("deadline_exceeded")
+            ):
+                headers["X-Pathway-Degraded"] = "1"
+                if isinstance(result, dict):
+                    result = dict(result)
+                    result["degraded"] = True
+                    result["missing_shards"] = list(
+                        st.get("missing_shards", ())
+                    )
+            return web.json_response(result, dumps=_dumps, headers=headers)
+        except asyncio.CancelledError:
+            # client disconnected mid-flight: free the slot now, drop the
+            # pending future (the engine's late answer finds nobody)
             self._futures.pop(key, None)
-            return web.json_response({"error": "timeout"}, status=504)
-        if isinstance(result, Json):
-            result = result.value
-        return web.json_response(result, dumps=_dumps)
+            ctrl.cancel(slot)
+            slot = None
+            raise
+        finally:
+            if slot is not None:
+                ctrl.release(slot, service_s=loop.time() - t0)
 
     def _complete(self, key: int, value: Any) -> None:
         """Called from the engine thread by the response writer sink."""

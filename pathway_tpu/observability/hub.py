@@ -364,6 +364,22 @@ class ObservabilityHub:
             return {}
 
     @staticmethod
+    def serve_stats_snapshot() -> dict[str, float]:
+        """This process's serve-plane counters + live gauges (admitted /
+        rejected / degraded queries, scatter posts, in-flight, queue
+        depth — serve/stats.py), so an overloaded or shard-degraded
+        serving cluster reads as numbers, not client anecdotes. Empty
+        until the serve plane ran, keeping non-serving expositions
+        byte-identical."""
+        try:
+            from ..serve.stats import serve_stats_snapshot
+
+            return serve_stats_snapshot()
+        except Exception:
+            # telemetry must not fail the run it observes
+            return {}
+
+    @staticmethod
     def ingest_stats_snapshot() -> dict[str, float]:
         """This process's staged ingest cost split (parse | hash | delta
         seconds + rows/flushes — io/python.INGEST_STAGE_STATS), the
@@ -413,6 +429,7 @@ class ObservabilityHub:
             "sinks": self.sink_stats_snapshot(),
             "udf": self.udf_stats_snapshot(),
             "fusion": self.fusion_stats_snapshot(),
+            "serve": self.serve_stats_snapshot(),
             "ingest": self.ingest_stats_snapshot(),
             "profile": self.profile_stats_snapshot(),
             "trace_dropped": self._local_trace_dropped(),
@@ -425,6 +442,7 @@ class ObservabilityHub:
         dict[str, dict],
         dict[str, int],
         dict[str, float],
+        dict[str, dict],
         dict[str, dict],
         dict[str, dict],
         dict[str, dict],
@@ -450,6 +468,7 @@ class ObservabilityHub:
         sink_stats = {str(self.process_id): self.sink_stats_snapshot()}
         udf_stats = {str(self.process_id): self.udf_stats_snapshot()}
         fusion_stats = {str(self.process_id): self.fusion_stats_snapshot()}
+        serve_stats = {str(self.process_id): self.serve_stats_snapshot()}
         ingest_stats = {str(self.process_id): self.ingest_stats_snapshot()}
         profile_stats = {str(self.process_id): self.profile_stats_snapshot()}
         trace_dropped: dict[str, int] = {}
@@ -496,6 +515,9 @@ class ObservabilityHub:
             peer_fusion = doc.get("fusion")
             if peer_fusion:
                 fusion_stats[str(doc.get("process_id", "?"))] = peer_fusion
+            peer_serve = doc.get("serve")
+            if peer_serve:
+                serve_stats[str(doc.get("process_id", "?"))] = peer_serve
             peer_ingest = doc.get("ingest")
             if peer_ingest:
                 ingest_stats[str(doc.get("process_id", "?"))] = peer_ingest
@@ -511,6 +533,7 @@ class ObservabilityHub:
         return (
             snapshots, comm_stats, trace_dropped, stale, memory_stats,
             sink_stats, udf_stats, fusion_stats, ingest_stats, profile_stats,
+            serve_stats,
         )
 
     @staticmethod
@@ -624,6 +647,7 @@ class ObservabilityHub:
         doc["sinks"] = self.sink_stats_snapshot()
         doc["udf"] = self.udf_stats_snapshot()
         doc["fusion"] = self.fusion_stats_snapshot()
+        doc["serve"] = self.serve_stats_snapshot()
         doc["ingest"] = self.ingest_stats_snapshot()
         doc["profile"] = self.profile_stats_snapshot()
         doc["waves"] = self._waves_document()
@@ -733,6 +757,7 @@ class ObservabilityHub:
         merged["sinks"] = {str(self.process_id): local.get("sinks", {})}
         merged["udf"] = {str(self.process_id): local.get("udf", {})}
         merged["fusion"] = {str(self.process_id): local.get("fusion", {})}
+        merged["serve"] = {str(self.process_id): local.get("serve", {})}
         merged["ingest"] = {str(self.process_id): local.get("ingest", {})}
         merged["profile"] = {str(self.process_id): local.get("profile", {})}
         merged["alerts"] = {
@@ -753,6 +778,7 @@ class ObservabilityHub:
             merged["sinks"][str(pid)] = doc.get("sinks", {})
             merged["udf"][str(pid)] = doc.get("udf", {})
             merged["fusion"][str(pid)] = doc.get("fusion", {})
+            merged["serve"][str(pid)] = doc.get("serve", {})
             merged["ingest"][str(pid)] = doc.get("ingest", {})
             merged["profile"][str(pid)] = doc.get("profile", {})
             alerts = doc.get("alerts", {})
@@ -932,7 +958,7 @@ class ObservabilityHub:
             (
                 snapshots, comm_stats, dropped_by_proc, stale,
                 memory_stats, sink_stats, udf_stats, fusion_stats,
-                ingest_stats, profile_stats,
+                ingest_stats, profile_stats, serve_stats,
             ) = self.cluster_snapshots()
             # per-process labels, like the comm gauges: series identity
             # stays stable when a peer scrape transiently fails
@@ -955,6 +981,8 @@ class ObservabilityHub:
             profile_stats = (
                 {str(self.process_id): profile} if profile else {}
             )
+            serve = self.serve_stats_snapshot()
+            serve_stats = {str(self.process_id): serve} if serve else {}
             trace_dropped = self._local_trace_dropped()
         # label by TOPOLOGY, not by how many snapshots this scrape got:
         # in cluster mode a transient peer outage must not flip series
@@ -1004,6 +1032,7 @@ class ObservabilityHub:
             fusion_stats=fusion_stats or None,
             ingest_stats=_drop_empty(ingest_stats),
             profile_stats=_drop_empty(profile_stats),
+            serve_stats=_drop_empty(serve_stats),
         )
 
     @staticmethod

@@ -123,6 +123,7 @@ def render_snapshots(
     fusion_stats: dict[str, dict[str, float]] | None = None,
     ingest_stats: dict[str, dict[str, float]] | None = None,
     profile_stats: dict[str, dict[str, float]] | None = None,
+    serve_stats: dict[str, dict[str, float]] | None = None,
 ) -> str:
     """Exposition text for a set of worker stats snapshots.
 
@@ -315,6 +316,15 @@ def render_snapshots(
                     value,
                     {"process": str(proc)},
                 )
+    for proc, gauges in sorted((serve_stats or {}).items()):
+        # serve-plane counters + gauges (serve/stats.py): queries
+        # admitted / rejected / degraded, scatter posts, shard searches,
+        # plus the live in-flight and queue-depth admission gauges — the
+        # pathway_serve_* overload-visibility surface
+        plab = {"process": str(proc)}
+        for key, value in sorted(gauges.items()):
+            kind = "counter" if key.endswith("_total") else "gauge"
+            r.add(f"pathway_serve_{key}", kind, value, plab)
     for proc, gauges in sorted((profile_stats or {}).items()):
         # continuous-profiling scalars (observability/profiler.py):
         # samples taken, distinct collapsed stacks, top-frame share and

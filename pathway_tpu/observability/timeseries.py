@@ -515,6 +515,12 @@ class SignalsPlane:
         # the fused path after a schema/dtype change
         for key, value in self.hub.fusion_stats_snapshot().items():
             self.store.record(f"fusion.{key}", float(value), None, t)
+        # serve-plane counters + admission gauges (serve/stats.py): the
+        # autoscale decider watches serve.queue_depth / serve.inflight
+        # against their bounds, and an SLO rule can watch
+        # serve.rejected_total or serve.degraded_total directly
+        for key, value in self.hub.serve_stats_snapshot().items():
+            self.store.record(f"serve.{key}", float(value), None, t)
         # staged ingest cost split (io/python.INGEST_STAGE_STATS): an SLO
         # rule can watch ingest.hash_s grow faster than ingest.parse_s —
         # the columnar-ingest arc's regression tripwire (ROADMAP item 2)

@@ -199,6 +199,31 @@ def render_frame(doc: dict, now: float | None = None) -> str:
         if f.get("jit_chains_total"):
             line += f", {_fmt(f.get('jit_chains_total'), nd=0)} XLA"
         lines.append(line)
+    srv = doc.get("serve", {})
+    # merged docs key serve by process; single-process docs are flat
+    srv_by_proc = (
+        srv
+        if srv and all(isinstance(v, dict) for v in srv.values())
+        else {str(doc.get("process_id", 0)): srv}
+    )
+    for proc in sorted(srv_by_proc):
+        s = srv_by_proc[proc] or {}
+        if not any(s.values()):
+            continue
+        line = (
+            f"serve p{proc}: {_fmt(s.get('queries_total'), nd=0)} "
+            f"quer(ies), inflight {_fmt(s.get('inflight'), nd=0)}/"
+            f"{_fmt(s.get('max_inflight'), nd=0)}, "
+            f"queue {_fmt(s.get('queue_depth'), nd=0)}, "
+            f"{_fmt(s.get('rejected_total'), nd=0)} rejected"
+        )
+        if s.get("degraded_total"):
+            line += f", {s['degraded_total']:.0f} degraded"
+        if s.get("deadline_dropped_total"):
+            line += (
+                f", {s['deadline_dropped_total']:.0f} deadline-dropped"
+            )
+        lines.append(line)
     ing = doc.get("ingest", {})
     # merged docs key ingest by process; single-process docs are flat
     ing_by_proc = (

@@ -7,6 +7,12 @@ is one bf16 matmul on the MXU (batch × index), top-k via ``lax.top_k``.
 A mesh-sharded variant splits the index rows across devices and merges
 local top-k with an all-gather — the "sharded vector index over ICI" of
 BASELINE.json's north star.
+
+The same local-top-k → global-top-k shape exists at two scales: within a
+device mesh the merge is the in-XLA all-gather below; across WORKERS the
+serve plane (``serve/router.py``) carries each shard's host-side candidate
+list over the wire and merges with :func:`merge_shard_topk` — the
+host-side generalization of this file's gather-merge.
 """
 
 from __future__ import annotations
@@ -19,7 +25,22 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["topk_scores", "knn_search", "ShardedKnnIndex", "sharded_knn_search"]
+__all__ = [
+    "topk_scores", "knn_search", "ShardedKnnIndex", "sharded_knn_search",
+    "merge_shard_topk",
+]
+
+
+def merge_shard_topk(
+    parts: "list[list[tuple[Any, float]]]", k: int
+) -> "list[tuple[Any, float]]":
+    """Merge per-shard best-first (key, score) candidate lists into a
+    global top-k on the host — the cross-worker counterpart of
+    ``sharded_knn_search``'s in-mesh all-gather merge (scores compare
+    higher-is-better; duplicate keys keep their best score)."""
+    from ..serve.merge import merge_topk
+
+    return merge_topk(parts, k)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "metric"))

@@ -292,9 +292,17 @@ class ClusterComm(Comm):
         }
         self._async_data: dict[int, int] = {w: 0 for w in self._local_workers}
         self._async_wakers: dict[int, Any] = {}
-        from .comm import async_queue_bound
+        from .comm import async_queue_bound, serve_queue_bound
 
         self._async_bound = async_queue_bound()
+        #: serve plane (pathway_tpu/serve/): per-LOCAL-worker query
+        #: event inboxes, bounded and drop-on-overflow — a lost serve
+        #: event degrades one gather, never wedges the dataflow
+        self._serve_q: dict[int, collections.deque] = {
+            w: collections.deque() for w in self._local_workers
+        }
+        self._serve_bound = serve_queue_bound()
+        self._serve_dropped = 0
         self._broken: str | None = None
         self._socks: dict[int, socket.socket] = {}
         self._writers: dict[int, _PeerWriter] = {}
@@ -518,6 +526,23 @@ class ClusterComm(Comm):
                         )
                         self._async_data[dst] += 1
                         wake.append(dst)
+                elif (
+                    isinstance(channel, tuple)
+                    and channel
+                    and channel[0] == "s"
+                ):
+                    # serve plane: (meta, payload) query events into the
+                    # bounded serve inboxes — overflow DROPS (counted);
+                    # the origin's partial-gather timeout is the recovery
+                    meta = channel[1]
+                    for dst, payload in per_dst.items():
+                        q = self._serve_q.get(dst)
+                        if q is None:
+                            continue  # stale frame for a non-local worker
+                        if len(q) >= self._serve_bound:
+                            self._serve_dropped += 1
+                            continue
+                        q.append((meta, payload))
                 else:
                     for dst, payload in per_dst.items():
                         self._inbox.setdefault(("x", channel, tick, dst), {})[src] = payload
@@ -839,6 +864,64 @@ class ClusterComm(Comm):
             self._cond.notify_all()
         return out
 
+    # -- serve plane (query scatter/gather) -----------------------------
+
+    def supports_serve(self) -> bool:
+        return True
+
+    def serve_post(self, dst_worker, meta, payload):
+        import time as time_mod
+
+        p = self._process_of(dst_worker)
+        if p == self.process_id:
+            with self._cond:
+                if self._broken is not None:
+                    return False
+                q = self._serve_q.get(dst_worker)
+                if q is None or len(q) >= self._serve_bound:
+                    self._serve_dropped += 1
+                    return False
+                q.append((meta, payload))
+                self._cond.notify_all()
+            return True
+        ctx = self._frame_ctx(p, channel="serve")
+        t0 = time_mod.perf_counter_ns()
+        # serve events ride the same columnar codec and the same
+        # chaos-gated _post as exchange frames (comm.send faults apply);
+        # the ("s", meta) channel tag routes them into the serve inbox
+        # on the receiving side instead of the rendezvous/async inboxes
+        chunks, body_len = frames.encode_frame(
+            ("s", meta), 0, self.process_id * self.threads,
+            {dst_worker: payload}, ctx,
+        )
+        with self._encode_lock:
+            self.encode_ns += time_mod.perf_counter_ns() - t0
+        try:
+            return self._post(p, [_LEN.pack(body_len)] + chunks, 8 + body_len)
+        except (RuntimeError, OSError):
+            # dead peer / torn mesh: a lost serve event degrades one
+            # gather; the caller flags the shard missing
+            return False
+
+    def serve_recv(self, worker_id, timeout_s=None):
+        with self._cond:
+            if self._broken is not None:
+                raise RuntimeError(
+                    f"process {self.process_id}: a peer worker failed: "
+                    f"{self._broken}"
+                )
+            q = self._serve_q[worker_id]
+            if not q:
+                self._cond.wait(timeout=timeout_s)
+            if self._broken is not None:
+                raise RuntimeError(
+                    f"process {self.process_id}: a peer worker failed: "
+                    f"{self._broken}"
+                )
+            out = list(q)
+            q.clear()
+        return out
+
     def _wait(self, key: Any, n: int) -> dict[int, Any]:
         deadline = time.monotonic() + self.collective_timeout_s
         with self._cond:
@@ -915,6 +998,12 @@ class ClusterComm(Comm):
             "async_inbox_capacity": float(
                 self._async_bound * max(1, len(self._async_q))
             ),
+            # serve plane: query events delivered but not yet picked up
+            # by a responder dispatcher, and events dropped at the bound
+            "serve_inbox_depth": float(
+                sum(len(q) for q in self._serve_q.values())
+            ),
+            "serve_dropped_total": float(self._serve_dropped),
         }
 
     def _break(self, reason: str) -> None:

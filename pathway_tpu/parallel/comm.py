@@ -148,6 +148,43 @@ class Comm:
         backlog stays bounded by what was already in flight."""
         return False
 
+    # -- serve plane (query scatter/gather, pathway_tpu/serve/) ---------
+    #
+    # A THIRD seam beside the BSP collectives and the async exchange
+    # plane: serve queries are fire-and-forget posts with correlation
+    # ids, no tick to wait for — but they must NOT ride the async
+    # exchange inboxes, whose sent/received totals feed the quiesce
+    # ledger (a query event in that ledger could wedge termination).
+    # Events are (meta, payload) pairs; meta is a small picklable tuple
+    # (the serve router's protocol), payload is whatever the columnar
+    # wire codec can carry. Posts never block: a full serve inbox DROPS
+    # the event and returns False (the gather's partial-result timeout
+    # is the recovery path, same as a lost frame).
+
+    def supports_serve(self) -> bool:
+        return False
+
+    def serve_post(self, dst_worker: int, meta: tuple, payload: Any) -> bool:
+        """Deliver one serve event to ``dst_worker``'s serve inbox.
+        Returns False when the event was dropped (bounded inbox full,
+        broken mesh, dead peer) — never raises, never blocks."""
+        raise NotImplementedError
+
+    def serve_recv(
+        self, worker_id: int, timeout_s: float | None = None
+    ) -> list:
+        """Block up to ``timeout_s`` for serve events addressed to
+        ``worker_id``; returns them in arrival order (possibly empty on
+        timeout). Raises RuntimeError once the mesh is broken so
+        dispatcher threads unwind instead of spinning."""
+        raise NotImplementedError
+
+
+def serve_queue_bound() -> int:
+    from ..internals.config import _env_int
+
+    return max(1, _env_int("PATHWAY_SERVE_QUEUE_BOUND", 256))
+
 
 class LocalComm(Comm):
     """In-process comm for worker threads (timely ``thread`` allocator)."""
@@ -204,17 +241,24 @@ class LocalComm(Comm):
         instead of deadlocking (worker panic propagation) — and poison
         the async plane so drains/posts raise instead of parking."""
         self._barrier.abort()
+        msg = (
+            "a peer worker failed — aborting this worker's "
+            "dataflow (cross-worker panic propagation)"
+        )
         st = self._async_state()
         if st is not None:
             with st["cond"]:
                 if st["broken"] is None:
-                    st["broken"] = (
-                        "a peer worker failed — aborting this worker's "
-                        "dataflow (cross-worker panic propagation)"
-                    )
+                    st["broken"] = msg
                 st["cond"].notify_all()
             for waker in st["wakers"].values():
                 waker.set()
+        sv = getattr(self, "_serve", None)
+        if sv is not None:
+            with sv["cond"]:
+                if sv["broken"] is None:
+                    sv["broken"] = msg
+                sv["cond"].notify_all()
 
     def exchange(self, channel, tick, worker_id, buckets):
         """In-process all-to-all. Frames pass **by reference** — the
@@ -364,6 +408,57 @@ class LocalComm(Comm):
             st["cond"].notify_all()
         return out
 
+    # -- serve plane ----------------------------------------------------
+
+    def supports_serve(self) -> bool:
+        return True
+
+    def _serve_state(self):
+        # lazy like _async_state: pipelines that never serve pay nothing
+        sv = getattr(self, "_serve", None)
+        if sv is None:
+            with self._lock:
+                sv = getattr(self, "_serve", None)
+                if sv is None:
+                    sv = self._serve = {
+                        "cond": threading.Condition(),
+                        "q": {
+                            w: collections.deque()
+                            for w in range(self.n_workers)
+                        },
+                        "dropped": 0,
+                        "broken": None,
+                        "bound": serve_queue_bound(),
+                    }
+        return sv
+
+    def serve_post(self, dst_worker, meta, payload):
+        sv = self._serve_state()
+        with sv["cond"]:
+            if sv["broken"] is not None:
+                return False
+            q = sv["q"].get(dst_worker)
+            if q is None or len(q) >= sv["bound"]:
+                sv["dropped"] += 1
+                return False
+            q.append((meta, payload))
+            sv["cond"].notify_all()
+        return True
+
+    def serve_recv(self, worker_id, timeout_s=None):
+        sv = self._serve_state()
+        with sv["cond"]:
+            if sv["broken"] is not None:
+                raise RuntimeError(sv["broken"])
+            q = sv["q"][worker_id]
+            if not q:
+                sv["cond"].wait(timeout=timeout_s)
+            if sv["broken"] is not None:
+                raise RuntimeError(sv["broken"])
+            out = list(q)
+            q.clear()
+        return out
+
     def comm_stats(self) -> dict[str, float]:
         # slots outstanding = collectives some worker entered but not all
         # left — a sustained nonzero depth means a straggler worker
@@ -376,6 +471,12 @@ class LocalComm(Comm):
             out["async_inbox_capacity"] = float(
                 st["bound"] * self.n_workers
             )
+        sv = getattr(self, "_serve", None)
+        if sv is not None:
+            out["serve_inbox_depth"] = float(
+                sum(len(q) for q in sv["q"].values())
+            )
+            out["serve_dropped_total"] = float(sv["dropped"])
         return out
 
 

@@ -1,0 +1,43 @@
+"""Scale-out serve plane: sharded-index query fan-out.
+
+The distributed query subsystem behind ``rest_connector`` serving: each
+worker holds an index shard (ownership follows the engine's row-hash
+exchange), a query is scattered to every shard as a fire-and-forget
+post with a correlation id over the comm serve seam, per-shard top-k
+results gather back at the origin and merge best-first. On top sits an
+admission controller (bounded in-flight + bounded queue, 429 with
+Retry-After on saturation), per-query deadline propagation (expired
+queries are dropped at every hop, not just the edge), and graceful
+shard-loss degradation (a dead shard yields a partial result flagged
+``degraded`` with the missing shard set — never a hung gather).
+
+Modules:
+
+- :mod:`.stats` — ``serve.*`` counters/gauges (hub → prometheus →
+  timeseries → top);
+- :mod:`.admission` — the bounded in-flight admission controller;
+- :mod:`.merge` — pure scatter/gather bookkeeping (top-k merge,
+  correlation-id dedup, partial-gather accounting);
+- :mod:`.registry` — which local worker holds which index shard;
+- :mod:`.router` — the per-process scatter/gather router over the comm
+  serve seam;
+- :mod:`.status` — process-local per-query degraded/deadline side
+  channel between the engine node and the HTTP edge.
+"""
+
+from __future__ import annotations
+
+from .admission import AdmissionController
+from .merge import GatherState, merge_topk
+from .registry import ShardRegistry
+from .stats import SERVE_STATS, bump, serve_stats_snapshot
+
+__all__ = [
+    "AdmissionController",
+    "GatherState",
+    "merge_topk",
+    "ShardRegistry",
+    "SERVE_STATS",
+    "bump",
+    "serve_stats_snapshot",
+]

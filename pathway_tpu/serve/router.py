@@ -1,0 +1,321 @@
+"""The per-process query router: scatter/gather over the comm serve seam.
+
+One router per comm backend (module singleton keyed by the live comm,
+weakly — a dead comm's dispatchers unwind on their next poll). The
+router runs one dispatcher thread per LOCAL worker, draining that
+worker's serve inbox and handling three event kinds, all
+fire-and-forget posts with a correlation id:
+
+- ``("q", qid, origin, shard, deadline_ns, limits, node_key)`` —
+  a scatter: search shard ``shard``'s registered index, post the
+  answer back to ``origin``;
+- ``("r", qid, shard)`` — a shard's answer arriving at the origin:
+  feed the pending :class:`~pathway_tpu.serve.merge.GatherState`;
+- ``("f", qid, shard)`` — a shard declining (error, missing
+  registration, expired deadline): the gather completes without it.
+
+Every hop is a ``serve.query`` chaos site (phases scatter / search /
+result); a lost event at any hop degrades exactly one gather — the
+origin's bounded wait plus :class:`GatherState`'s partial-result
+accounting guarantee no query ever hangs on a dead shard.
+
+Query payloads ride the columnar wire codec when they can: a batch of
+same-dim vector queries is posted as one ``(n, {"q": stacked})``
+PT_COLS frame; anything else (text queries, metadata filters) falls
+back to the pickle section, exactly like exchange frames.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import weakref
+from collections import OrderedDict
+from typing import Any
+
+import numpy as np
+
+from .merge import GatherState, expired
+from .registry import registry
+from .stats import bump, register_gauge_provider
+
+__all__ = ["QueryRouter", "get_router", "gather_timeout_s"]
+
+#: dispatcher poll period — also the close()-latency bound
+_POLL_S = 0.2
+
+#: responder-side seen-correlation-id window (duplicate scatter drops)
+_SEEN_MAX = 4096
+
+
+def gather_timeout_s() -> float:
+    from ..internals.config import _env_float
+
+    return max(
+        0.01, _env_float("PATHWAY_SERVE_GATHER_TIMEOUT_MS", 5000.0) / 1e3
+    )
+
+
+def _encode_queries(queries: list, filters: list) -> Any:
+    """Columnar when possible: same-dim ndarray batch + no filters →
+    the PT_COLS 2-tuple shape frames.py auto-detects."""
+    if (
+        queries
+        and all(f is None for f in filters)
+        and all(isinstance(q, np.ndarray) and q.ndim == 1 for q in queries)
+        and len({q.shape[0] for q in queries}) == 1
+    ):
+        return (len(queries), {"q": np.stack(queries)})
+    return ("obj", list(queries), list(filters))
+
+
+def _decode_queries(payload: Any) -> tuple[list, list]:
+    if (
+        isinstance(payload, tuple)
+        and len(payload) == 2
+        and isinstance(payload[1], dict)
+    ):
+        n, cols = payload
+        qs = list(cols["q"])
+        return qs, [None] * len(qs)
+    _tag, queries, filters = payload
+    return list(queries), list(filters)
+
+
+class QueryRouter:
+    def __init__(self, comm: Any, n_workers: int):
+        self._comm_ref = weakref.ref(comm)
+        self.n_workers = n_workers
+        local = getattr(comm, "_local_workers", None)
+        self.local_workers = (
+            sorted(local) if local is not None else list(range(n_workers))
+        )
+        self._seq = itertools.count()
+        self._lock = threading.Lock()
+        self._pending: dict[tuple, GatherState] = {}
+        #: per-worker seen scatter qids (duplicate-delivery dedup)
+        self._seen: dict[int, OrderedDict] = {
+            w: OrderedDict() for w in self.local_workers
+        }
+        self._closed = False
+        from ..chaos import injector as _chaos
+
+        armed = _chaos.current()
+        self._chaos = armed.serve_faults() if armed is not None else None
+        self._threads = [
+            threading.Thread(
+                target=self._dispatch_loop,
+                args=(w,),
+                daemon=True,
+                name=f"pathway-serve-w{w}",
+            )
+            for w in self.local_workers
+        ]
+        for t in self._threads:
+            t.start()
+        register_gauge_provider(self._gauges)
+
+    def _gauges(self) -> dict[str, float]:
+        with self._lock:
+            return {"pending_gathers": float(len(self._pending))}
+
+    # -- origin side ---------------------------------------------------
+
+    def scatter_search(
+        self,
+        node_key: Any,
+        origin_worker: int,
+        queries: list,
+        limits: list,
+        filters: list,
+        deadline_ns: int | None = None,
+        timeout_s: float | None = None,
+    ) -> dict:
+        """Fan a query batch out to every shard, gather, merge.
+
+        Never raises and never hangs: shards that fail, stay silent
+        past the gather timeout, or were never reachable come back in
+        ``missing_shards`` with ``degraded=True``."""
+        shards = list(range(self.n_workers))
+        if expired(deadline_ns):
+            # dropped at the first hop: the origin never scatters an
+            # already-dead query
+            bump("deadline_dropped_total")
+            return {
+                "hits": [[] for _ in queries],
+                "degraded": True,
+                "missing_shards": shards,
+                "deadline_exceeded": True,
+            }
+        qid = (node_key, origin_worker, next(self._seq))
+        g = GatherState(qid, shards, limits, deadline_ns)
+        with self._lock:
+            self._pending[qid] = g
+        payload = _encode_queries(queries, filters)
+        meta_base = (qid, origin_worker)
+        comm = self._comm_ref()
+        try:
+            for shard in shards:
+                if self._chaos is not None:
+                    op = self._chaos.op_for("scatter", shard)
+                    if op is not None:
+                        action, delay_s = op
+                        if action == "drop":
+                            continue  # lost scatter: shard goes missing
+                        if action == "fail":
+                            g.fail(shard)
+                            continue
+                        if action == "delay":
+                            time.sleep(delay_s)
+                if comm is None:
+                    g.fail(shard)
+                    continue
+                meta = (
+                    "q", qid, origin_worker, shard, deadline_ns,
+                    tuple(limits), node_key,
+                )
+                if comm.serve_post(shard, meta, payload):
+                    bump("scatter_posts_total")
+                else:
+                    g.fail(shard)
+        finally:
+            del comm
+        g.wait(timeout_s if timeout_s is not None else gather_timeout_s())
+        with self._lock:
+            self._pending.pop(qid, None)
+        return g.result()
+
+    # -- dispatcher (responder + gather feed) --------------------------
+
+    def _dispatch_loop(self, worker_id: int) -> None:
+        while not self._closed:
+            comm = self._comm_ref()
+            if comm is None:
+                break
+            try:
+                events = comm.serve_recv(worker_id, timeout_s=_POLL_S)
+            except RuntimeError:
+                self._fail_all()
+                break
+            finally:
+                del comm
+            for meta, payload in events:
+                try:
+                    self._handle(worker_id, meta, payload)
+                except Exception:
+                    bump("errors_total")
+
+    def _handle(self, worker_id: int, meta: tuple, payload: Any) -> None:
+        kind = meta[0]
+        if kind == "q":
+            self._handle_query(worker_id, meta, payload)
+        elif kind in ("r", "f"):
+            _, qid, shard = meta[:3]
+            with self._lock:
+                g = self._pending.get(qid)
+            if g is None:
+                return  # late answer for a timed-out gather
+            if kind == "r":
+                g.add(shard, payload)
+            else:
+                g.fail(shard)
+
+    def _handle_query(
+        self, worker_id: int, meta: tuple, payload: Any
+    ) -> None:
+        _, qid, origin, shard, deadline_ns, limits, node_key = meta
+        comm = self._comm_ref()
+        if comm is None:
+            return
+        seen = self._seen[worker_id]
+        if qid in seen:
+            # the serve seam inherits the async plane's at-least-once
+            # chaos duplication: a re-delivered scatter must not search
+            # (or answer) twice
+            bump("duplicate_results_total")
+            return
+        seen[qid] = True
+        while len(seen) > _SEEN_MAX:
+            seen.popitem(last=False)
+        if self._chaos is not None:
+            op = self._chaos.op_for("search", shard)
+            if op is not None:
+                action, delay_s = op
+                if action == "drop":
+                    return  # silent shard: the origin's timeout degrades
+                if action == "fail":
+                    bump("errors_total")
+                    comm.serve_post(origin, ("f", qid, shard), None)
+                    return
+                if action == "delay":
+                    time.sleep(delay_s)
+        if expired(deadline_ns):
+            # dropped at the interior hop: no search for a dead query
+            bump("deadline_dropped_total")
+            comm.serve_post(origin, ("f", qid, shard), None)
+            return
+        handle = registry().get(node_key, shard)
+        if handle is None:
+            comm.serve_post(origin, ("f", qid, shard), None)
+            return
+        try:
+            queries, filters = _decode_queries(payload)
+            hits = handle.search(queries, list(limits), filters)
+            bump("shard_searches_total")
+        except Exception:
+            bump("errors_total")
+            comm.serve_post(origin, ("f", qid, shard), None)
+            return
+        if self._chaos is not None:
+            op = self._chaos.op_for("result", shard)
+            if op is not None:
+                action, delay_s = op
+                if action == "drop":
+                    return  # lost answer: origin degrades on timeout
+                if action == "fail":
+                    bump("errors_total")
+                    comm.serve_post(origin, ("f", qid, shard), None)
+                    return
+                if action == "delay":
+                    time.sleep(delay_s)
+        comm.serve_post(origin, ("r", qid, shard), hits)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _fail_all(self) -> None:
+        with self._lock:
+            pending = list(self._pending.values())
+        for g in pending:
+            for shard in g.expected:
+                g.fail(shard)
+
+    def close(self) -> None:
+        self._closed = True
+        self._fail_all()
+
+
+_lock = threading.Lock()
+_routers: dict[int, QueryRouter] = {}
+
+
+def get_router(comm: Any, n_workers: int) -> QueryRouter:
+    """The process's router for ``comm``, created on first use. Weakly
+    bound: the router never keeps a dead comm alive, and its dispatcher
+    threads exit once the comm is collected or the mesh breaks."""
+    key = id(comm)
+    with _lock:
+        r = _routers.get(key)
+        if r is not None and r._comm_ref() is comm and not r._closed:
+            return r
+        r = QueryRouter(comm, n_workers)
+        _routers[key] = r
+
+        def _cleanup(_ref: Any, key: int = key) -> None:
+            with _lock:
+                stale = _routers.pop(key, None)
+            if stale is not None:
+                stale.close()
+
+        weakref.finalize(comm, _cleanup, None)
+        return r

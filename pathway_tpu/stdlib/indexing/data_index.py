@@ -92,8 +92,12 @@ class InnerIndex(ABC):
         def lower(runner, tbl):
             from ...engine import operators as ops
 
-            data_node = runner.lower(prep_d)
+            # query chain first: source ownership round-robins in lowering
+            # order, so this keeps a REST query edge on worker 0 — the same
+            # worker the serve plane's scatter origin, the response sink's
+            # gather and the degraded-status side channel all live on
             query_node = runner.lower(prep_q)
+            data_node = runner.lower(prep_d)
             return runner._add(
                 ExternalIndexNode(
                     data_node, query_node, make_engine(), asof_now=asof_now

@@ -1,0 +1,527 @@
+"""Serve plane: admission control, scatter/gather merge, query router.
+
+The components are deliberately pure (``serve/admission.py``,
+``serve/merge.py`` take no sockets or event loops), so the edge
+behaviours the smoke exercises over HTTP — saturation → 429, deadline
+expiry at interior hops, partial-gather timeout, correlation-id dedup —
+are each pinned here as direct unit tests, plus one end-to-end sharded
+run over ``LocalComm`` threads asserting scale-out serving answers
+byte-identically to the single-host gather.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu import indexing
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table_io import rows_to_table
+from pathway_tpu.parallel.comm import LocalComm
+from pathway_tpu.serve import admission as adm
+from pathway_tpu.serve import status as serve_status
+from pathway_tpu.serve.admission import AdmissionController, shared_controller
+from pathway_tpu.serve.merge import (
+    GatherState,
+    deadline_from_ms,
+    default_deadline_ms,
+    expired,
+    merge_topk,
+)
+from pathway_tpu.serve.registry import registry
+from pathway_tpu.serve.router import (
+    QueryRouter,
+    _decode_queries,
+    _encode_queries,
+    gather_timeout_s,
+)
+from pathway_tpu.serve.stats import (
+    SERVE_STATS,
+    reset_serve_stats,
+    serve_stats_snapshot,
+)
+from pathway_tpu.testing import _norm
+
+
+@pytest.fixture(autouse=True)
+def _clean_serve_plane():
+    reset_serve_stats()
+    registry().clear()
+    yield
+    reset_serve_stats()
+    registry().clear()
+
+
+def _stat(key: str) -> int:
+    return SERVE_STATS[key]
+
+
+# ---------------------------------------------------------------------------
+# admission controller
+# ---------------------------------------------------------------------------
+
+
+class TestAdmissionController:
+    def test_fast_admit_below_inflight(self):
+        c = AdmissionController(max_inflight=2, queue_bound=4)
+        s1 = c.try_admit()
+        s2 = c.try_admit(timeout_s=0)
+        assert s1 is not None and not s1.queued
+        assert s2 is not None and not s2.queued
+        assert _stat("queries_total") == 2
+        c.release(s1)
+        c.release(s2)
+
+    def test_saturated_queue_at_bound_rejects(self):
+        c = AdmissionController(max_inflight=1, queue_bound=0)
+        slot = c.try_admit()
+        assert slot is not None
+        # queue bound 0: nothing may wait, even with an unbounded timeout
+        assert c.try_admit() is None
+        assert _stat("rejected_total") == 1
+        assert _stat("queued_total") == 0
+        c.release(slot)
+
+    def test_zero_timeout_never_queues(self):
+        c = AdmissionController(max_inflight=1, queue_bound=8)
+        slot = c.try_admit()
+        assert c.try_admit(timeout_s=0) is None
+        assert _stat("queued_total") == 0
+        assert _stat("rejected_total") == 1
+        c.release(slot)
+
+    def test_queued_waiter_admitted_on_release(self):
+        c = AdmissionController(max_inflight=1, queue_bound=2)
+        first = c.try_admit()
+        got: list = []
+
+        def waiter():
+            got.append(c.try_admit(timeout_s=5.0))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        deadline = time.monotonic() + 2.0
+        while c.gauges()["queue_depth"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert c.gauges()["queue_depth"] == 1
+        c.release(first, service_s=0.01)
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        (slot,) = got
+        assert slot is not None and slot.queued
+        assert _stat("queued_total") == 1
+        c.release(slot)
+
+    def test_wait_timeout_rejects(self):
+        c = AdmissionController(max_inflight=1, queue_bound=2)
+        slot = c.try_admit()
+        t0 = time.monotonic()
+        assert c.try_admit(timeout_s=0.05) is None
+        assert time.monotonic() - t0 < 2.0
+        assert _stat("rejected_total") == 1
+        c.release(slot)
+
+    def test_retry_after_floor_and_scaling(self):
+        c = AdmissionController(max_inflight=2, queue_bound=4)
+        # no history: floored so clients can't busy-retry
+        assert c.retry_after_s() == pytest.approx(0.05)
+        s = c.try_admit()
+        c.release(s, service_s=10.0)
+        # ewma 10 s over 2 slots -> one queue position costs 5 s
+        assert c.retry_after_s() == pytest.approx(5.0)
+
+    def test_cancel_frees_slot_and_counts(self):
+        c = AdmissionController(max_inflight=1, queue_bound=0)
+        slot = c.try_admit()
+        c.cancel(slot)
+        assert _stat("cancelled_total") == 1
+        assert c.gauges()["inflight"] == 0
+        assert c.try_admit() is not None
+
+    def test_shared_controller_singleton_registers_gauges(self):
+        a = shared_controller()
+        b = shared_controller()
+        assert a is b
+        snap = serve_stats_snapshot()
+        assert "inflight" in snap and "queue_bound" in snap
+        # module singleton survives reset; re-arming is idempotent
+        reset_serve_stats()
+        assert shared_controller() is a
+        assert "inflight" in serve_stats_snapshot()
+
+    def test_floors_on_bad_knobs(self):
+        c = AdmissionController(max_inflight=0, queue_bound=-3)
+        assert c.max_inflight == 1
+        assert c.queue_bound == 0
+
+
+# ---------------------------------------------------------------------------
+# merge + gather state
+# ---------------------------------------------------------------------------
+
+
+class TestMergeTopk:
+    def test_global_order_and_truncation(self):
+        merged = merge_topk(
+            [[("a", 0.9), ("b", 0.5)], [("c", 0.7), ("d", 0.1)]], 3
+        )
+        assert merged == [("a", 0.9), ("c", 0.7), ("b", 0.5)]
+
+    def test_duplicate_keys_keep_best_score(self):
+        merged = merge_topk([[("a", 0.3)], [("a", 0.8), ("b", 0.4)]], 5)
+        assert merged == [("a", 0.8), ("b", 0.4)]
+
+    def test_score_ties_break_by_key(self):
+        merged = merge_topk([[("b", 0.5)], [("a", 0.5)]], 2)
+        assert merged == [("a", 0.5), ("b", 0.5)]
+
+    def test_ops_layer_alias(self):
+        # the single-host gather in ops/knn.py and the wire gather share
+        # one merge
+        from pathway_tpu.ops.knn import merge_shard_topk
+
+        assert merge_shard_topk([[("a", 1.0)], [("b", 2.0)]], 1) == [
+            ("b", 2.0)
+        ]
+
+
+class TestGatherState:
+    def test_complete_gather_not_degraded(self):
+        g = GatherState(("q", 0), shards=[0, 1], limits=[2])
+        g.add(0, [[("a", 0.9)]])
+        g.add(1, [[("b", 0.8)]])
+        assert g.wait(timeout_s=1.0)
+        res = g.result()
+        assert res["hits"] == [[("a", 0.9), ("b", 0.8)]]
+        assert not res["degraded"]
+        assert res["missing_shards"] == []
+        assert not res["deadline_exceeded"]
+
+    def test_partial_gather_timeout_degrades(self):
+        g = GatherState(("q", 1), shards=[0, 1], limits=[2])
+        g.add(0, [[("a", 0.9)]])
+        t0 = time.monotonic()
+        assert not g.wait(timeout_s=0.05)
+        assert time.monotonic() - t0 < 2.0
+        res = g.result()
+        assert res["degraded"]
+        assert res["missing_shards"] == [1]
+        assert res["hits"] == [[("a", 0.9)]]
+        assert _stat("degraded_total") == 1
+
+    def test_duplicate_and_unexpected_answers_dropped(self):
+        g = GatherState(("q", 2), shards=[0], limits=[1])
+        assert g.add(0, [[("a", 0.9)]])
+        assert not g.add(0, [[("a", 0.1)]])  # duplicate delivery
+        assert not g.add(7, [[("x", 1.0)]])  # never scattered there
+        assert _stat("duplicate_results_total") == 2
+        assert g.result()["hits"] == [[("a", 0.9)]]
+
+    def test_failed_shard_completes_gather(self):
+        g = GatherState(("q", 3), shards=[0, 1], limits=[1])
+        g.add(0, [[("a", 0.9)]])
+        g.fail(1)
+        assert g.wait(timeout_s=1.0)
+        res = g.result()
+        assert res["degraded"] and res["missing_shards"] == [1]
+
+    def test_wait_clamped_to_deadline(self):
+        past = time.time_ns() - 1
+        g = GatherState(("q", 4), shards=[0], limits=[1], deadline_ns=past)
+        t0 = time.monotonic()
+        assert not g.wait(timeout_s=30.0)
+        assert time.monotonic() - t0 < 2.0
+        assert g.result()["deadline_exceeded"]
+
+    def test_per_query_limits(self):
+        g = GatherState(("q", 5), shards=[0], limits=[1, 2])
+        g.add(0, [[("a", 0.9), ("b", 0.8)], [("c", 0.7), ("d", 0.6)]])
+        res = g.result()
+        assert res["hits"] == [
+            [("a", 0.9)],
+            [("c", 0.7), ("d", 0.6)],
+        ]
+
+
+class TestDeadlineHelpers:
+    def test_deadline_from_ms(self):
+        base = 1_000_000
+        assert deadline_from_ms(2.5, now_ns=base) == base + 2_500_000
+
+    def test_expired(self):
+        assert not expired(None)
+        assert expired(time.time_ns() - 1)
+        assert not expired(time.time_ns() + 10**12)
+        assert expired(100, now_ns=100)
+
+    def test_default_deadline_env(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_SERVE_DEADLINE_MS", "2500")
+        assert default_deadline_ms() == 2500.0
+        monkeypatch.setenv("PATHWAY_SERVE_DEADLINE_MS", "-5")
+        assert default_deadline_ms() == 1.0  # floored
+
+    def test_gather_timeout_env(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_SERVE_GATHER_TIMEOUT_MS", "250")
+        assert gather_timeout_s() == pytest.approx(0.25)
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+
+class TestQueryCodec:
+    def test_vector_batch_goes_columnar(self):
+        qs = [np.ones(4), np.zeros(4)]
+        enc = _encode_queries(qs, [None, None])
+        n, cols = enc
+        assert n == 2 and cols["q"].shape == (2, 4)
+        dec_q, dec_f = _decode_queries(enc)
+        assert len(dec_q) == 2 and dec_f == [None, None]
+        np.testing.assert_array_equal(dec_q[0], qs[0])
+
+    def test_filters_or_text_fall_back_to_obj(self):
+        enc = _encode_queries(["hello"], [None])
+        assert enc[0] == "obj"
+        qs, fs = _decode_queries(enc)
+        assert qs == ["hello"] and fs == [None]
+        enc = _encode_queries([np.ones(3)], ["f > 1"])
+        assert enc[0] == "obj"
+
+
+# ---------------------------------------------------------------------------
+# status side channel
+# ---------------------------------------------------------------------------
+
+
+class TestStatusChannel:
+    def test_deadline_round_trip_is_take_once(self):
+        serve_status.note_deadline("k1", 42)
+        assert serve_status.take_deadline("k1") == 42
+        assert serve_status.take_deadline("k1") is None
+
+    def test_status_round_trip(self):
+        st = {"degraded": True, "missing_shards": [1]}
+        serve_status.note_status("k2", st)
+        assert serve_status.take_status("k2") == st
+        assert serve_status.take_status("k2") is None
+
+    def test_bounded_eviction(self):
+        for i in range(serve_status._MAX_ENTRIES + 10):
+            serve_status.note_deadline(("evict", i), i)
+        assert serve_status.take_deadline(("evict", 0)) is None
+        last = serve_status._MAX_ENTRIES + 9
+        assert serve_status.take_deadline(("evict", last)) == last
+
+
+# ---------------------------------------------------------------------------
+# query router over LocalComm
+# ---------------------------------------------------------------------------
+
+NODE_KEY = ("xidx", 0)
+
+
+def _shard_fn(rows):
+    def search(queries, limits, filters):
+        return [list(rows)[: limits[q]] for q in range(len(queries))]
+
+    return search
+
+
+@pytest.fixture()
+def two_worker_router():
+    comm = LocalComm(2)
+    router = QueryRouter(comm, n_workers=2)
+    try:
+        yield comm, router
+    finally:
+        router.close()
+
+
+class TestQueryRouter:
+    def test_scatter_gather_merges_across_shards(self, two_worker_router):
+        comm, router = two_worker_router
+        registry().register(NODE_KEY, 0, _shard_fn([("a", 0.9), ("b", 0.5)]))
+        registry().register(NODE_KEY, 1, _shard_fn([("c", 0.7)]))
+        res = router.scatter_search(
+            NODE_KEY, 0, [np.ones(3)], [2], [None]
+        )
+        assert res["hits"] == [[("a", 0.9), ("c", 0.7)]]
+        assert not res["degraded"]
+        assert _stat("scatter_posts_total") == 2
+        assert _stat("shard_searches_total") == 2
+        assert _stat("results_merged_total") == 1
+
+    def test_unregistered_shard_degrades_not_hangs(self, two_worker_router):
+        comm, router = two_worker_router
+        registry().register(NODE_KEY, 0, _shard_fn([("a", 0.9)]))
+        t0 = time.monotonic()
+        res = router.scatter_search(NODE_KEY, 0, [np.ones(3)], [2], [None])
+        # shard 1 answers ("f", ...) immediately: no gather-timeout wait
+        assert time.monotonic() - t0 < gather_timeout_s()
+        assert res["degraded"]
+        assert res["missing_shards"] == [1]
+        assert res["hits"] == [[("a", 0.9)]]
+
+    def test_expired_deadline_dropped_at_origin(self, two_worker_router):
+        comm, router = two_worker_router
+        registry().register(NODE_KEY, 0, _shard_fn([("a", 0.9)]))
+        res = router.scatter_search(
+            NODE_KEY, 0, [np.ones(3)], [2], [None],
+            deadline_ns=time.time_ns() - 1,
+        )
+        assert res["deadline_exceeded"] and res["degraded"]
+        assert res["hits"] == [[]]
+        assert _stat("deadline_dropped_total") == 1
+        assert _stat("scatter_posts_total") == 0  # never left the origin
+
+    def test_duplicate_scatter_delivery_searches_once(
+        self, two_worker_router
+    ):
+        comm, router = two_worker_router
+        calls: list = []
+
+        def counting(queries, limits, filters):
+            calls.append(1)
+            return [[("c", 0.7)]]
+
+        registry().register(NODE_KEY, 1, counting)
+        qid = (NODE_KEY, 0, 999)
+        g = GatherState(qid, shards=[1], limits=[2])
+        with router._lock:
+            router._pending[qid] = g
+        meta = ("q", qid, 0, 1, None, (2,), NODE_KEY)
+        payload = ("obj", [np.ones(3)], [None])
+        # at-least-once delivery: the same scatter lands twice
+        assert comm.serve_post(1, meta, payload)
+        assert comm.serve_post(1, meta, payload)
+        assert g.wait(timeout_s=5.0)
+        with router._lock:
+            router._pending.pop(qid, None)
+        deadline = time.monotonic() + 2.0
+        while _stat("duplicate_results_total") < 1 and (
+            time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert len(calls) == 1
+        assert _stat("duplicate_results_total") >= 1
+        assert g.result()["hits"] == [[("c", 0.7)]]
+
+    def test_expired_deadline_dropped_at_interior_hop(
+        self, two_worker_router
+    ):
+        comm, router = two_worker_router
+        registry().register(NODE_KEY, 1, _shard_fn([("c", 0.7)]))
+        qid = (NODE_KEY, 0, 1000)
+        g = GatherState(qid, shards=[1], limits=[2])
+        with router._lock:
+            router._pending[qid] = g
+        meta = ("q", qid, 0, 1, time.time_ns() - 1, (2,), NODE_KEY)
+        assert comm.serve_post(1, meta, ("obj", [np.ones(3)], [None]))
+        # the responder refuses the dead query and posts ("f", ...) so
+        # the origin completes (degraded) instead of timing out
+        assert g.wait(timeout_s=5.0)
+        with router._lock:
+            router._pending.pop(qid, None)
+        res = g.result()
+        assert res["degraded"] and res["missing_shards"] == [1]
+        assert _stat("deadline_dropped_total") == 1
+        assert _stat("shard_searches_total") == 0
+
+    def test_late_answer_for_forgotten_gather_is_ignored(
+        self, two_worker_router
+    ):
+        comm, router = two_worker_router
+        # an answer whose gather already timed out and was reaped must
+        # not raise in the dispatcher
+        assert comm.serve_post(0, (("r"), ("gone", 0, 1), 1), [[("a", 1.0)]])
+        time.sleep(0.3)
+        assert _stat("errors_total") == 0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: sharded index graph at PATHWAY_THREADS=2
+# ---------------------------------------------------------------------------
+
+
+def _collect(build, monkeypatch, threads: int) -> Counter:
+    G.clear()
+    acc: Counter = Counter()
+    lock = threading.Lock()
+    table = build()
+    cols = table.column_names()
+
+    def on_change(key, row, time, is_addition):
+        with lock:
+            acc[tuple(_norm(row[c]) for c in cols)] += (
+                1 if is_addition else -1
+            )
+
+    pw.io.subscribe(table, on_change=on_change)
+    monkeypatch.setenv("PATHWAY_THREADS", str(threads))
+    try:
+        pw.run()
+    finally:
+        monkeypatch.setenv("PATHWAY_THREADS", "1")
+        G.clear()
+    return +acc
+
+
+def _build_knn_program():
+    # docs strictly before the as-of-now queries so every shard has
+    # applied its slice by scatter time
+    doc_rows = [
+        ("a", [1.0, 0.0, 0.0]),
+        ("b", [0.0, 1.0, 0.0]),
+        ("c", [0.0, 0.0, 1.0]),
+        ("d", [0.9, 0.1, 0.0]),
+        ("e", [0.1, 0.9, 0.0]),
+        ("f", [0.5, 0.5, 0.0]),
+    ]
+    docs = rows_to_table(
+        ["name", "vec"],
+        [(n, np.asarray(v, dtype=np.float64)) for n, v in doc_rows],
+        times=[0] * len(doc_rows),
+    )
+    q_rows = [("q1", [1.0, 0.0, 0.0]), ("q2", [0.0, 1.0, 0.1])]
+    queries = rows_to_table(
+        ["qname", "qvec"],
+        [(q, np.asarray(v, dtype=np.float64)) for q, v in q_rows],
+        times=[2] * len(q_rows),
+    )
+    inner = indexing.BruteForceKnn(
+        data_column=docs.vec, dimensions=3, reserved_space=16
+    )
+    jr = indexing.DataIndex(docs, inner).query_as_of_now(
+        queries.qvec, number_of_matches=2
+    )
+    return jr.select(pw.left.qname, matches=pw.right.name)
+
+
+class TestShardedServeEndToEnd:
+    def test_sharded_serve_matches_single_host(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_SERVE_SHARDED", "0")
+        want = _collect(_build_knn_program, monkeypatch, threads=1)
+        reset_serve_stats()
+        registry().clear()
+        monkeypatch.setenv("PATHWAY_SERVE_SHARDED", "1")
+        got = _collect(_build_knn_program, monkeypatch, threads=2)
+        assert got == want
+        # the scatter path actually served the queries (legacy mode
+        # would leave every serve counter at zero)
+        assert _stat("shard_searches_total") >= 1
+        assert _stat("results_merged_total") >= 1
+        assert _stat("degraded_total") == 0
+        assert _stat("deadline_dropped_total") == 0
+
+    def test_sharded_legacy_gather_still_matches(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_SERVE_SHARDED", "0")
+        want = _collect(_build_knn_program, monkeypatch, threads=1)
+        got = _collect(_build_knn_program, monkeypatch, threads=2)
+        assert got == want
+        assert _stat("shard_searches_total") == 0

@@ -1,0 +1,26 @@
+"""Tier-1 wrapper around scripts/serve_smoke.py (like test_chaos_smoke):
+the shard-loss serving contract end to end — a serve.query fault plan
+silences shard 1, generation 0 keeps answering fast degraded 200s with
+zero client timeouts while the shard is dark, the harness SIGKILLs the
+silenced shard's process, `spawn --supervise` relaunches, and the
+fault-free generation serves the exact full top-k again."""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    ),
+)
+
+
+def test_serve_smoke(tmp_path):
+    from serve_smoke import FULL_TOPK, run_smoke
+
+    result = run_smoke(workdir=str(tmp_path))
+    assert result["generations"] == [0, 1]
+    assert result["gen0_degraded"] >= 2
+    assert result["timeouts"] == 0
+    assert sorted(result["gen1_full"]["body"]["hits"]) == sorted(FULL_TOPK)
