@@ -248,6 +248,8 @@ def main() -> None:
     skew = _skew_lane()
     lineage = _lineage_lane()
     ingest_stage = _ingest_stage_lane()
+    ingest_conn_lanes = _ingest_connector_lanes()
+    wc_file_ab = _wordcount_file_ab()
     from pathway_tpu.io.python import INGEST_BUILD_STATS as _IBS
 
     ingest_build = {
@@ -354,6 +356,19 @@ def main() -> None:
             # a fresh-process PATHWAY_PROFILE on/off rows/s A/B
             # (budget <= 3%)
             "ingest_stage_split": ingest_stage,
+            # per-connector ingest lanes (fs csv/jsonlines/plaintext +
+            # python rowwise), each in a fresh process: rows/s with the
+            # parse/hash/delta split as per-stage rows/s, off the
+            # columnar plane's INGEST_CONNECTOR_STATS counters
+            "ingest_connector_lanes": ingest_conn_lanes,
+            # end-to-end wordcount fed from a FILE, fresh-process
+            # columnar on/off A/B (PATHWAY_INGEST_COLUMNAR escape
+            # hatch): ingest_speedup is the columnar plane's same-host
+            # attributable win, with each arm's ingest share of wall
+            "wordcount_from_file_rows_per_sec": (
+                wc_file_ab["rows_per_sec"] if wc_file_ab else None
+            ),
+            "wordcount_from_file_ab": wc_file_ab,
             "host_cores": n_cores,
             "sharded_note": (
                 "host exposes ONE core: N workers time-slice it, so "
@@ -1586,6 +1601,272 @@ def _ingest_stage_lane(reps: int = 2) -> dict | None:
         "profile_overhead_pct": round(overhead_pct, 2),
         "profile_overhead_ok": overhead_pct <= 3.0,
     }
+
+
+_INGEST_CONNECTOR_PROG = """
+import json, os, sys, tempfile, time
+sys.path.insert(0, {repo!r})
+from pathway_tpu.utils.jaxcfg import guard_cpu_platform
+guard_cpu_platform()
+import pathway_tpu as pw
+
+KIND, N_ROWS = {kind!r}, {n_rows}
+words = [f"w{{i % 997}}" for i in range(N_ROWS)]
+if KIND == "python":
+    class Feed(pw.io.python.ConnectorSubject):
+        def run(self):
+            for w in words:
+                self.next(word=w)
+            self.commit()
+
+    t = pw.io.python.read(
+        Feed(), schema=pw.schema_from_types(word=str), name="words",
+        autocommit_duration_ms=25,
+    )
+else:
+    d = tempfile.mkdtemp(prefix="ingest_lane_")
+    path = os.path.join(d, "data.in")
+    with open(path, "w") as f:
+        if KIND == "csv":
+            f.write("word,x\\n")
+            f.writelines(f"{{w}},{{i}}\\n" for i, w in enumerate(words))
+        elif KIND == "jsonlines":
+            f.writelines(
+                '{{"word": "%s", "x": %d}}\\n' % (w, i)
+                for i, w in enumerate(words)
+            )
+        else:
+            f.writelines(w + "\\n" for w in words)
+    if KIND == "plaintext":
+        schema = pw.schema_from_types(data=str)
+    else:
+        schema = pw.schema_from_types(word=str, x=int)
+    t = pw.io.fs.read(
+        path, format=KIND, schema=schema, mode="streaming",
+        autocommit_duration_ms=25,
+    )
+total = {{"n": 0}}
+
+
+def on_batch(time_, b):
+    # duplicate content keys consolidate into one entry with diff =
+    # multiplicity, so input rows are counted as the positive-diff sum
+    total["n"] += int(b.diffs[b.diffs > 0].sum())
+    if total["n"] >= N_ROWS:
+        pw.request_stop()
+
+
+pw.io.subscribe(t, on_batch=on_batch)
+t0 = time.perf_counter()
+pw.run()
+elapsed = max(time.perf_counter() - t0, 1e-9)
+assert total["n"] == N_ROWS, total
+from pathway_tpu.io.python import INGEST_CONNECTOR_STATS
+
+name, s = max(
+    INGEST_CONNECTOR_STATS.items(),
+    key=lambda kv: kv[1]["rows"],
+    default=(None, None),
+)
+print(json.dumps({{
+    "rows_per_sec": N_ROWS / elapsed,
+    "connector": name,
+    "parse_s": (s["parse_ns"] / 1e9) if s else 0.0,
+    "hash_s": (s["hash_ns"] / 1e9) if s else 0.0,
+    "delta_s": (s["delta_ns"] / 1e9) if s else 0.0,
+    "rows": s["rows"] if s else 0,
+}}))
+"""
+
+
+def _ingest_connector_lanes(n_rows: int = 200_000) -> dict | None:
+    """``ingest_connector_lanes``: per-connector ingest throughput with
+    the parse | hash | delta stage split as per-stage rows/s, one FRESH
+    process per connector kind (fs CSV, fs jsonlines, fs plaintext,
+    python rowwise). The split comes from the per-connector counters
+    (io/python.INGEST_CONNECTOR_STATS) the columnar ingest plane accrues
+    on every sanctioned parse path — so a parse-bound connector is
+    distinguishable from a hash-bound one without a profiler run."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    out: dict = {}
+    for kind in ("csv", "jsonlines", "plaintext", "python"):
+        rows = n_rows if kind != "python" else min(n_rows, 50_000)
+        prog = _INGEST_CONNECTOR_PROG.format(
+            repo=repo, kind=kind, n_rows=rows
+        )
+        env = {
+            **os.environ, "JAX_PLATFORMS": "cpu", "PATHWAY_PROFILE": "1",
+        }
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", prog], env=env,
+                capture_output=True, text=True, timeout=600,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"bench: ingest lane {kind} timed out", file=sys.stderr)
+            continue
+        if r.returncode != 0:
+            print(
+                f"bench: ingest lane {kind} failed (rc={r.returncode}):\n"
+                f"{r.stderr.strip()[-2000:]}",
+                file=sys.stderr,
+            )
+            continue
+        try:
+            rep = json.loads(r.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            print(
+                f"bench: ingest lane {kind} output unreadable: "
+                f"{r.stdout[-500:]}", file=sys.stderr,
+            )
+            continue
+        lane = {
+            "rows_per_sec": round(rep["rows_per_sec"], 1),
+            "connector": rep["connector"],
+            "parse_s": round(rep["parse_s"], 4),
+            "hash_s": round(rep["hash_s"], 4),
+            "delta_s": round(rep["delta_s"], 4),
+        }
+        # per-stage rows/s: how fast each stage alone would go — the
+        # smallest number names the stage that bounds this connector
+        for st in ("parse", "hash", "delta"):
+            sec = rep[f"{st}_s"]
+            lane[f"{st}_rows_per_sec"] = (
+                round(rep["rows"] / sec, 1) if sec > 0 else None
+            )
+        out[f"ingest_{kind}"] = lane
+    return out or None
+
+
+_WORDCOUNT_FILE_PROG = """
+import json, os, sys, tempfile, time
+sys.path.insert(0, {repo!r})
+from pathway_tpu.utils.jaxcfg import guard_cpu_platform
+guard_cpu_platform()
+import pathway_tpu as pw
+
+N_ROWS = {n_rows}
+d = tempfile.mkdtemp(prefix="wc_file_")
+path = os.path.join(d, "words.txt")
+with open(path, "w") as f:
+    f.writelines(f"w{{i % 997}}\\n" for i in range(N_ROWS))
+t = pw.io.fs.read(
+    path, format="plaintext", schema=pw.schema_from_types(data=str),
+    mode="streaming", autocommit_duration_ms=25,
+)
+counts = t.groupby(pw.this.data).reduce(
+    pw.this.data, c=pw.reducers.count()
+)
+total = {{"n": 0}}
+
+
+def on_raw(time_, b):
+    # duplicate content keys consolidate into one entry with diff =
+    # multiplicity, so input rows are counted as the positive-diff sum
+    total["n"] += int(b.diffs[b.diffs > 0].sum())
+    if total["n"] >= N_ROWS:
+        pw.request_stop()
+
+
+done = {{"max": 0}}
+
+
+def on_counts(time_, b):
+    done["max"] = max(done["max"], int(b.data["c"].max()))
+
+
+pw.io.subscribe(t, on_batch=on_raw)
+pw.io.subscribe(counts, on_batch=on_counts)
+t0 = time.perf_counter()
+pw.run()
+elapsed = max(time.perf_counter() - t0, 1e-9)
+assert total["n"] == N_ROWS, total
+from pathway_tpu.io.python import INGEST_STAGE_STATS as S
+
+print(json.dumps({{
+    "rows_per_sec": N_ROWS / elapsed,
+    "elapsed_s": elapsed,
+    "ingest_s": (S["parse_ns"] + S["hash_ns"] + S["delta_ns"]) / 1e9,
+    "max_count": done["max"],
+}}))
+"""
+
+
+def _wordcount_file_ab(reps: int = 2, n_rows: int = 300_000) -> dict | None:
+    """``wordcount_from_file``: the end-to-end fused wordcount fed from a
+    FILE (fs plaintext streaming -> groupby count), as a same-host
+    fresh-process columnar on/off A/B through the
+    ``PATHWAY_INGEST_COLUMNAR`` escape hatch (the ``_fusion_off()``
+    pattern, one process per arm). ``ingest_speedup`` is the columnar
+    ingest plane's attributable win, and each arm carries its ingest
+    share of wall — the tentpole claim is that share dropping from ~60%
+    to <=30%."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    prog = _WORDCOUNT_FILE_PROG.format(repo=repo, n_rows=n_rows)
+
+    def arm(columnar: str) -> dict | None:
+        best: dict | None = None
+        for _ in range(reps):
+            env = {
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "PATHWAY_PROFILE": "1",
+                "PATHWAY_INGEST_COLUMNAR": columnar,
+            }
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c", prog], env=env,
+                    capture_output=True, text=True, timeout=600,
+                )
+            except subprocess.TimeoutExpired:
+                print("bench: wordcount-file rep timed out", file=sys.stderr)
+                return best
+            if r.returncode != 0:
+                print(
+                    f"bench: wordcount-file rep failed "
+                    f"(rc={r.returncode}):\n{r.stderr.strip()[-2000:]}",
+                    file=sys.stderr,
+                )
+                return best
+            try:
+                rep = json.loads(r.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                print(
+                    f"bench: wordcount-file output unreadable: "
+                    f"{r.stdout[-500:]}", file=sys.stderr,
+                )
+                return best
+            if best is None or rep["rows_per_sec"] > best["rows_per_sec"]:
+                best = rep
+        return best
+
+    on = arm("1")
+    off = arm("0")
+    if not on:
+        return None
+    out = {
+        "rows_per_sec": round(on["rows_per_sec"], 1),
+        "ingest_share_of_wall_pct": round(
+            on["ingest_s"] / on["elapsed_s"] * 100.0, 1
+        ),
+    }
+    if off:
+        out["rows_per_sec_columnar_off"] = round(off["rows_per_sec"], 1)
+        out["ingest_share_of_wall_pct_columnar_off"] = round(
+            off["ingest_s"] / off["elapsed_s"] * 100.0, 1
+        )
+        out["ingest_speedup"] = round(
+            on["rows_per_sec"] / off["rows_per_sec"], 3
+        )
+    return out
 
 
 _LINEAGE_PROG = """

@@ -797,6 +797,39 @@ def mix_columns(
     return acc
 
 
+def mix_columns_fused(
+    cols: list[np.ndarray], n: int, salt: int = 0, register: bool = True
+) -> KeyArray:
+    """Ingest-path variant of :func:`mix_columns`: when every key column
+    is an OBJECT column (string-heavy sources — wordcount lines, str
+    CSV keys), fold all of them through the native ``mix_cols2`` kernel
+    in ONE pass: no per-column lane arrays, no row tuples, strings
+    memoized value-wise. Bit-identical to ``mix_columns`` (same
+    per-scalar lanes, same splitmix fold per column). Dense columns or
+    a missing native module fall back to ``mix_columns`` unchanged.
+    Ingest columns are freshly parsed buffers, so the per-array lane
+    cache is deliberately skipped — it could never hit."""
+    from ..native import get_native
+
+    if register and _registration_suspended_here():
+        register = False
+    native = get_native()
+    if native is None or not register:
+        return mix_columns(cols, n, salt, register)
+    arrs = [np.asarray(c) for c in cols]
+    if not arrs or any(a.dtype != object for a in arrs):
+        return mix_columns(arrs, n, salt, register)
+    lo = np.empty(n, dtype=np.uint64)
+    hi = np.empty(n, dtype=np.uint64)
+    salt64 = int(salt) & _M64_
+    native.mix_cols2(
+        arrs, n, salt64, salt64, _hash_scalar, _hash_scalar_hi,
+        _STR_MEMO2, lo, hi,
+    )
+    _register_keys(lo, hi)
+    return lo
+
+
 def _hash_values_py(rows: list[tuple], salt: int = 0) -> KeyArray:
     base = np.uint64(0xA076_1D64_78BD_642F) ^ np.uint64(salt)
     out = []

@@ -47,17 +47,62 @@ class _DebeziumSubject(ConnectorSubject):
     """Wraps a transport of raw envelopes into parsed row events."""
 
     def __init__(self, raw_messages):
-        super().__init__()
+        super().__init__(datasource_name="debezium")
         self._raw = raw_messages
 
-    def run(self) -> None:
-        for msg in self._raw:
+    def _emit_envelopes(self, envelopes) -> None:
+        """Decoded-envelope emission: rows keep per-row ``next``/``_remove``
+        (mixed diffs ride the ingest coalescer) and the per-envelope commit
+        cadence — a CDC retract+insert pair squeezed into one tick would
+        cancel before any subscriber saw it, so only the *decode* is
+        batched, never the tick boundaries."""
+        for msg in envelopes:
             for diff, row in parse_debezium_message(msg):
                 if diff > 0:
                     self.next(**row)
                 else:
                     self._remove(**row)
             self.commit()
+
+    def run(self) -> None:
+        from itertools import islice
+
+        from . import columnar as _columnar
+
+        if not _columnar.enabled():
+            for msg in self._raw:
+                for diff, row in parse_debezium_message(msg):
+                    if diff > 0:
+                        self.next(**row)
+                    else:
+                        self._remove(**row)
+                self.commit()
+            return
+        step = _columnar.chunk_rows()
+        it = iter(self._raw)
+        while not self.stopped:
+            chunk = list(islice(it, step))
+            if not chunk:
+                break
+            if len(chunk) > 1 and all(
+                isinstance(m, (str, bytes)) for m in chunk
+            ):
+                # batch decode: ONE json.loads over the joined chunk; any
+                # disagreement falls back to per-envelope decoding, which
+                # raises at the exact envelope the row-wise path would have
+                try:
+                    joined = ",".join(
+                        m.decode("utf-8") if isinstance(m, bytes) else m
+                        for m in chunk
+                    )
+                    decoded = json.loads("[" + joined + "]")
+                    if len(decoded) != len(chunk):
+                        raise ValueError("envelope count mismatch")
+                except (ValueError, UnicodeDecodeError):
+                    decoded = chunk
+            else:
+                decoded = chunk
+            self._emit_envelopes(decoded)
 
 
 def read(

@@ -89,6 +89,12 @@ class FsStreamSource(RealtimeSource):
         self._staged: dict[str, int] = {}
         self._headers: dict[str, list[str]] = {}
         self._pending: list[tuple] = []
+        #: columnar-parsed chunks awaiting emission: (path, columns, n).
+        #: Keys are derived at EMISSION time (poll), like the dict path —
+        #: a truncation dropping staged chunks must not have registered
+        #: key pairs for rows that never ship
+        self._pending_cols: list[tuple[str, dict, int]] = []
+        self._plan: list | None = None  # lazy columnar csv parse plan
         self._last_emit: float | None = None  # None = emit first batch now
 
     # -- persistence protocol --
@@ -100,6 +106,7 @@ class FsStreamSource(RealtimeSource):
         self._consumed = {str(k): int(v) for k, v in state.get("files", {}).items()}
         self._staged = {}
         self._pending = []
+        self._pending_cols = []
         # headers live before the persisted offsets — recover them
         for fpath in list(self._consumed):
             self._load_header(fpath)
@@ -140,6 +147,64 @@ class FsStreamSource(RealtimeSource):
             return tuple(obj.get(n) for n in self.names)
         return (line,)  # plaintext
 
+    def _parse_chunk(self, fpath: str, lines: list[str]):
+        """Columnar parse of one chunk of raw lines → (columns, n), or
+        :class:`columnar.ParseRefusal` when bit-parity with
+        ``_parse_line`` cannot be guaranteed for this chunk."""
+        from . import columnar as _col
+
+        if self.format in ("csv", "dsv"):
+            if self.fschema is None:
+                raise _col.ParseRefusal("schemaless csv (_auto per cell)")
+            if self._plan is None:
+                self._plan = _col.csv_plan(self.fschema, self.names)
+            return _col.parse_csv_chunk(
+                lines, self._headers[fpath], self._plan, self.delimiter
+            )
+        if self.format in ("json", "jsonlines"):
+            return _col.parse_json_chunk(lines, self.names)
+        if self.format == "plaintext" and len(self.names) == 1:
+            return _col.parse_plaintext_chunk(lines, self.names[0])
+        raise _col.ParseRefusal(f"no columnar reader for {self.format!r}")
+
+    def _ingest_lines(self, fpath: str, lines: list[str]) -> None:
+        """Route freshly scanned lines into the parse staging area:
+        columnar chunks when the columnar plane is on, the per-line dict
+        path otherwise — and per CHUNK on any parse refusal (same
+        values, same keys, same exceptions as the dict path)."""
+        import time as _time
+
+        from . import columnar as _col
+        from .python import _accrue, _stage_sinks
+
+        stage = _stage_sinks(f"fs-{self.format}")
+        if not _col.enabled():
+            t0 = _time.perf_counter_ns()
+            for line in lines:
+                self._pending.append((fpath, self._parse_line(fpath, line)))
+            if stage is not None:
+                _accrue(stage, "parse_ns", _time.perf_counter_ns() - t0)
+            return
+        step = _col.chunk_rows()
+        for i in range(0, len(lines), step):
+            sub = lines[i:i + step]
+            t0 = _time.perf_counter_ns()
+            try:
+                data, n = self._parse_chunk(fpath, sub)
+            except _col.ParseRefusal:
+                # per-batch fallback: re-parse exactly this chunk row by
+                # row — malformed cells raise here, where they always did
+                for line in sub:
+                    self._pending.append(
+                        (fpath, self._parse_line(fpath, line))
+                    )
+                if stage is not None:
+                    _accrue(stage, "parse_ns", _time.perf_counter_ns() - t0)
+                continue
+            if stage is not None:
+                _accrue(stage, "parse_ns", _time.perf_counter_ns() - t0)
+            self._pending_cols.append((fpath, data, n))
+
     def _scan(self) -> None:
         """Read appended tails of all watched files into _pending."""
         for fpath in _paths_of(self.path):
@@ -157,6 +222,9 @@ class FsStreamSource(RealtimeSource):
                 self._staged.pop(fpath, None)
                 self._headers.pop(fpath, None)
                 self._pending = [(p, r) for p, r in self._pending if p != fpath]
+                self._pending_cols = [
+                    (p, d, n) for p, d, n in self._pending_cols if p != fpath
+                ]
                 start = 0
             if not self._load_header(fpath):
                 continue
@@ -174,20 +242,25 @@ class FsStreamSource(RealtimeSource):
             end = chunk.rfind(b"\n")
             if end < 0:
                 continue
-            for line in chunk[:end].decode("utf-8").split("\n"):
-                line = line.rstrip("\r")
-                if line.strip():
-                    self._pending.append((fpath, self._parse_line(fpath, line)))
+            lines = [
+                stripped
+                for line in chunk[:end].decode("utf-8").split("\n")
+                if (stripped := line.rstrip("\r")).strip()
+            ]
+            if lines:
+                self._ingest_lines(fpath, lines)
             self._staged[fpath] = start + end + 1
 
     def poll(self):
         import time as _time
 
         from ..engine import keys as K
-        from ..engine.delta import Delta, rows_to_columns
+        from ..engine.delta import Delta, concat_deltas, rows_to_columns
+        from ..parallel import frames as _frames
+        from .python import _accrue, _stage_sinks
 
         self._scan()
-        if not self._pending:
+        if not self._pending and not self._pending_cols:
             return []
         now = _time.monotonic()
         window_open = (
@@ -197,18 +270,67 @@ class FsStreamSource(RealtimeSource):
         )
         if not window_open:
             return []
-        rows = [r for _, r in self._pending]
-        self._pending = []
+        stage = _stage_sinks(f"fs-{self.format}")
+        pk = (
+            self.fschema.primary_key_columns()
+            if self.fschema is not None
+            else None
+        )
+        key_names = list(pk) if pk else list(self.names)
+        deltas: list[Delta] = []
+        total = 0
+        if self._pending:
+            rows = [r for _, r in self._pending]
+            self._pending = []
+            h0 = _time.perf_counter_ns()
+            if pk:
+                idx = [self.names.index(p) for p in pk]
+                keys = K.hash_values([tuple(r[i] for i in idx) for r in rows])
+            else:
+                keys = K.hash_values(rows)
+            h1 = _time.perf_counter_ns()
+            deltas.append(Delta(keys=keys, data=rows_to_columns(rows, self.names)))
+            if stage is not None:
+                _accrue(stage, "hash_ns", h1 - h0)
+                _accrue(stage, "delta_ns", _time.perf_counter_ns() - h1)
+            total += len(rows)
+        chunks, self._pending_cols = self._pending_cols, []
+        for _fpath, data, n in chunks:
+            # one fused native BLAKE2b pass over the parsed column
+            # buffers — bit-identical to hash_values over the row tuples
+            h0 = _time.perf_counter_ns()
+            keys = K.mix_columns_fused([data[c] for c in key_names], n)
+            h1 = _time.perf_counter_ns()
+            d = Delta(keys=keys, data=data)
+            d.keys_content_cols = tuple(key_names)
+            # the chunk IS a wire frame: in process it travels by
+            # reference (zero-copy — LocalComm.exchange's contract),
+            # across processes the identical shape encodes binary
+            frame = _frames.connector_frame(d)
+            opened = _frames.open_connector_frame(frame)
+            assert opened is d, (
+                "connector frame must pass by reference in-process"
+            )
+            deltas.append(opened)
+            if stage is not None:
+                _accrue(stage, "hash_ns", h1 - h0)
+                _accrue(stage, "delta_ns", _time.perf_counter_ns() - h1)
+            total += n
         self._consumed.update(self._staged)  # rows now delivered → offset moves
         self._staged.clear()
         self._last_emit = now
-        if self.fschema is not None and self.fschema.primary_key_columns():
-            pk = self.fschema.primary_key_columns()
-            idx = [self.names.index(p) for p in pk]
-            keys = K.hash_values([tuple(r[i] for i in idx) for r in rows])
-        else:
-            keys = K.hash_values(rows)
-        return [Delta(keys=keys, data=rows_to_columns(rows, self.names))]
+        t0 = _time.perf_counter_ns()
+        out = (
+            deltas[0]
+            if len(deltas) == 1
+            else concat_deltas(deltas, self.names)
+        )
+        if stage is not None:
+            if len(deltas) > 1:
+                _accrue(stage, "delta_ns", _time.perf_counter_ns() - t0)
+            _accrue(stage, "rows", total)
+            _accrue(stage, "flushes", 1)
+        return [out]
 
     def is_finished(self) -> bool:
         return False  # watches forever (stop via pw.request_stop)

@@ -204,7 +204,7 @@ class _RestSubject(ConnectorSubject):
         delete_completed_queries: bool,
         request_validator: Callable | None,
     ):
-        super().__init__()
+        super().__init__(datasource_name="rest")
         self.webserver = webserver
         self.schema = schema
         self.delete_completed_queries = delete_completed_queries
@@ -415,16 +415,55 @@ def rest_connector(
     )
 
     def response_writer(result_table: Table) -> None:
+        from ...internals.config import _env_bool
         from .. import subscribe
 
         cols = result_table.column_names()
 
-        def on_change(key, row, time, is_addition):
-            if not is_addition:
-                return
-            value = row.get("result") if "result" in cols else row
-            subject._complete(int(key), value)
+        def _value_of(row):
+            return row.get("result") if "result" in cols else row
 
-        subscribe(result_table, on_change=on_change)
+        if not _env_bool("PATHWAY_SERVE_QUIESCENT", True):
+            # legacy: resolve the HTTP future on the FIRST emission for the
+            # key — wrong/partial on multi-wave cascades within one commit
+            # tick (a later operator wave may retract + replace the row
+            # after the client already got the early version)
+            def on_change(key, row, time, is_addition):
+                if not is_addition:
+                    return
+                subject._complete(int(key), _value_of(row))
+
+            subscribe(result_table, on_change=on_change)
+            return
+
+        # frontier-quiescent respond(): buffer the latest addition per key
+        # and resolve only at on_time_end, i.e. after the commit wave's
+        # frontier has passed every operator on the query→response path.
+        # Intra-tick retract+replace cascades (e.g. DataIndex collapsed
+        # repack) therefore answer with the settled row, never an interim
+        # one. Single-wave queries see no added latency: on_time_end fires
+        # in the same topological sweep that produced the emission.
+        pending: dict[int, Any] = {}
+        lock = threading.Lock()
+
+        def on_change(key, row, time, is_addition):
+            k = int(key)
+            value = _value_of(row)
+            with lock:
+                if is_addition:
+                    pending[k] = value
+                elif k in pending and pending[k] == value:
+                    # a retraction of the exact buffered value cancels it
+                    # (ordering of retract/add within a wave is free)
+                    del pending[k]
+
+        def on_time_end(time):
+            with lock:
+                ready = list(pending.items())
+                pending.clear()
+            for k, value in ready:
+                subject._complete(k, value)
+
+        subscribe(result_table, on_change=on_change, on_time_end=on_time_end)
 
     return table, response_writer

@@ -32,7 +32,7 @@ _DONE = object()
 
 
 class _Batch:
-    __slots__ = ("data", "diffs", "ingest_ns", "keys", "key_names")
+    __slots__ = ("data", "diffs", "ingest_ns", "keys", "key_names", "frame")
 
     def __init__(self, data: dict[str, Any], diffs: Any):
         self.data = data
@@ -48,6 +48,11 @@ class _Batch:
         #: — the post-fusion wordcount bottleneck (PR 14 headroom note)
         self.keys: Any = None
         self.key_names: tuple | None = None
+        #: the finished connector batch AS a wire frame
+        #: (``parallel.frames.connector_frame``): in process it carries
+        #: the built Delta by reference — the engine-side poll opens it
+        #: and asserts identity (zero-copy, LocalComm.exchange contract)
+        self.frame: Any = None
 
 
 #: process-wide ingest-build accounting (read by bench.py's ingest-split
@@ -77,11 +82,41 @@ INGEST_STAGE_STATS = {
     "flushes": 0,
 }
 
+#: the same staged split, keyed by connector (the subject's
+#: ``datasource_name``, or the fs source's ``fs-<format>``): the
+#: aggregate line above says ingest is the bottleneck, this says WHICH
+#: source — `pathway-tpu top` / the profiling hub's /query render one
+#: line per connector from it
+INGEST_CONNECTOR_STATS: dict[str, dict[str, int]] = {}
+
+
+def _connector_stage(name: str) -> dict[str, int]:
+    s = INGEST_CONNECTOR_STATS.get(name)
+    if s is None:
+        s = INGEST_CONNECTOR_STATS[name] = {
+            "parse_ns": 0, "hash_ns": 0, "delta_ns": 0,
+            "rows": 0, "flushes": 0,
+        }
+    return s
+
 
 def _stages_on() -> bool:
     from ..observability.profiler import enabled
 
     return enabled()
+
+
+def _stage_sinks(conn: str):
+    """(global split, per-connector split) when profiling is on, else
+    None — every parse path accrues through exactly this pair."""
+    if not _stages_on():
+        return None
+    return (INGEST_STAGE_STATS, _connector_stage(conn))
+
+
+def _accrue(sinks, key: str, v: int) -> None:
+    sinks[0][key] += v
+    sinks[1][key] += v
 
 
 class _SourceError:
@@ -102,6 +137,9 @@ class ConnectorSubject:
     _MAX_HOLD_S = 0.005
 
     def __init__(self, datasource_name: str = "python"):
+        #: names this subject in the per-connector ingest stage split
+        #: (INGEST_CONNECTOR_STATS → `pathway-tpu top` / hub /query)
+        self.datasource_name = datasource_name
         # SimpleQueue: C-implemented puts/gets, ~10x cheaper than Queue —
         # the per-row cross-thread handoff is the ingestion hot path
         self._queue: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
@@ -299,6 +337,17 @@ class PythonSubjectSource(RealtimeSource):
             for name, dtc in (dtypes or {}).items()
             if dt.unoptionalize(dtc) == dt.FLOAT
         )
+        # columns whose DECLARED dtype is STR/BYTES: schema-aware dtype
+        # promotion — they land as object columns by declaration, so the
+        # per-entry ``column_of_values`` type scan is skipped entirely
+        # on the rowwise hot path (the columnar-ingest contract: the
+        # schema, not the batch contents, picks the column dtype)
+        self._obj_cols = frozenset(
+            name
+            for name, dtc in (dtypes or {}).items()
+            if dt.unoptionalize(dtc) in (dt.STR, dt.BYTES)
+        )
+        self._conn_name = getattr(subject, "datasource_name", "python")
         self._partial: list[tuple[int, tuple, int | None]] = []  # (diff, row, key)
         #: AND of the plain-chunk flags accumulated into _partial — True
         #: means every entry is a bare kwargs dict, so the delta build
@@ -333,6 +382,9 @@ class PythonSubjectSource(RealtimeSource):
     #: registration is thread-local to the executor thread, so the
     #: subject-thread builder must be told explicitly)
     _keys_register = True
+    #: class-level defaults (also cover sources built piecemeal in tests)
+    _conn_name = "python"
+    _obj_cols: frozenset = frozenset()
 
     def start(self) -> None:
         # install the fused batch builder BEFORE the reader thread exists:
@@ -346,11 +398,12 @@ class PythonSubjectSource(RealtimeSource):
 
     def _prebuild_batch(self, batch: _Batch) -> None:
         """Producer-thread half of the batch path: columns → schema-ordered
-        normalized arrays + vectorized row keys (pure per-row work; the
+        normalized arrays + vectorized row keys + the finished Delta,
+        wrapped as a connector wire frame (pure per-row work; the
         engine-side poll keeps the skip/offset bookkeeping). Bit-identical
         to the engine-side build — ``K.mix_columns`` over the same
         normalized columns."""
-        stage = INGEST_STAGE_STATS if _stages_on() else None
+        stage = _stage_sinks(self._conn_name)
         t0 = _time.perf_counter_ns()
         data, n = self._batch_columns(batch)
         t1 = _time.perf_counter_ns() if stage is not None else 0
@@ -359,15 +412,32 @@ class PythonSubjectSource(RealtimeSource):
         else:
             key_names = tuple(self.names)
         batch.data = data
-        batch.keys = K.mix_columns(
+        batch.keys = K.mix_columns_fused(
             [data[c] for c in key_names], n, register=self._keys_register
         )
         batch.key_names = key_names
         t2 = _time.perf_counter_ns()
         if stage is not None:
-            stage["parse_ns"] += t1 - t0
-            stage["hash_ns"] += t2 - t1
-        INGEST_BUILD_STATS["subject_ns"] += t2 - t0
+            _accrue(stage, "parse_ns", t1 - t0)
+            _accrue(stage, "hash_ns", t2 - t1)
+        # assemble the Delta here too and ship it as a wire frame: the
+        # engine-side poll then just opens the frame (pass-by-reference
+        # in process — the columnar-ingest zero-copy seam)
+        from ..parallel import frames as _frames
+
+        diffs = (
+            np.ones(n, dtype=np.int64)
+            if batch.diffs is None
+            else np.asarray(batch.diffs, dtype=np.int64)
+        )
+        d = Delta(keys=batch.keys, data=data, diffs=diffs)
+        # key provenance for the fusion content-key reuse fast path
+        d.keys_content_cols = key_names
+        batch.frame = _frames.connector_frame(d)
+        t3 = _time.perf_counter_ns()
+        if stage is not None:
+            _accrue(stage, "delta_ns", t3 - t2)
+        INGEST_BUILD_STATS["subject_ns"] += t3 - t0
         INGEST_BUILD_STATS["subject_rows"] += n
 
     def attach_waker(self, event) -> None:
@@ -389,9 +459,9 @@ class PythonSubjectSource(RealtimeSource):
         # over columns is bit-identical to ``hash_values`` over the
         # corresponding row tuples) — no per-row tuple building, no
         # rows->columns transpose (VERDICT r4 #4, the per-row API tax).
-        from ..engine.delta import column_of_values
+        from ..engine.delta import _object_column, column_of_values
 
-        stage = INGEST_STAGE_STATS if _stages_on() else None
+        stage = _stage_sinks(self._conn_name)
         t0 = _time.perf_counter_ns() if stage is not None else 0
         self._emitted += len(entries)
         n = len(entries)
@@ -415,10 +485,15 @@ class PythonSubjectSource(RealtimeSource):
             except KeyError:
                 dflt = self.defaults.get(name)
                 col = [f.get(name, dflt) for f in fields_list]
-            data[name] = self._normalize(name, column_of_values(col))
+            if name in self._obj_cols:
+                # schema-aware promotion: a declared STR/BYTES column IS
+                # an object column — no per-entry type scan
+                data[name] = _object_column(col)
+            else:
+                data[name] = self._normalize(name, column_of_values(col))
         t_parse = _time.perf_counter_ns() if stage is not None else 0
         if stage is not None:
-            stage["parse_ns"] += t_parse - t0
+            _accrue(stage, "parse_ns", t_parse - t0)
         if plain:
             diffs = np.ones(n, dtype=np.int64)
         else:
@@ -441,7 +516,7 @@ class PythonSubjectSource(RealtimeSource):
         )
         if not explicit:
             h0 = _time.perf_counter_ns() if stage is not None else 0
-            keys = K.mix_columns(key_cols, n)
+            keys = K.mix_columns_fused(key_cols, n)
             h1 = _time.perf_counter_ns() if stage is not None else 0
             out = Delta(keys=keys, data=data, diffs=diffs)
             out.keys_content_cols = tuple(
@@ -451,9 +526,10 @@ class PythonSubjectSource(RealtimeSource):
                 # everything past the column extraction that is not the
                 # hash pass (diffs + Delta assembly) counts as delta
                 hash_dt = h1 - h0
-                stage["hash_ns"] += hash_dt
-                stage["delta_ns"] += (
-                    _time.perf_counter_ns() - t_parse - hash_dt
+                _accrue(stage, "hash_ns", hash_dt)
+                _accrue(
+                    stage, "delta_ns",
+                    _time.perf_counter_ns() - t_parse - hash_dt,
                 )
             return out
         # rows carrying an explicit key never USE their derived key —
@@ -469,7 +545,7 @@ class PythonSubjectSource(RealtimeSource):
         hash_dt = 0
         if keep.any():
             h0 = _time.perf_counter_ns() if stage is not None else 0
-            keys[keep] = K.mix_columns(
+            keys[keep] = K.mix_columns_fused(
                 [np.asarray(c)[keep] for c in key_cols], int(keep.sum())
             )
             if stage is not None:
@@ -478,8 +554,11 @@ class PythonSubjectSource(RealtimeSource):
             keys[i] = entries[i][2]
         out = Delta(keys=keys, data=data, diffs=diffs)
         if stage is not None:
-            stage["hash_ns"] += hash_dt
-            stage["delta_ns"] += _time.perf_counter_ns() - t_parse - hash_dt
+            _accrue(stage, "hash_ns", hash_dt)
+            _accrue(
+                stage, "delta_ns",
+                _time.perf_counter_ns() - t_parse - hash_dt,
+            )
         return out
 
     def _normalize(self, name: str, arr: np.ndarray) -> np.ndarray:
@@ -560,7 +639,26 @@ class PythonSubjectSource(RealtimeSource):
         (_prebuild_batch, fused key derivation); this engine-side path
         keeps only the skip/offset bookkeeping then — the fallback build
         covers batches enqueued before the source started."""
-        stage = INGEST_STAGE_STATS if _stages_on() else None
+        stage = _stage_sinks(self._conn_name)
+        if batch.frame is not None and self._skip == 0:
+            # the connector batch arrived AS a wire frame: open it and
+            # hand the Delta straight through. In process the frame is
+            # passed by reference, never serialized — the engine reads
+            # the very column buffers the producer thread filled
+            # (LocalComm.exchange's zero-copy contract, asserted here)
+            from ..parallel import frames as _frames
+
+            t_open = _time.perf_counter_ns() if stage is not None else 0
+            d = _frames.open_connector_frame(batch.frame)
+            assert d.data is batch.data, (
+                "connector frame must pass by reference in-process"
+            )
+            self._emitted += len(d)
+            if stage is not None:
+                _accrue(
+                    stage, "delta_ns", _time.perf_counter_ns() - t_open
+                )
+            return d
         if batch.keys is not None:
             data, n, keys = batch.data, len(batch.keys), batch.keys
             key_names = batch.key_names
@@ -573,11 +671,11 @@ class PythonSubjectSource(RealtimeSource):
                 key_names = tuple(self.names[i] for i in self.pk_indices)
             else:
                 key_names = tuple(self.names)
-            keys = K.mix_columns([data[c] for c in key_names], n)
+            keys = K.mix_columns_fused([data[c] for c in key_names], n)
             t_built = _time.perf_counter_ns()
             if stage is not None:
-                stage["parse_ns"] += t1 - t0
-                stage["hash_ns"] += t_built - t1
+                _accrue(stage, "parse_ns", t1 - t0)
+                _accrue(stage, "hash_ns", t_built - t1)
             INGEST_BUILD_STATS["engine_ns"] += t_built - t0
             INGEST_BUILD_STATS["engine_rows"] += n
         # recovery seek already counted skipped rows into _emitted
@@ -606,7 +704,7 @@ class PythonSubjectSource(RealtimeSource):
         if stage is not None:
             # skip/slice bookkeeping + Delta wrap (the whole engine-side
             # cost of a prebuilt batch)
-            stage["delta_ns"] += _time.perf_counter_ns() - t_built
+            _accrue(stage, "delta_ns", _time.perf_counter_ns() - t_built)
         return out
 
     def _flush_partial(self) -> None:
@@ -634,7 +732,7 @@ class PythonSubjectSource(RealtimeSource):
         if self._pending:
             from ..engine.delta import concat_deltas
 
-            stage = INGEST_STAGE_STATS if _stages_on() else None
+            stage = _stage_sinks(self._conn_name)
             t0 = _time.perf_counter_ns()
             d = (
                 self._pending[0]
@@ -646,9 +744,9 @@ class PythonSubjectSource(RealtimeSource):
             # engine-side build wall so the staged split sums to it
             INGEST_BUILD_STATS["engine_ns"] += dt
             if stage is not None:
-                stage["delta_ns"] += dt
-                stage["rows"] += len(d)
-                stage["flushes"] += 1
+                _accrue(stage, "delta_ns", dt)
+                _accrue(stage, "rows", len(d))
+                _accrue(stage, "flushes", 1)
             out.append(d)
             self._pending = []
             self._out_ingest.append(self._window_ingest_ns)

@@ -372,6 +372,84 @@ fail:
     return NULL;
 }
 
+/* mix_cols2(cols, n, salt_lo, salt_hi, fb_lo, fb_hi, memo_or_None,
+ *           out_lo_u64, out_hi_u64) -> None
+ * Fused column-key fold for the columnar ingest plane: accumulate every
+ * OBJECT column of a batch into both key lanes in one C pass —
+ * out[i] starts at ROW_SEED ^ salt and folds splitmix(acc ^ lane(v))
+ * per column, which is keys.mix_columns' per-column _column_lanes fold
+ * (and therefore hash_rows2 over the corresponding row tuples)
+ * bit-for-bit, without materializing per-column lane arrays or row
+ * tuples. Strings ride the same value-level memo as hash_rows2. */
+static PyObject *py_mix_cols2(PyObject *self, PyObject *args) {
+    PyObject *cols, *fb_lo, *fb_hi, *memo, *lo_obj, *hi_obj;
+    unsigned long long salt_lo, salt_hi;
+    Py_ssize_t n;
+    Py_buffer lo, hi;
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OnKKOOOOO", &cols, &n, &salt_lo, &salt_hi,
+                          &fb_lo, &fb_hi, &memo, &lo_obj, &hi_obj))
+        return NULL;
+    if (memo == Py_None) memo = NULL;
+    if (PyObject_GetBuffer(lo_obj, &lo, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0)
+        return NULL;
+    if (PyObject_GetBuffer(hi_obj, &hi, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) < 0) {
+        PyBuffer_Release(&lo);
+        return NULL;
+    }
+    {
+        PyObject *colseq = PySequence_Fast(cols, "cols must be a sequence");
+        Py_ssize_t ncols, c, i;
+        uint64_t *dlo = (uint64_t *)lo.buf, *dhi = (uint64_t *)hi.buf;
+        if (colseq == NULL) goto fail;
+        ncols = PySequence_Fast_GET_SIZE(colseq);
+        if ((Py_ssize_t)(lo.len / 8) < n || (Py_ssize_t)(hi.len / 8) < n) {
+            Py_DECREF(colseq);
+            PyErr_SetString(PyExc_ValueError, "output buffer too small");
+            goto fail;
+        }
+        for (i = 0; i < n; i++) {
+            dlo[i] = ROW_SEED ^ (uint64_t)salt_lo;
+            dhi[i] = ROW_SEED_HI ^ (uint64_t)salt_hi;
+        }
+        for (c = 0; c < ncols; c++) {
+            PyObject *col = PySequence_Fast_GET_ITEM(colseq, c);
+            PyObject *vals = PySequence_Fast(col, "column must be a sequence");
+            uint64_t l, h;
+            if (vals == NULL) {
+                Py_DECREF(colseq);
+                goto fail;
+            }
+            if (PySequence_Fast_GET_SIZE(vals) != n) {
+                Py_DECREF(vals);
+                Py_DECREF(colseq);
+                PyErr_SetString(PyExc_ValueError,
+                                "column length != row count");
+                goto fail;
+            }
+            for (i = 0; i < n; i++) {
+                if (hash_scalar2_memo(PySequence_Fast_GET_ITEM(vals, i),
+                                      fb_lo, fb_hi, memo, &l, &h) < 0) {
+                    Py_DECREF(vals);
+                    Py_DECREF(colseq);
+                    goto fail;
+                }
+                dlo[i] = splitmix(dlo[i] ^ l);
+                dhi[i] = splitmix2(dhi[i] ^ h);
+            }
+            Py_DECREF(vals);
+        }
+        Py_DECREF(colseq);
+    }
+    PyBuffer_Release(&lo);
+    PyBuffer_Release(&hi);
+    Py_RETURN_NONE;
+fail:
+    PyBuffer_Release(&lo);
+    PyBuffer_Release(&hi);
+    return NULL;
+}
+
 /* hash_rows2(rows, salt_lo, salt_hi, fb_lo, fb_hi, memo_or_None,
  *            out_lo_u64, out_hi_u64) -> None — both key lanes per row */
 static PyObject *py_hash_rows2(PyObject *self, PyObject *args) {
@@ -1076,6 +1154,8 @@ static PyMethodDef methods[] = {
      "hash_scalars(values, fallback, out_uint64_buffer[, memo])"},
     {"hash_rows2", py_hash_rows2, METH_VARARGS,
      "hash_rows2(rows, salt_lo, salt_hi, fb_lo, fb_hi, memo, out_lo, out_hi)"},
+    {"mix_cols2", py_mix_cols2, METH_VARARGS,
+     "mix_cols2(cols, n, salt_lo, salt_hi, fb_lo, fb_hi, memo, out_lo, out_hi)"},
     {"hash_scalars2", py_hash_scalars2, METH_VARARGS,
      "hash_scalars2(values, fb_lo, fb_hi, memo, out_lo, out_hi)"},
     {"blake2b8", py_blake2b8, METH_O, "8-byte BLAKE2b digest as uint64"},

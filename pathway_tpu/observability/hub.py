@@ -380,7 +380,7 @@ class ObservabilityHub:
             return {}
 
     @staticmethod
-    def ingest_stats_snapshot() -> dict[str, float]:
+    def ingest_stats_snapshot() -> dict[str, Any]:
         """This process's staged ingest cost split (parse | hash | delta
         seconds + rows/flushes — io/python.INGEST_STAGE_STATS), the
         measured form of ROADMAP item 2's "hashing + delta build ~60% of
@@ -396,13 +396,32 @@ class ObservabilityHub:
                 return {}
             if not s["flushes"] and not s["rows"]:
                 return {}
-            return {
+            out: dict[str, Any] = {
                 "parse_s": round(s["parse_ns"] / 1e9, 6),
                 "hash_s": round(s["hash_ns"] / 1e9, 6),
                 "delta_s": round(s["delta_ns"] / 1e9, 6),
                 "rows_total": float(s["rows"]),
                 "flushes_total": float(s["flushes"]),
             }
+            # per-connector stage split (io/python.INGEST_CONNECTOR_STATS)
+            # so the bottleneck connector is nameable cluster-wide, not
+            # just "ingest is slow somewhere"
+            from ..io.python import INGEST_CONNECTOR_STATS as per_conn
+
+            conns = {
+                name: {
+                    "parse_s": round(c["parse_ns"] / 1e9, 6),
+                    "hash_s": round(c["hash_ns"] / 1e9, 6),
+                    "delta_s": round(c["delta_ns"] / 1e9, 6),
+                    "rows_total": float(c["rows"]),
+                    "flushes_total": float(c["flushes"]),
+                }
+                for name, c in sorted(per_conn.items())
+                if c["rows"] or c["flushes"]
+            }
+            if conns:
+                out["connectors"] = conns
+            return out
         except Exception:
             # telemetry must not fail the run it observes
             return {}

@@ -301,6 +301,38 @@ def render_snapshots(
         # parse | hash | delta seconds per connector flush, as one
         # stage-labeled family so dashboards stack the split directly
         for key, value in sorted(gauges.items()):
+            if key == "connectors":
+                # nested per-connector split: one connector-labeled
+                # family so the bottleneck connector is nameable from
+                # the dashboard, not just "ingest is slow somewhere"
+                for cname, cg in sorted(value.items()):
+                    for ckey, cval in sorted(cg.items()):
+                        if ckey.endswith("_s"):
+                            r.add(
+                                "pathway_ingest_connector_stage_seconds_total",
+                                "counter",
+                                cval,
+                                {
+                                    "process": str(proc),
+                                    "connector": str(cname),
+                                    "stage": ckey[:-2],
+                                },
+                            )
+                        else:
+                            kind = (
+                                "counter" if ckey.endswith("_total")
+                                else "gauge"
+                            )
+                            r.add(
+                                f"pathway_ingest_connector_{ckey}",
+                                kind,
+                                cval,
+                                {
+                                    "process": str(proc),
+                                    "connector": str(cname),
+                                },
+                            )
+                continue
             if key.endswith("_s"):
                 r.add(
                     "pathway_ingest_stage_seconds_total",

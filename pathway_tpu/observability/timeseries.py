@@ -525,6 +525,16 @@ class SignalsPlane:
         # rule can watch ingest.hash_s grow faster than ingest.parse_s —
         # the columnar-ingest arc's regression tripwire (ROADMAP item 2)
         for key, value in self.hub.ingest_stats_snapshot().items():
+            if key == "connectors":
+                # per-connector stage split rides as nested dicts:
+                # ingest.conn.<name>.<stage> series name the bottleneck
+                # connector instead of one anonymous ingest total
+                for cname, gauges in value.items():
+                    for ckey, cval in gauges.items():
+                        self.store.record(
+                            f"ingest.conn.{cname}.{ckey}", float(cval), None, t
+                        )
+                continue
             self.store.record(f"ingest.{key}", float(value), None, t)
         # continuous-profiling scalars (observability/profiler.py):
         # samples_total proves the sampler is alive; op_tagged_share

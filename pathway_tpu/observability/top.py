@@ -252,6 +252,23 @@ def render_frame(doc: dict, now: float | None = None) -> str:
             f"{_fmt(g.get('rows_total'), nd=0)} row(s)/"
             f"{_fmt(g.get('flushes_total'), nd=0)} flush(es)"
         )
+        # per-connector stage split, costliest first: names the
+        # bottleneck connector instead of one anonymous ingest total
+        conns = g.get("connectors") or {}
+
+        def _conn_total(c: dict) -> float:
+            return (
+                c.get("parse_s", 0) + c.get("hash_s", 0) + c.get("delta_s", 0)
+            )
+
+        for cname in sorted(conns, key=lambda n: -_conn_total(conns[n])):
+            c = conns[cname]
+            lines.append(
+                f"  {cname}: parse {_fmt(c.get('parse_s'), 's', 3)}, "
+                f"hash {_fmt(c.get('hash_s'), 's', 3)}, "
+                f"delta {_fmt(c.get('delta_s'), 's', 3)} over "
+                f"{_fmt(c.get('rows_total'), nd=0)} row(s)"
+            )
     prof = doc.get("profile", {})
     # merged docs key profile by process; single-process docs are flat
     prof_by_proc = (

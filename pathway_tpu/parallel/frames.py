@@ -59,6 +59,8 @@ __all__ = [
     "decode_frame",
     "encode_control",
     "decodable_payload",
+    "connector_frame",
+    "open_connector_frame",
 ]
 
 KIND_PICKLE = 0  #: body[0] of a pickled control frame (allgather/ping/bye)
@@ -364,3 +366,34 @@ def decodable_payload(payload: Any) -> bool:
     LocalComm's no-serialization assertion use this to know which
     payloads the binary path covers)."""
     return _payload_entries(payload) is not None
+
+
+#: channel id of in-process connector-batch frames — the ingest→engine
+#: seam (a real exchange channel id is a non-negative edge id)
+INGEST_CHANNEL = -1
+
+
+def connector_frame(delta: Any, tick: int = -1, src: int = 0) -> tuple:
+    """Wrap one ingest Delta as a wire frame: a connector batch IS an
+    exchange frame, so handing it to the engine is the same operation as
+    handing it to a remote worker. In process the tuple carries the Delta
+    **by reference** (LocalComm.exchange's contract — never serialize on
+    a local hop); across processes ``encode_frame`` ships the identical
+    shape binary. Asserting decodability here means a columnar reader
+    can never build a batch the cluster data plane would refuse."""
+    assert decodable_payload(delta), (
+        "connector batch must be frame-codec decodable"
+    )
+    return ("x", INGEST_CHANNEL, tick, src, {src: delta}, None)
+
+
+def open_connector_frame(frame: Any) -> Any:
+    """Unwrap a connector-batch frame back to its Delta. An in-process
+    tuple returns the referenced Delta itself (pass-by-reference: callers
+    assert identity, like LocalComm.exchange); an encoded byte frame is
+    decoded through the columnar codec."""
+    if isinstance(frame, (bytes, bytearray, memoryview)):
+        _kind, _channel, _tick, src, per_dst, _ctx = decode_frame(frame)
+        return per_dst[src]
+    _kind, _channel, _tick, src, per_dst, _ctx = frame
+    return per_dst[src]

@@ -22,7 +22,21 @@ Checks, all AST-level over ``pathway_tpu/io/python.py``:
 3. inside ``_emit``, every ``put`` is guarded by a conditional (the
    chunk-size flush), never unconditional per-entry;
 4. no ``put`` anywhere in the module executes inside a ``for``/``while``
-   loop — the signature of a per-row flush path.
+   loop — the signature of a per-row flush path;
+5. the sanctioned columnar readers (``io/columnar.py``) decode in bulk:
+   no ``json.loads`` / ``csv.reader`` call inside a ``for``/``while``
+   loop — a per-row decode inside a "columnar" reader is the dict path
+   wearing a costume;
+6. the columnar batch path rides the wire-frame codec: ``io/python.py``
+   and ``io/fs.py`` must reference both ``connector_frame`` and
+   ``open_connector_frame`` (``parallel/frames.py``) — that pairing is
+   what makes a connector batch a PR 5 frame, pass-by-reference
+   in-process;
+7. every columnar parse path accrues the ingest stage split: the parse
+   entrypoints in ``io/fs.py`` and the delta builders in
+   ``io/python.py`` must call ``_stage_sinks`` (the
+   ``INGEST_STAGE_STATS`` / per-connector accrual seam) so the
+   profile_metrics surface covers the new paths.
 
 Rides the shared AST-gate framework (``pathway_tpu/analysis/astgate.py``)
 and registers as the ``ingest_paths`` gate for ``scripts/check_all.py``.
@@ -121,6 +135,95 @@ def check(path: str | None = None) -> list[str]:
             f"python.py:{lineno} queue put inside a loop "
             "(per-row flush path)"
         )
+
+    problems += _check_columnar_readers()
+    problems += _check_frame_codec_and_stage_stats(tree)
+    return problems
+
+
+#: bulk decoders in io/columnar.py — each must decode its chunk in ONE
+#: library call, never per row
+COLUMNAR_READERS = (
+    "parse_csv_chunk", "parse_json_chunk", "parse_plaintext_chunk",
+    "_pyarrow_csv",
+)
+
+#: decode calls that mark a per-row parse when they appear inside a loop
+_ROWWISE_DECODERS = ("loads", "reader")
+
+
+def _module_functions(tree: ast.Module) -> dict[str, ast.FunctionDef]:
+    return {
+        node.name: node
+        for node in tree.body
+        if isinstance(node, ast.FunctionDef)
+    }
+
+
+def _check_columnar_readers() -> list[str]:
+    """5. sanctioned columnar readers decode in bulk (no per-row Python)."""
+    path = os.path.join(astgate.PACKAGE_DIR, "io", "columnar.py")
+    if not os.path.exists(path):
+        return ["io/columnar.py missing (columnar ingest plane removed?)"]
+    tree = ast.parse(astgate.read_text(path), filename=path)
+    fns = _module_functions(tree)
+    problems: list[str] = []
+    for name in COLUMNAR_READERS:
+        fn = fns.get(name)
+        if fn is None:
+            problems.append(
+                f"columnar.py: sanctioned reader {name}() not found"
+            )
+            continue
+        for decoder in _ROWWISE_DECODERS:
+            for lineno in astgate.calls_inside_loops(fn, decoder):
+                problems.append(
+                    f"columnar.py:{lineno} {name}() calls {decoder}() "
+                    "inside a loop (per-row decode in a columnar reader)"
+                )
+    return problems
+
+
+def _check_frame_codec_and_stage_stats(python_tree: ast.Module) -> list[str]:
+    """6.+7. the columnar batch path rides the frame codec and accrues
+    the ingest stage split on every parse path."""
+    problems: list[str] = []
+    fs_path = os.path.join(astgate.PACKAGE_DIR, "io", "fs.py")
+    fs_tree = ast.parse(astgate.read_text(fs_path), filename=fs_path)
+
+    # 6. connector batches ARE wire frames, opened by reference
+    for fname, tree in (("python.py", python_tree), ("fs.py", fs_tree)):
+        calls = astgate.calls_in(tree)
+        for required in ("connector_frame", "open_connector_frame"):
+            if required not in calls:
+                problems.append(
+                    f"{fname}: columnar batch path does not call "
+                    f"{required}() (connector batches must ride the "
+                    "parallel/frames.py codec)"
+                )
+
+    # 7. stage-split accrual covers every parse path
+    fs_methods = astgate.method_defs(fs_tree, "FsStreamSource")
+    py_methods = astgate.method_defs(python_tree, "PythonSubjectSource")
+    for fname, methods, names in (
+        ("fs.py", fs_methods, ("_ingest_lines", "poll")),
+        (
+            "python.py",
+            py_methods,
+            ("_prebuild_batch", "_make_delta", "_make_batch_delta"),
+        ),
+    ):
+        for name in names:
+            fn = methods.get(name)
+            if fn is None:
+                problems.append(f"{fname}: parse path {name}() not found")
+                continue
+            if "_stage_sinks" not in astgate.calls_in(fn):
+                problems.append(
+                    f"{fname}:{fn.lineno} {name}() does not accrue the "
+                    "ingest stage split (_stage_sinks/_accrue missing — "
+                    "INGEST_STAGE_STATS coverage regressed)"
+                )
     return problems
 
 

@@ -1,0 +1,431 @@
+"""Columnar-native ingest plane (ISSUE 20): dtype-promotion parity,
+bit-identical row keys, row-error parity, the zero-copy connector-batch
+wire frame, kafka/debezium batch decode, and SIGKILL recovery across a
+columnar flush.
+
+The contract under test: for every connector, the columnar parse path
+either produces BIT-IDENTICAL results to the per-row dict path — same
+row multiset, same column dtypes, same engine keys, same exceptions on
+malformed input — or refuses the chunk (``columnar.ParseRefusal``) and
+falls back to the dict path for exactly that chunk. ``PATHWAY_INGEST_
+COLUMNAR=0`` is the whole-plane escape hatch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io.fs import FsStreamSource
+from pathway_tpu.io.python import ConnectorSubject, PythonSubjectSource, _Batch
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    G.clear()
+    yield
+    G.clear()
+
+
+def _boom_parse_line(self, fpath, line):  # pragma: no cover - must not run
+    raise AssertionError(
+        "dict-path _parse_line ran while the columnar plane was on"
+    )
+
+
+def _fs_delta(
+    tmp_path, monkeypatch, *, columnar, text, format, schema, names,
+    fname="data.in", assert_columnar=False,
+):
+    monkeypatch.setenv("PATHWAY_INGEST_COLUMNAR", "1" if columnar else "0")
+    p = tmp_path / (("on_" if columnar else "off_") + fname)
+    p.write_text(text)
+    src = FsStreamSource(str(p), format, schema, names, autocommit_ms=None)
+    if assert_columnar:
+        monkeypatch.setattr(FsStreamSource, "_parse_line", _boom_parse_line)
+    try:
+        out = src.poll()
+    finally:
+        if assert_columnar:
+            monkeypatch.undo()
+    assert len(out) == 1
+    return out[0]
+
+
+def _rows_of(delta, names):
+    return Counter(zip(*[list(delta.data[n]) for n in names]))
+
+
+PARITY_CASES = [
+    (
+        "csv",
+        "name,age,score,ok\nalice,30,1.5,true\nbob,41,2.0,false\n"
+        "carol,0,-3.25,true\n",
+        {"name": str, "age": int, "score": float, "ok": bool},
+    ),
+    (
+        "jsonlines",
+        '{"name": "alice", "age": 30, "score": 1.5, "ok": true}\n'
+        '{"name": "bob", "age": 41, "score": 2.0, "ok": false}\n'
+        '{"name": "carol", "age": 0, "score": -3.25, "ok": true}\n',
+        {"name": str, "age": int, "score": float, "ok": bool},
+    ),
+    ("plaintext", "alpha\nbeta\ngamma\nalpha\n", {"data": str}),
+]
+
+
+@pytest.mark.parametrize(
+    "format,text,types", PARITY_CASES, ids=[c[0] for c in PARITY_CASES]
+)
+def test_fs_promotion_parity_matrix(tmp_path, monkeypatch, format, text, types):
+    """Every connector format: the columnar parse produces a
+    multiset-identical row set, identical column dtypes, and BIT-identical
+    engine keys vs the per-row dict path — with the dict-path parser
+    provably never invoked on the columnar arm."""
+    schema = pw.schema_from_types(**types)
+    names = list(types)
+    d_on = _fs_delta(
+        tmp_path, monkeypatch, columnar=True, text=text, format=format,
+        schema=schema, names=names, assert_columnar=True,
+    )
+    d_off = _fs_delta(
+        tmp_path, monkeypatch, columnar=False, text=text, format=format,
+        schema=schema, names=names,
+    )
+    assert np.array_equal(d_on.keys, d_off.keys), "row keys diverged"
+    assert _rows_of(d_on, names) == _rows_of(d_off, names)
+    for n in names:
+        a = np.asarray(d_on.data[n])
+        b = np.asarray(d_off.data[n])
+        assert a.dtype == b.dtype, (n, a.dtype, b.dtype)
+
+
+def test_csv_declared_float_coercion_keys(tmp_path, monkeypatch):
+    """The ISSUE 5 ghost-row case through the file reader: a
+    float-declared column whose lexical form is int ("1") vs float
+    ("1.0") must hash to the SAME key — on both the columnar and dict
+    paths."""
+    schema = pw.schema_from_types(x=float)
+    keys = {}
+    for tag, text in (("int", "x\n1\n2\n"), ("float", "x\n1.0\n2.5\n")):
+        for columnar in (True, False):
+            d = _fs_delta(
+                tmp_path, monkeypatch, columnar=columnar, text=text,
+                format="csv", schema=schema, names=["x"],
+                fname=f"{tag}.csv",
+            )
+            assert np.asarray(d.data["x"]).dtype == np.float64
+            keys[(tag, columnar)] = int(d.keys[0])
+    assert len(set(keys.values())) == 1, keys
+
+
+def test_csv_primary_key_parity(tmp_path, monkeypatch):
+    """Declared primary keys hash the pk subset only — identically on
+    both paths (the columnar path mixes the pk column buffers, the dict
+    path hashes pk-subset tuples)."""
+    schema = pw.schema_builder({
+        "id": pw.column_definition(dtype=int, primary_key=True),
+        "v": pw.column_definition(dtype=str),
+    })
+    text = "id,v\n1,aa\n2,bb\n"
+    d_on = _fs_delta(
+        tmp_path, monkeypatch, columnar=True, text=text, format="csv",
+        schema=schema, names=["id", "v"], assert_columnar=True,
+    )
+    d_off = _fs_delta(
+        tmp_path, monkeypatch, columnar=False, text=text, format="csv",
+        schema=schema, names=["id", "v"],
+    )
+    assert np.array_equal(d_on.keys, d_off.keys)
+    # pk keys are value-independent: same ids + different v = same keys
+    d_on2 = _fs_delta(
+        tmp_path, monkeypatch, columnar=True, text="id,v\n1,zz\n2,ww\n",
+        format="csv", schema=schema, names=["id", "v"], fname="alt.csv",
+    )
+    assert np.array_equal(d_on.keys, d_on2.keys)
+
+
+@pytest.mark.parametrize(
+    "format,text,types",
+    [
+        ("csv", "x\n1\nabc\n", {"x": int}),
+        ("jsonlines", '{"x": 1}\n{"x": oops}\n', {"x": int}),
+    ],
+    ids=["csv-bad-int", "jsonlines-bad-line"],
+)
+def test_malformed_input_error_parity(
+    tmp_path, monkeypatch, format, text, types
+):
+    """A malformed cell/line raises the SAME exception (type and
+    message) on both paths: the columnar chunk refuses and the per-row
+    fallback re-raises exactly where the dict path always did."""
+    schema = pw.schema_from_types(**types)
+    names = list(types)
+    errors = {}
+    for columnar in (True, False):
+        with pytest.raises(ValueError) as exc:
+            _fs_delta(
+                tmp_path, monkeypatch, columnar=columnar, text=text,
+                format=format, schema=schema, names=names,
+            )
+        errors[columnar] = (type(exc.value), str(exc.value))
+    assert errors[True] == errors[False], errors
+
+
+def test_rowwise_dict_ingest_matches_columnar_batch():
+    """Rowwise ``next()`` ingest rides the same columnar machinery: the
+    dict-built delta and the producer-prebuilt batch delta carry the
+    same keys, data, and dtypes — including the declared-str promotion
+    that skips the per-entry type scan."""
+    subject = ConnectorSubject()
+    src = PythonSubjectSource(
+        subject, ["word", "x"], {}, None, autocommit_ms=None,
+        dtypes={"word": dt.STR, "x": dt.INT},
+    )
+    d_rows = src._make_delta([
+        {"word": "a", "x": 1}, {"word": "b", "x": 2},
+    ])
+    subject.next_batch({"word": ["a", "b"], "x": [1, 2]})
+    d_batch = src._make_batch_delta(subject._queue.get())
+    assert np.array_equal(d_rows.keys, d_batch.keys)
+    for n in ("word", "x"):
+        a, b = np.asarray(d_rows.data[n]), np.asarray(d_batch.data[n])
+        assert a.dtype == b.dtype
+        assert list(a) == list(b)
+    # declared STR landed as an object column without the type scan
+    assert np.asarray(d_rows.data["word"]).dtype == object
+
+
+def test_connector_batch_frame_passes_by_reference():
+    """A connector batch IS a wire frame: the producer thread wraps the
+    prebuilt Delta with ``connector_frame`` and the engine-side open
+    returns the SAME buffers — pass-by-reference in-process, the
+    ``LocalComm.exchange`` contract (zero-copy proof for the tentpole
+    acceptance bar)."""
+    from pathway_tpu.parallel import frames as _frames
+
+    subject = ConnectorSubject()
+    src = PythonSubjectSource(
+        subject, ["word"], {}, None, autocommit_ms=None,
+        dtypes={"word": dt.STR},
+    )
+    # what src.start() installs before spawning the reader thread
+    subject._batch_builder = src._prebuild_batch
+    subject.next_batch({"word": ["a", "b", "c"]})
+    item = subject._queue.get()
+    assert isinstance(item, _Batch)
+    assert item.frame is not None, "producer did not wrap the batch"
+    opened = _frames.open_connector_frame(item.frame)
+    assert opened.data is item.data, "frame copied instead of referenced"
+    d = src._make_batch_delta(item)
+    assert d.data is item.data, (
+        "engine-side open must hand the producer's buffers through "
+        "by reference"
+    )
+    assert np.array_equal(d.keys, item.keys)
+
+
+class _FakeMsg:
+    def __init__(self, v):
+        self._v = v
+
+    def value(self):
+        return self._v
+
+    def error(self):
+        return None
+
+
+def test_kafka_batch_decode_columns():
+    """The kafka json poll burst decodes with ONE json.loads and lands
+    as next_batch columns, schema defaults filled per row."""
+    from pathway_tpu.io.kafka import _KafkaSubject
+
+    sub = _KafkaSubject(
+        object(), ["t"], "json", names=["word", "x"], defaults={"x": 0},
+    )
+    batches, commits = [], []
+    sub.next_batch = lambda data: batches.append(data)  # type: ignore
+    sub.commit = lambda: commits.append(1)  # type: ignore
+    sub._emit_batch([
+        _FakeMsg(b'{"word": "a", "x": 1}'),
+        _FakeMsg(b'{"word": "b"}'),
+    ])
+    assert batches == [{"word": ["a", "b"], "x": [1, 0]}]
+    assert commits == [1]
+
+
+def test_kafka_batch_decode_falls_back_rowwise():
+    """A burst whose joined decode fails re-runs per message — the same
+    values, the same commit cadence, and the raise lands at the exact
+    message the row-wise path would have raised at."""
+    from pathway_tpu.io.kafka import _KafkaSubject
+
+    sub = _KafkaSubject(
+        object(), ["t"], "json", names=["word"], defaults={},
+    )
+    nexts, commits = [], []
+    sub.next = lambda **row: nexts.append(row)  # type: ignore
+    sub.commit = lambda: commits.append(1)  # type: ignore
+    with pytest.raises(ValueError):
+        sub._emit_batch([_FakeMsg(b'{"word": "a"}'), _FakeMsg(b"not json")])
+    assert nexts == [{"word": "a"}]
+    assert commits == [1]
+
+
+def test_debezium_batch_decode_keeps_commit_cadence():
+    """Envelopes batch-decode with one json.loads, but commits stay
+    per-envelope: a CDC retract+insert pair squeezed into one tick
+    would cancel before any subscriber saw it."""
+    from pathway_tpu.io.debezium import _DebeziumSubject
+
+    envs = [
+        json.dumps({"payload": {"op": "c", "after": {"id": 1, "v": "a"}}}),
+        json.dumps({"payload": {"op": "u", "before": {"id": 1, "v": "a"},
+                                "after": {"id": 1, "v": "b"}}}),
+        json.dumps({"payload": {"op": "d", "before": {"id": 1, "v": "b"}}}),
+    ]
+    sub = _DebeziumSubject(envs)
+    events, commits = [], []
+    sub.next = lambda **row: events.append(("add", row["v"]))  # type: ignore
+    sub._remove = (  # type: ignore
+        lambda **row: events.append(("del", row["v"]))
+    )
+    sub.commit = lambda: commits.append(len(events))  # type: ignore
+    sub.run()
+    assert events == [
+        ("add", "a"), ("del", "a"), ("add", "b"), ("del", "b"),
+    ]
+    # one commit per envelope, at the right row boundaries
+    assert commits == [1, 3, 4]
+
+
+_CHAOS_PROGRAM = """
+import json, sys
+
+import pathway_tpu as pw
+from pathway_tpu.persistence import Backend, Config
+
+in_path, out_path, pstate, n_total = (
+    sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4])
+)
+
+t = pw.io.fs.read(
+    in_path, format="plaintext", schema=pw.schema_from_types(data=str),
+    mode="streaming", autocommit_duration_ms=20, name="words",
+)
+counts = t.groupby(pw.this.data).reduce(pw.this.data, c=pw.reducers.count())
+f = open(out_path, "a")
+finals = {}
+
+
+def on_change(key, row, time, is_addition):
+    if is_addition:
+        finals[row["data"]] = int(row["c"])
+    f.write(json.dumps([row["data"], int(row["c"]), bool(is_addition)]) + "\\n")
+    f.flush()
+    if sum(finals.values()) >= n_total:
+        pw.request_stop()
+
+
+pw.io.subscribe(counts, on_change=on_change)
+cfg = Config.simple_config(Backend.filesystem(pstate), snapshot_interval_ms=20)
+pw.run(persistence_config=cfg)
+"""
+
+
+def _finals(path):
+    out = {}
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            try:  # SIGKILL may tear the last line mid-write
+                w, c, add = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if add:
+                out[w] = int(c)
+    return out
+
+
+def test_sigkill_mid_columnar_flush_recovers_exact_counts(tmp_path):
+    """Chaos leg: SIGKILL the engine while the columnar fs reader is
+    mid-stream (chunks parsed, some staged, some delivered), restart
+    over the same persisted state, and the final counts are EXACT —
+    offsets advance only at delivery boundaries, never for staged
+    chunks the crash threw away."""
+    words = [f"w{i % 8}" for i in range(400)]
+    prog = tmp_path / "prog.py"
+    prog.write_text(textwrap.dedent(_CHAOS_PROGRAM))
+    inp = tmp_path / "words.txt"
+    out = tmp_path / "events.jsonl"
+    pstate = tmp_path / "pstate"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo_root,
+        "PATHWAY_THREADS": "1",
+        "PATHWAY_INGEST_COLUMNAR": "1",
+        # small chunks: the kill window spans many parse/flush boundaries
+        "PATHWAY_INGEST_CHUNK": "16",
+    }
+    argv = [
+        sys.executable, str(prog), str(inp), str(out), str(pstate),
+        str(len(words)),
+    ]
+
+    # the input file grows WHILE the reader runs: the first half streams
+    # in, the kill lands mid-stream (the second half does not exist yet,
+    # so the killed run CANNOT have seen the full input), the rest lands
+    # on disk before the restart
+    half = len(words) // 2
+    inp.write_text("")
+    p = subprocess.Popen(argv, env=env)
+    try:
+        with open(inp, "a") as f:
+            for i in range(0, half, 50):
+                f.write("".join(w + "\n" for w in words[i:i + 50]))
+                f.flush()
+                time.sleep(0.12)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if sum(_finals(out).values()) >= 100:
+                break
+            if p.poll() is not None:
+                raise AssertionError("program finished before the kill")
+            time.sleep(0.02)
+        else:
+            raise AssertionError(f"no progress before kill: {_finals(out)}")
+        os.kill(p.pid, signal.SIGKILL)
+        p.wait(timeout=30)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    with open(inp, "a") as f:
+        f.write("".join(w + "\n" for w in words[half:]))
+
+    killed = _finals(out)
+    assert killed, "kill landed before any output"
+    assert sum(killed.values()) < len(words), (
+        "kill landed after the stream completed — not a mid-run crash"
+    )
+
+    # restart over the same persisted state; the full input is on disk,
+    # so the run drains to exact counts and stops itself
+    subprocess.run(argv, env=env, check=True, timeout=120)
+    want = dict(Counter(words))
+    assert _finals(out) == want

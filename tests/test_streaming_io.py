@@ -217,3 +217,136 @@ def test_fs_streaming_object_semantics(tmp_path):
     threading.Thread(target=writer, daemon=True).start()
     pw.run()
     assert sorted(acc.items()) == [("beta", 1), ("gamma", 1)]
+
+
+class _FakeWebserver:
+    """Just enough surface for rest_connector's unit tests: route
+    registry + terminate hook, no sockets."""
+
+    def __init__(self):
+        self._routes = {}
+        self._loop = None
+
+    def _add_route(self, route, methods, handler):
+        pass
+
+    def terminate(self):
+        pass
+
+
+class TestServeQuiescent:
+    def _capture_writer(self, monkeypatch):
+        """Build a rest (queries, writer) pair on a fake webserver, route
+        the writer's subscribe() into captured closures and _complete()
+        into a recorded list."""
+        import pathway_tpu.io as pwio
+        from pathway_tpu.io.http._server import _RestSubject
+
+        captured = {}
+
+        def fake_subscribe(table, **kwargs):
+            captured.update(kwargs)
+
+        monkeypatch.setattr(pwio, "subscribe", fake_subscribe)
+        completed = []
+        monkeypatch.setattr(
+            _RestSubject,
+            "_complete",
+            lambda self, key, value: completed.append((key, value)),
+        )
+        queries, writer = pw.io.http.rest_connector(
+            webserver=_FakeWebserver(),
+            schema=pw.schema_from_types(query=str),
+        )
+        writer(queries.select(result=pw.this.query))
+        return captured, completed
+
+    def test_quiescent_holds_until_frontier(self, monkeypatch):
+        """Frontier-quiescent respond(): with the knob on (default), the
+        HTTP future resolves only at on_time_end — a later wave in the
+        same commit tick that retracts + replaces the first emission wins,
+        and the client never sees the partial value."""
+        monkeypatch.delenv("PATHWAY_SERVE_QUIESCENT", raising=False)
+        captured, completed = self._capture_writer(monkeypatch)
+        on_change = captured["on_change"]
+        on_time_end = captured["on_time_end"]
+
+        # wave 1: an early operator emits a partial answer
+        on_change(7, {"result": "partial"}, 1, True)
+        assert completed == []  # held — frontier has not passed
+        # wave 2 (same tick): downstream retracts it and emits the full one
+        on_change(7, {"result": "partial"}, 1, False)
+        on_change(7, {"result": "full"}, 1, True)
+        assert completed == []
+        # frontier passes every operator on the path: respond now
+        on_time_end(1)
+        assert completed == [(7, "full")]
+        # the buffer drained — a later tick does not re-complete
+        on_time_end(2)
+        assert completed == [(7, "full")]
+
+    def test_legacy_first_emission_resolves_immediately(self, monkeypatch):
+        """PATHWAY_SERVE_QUIESCENT=0 restores the legacy first-emission
+        behavior: the partial value goes out the moment it appears."""
+        monkeypatch.setenv("PATHWAY_SERVE_QUIESCENT", "0")
+        captured, completed = self._capture_writer(monkeypatch)
+        assert "on_time_end" not in captured  # legacy arm never buffers
+        captured["on_change"](7, {"result": "partial"}, 1, True)
+        assert completed == [(7, "partial")]
+
+    def test_quiescent_rest_over_collapsed_index_join(self):
+        """End-to-end serve smoke on the collapsed DataIndex join: the
+        quiescent default answers the SETTLED top-k row — the cascade
+        query → BM25 index join → collapse → select all quiesces before
+        the HTTP future resolves."""
+        from pathway_tpu import indexing
+        from pathway_tpu.internals.table_io import rows_to_table
+
+        queries, writer = pw.io.http.rest_connector(
+            host="127.0.0.1",
+            port=18414,
+            schema=pw.schema_from_types(query=str),
+        )
+        docs = rows_to_table(
+            ["name", "text"],
+            [
+                ("a", "the quick brown fox jumps over the lazy dog"),
+                ("b", "pack my box with five dozen liquor jugs"),
+                ("c", "the brown dog sleeps by the fire"),
+            ],
+        )
+        inner = indexing.TantivyBM25(data_column=docs.text)
+        jr = indexing.DataIndex(docs, inner).query_as_of_now(
+            queries.query, number_of_matches=2
+        )
+        writer(jr.select(result=pw.right.name))
+
+        answers = []
+
+        def client():
+            import requests
+
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                try:
+                    r = requests.post(
+                        "http://127.0.0.1:18414/",
+                        json={"query": "brown dog"},
+                        timeout=10,
+                    )
+                    answers.append((r.status_code, r.json()))
+                    break
+                except Exception:
+                    time.sleep(0.1)
+            from pathway_tpu.io.http._server import terminate_all
+
+            terminate_all()
+
+        th = threading.Thread(target=client, daemon=True)
+        th.start()
+        pw.run()
+        th.join(timeout=10)
+        assert len(answers) == 1
+        code, body = answers[0]
+        assert code == 200
+        assert sorted(body) == ["a", "c"]
